@@ -1,0 +1,15 @@
+"""Pytest root conftest: force an 8-device virtual CPU mesh BEFORE jax
+initializes any backend (SURVEY §4 "fake-backend note": multi-chip tests run
+on xla_force_host_platform_device_count virtual devices)."""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon PJRT plugin (TPU tunnel) registers itself via sitecustomize in
+# every interpreter; tests must run CPU-only even when the tunnel is down.
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]

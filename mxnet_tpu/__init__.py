@@ -1,0 +1,51 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with MXNet's capability
+surface.
+
+Brand-new design (NOT a port) targeting JAX/XLA/Pallas/pjit:
+
+- ``mx.nd`` / ``mx.np``: imperative NDArray backed by jax.Array (PJRT HBM
+  buffers); async semantics come from XLA dispatch, not a threaded engine.
+- ``mx.autograd``: dynamic tape whose nodes are jax.vjp closures.
+- ``mx.gluon``: Block/HybridBlock/Trainer; hybridize() traces the block into
+  one jit-compiled XLA computation (the CachedOp equivalent).
+- ``mx.kvstore`` + ``mxnet_tpu.parallel``: data/tensor/pipeline/sequence
+  parallelism via jax.sharding Mesh + collectives over ICI.
+- Hot ops as Pallas TPU kernels (mxnet_tpu/ops/pallas_*).
+
+Reference capability map: SURVEY.md at the repo root (mozga-intel/
+incubator-mxnet structural survey).
+"""
+from __future__ import annotations
+
+__version__ = "2.0.0-tpu0"
+
+from . import autograd, base, context, engine
+from . import ndarray
+from . import ndarray as nd
+from . import random
+from .base import MXNetError, get_env
+from .context import (Context, cpu, cpu_pinned, current_context, gpu,
+                      num_gpus, num_tpus, tpu)
+from .ndarray.ndarray import NDArray, waitall
+
+# lazily-importable heavy submodules
+from . import initializer  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import gluon  # noqa: E402
+from . import numpy as np  # noqa: E402
+from . import numpy_extension as npx  # noqa: E402
+from . import kvstore as kv  # noqa: E402
+from . import kvstore  # noqa: E402
+from . import io  # noqa: E402
+from . import recordio  # noqa: E402
+from . import symbol  # noqa: E402
+from . import symbol as sym  # noqa: E402
+from . import profiler  # noqa: E402
+from . import runtime  # noqa: E402
+from . import util  # noqa: E402
+from . import parallel  # noqa: E402
+from . import test_utils  # noqa: E402
+from . import contrib  # noqa: E402
+from . import metric  # noqa: E402  (alias of gluon.metric, reference layout)
+from . import image  # noqa: E402
+from .util import is_np_array, set_np, reset_np, use_np  # noqa: E402

@@ -1,0 +1,309 @@
+"""Imperative autograd: record / pause / backward on a dynamic tape.
+
+Reference design: MXNet's ``Imperative`` runtime records every executed op
+into an nnvm graph hanging off ``NDArray.autograd_entry_``
+(src/imperative/imperative.cc:204 RecordOp, :385 Backward) and runs the
+``Gradient`` pass (src/nnvm/gradient.cc:85) to build the backward graph.
+
+TPU-native redesign: there is no hand-written per-op FGradient table.  At
+record time each op is executed through ``jax.vjp`` — XLA differentiates the
+op and keeps the residuals on-device — and the resulting vjp closure becomes
+the tape node.  ``backward()`` is a reverse topological sweep over tape
+nodes; gradient *execution* therefore runs through the same XLA dispatch as
+forward.  Hybridized blocks record a single tape node for their whole fused
+XLA computation, which is the CachedOp-backward equivalent
+(src/imperative/cached_op.cc:1016) for free.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from .base import MXNetError, thread_state
+
+__all__ = [
+    "record", "pause", "train_mode", "predict_mode",
+    "is_recording", "is_training", "set_recording", "set_training",
+    "mark_variables", "backward", "grad", "get_symbol", "Function",
+]
+
+
+def is_recording():
+    return thread_state.is_recording
+
+
+def is_training():
+    return thread_state.is_training
+
+
+def set_recording(is_record):
+    prev = thread_state.is_recording
+    thread_state.is_recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode_):
+    prev = thread_state.is_training
+    thread_state.is_training = bool(train_mode_)
+    return prev
+
+
+@contextlib.contextmanager
+def _mode(record=None, train=None):
+    prev_r = thread_state.is_recording
+    prev_t = thread_state.is_training
+    if record is not None:
+        thread_state.is_recording = record
+    if train is not None:
+        thread_state.is_training = train
+    try:
+        yield
+    finally:
+        thread_state.is_recording = prev_r
+        thread_state.is_training = prev_t
+
+
+def record(train_mode=True):  # pylint: disable=redefined-outer-name
+    """Scope: record ops for autograd (reference python/mxnet/autograd.py:121)."""
+    return _mode(record=True, train=train_mode)
+
+
+def pause(train_mode=False):  # pylint: disable=redefined-outer-name
+    return _mode(record=False, train=train_mode)
+
+
+def train_mode():
+    return _mode(train=True)
+
+
+def predict_mode():
+    return _mode(train=False)
+
+
+class TapeNode:
+    """One recorded op: holds the vjp closure (residuals live on device)."""
+
+    __slots__ = ("vjp_fn", "inputs", "n_outputs", "out_avals", "seq", "name")
+    _counter = [0]
+
+    def __init__(self, vjp_fn, inputs, n_outputs, out_avals=None, name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs          # list of NDArray (kept alive for graph walk)
+        self.n_outputs = n_outputs
+        self.out_avals = out_avals    # [(shape, dtype)] for zero-cotangent fill
+        self.name = name
+        TapeNode._counter[0] += 1
+        self.seq = TapeNode._counter[0]
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers (reference autograd.py:196)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, gradient, req in zip(variables, gradients, grad_reqs):
+        var._grad = gradient if req != "null" else None
+        var._grad_req = req
+        var._entry = None
+        var._marked = True
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # pylint: disable=redefined-outer-name
+    """Run the backward sweep from ``heads``; accumulate into ``.grad``.
+
+    Reference: Imperative::Backward (src/imperative/imperative.cc:385).
+    """
+    _backward_impl(heads, head_grads, retain_graph, create_graph=False,
+                   accumulate=True)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):  # pylint: disable=redefined-outer-name
+    """Return gradients of heads w.r.t. variables (reference autograd.py:272)."""
+    variables = _as_list(variables)
+    grads = _backward_impl(heads, head_grads, retain_graph, create_graph,
+                           accumulate=False, variables=variables)
+    out = []
+    for v in variables:
+        g = grads.get(id(v))
+        if g is None:
+            raise MXNetError("one of the requested variables is unreachable "
+                             "from the heads")
+        out.append(g)
+    return out
+
+
+def _backward_impl(heads, head_grads, retain_graph, create_graph,
+                   accumulate, variables=None):
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray
+
+    heads = _as_list(heads)
+    unmark = []
+    if variables is not None:
+        for v in variables:
+            if not getattr(v, "_marked", False):
+                v._marked = True
+                unmark.append(v)
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    else:
+        head_grads = _as_list(head_grads)
+
+    # Cotangent store: (id(node), out_index) -> jax array; plus variable grads.
+    cotangents = {}
+    var_grads = {}
+    roots = []
+    for head, hgrad in zip(heads, head_grads):
+        entry = getattr(head, "_entry", None)
+        g = hgrad._data if isinstance(hgrad, NDArray) else (
+            hgrad if hgrad is not None else jnp.ones_like(head._data))
+        if entry is None:
+            if getattr(head, "_marked", False):
+                var_grads[id(head)] = _accum(var_grads.get(id(head)), g)
+            continue
+        node, idx = entry
+        key = (id(node), idx)
+        cotangents[key] = _accum(cotangents.get(key), g)
+        roots.append(node)
+
+    # Collect reachable nodes, then process in reverse creation order (a
+    # valid reverse topological order for a tape).
+    seen = {}
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen[id(node)] = node
+        for inp in node.inputs:
+            e = getattr(inp, "_entry", None)
+            if e is not None:
+                stack.append(e[0])
+    order = sorted(seen.values(), key=lambda n: n.seq, reverse=True)
+
+    for node in order:
+        # vjp closures were built over a tuple-valued pure fn; gather all
+        # output cotangents (zeros where the consumer never produced one).
+        outs_ct = []
+        any_ct = False
+        for i in range(node.n_outputs):
+            ct = cotangents.pop((id(node), i), None)
+            outs_ct.append(ct)
+            any_ct = any_ct or ct is not None
+        if not any_ct:
+            continue
+        if node.out_avals is not None:
+            import numpy as _onp
+            import jax as _jax
+            outs_ct = [
+                ct if ct is not None else (
+                    jnp.zeros(shape, dtype)
+                    if jnp.issubdtype(dtype, jnp.floating)
+                    else _onp.zeros(shape, _jax.dtypes.float0))
+                for ct, (shape, dtype) in zip(outs_ct, node.out_avals)
+            ]
+        in_grads = node.vjp_fn(tuple(outs_ct))
+        for inp, ig in zip(node.inputs, in_grads):
+            if ig is None:
+                continue
+            if hasattr(ig, "dtype") and ig.dtype.name == "float0":
+                continue
+            e = getattr(inp, "_entry", None)
+            if e is not None:
+                key = (id(e[0]), e[1])
+                cotangents[key] = _accum(cotangents.get(key), ig)
+            if getattr(inp, "_marked", False):
+                var_grads[id(inp)] = _accum(var_grads.get(id(inp)), ig)
+
+    for v in unmark:
+        v._marked = False
+    if accumulate:
+        _write_grads(var_grads, order, heads)
+        return None
+    return {k: NDArray(v) for k, v in var_grads.items()}
+
+
+def _write_grads(var_grads, order, heads):
+    # Find every marked array reachable on the tape and write/add its grad.
+    seen_arrays = {}
+    def visit(arr):
+        if getattr(arr, "_marked", False) and id(arr) not in seen_arrays:
+            seen_arrays[id(arr)] = arr
+    for head in heads:
+        visit(head)
+    for node in order:
+        for inp in node.inputs:
+            visit(inp)
+    for aid, arr in seen_arrays.items():
+        g = var_grads.get(aid)
+        if g is None or arr._grad is None:
+            continue
+        if arr._grad_req == "add":
+            arr._grad._data = arr._grad._data + g
+        else:
+            arr._grad._data = g
+
+
+def _accum(existing, new):
+    return new if existing is None else existing + new
+
+
+def get_symbol(x):
+    """Reference autograd.get_symbol: expose the recorded graph.  Here the
+    tape is JAX-traced; return a Symbol wrapper of the deferred trace."""
+    from .symbol import Symbol
+    return Symbol._from_tape(x)
+
+
+class Function:
+    """User-defined differentiable function (reference autograd.py:369).
+
+    Subclass and override ``forward``/``backward`` on NDArrays.  The custom
+    backward is attached as a tape node so it composes with the XLA-derived
+    vjps around it.
+    """
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+
+        with pause():
+            outputs = self.forward(*inputs)
+        outs = _as_list(outputs)
+        if is_recording():
+            func = self
+
+            def vjp_fn(out_cts):
+                from . import ndarray as nd_mod
+                cts = [NDArray(c) if c is not None else None for c in out_cts]
+                in_grads = func.backward(*cts)
+                in_grads = _as_list(in_grads)
+                return [g._data if isinstance(g, NDArray) else g
+                        for g in in_grads]
+
+            node = TapeNode(vjp_fn, list(inputs), len(outs),
+                            out_avals=[(o.shape, o._data.dtype)
+                                       for o in outs],
+                            name=type(self).__name__)
+            for i, o in enumerate(outs):
+                o._entry = (node, i)
+        return outputs

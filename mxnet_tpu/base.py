@@ -1,0 +1,89 @@
+"""Base utilities: errors, env-config, dtype registry.
+
+TPU-native re-design of the reference's foundation layer
+(``include/mxnet/base.h``, dmlc logging/params).  Instead of a C++
+``dmlc::GetEnv`` config layer we expose a typed env reader; instead of
+mshadow dtype enums we map names onto JAX dtypes (bfloat16 first-class).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as _np
+
+__all__ = [
+    "MXNetError",
+    "get_env",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+    "_as_np_dtype",
+]
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (reference: dmlc::Error surfaced through the C API)."""
+
+
+def get_env(name, dtype=str, default=None):
+    """Typed environment-variable reader.
+
+    Mirrors the role of ``dmlc::GetEnv`` in the reference
+    (src/engine/threaded_engine_perdevice.cc:82-86 and the ~102 documented
+    MXNET_* vars): a single, typed entry point for runtime config.
+    """
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    if dtype is bool:
+        return val.lower() not in ("0", "false", "off", "")
+    return dtype(val)
+
+
+# dtype name <-> numpy dtype mapping.  bfloat16 is first-class on TPU.
+def _bfloat16():
+    import ml_dtypes
+
+    return _np.dtype(ml_dtypes.bfloat16)
+
+
+_DTYPE_ALIASES = {
+    "float32": _np.float32,
+    "float64": _np.float64,
+    "float16": _np.float16,
+    "uint8": _np.uint8,
+    "int8": _np.int8,
+    "int32": _np.int32,
+    "int64": _np.int64,
+    "bool": _np.bool_,
+}
+
+
+def _as_np_dtype(dtype):
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            return _bfloat16()
+        if dtype in _DTYPE_ALIASES:
+            return _np.dtype(_DTYPE_ALIASES[dtype])
+    return _np.dtype(dtype)
+
+
+class _ThreadLocalState(threading.local):
+    """Per-thread mode flags (reference: Imperative's thread-local
+    is_recording_/is_training_, src/imperative/imperative.cc:33-41)."""
+
+    def __init__(self):
+        super().__init__()
+        self.is_recording = False
+        self.is_training = False
+        self.is_deferred_compute = False
+
+
+thread_state = _ThreadLocalState()

@@ -1,0 +1,4 @@
+"""Contrib (reference python/mxnet/contrib/ — amp, onnx, tensorboard...)."""
+from . import amp
+
+__all__ = ["amp"]

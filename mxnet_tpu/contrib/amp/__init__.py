@@ -1,0 +1,94 @@
+"""Automatic mixed precision.
+
+Reference: python/mxnet/contrib/amp/ (amp.py ReducePrecision graph rewrite,
+per-op fp16/fp32 safety lists in lists/symbol_fp16.py, dynamic LossScaler
+using the multi_all_finite op).
+
+TPU-native: bf16 is the native mixed-precision mode — same exponent range
+as f32, so NO loss scaling is required (the reference's LossScaler exists
+for fp16's narrow range; it is provided for API parity and fp16 use).
+``convert_model``/``init`` cast parameters/blocks to bf16 while keeping
+normalization statistics and optimizer master weights in f32; matmul/conv
+accumulate in f32 via preferred_element_type (ops/nn.py).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+
+__all__ = ["init", "init_trainer", "convert_model", "convert_hybrid_block",
+           "LossScaler", "amp_init"]
+
+# ops that must stay f32 (reference lists/symbol_fp16.py FP32_FUNCS spirit)
+FP32_PARAM_SUFFIXES = ("gamma", "beta", "running_mean", "running_var",
+                       "moving_mean", "moving_var")
+
+_initialized = {"on": False, "dtype": "bfloat16"}
+
+
+def init(target_dtype="bfloat16"):
+    """Enable AMP (reference amp.py init)."""
+    _initialized["on"] = True
+    _initialized["dtype"] = target_dtype
+
+
+amp_init = init
+
+
+def convert_model(block, target_dtype="bfloat16"):
+    """Cast a Gluon block to mixed precision: weights -> target dtype,
+    norm params/statistics stay f32."""
+    for name, param in block.collect_params().items():
+        if name.split(".")[-1] in FP32_PARAM_SUFFIXES:
+            continue
+        param.cast(target_dtype)
+    return block
+
+
+convert_hybrid_block = convert_model
+
+
+def init_trainer(trainer):
+    """Reference amp.py init_trainer: hook the loss scaler into Trainer.
+    bf16 needs none; fp16 users pair this with LossScaler.scale."""
+    trainer._amp_loss_scaler = LossScaler()
+    return trainer
+
+
+class LossScaler:
+    """Dynamic loss scaler (reference amp/loss_scaler.py:26)."""
+
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, tolerance=0.05):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def scale(self, loss):
+        return loss * self.loss_scale
+
+    def unscale(self, grads):
+        inv = 1.0 / self.loss_scale
+        for g in grads:
+            g._data = g._data * inv
+
+    def has_overflow(self, grads):
+        """all_finite check (reference multi_all_finite op)."""
+        import jax.numpy as jnp
+
+        for g in grads:
+            if not bool(jnp.isfinite(g._data).all()):
+                return True
+        return False
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

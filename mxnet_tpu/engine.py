@@ -1,0 +1,84 @@
+"""Engine facade.
+
+Reference: the threaded dependency engine (src/engine/ — ThreadedVar
+hazard-tracking queues, per-device worker pools, threaded_engine.cc:318
+PushAsync).  Its job — run ops async while serializing RAW/WAR/WAW hazards
+per buffer — is exactly PJRT+XLA's execution model on TPU: dispatch is
+async, buffers carry futures, and data dependencies order execution.  So
+this module is a *facade* that keeps the reference API (push/waitall/
+engine-type selection) for compatibility and debugging, with PJRT as the
+scheduler.  NaiveEngine ≡ blocking after every op (useful to localize async
+failures, same as MXNET_ENGINE_TYPE=NaiveEngine in the reference).
+"""
+from __future__ import annotations
+
+import contextlib
+
+from .base import get_env
+
+__all__ = ["Engine", "get", "set_bulk_size", "bulk"]
+
+
+class Engine:
+    """Singleton facade over PJRT async dispatch."""
+
+    _instance = None
+
+    def __init__(self):
+        # MXNET_ENGINE_TYPE compat: NaiveEngine => synchronous execution
+        self.engine_type = get_env("MXNET_ENGINE_TYPE", str,
+                                   "ThreadedEnginePerDevice")
+        self._bulk_size = 0
+
+    @classmethod
+    def get(cls):
+        if cls._instance is None:
+            cls._instance = Engine()
+        return cls._instance
+
+    @property
+    def naive(self):
+        return self.engine_type == "NaiveEngine"
+
+    def push(self, fn, *args):
+        """Run fn; in naive mode block immediately (exception surfacing)."""
+        out = fn(*args)
+        if self.naive:
+            from .ndarray.ndarray import NDArray
+
+            for o in out if isinstance(out, (tuple, list)) else [out]:
+                if isinstance(o, NDArray):
+                    o.wait_to_read()
+        return out
+
+    def wait_for_var(self, arr):
+        arr.wait_to_read()
+
+    def wait_for_all(self):
+        from .ndarray.ndarray import waitall
+
+        waitall()
+
+    def set_bulk_size(self, size):
+        prev, self._bulk_size = self._bulk_size, size
+        return prev
+
+
+def get():
+    return Engine.get()
+
+
+def set_bulk_size(size):
+    """Reference: python/mxnet/engine.py set_bulk_size.  Bulking exists to
+    amortize engine-push overhead; XLA jit regions are the TPU equivalent, so
+    this only records the value."""
+    return Engine.get().set_bulk_size(size)
+
+
+@contextlib.contextmanager
+def bulk(size):
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

@@ -1,0 +1,11 @@
+"""Gluon API (reference python/mxnet/gluon/)."""
+from . import data, loss, metric, model_zoo, nn, rnn, utils
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import Constant, Parameter
+from .trainer import Trainer
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "Parameter", "Constant",
+           "Trainer", "nn", "rnn", "loss", "metric", "data", "utils",
+           "model_zoo", "contrib"]
+
+from . import contrib  # noqa: E402

@@ -1,0 +1,271 @@
+"""Keras-style Estimator train loop.
+
+Reference: python/mxnet/gluon/contrib/estimator/ — Estimator
+(estimator.py), event handlers ValidationHandler/LoggingHandler/
+CheckpointHandler/EarlyStoppingHandler (event_handler.py:160,226,336,614).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+from ... import autograd
+from ...base import MXNetError
+from .. import loss as gloss, metric as gmetric
+from ..trainer import Trainer
+
+__all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler", "ValidationHandler", "StoppingHandler"]
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            self.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            self.stop_training = True
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
+    """Reference event_handler.py:226."""
+
+    def __init__(self, log_interval="epoch", metrics=None):
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self.batch_index = 0
+        self.logger = logging.getLogger("mxnet_tpu.estimator")
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        self.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        self.logger.info("Training done in %.1fs",
+                         time.time() - self.train_start)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.epoch_start = time.time()
+        self.batch_index = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        msg = "; ".join("%s=%.4f" % m.get() for m in estimator.train_metrics)
+        self.logger.info("Epoch done in %.1fs: %s",
+                         time.time() - self.epoch_start, msg)
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.batch_index += 1
+        if isinstance(self.log_interval, int) and \
+                self.batch_index % self.log_interval == 0:
+            msg = "; ".join("%s=%.4f" % m.get()
+                            for m in estimator.train_metrics)
+            self.logger.info("batch %d: %s", self.batch_index, msg)
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Reference event_handler.py:336 (resumable, monitors a metric)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 verbose=0, save_best=False, mode="auto", epoch_period=1,
+                 batch_period=None, max_checkpoints=5,
+                 resume_from_checkpoint=False):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        self.max_checkpoints = max_checkpoints
+        self.current_epoch = 0
+        self.best = None
+        self.mode = mode
+        self.saved = []
+        os.makedirs(model_dir, exist_ok=True)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.current_epoch % self.epoch_period:
+            return
+        prefix = os.path.join(self.model_dir, "%s-epoch%d" %
+                              (self.model_prefix, self.current_epoch))
+        estimator.net.save_parameters(prefix + ".params")
+        estimator.trainer.save_states(prefix + ".states")
+        self.saved.append(prefix)
+        while len(self.saved) > self.max_checkpoints:
+            old = self.saved.pop(0)
+            for suffix in (".params", ".states"):
+                try:
+                    os.remove(old + suffix)
+                except OSError:
+                    pass
+        if self.save_best and self.monitor is not None:
+            name, value = self.monitor.get()
+            better = (self.best is None or
+                      (value > self.best if self.mode == "max"
+                       else value < self.best))
+            if better:
+                self.best = value
+                best_prefix = os.path.join(self.model_dir,
+                                           "%s-best" % self.model_prefix)
+                estimator.net.save_parameters(best_prefix + ".params")
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd):
+    """Reference event_handler.py:614."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.mode = mode
+        self.baseline = baseline
+        self.wait = 0
+        self.best = None
+        self.stop_training = False
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        name, value = self.monitor.get()
+        if value != value:  # nan
+            return
+        improved = (self.best is None or
+                    (value > self.best + self.min_delta
+                     if self.mode == "max"
+                     else value < self.best - self.min_delta))
+        if improved:
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stop_training = True
+
+
+class ValidationHandler(BatchEnd, EpochEnd):
+    """Reference event_handler.py:160."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.current_epoch = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(self.val_data)
+
+
+class Estimator:
+    """Reference estimator/estimator.py Estimator."""
+
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 trainer=None, context=None, devices=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = train_metrics or [gmetric.Accuracy()]
+        self.val_metrics = val_metrics or [gmetric.Accuracy()]
+        if not isinstance(self.train_metrics, list):
+            self.train_metrics = [self.train_metrics]
+        if not isinstance(self.val_metrics, list):
+            self.val_metrics = [self.val_metrics]
+        self.trainer = trainer or Trainer(net.collect_params(), "adam")
+        self.stop_training = False
+
+    def evaluate(self, val_data):
+        for m in self.val_metrics:
+            m.reset()
+        for batch in val_data:
+            data, label = batch[0], batch[1]
+            pred = self.net(data)
+            for m in self.val_metrics:
+                m.update([label], [pred])
+        return {m.get()[0]: m.get()[1] for m in self.val_metrics}
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None):
+        if epochs is None and batches is None:
+            epochs = 1
+        handlers = list(event_handlers or [])
+        stopper = StoppingHandler(epochs, batches)
+        handlers.append(stopper)
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler())
+
+        def fire(event):
+            for h in handlers:
+                fn = getattr(h, event, None)
+                if fn:
+                    fn(self)
+                if getattr(h, "stop_training", False):
+                    self.stop_training = True
+
+        fire("train_begin")
+        while not self.stop_training:
+            for m in self.train_metrics:
+                m.reset()
+            fire("epoch_begin")
+            for batch in train_data:
+                data, label = batch[0], batch[1]
+                fire("batch_begin")
+                with autograd.record():
+                    pred = self.net(data)
+                    loss_val = self.loss(pred, label)
+                loss_val.backward()
+                self.trainer.step(data.shape[0])
+                for m in self.train_metrics:
+                    m.update([label], [pred])
+                fire("batch_end")
+                if self.stop_training:
+                    break
+            fire("epoch_end")
+            if val_data is not None:
+                self.evaluate(val_data)
+        fire("train_end")
+        return self

@@ -1,0 +1,11 @@
+"""Gluon data API (reference python/mxnet/gluon/data/)."""
+from . import batchify, vision
+from .dataloader import DataLoader, default_batchify_fn
+from .dataset import ArrayDataset, Dataset, SimpleDataset
+from .sampler import (BatchSampler, FilterSampler, IntervalSampler,
+                      RandomSampler, Sampler, SequentialSampler)
+
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "DataLoader",
+           "Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
+           "FilterSampler", "IntervalSampler", "vision", "batchify",
+           "default_batchify_fn"]

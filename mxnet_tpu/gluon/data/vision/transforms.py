@@ -1,0 +1,192 @@
+"""Vision transforms (reference python/mxnet/gluon/data/vision/transforms.py
+— Compose/ToTensor/Normalize/Resize/CenterCrop/RandomFlip etc.)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from .... import ndarray as nd
+from ....ndarray.ndarray import NDArray
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import Sequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomLighting", "RandomColorJitter"]
+
+
+class Compose(Sequential):
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference transforms
+    ToTensor → image ops)."""
+
+    def forward(self, x):
+        x = x.astype("float32") / 255.0
+        if x.ndim == 3:
+            return x.transpose((2, 0, 1))
+        return x.transpose((0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = _np.asarray(mean, dtype=_np.float32).reshape(-1, 1, 1)
+        self._std = _np.asarray(std, dtype=_np.float32).reshape(-1, 1, 1)
+
+    def forward(self, x):
+        return (x - nd.array(self._mean)) / nd.array(self._std)
+
+
+def _resize_hwc(x, size):
+    import jax
+
+    if isinstance(size, int):
+        size = (size, size)
+    h, w = size[1], size[0]
+    data = x._data if isinstance(x, NDArray) else x
+    out = jax.image.resize(data.astype("float32"), (h, w, data.shape[2]),
+                           "bilinear")
+    return NDArray(out.astype(data.dtype))
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+
+    def forward(self, x):
+        return _resize_hwc(x, self._size)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def forward(self, x):
+        w, h = self._size
+        H, W = x.shape[0], x.shape[1]
+        y0 = max(0, (H - h) // 2)
+        x0 = max(0, (W - w) // 2)
+        return x[y0:y0 + h, x0:x0 + w, :]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        H, W = x.shape[0], x.shape[1]
+        area = H * W
+        for _ in range(10):
+            target_area = _np.random.uniform(*self._scale) * area
+            aspect = _np.random.uniform(*self._ratio)
+            w = int(round(_np.sqrt(target_area * aspect)))
+            h = int(round(_np.sqrt(target_area / aspect)))
+            if w <= W and h <= H:
+                x0 = _np.random.randint(0, W - w + 1)
+                y0 = _np.random.randint(0, H - h + 1)
+                crop = x[y0:y0 + h, x0:x0 + w, :]
+                return _resize_hwc(crop, self._size)
+        return _resize_hwc(x, self._size)
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if _np.random.rand() < 0.5:
+            return x[:, ::-1, :]
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if _np.random.rand() < 0.5:
+            return x[::-1, :, :]
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        alpha = 1.0 + _np.random.uniform(-self._b, self._b)
+        return (x.astype("float32") * alpha).clip(0, 255).astype(x.dtype)
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        alpha = 1.0 + _np.random.uniform(-self._c, self._c)
+        xf = x.astype("float32")
+        gray = xf.mean()
+        return ((xf - gray) * alpha + gray).clip(0, 255).astype(x.dtype)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        alpha = 1.0 + _np.random.uniform(-self._s, self._s)
+        xf = x.astype("float32")
+        gray = xf.mean(axis=-1, keepdims=True)
+        return (gray + (xf - gray) * alpha).clip(0, 255).astype(x.dtype)
+
+
+class RandomLighting(Block):
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        alpha = _np.random.normal(0, self._alpha, size=(3,))
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        rgb = (eigvec @ (alpha * eigval)).astype(_np.float32)
+        return (x.astype("float32") + nd.array(rgb)).clip(0, 255) \
+            .astype(x.dtype)
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+
+    def forward(self, x):
+        order = _np.random.permutation(len(self._ts))
+        for i in order:
+            x = self._ts[i](x)
+        return x

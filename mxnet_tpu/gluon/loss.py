@@ -1,0 +1,281 @@
+"""Loss blocks (reference python/mxnet/gluon/loss.py, 1,113 LoC — 15 loss
+classes with sample_weight/batch_axis semantics)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .block import HybridBlock
+
+__all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
+           "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
+           "KLDivLoss", "CTCLoss", "HuberLoss", "HingeLoss",
+           "SquaredHingeLoss", "LogisticLoss", "TripletLoss", "PoissonNLLLoss",
+           "CosineEmbeddingLoss", "SDMLLoss"]
+
+
+def _apply_weighting(loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        loss = loss * weight
+    return loss
+
+
+def _reshape_like(pred, label):
+    if isinstance(label, NDArray) and label.shape != pred.shape:
+        label = label.reshape(pred.shape)
+    return label
+
+
+class Loss(HybridBlock):
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__()
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def _mean_nonbatch(self, loss):
+        axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+    def __repr__(self):
+        return "%s(batch_axis=%s, w=%s)" % (type(self).__name__,
+                                            self._batch_axis, self._weight)
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = nd.square(label - pred)
+        loss = _apply_weighting(loss, self._weight / 2, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+class L1Loss(Loss):
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = nd.abs(label - pred)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def forward(self, pred, label, sample_weight=None, pos_weight=None):
+        label = _reshape_like(pred, label)
+        if not self._from_sigmoid:
+            # log(1+exp(-|x|)) + max(x,0) - x*y form, numerically stable
+            softplus_neg = nd.log(1.0 + nd.exp(-nd.abs(pred))) + \
+                nd.relu(-pred)  # = log(1+exp(-x)) = -log(sigmoid(x))
+            if pos_weight is None:
+                loss = nd.relu(pred) - pred * label + \
+                    nd.log(1.0 + nd.exp(-nd.abs(pred)))
+            else:
+                # weighted: (1-y)*x + (1 + (pw-1)*y) * (-log(sigmoid(x)))
+                log_weight = 1 + (pos_weight - 1) * label
+                loss = (1 - label) * pred + log_weight * softplus_neg
+        else:
+            eps = 1e-12
+            loss = -(nd.log(pred + eps) * label +
+                     nd.log(1 - pred + eps) * (1 - label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """Reference loss.py SoftmaxCrossEntropyLoss (sparse_label etc.)."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def forward(self, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = nd.log_softmax(pred, axis=self._axis)
+        if self._sparse_label:
+            loss = -nd.pick(pred, label, axis=self._axis, keepdims=False)
+        else:
+            label = _reshape_like(pred, label)
+            loss = -(pred * label).sum(axis=self._axis, keepdims=False)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def forward(self, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = nd.log_softmax(pred, axis=self._axis)
+        loss = label * (nd.log(label + 1e-12) - pred)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+class CTCLoss(Loss):
+    """Reference loss.py CTCLoss → nn/ctc_loss.cc (layouts TNC/NTC)."""
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None,
+                 **kwargs):
+        super().__init__(weight, 0, **kwargs)
+        self._layout = layout
+        self._label_layout = label_layout
+
+    def forward(self, pred, label, pred_lengths=None, label_lengths=None,
+                sample_weight=None):
+        if self._layout == "NTC":
+            pred = pred.swapaxes(0, 1)
+        if self._label_layout == "TN":
+            label = label.swapaxes(0, 1)
+        loss = nd.ctc_loss(pred, label, pred_lengths, label_lengths)
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1.0, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        err = nd.abs(label - pred)
+        loss = nd.where(err > self._rho,
+                        err - 0.5 * self._rho,
+                        (0.5 / self._rho) * nd.square(err))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = nd.relu(self._margin - pred * label)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+class SquaredHingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = nd.square(nd.relu(self._margin - pred * label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, label_format="signed",
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._label_format = label_format
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        if self._label_format == "signed":
+            label = (label + 1.0) / 2.0
+        loss = nd.relu(pred) - pred * label + \
+            nd.log(1.0 + nd.exp(-nd.abs(pred)))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, positive, negative, sample_weight=None):
+        positive = _reshape_like(pred, positive)
+        negative = _reshape_like(pred, negative)
+        loss = (nd.square(pred - positive) -
+                nd.square(pred - negative)).sum(
+                    axis=tuple(range(1, pred.ndim)))
+        loss = nd.relu(loss + self._margin)
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class PoissonNLLLoss(Loss):
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def forward(self, pred, target, sample_weight=None, epsilon=1e-08):
+        target = _reshape_like(pred, target)
+        if self._from_logits:
+            loss = nd.exp(pred) - target * pred
+        else:
+            loss = pred - target * nd.log(pred + epsilon)
+        if self._compute_full:
+            stirling = target * nd.log(target + 1e-12) - target + \
+                0.5 * nd.log(2 * _np.pi * (target + 1e-12))
+            stirling = nd.where(target <= 1, nd.zeros_like(stirling),
+                                stirling)
+            loss = loss + stirling
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return loss.mean()
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, input1, input2, label, sample_weight=None):
+        cos = (input1 * input2).sum(axis=-1) / (
+            nd.sqrt(nd.square(input1).sum(axis=-1)) *
+            nd.sqrt(nd.square(input2).sum(axis=-1)) + 1e-12)
+        label = label.reshape(cos.shape)
+        loss = nd.where(label == 1, 1 - cos,
+                        nd.relu(cos - self._margin))
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class SDMLLoss(Loss):
+    """Smoothed deep metric learning (reference loss.py SDMLLoss)."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._sp = smoothing_parameter
+
+    def forward(self, x1, x2):
+        batch = x1.shape[0]
+        # pairwise negative euclidean distance as logits
+        d = nd.sqrt(nd.square(
+            x1.expand_dims(1) - x2.expand_dims(0)).sum(axis=-1) + 1e-12)
+        logits = -d
+        labels = nd.one_hot(nd.arange(batch), batch) * \
+            (1 - self._sp - self._sp / (batch - 1)) + self._sp / (batch - 1)
+        logp = nd.log_softmax(logits, axis=-1)
+        return -(labels * logp).sum(axis=-1)

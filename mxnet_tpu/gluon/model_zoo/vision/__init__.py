@@ -1,0 +1,30 @@
+"""Model zoo — vision (reference python/mxnet/gluon/model_zoo/vision/)."""
+from __future__ import annotations
+
+import importlib
+
+from ....base import MXNetError
+
+_MODULE_NAMES = ("resnet", "vgg", "alexnet", "mobilenet", "squeezenet",
+                 "densenet")
+_models = {}
+for _mod_name in _MODULE_NAMES:
+    _mod = importlib.import_module("." + _mod_name, __name__)
+    for _name in _mod.__all__:
+        _obj = getattr(_mod, _name)
+        globals()[_name] = _obj
+        if callable(_obj) and _name[0].islower():
+            _models[_name] = _obj
+
+
+def get_model(name, **kwargs):
+    """Model registry (reference model_zoo/model_store.py + vision
+    __init__.get_model)."""
+    name = name.lower().replace("-", "_")
+    if name not in _models:
+        raise MXNetError("model %s not found; available: %s"
+                         % (name, sorted(_models)))
+    return _models[name](**kwargs)
+
+
+__all__ = ["get_model"] + sorted(_models)

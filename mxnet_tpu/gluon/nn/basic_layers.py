@@ -1,0 +1,439 @@
+"""Basic neural network layers.
+
+Reference: python/mxnet/gluon/nn/basic_layers.py (1,116 LoC — Dense,
+Dropout, BatchNorm, LayerNorm, GroupNorm, InstanceNorm, Embedding, Flatten,
+activations, Sequential containers).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ... import autograd
+from ... import ndarray as nd
+from ...base import MXNetError
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
+           "SyncBatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
+           "Embedding", "Flatten", "Lambda", "HybridLambda", "Activation",
+           "LeakyReLU", "PReLU", "ELU", "SELU", "GELU", "SiLU", "Swish",
+           "Mish", "RMSNorm", "Identity", "Concatenate", "HybridConcatenate"]
+
+
+class Sequential(Block):
+    """Stack of blocks (reference basic_layers.py Sequential)."""
+
+    def __init__(self, *blocks):
+        super().__init__()
+        for b in blocks:
+            self.add(b)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x)
+        return x
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        vals = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*vals[key])
+            return net
+        return vals[key]
+
+
+class HybridSequential(Sequential, HybridBlock):
+    def __init__(self, *blocks):
+        HybridBlock.__init__(self)
+        for b in blocks:
+            self.add(b)
+
+
+class _Resolving(HybridBlock):
+    """Leaf-layer base: resolves deferred parameter shapes on first call
+    (the TPU stand-in for the deferred-compute shape-inference pass)."""
+
+    def _resolve(self, *args):
+        need = [p for p in self._reg_params.values() if p._data is None]
+        if need:
+            self.infer_shape(*args)
+            for p in need:
+                p._finish_deferred_init()
+
+
+class Dense(_Resolving):
+    """Fully-connected layer (reference basic_layers.py Dense →
+    nn/fully_connected.cc).  Weight layout (units, in_units)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None,
+                 bias_initializer="zeros", in_units=0, **kwargs):
+        super().__init__()
+        self._units = units
+        self._flatten = flatten
+        self._activation = activation
+        self.weight = Parameter("weight", shape=(units, in_units),
+                                dtype=dtype, init=weight_initializer,
+                                allow_deferred_init=True,
+                                sharding=("tp", None))
+        self.bias = (Parameter("bias", shape=(units,), dtype=dtype,
+                               init=bias_initializer,
+                               allow_deferred_init=True,
+                               sharding=("tp",))
+                     if use_bias else None)
+
+    def infer_shape(self, x, *args):
+        in_units = (int(_np.prod(x.shape[1:])) if self._flatten
+                    else x.shape[-1])
+        self.weight.shape = (self._units, in_units)
+        if self.bias is not None:
+            self.bias.shape = (self._units,)
+
+    def forward(self, x):
+        self._resolve(x)
+        out = nd.fully_connected(
+            x, self.weight.data(),
+            self.bias.data() if self.bias is not None else None,
+            num_hidden=self._units, flatten=self._flatten,
+            no_bias=self.bias is None)
+        if self._activation:
+            out = nd.Activation(out, act_type=self._activation)
+        return out
+
+    def __repr__(self):
+        return "Dense(%s -> %d)" % (self.weight.shape[1] if self.weight.shape
+                                    else None, self._units)
+
+
+class Dropout(HybridBlock):
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__()
+        self._rate = rate
+        self._axes = axes
+
+    def forward(self, x):
+        return nd.dropout(x, p=self._rate, axes=self._axes)
+
+
+class BatchNorm(_Resolving):
+    """Reference basic_layers.py BatchNorm → nn/batch_norm.cc.  Running
+    stats are functionalized state (see ops/nn.py batch_norm docstring)."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0,
+                 **kwargs):
+        super().__init__()
+        self._axis = axis
+        self._momentum = momentum
+        self._eps = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape,
+                               init=gamma_initializer,
+                               grad_req="write" if scale else "null",
+                               allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=shape, init=beta_initializer,
+                              grad_req="write" if center else "null",
+                              allow_deferred_init=True)
+        self.running_mean = Parameter("running_mean", shape=shape,
+                                      init=running_mean_initializer,
+                                      grad_req="null",
+                                      allow_deferred_init=True)
+        self.running_var = Parameter("running_var", shape=shape,
+                                     init=running_variance_initializer,
+                                     grad_req="null",
+                                     allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean,
+                  self.running_var):
+            p.shape = (c,)
+
+    def forward(self, x):
+        self._resolve(x)
+        training = autograd.is_training() and not self._use_global_stats
+        out, new_mean, new_var = nd.batch_norm(
+            x, self.gamma.data(), self.beta.data(),
+            self.running_mean.data(), self.running_var.data(),
+            eps=self._eps, momentum=self._momentum,
+            fix_gamma=not self._scale,
+            use_global_stats=self._use_global_stats,
+            axis=self._axis, training=training)
+        if training:
+            with autograd.pause():
+                self.running_mean.set_data(new_mean.detach())
+                self.running_var.set_data(new_var.detach())
+        return out
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BN (reference contrib sync_batch_norm-inl.h).  Under
+    pjit/shard_map the batch axis is sharded and XLA turns the mean/var
+    reductions into cross-replica collectives automatically, so this is
+    BatchNorm; kept as a distinct class for API parity."""
+
+    def __init__(self, in_channels=0, num_devices=None, **kwargs):
+        super().__init__(in_channels=in_channels, **kwargs)
+
+
+class LayerNorm(_Resolving):
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__()
+        self._axis = axis
+        self._eps = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape, init=gamma_initializer,
+                               grad_req="write" if scale else "null",
+                               allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=shape, init=beta_initializer,
+                              grad_req="write" if center else "null",
+                              allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        c = x.shape[self._axis]
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def forward(self, x):
+        self._resolve(x)
+        return nd.layer_norm(x, self.gamma.data(), self.beta.data(),
+                             axis=self._axis, eps=self._eps)
+
+
+class RMSNorm(_Resolving):
+    """TPU-era extra (no reference equivalent; transformer staple)."""
+
+    def __init__(self, axis=-1, epsilon=1e-6, in_channels=0, **kwargs):
+        super().__init__()
+        self._axis = axis
+        self._eps = epsilon
+        self.gamma = Parameter("gamma", shape=(in_channels,) if in_channels
+                               else (0,), init="ones",
+                               allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        self.gamma.shape = (x.shape[self._axis],)
+
+    def forward(self, x):
+        self._resolve(x)
+        return nd.rms_norm(x, self.gamma.data(), axis=self._axis,
+                           eps=self._eps)
+
+
+class GroupNorm(_Resolving):
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__()
+        self._num_groups = num_groups
+        self._eps = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape, init=gamma_initializer,
+                               grad_req="write" if scale else "null",
+                               allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=shape, init=beta_initializer,
+                              grad_req="write" if center else "null",
+                              allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        self.gamma.shape = (x.shape[1],)
+        self.beta.shape = (x.shape[1],)
+
+    def forward(self, x):
+        self._resolve(x)
+        return nd.group_norm(x, self.gamma.data(), self.beta.data(),
+                             num_groups=self._num_groups, eps=self._eps)
+
+
+class InstanceNorm(_Resolving):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__()
+        self._eps = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape, init=gamma_initializer,
+                               allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=shape, init=beta_initializer,
+                              allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        self.gamma.shape = (x.shape[1],)
+        self.beta.shape = (x.shape[1],)
+
+    def forward(self, x):
+        self._resolve(x)
+        return nd.instance_norm(x, self.gamma.data(), self.beta.data(),
+                                eps=self._eps)
+
+
+class Embedding(_Resolving):
+    """Reference basic_layers.py Embedding → tensor/indexing_op.cc.
+    ``sparse_grad`` maps to a row_sparse gradient in the reference; on TPU
+    the gather's gradient is a scatter-add XLA fuses well, so dense grads
+    are kept (SURVEY §7 sparse decision)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, **kwargs):
+        super().__init__()
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = Parameter("weight", shape=(input_dim, output_dim),
+                                dtype=dtype, init=weight_initializer,
+                                grad_stype="row_sparse" if sparse_grad
+                                else "default",
+                                sharding=(None, "tp"))
+
+    def forward(self, x):
+        self._resolve(x)
+        return nd.embedding(x, self.weight.data())
+
+    def __repr__(self):
+        return "Embedding(%d -> %d)" % (self._input_dim, self._output_dim)
+
+
+class Flatten(HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__()
+
+    def forward(self, x):
+        return x.reshape((x.shape[0], -1))
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return x
+
+
+class Lambda(Block):
+    def __init__(self, function):
+        super().__init__()
+        if isinstance(function, str):
+            function = getattr(nd, function)
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function):
+        super().__init__()
+        if isinstance(function, str):
+            function = getattr(nd, function)
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class Activation(HybridBlock):
+    def __init__(self, activation, **kwargs):
+        super().__init__()
+        self._act_type = activation
+
+    def forward(self, x):
+        return nd.Activation(x, act_type=self._act_type)
+
+    def __repr__(self):
+        return "Activation(%s)" % self._act_type
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha=0.01, **kwargs):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        return nd.leaky_relu(x, slope=self._alpha)
+
+
+class PReLU(_Resolving):
+    def __init__(self, alpha_initializer=None, in_channels=1, **kwargs):
+        super().__init__()
+        from ...initializer import Constant
+
+        self.alpha = Parameter("alpha", shape=(in_channels,),
+                               init=alpha_initializer or Constant(0.25))
+
+    def forward(self, x):
+        self._resolve(x)
+        a = self.alpha.data()
+        shape = [1] * x.ndim
+        if x.ndim > 1:
+            shape[1] = a.shape[0]
+        return nd.prelu(x, a.reshape(shape))
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0, **kwargs):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        return nd.elu(x, alpha=self._alpha)
+
+
+class SELU(HybridBlock):
+    def forward(self, x):
+        return nd.selu(x)
+
+
+class GELU(HybridBlock):
+    def __init__(self, approximation="erf", **kwargs):
+        super().__init__()
+        self._approx = approximation != "erf"
+
+    def forward(self, x):
+        return nd.gelu(x, approximate=self._approx)
+
+
+class SiLU(HybridBlock):
+    def forward(self, x):
+        return nd.silu(x)
+
+
+Swish = SiLU
+
+
+class Mish(HybridBlock):
+    def forward(self, x):
+        return nd.mish(x)
+
+
+class Concatenate(Sequential):
+    """Run children on the same input, concat outputs (reference
+    gluon/contrib Concurrent)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        outs = [block(x) for block in self._children.values()]
+        return nd.concat(*outs, dim=self._axis)
+
+
+class HybridConcatenate(Concatenate, HybridBlock):
+    def __init__(self, axis=-1):
+        HybridBlock.__init__(self)
+        self._axis = axis

@@ -1,0 +1,274 @@
+"""Convolution and pooling layers.
+
+Reference: python/mxnet/gluon/nn/conv_layers.py (1,811 LoC — _Conv base,
+Conv1D/2D/3D(+Transpose), Max/Avg pooling, global pooling, reflection pad).
+Layouts default to the reference's NCHW family; XLA:TPU's layout assignment
+re-tiles internally so NCHW runs at full MXU rate.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ... import ndarray as nd
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..parameter import Parameter
+from .basic_layers import _Resolving
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
+           "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D"]
+
+
+def _tuple(x, n):
+    if isinstance(x, (list, tuple)):
+        return tuple(x)
+    return (x,) * n
+
+
+class _Conv(_Resolving):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", transpose=False,
+                 output_padding=0, dtype="float32"):
+        super().__init__()
+        ndim = len(kernel_size)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = kernel_size
+        self._strides = _tuple(strides, ndim)
+        self._padding = _tuple(padding, ndim)
+        self._dilation = _tuple(dilation, ndim)
+        self._groups = groups
+        self._layout = layout
+        self._activation = activation
+        self._transpose = transpose
+        self._output_padding = _tuple(output_padding, ndim)
+        if transpose:
+            wshape = (in_channels, channels // groups) + kernel_size
+        else:
+            wshape = (channels, in_channels // groups if in_channels else 0) \
+                + kernel_size
+        self.weight = Parameter("weight", shape=wshape, dtype=dtype,
+                                init=weight_initializer,
+                                allow_deferred_init=True,
+                                sharding=("tp",) + (None,) * (ndim + 1))
+        self.bias = (Parameter("bias", shape=(channels,), dtype=dtype,
+                               init=bias_initializer,
+                               allow_deferred_init=True)
+                     if use_bias else None)
+
+    def infer_shape(self, x, *args):
+        c_axis = self._layout.index("C")
+        in_c = x.shape[c_axis]
+        if self._transpose:
+            self.weight.shape = (in_c, self._channels // self._groups) + \
+                self._kernel
+        else:
+            self.weight.shape = (self._channels, in_c // self._groups) + \
+                self._kernel
+        if self.bias is not None:
+            self.bias.shape = (self._channels,)
+
+    def forward(self, x):
+        self._resolve(x)
+        bias = self.bias.data() if self.bias is not None else None
+        if self._transpose:
+            out = nd.deconvolution(
+                x, self.weight.data(), bias, kernel=self._kernel,
+                stride=self._strides, dilate=self._dilation,
+                pad=self._padding, adj=self._output_padding,
+                num_filter=self._channels, num_group=self._groups,
+                no_bias=bias is None, layout=self._layout)
+        else:
+            out = nd.convolution(
+                x, self.weight.data(), bias, kernel=self._kernel,
+                stride=self._strides, dilate=self._dilation,
+                pad=self._padding, num_filter=self._channels,
+                num_group=self._groups, no_bias=bias is None,
+                layout=self._layout)
+        if self._activation:
+            out = nd.Activation(out, act_type=self._activation)
+        return out
+
+    def __repr__(self):
+        return "%s(%s, kernel_size=%s, stride=%s)" % (
+            type(self).__name__, self._channels, self._kernel, self._strides)
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _tuple(kernel_size, 1), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _tuple(kernel_size, 2), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super().__init__(channels, _tuple(kernel_size, 3), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _tuple(kernel_size, 1), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer,
+                         transpose=True, output_padding=output_padding)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super().__init__(channels, _tuple(kernel_size, 2), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer,
+                         transpose=True, output_padding=output_padding)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _tuple(kernel_size, 3), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer,
+                         transpose=True, output_padding=output_padding)
+
+
+class _Pooling(HybridBlock):
+    def __init__(self, pool_size, strides, padding, global_pool, pool_type,
+                 layout, count_include_pad=True, ceil_mode=False):
+        super().__init__()
+        self._kernel = pool_size
+        self._stride = strides if strides is not None else pool_size
+        self._pad = padding
+        self._global = global_pool
+        self._type = pool_type
+        self._layout = layout
+        self._count_include_pad = count_include_pad
+
+    def forward(self, x):
+        return nd.pooling(
+            x, kernel=self._kernel, pool_type=self._type,
+            stride=_tuple(self._stride, len(self._kernel)),
+            pad=_tuple(self._pad, len(self._kernel)),
+            global_pool=self._global,
+            count_include_pad=self._count_include_pad, layout=self._layout)
+
+    def __repr__(self):
+        return "%s(size=%s, stride=%s)" % (type(self).__name__,
+                                           self._kernel, self._stride)
+
+
+class MaxPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, **kwargs):
+        super().__init__(_tuple(pool_size, 1), strides, padding, False,
+                         "max", layout, ceil_mode=ceil_mode)
+
+
+class MaxPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, **kwargs):
+        super().__init__(_tuple(pool_size, 2), strides, padding, False,
+                         "max", layout, ceil_mode=ceil_mode)
+
+
+class MaxPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, **kwargs):
+        super().__init__(_tuple(pool_size, 3), strides, padding, False,
+                         "max", layout, ceil_mode=ceil_mode)
+
+
+class AvgPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(_tuple(pool_size, 1), strides, padding, False,
+                         "avg", layout, count_include_pad, ceil_mode)
+
+
+class AvgPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(_tuple(pool_size, 2), strides, padding, False,
+                         "avg", layout, count_include_pad, ceil_mode)
+
+
+class AvgPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(_tuple(pool_size, 3), strides, padding, False,
+                         "avg", layout, count_include_pad, ceil_mode)
+
+
+class GlobalMaxPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__((1,), None, 0, True, "max", layout)
+
+
+class GlobalMaxPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__((1, 1), None, 0, True, "max", layout)
+
+
+class GlobalMaxPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__((1, 1, 1), None, 0, True, "max", layout)
+
+
+class GlobalAvgPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__((1,), None, 0, True, "avg", layout)
+
+
+class GlobalAvgPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__((1, 1), None, 0, True, "avg", layout)
+
+
+class GlobalAvgPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__((1, 1, 1), None, 0, True, "avg", layout)
+
+
+class ReflectionPad2D(HybridBlock):
+    def __init__(self, padding=0, **kwargs):
+        super().__init__()
+        self._padding = padding
+
+    def forward(self, x):
+        p = self._padding
+        return x.pad(((0, 0), (0, 0), (p, p), (p, p)), mode="reflect")

@@ -1,0 +1,238 @@
+"""Gluon Parameter.
+
+Reference: python/mxnet/gluon/parameter.py:47 — deferred init, per-context
+replicas (_init_grad:379), grad_req, row_sparse grad support.
+
+TPU-native changes:
+- A parameter owns ONE logical array (a jax.Array), not per-GPU replicas;
+  multi-device is expressed by a `sharding` hint consumed by
+  mxnet_tpu.parallel when the enclosing computation is pjit-ed over a Mesh
+  (this is the TP/ZeRO hook the reference never had — SURVEY §2.3).
+  Per-context replica API (list_data/list_grad) is kept for compat and
+  returns views on the single array.
+- During hybridize tracing, ``data()`` returns the traced stand-in so the
+  whole block lowers to one XLA computation (see block.py).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError, _as_np_dtype
+from ..context import current_context
+from ..ndarray.ndarray import NDArray
+from .. import initializer as init_mod
+
+__all__ = ["Parameter", "Constant", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter accessed before shape is known (reference parameter.py)."""
+
+
+# active trace contexts (stack) — block.py pushes/pops
+_trace_stack = []
+
+
+class Parameter:
+    def __init__(self, name="weight", grad_req="write", shape=None,
+                 dtype="float32", lr_mult=1.0, wd_mult=1.0, init=None,
+                 allow_deferred_init=False, differentiable=True,
+                 stype="default", grad_stype="default", sharding=None):
+        self._name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = _as_np_dtype(dtype)
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self.grad_req = grad_req if differentiable else "null"
+        self._differentiable = differentiable
+        self.stype = stype
+        self.grad_stype = grad_stype
+        # TP/FSDP sharding hint: a jax PartitionSpec-like tuple of axis names
+        self.sharding = sharding
+        self._data = None            # NDArray
+        self._ctx = None
+        self._deferred_init = None   # (init, ctx, default_init)
+        self.attrs = {}
+
+    # ---- identity ---------------------------------------------------------
+    @property
+    def name(self):
+        return self._name
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (
+            self._name, self._shape, self.dtype)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if new_shape is None:
+            return
+        if self._shape is not None:
+            matched = len(self._shape) == len(new_shape) and all(
+                s in (0, n) or s == n or n in (0, -1)
+                for s, n in zip(self._shape, new_shape))
+            if not matched and self._data is not None:
+                raise MXNetError(
+                    "cannot reset shape of initialized Parameter %s from %s "
+                    "to %s" % (self._name, self._shape, new_shape))
+        self._shape = tuple(int(s) for s in new_shape)
+
+    def _needs_shape(self):
+        return self._shape is None or any(s in (0, -1) for s in self._shape)
+
+    # ---- init -------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        default_init = default_init or init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = current_context()
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0]
+        self._ctx = ctx
+        if self._needs_shape():
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise DeferredInitializationError(
+                "Parameter %s has unknown shape %s and allow_deferred_init "
+                "is False" % (self._name, self._shape))
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        import jax.numpy as jnp
+
+        arr = NDArray(jnp.zeros(self._shape, self.dtype), ctx=ctx)
+        initializer = init or self.init or default_init
+        if isinstance(initializer, str):
+            initializer = init_mod.create(initializer)
+        initializer(init_mod.InitDesc(self._name, self.attrs), arr)
+        if arr.dtype != self.dtype:
+            arr = arr.astype(self.dtype)
+        self._data = arr
+        self._deferred_init = None
+        if self.grad_req != "null":
+            self._data.attach_grad(self.grad_req)
+
+    def _finish_deferred_init(self):
+        if self._deferred_init is None:
+            return
+        if self._needs_shape():
+            raise DeferredInitializationError(
+                "Parameter %s still has unknown shape %s" %
+                (self._name, self._shape))
+        init, ctx, default_init = self._deferred_init
+        self._finish_init(init, ctx, default_init)
+
+    # ---- access -----------------------------------------------------------
+    def _check_initialized(self):
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    "Parameter %s was not initialized yet: shape unknown. "
+                    "Run a forward pass or call infer_shape first."
+                    % self._name)
+            raise MXNetError(
+                "Parameter %s has not been initialized; call .initialize()"
+                % self._name)
+
+    def data(self, ctx=None):
+        # during hybridize tracing, hand out the traced stand-in
+        for tctx in reversed(_trace_stack):
+            sub = tctx.substitution.get(id(self))
+            if sub is not None:
+                return sub
+        self._check_initialized()
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def grad(self, ctx=None):
+        self._check_initialized()
+        if self._data._grad is None:
+            raise MXNetError("Parameter %s has grad_req='null'" % self._name)
+        return self._data._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        self._check_initialized()
+        return [self._data.context]
+
+    def zero_grad(self):
+        if self._data is not None and self._data._grad is not None:
+            self._data.zero_grad()
+
+    def set_data(self, data):
+        if _trace_stack:
+            tctx = _trace_stack[-1]
+            if id(self) in tctx.substitution:
+                tctx.record_state_update(self, data)
+                return
+        self.shape = data.shape
+        if self._data is None:
+            if self._deferred_init is not None:
+                self._finish_deferred_init()
+            else:
+                self._data = NDArray(data._data if isinstance(data, NDArray)
+                                     else data)
+                if self.grad_req != "null":
+                    self._data.attach_grad(self.grad_req)
+                return
+        d = data._data if isinstance(data, NDArray) else data
+        import jax.numpy as jnp
+
+        self._data._data = jnp.asarray(d, dtype=self.dtype)
+
+    def reset_ctx(self, ctx):
+        if self._data is not None:
+            self._data = self._data.as_in_context(ctx)
+            if self.grad_req != "null":
+                self._data.attach_grad(self.grad_req)
+
+    def cast(self, dtype):
+        self.dtype = _as_np_dtype(dtype)
+        if self._data is not None:
+            self._data = self._data.astype(self.dtype)
+            if self.grad_req != "null":
+                self._data.attach_grad(self.grad_req)
+
+    def var(self):
+        from ..symbol import Symbol
+
+        return Symbol.var(self._name, shape=self._shape)
+
+    def __copy__(self):
+        return self
+
+    def __deepcopy__(self, memo):
+        return self
+
+
+class Constant(Parameter):
+    """Non-learnable parameter (reference gluon/parameter.py Constant)."""
+
+    def __init__(self, value, name="const"):
+        if isinstance(value, NDArray):
+            value_np = value.asnumpy()
+        else:
+            value_np = _np.asarray(value, dtype=_np.float32)
+        super().__init__(name=name, grad_req="null",
+                         shape=value_np.shape, dtype=value_np.dtype,
+                         init=init_mod.Constant(0.0))
+        self._value = value_np
+
+    def _finish_init(self, init, ctx, default_init):
+        import jax.numpy as jnp
+
+        self._data = NDArray(jnp.asarray(self._value), ctx=ctx)
+        self._deferred_init = None

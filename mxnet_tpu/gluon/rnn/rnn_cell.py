@@ -1,0 +1,287 @@
+"""RNN cells (reference python/mxnet/gluon/rnn/rnn_cell.py, 1,493 LoC)."""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell", "HybridSequentialRNNCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self._modified = False
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        func = func or nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(func(shape=info["shape"], **kwargs)
+                          if "shape" in info else func(**kwargs))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unrolled application (reference rnn_cell.py unroll).  Python loop
+        at eager level; under hybridize the loop is traced once and XLA
+        compiles the unrolled graph."""
+        axis = layout.find("T")
+        batch = inputs.shape[layout.find("N")]
+        states = begin_state if begin_state is not None else \
+            self.begin_state(batch)
+        outputs = []
+        for t in range(length):
+            step = nd.take(inputs, nd.array([t], dtype="int32"),
+                           axis=axis).squeeze(axis=axis)
+            out, states = self(step, states)
+            outputs.append(out)
+        if valid_length is not None:
+            stacked = nd.stack(*outputs, axis=0)
+            stacked = nd.sequence_mask(stacked, valid_length,
+                                       use_sequence_length=True, axis=0)
+            outputs = stacked.swapaxes(0, 1) if axis == 1 else stacked
+        elif merge_outputs is None or merge_outputs:
+            outputs = nd.stack(*outputs, axis=axis)
+        return outputs, states
+
+    def _get_activation(self, x, activation):
+        if callable(activation):
+            return activation(x)
+        return nd.Activation(x, act_type=activation)
+
+
+class _BaseRNNCell(RecurrentCell):
+    def __init__(self, hidden_size, num_gates, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 dtype="float32"):
+        super().__init__()
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        ng = num_gates
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(ng * hidden_size, input_size),
+                                    init=i2h_weight_initializer,
+                                    dtype=dtype, allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(ng * hidden_size, hidden_size),
+                                    init=h2h_weight_initializer, dtype=dtype)
+        self.i2h_bias = Parameter("i2h_bias", shape=(ng * hidden_size,),
+                                  init=i2h_bias_initializer, dtype=dtype)
+        self.h2h_bias = Parameter("h2h_bias", shape=(ng * hidden_size,),
+                                  init=h2h_bias_initializer, dtype=dtype)
+        self._ng = ng
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (self._ng * self._hidden_size, x.shape[-1])
+
+    def _resolve(self, x):
+        need = [p for p in self._reg_params.values() if p._data is None]
+        if need:
+            self.infer_shape(x)
+            for p in need:
+                p._finish_deferred_init()
+
+
+class RNNCell(_BaseRNNCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 **kwargs):
+        super().__init__(hidden_size, 1, input_size, **kwargs)
+        self._activation = activation
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def forward(self, inputs, states):
+        self._resolve(inputs)
+        i2h = nd.fully_connected(inputs, self.i2h_weight.data(),
+                                 self.i2h_bias.data(),
+                                 num_hidden=self._hidden_size, flatten=False)
+        h2h = nd.fully_connected(states[0], self.h2h_weight.data(),
+                                 self.h2h_bias.data(),
+                                 num_hidden=self._hidden_size, flatten=False)
+        out = self._get_activation(i2h + h2h, self._activation)
+        return out, [out]
+
+
+class LSTMCell(_BaseRNNCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 4, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def forward(self, inputs, states):
+        self._resolve(inputs)
+        H = self._hidden_size
+        gates = nd.fully_connected(
+            inputs, self.i2h_weight.data(), self.i2h_bias.data(),
+            num_hidden=4 * H, flatten=False) + nd.fully_connected(
+            states[0], self.h2h_weight.data(), self.h2h_bias.data(),
+            num_hidden=4 * H, flatten=False)
+        i = nd.sigmoid(gates[..., :H])
+        f = nd.sigmoid(gates[..., H:2 * H])
+        g = nd.tanh(gates[..., 2 * H:3 * H])
+        o = nd.sigmoid(gates[..., 3 * H:])
+        c = f * states[1] + i * g
+        h = o * nd.tanh(c)
+        return h, [h, c]
+
+
+class GRUCell(_BaseRNNCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 3, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def forward(self, inputs, states):
+        self._resolve(inputs)
+        H = self._hidden_size
+        prev = states[0]
+        i2h = nd.fully_connected(inputs, self.i2h_weight.data(),
+                                 self.i2h_bias.data(), num_hidden=3 * H,
+                                 flatten=False)
+        h2h = nd.fully_connected(prev, self.h2h_weight.data(),
+                                 self.h2h_bias.data(), num_hidden=3 * H,
+                                 flatten=False)
+        r = nd.sigmoid(i2h[..., :H] + h2h[..., :H])
+        z = nd.sigmoid(i2h[..., H:2 * H] + h2h[..., H:2 * H])
+        n = nd.tanh(i2h[..., 2 * H:] + r * h2h[..., 2 * H:])
+        h = (1 - z) * n + z * prev
+        return h, [h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self):
+        super().__init__()
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        out = []
+        for cell in self._children.values():
+            out.extend(cell.state_info(batch_size))
+        return out
+
+    def begin_state(self, batch_size=0, **kwargs):
+        states = []
+        for cell in self._children.values():
+            states.append(cell.begin_state(batch_size, **kwargs))
+        return states
+
+    def forward(self, inputs, states):
+        next_states = []
+        for cell, state in zip(self._children.values(), states):
+            inputs, new_state = cell(inputs, state)
+            next_states.append(new_state)
+        return inputs, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+
+HybridSequentialRNNCell = SequentialRNNCell
+
+
+class _ModifierCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__()
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size, **kwargs)
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=()):
+        super().__init__()
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, inputs, states):
+        if self._rate > 0:
+            inputs = nd.dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class ZoneoutCell(_ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev_output = None
+
+    def forward(self, inputs, states):
+        out, next_states = self.base_cell(inputs, states)
+        from ... import autograd, random as mxrandom
+
+        if autograd.is_training():
+            if self._zo > 0:
+                mask = mxrandom.bernoulli(1 - self._zo, shape=out.shape)
+                prev = self._prev_output if self._prev_output is not None \
+                    else nd.zeros_like(out)
+                out = mask * out + (1 - mask) * prev
+            if self._zs > 0:
+                next_states = [
+                    mxrandom.bernoulli(1 - self._zs, shape=ns.shape) * ns +
+                    (1 - mxrandom.bernoulli(1 - self._zs, shape=ns.shape))
+                    * s for ns, s in zip(next_states, states)]
+        self._prev_output = out
+        return out, next_states
+
+    def reset(self):
+        self._prev_output = None
+
+
+class ResidualCell(_ModifierCell):
+    def forward(self, inputs, states):
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell):
+        super().__init__()
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+
+    def state_info(self, batch_size=0):
+        return (self._children["l_cell"].state_info(batch_size) +
+                self._children["r_cell"].state_info(batch_size))
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        l_cell = self._children["l_cell"]
+        r_cell = self._children["r_cell"]
+        axis = layout.find("T")
+        l_out, l_states = l_cell.unroll(length, inputs, None, layout, True,
+                                        valid_length)
+        rev = nd.flip(inputs, axis=axis) if valid_length is None else \
+            nd.sequence_reverse(inputs.swapaxes(0, axis), valid_length,
+                                True).swapaxes(0, axis)
+        r_out, r_states = r_cell.unroll(length, rev, None, layout, True,
+                                        valid_length)
+        r_out = nd.flip(r_out, axis=axis) if valid_length is None else \
+            nd.sequence_reverse(r_out.swapaxes(0, axis), valid_length,
+                                True).swapaxes(0, axis)
+        out = nd.concat(l_out, r_out, dim=2)
+        return out, l_states + r_states
+
+    def forward(self, inputs, states):
+        raise MXNetError("BidirectionalCell must be used with unroll()")

@@ -1,0 +1,243 @@
+"""Fused multi-layer RNN/LSTM/GRU layers.
+
+Reference: the monolithic fused RNN op (NNVM_REGISTER_OP(RNN),
+src/operator/rnn.cc:295 — cuDNN descriptors on GPU, rnn_impl.h on CPU)
+wrapped by python/mxnet/gluon/rnn/rnn_layer.py.
+
+TPU-native: the recurrence is a single ``lax.scan`` over time with all
+layers' gate GEMMs batched — XLA compiles the whole sequence loop into one
+program (the cuDNN-RNN equivalent on TPU).  Weight layout follows the
+reference's flat i2h/h2h per layer/direction.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ... import ndarray as nd
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from ...ops.registry import apply_op
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _cell_step(mode, x_gates, h_gates, h, c):
+    """One timestep given precomputed input gates + hidden gates."""
+    H = h.shape[-1]
+    g = x_gates + h_gates
+    if mode == "rnn_relu":
+        nh = jnp.maximum(g, 0)
+        return nh, c
+    if mode == "rnn_tanh":
+        nh = jnp.tanh(g)
+        return nh, c
+    if mode == "lstm":
+        i = jax.nn.sigmoid(g[..., :H])
+        f = jax.nn.sigmoid(g[..., H:2 * H])
+        gg = jnp.tanh(g[..., 2 * H:3 * H])
+        o = jax.nn.sigmoid(g[..., 3 * H:])
+        nc = f * c + i * gg
+        nh = o * jnp.tanh(nc)
+        return nh, nc
+    if mode == "gru":
+        # gru mixes r into h2h new-gate term: need separate handling
+        raise AssertionError("gru handled in _layer_scan")
+    raise MXNetError("unknown mode %s" % mode)
+
+
+def _layer_scan(mode, x, h0, c0, wi, wh, bi, bh):
+    """Scan one direction of one layer.  x: (T, B, I) -> (T, B, H)."""
+    H = h0.shape[-1]
+    # batch the input GEMM over all timesteps at once (MXU-friendly)
+    x_gates = jnp.einsum("tbi,gi->tbg", x, wi) + bi
+
+    if mode == "gru":
+        def step(carry, xg):
+            h, _ = carry
+            hg = jnp.einsum("bh,gh->bg", h, wh) + bh
+            r = jax.nn.sigmoid(xg[..., :H] + hg[..., :H])
+            z = jax.nn.sigmoid(xg[..., H:2 * H] + hg[..., H:2 * H])
+            n = jnp.tanh(xg[..., 2 * H:] + r * hg[..., 2 * H:])
+            nh = (1 - z) * n + z * h
+            return (nh, nh), nh
+    else:
+        def step(carry, xg):
+            h, c = carry
+            hg = jnp.einsum("bh,gh->bg", h, wh) + bh
+            nh, nc = _cell_step(mode, xg, hg, h, c)
+            return (nh, nc), nh
+
+    (hT, cT), outs = lax.scan(step, (h0, c0), x_gates)
+    return outs, hT, cT
+
+
+def _rnn_forward(x, h0, c0, mode, num_layers, bidirectional, dropout, key,
+                 *weights):
+    """Full fused RNN: x (T, B, I); weights flat list per (layer, dir):
+    wi, wh, bi, bh."""
+    ndir = 2 if bidirectional else 1
+    idx = 0
+    hs, cs = [], []
+    for layer in range(num_layers):
+        outs_dir = []
+        for d in range(ndir):
+            wi, wh, bi, bh = weights[idx:idx + 4]
+            idx += 4
+            xd = x if d == 0 else jnp.flip(x, axis=0)
+            li = layer * ndir + d
+            outs, hT, cT = _layer_scan(mode, xd, h0[li], c0[li], wi, wh,
+                                       bi, bh)
+            if d == 1:
+                outs = jnp.flip(outs, axis=0)
+            outs_dir.append(outs)
+            hs.append(hT)
+            cs.append(cT)
+        x = outs_dir[0] if ndir == 1 else jnp.concatenate(outs_dir, axis=-1)
+        if dropout > 0 and layer < num_layers - 1 and key is not None:
+            keep = 1.0 - dropout
+            mask = jax.random.bernoulli(
+                jax.random.fold_in(key, layer), keep, x.shape)
+            x = x * mask.astype(x.dtype) / keep
+    return x, jnp.stack(hs), jnp.stack(cs)
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, dtype="float32",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__()
+        assert layout in ("TNC", "NTC")
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._dtype = dtype
+        ng = _GATES[mode]
+        self._gates = ng
+        for layer in range(num_layers):
+            for d in range(self._dir):
+                suffix = "l%d%s" % (layer, "_r" if d else "")
+                isz = input_size if layer == 0 else \
+                    hidden_size * self._dir
+                setattr(self, "%s_i2h_weight" % suffix,
+                        Parameter("%s_i2h_weight" % suffix,
+                                  shape=(ng * hidden_size, isz or 0),
+                                  init=i2h_weight_initializer, dtype=dtype,
+                                  allow_deferred_init=True))
+                setattr(self, "%s_h2h_weight" % suffix,
+                        Parameter("%s_h2h_weight" % suffix,
+                                  shape=(ng * hidden_size, hidden_size),
+                                  init=h2h_weight_initializer, dtype=dtype))
+                setattr(self, "%s_i2h_bias" % suffix,
+                        Parameter("%s_i2h_bias" % suffix,
+                                  shape=(ng * hidden_size,),
+                                  init=i2h_bias_initializer, dtype=dtype))
+                setattr(self, "%s_h2h_bias" % suffix,
+                        Parameter("%s_h2h_bias" % suffix,
+                                  shape=(ng * hidden_size,),
+                                  init=h2h_bias_initializer, dtype=dtype))
+
+    def infer_shape(self, x, *args):
+        isz = x.shape[-1]
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                suffix = "l%d%s" % (layer, "_r" if d else "")
+                p = self._reg_params["%s_i2h_weight" % suffix]
+                layer_in = isz if layer == 0 else \
+                    self._hidden_size * self._dir
+                p.shape = (self._gates * self._hidden_size, layer_in)
+
+    def _resolve(self, x):
+        need = [p for p in self._reg_params.values() if p._data is None]
+        if need:
+            self.infer_shape(x)
+            for p in need:
+                p._finish_deferred_init()
+
+    def state_info(self, batch_size=0):
+        num = self._num_layers * self._dir
+        shapes = [{"shape": (num, batch_size, self._hidden_size)}]
+        if self._mode == "lstm":
+            shapes.append({"shape": (num, batch_size, self._hidden_size)})
+        return shapes
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        func = func or nd.zeros
+        return [func(shape=info["shape"], **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def forward(self, inputs, states=None):
+        self._resolve(inputs if self._layout == "TNC"
+                      else inputs.swapaxes(0, 1))
+        x = inputs if self._layout == "TNC" else inputs.swapaxes(0, 1)
+        batch = x.shape[1]
+        return_states = states is not None
+        if states is None:
+            states = self.begin_state(batch, dtype=str(self._dtype))
+        if self._mode == "lstm":
+            h0, c0 = states
+        else:
+            h0 = states[0] if isinstance(states, (list, tuple)) else states
+            c0 = nd.zeros_like(h0)
+        weights = []
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                suffix = "l%d%s" % (layer, "_r" if d else "")
+                for part in ("i2h_weight", "h2h_weight", "i2h_bias",
+                             "h2h_bias"):
+                    weights.append(
+                        self._reg_params["%s_%s" % (suffix, part)].data())
+        from ... import autograd, random as mxrandom
+
+        drop = self._dropout if autograd.is_training() else 0.0
+        key = mxrandom.take_key() if drop > 0 else None
+
+        def fused(x_, h0_, c0_, *ws):
+            return _rnn_forward(x_, h0_, c0_, self._mode, self._num_layers,
+                                self._dir == 2, drop, key, *ws)
+
+        fused.__name__ = "rnn_%s" % self._mode
+        out, hT, cT = apply_op(fused, x, h0, c0, *weights)
+        if self._layout == "NTC":
+            out = out.swapaxes(0, 1)
+        if not return_states:
+            return out
+        if self._mode == "lstm":
+            return out, [hT, cT]
+        return out, [hT]
+
+
+class RNN(_RNNLayer):
+    """Vanilla RNN (reference rnn_layer.py RNN; activation relu/tanh)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 input_size=0, **kwargs):
+        super().__init__("rnn_" + activation, hidden_size, num_layers,
+                         layout, dropout, bidirectional, input_size,
+                         **kwargs)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)

@@ -1,0 +1,175 @@
+"""Gluon Trainer.
+
+Reference: python/mxnet/gluon/trainer.py:31 — kvstore wiring
+(_init_kvstore:188), step:334 (allreduce_grads + update),
+save_states/load_states:482,511.
+
+TPU-native: gradients live on device; `step` applies the optimizer through
+XLA (each update fuses into a few kernels).  For the fully-fused path —
+fwd+bwd+allreduce+update in ONE compiled XLA program over a device mesh —
+see mxnet_tpu.parallel.train_step, which this Trainer's `fuse()` helper
+delegates to.  KVStore names keep their reference semantics: 'local'/
+'device' are process-local, 'dist_*' all-reduce across worker processes via
+collectives (no parameter servers).
+"""
+from __future__ import annotations
+
+import pickle
+
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from ..kvstore import create as kv_create
+from .parameter import Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict,)):
+            param_dict = dict(params)
+        elif isinstance(params, (list, tuple)):
+            param_dict = {i: p for i, p in enumerate(params)}
+        else:
+            raise MXNetError("params must be dict or list of Parameter")
+        self._param_names = list(param_dict.keys())
+        self._params = []
+        self._param2idx = {}
+        for i, (name, param) in enumerate(param_dict.items()):
+            if not isinstance(param, Parameter):
+                raise MXNetError("invalid parameter %r" % (param,))
+            self._params.append(param)
+            self._param2idx[id(param)] = i
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._scale = self._optimizer.rescale_grad
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._update_on_kvstore = update_on_kvstore
+        self._kv_initialized = False
+        self._states = {}
+        self._params_to_init = list(self._params)
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params:
+                raise MXNetError("optimizer_params must be None when "
+                                 "optimizer is an Optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer,
+                                             param_dict=param_dict,
+                                             **optimizer_params)
+
+    def _init_kvstore(self):
+        if self._kvstore_type is None or self._kvstore_type == "None":
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            kv = kv_create(self._kvstore_type) if isinstance(
+                self._kvstore_type, str) else self._kvstore_type
+            self._kvstore = kv
+            if self._compression_params and hasattr(
+                    kv, "set_gradient_compression"):
+                kv.set_gradient_compression(self._compression_params)
+            if self._update_on_kvstore is None:
+                self._update_on_kvstore = False
+            if self._update_on_kvstore:
+                if not kv.is_capable(kv.OPTIMIZER):
+                    raise MXNetError("kvstore %s cannot run the optimizer"
+                                     % kv.type)
+                kv.set_optimizer(self._optimizer)
+                for i, param in enumerate(self._params):
+                    if param._data is not None:
+                        kv.init(i, param.data())
+        self._kv_initialized = True
+
+    # ---- properties -------------------------------------------------------
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # ---- the step ---------------------------------------------------------
+    def _maybe_init_states(self, i, param):
+        if i not in self._states:
+            self._states[i] = \
+                self._optimizer.create_state_multi_precision(i, param.data())
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce_grads + update (reference trainer.py:334)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null" and param._data is not None:
+                if self._update_on_kvstore:
+                    continue
+                grads = param.list_grad()
+                self._kvstore.pushpull(i, grads, out=grads)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            if self._update_on_kvstore:
+                self._kvstore.pushpull(i, param.list_grad(),
+                                       out=param.list_data())
+                continue
+            self._maybe_init_states(i, param)
+            self._optimizer.update_multi_precision(
+                i, param.data(), param.grad(), self._states[i])
+
+    # ---- persistence ------------------------------------------------------
+    def save_states(self, fname):
+        """Reference trainer.py:482."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+            return
+        from ..optimizer.optimizer import _state_np
+
+        with open(fname, "wb") as f:
+            pickle.dump({k: _state_np(v) for k, v in self._states.items()},
+                        f)
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            return
+        from ..optimizer.optimizer import _state_nd
+
+        with open(fname, "rb") as f:
+            self._states = {k: _state_nd(v)
+                            for k, v in pickle.load(f).items()}

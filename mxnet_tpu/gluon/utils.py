@@ -1,0 +1,94 @@
+"""Gluon utilities (reference python/mxnet/gluon/utils.py —
+split_and_load, clip_global_norm, download...)."""
+from __future__ import annotations
+
+import math
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "download",
+           "check_sha1"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            "data size %d cannot be evenly split into %d slices"
+            % (size, num_slice))
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(begin, end)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Shard a batch across contexts (reference gluon/utils.py).  With one
+    TPU context this is a passthrough; multi-chip batch sharding is done by
+    pjit input shardings (mxnet_tpu.parallel), not host-side splits."""
+    if not isinstance(data, NDArray):
+        data = nd.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Reference gluon/utils.py clip_global_norm."""
+    import jax.numpy as jnp
+
+    if not arrays:
+        raise MXNetError("arrays must not be empty")
+    total = None
+    for a in arrays:
+        s = jnp.sum(jnp.square(a._data.astype(jnp.float32)))
+        total = s if total is None else total + s
+    total_norm = jnp.sqrt(total)
+    scale = jnp.minimum(1.0, max_norm / (total_norm + 1e-8))
+    for a in arrays:
+        a._data = (a._data.astype(jnp.float32) * scale).astype(a._data.dtype)
+    tn = float(total_norm)
+    if check_isfinite and not math.isfinite(tn):
+        import warnings
+
+        warnings.warn("nan or inf in gradient global norm")
+    return tn
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """Kept for API parity; this environment has no egress, so only
+    file:// URLs and existing files resolve."""
+    import os
+    import shutil
+
+    fname = path or url.split("/")[-1]
+    if os.path.isdir(fname):
+        fname = os.path.join(fname, url.split("/")[-1])
+    if os.path.exists(fname) and not overwrite:
+        return fname
+    if url.startswith("file://"):
+        shutil.copyfile(url[len("file://"):], fname)
+        return fname
+    raise MXNetError("download unavailable (no network egress): %s" % url)

@@ -1,0 +1,126 @@
+"""Image utilities (reference python/mxnet/image/image.py — imread,
+imresize, augmenters, ImageIter).  OpenCV-free: PIL when available, npy
+always."""
+from __future__ import annotations
+
+import os
+
+import numpy as _np
+
+from . import ndarray as nd
+from .base import MXNetError
+
+__all__ = ["imread", "imresize", "imdecode", "resize_short", "center_crop",
+           "random_crop", "ImageIter", "CreateAugmenter"]
+
+
+def imread(filename, flag=1, to_rgb=True):
+    if filename.endswith(".npy"):
+        return nd.array(_np.load(filename), dtype="uint8")
+    try:
+        from PIL import Image
+    except ImportError as exc:
+        raise MXNetError("PIL unavailable; use .npy images") from exc
+    img = Image.open(filename)
+    if flag == 1:
+        img = img.convert("RGB")
+    else:
+        img = img.convert("L")
+    arr = _np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return nd.array(arr, dtype="uint8")
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    import io
+
+    try:
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(buf))
+        img = img.convert("RGB" if flag else "L")
+        return nd.array(_np.asarray(img), dtype="uint8")
+    except Exception:
+        return nd.array(_np.load(io.BytesIO(buf)), dtype="uint8")
+
+
+def imresize(src, w, h, interp=1):
+    import jax
+
+    data = src._data.astype("float32")
+    out = jax.image.resize(data, (h, w, data.shape[2]), "bilinear")
+    return nd.array(out).astype(src.dtype)
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size if isinstance(size, tuple) else (size, size)
+    x0 = max(0, (w - new_w) // 2)
+    y0 = max(0, (h - new_h) // 2)
+    out = src[y0:y0 + new_h, x0:x0 + new_w]
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size if isinstance(size, tuple) else (size, size)
+    x0 = _np.random.randint(0, max(1, w - new_w + 1))
+    y0 = _np.random.randint(0, max(1, h - new_h + 1))
+    out = src[y0:y0 + new_h, x0:x0 + new_w]
+    return out, (x0, y0, new_w, new_h)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, **kwargs):
+    augs = []
+    if resize > 0:
+        augs.append(lambda img: resize_short(img, resize))
+    if rand_crop:
+        augs.append(lambda img: random_crop(img, (data_shape[2],
+                                                  data_shape[1]))[0])
+    else:
+        augs.append(lambda img: center_crop(img, (data_shape[2],
+                                                  data_shape[1]))[0])
+    if rand_mirror:
+        def mirror(img):
+            if _np.random.rand() < 0.5:
+                return img[:, ::-1, :]
+            return img
+
+        augs.append(mirror)
+    return augs
+
+
+class ImageIter:
+    """Pre-Gluon image iterator (reference image/image.py ImageIter); thin
+    wrapper over ImageRecordIter / ImageFolderDataset paths."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_root=None, shuffle=False, aug_list=None, **kwargs):
+        from .io import ImageRecordIter
+
+        if path_imgrec:
+            self._iter = ImageRecordIter(path_imgrec, data_shape,
+                                         batch_size, shuffle, **kwargs)
+        else:
+            raise MXNetError("ImageIter needs path_imgrec (or use "
+                             "gluon.data.vision.ImageFolderDataset)")
+
+    def __iter__(self):
+        return self._iter.__iter__()
+
+    def reset(self):
+        self._iter.reset()
+
+    def next(self):
+        return self._iter.next()

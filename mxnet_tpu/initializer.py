@@ -1,0 +1,246 @@
+"""Weight initializers (reference python/mxnet/initializer.py, 713 LoC:
+Xavier/MSRA/Orthogonal/Uniform/Normal/Constant + registry + InitDesc)."""
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["Initializer", "Uniform", "Normal", "Constant", "Zero", "One",
+           "Xavier", "MSRAPrelu", "Orthogonal", "Bilinear", "LSTMBias",
+           "Mixed", "InitDesc", "register", "create"]
+
+_INIT_REGISTRY = {}
+
+
+def register(klass):
+    _INIT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+_ALIASES = {"zeros": "zero", "ones": "one", "gaussian": "normal",
+            "msra": "msraprelu", "xavier": "xavier"}
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    if callable(name):
+        return name
+    key = name.lower()
+    key = _ALIASES.get(key, key)
+    if key not in _INIT_REGISTRY:
+        raise MXNetError("unknown initializer %r" % name)
+    return _INIT_REGISTRY[key](**kwargs)
+
+
+class InitDesc(str):
+    """Name + attrs describing what is being initialized (reference
+    initializer.py InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, desc, arr):
+        self.init_weight(desc, arr)
+
+    def init_weight(self, name, arr):
+        name = str(name)
+        if name.endswith("bias"):
+            self._init_zero(arr)
+        elif name.endswith("gamma"):
+            self._init_one(arr)
+        elif name.endswith("beta"):
+            self._init_zero(arr)
+        elif "running_mean" in name or "moving_mean" in name:
+            self._init_zero(arr)
+        elif "running_var" in name or "moving_var" in name:
+            self._init_one(arr)
+        else:
+            self._init_weight(name, arr)
+
+    def _init_zero(self, arr):
+        _fill(arr, _np.zeros(arr.shape, arr.dtype))
+
+    def _init_one(self, arr):
+        _fill(arr, _np.ones(arr.shape, arr.dtype))
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return "%s(%s)" % (type(self).__name__, self._kwargs)
+
+
+def _fill(arr, value):
+    import jax.numpy as jnp
+
+    arr._data = jnp.asarray(_np.asarray(value, dtype=arr.dtype))
+
+
+def _rng():
+    from . import random as mxrand
+    import jax
+
+    return mxrand, jax
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        mxrand, jax_ = _rng()
+        key = mxrand.take_key()
+        arr._data = jax_.random.uniform(key, arr.shape, minval=-self.scale,
+                                        maxval=self.scale).astype(arr.dtype)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        mxrand, jax_ = _rng()
+        arr._data = (jax_.random.normal(mxrand.take_key(), arr.shape) *
+                     self.sigma).astype(arr.dtype)
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        _fill(arr, _np.full(arr.shape, self.value, arr.dtype))
+
+
+@register
+class Zero(Constant):
+    def __init__(self):
+        super().__init__(0.0)
+
+
+@register
+class One(Constant):
+    def __init__(self):
+        super().__init__(1.0)
+
+
+@register
+class Xavier(Initializer):
+    """Reference initializer.py Xavier: rnd_type uniform/gaussian,
+    factor_type avg/in/out."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise MXNetError("Xavier requires ndim >= 2, got %s for %s"
+                             % (shape, name))
+        if len(shape) > 2:
+            hw_scale = _np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in,
+                  "out": fan_out}[self.factor_type]
+        scale = math.sqrt(self.magnitude / factor)
+        mxrand, jax_ = _rng()
+        key = mxrand.take_key()
+        if self.rnd_type == "uniform":
+            arr._data = jax_.random.uniform(
+                key, shape, minval=-scale, maxval=scale).astype(arr.dtype)
+        else:
+            arr._data = (jax_.random.normal(key, shape) * scale).astype(
+                arr.dtype)
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        _fill(arr, (self.scale * q).reshape(arr.shape))
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        weight = _np.zeros(int(_np.prod(arr.shape)), dtype="float32")
+        shape = arr.shape
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        _fill(arr, weight.reshape(shape))
+
+
+@register
+class LSTMBias(Initializer):
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = _np.zeros(arr.shape, dtype="float32")
+        num_hidden = int(b.shape[0] / 4)
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        _fill(arr, b)
+
+
+class Mixed:
+    """Per-pattern initializer mux (reference initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        assert len(patterns) == len(initializers)
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(str(name)):
+                init(name, arr)
+                return
+        raise MXNetError("no initializer pattern matches %r" % str(name))

@@ -1,0 +1,303 @@
+"""Legacy DataIter API (reference python/mxnet/io/ — NDArrayIter:490,
+ResizeIter:281, PrefetchingIter:346, CSVIter and the C++
+MXNET_REGISTER_IO_ITER iterators of src/io/)."""
+from __future__ import annotations
+
+import threading
+from collections import namedtuple
+
+import numpy as _np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference io/io.py:490)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True,
+                                default_name=label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self.idx = _np.arange(self.num_data)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]),
+                         v.dtype) for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]),
+                         v.dtype) for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            _np.random.shuffle(self.idx)
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "roll_over":
+            return self.cursor + self.batch_size <= self.num_data
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _take(self, arrays):
+        end = self.cursor + self.batch_size
+        sel = self.idx[self.cursor:min(end, self.num_data)]
+        if end > self.num_data and self.last_batch_handle == "pad":
+            pad = end - self.num_data
+            sel = _np.concatenate([sel, self.idx[:pad]])
+        return [nd.array(_np.asarray(v.asnumpy() if isinstance(v, NDArray)
+                                     else v)[sel]) for _, v in arrays]
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        end = self.cursor + self.batch_size
+        if self.last_batch_handle == "pad" and end > self.num_data:
+            return end - self.num_data
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        if not allow_empty:
+            raise MXNetError("data is required")
+        return []
+    if isinstance(data, (NDArray, _np.ndarray)):
+        data = [(default_name, data)]
+    elif isinstance(data, dict):
+        data = sorted(data.items())
+    elif isinstance(data, (list, tuple)):
+        data = [("%s_%d" % (default_name, i), d)
+                for i, d in enumerate(data)]
+    out = []
+    for k, v in data:
+        if isinstance(v, _np.ndarray):
+            v = nd.array(v.astype(_np.float32) if v.dtype == _np.float64
+                         else v)
+        out.append((k, v))
+    return out
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator's epoch length (reference io.py:281)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Thread-prefetch wrapper (reference io.py:346)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        iters = iters if isinstance(iters, list) else [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self._queue = []
+        self._lock = threading.Lock()
+        self.current_batch = None
+
+    def reset(self):
+        for it in self.iters:
+            it.reset()
+
+    def iter_next(self):
+        try:
+            batches = [it.next() for it in self.iters]
+        except StopIteration:
+            return False
+        b = batches[0]
+        if len(batches) > 1:
+            data = sum((bb.data for bb in batches), [])
+            label = sum((bb.label for bb in batches), [])
+            b = DataBatch(data, label, pad=b.pad)
+        self.current_batch = b
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class CSVIter(NDArrayIter):
+    """CSV reader (reference src/io/iter_csv.cc)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None,
+                 label_shape=(1,), batch_size=1, **kwargs):
+        data = _np.loadtxt(data_csv, delimiter=",", dtype=_np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",", dtype=_np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+        super().__init__(data, label, batch_size=batch_size, **kwargs)
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST iterator (reference src/io/iter_mnist.cc:260); parses the
+    idx-ubyte files when present, else the synthetic MNIST dataset."""
+
+    def __init__(self, image=None, label=None, batch_size=128, shuffle=True,
+                 flat=False, **kwargs):
+        from ..gluon.data.vision import MNIST
+
+        train = image is None or "train" in str(image)
+        ds = MNIST(train=train)
+        data = ds._data.asnumpy().astype(_np.float32) / 255.0
+        data = data.transpose(0, 3, 1, 2)
+        if flat:
+            data = data.reshape(len(data), -1)
+        super().__init__(data, ds._label.astype(_np.float32),
+                         batch_size=batch_size, shuffle=shuffle)
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image pipeline (reference src/io/iter_image_recordio_2.cc:
+    887 — decode thread pool + augment + batch + prefetch).  Python/thread
+    version; the native C++ pipeline is tracked in native/."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size=1, shuffle=False,
+                 label_width=1, mean_r=0, mean_g=0, mean_b=0, scale=1.0,
+                 rand_crop=False, rand_mirror=False, preprocess_threads=4,
+                 **kwargs):
+        super().__init__(batch_size)
+        from ..gluon.data.vision.datasets import ImageRecordDataset
+        from ..gluon.data import DataLoader
+
+        self._dataset = ImageRecordDataset(path_imgrec)
+        self._shape = tuple(data_shape)
+        self._scale = scale
+        self._mean = _np.array([mean_r, mean_g, mean_b],
+                               dtype=_np.float32).reshape(3, 1, 1)
+        self._loader = DataLoader(self._dataset, batch_size=batch_size,
+                                  shuffle=shuffle, last_batch="discard",
+                                  num_workers=preprocess_threads)
+        self._it = None
+
+    def reset(self):
+        self._it = None
+
+    def next(self):
+        if self._it is None:
+            self._it = iter(self._loader)
+        try:
+            data, label = next(self._it)
+        except StopIteration:
+            self._it = None
+            raise
+        x = data.astype("float32").transpose((0, 3, 1, 2))
+        if self._mean.any():
+            x = x - nd.array(self._mean)
+        if self._scale != 1.0:
+            x = x * self._scale
+        return DataBatch([x], [nd.array(_np.asarray(label))], pad=0)

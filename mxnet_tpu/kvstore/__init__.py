@@ -1,0 +1,7 @@
+"""KVStore package (reference python/mxnet/kvstore/)."""
+from .base import KVStoreBase, create, register
+from .collective import CollectiveKVStore
+from .kvstore import KVStore
+
+__all__ = ["KVStoreBase", "KVStore", "CollectiveKVStore", "create",
+           "register"]

@@ -1,0 +1,80 @@
+"""KVStore plugin registry (reference python/mxnet/kvstore/base.py:74,
+217-242 — KVStoreBase with register(), capability strings, and the
+horovod/byteps third-party backends behind the same interface)."""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["KVStoreBase", "register", "create"]
+
+_KVSTORE_REGISTRY = {}
+
+
+def register(klass):
+    _KVSTORE_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+class KVStoreBase:
+    """Interface: broadcast / pushpull (+ optional optimizer offload)."""
+
+    OPTIMIZER = "optimizer"
+
+    def broadcast(self, key, value, out):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError
+
+    def push(self, key, value, priority=0):
+        raise NotImplementedError
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        raise NotImplementedError
+
+    def set_optimizer(self, optimizer):
+        raise NotImplementedError
+
+    @staticmethod
+    def is_capable(capability):
+        return False
+
+    @property
+    def type(self):
+        return type(self).__name__.lower()
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        raise NotImplementedError
+
+    def load_optimizer_states(self, fname):
+        raise NotImplementedError
+
+
+def create(name="local", **kwargs):
+    """Factory (reference src/kvstore/kvstore.cc:42-85 name dispatch).
+
+    Names kept from the reference: local / device / dist_sync / dist_device
+    _sync / dist_async / nccl / horovod / byteps — on TPU they all resolve
+    to either the single-process store or the collective store (XLA
+    collectives over ICI replace both NCCL rings and ps-lite servers)."""
+    name = name.lower()
+    from .kvstore import KVStore
+    from .collective import CollectiveKVStore
+
+    if name in ("local", "device", "local_allreduce_cpu",
+                "local_allreduce_device"):
+        return KVStore(**kwargs)
+    if name in ("dist", "dist_sync", "dist_device_sync", "dist_async",
+                "dist_sync_device", "nccl", "horovod", "byteps"):
+        return CollectiveKVStore(mode=name, **kwargs)
+    if name in _KVSTORE_REGISTRY:
+        return _KVSTORE_REGISTRY[name](**kwargs)
+    raise MXNetError("unknown kvstore type %r" % name)

@@ -1,0 +1,122 @@
+"""Single-process KVStore (reference KVStoreLocal, src/kvstore/
+kvstore_local.h — per-key merge buffers + device comm).
+
+On TPU a single *process* drives many chips, so "local" covers both the
+reference's 'local' and 'device' modes: values live as jax.Arrays; when the
+caller hands multiple replicas (one per device) they are reduced by summing
+— the CommDevice reduce-scatter machinery (src/kvstore/comm.h:452) is XLA's
+job when the train step is pjit-ed, so this store is plain bookkeeping.
+"""
+from __future__ import annotations
+
+import pickle
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .base import KVStoreBase
+
+
+class KVStore(KVStoreBase):
+    def __init__(self, **kwargs):
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+
+    @property
+    def type(self):
+        return "local"
+
+    def _key(self, key):
+        return str(key)
+
+    # ---- classic API ------------------------------------------------------
+    def init(self, key, value):
+        keys, values = _pair(key, value)
+        for k, v in zip(keys, values):
+            self._store[self._key(k)] = v.copy()
+
+    def push(self, key, value, priority=0):
+        keys, values = _pair(key, value)
+        for k, v in zip(keys, values):
+            merged = _reduce(v)
+            k = self._key(k)
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError("key %s not initialized" % k)
+                self._updater(k, merged, self._store[k])
+            else:
+                self._store[k] = merged
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _pair(key, out)
+        for k, o in zip(keys, outs):
+            src = self._store[self._key(k)]
+            for dst in (o if isinstance(o, (list, tuple)) else [o]):
+                src.copyto(dst)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        keys, values = _pair(key, value)
+        for k, v in zip(keys, values):
+            merged = _reduce(v)
+            kk = self._key(k)
+            if self._updater is not None and kk in self._store:
+                self._updater(kk, merged, self._store[kk])
+                merged = self._store[kk]
+            else:
+                self._store[kk] = merged
+            if out is not None:
+                _, outs = _pair(key, out)
+        if out is not None:
+            keys2, outs = _pair(key, out)
+            for k, o in zip(keys2, outs):
+                src = self._store.get(self._key(k))
+                for dst in (o if isinstance(o, (list, tuple)) else [o]):
+                    src.copyto(dst)
+
+    def broadcast(self, key, value, out):
+        self.init(key, value)
+        if out is not None:
+            self.pull(key, out)
+
+    # ---- optimizer offload (reference update_on_kvstore) ------------------
+    def set_optimizer(self, optimizer):
+        from ..optimizer import Updater
+
+        self._optimizer = optimizer
+        self._updater = Updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    @staticmethod
+    def is_capable(capability):
+        return capability == KVStoreBase.OPTIMIZER
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def _pair(key, value):
+    if isinstance(key, (list, tuple)):
+        return list(key), list(value)
+    return [key], [value]
+
+
+def _reduce(value):
+    if isinstance(value, (list, tuple)):
+        if len(value) == 1:
+            return value[0].copy()
+        acc = value[0]
+        for v in value[1:]:
+            acc = acc + v
+        return acc
+    return value.copy()

@@ -1,0 +1,4 @@
+"""Top-level mx.metric alias (reference keeps metrics importable both as
+mxnet.metric (1.x) and mxnet.gluon.metric (2.0))."""
+from .gluon.metric import *  # noqa: F401,F403
+from .gluon.metric import __all__  # noqa: F401

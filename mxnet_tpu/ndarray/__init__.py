@@ -1,0 +1,230 @@
+"""``mx.nd`` — the imperative NDArray API.
+
+Reference: python/mxnet/ndarray/ — op wrappers are code-generated at import
+from the C++ op registry (register.py:265 _make_ndarray_function).  Here the
+registry is Python-native (mxnet_tpu/ops/registry.py) so the "generated
+wrapper" is simply the registered Operator object exposed under its name;
+every call flows through the same invoke() path the reference routes through
+MXImperativeInvoke.
+"""
+# pylint: disable=redefined-builtin,wildcard-import
+from __future__ import annotations
+
+import pickle
+
+import numpy as _np
+
+from ..base import MXNetError, _as_np_dtype
+from ..context import current_context
+from ..ops import core as _core
+from ..ops import nn as _nn
+from ..ops.registry import get_op, list_ops
+from .ndarray import NDArray, concatenate, from_jax, waitall
+
+# ---- re-export every registered op under its MXNet name -------------------
+_namespace = globals()
+for _name in list_ops():
+    _namespace.setdefault(_name, get_op(_name))
+
+# broadcast_* and elemwise_* legacy aliases (reference op names)
+broadcast_add = elemwise_add = _core.add
+broadcast_sub = elemwise_sub = _core.subtract
+broadcast_mul = elemwise_mul = _core.multiply
+broadcast_div = elemwise_div = _core.divide
+broadcast_power = _core.power
+broadcast_maximum = _core.maximum
+broadcast_minimum = _core.minimum
+broadcast_equal = _core.equal
+broadcast_not_equal = _core.not_equal
+broadcast_greater = _core.greater
+broadcast_greater_equal = _core.greater_equal
+broadcast_lesser = _core.lesser
+broadcast_lesser_equal = _core.lesser_equal
+broadcast_like = _core.broadcast_to
+Activation = _nn.relu  # overridden below by proper dispatcher
+Embedding = _core.embedding
+FullyConnected = _nn.fully_connected
+Convolution = _nn.convolution
+Deconvolution = _nn.deconvolution
+Pooling = _nn.pooling
+BatchNorm = _nn.batch_norm
+LayerNorm = _nn.layer_norm
+GroupNorm = _nn.group_norm
+InstanceNorm = _nn.instance_norm
+LRN = _nn.lrn
+SequenceMask = _core.sequence_mask
+SequenceLast = _core.sequence_last
+SequenceReverse = _core.sequence_reverse
+Cast = _core.cast
+Concat = _core.concat
+SoftmaxActivation = _nn.softmax
+L2Normalization = _nn.l2_normalization
+UpSampling = _nn.upsampling
+BlockGrad = stop_gradient = _core.stop_gradient
+
+
+def Activation(data, act_type="relu"):  # noqa: F811
+    """Reference: src/operator/nn/activation.cc act_type dispatch."""
+    fns = {"relu": _nn.relu, "sigmoid": _nn.sigmoid, "tanh": _core.tanh,
+           "softrelu": _nn.softrelu, "softsign": _nn.softsign,
+           "log_sigmoid": _nn.log_sigmoid, "mish": _nn.mish,
+           "gelu": _nn.gelu, "silu": _nn.silu}
+    return fns[act_type](data)
+
+
+def LeakyReLU(data, gamma=None, act_type="leaky", slope=0.25,
+              lower_bound=0.125, upper_bound=0.334):
+    """Reference: src/operator/leaky_relu.cc."""
+    if act_type == "leaky":
+        return _nn.leaky_relu(data, slope=slope)
+    if act_type == "prelu":
+        return _nn.prelu(data, gamma)
+    if act_type == "elu":
+        return _nn.elu(data, alpha=slope)
+    if act_type == "selu":
+        return _nn.selu(data)
+    if act_type == "gelu":
+        return _nn.gelu(data)
+    if act_type == "rrelu":
+        from .. import autograd as _ag
+        if _ag.is_training():
+            from .. import random as _rnd
+            u = _rnd.uniform(lower_bound, upper_bound, shape=data.shape)
+            return _nn.prelu(data, u)
+        return _nn.leaky_relu(data, slope=(lower_bound + upper_bound) / 2)
+    raise MXNetError("unknown act_type %s" % act_type)
+
+
+def dropout(data, p=0.5, mode="training", axes=None):
+    """Imperative dropout: draws a key from the global RNG state
+    (reference nn/dropout.cc; active only in autograd training mode)."""
+    from .. import autograd as _ag
+    from .. import random as _rnd
+
+    if mode == "always" or (_ag.is_training() and p > 0.0):
+        return _nn.dropout(data, _rnd.take_key(), p=p,
+                           axes=tuple(axes) if axes else None)
+    return data
+
+
+Dropout = dropout
+
+
+# ---- creation -------------------------------------------------------------
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, NDArray):
+        source_array = source_array.asnumpy()
+    arr = _np.asarray(source_array, dtype=_as_np_dtype(dtype) if dtype
+                      else None)
+    if arr.dtype == _np.float64 and dtype is None:
+        arr = arr.astype(_np.float32)
+    data = _jnp().asarray(arr)
+    return NDArray(data, ctx=ctx or current_context())
+
+
+def zeros(shape, ctx=None, dtype="float32", **kw):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_jnp().zeros(shape, _as_np_dtype(dtype)),
+                   ctx=ctx or current_context())
+
+
+def ones(shape, ctx=None, dtype="float32", **kw):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_jnp().ones(shape, _as_np_dtype(dtype)),
+                   ctx=ctx or current_context())
+
+
+def full(shape, val, ctx=None, dtype="float32", **kw):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_jnp().full(shape, val, _as_np_dtype(dtype)),
+                   ctx=ctx or current_context())
+
+
+def empty(shape, ctx=None, dtype="float32"):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    data = _jnp().arange(start, stop, step, _as_np_dtype(dtype))
+    if repeat > 1:
+        data = _jnp().repeat(data, repeat)
+    return NDArray(data, ctx=ctx or current_context())
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype="float32"):
+    return NDArray(_jnp().linspace(start, stop, num, endpoint=endpoint,
+                                   dtype=_as_np_dtype(dtype)),
+                   ctx=ctx or current_context())
+
+
+def eye(N, M=0, k=0, ctx=None, dtype="float32"):
+    return NDArray(_jnp().eye(N, M if M else None, k,
+                              dtype=_as_np_dtype(dtype)),
+                   ctx=ctx or current_context())
+
+
+def zeros_like(other, **kw):
+    return _core.zeros_like(other)
+
+
+def ones_like(other, **kw):
+    return _core.ones_like(other)
+
+
+def moveaxis(a, source, destination):
+    from ..ops.registry import apply_op
+
+    return apply_op(lambda x: _jnp().moveaxis(x, source, destination), a)
+
+
+# ---- serialization (reference MXNDArraySave/Load, ndarray/utils.py) -------
+
+
+def save(fname, data):
+    """Save NDArray / list / dict of NDArray (reference ndarray/utils.py:149).
+
+    Format: numpy .npz under the hood (TPU-native: the reference's custom
+    binary chunk format served its C++ loader; npz keeps numpy interop)."""
+    if isinstance(data, NDArray):
+        payload = {"__mx_single__": data.asnumpy()}
+    elif isinstance(data, dict):
+        payload = {k: v.asnumpy() for k, v in data.items()}
+    elif isinstance(data, (list, tuple)):
+        payload = {"__mx_list_%d__" % i: v.asnumpy()
+                   for i, v in enumerate(data)}
+    else:
+        raise MXNetError("save: unsupported data type %r" % type(data))
+    with open(fname, "wb") as f:
+        _np.savez(f, **payload)
+
+
+def load(fname):
+    with _np.load(fname, allow_pickle=False) as npz:
+        keys = list(npz.keys())
+        if keys == ["__mx_single__"]:
+            return array(npz["__mx_single__"])
+        if all(k.startswith("__mx_list_") for k in keys):
+            out = [None] * len(keys)
+            for k in keys:
+                out[int(k[len("__mx_list_"):-2])] = array(npz[k])
+            return out
+        return {k: array(npz[k]) for k in keys}
+
+
+# submodules / namespaces
+from .. import random  # noqa: E402  (mx.nd.random mirror)
+from . import sparse  # noqa: E402
+
+__all__ = ["NDArray", "waitall", "array", "zeros", "ones", "full", "empty",
+           "arange", "linspace", "eye", "save", "load", "concatenate",
+           "random", "sparse"] + list_ops()

@@ -1,0 +1,165 @@
+"""Sparse NDArray: row_sparse + CSR.
+
+Reference: kRowSparseStorage / kCSRStorage (include/mxnet/ndarray.h:61-66),
+src/operator/tensor/cast_storage, sparse dot (tensor/dot-inl.h).
+
+TPU-native design decision (SURVEY §7 hard part 2): XLA is dense-only, so
+sparse storage is a *format* held as dense index/value buffers on device;
+ops that have efficient gather/scatter/segment-sum lowerings run on TPU
+(row_sparse dot, sparse grads for embeddings), everything else falls back by
+densifying — the same philosophy as the reference's storage-fallback
+executor (src/imperative/attach_op_execs_pass.cc:50), with the fallback
+being "densify" instead of "copy to CPU".
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError, _as_np_dtype
+from .ndarray import NDArray
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "zeros"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ("indices_", "indptr_", "_shape")
+
+    @property
+    def shape(self):
+        return self._shape
+
+    def asnumpy(self):
+        return self.tostype("default").asnumpy()
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """(data[K, ...], indices[K]) — K stored rows of a larger array."""
+
+    def __init__(self, data, indices, shape):
+        super().__init__(data)
+        self.indices_ = indices
+        self.indptr_ = None
+        self._shape = tuple(shape)
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def data(self):
+        return NDArray(self._data)
+
+    @property
+    def indices(self):
+        return NDArray(self.indices_)
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype != "default":
+            raise MXNetError("cast_storage row_sparse->%s unsupported" % stype)
+        jnp = _jnp()
+        dense = jnp.zeros(self._shape, self._data.dtype)
+        idx = self.indices_.astype(jnp.int32)
+        return NDArray(dense.at[idx].add(self._data))
+
+    def __repr__(self):
+        return "<RowSparseNDArray %s>" % (self._shape,)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    def __init__(self, data, indices, indptr, shape):
+        super().__init__(data)
+        self.indices_ = indices
+        self.indptr_ = indptr
+        self._shape = tuple(shape)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def data(self):
+        return NDArray(self._data)
+
+    @property
+    def indices(self):
+        return NDArray(self.indices_)
+
+    @property
+    def indptr(self):
+        return NDArray(self.indptr_)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype != "default":
+            raise MXNetError("cast_storage csr->%s unsupported" % stype)
+        jnp = _jnp()
+        m, n = self._shape
+        indptr = _np.asarray(self.indptr_)
+        rows = _np.repeat(_np.arange(m), _np.diff(indptr))
+        dense = jnp.zeros((m, n), self._data.dtype)
+        return NDArray(dense.at[rows, self.indices_.astype(_jnp().int32)]
+                       .add(self._data))
+
+    def __repr__(self):
+        return "<CSRNDArray %s>" % (self._shape,)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    jnp = _jnp()
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = jnp.asarray(_np.asarray(data, dtype=_as_np_dtype(dtype)
+                                       if dtype else _np.float32))
+        indices = jnp.asarray(_np.asarray(indices, dtype=_np.int64))
+        return RowSparseNDArray(data, indices, shape)
+    arr = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
+    nz = _np.where(_np.any(arr.reshape(arr.shape[0], -1) != 0, axis=1))[0]
+    return RowSparseNDArray(jnp.asarray(arr[nz]), jnp.asarray(nz),
+                            shape or arr.shape)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    jnp = _jnp()
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(jnp.asarray(_np.asarray(data)),
+                          jnp.asarray(_np.asarray(indices, _np.int64)),
+                          jnp.asarray(_np.asarray(indptr, _np.int64)), shape)
+    arr = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
+    m, n = arr.shape
+    indptr = [0]
+    indices = []
+    data = []
+    for i in range(m):
+        nz = _np.where(arr[i] != 0)[0]
+        indices.extend(nz.tolist())
+        data.extend(arr[i, nz].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(jnp.asarray(_np.asarray(data, arr.dtype)),
+                      jnp.asarray(_np.asarray(indices, _np.int64)),
+                      jnp.asarray(_np.asarray(indptr, _np.int64)),
+                      shape or arr.shape)
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    jnp = _jnp()
+    dt = _as_np_dtype(dtype)
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dt),
+                                jnp.zeros((0,), jnp.int64), shape)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dt), jnp.zeros((0,), jnp.int64),
+                          jnp.zeros((shape[0] + 1,), jnp.int64), shape)
+    from . import zeros as dense_zeros
+
+    return dense_zeros(shape, ctx=ctx, dtype=dtype)

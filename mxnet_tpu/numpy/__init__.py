@@ -1,0 +1,218 @@
+"""``mx.np`` — NumPy-compatible front-end.
+
+Reference: python/mxnet/numpy/ (14.8k LoC) — mx.np.ndarray with
+__array_function__ interop (multiarray.py:264,367), op handlers under
+src/api/operator/numpy/* (216 _npi_* registrations) and fallback-to-numpy
+for uncovered ops (numpy/fallback.py).
+
+TPU-native: jax.numpy IS a numpy-compatible op set, so rather than
+re-registering 216 handlers this namespace adapts jnp wholesale: any
+``mx.np.foo`` resolves to ``jnp.foo`` wrapped to (a) accept/return
+mxnet_tpu NDArrays and (b) route through the autograd-recording invoke path
+(ops/registry.py).  Functions already registered in the framework op
+registry (softmax etc.) take priority.  This gives the full numpy surface —
+einsum, linalg, fft, polynomial... — with every call jit-traceable.
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as _onp
+
+from ..base import MXNetError, _as_np_dtype
+from ..context import current_context
+from ..ndarray.ndarray import NDArray
+from ..ops.registry import apply_op
+
+ndarray = NDArray
+
+_float64_names = set()
+
+pi = _onp.pi
+e = _onp.e
+euler_gamma = _onp.euler_gamma
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+
+_NON_DIFF = {
+    "argmax", "argmin", "argsort", "argwhere", "around", "round", "round_",
+    "sign", "floor", "ceil", "trunc", "rint", "fix", "equal", "not_equal",
+    "greater", "greater_equal", "less", "less_equal", "isnan", "isinf",
+    "isfinite", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "nonzero", "unique", "searchsorted", "digitize", "bincount",
+}
+
+# names that must not be auto-adapted
+_SKIP = {"ndarray", "dtype", "generic"}
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _unwrap(x):
+    if isinstance(x, NDArray):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return x
+
+
+def _wrap_out(out):
+    import jax
+
+    if isinstance(out, jax.Array):
+        return NDArray(out)
+    if isinstance(out, (list, tuple)):
+        return type(out)(_wrap_out(o) for o in out)
+    return out
+
+
+def _adapt(name, fn):
+    def wrapped(*args, **kwargs):
+        has_nd = any(isinstance(a, NDArray) for a in args) or any(
+            isinstance(a, NDArray)
+            for arg in args if isinstance(arg, (list, tuple)) for a in arg)
+        nd_args = []
+        positions = []
+        flat_args = list(args)
+        # split NDArray positional args from static ones so attrs stay static
+        plain_args = []
+        for i, a in enumerate(flat_args):
+            if isinstance(a, NDArray):
+                positions.append(i)
+                nd_args.append(a)
+                plain_args.append(None)
+            else:
+                plain_args.append(_unwrap(a))
+        kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+
+        def pure(*datas):
+            merged = list(plain_args)
+            for p, d in zip(positions, datas):
+                merged[p] = d
+            return fn(*merged, **kwargs)
+
+        pure.__name__ = "np." + name
+        if name in _NON_DIFF or not nd_args:
+            out = pure(*[a._data for a in nd_args])
+            return _wrap_out(out)
+        out = apply_op(pure, *nd_args)
+        return out
+
+    wrapped.__name__ = name
+    wrapped.__qualname__ = name
+    wrapped.__doc__ = fn.__doc__
+    return wrapped
+
+
+class _NPModule(types.ModuleType):
+    def __getattr__(self, name):
+        if name.startswith("__"):
+            raise AttributeError(name)
+        jnp = _jnp()
+        target = getattr(jnp, name, None)
+        if target is None:
+            # fallback to plain numpy (reference numpy/fallback.py)
+            target = getattr(_onp, name, None)
+            if target is None:
+                raise AttributeError("mx.np has no attribute %r" % name)
+        if isinstance(target, types.ModuleType):
+            sub = _SubModule("%s.%s" % (__name__, name), target)
+            setattr(self, name, sub)
+            return sub
+        if callable(target):
+            fn = _adapt(name, target)
+            setattr(self, name, fn)
+            return fn
+        setattr(self, name, target)
+        return target
+
+
+class _SubModule(types.ModuleType):
+    """Adapted jnp submodule (linalg, fft, ...)."""
+
+    def __init__(self, name, target):
+        super().__init__(name)
+        self._target = target
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        obj = getattr(self._target, name)
+        if callable(obj):
+            fn = _adapt(name, obj)
+            setattr(self, name, fn)
+            return fn
+        return obj
+
+
+# creation / conversion with mxnet semantics ---------------------------------
+
+def array(obj, dtype=None, ctx=None, device=None):
+    if isinstance(obj, NDArray):
+        obj = obj.asnumpy()
+    arr = _onp.asarray(obj)
+    if dtype is None and arr.dtype == _onp.float64:
+        arr = arr.astype(_onp.float32)
+    elif dtype is not None:
+        arr = arr.astype(_as_np_dtype(dtype))
+    return NDArray(_jnp().asarray(arr), ctx=ctx or device or
+                   current_context())
+
+
+def zeros(shape, dtype="float32", ctx=None, device=None, order="C"):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_jnp().zeros(shape, _as_np_dtype(dtype or "float32")),
+                   ctx=ctx or device or current_context())
+
+
+def ones(shape, dtype="float32", ctx=None, device=None, order="C"):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_jnp().ones(shape, _as_np_dtype(dtype or "float32")),
+                   ctx=ctx or device or current_context())
+
+
+def full(shape, fill_value, dtype=None, ctx=None, device=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_jnp().full(shape, fill_value,
+                               _as_np_dtype(dtype) if dtype else None),
+                   ctx=ctx or device or current_context())
+
+
+def empty(shape, dtype="float32", ctx=None, device=None):
+    return zeros(shape, dtype, ctx, device)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None, device=None):
+    return NDArray(_jnp().arange(start, stop, step,
+                                 _as_np_dtype(dtype) if dtype else None),
+                   ctx=ctx or device or current_context())
+
+
+def eye(N, M=None, k=0, dtype="float32", ctx=None, device=None):
+    return NDArray(_jnp().eye(N, M, k, dtype=_as_np_dtype(dtype)))
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None, device=None):
+    out = _jnp().linspace(start, stop, num, endpoint=endpoint,
+                          retstep=retstep, dtype=_as_np_dtype(dtype)
+                          if dtype else None, axis=axis)
+    if retstep:
+        return NDArray(out[0]), out[1]
+    return NDArray(out)
+
+
+# install the auto-adapting module class
+_mod = sys.modules[__name__]
+_mod.__class__ = _NPModule
+
+from .. import random  # noqa: E402  (mx.np.random mirror)

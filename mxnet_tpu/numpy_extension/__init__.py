@@ -1,0 +1,45 @@
+"""``mx.npx`` — numpy-extension ops (reference python/mxnet/
+numpy_extension/: the non-numpy "neural" ops usable with mx.np arrays +
+np-mode switches)."""
+from __future__ import annotations
+
+from ..ndarray import (Activation, BatchNorm, Convolution, Deconvolution,
+                       Embedding, FullyConnected, LayerNorm, Pooling,
+                       dropout, one_hot, pick, relu, sigmoid, softmax,
+                       log_softmax, topk, gamma, erf, erfinv,
+                       sequence_mask, gather_nd, reshape, batch_dot)
+from ..util import (is_np_array, is_np_shape, reset_np, set_np, use_np,
+                    use_np_array, use_np_shape)
+from ..context import cpu, current_context, gpu, num_gpus, num_tpus, tpu
+from .. import random  # noqa: F401
+from ..base import get_env  # noqa: F401
+
+fully_connected = FullyConnected
+convolution = Convolution
+pooling = Pooling
+batch_norm = BatchNorm
+layer_norm = LayerNorm
+embedding = Embedding
+activation = Activation
+
+
+def seed(s):
+    random.seed(s)
+
+
+def waitall():
+    from ..ndarray.ndarray import waitall as _w
+
+    return _w()
+
+
+def load(fname):
+    from .. import ndarray as nd
+
+    return nd.load(fname)
+
+
+def save(fname, data):
+    from .. import ndarray as nd
+
+    return nd.save(fname, data)

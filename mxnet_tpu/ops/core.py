@@ -1,0 +1,843 @@
+"""Core tensor ops (elemwise, broadcast, reduce, matrix, indexing, ordering).
+
+Reference surface: src/operator/tensor/ (39k LoC of mshadow/cuBLAS kernels —
+elemwise_binary_broadcast_op*, broadcast_reduce*, dot-inl.h, matrix_op*,
+indexing_op, ordering_op) plus the numpy front-end ops (src/operator/numpy/).
+
+TPU-native: every op is one pure jnp/lax expression; XLA fuses chains of
+them into single kernels (replacing both mshadow expression templates and
+the NVRTC FusedOp subsystem, src/operator/fusion/fused_op.h:58).
+"""
+# pylint: disable=redefined-builtin
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# ---- elemwise binary (broadcasting; reference elemwise_binary_broadcast) ---
+
+
+@register("add")
+def add(lhs, rhs):
+    return jnp.add(lhs, rhs)
+
+
+@register("subtract")
+def subtract(lhs, rhs):
+    return jnp.subtract(lhs, rhs)
+
+
+@register("multiply")
+def multiply(lhs, rhs):
+    return jnp.multiply(lhs, rhs)
+
+
+@register("divide")
+def divide(lhs, rhs):
+    return jnp.divide(lhs, rhs)
+
+
+@register("floor_divide")
+def floor_divide(lhs, rhs):
+    return jnp.floor_divide(lhs, rhs)
+
+
+@register("mod")
+def mod(lhs, rhs):
+    return jnp.mod(lhs, rhs)
+
+
+@register("power")
+def power(lhs, rhs):
+    return jnp.power(lhs, rhs)
+
+
+@register("maximum")
+def maximum(lhs, rhs):
+    return jnp.maximum(lhs, rhs)
+
+
+@register("minimum")
+def minimum(lhs, rhs):
+    return jnp.minimum(lhs, rhs)
+
+
+@register("hypot")
+def hypot(lhs, rhs):
+    return jnp.hypot(lhs, rhs)
+
+
+@register("arctan2")
+def arctan2(lhs, rhs):
+    return jnp.arctan2(lhs, rhs)
+
+
+@register("logaddexp")
+def logaddexp(lhs, rhs):
+    return jnp.logaddexp(lhs, rhs)
+
+
+# comparisons (non-differentiable)
+@register("equal", differentiable=False)
+def equal(lhs, rhs):
+    return jnp.equal(lhs, rhs)
+
+
+@register("not_equal", differentiable=False)
+def not_equal(lhs, rhs):
+    return jnp.not_equal(lhs, rhs)
+
+
+@register("greater", differentiable=False)
+def greater(lhs, rhs):
+    return jnp.greater(lhs, rhs)
+
+
+@register("greater_equal", differentiable=False)
+def greater_equal(lhs, rhs):
+    return jnp.greater_equal(lhs, rhs)
+
+
+@register("lesser", differentiable=False)
+def lesser(lhs, rhs):
+    return jnp.less(lhs, rhs)
+
+
+@register("lesser_equal", differentiable=False)
+def lesser_equal(lhs, rhs):
+    return jnp.less_equal(lhs, rhs)
+
+
+@register("logical_and", differentiable=False)
+def logical_and(lhs, rhs):
+    return jnp.logical_and(lhs, rhs)
+
+
+@register("logical_or", differentiable=False)
+def logical_or(lhs, rhs):
+    return jnp.logical_or(lhs, rhs)
+
+
+@register("logical_xor", differentiable=False)
+def logical_xor(lhs, rhs):
+    return jnp.logical_xor(lhs, rhs)
+
+
+@register("logical_not", differentiable=False)
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+# ---- elemwise unary --------------------------------------------------------
+
+
+@register("negative")
+def negative(x):
+    return jnp.negative(x)
+
+
+@register("abs")
+def abs(x):
+    return jnp.abs(x)
+
+
+@register("sign")
+def sign(x):
+    return jnp.sign(x)
+
+
+@register("round")
+def round(x):
+    return jnp.round(x)
+
+
+@register("rint")
+def rint(x):
+    return jnp.rint(x)
+
+
+@register("ceil")
+def ceil(x):
+    return jnp.ceil(x)
+
+
+@register("floor")
+def floor(x):
+    return jnp.floor(x)
+
+
+@register("trunc")
+def trunc(x):
+    return jnp.trunc(x)
+
+
+@register("fix")
+def fix(x):
+    return jnp.fix(x)
+
+
+@register("square")
+def square(x):
+    return jnp.square(x)
+
+
+@register("sqrt")
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+@register("rsqrt")
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+@register("cbrt")
+def cbrt(x):
+    return jnp.cbrt(x)
+
+
+@register("rcbrt")
+def rcbrt(x):
+    return 1.0 / jnp.cbrt(x)
+
+
+@register("exp")
+def exp(x):
+    return jnp.exp(x)
+
+
+@register("expm1")
+def expm1(x):
+    return jnp.expm1(x)
+
+
+@register("log")
+def log(x):
+    return jnp.log(x)
+
+
+@register("log10")
+def log10(x):
+    return jnp.log10(x)
+
+
+@register("log2")
+def log2(x):
+    return jnp.log2(x)
+
+
+@register("log1p")
+def log1p(x):
+    return jnp.log1p(x)
+
+
+@register("reciprocal")
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+@register("sin")
+def sin(x):
+    return jnp.sin(x)
+
+
+@register("cos")
+def cos(x):
+    return jnp.cos(x)
+
+
+@register("tan")
+def tan(x):
+    return jnp.tan(x)
+
+
+@register("arcsin")
+def arcsin(x):
+    return jnp.arcsin(x)
+
+
+@register("arccos")
+def arccos(x):
+    return jnp.arccos(x)
+
+
+@register("arctan")
+def arctan(x):
+    return jnp.arctan(x)
+
+
+@register("sinh")
+def sinh(x):
+    return jnp.sinh(x)
+
+
+@register("cosh")
+def cosh(x):
+    return jnp.cosh(x)
+
+
+@register("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@register("arcsinh")
+def arcsinh(x):
+    return jnp.arcsinh(x)
+
+
+@register("arccosh")
+def arccosh(x):
+    return jnp.arccosh(x)
+
+
+@register("arctanh")
+def arctanh(x):
+    return jnp.arctanh(x)
+
+
+@register("degrees")
+def degrees(x):
+    return jnp.degrees(x)
+
+
+@register("radians")
+def radians(x):
+    return jnp.radians(x)
+
+
+@register("erf")
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+@register("erfinv")
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+@register("gamma")
+def gamma(x):
+    return jnp.exp(jax.scipy.special.gammaln(x))
+
+
+@register("gammaln")
+def gammaln(x):
+    return jax.scipy.special.gammaln(x)
+
+
+@register("digamma")
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+@register("isnan", differentiable=False)
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@register("isinf", differentiable=False)
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@register("isfinite", differentiable=False)
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+@register("clip")
+def clip(x, a_min=None, a_max=None):
+    return jnp.clip(x, a_min, a_max)
+
+
+# ---- reductions (reference broadcast_reduce_op) ---------------------------
+
+
+def _norm_axis(axis):
+    if isinstance(axis, list):
+        return tuple(axis)
+    return axis
+
+
+@register("sum")
+def sum(x, axis=None, keepdims=False, dtype=None):
+    return jnp.sum(x, axis=_norm_axis(axis), keepdims=keepdims, dtype=dtype)
+
+
+@register("mean")
+def mean(x, axis=None, keepdims=False, dtype=None):
+    return jnp.mean(x, axis=_norm_axis(axis), keepdims=keepdims, dtype=dtype)
+
+
+@register("prod")
+def prod(x, axis=None, keepdims=False):
+    return jnp.prod(x, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@register("max")
+def max(x, axis=None, keepdims=False):
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@register("min")
+def min(x, axis=None, keepdims=False):
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@register("std")
+def std(x, axis=None, ddof=0, keepdims=False):
+    return jnp.std(x, axis=_norm_axis(axis), ddof=ddof, keepdims=keepdims)
+
+
+@register("var")
+def var(x, axis=None, ddof=0, keepdims=False):
+    return jnp.var(x, axis=_norm_axis(axis), ddof=ddof, keepdims=keepdims)
+
+
+@register("norm")
+def norm(x, ord=2, axis=None, keepdims=False):
+    if ord == 2 and axis is None:
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    return jnp.linalg.norm(x, ord=ord, axis=_norm_axis(axis),
+                           keepdims=keepdims)
+
+
+@register("argmax", differentiable=False)
+def argmax(x, axis=None):
+    return jnp.argmax(x, axis=axis)
+
+
+@register("argmin", differentiable=False)
+def argmin(x, axis=None):
+    return jnp.argmin(x, axis=axis)
+
+
+@register("cumsum")
+def cumsum(x, axis=None, dtype=None):
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+@register("cumprod")
+def cumprod(x, axis=None):
+    return jnp.cumprod(x, axis=axis)
+
+
+@register("logsumexp")
+def logsumexp(x, axis=None, keepdims=False):
+    return jax.scipy.special.logsumexp(x, axis=_norm_axis(axis),
+                                       keepdims=keepdims)
+
+
+# ---- matrix / linalg (reference dot-inl.h, la_op.cc; MXU-resident) --------
+
+
+@register("dot")
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """MXU matmul.  Reference: src/operator/tensor/dot-inl.h (cuBLAS GEMM).
+    Promotes to preferred_element_type=f32 accumulation on bf16 inputs."""
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2) if lhs.ndim > 1 else lhs
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2) if rhs.ndim > 1 else rhs
+    if lhs.ndim == 1 and rhs.ndim == 1:
+        return jnp.dot(lhs, rhs)
+    # MXNet semantics: contract the LAST axis of lhs with the FIRST of rhs
+    return lax.dot_general(
+        lhs, rhs,
+        dimension_numbers=(((lhs.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32
+        if lhs.dtype == jnp.bfloat16 else None,
+    ).astype(jnp.result_type(lhs.dtype, rhs.dtype))
+
+
+@register("batch_dot")
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    return jnp.matmul(lhs, rhs)
+
+
+@register("matmul")
+def matmul(a, b):
+    return jnp.matmul(a, b)
+
+
+@register("tensordot")
+def tensordot(a, b, axes=2):
+    return jnp.tensordot(a, b, axes=axes)
+
+
+@register("einsum")
+def einsum(*operands, subscripts=None, optimize=True):
+    return jnp.einsum(subscripts, *operands, optimize=bool(optimize))
+
+
+@register("outer")
+def outer(a, b):
+    return jnp.outer(a, b)
+
+
+@register("inner")
+def inner(a, b):
+    return jnp.inner(a, b)
+
+
+@register("kron")
+def kron(a, b):
+    return jnp.kron(a, b)
+
+
+@register("trace")
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register("diag")
+def diag(x, k=0):
+    return jnp.diag(x, k=k)
+
+
+@register("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+# ---- shape manipulation (reference matrix_op*.cc) -------------------------
+
+
+@register("transpose")
+def transpose(x, axes=None):
+    return jnp.transpose(x, axes=axes)
+
+
+@register("swapaxes")
+def swapaxes(x, dim1=0, dim2=1):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register("expand_dims")
+def expand_dims(x, axis=0):
+    return jnp.expand_dims(x, axis)
+
+
+@register("squeeze")
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+@register("reshape")
+def reshape(x, shape=None):
+    return jnp.reshape(x, shape)
+
+
+@register("flatten")
+def flatten(x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("broadcast_to")
+def broadcast_to(x, shape=None):
+    shape = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+@register("tile")
+def tile(x, reps=None):
+    return jnp.tile(x, reps)
+
+
+@register("repeat")
+def repeat(x, repeats=1, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register("flip")
+def flip(x, axis=None):
+    return jnp.flip(x, axis=axis)
+
+
+@register("roll")
+def roll(x, shift=None, axis=None):
+    return jnp.roll(x, shift, axis=axis)
+
+
+@register("rot90")
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@register("concat")
+def concat(*xs, dim=1):
+    return jnp.concatenate(xs, axis=dim)
+
+
+@register("stack")
+def stack(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+@register("split", num_outputs=None)
+def split(x, num_outputs=None, axis=1, squeeze_axis=False):
+    outs = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        outs = [jnp.squeeze(o, axis=axis) for o in outs]
+    return tuple(outs)
+
+
+@register("array_split", num_outputs=None)
+def array_split(x, indices_or_sections, axis=0):
+    return tuple(jnp.array_split(x, indices_or_sections, axis=axis))
+
+
+@register("slice_axis")
+def slice_axis(x, axis=0, begin=0, end=None):
+    return lax.slice_in_dim(x, begin, end if end is not None else x.shape[axis],
+                            axis=axis)
+
+
+@register("slice_like")
+def slice_like(x, shape_like, axes=None):
+    slices = [slice(None)] * x.ndim
+    axes_ = axes if axes is not None else range(x.ndim)
+    for ax in axes_:
+        slices[ax] = slice(0, shape_like[ax])
+    return x[tuple(slices)]
+
+
+@register("pad")
+def pad(x, pad_width=None, mode="constant", constant_value=0):
+    if mode == "constant":
+        return jnp.pad(x, pad_width, mode=mode,
+                       constant_values=constant_value)
+    return jnp.pad(x, pad_width, mode=mode)
+
+
+@register("where")
+def where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+@register("tril")
+def tril(x, k=0):
+    return jnp.tril(x, k=k)
+
+
+@register("triu")
+def triu(x, k=0):
+    return jnp.triu(x, k=k)
+
+
+@register("meshgrid", num_outputs=None)
+def meshgrid(*xs, indexing="xy"):
+    return tuple(jnp.meshgrid(*xs, indexing=indexing))
+
+
+# ---- indexing (reference indexing_op.cc) ----------------------------------
+
+
+@register("take")
+def take(x, indices, axis=0, mode="clip"):
+    return jnp.take(x, indices.astype(jnp.int32) if hasattr(indices, "astype")
+                    else indices, axis=axis, mode=mode)
+
+
+@register("pick")
+def pick(x, index, axis=-1, keepdims=False):
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return out if keepdims else jnp.squeeze(out, axis=axis)
+
+
+@register("take_along_axis")
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+@register("gather_nd")
+def gather_nd(data, indices):
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return data[idx]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, shape=None):
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return jnp.zeros(shape, data.dtype).at[idx].set(data)
+
+
+@register("embedding")
+def embedding(indices, weight):
+    """Reference: src/operator/tensor/indexing_op.cc Embedding."""
+    return jnp.take(weight, indices.astype(jnp.int32), axis=0)
+
+
+@register("one_hot")
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=dtype)
+    return oh * (on_value - off_value) + off_value
+
+
+@register("boolean_mask", differentiable=False)
+def boolean_mask(data, mask):
+    # dynamic-shape op: executes un-jitted (reference contrib/boolean_mask)
+    return data[mask.astype(bool)]
+
+
+# ---- ordering (reference ordering_op.cc) ----------------------------------
+
+
+@register("sort")
+def sort(x, axis=-1, is_ascend=True):
+    out = jnp.sort(x, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort", differentiable=False)
+def argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    out = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(dtype)
+
+
+@register("topk", differentiable=False, num_outputs=None)
+def topk(x, k=1, axis=-1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    neg = not is_ascend
+    xm = x if neg else -x
+    xs = jnp.moveaxis(xm, axis, -1)
+    vals, idx = lax.top_k(xs, k)
+    if not neg:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    if ret_typ == "indices":
+        return idx.astype(dtype)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return (vals, idx.astype(dtype))
+    raise ValueError("unknown ret_typ %s" % ret_typ)
+
+
+@register("unique", differentiable=False)
+def unique(x):
+    return jnp.unique(x)
+
+
+@register("nonzero", differentiable=False)
+def nonzero(x):
+    # dynamic output shape: host fallback path (SURVEY §7 hard part 1)
+    return jnp.stack(jnp.nonzero(x), axis=-1)
+
+
+@register("histogram", differentiable=False, num_outputs=2)
+def histogram(x, bins=10, range=None):
+    cnt, edges = jnp.histogram(x, bins=bins, range=range)
+    return cnt, edges
+
+
+# ---- sequence ops (reference sequence_*.cc) -------------------------------
+
+
+@register("sequence_mask")
+def sequence_mask(data, sequence_length=None, use_sequence_length=True,
+                  value=0.0, axis=0):
+    """Reference: src/operator/sequence_mask.cc — mask time steps beyond
+    per-batch lengths.  data: (T, B, ...) for axis=0."""
+    if sequence_length is None or not use_sequence_length:
+        return data
+    T = data.shape[axis]
+    pos = jnp.arange(T)
+    sl = sequence_length.astype(jnp.int32)
+    if axis == 0:      # (T, B, ...)
+        mask = pos[:, None] < sl[None, :]
+    else:              # (B, T, ...)
+        mask = pos[None, :] < sl[:, None]
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, value)
+
+
+@register("sequence_last")
+def sequence_last(data, sequence_length=None, use_sequence_length=False,
+                  axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.take(data, data.shape[axis] - 1, axis=axis)
+    idx = (sequence_length.astype(jnp.int32) - 1)
+    batch = jnp.arange(data.shape[1 - axis])
+    if axis == 0:
+        return data[idx, batch]
+    return data[batch, idx]
+
+
+@register("sequence_reverse")
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                     axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    T = data.shape[axis]
+    pos = jnp.arange(T)
+    sl = sequence_length.astype(jnp.int32)
+    # reversed index within each sequence, identity beyond length
+    rev = jnp.where(pos[:, None] < sl[None, :], sl[None, :] - 1 - pos[:, None],
+                    pos[:, None])
+    batch = jnp.arange(data.shape[1])
+    return data[rev, batch[None, :]]
+
+
+# ---- casting / misc -------------------------------------------------------
+
+
+@register("cast")
+def cast(x, dtype="float32"):
+    from ..base import _as_np_dtype
+
+    return jnp.asarray(x, dtype=_as_np_dtype(dtype))
+
+
+@register("identity")
+def identity(x):
+    return x
+
+
+@register("stop_gradient", differentiable=False)
+def stop_gradient(x):
+    return lax.stop_gradient(x)
+
+
+@register("shape_array", differentiable=False)
+def shape_array(x):
+    return jnp.array(x.shape, dtype=jnp.int64)
+
+
+@register("size_array", differentiable=False)
+def size_array(x):
+    return jnp.array([x.size], dtype=jnp.int64)
+
+
+@register("zeros_like")
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like")
+def ones_like(x):
+    return jnp.ones_like(x)
+
+
+@register("full_like")
+def full_like(x, fill_value=0.0):
+    return jnp.full_like(x, fill_value)
+
+
+@register("add_n")
+def add_n(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out

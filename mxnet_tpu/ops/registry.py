@@ -1,0 +1,151 @@
+"""Operator registry + imperative dispatch.
+
+Reference design: 586 ``NNVM_REGISTER_OP`` registrations, each carrying
+FInferShape/FInferType/FCompute attrs (include/mxnet/op_attr_types.h:218-316),
+invoked through Imperative::Invoke → SetShapeType → PushFCompute → engine
+(src/imperative/imperative.cc:49,98; imperative_utils.h:169,636).
+
+TPU-native redesign: an op is a *pure JAX function* ``fn(*arrays, **attrs)``.
+- Shape/type inference: ``jax.eval_shape`` derives it from the same fn —
+  there is no separate FInferShape table to keep in sync.
+- FCompute<tpu>: the fn itself; XLA lowers and fuses it.  Hot ops override
+  with Pallas kernels (mxnet_tpu/ops/pallas/*).
+- The async engine: PJRT's async dispatch — calling fn returns immediately
+  with a future-backed jax.Array, which is exactly the reference engine's
+  "push returns, var carries pending write" contract.
+- Autograd: at record time the op runs under ``jax.vjp``; the vjp closure is
+  the tape node (see mxnet_tpu/autograd.py).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from ..base import MXNetError, thread_state
+
+__all__ = ["Operator", "register", "get_op", "list_ops", "invoke", "apply_op"]
+
+_OP_REGISTRY = {}
+
+
+class Operator:
+    """A registered op: name, pure fn, doc, and dispatch metadata."""
+
+    __slots__ = ("name", "fn", "num_outputs", "differentiable", "doc")
+
+    def __init__(self, name, fn, num_outputs=1, differentiable=True, doc=None):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs
+        self.differentiable = differentiable
+        self.doc = doc or fn.__doc__
+
+    def __call__(self, *inputs, **attrs):
+        return invoke(self, inputs, attrs)
+
+    def __repr__(self):
+        return "Operator(%s)" % self.name
+
+
+def register(name=None, num_outputs=1, differentiable=True):
+    """Register a pure JAX function as a framework op.
+
+    Usage::
+
+        @register("relu")
+        def relu(x):
+            return jnp.maximum(x, 0)
+    """
+
+    def deco(fn):
+        opname = name or fn.__name__
+        if opname in _OP_REGISTRY:
+            raise MXNetError("op '%s' registered twice" % opname)
+        op = Operator(opname, fn, num_outputs, differentiable)
+        _OP_REGISTRY[opname] = op
+        return op
+
+    return deco
+
+
+def get_op(name):
+    try:
+        return _OP_REGISTRY[name]
+    except KeyError:
+        raise MXNetError("unknown op '%s'" % name) from None
+
+
+def list_ops():
+    return sorted(_OP_REGISTRY)
+
+
+def _is_float(x):
+    import jax.numpy as jnp
+
+    return jnp.issubdtype(x.dtype, jnp.floating) or jnp.issubdtype(
+        x.dtype, jnp.complexfloating)
+
+
+def invoke(op, inputs, attrs):
+    """Imperative invoke: run ``op`` on NDArray inputs, record if needed.
+
+    Mirrors Imperative::Invoke + RecordOp (imperative.cc:98,204) with XLA as
+    the executor.
+    """
+    from ..ndarray.ndarray import NDArray
+
+    datas = [x._data if isinstance(x, NDArray) else x for x in inputs]
+    if attrs:
+        # array-valued attrs (e.g. length masks) ride along as constants
+        attrs = {k: (v._data if isinstance(v, NDArray) else v)
+                 for k, v in attrs.items()}
+        fn = functools.partial(op.fn, **attrs)
+    else:
+        fn = op.fn
+
+    recordable = (
+        thread_state.is_recording
+        and op.differentiable
+        and any(_on_tape(x) for x in inputs if isinstance(x, NDArray))
+    )
+    if recordable:
+        from ..autograd import TapeNode
+
+        def tuple_fn(*args):
+            out = fn(*args)
+            return out if isinstance(out, tuple) else (out,)
+
+        out_datas, vjp_fn = jax.vjp(tuple_fn, *datas)
+        nd_inputs = [x for x in inputs if isinstance(x, NDArray)]
+        # vjp_fn covers every positional arg; non-NDArray args get dropped.
+        positions = [i for i, x in enumerate(inputs) if isinstance(x, NDArray)]
+
+        def vjp_wrapper(out_cts, _vjp=vjp_fn, _pos=positions, _n=len(datas)):
+            all_grads = _vjp(tuple(out_cts))
+            return [all_grads[i] for i in _pos]
+
+        node = TapeNode(
+            vjp_wrapper, nd_inputs, len(out_datas),
+            out_avals=[(o.shape, o.dtype) for o in out_datas],
+            name=op.name)
+        outs = [NDArray(o) for o in out_datas]
+        for i, o in enumerate(outs):
+            if _is_float(o._data):
+                o._entry = (node, i)
+        return outs[0] if (op.num_outputs == 1 and len(outs) == 1) else tuple(outs)
+
+    out = fn(*datas)
+    if isinstance(out, tuple):
+        return tuple(NDArray(o) for o in out)
+    return NDArray(out)
+
+
+def _on_tape(x):
+    return getattr(x, "_marked", False) or getattr(x, "_entry", None) is not None
+
+
+def apply_op(fn, *inputs, **attrs):
+    """One-off invoke of an unregistered pure fn through the same record path."""
+    op = Operator(getattr(fn, "__name__", "lambda"), fn)
+    return invoke(op, inputs, attrs)

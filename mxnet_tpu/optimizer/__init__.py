@@ -1,0 +1,7 @@
+"""Optimizer package (reference python/mxnet/optimizer/)."""
+from . import lr_scheduler, optimizer
+from .lr_scheduler import *  # noqa: F401,F403
+from .optimizer import *  # noqa: F401,F403
+from .optimizer import _OPT_REGISTRY  # noqa: F401
+
+__all__ = optimizer.__all__ + lr_scheduler.__all__

@@ -1,0 +1,728 @@
+"""Optimizers.
+
+Reference: python/mxnet/optimizer/ (3,688 LoC — 18 optimizers with a
+registry, multi-precision master weights, aggregate_num multi-tensor
+fusion) + the fused C++/CUDA kernels (src/operator/optimizer_op*.cc,
+contrib multi_lamb/multi_lans/...).
+
+TPU-native: each update rule is one pure jnp expression executed through
+XLA (fused into a couple of kernels per tensor).  The multi-tensor fused
+kernels of the reference are unnecessary as a separate concept: the
+pjit/fused train step (mxnet_tpu.parallel.train_step) runs ALL parameter
+updates inside one XLA computation, which is strictly stronger bulking than
+aggregate_num.  Multi-precision (bf16 weights + f32 master copy) is
+supported via ``multi_precision``.
+"""
+# pylint: disable=too-many-instance-attributes
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Optimizer", "create", "register", "SGD", "NAG", "Adam", "AdamW",
+           "Adamax", "Nadam", "LAMB", "LANS", "LARS", "Ftrl", "FTML",
+           "AdaGrad", "AdaDelta", "RMSProp", "SGLD", "Signum", "DCASGD",
+           "LBSGD", "Test", "Updater", "get_updater"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+_OPT_REGISTRY = {}
+
+
+def register(klass):
+    _OPT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    key = name.lower()
+    if key not in _OPT_REGISTRY:
+        raise MXNetError("unknown optimizer %r" % name)
+    return _OPT_REGISTRY[key](**kwargs)
+
+
+class Optimizer:
+    """Base optimizer (reference optimizer/optimizer.py:29)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, aggregate_num=None, use_fused_step=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate if learning_rate is not None else 0.01
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and learning_rate is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num or 1
+        self.idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+
+    # ---- hyper-parameter plumbing (reference semantics) -------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("lr_scheduler is active; set lr via scheduler")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    @learning_rate.setter
+    def learning_rate(self, lr):
+        self.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self.num_update,
+                              self._index_update_count[index])
+
+    def _get_lr(self, index):
+        lr = (self.lr_scheduler(self.num_update)
+              if self.lr_scheduler is not None else self.lr)
+        param = self.param_dict.get(index)
+        if param is not None:
+            lr *= param.lr_mult
+        else:
+            lr *= self.lr_mult.get(self.idx2name.get(index, index), 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        param = self.param_dict.get(index)
+        if param is not None:
+            wd *= param.wd_mult
+        else:
+            wd *= self.wd_mult.get(self.idx2name.get(index, index), 1.0)
+        return wd
+
+    def _preprocess_grad(self, grad):
+        jnp = _jnp()
+        g = grad._data.astype(jnp.float32) * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    # ---- state ------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == _np.float16:
+            master = weight.astype("float32")
+            return (master, self.create_state(index, master))
+        if self.multi_precision and str(weight.dtype) == "bfloat16":
+            master = weight.astype("float32")
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    # ---- update -----------------------------------------------------------
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        use_mp = self.multi_precision and weight.dtype in (
+            _np.float16,) or (self.multi_precision and
+                              str(weight.dtype) == "bfloat16")
+        if use_mp and isinstance(state, tuple) and len(state) == 2 and \
+                isinstance(state[0], NDArray):
+            master, substate = state
+            grad32 = grad.astype("float32")
+            self.update(index, master, grad32, substate)
+            weight._data = master._data.astype(weight._data.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+    def __repr__(self):
+        return "%s(lr=%s, wd=%s)" % (type(self).__name__, self.lr, self.wd)
+
+
+def _zeros_like(weight, dtype=None):
+    jnp = _jnp()
+    return NDArray(jnp.zeros(weight.shape,
+                             dtype or _jnp().float32))
+
+
+@register
+class SGD(Optimizer):
+    """SGD w/ momentum (reference optimizer/sgd.py; multi-precision at
+    sgd.py:96-106)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        jnp = _jnp()
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        w = weight._data.astype(jnp.float32)
+        g = g + wd * w
+        if state is not None:
+            mom = state._data * self.momentum - lr * g
+            state._data = mom
+            w = w + mom
+        else:
+            w = w - lr * g
+        weight._data = w.astype(weight._data.dtype)
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference optimizer/sgd.py NAG)."""
+
+    def update(self, index, weight, grad, state):
+        jnp = _jnp()
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        w = weight._data.astype(jnp.float32)
+        g = g + wd * w
+        if state is not None:
+            mom = state._data * self.momentum - lr * g
+            state._data = mom
+            w = w + self.momentum * mom - lr * g
+        else:
+            w = w - lr * g
+        weight._data = w.astype(weight._data.dtype)
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        jnp = _jnp()
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = self._preprocess_grad(grad)
+        w = weight._data.astype(jnp.float32)
+        g = g + wd * w
+        m, v = state
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        v._data = self.beta2 * v._data + (1 - self.beta2) * jnp.square(g)
+        mhat = m._data / (1 - self.beta1 ** t)
+        vhat = v._data / (1 - self.beta2 ** t)
+        w = w - lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
+        weight._data = w.astype(weight._data.dtype)
+
+
+@register
+class AdamW(Adam):
+    """Decoupled weight decay (reference contrib adamw.cc)."""
+
+    def update(self, index, weight, grad, state):
+        jnp = _jnp()
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = self._preprocess_grad(grad)
+        w = weight._data.astype(jnp.float32)
+        m, v = state
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        v._data = self.beta2 * v._data + (1 - self.beta2) * jnp.square(g)
+        mhat = m._data / (1 - self.beta1 ** t)
+        vhat = v._data / (1 - self.beta2 ** t)
+        w = w - lr * (mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * w)
+        weight._data = w.astype(weight._data.dtype)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        jnp = _jnp()
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1 - self.beta1 ** t)
+        g = self._preprocess_grad(grad)
+        w = weight._data.astype(jnp.float32)
+        g = g + wd * w
+        m, u = state
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        u._data = jnp.maximum(self.beta2 * u._data, jnp.abs(g))
+        w = w - lr * m._data / (u._data + 1e-8)
+        weight._data = w.astype(weight._data.dtype)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        jnp = _jnp()
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = self._preprocess_grad(grad)
+        w = weight._data.astype(jnp.float32)
+        g = g + wd * w
+        momentum_t = self.beta1 * (1 - 0.5 * 0.96 ** (t *
+                                                      self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1 - 0.5 * 0.96 ** (
+            (t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        v._data = self.beta2 * v._data + (1 - self.beta2) * jnp.square(g)
+        g_prime = g / (1 - self.m_schedule)
+        m_prime = m._data / (1 - m_schedule_next)
+        v_prime = v._data / (1 - self.beta2 ** t)
+        m_bar = (1 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        w = w - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon)
+        weight._data = w.astype(weight._data.dtype)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments (reference contrib multi_lamb kernels +
+    optimizer/lamb.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        jnp = _jnp()
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = self._preprocess_grad(grad)
+        w = weight._data.astype(jnp.float32)
+        m, v = state
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        v._data = self.beta2 * v._data + (1 - self.beta2) * jnp.square(g)
+        mhat, vhat = m._data, v._data
+        if self.bias_correction:
+            mhat = mhat / (1 - self.beta1 ** t)
+            vhat = vhat / (1 - self.beta2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * w
+        w_norm = jnp.linalg.norm(w)
+        r_norm = jnp.linalg.norm(r)
+        if self.lower_bound is not None:
+            w_norm = jnp.maximum(w_norm, self.lower_bound)
+        if self.upper_bound is not None:
+            w_norm = jnp.minimum(w_norm, self.upper_bound)
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        w = w - lr * ratio * r
+        weight._data = w.astype(weight._data.dtype)
+
+
+@register
+class LANS(LAMB):
+    """LANS (reference contrib multi_lans): LAMB + normalized gradient."""
+
+    def update(self, index, weight, grad, state):
+        jnp = _jnp()
+        g = self._preprocess_grad(grad)
+        gnorm = jnp.linalg.norm(g)
+        grad = NDArray(jnp.where(gnorm > 0, g / gnorm, g))
+        prev, self.rescale_grad = self.rescale_grad, 1.0
+        try:
+            super().update(index, weight, grad, state)
+        finally:
+            self.rescale_grad = prev
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (reference optimizer/lars.py +
+    multi_lars kernels)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight) if self.momentum else None
+
+    def update(self, index, weight, grad, state):
+        jnp = _jnp()
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        w = weight._data.astype(jnp.float32)
+        w_norm = jnp.linalg.norm(w)
+        g_norm = jnp.linalg.norm(g)
+        trust = jnp.where((w_norm > 0) & (g_norm > 0),
+                          self.eta * w_norm / (g_norm + wd * w_norm +
+                                               self.epsilon), 1.0)
+        g = trust * (g + wd * w)
+        if state is not None:
+            state._data = self.momentum * state._data + lr * g
+            w = w - state._data
+        else:
+            w = w - lr * g
+        weight._data = w.astype(weight._data.dtype)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1.0, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))  # z, n
+
+    def update(self, index, weight, grad, state):
+        jnp = _jnp()
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        w = weight._data.astype(jnp.float32)
+        z, n = state
+        sigma = (jnp.sqrt(n._data + jnp.square(g)) - jnp.sqrt(n._data)) / lr
+        z._data = z._data + g - sigma * w
+        n._data = n._data + jnp.square(g)
+        w = jnp.where(
+            jnp.abs(z._data) <= self.lamda1, jnp.zeros_like(w),
+            -(z._data - jnp.sign(z._data) * self.lamda1) /
+            ((self.beta + jnp.sqrt(n._data)) / lr + wd))
+        weight._data = w.astype(weight._data.dtype)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight),
+                _zeros_like(weight))  # d, v, z
+
+    def update(self, index, weight, grad, state):
+        jnp = _jnp()
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = self._preprocess_grad(grad)
+        w = weight._data.astype(jnp.float32)
+        g = g + wd * w
+        d, v, z = state
+        v._data = self.beta2 * v._data + (1 - self.beta2) * jnp.square(g)
+        d_t = (1 - self.beta1 ** t) / lr * (
+            jnp.sqrt(v._data / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma = d_t - self.beta1 * d._data
+        z._data = self.beta1 * z._data + (1 - self.beta1) * g - sigma * w
+        d._data = d_t
+        w = -z._data / d_t
+        weight._data = w.astype(weight._data.dtype)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=0.01, epsilon=1e-7, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        jnp = _jnp()
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        w = weight._data.astype(jnp.float32)
+        g = g + wd * w
+        state._data = state._data + jnp.square(g)
+        w = w - lr * g / (jnp.sqrt(state._data) + self.epsilon)
+        weight._data = w.astype(weight._data.dtype)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, learning_rate=1.0, rho=0.9, epsilon=1e-5, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        jnp = _jnp()
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        w = weight._data.astype(jnp.float32)
+        g = g + wd * w
+        acc_g, acc_delta = state
+        acc_g._data = self.rho * acc_g._data + (1 - self.rho) * jnp.square(g)
+        delta = jnp.sqrt(acc_delta._data + self.epsilon) / \
+            jnp.sqrt(acc_g._data + self.epsilon) * g
+        acc_delta._data = self.rho * acc_delta._data + \
+            (1 - self.rho) * jnp.square(delta)
+        w = w - self.lr * delta
+        weight._data = w.astype(weight._data.dtype)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.rho, self.momentum, self.epsilon = rho, momentum, epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (_zeros_like(weight), _zeros_like(weight),
+                    _zeros_like(weight))
+        return (_zeros_like(weight),)
+
+    def update(self, index, weight, grad, state):
+        jnp = _jnp()
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        w = weight._data.astype(jnp.float32)
+        g = g + wd * w
+        if self.centered:
+            n, gm, delta = state
+            n._data = self.rho * n._data + (1 - self.rho) * jnp.square(g)
+            gm._data = self.rho * gm._data + (1 - self.rho) * g
+            delta._data = self.momentum * delta._data - lr * g / jnp.sqrt(
+                n._data - jnp.square(gm._data) + self.epsilon)
+            w = w + delta._data
+        else:
+            (n,) = state
+            n._data = self.rho * n._data + (1 - self.rho) * jnp.square(g)
+            w = w - lr * g / jnp.sqrt(n._data + self.epsilon)
+        if self.clip_weights:
+            w = jnp.clip(w, -self.clip_weights, self.clip_weights)
+        weight._data = w.astype(weight._data.dtype)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference optimizer/sgld.py)."""
+
+    def update(self, index, weight, grad, state):
+        import jax
+
+        from .. import random as mxrandom
+
+        jnp = _jnp()
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        w = weight._data.astype(jnp.float32)
+        g = g + wd * w
+        noise = jax.random.normal(mxrandom.take_key(), w.shape) * \
+            math.sqrt(lr)
+        w = w - lr / 2 * g + noise
+        weight._data = w.astype(weight._data.dtype)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight) if self.momentum != 0.0 else None
+
+    def update(self, index, weight, grad, state):
+        jnp = _jnp()
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        w = weight._data.astype(jnp.float32)
+        if state is not None:
+            state._data = self.momentum * state._data - \
+                (1 - self.momentum) * (g + wd * w)
+            w = (1 - lr * self.wd_lh) * w + lr * jnp.sign(state._data)
+        else:
+            w = (1 - lr * self.wd_lh) * w - lr * jnp.sign(g + wd * w)
+        weight._data = w.astype(weight._data.dtype)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer/dcasgd.py)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight) if self.momentum != 0.0 else None,
+                NDArray(weight._data.astype(_jnp().float32)))
+
+    def update(self, index, weight, grad, state):
+        jnp = _jnp()
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        w = weight._data.astype(jnp.float32)
+        g = g + wd * w
+        mom, prev_w = state
+        comp = g + self.lamda * g * g * (w - prev_w._data)
+        if mom is not None:
+            mom._data = self.momentum * mom._data - lr * comp
+            w = w + mom._data
+        else:
+            w = w - lr * comp
+        prev_w._data = w
+        weight._data = w.astype(weight._data.dtype)
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD w/ warmup (reference optimizer/lbsgd.py); layer-wise
+    scaling handled as in LARS."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, warmup_strategy=
+                 "linear", warmup_epochs=5, batch_scale=1, updates_per_epoch=
+                 32, begin_epoch=0, num_epochs=60, **kw):
+        super().__init__(learning_rate=learning_rate, momentum=momentum, **kw)
+        self.warmup_updates = warmup_epochs * updates_per_epoch
+
+    def _get_lr(self, index):
+        lr = super()._get_lr(index)
+        if self.num_update < self.warmup_updates:
+            lr = lr * (self.num_update + 1) / self.warmup_updates
+        return lr
+
+
+@register
+class Test(Optimizer):
+    """Reference optimizer.py Test optimizer (for unit tests)."""
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        weight._data = (weight._data.astype(_jnp().float32) -
+                        self.lr * self._preprocess_grad(grad)).astype(
+                            weight._data.dtype)
+
+
+class Updater:
+    """Wraps an optimizer for kvstore server-side updates (reference
+    optimizer/updater.py)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+
+        return pickle.dumps({k: _state_np(v) for k, v in
+                             self.states.items()})
+
+    def set_states(self, states):
+        import pickle
+
+        self.states = {k: _state_nd(v)
+                       for k, v in pickle.loads(states).items()}
+
+
+def _state_np(state):
+    if state is None:
+        return None
+    if isinstance(state, NDArray):
+        return state.asnumpy()
+    if isinstance(state, tuple):
+        return tuple(_state_np(s) for s in state)
+    return state
+
+
+def _state_nd(state):
+    import jax.numpy as jnp
+
+    if state is None:
+        return None
+    if isinstance(state, _np.ndarray):
+        return NDArray(jnp.asarray(state))
+    if isinstance(state, tuple):
+        return tuple(_state_nd(s) for s in state)
+    return state
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,250 @@
+"""Parallelism over the TPU device mesh.
+
+This package provides what the reference NEVER had (SURVEY §2.3): tensor /
+sequence / expert parallelism and sharded training as first-class features,
+plus the data-parallel capability the reference implemented with kvstore +
+ps-lite/NCCL (src/kvstore/) — all expressed as jax.sharding Meshes and XLA
+collectives over ICI:
+
+- ``make_mesh``: name→size device mesh ('dp','tp','sp','pp','ep'...).
+- ``FusedTrainer``: fwd+bwd+grad-psum+optimizer as ONE pjit-compiled XLA
+  program over the mesh; parameters sharded by their Parameter.sharding
+  hints (TP/FSDP), batch sharded over dp×sp.  This is the TPU equivalent of
+  the entire dist-kvstore training stack (kvstore_dist.h push/pull overlap,
+  server-side optimizer, CommDevice tree reduce) AND of CachedOp bulking.
+- ``ring_attention`` / ``ulysses_attention``: context parallelism for long
+  sequences (SURVEY §5.7 — absent in the reference).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .optim import make_optimizer
+from .ring import ring_attention, ulysses_attention
+
+__all__ = ["make_mesh", "FusedTrainer", "make_train_step", "ring_attention",
+           "ulysses_attention", "P", "Mesh", "NamedSharding",
+           "shard_params", "param_pspec"]
+
+
+def make_mesh(axes=None, devices=None):
+    """Build a named device mesh.
+
+    axes: dict name->size; a single axis size may be -1 (filled with the
+    remaining devices).  Default: {'dp': n_devices}.
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if axes is None:
+        axes = {"dp": n}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = 1
+        for s in sizes:
+            if s != -1:
+                known *= s
+        sizes[sizes.index(-1)] = n // known
+    total = 1
+    for s in sizes:
+        total *= s
+    if total > n:
+        raise MXNetError("mesh %s needs %d devices, have %d"
+                         % (dict(zip(names, sizes)), total, n))
+    dev_array = _np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def param_pspec(param, mesh):
+    """PartitionSpec from a Parameter.sharding hint, dropping axes the mesh
+    does not have (so the same model runs on any mesh shape)."""
+    hint = getattr(param, "sharding", None)
+    if hint is None:
+        return P()
+    spec = []
+    for ax in hint:
+        if ax is not None and ax in mesh.axis_names and \
+                mesh.shape[ax] > 1:
+            spec.append(ax)
+        else:
+            spec.append(None)
+    return P(*spec)
+
+
+def shard_params(block, mesh):
+    """Device-put every initialized parameter according to its hint."""
+    out = {}
+    for name, param in block.collect_params().items():
+        spec = param_pspec(param, mesh)
+        sharding = NamedSharding(mesh, spec)
+        if param._data is not None:
+            param._data._data = jax.device_put(param._data._data, sharding)
+        out[name] = spec
+    return out
+
+
+class FusedTrainer:
+    """One-XLA-program training over a mesh.
+
+    Usage::
+
+        net = model_zoo.vision.resnet50_v1()
+        net.initialize()
+        trainer = parallel.FusedTrainer(
+            net, loss="softmax_ce", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            mesh=parallel.make_mesh({"dp": 8}))
+        loss = trainer.step(x, y)          # jax or NDArray batches
+
+    The step runs forward, backward, cross-dp gradient reduction (implicit:
+    XLA inserts psum from the shardings) and the optimizer update inside a
+    single compiled program with donated buffers (the reference's
+    static_alloc + inplace memory planning, done by XLA).
+    """
+
+    def __init__(self, block, loss=None, optimizer="sgd",
+                 optimizer_params=None, mesh=None, loss_fn=None,
+                 batch_axes=("dp",), dtype=None, grad_accum=1):
+        self._block = block
+        self._mesh = mesh
+        self._batch_axes = tuple(a for a in batch_axes
+                                 if mesh is not None and
+                                 a in mesh.axis_names)
+        optimizer_params = dict(optimizer_params or {})
+        self._lr = optimizer_params.pop("learning_rate", 0.01)
+        self._opt_init, self._opt_update = make_optimizer(
+            optimizer, learning_rate=self._lr, **optimizer_params)
+        self._loss_fn = loss_fn or _make_loss(loss)
+        self._apply = None
+        self._params = None
+        self._opt_state = None
+        self._step_fn = None
+        self._step_count = 0
+        self._param_specs = None
+
+    # -- param plumbing -----------------------------------------------------
+    def _setup(self, *example_inputs):
+        block = self._block
+        # resolve deferred shapes with an eager probe
+        from .. import autograd
+
+        if any(p._data is None for p in block.collect_params().values()):
+            with autograd.pause():
+                block(*[NDArray(x) for x in example_inputs])
+        apply_fn, params = block.export_pure(training=True)
+        self._apply = apply_fn
+        named = block.collect_params()
+        self._trainable = {n for n, p in named.items()
+                           if p.grad_req != "null"}
+        if self._mesh is not None:
+            self._param_specs = {n: param_pspec(p, self._mesh)
+                                 for n, p in named.items()}
+            params = {
+                n: jax.device_put(v, NamedSharding(self._mesh,
+                                                   self._param_specs[n]))
+                for n, v in params.items()}
+        self._params = params
+        self._opt_state = self._opt_init(
+            {n: v for n, v in params.items() if n in self._trainable})
+        self._build_step()
+
+    def _build_step(self):
+        apply_fn = self._apply
+        loss_fn = self._loss_fn
+        trainable = self._trainable
+        opt_update = self._opt_update
+        lr = self._lr
+
+        def step(params, opt_state, step_i, rng, x, y):
+            train_p = {n: v for n, v in params.items() if n in trainable}
+            frozen = {n: v for n, v in params.items() if n not in trainable}
+
+            def loss_of(tp):
+                full = dict(frozen)
+                full.update(tp)
+                outs, new_states = apply_fn(full, rng, x)
+                loss = loss_fn(outs[0], y)
+                return jnp.mean(loss), new_states
+
+            (loss, new_states), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_p)
+            new_train, new_opt = opt_update(step_i, train_p, grads,
+                                            opt_state, lr)
+            new_params = dict(frozen)
+            new_params.update(new_train)
+            new_params.update(new_states)  # running stats etc.
+            return new_params, new_opt, loss
+
+        if self._mesh is not None:
+            batch_spec = P(self._batch_axes if self._batch_axes else None)
+            param_sh = {n: NamedSharding(self._mesh, self._param_specs[n])
+                        for n in self._params}
+            with self._mesh:
+                self._step_fn = jax.jit(
+                    step,
+                    in_shardings=(param_sh, None, None, None,
+                                  NamedSharding(self._mesh, batch_spec),
+                                  NamedSharding(self._mesh, batch_spec)),
+                    donate_argnums=(0, 1))
+        else:
+            self._step_fn = jax.jit(step, donate_argnums=(0, 1))
+
+    # -- public -------------------------------------------------------------
+    def step(self, x, y):
+        from .. import random as mxrandom
+
+        x = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        y = y._data if isinstance(y, NDArray) else jnp.asarray(y)
+        if self._step_fn is None:
+            self._setup(x)
+        rng = mxrandom.take_key()
+        self._params, self._opt_state, loss = self._step_fn(
+            self._params, self._opt_state, jnp.uint32(self._step_count),
+            rng, x, y)
+        self._step_count += 1
+        return NDArray(loss)
+
+    def sync_block(self):
+        """Write the trained params back into the Gluon block (gathering
+        mesh-sharded values onto one device for eager use)."""
+        named = self._block.collect_params()
+        for n, v in self._params.items():
+            if n in named and named[n]._data is not None:
+                if self._mesh is not None:
+                    v = jnp.asarray(_np.asarray(v))
+                named[n]._data._data = v
+
+    @property
+    def params(self):
+        return self._params
+
+
+def _make_loss(loss):
+    from ..gluon import loss as gloss
+
+    if loss in (None, "softmax_ce", "softmax_cross_entropy"):
+        def fn(pred, label):
+            logp = jax.nn.log_softmax(pred, axis=-1)
+            lbl = label.astype(jnp.int32)
+            return -jnp.take_along_axis(logp, lbl[..., None],
+                                        axis=-1)[..., 0]
+
+        return fn
+    if loss == "l2":
+        return lambda pred, label: 0.5 * jnp.square(pred - label)
+    if callable(loss):
+        return loss
+    raise MXNetError("unknown fused loss %r" % loss)
+
+
+def make_train_step(block, loss="softmax_ce", optimizer="sgd",
+                    optimizer_params=None, mesh=None, **kwargs):
+    return FusedTrainer(block, loss=loss, optimizer=optimizer,
+                        optimizer_params=optimizer_params, mesh=mesh,
+                        **kwargs)

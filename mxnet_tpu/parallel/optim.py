@@ -1,0 +1,116 @@
+"""Pure-functional optimizer kernels for the fused/pjit training path.
+
+These mirror mxnet_tpu.optimizer rules as (init, update) pure functions
+over parameter pytrees so the WHOLE training step — forward, backward,
+cross-replica gradient psum, and every parameter update — compiles into a
+single XLA program (strictly stronger than the reference's multi-tensor
+fused optimizer kernels, src/operator/contrib/multi_*.cu).
+
+Weights may be bf16: optimizer state and the update run in f32 master
+precision, with a bf16 cast on the way out (multi-precision mode,
+reference optimizer/sgd.py:96-106).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+
+__all__ = ["make_optimizer"]
+
+
+def _f32(x):
+    return x.astype(jnp.float32)
+
+
+def make_optimizer(name, learning_rate=0.01, wd=0.0, momentum=0.9,
+                   beta1=0.9, beta2=0.999, epsilon=1e-8,
+                   clip_gradient=None, **kwargs):
+    """Return (init_fn(params)->state, update_fn(step, params, grads, state,
+    lr)->(new_params, new_state)).  params/grads: dict name->jax.Array."""
+    name = name.lower()
+
+    def preprocess(g):
+        g = _f32(g)
+        if clip_gradient is not None:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        return g
+
+    if name in ("sgd", "nag"):
+        def init(params):
+            if momentum == 0.0:
+                return {}
+            return {k: jnp.zeros_like(_f32(v)) for k, v in params.items()}
+
+        def update(step, params, grads, state, lr):
+            new_p, new_s = {}, {}
+            for k, p in params.items():
+                g = preprocess(grads[k]) + wd * _f32(p)
+                if momentum != 0.0:
+                    m = state[k] * momentum - lr * g
+                    new_s[k] = m
+                    if name == "nag":
+                        upd = momentum * m - lr * g
+                    else:
+                        upd = m
+                    new_p[k] = (_f32(p) + upd).astype(p.dtype)
+                else:
+                    new_p[k] = (_f32(p) - lr * g).astype(p.dtype)
+            return new_p, new_s
+
+        return init, update
+
+    if name in ("adam", "adamw"):
+        def init(params):
+            return {k: (jnp.zeros_like(_f32(v)), jnp.zeros_like(_f32(v)))
+                    for k, v in params.items()}
+
+        def update(step, params, grads, state, lr):
+            t = step.astype(jnp.float32) + 1.0
+            c1 = 1.0 - beta1 ** t
+            c2 = 1.0 - beta2 ** t
+            new_p, new_s = {}, {}
+            for k, p in params.items():
+                g = preprocess(grads[k])
+                if name == "adam":
+                    g = g + wd * _f32(p)
+                m, v = state[k]
+                m = beta1 * m + (1 - beta1) * g
+                v = beta2 * v + (1 - beta2) * jnp.square(g)
+                upd = (m / c1) / (jnp.sqrt(v / c2) + epsilon)
+                if name == "adamw":
+                    upd = upd + wd * _f32(p)
+                new_p[k] = (_f32(p) - lr * upd).astype(p.dtype)
+                new_s[k] = (m, v)
+            return new_p, new_s
+
+        return init, update
+
+    if name == "lamb":
+        def init(params):
+            return {k: (jnp.zeros_like(_f32(v)), jnp.zeros_like(_f32(v)))
+                    for k, v in params.items()}
+
+        def update(step, params, grads, state, lr):
+            t = step.astype(jnp.float32) + 1.0
+            c1 = 1.0 - beta1 ** t
+            c2 = 1.0 - beta2 ** t
+            new_p, new_s = {}, {}
+            for k, p in params.items():
+                g = preprocess(grads[k])
+                m, v = state[k]
+                m = beta1 * m + (1 - beta1) * g
+                v = beta2 * v + (1 - beta2) * jnp.square(g)
+                r = (m / c1) / (jnp.sqrt(v / c2) + epsilon) + wd * _f32(p)
+                wn = jnp.linalg.norm(_f32(p))
+                rn = jnp.linalg.norm(r)
+                ratio = jnp.where((wn > 0) & (rn > 0), wn / rn, 1.0)
+                new_p[k] = (_f32(p) - lr * ratio * r).astype(p.dtype)
+                new_s[k] = (m, v)
+            return new_p, new_s
+
+        return init, update
+
+    raise MXNetError("fused optimizer %r not available (use sgd/nag/adam/"
+                     "adamw/lamb, or the imperative Trainer)" % name)

@@ -1,0 +1,153 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has NO long-context support (SURVEY §5.7) — its closest
+artifacts are the fused attention GEMMs (src/operator/contrib/
+transformer.cc:650-826) bounded by single-GPU memory.  Here sequences are
+sharded over a mesh axis ('sp'):
+
+- ``ring_attention``: each device holds a Q/K/V shard; K/V blocks rotate
+  around the ICI ring via ``ppermute`` while each hop's partial attention
+  is accumulated with a numerically-stable online softmax (flash-attention
+  style).  Compute overlaps communication — the classic ring schedule.
+- ``ulysses_attention``: all-to-all reshard (seq→heads) so each device runs
+  full-sequence attention for a head subset — lower comm volume for
+  head-rich models.
+
+Both are pure jax functions usable inside shard_map/pjit; the single-device
+block kernel can be swapped for the Pallas flash kernel
+(mxnet_tpu.ops.pallas_attention).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["ring_attention", "ulysses_attention", "local_attention_block"]
+
+
+def local_attention_block(q, k, v, bias=None, scale=None):
+    """Single-shard attention block returning (out_unnorm, lse-style stats)
+    for online-softmax accumulation.  q:(B,H,Tq,D) k,v:(B,H,Tk,D)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+    return o, m[..., 0], l[..., 0]
+
+
+def _ring_attn_sharded(q, k, v, axis_name, causal, scale):
+    """Per-shard body (runs under shard_map).  q,k,v: local (B,H,T_loc,D)."""
+    axis_size = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    B, H, T, D = q.shape
+    scale_ = scale if scale is not None else 1.0 / (D ** 0.5)
+
+    def block_bias(kv_rank):
+        if not causal:
+            return None
+        # global positions of this device's queries vs the visiting block's
+        q_pos = rank * T + jnp.arange(T)
+        k_pos = kv_rank * T + jnp.arange(T)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        return jnp.where(mask, 0.0, -1e30)[None, None]
+
+    def step(carry, i):
+        o_acc, m_acc, l_acc, k_cur, v_cur = carry
+        kv_rank = (rank - i) % axis_size
+        bias = block_bias(kv_rank)
+        o_blk, m_blk, l_blk = local_attention_block(q, k_cur, v_cur,
+                                                    bias=bias, scale=scale_)
+        # online softmax merge
+        m_new = jnp.maximum(m_acc, m_blk)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        o_acc = o_acc * alpha[..., None] + o_blk * beta[..., None]
+        l_acc = l_acc * alpha + l_blk * beta
+        # rotate K/V to the next device on the ICI ring (overlaps with the
+        # next block's compute under XLA's async collectives)
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o_acc, m_new, l_acc, k_nxt, v_nxt), None
+
+    # derive carries from q so they inherit the device-varying type the
+    # scan body produces (shard_map vma rules)
+    zero_q = (q * 0).astype(jnp.float32)
+    o0 = zero_q
+    m0 = zero_q[..., 0] - jnp.inf
+    l0 = zero_q[..., 0]
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v),
+                                  jnp.arange(axis_size))
+    out = o / jnp.maximum(l[..., None], 1e-37)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
+                   scale=None):
+    """Context-parallel attention.  q,k,v: (B, H, T, D) with T sharded over
+    ``axis_name`` when called under pjit/shard_map; standalone call shards
+    internally over ``mesh``."""
+    body = functools.partial(_ring_attn_sharded, axis_name=axis_name,
+                             causal=causal, scale=scale)
+    if mesh is None:
+        # assume we're already inside a shard_map context
+        return body(q, k, v)
+    spec = P(None, None, axis_name, None)
+    return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)
+
+
+def _ulysses_sharded(q, k, v, axis_name, causal, scale):
+    """all-to-all: (B,H,T_loc,D) seq-sharded -> head-sharded full-seq."""
+    axis_size = lax.psum(1, axis_name)
+    B, H, T, D = q.shape
+    h_loc = H // axis_size
+
+    def to_heads(x):
+        # (B, H, T_loc, D) -> (B, H/A, T_loc*A, D): split the head axis
+        # across devices, gather the sequence axis (one tiled all-to-all)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def to_seq(x):
+        # inverse reshard: (B, H/A, T_glob, D) -> (B, H, T_loc, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    Tg = qh.shape[2]
+    bias = None
+    if causal:
+        mask = jnp.tril(jnp.ones((Tg, Tg), bool))
+        bias = jnp.where(mask, 0.0, -1e30)[None, None]
+    o, m, l = local_attention_block(qh, kh, vh, bias=bias, scale=scale)
+    o = (o / jnp.maximum(l[..., None], 1e-37)).astype(q.dtype)
+    return to_seq(o)
+
+
+def ulysses_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
+                      scale=None):
+    """DeepSpeed-Ulysses-style sequence parallelism: one all-to-all turns a
+    sequence shard into a head shard, full attention runs locally, a second
+    all-to-all restores sequence sharding."""
+    body = functools.partial(_ulysses_sharded, axis_name=axis_name,
+                             causal=causal, scale=scale)
+    if mesh is None:
+        return body(q, k, v)
+    spec = P(None, None, axis_name, None)
+    return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)

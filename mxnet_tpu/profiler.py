@@ -1,0 +1,213 @@
+"""Profiler (reference src/profiler/profiler.h + python/mxnet/profiler.py —
+Chrome-tracing JSON dumps, ProfileDomain/Task/Frame/Event/Counter/Marker,
+engine-hooked op profiling).
+
+TPU-native: backed by the XLA/PJRT profiler (jax.profiler): traces capture
+device kernels, HLO ops, and host activity into an xplane that exports to
+TensorBoard and Perfetto/Chrome-trace — superseding the ring-buffer
+ProfileStat machinery.  The mx.profiler python surface (set_config /
+set_state / dump / Task / Frame / Marker...) is preserved.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from .base import MXNetError, get_env
+
+__all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
+           "resume", "Domain", "Task", "Frame", "Event", "Counter", "Marker",
+           "profiler_set_config", "profiler_set_state"]
+
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": True,
+    "profile_api": True,
+    "aggregate_stats": False,
+}
+_state = {"running": False, "trace_dir": None, "events": []}
+
+
+def set_config(**kwargs):
+    """Reference profiler.py:34 set_config."""
+    _config.update(kwargs)
+
+
+profiler_set_config = set_config
+
+
+def set_state(state_name="stop", profile_process="worker"):
+    """Reference profiler.py:92 set_state ('run'/'stop')."""
+    import jax
+
+    if state_name == "run" and not _state["running"]:
+        trace_dir = os.path.splitext(_config["filename"])[0] + "_xplane"
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        _state["running"] = True
+        _state["trace_dir"] = trace_dir
+    elif state_name == "stop" and _state["running"]:
+        jax.profiler.stop_trace()
+        _state["running"] = False
+    elif state_name not in ("run", "stop"):
+        raise MXNetError("state must be 'run' or 'stop'")
+
+
+profiler_set_state = set_state
+
+
+def state():
+    return "run" if _state["running"] else "stop"
+
+
+def pause(profile_process="worker"):
+    if _state["running"]:
+        set_state("stop")
+
+
+def resume(profile_process="worker"):
+    set_state("run")
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write the chrome-trace JSON (reference profiler.py:125).  Custom
+    domain/task events are written directly; device activity lives in the
+    xplane directory next to it (TensorBoard-loadable)."""
+    if _state["running"] and finished:
+        set_state("stop")
+    trace = {"traceEvents": [
+        {"name": ev["name"], "cat": ev.get("cat", "user"),
+         "ph": ev.get("ph", "X"), "ts": ev["ts"] * 1e6,
+         "dur": ev.get("dur", 0) * 1e6, "pid": 0, "tid": ev.get("tid", 0),
+         "args": ev.get("args", {})}
+        for ev in _state["events"]]}
+    with open(_config["filename"], "w") as f:
+        json.dump(trace, f)
+    return _config["filename"]
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Aggregate stats string (reference profiler.py:154 + aggregate_
+    stats.cc)."""
+    by_name = {}
+    for ev in _state["events"]:
+        agg = by_name.setdefault(ev["name"], [0, 0.0])
+        agg[0] += 1
+        agg[1] += ev.get("dur", 0)
+    lines = ["%-40s %8s %12s" % ("Name", "Calls", "Total(ms)")]
+    for name, (calls, total) in sorted(by_name.items(),
+                                       key=lambda kv: -kv[1][1]):
+        lines.append("%-40s %8d %12.3f" % (name, calls, total * 1e3))
+    if reset:
+        _state["events"].clear()
+    return "\n".join(lines)
+
+
+class Domain:
+    """Reference profiler.py Domain."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_event(self, name):
+        return Event(name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class _Span:
+    _kind = "span"
+
+    def __init__(self, domain, name):
+        self.name = name if isinstance(domain, Domain) else domain
+        self._domain = domain.name if isinstance(domain, Domain) else "user"
+        self._start = None
+        self._jax_ctx = None
+
+    def start(self):
+        import jax
+
+        self._start = time.perf_counter()
+        self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+        self._jax_ctx.__enter__()
+        return self
+
+    def stop(self):
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(None, None, None)
+            self._jax_ctx = None
+        if self._start is not None:
+            _state["events"].append({
+                "name": self.name, "cat": self._kind, "ts": self._start,
+                "dur": time.perf_counter() - self._start})
+            self._start = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+class Task(_Span):
+    _kind = "task"
+
+
+class Frame(_Span):
+    _kind = "frame"
+
+
+class Event(_Span):
+    _kind = "event"
+
+    def __init__(self, name):
+        super().__init__("user", name)
+
+
+class Counter:
+    def __init__(self, domain, name, value=None):
+        self.name = name
+        self.value = value or 0
+
+    def set_value(self, value):
+        self.value = value
+        _state["events"].append({"name": self.name, "cat": "counter",
+                                 "ph": "C", "ts": time.perf_counter(),
+                                 "args": {"value": value}})
+
+    def increment(self, delta=1):
+        self.set_value(self.value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self.value - delta)
+
+    def __iadd__(self, delta):
+        self.increment(delta)
+        return self
+
+    def __isub__(self, delta):
+        self.decrement(delta)
+        return self
+
+
+class Marker:
+    def __init__(self, domain, name):
+        self.name = name
+
+    def mark(self, scope="process"):
+        _state["events"].append({"name": self.name, "cat": "marker",
+                                 "ph": "i", "ts": time.perf_counter()})

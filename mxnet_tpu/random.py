@@ -1,0 +1,158 @@
+"""Random state management.
+
+Reference: per-device ``RandGenerator`` (include/mxnet/random_generator.h —
+Philox on GPU, per-thread mt19937 on CPU) seeded via ``mx.random.seed``.
+
+TPU-native redesign: XLA's *stateless* threefry PRNG.  A module-level key is
+split on every imperative draw (same user-facing contract: global seed,
+reproducible streams).  Inside a hybridized trace, draws fold a step counter
+into a traced base key, so the compiled computation takes one fresh key per
+call — randomness stays inside the fused XLA program instead of a host RNG.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import _as_np_dtype
+
+__all__ = ["seed", "take_key", "uniform", "normal", "randn", "randint",
+           "gamma", "exponential", "poisson", "multinomial", "bernoulli",
+           "shuffle", "trace_rng"]
+
+_state = {"key": jax.random.PRNGKey(0)}
+_trace_stack = []
+
+
+class _TraceRNG:
+    __slots__ = ("base_key", "counter")
+
+    def __init__(self, base_key):
+        self.base_key = base_key
+        self.counter = 0
+
+
+class trace_rng:
+    """Context: route key draws through a traced base key (hybridize path)."""
+
+    def __init__(self, base_key):
+        self._rng = _TraceRNG(base_key)
+
+    def __enter__(self):
+        _trace_stack.append(self._rng)
+        return self._rng
+
+    def __exit__(self, *a):
+        _trace_stack.pop()
+
+
+def seed(seed_state, ctx="all"):
+    """Set the global seed (reference python/mxnet/random.py)."""
+    _state["key"] = jax.random.PRNGKey(int(seed_state))
+
+
+def take_key():
+    if _trace_stack:
+        rng = _trace_stack[-1]
+        rng.counter += 1
+        return jax.random.fold_in(rng.base_key, rng.counter)
+    _state["key"], sub = jax.random.split(_state["key"])
+    return sub
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def _wrap(data, ctx=None, out=None):
+    from .ndarray.ndarray import NDArray
+
+    if out is not None:
+        out._data = data
+        return out
+    return NDArray(data, ctx=ctx)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None,
+            out=None, **kw):
+    dt = _as_np_dtype(dtype)
+    data = jax.random.uniform(take_key(), _shape(shape), dtype=dt,
+                              minval=low, maxval=high)
+    return _wrap(data, ctx, out)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None,
+           out=None, **kw):
+    dt = _as_np_dtype(dtype)
+    data = jax.random.normal(take_key(), _shape(shape), dtype=dt) * scale + loc
+    return _wrap(data, ctx, out)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None):
+    return normal(loc, scale, shape, dtype=dtype, ctx=ctx)
+
+
+def randint(low, high=None, shape=(1,), dtype="int32", ctx=None, out=None):
+    if high is None:
+        low, high = 0, low
+    data = jax.random.randint(take_key(), _shape(shape), low, high,
+                              dtype=_as_np_dtype(dtype))
+    return _wrap(data, ctx, out)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None,
+          out=None):
+    from .ndarray.ndarray import NDArray
+
+    a = alpha._data if isinstance(alpha, NDArray) else alpha
+    b = beta._data if isinstance(beta, NDArray) else beta
+    data = jax.random.gamma(take_key(), a, _shape(shape),
+                            dtype=_as_np_dtype(dtype)) * b
+    return _wrap(data, ctx, out)
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    data = jax.random.exponential(take_key(), _shape(shape),
+                                  dtype=_as_np_dtype(dtype)) * scale
+    return _wrap(data, ctx, out)
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    data = jax.random.poisson(take_key(), lam, _shape(shape)).astype(
+        _as_np_dtype(dtype))
+    return _wrap(data, ctx, out)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", **kw):
+    """Sample category indices from (batched) probability rows."""
+    from .ndarray.ndarray import NDArray
+
+    p = data._data if isinstance(data, NDArray) else data
+    n = 1 if shape is None else shape
+    logits = jnp.log(jnp.maximum(p, 1e-37))
+    if p.ndim == 1:
+        out_shape = _shape(n) if shape is not None else ()
+        idx = jax.random.categorical(take_key(), logits, shape=out_shape)
+    else:
+        out_shape = (p.shape[0],) + (_shape(n) if shape is not None else ())
+        idx = jax.random.categorical(take_key(), logits[:, None, :] if shape
+                                     is not None else logits, axis=-1,
+                                     shape=out_shape)
+    return _wrap(idx.astype(_as_np_dtype(dtype)))
+
+
+def bernoulli(prob=0.5, shape=None, dtype="float32", ctx=None, out=None):
+    data = jax.random.bernoulli(take_key(), prob, _shape(shape)).astype(
+        _as_np_dtype(dtype))
+    return _wrap(data, ctx, out)
+
+
+def shuffle(data, **kw):
+    from .ndarray.ndarray import NDArray
+
+    x = data._data if isinstance(data, NDArray) else data
+    return _wrap(jax.random.permutation(take_key(), x, axis=0))

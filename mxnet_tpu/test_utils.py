@@ -1,0 +1,168 @@
+"""Test utilities (reference python/mxnet/test_utils.py, 2,602 LoC —
+assert_almost_equal w/ per-dtype tolerances, check_numeric_gradient,
+check_consistency, random generators, default_context)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import autograd
+from . import ndarray as nd
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from .ndarray.ndarray import NDArray
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "same", "rand_ndarray", "rand_shape_2d",
+           "rand_shape_3d", "rand_shape_nd", "check_numeric_gradient",
+           "check_consistency", "numeric_grad", "effective_dtype",
+           "default_rtols", "default_atols"]
+
+_default_ctx = [None]
+
+
+def default_context():
+    return _default_ctx[0] or current_context()
+
+
+def set_default_context(ctx):
+    _default_ctx[0] = ctx
+
+
+default_rtols = {_np.dtype(_np.float16): 1e-2, _np.dtype(_np.float32): 1e-4,
+                 _np.dtype(_np.float64): 1e-6}
+default_atols = {_np.dtype(_np.float16): 1e-3, _np.dtype(_np.float32): 1e-5,
+                 _np.dtype(_np.float64): 1e-8}
+
+
+def effective_dtype(arr):
+    dt = arr.dtype if hasattr(arr, "dtype") else _np.float32
+    if str(dt) == "bfloat16":
+        return _np.dtype(_np.float16)
+    return _np.dtype(dt) if _np.dtype(dt) in default_rtols else \
+        _np.dtype(_np.float32)
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def same(a, b):
+    return _np.array_equal(_as_np(a), _as_np(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    a, b = _as_np(a), _as_np(b)
+    rtol = rtol or default_rtols[effective_dtype(a)]
+    atol = atol or default_atols[effective_dtype(a)]
+    return _np.allclose(a.astype(_np.float64), b.astype(_np.float64),
+                        rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    a_np, b_np = _as_np(a), _as_np(b)
+    rtol = rtol if rtol is not None else default_rtols[effective_dtype(a_np)]
+    atol = atol if atol is not None else default_atols[effective_dtype(a_np)]
+    if not _np.allclose(a_np.astype(_np.float64), b_np.astype(_np.float64),
+                        rtol=rtol, atol=atol, equal_nan=equal_nan):
+        err = _np.abs(a_np.astype(_np.float64) - b_np.astype(_np.float64))
+        rel = err / (_np.abs(b_np.astype(_np.float64)) + atol)
+        raise AssertionError(
+            "%s and %s differ: max abs err %g, max rel err %g "
+            "(rtol=%g atol=%g)" % (names[0], names[1], err.max(),
+                                   rel.max(), rtol, atol))
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (_np.random.randint(1, dim0 + 1), _np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return rand_shape_2d(dim0, dim1) + (_np.random.randint(1, dim2 + 1),)
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(_np.random.randint(1, dim + 1, size=num_dim).tolist())
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype="float32",
+                 ctx=None, scale=1.0):
+    data = _np.random.uniform(-scale, scale, size=shape).astype(dtype)
+    if stype == "default":
+        return nd.array(data, ctx=ctx)
+    from .ndarray import sparse
+
+    if stype == "row_sparse":
+        return sparse.row_sparse_array(data, shape=shape)
+    if stype == "csr":
+        return sparse.csr_matrix(data, shape=shape)
+    raise MXNetError("unknown stype %s" % stype)
+
+
+def numeric_grad(f, inputs, eps=1e-4):
+    """Central finite differences of scalar f over list of np arrays."""
+    grads = []
+    for i, x in enumerate(inputs):
+        g = _np.zeros_like(x, dtype=_np.float64)
+        flat = x.reshape(-1)
+        gflat = g.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            fp = float(f(*inputs))
+            flat[j] = orig - eps
+            fm = float(f(*inputs))
+            flat[j] = orig
+            gflat[j] = (fp - fm) / (2 * eps)
+        grads.append(g)
+    return grads
+
+
+def check_numeric_gradient(fn, inputs, rtol=1e-2, atol=1e-3, eps=1e-3):
+    """Compare autograd gradients against finite differences
+    (reference test_utils.py check_numeric_gradient)."""
+    nd_inputs = [nd.array(x.astype(_np.float64).astype(_np.float32))
+                 for x in inputs]
+    for x in nd_inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*nd_inputs)
+        loss = out.sum() if out.size > 1 else out
+    loss.backward()
+    analytic = [x.grad.asnumpy().astype(_np.float64) for x in nd_inputs]
+
+    np_inputs = [x.astype(_np.float64) for x in inputs]
+
+    def np_f(*xs):
+        outs = fn(*[nd.array(x.astype(_np.float32)) for x in xs])
+        return outs.sum().asscalar() if outs.size > 1 else outs.asscalar()
+
+    numeric = numeric_grad(np_f, np_inputs, eps=eps)
+    for a, n in zip(analytic, numeric):
+        assert_almost_equal(a, n, rtol=rtol, atol=atol,
+                            names=("autograd", "numeric"))
+
+
+def check_consistency(fn, inputs, ctx_list=None, dtypes=("float32",),
+                      rtol=None, atol=None):
+    """Run fn across contexts/dtypes and compare (the reference's CPU↔GPU
+    oracle, here CPU↔TPU)."""
+    ctx_list = ctx_list or [cpu()]
+    ref = None
+    for ctx in ctx_list:
+        for dtype in dtypes:
+            args = [nd.array(x, ctx=ctx).astype(dtype) for x in inputs]
+            out = fn(*args)
+            out_np = out.asnumpy().astype(_np.float64)
+            if ref is None:
+                ref = out_np
+            else:
+                tol_dt = _np.dtype(_np.float16) if dtype in ("float16",
+                                                             "bfloat16") \
+                    else _np.dtype(dtype)
+                assert_almost_equal(out_np, ref,
+                                    rtol=rtol or default_rtols[tol_dt],
+                                    atol=atol or default_atols[tol_dt])
+    return ref

@@ -1,0 +1,102 @@
+"""Utility flags & decorators (reference python/mxnet/util.py).
+
+np-shape / np-array semantics switches: in the reference these flip C++
+global state (MXSetIsNumpyShape).  Here numpy semantics are the native
+default (JAX is numpy-shaped); the flags are kept for API compatibility and
+to let `mx.np` vs `mx.nd` front-ends advertise themselves.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+_state = threading.local()
+
+
+def _get(flag, default=True):
+    return getattr(_state, flag, default)
+
+
+def set_np_shape(active=True):
+    prev = _get("np_shape")
+    _state.np_shape = active
+    return prev
+
+
+def is_np_shape():
+    return _get("np_shape")
+
+
+def set_np_array(active=True):
+    prev = _get("np_array")
+    _state.np_array = active
+    return prev
+
+
+def is_np_array():
+    return _get("np_array")
+
+
+def set_np(shape=True, array=True, dtype=False):
+    set_np_shape(shape)
+    set_np_array(array)
+
+
+def reset_np():
+    set_np(True, True)
+
+
+def use_np(func):
+    """Decorator form (reference util.py use_np); numpy semantics are always
+    on, so this is an identity wrapper that also accepts classes."""
+    return func
+
+
+def use_np_shape(func):
+    return func
+
+
+def use_np_array(func):
+    return func
+
+
+def np_shape(active=True):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        prev = set_np_shape(active)
+        try:
+            yield
+        finally:
+            set_np_shape(prev)
+
+    return _cm()
+
+
+def np_array(active=True):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        prev = set_np_array(active)
+        try:
+            yield
+        finally:
+            set_np_array(prev)
+
+    return _cm()
+
+
+def wrap_ctx_to_device_func(func):
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        if "device" in kwargs and "ctx" not in kwargs:
+            kwargs["ctx"] = kwargs.pop("device")
+        return func(*args, **kwargs)
+
+    return wrapper
+
+
+def get_cuda_compute_capability(ctx):
+    return None

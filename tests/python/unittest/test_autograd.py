@@ -1,0 +1,123 @@
+"""Autograd tests (reference tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain_and_branches():
+    x = nd.array(np.random.rand(3, 4).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        a = x * 2
+        b = nd.sin(x)
+        y = (a + b).sum()
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 + np.cos(x.asnumpy()),
+                        rtol=1e-4)
+
+
+def test_head_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0]))
+    assert x.grad.asnumpy()[0] == 30.0
+
+
+def test_grad_add_accumulate():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    assert x.grad.asnumpy()[0] == 6.0
+
+
+def test_detach_stops_grad():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).detach() * x
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), np.array([9.0], np.float32))
+
+
+def test_stop_gradient_op():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.stop_gradient(x * x) + x
+    y.backward()
+    assert x.grad.asnumpy()[0] == 1.0
+
+
+def test_grad_function():
+    x = nd.array([1.0, 2.0])
+    g = autograd.grad(lambda: None, [x]) if False else None
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+    grads = autograd.grad(y, [x])
+    assert_almost_equal(grads[0].asnumpy(), 3 * x.asnumpy() ** 2)
+
+
+def test_training_modes():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training()
+        assert autograd.is_recording()
+        with autograd.pause():
+            assert not autograd.is_recording()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+
+
+def test_custom_function():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 2 * x * dy
+
+    x = nd.array([3.0, 4.0])
+    x.attach_grad()
+    sq = Square()
+    with autograd.record():
+        y = sq(x).sum()
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_numeric_gradients():
+    check_numeric_gradient(lambda x: nd.tanh(x),
+                           [np.random.rand(3, 3) - 0.5])
+    check_numeric_gradient(lambda x: nd.softmax(x, axis=-1).sum(),
+                           [np.random.rand(2, 5)])
+    check_numeric_gradient(lambda a, b: nd.dot(a, b),
+                           [np.random.rand(3, 4), np.random.rand(4, 2)])
+
+
+def test_mark_variables():
+    x = nd.array([1.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = 5 * x
+    y.backward()
+    assert x.grad.asnumpy()[0] == 5.0

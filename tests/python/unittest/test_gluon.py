@@ -1,0 +1,218 @@
+"""Gluon tests (reference tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_dense_shapes_and_deferred_init():
+    layer = nn.Dense(5)
+    layer.initialize()
+    x = nd.ones((2, 7))
+    out = layer(x)
+    assert out.shape == (2, 5)
+    assert layer.weight.shape == (5, 7)
+    # explicit in_units path
+    layer2 = nn.Dense(4, in_units=3)
+    layer2.initialize()
+    assert layer2(nd.ones((2, 3))).shape == (2, 4)
+
+
+def test_dense_flatten():
+    layer = nn.Dense(5, flatten=False)
+    layer.initialize()
+    out = layer(nd.ones((2, 3, 7)))
+    assert out.shape == (2, 3, 5)
+
+
+def test_sequential_and_children():
+    net = nn.Sequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    assert len(net) == 2
+    net.initialize()
+    assert net(nd.ones((1, 3))).shape == (1, 2)
+    names = list(net.collect_params().keys())
+    assert any("weight" in n for n in names)
+
+
+def test_conv_pool_stack():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2, 2),
+            nn.BatchNorm(),
+            nn.Conv2D(4, 1),
+            nn.GlobalAvgPool2D(),
+            nn.Flatten(),
+            nn.Dense(3))
+    net.initialize()
+    out = net(nd.ones((2, 3, 8, 8)))
+    assert out.shape == (2, 3)
+
+
+def test_batchnorm_running_stats_update():
+    bn = nn.BatchNorm(in_channels=3, momentum=0.5)
+    bn.initialize()
+    x = nd.array(np.random.rand(4, 3, 2, 2).astype(np.float32) + 5)
+    with autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert (rm > 0).all()  # moved toward batch mean ~5.5
+    # eval mode: uses running stats, doesn't update
+    before = bn.running_mean.data().asnumpy().copy()
+    bn(x)
+    assert_almost_equal(bn.running_mean.data().asnumpy(), before)
+
+
+def test_hybridize_parity():
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.BatchNorm(), nn.Dense(4))
+    net.initialize()
+    x = nd.array(np.random.rand(5, 8).astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-4, atol=1e-5)
+    # second call uses the cache
+    hybrid2 = net(x).asnumpy()
+    assert_almost_equal(hybrid, hybrid2)
+
+
+def test_hybridize_batchnorm_state_writeback():
+    net = nn.HybridSequential()
+    net.add(nn.BatchNorm(in_channels=2, momentum=0.5))
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.rand(4, 2, 3, 3).astype(np.float32) + 3)
+    with autograd.record():
+        net(x)
+    rm = net[0].running_mean.data().asnumpy()
+    assert (rm != 0).any()
+
+
+def test_hybrid_grad_matches_eager():
+    np.random.seed(1)
+    x_np = np.random.rand(4, 6).astype(np.float32)
+    y_np = np.random.randint(0, 3, 4).astype(np.float32)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def build():
+        mx.random.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="tanh"), nn.Dense(3))
+        net.initialize()
+        net(nd.array(x_np))
+        return net
+
+    grads = []
+    for hybrid in (False, True):
+        net = build()
+        if hybrid:
+            net.hybridize()
+        x, y = nd.array(x_np), nd.array(y_np)
+        with autograd.record():
+            L = loss_fn(net(x), y).mean()
+        L.backward()
+        grads.append({k: p.grad().asnumpy()
+                      for k, p in net.collect_params().items()})
+    for k in grads[0]:
+        assert_almost_equal(grads[0][k], grads[1][k], rtol=1e-3, atol=1e-5,
+                            names=("eager:" + k, "hybrid:" + k))
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    x = nd.ones((1, 3))
+    ref = net(x).asnumpy()
+    fname = str(tmp_path / "net.params")
+    net.save_parameters(fname)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4), nn.Dense(2))
+    net2.load_parameters(fname)
+    assert_almost_equal(net2(x).asnumpy(), ref)
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 6)
+    emb.initialize()
+    out = emb(nd.array([[1, 2], [3, 4]], dtype="int32"))
+    assert out.shape == (2, 2, 6)
+
+
+def test_dropout_train_vs_eval():
+    drop = nn.Dropout(0.5)
+    x = nd.ones((100, 100))
+    out_eval = drop(x)
+    assert_almost_equal(out_eval.asnumpy(), x.asnumpy())
+    with autograd.record():
+        out_train = drop(x)
+    frac_zero = (out_train.asnumpy() == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+
+
+def test_trainer_updates_params():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    w0 = net.weight.data().asnumpy().copy()
+    with autograd.record():
+        L = net(nd.ones((1, 2))).sum()
+    L.backward()
+    trainer.step(1)
+    w1 = net.weight.data().asnumpy()
+    assert_almost_equal(w1, w0 - 0.5, rtol=1e-5)
+
+
+def test_trainer_states_roundtrip(tmp_path):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.1})
+    with autograd.record():
+        L = net(nd.ones((1, 2))).sum()
+    L.backward()
+    trainer.step(1)
+    f = str(tmp_path / "t.states")
+    trainer.save_states(f)
+    trainer.load_states(f)
+
+
+def test_shared_parameters():
+    a = nn.Dense(3, in_units=3)
+    b = nn.Dense(3, in_units=3)
+    a.initialize()
+    b.initialize()
+    b.share_parameters(a.collect_params())
+    assert b.collect_params()["weight"] is a.collect_params()["weight"]
+
+
+def test_cast():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    net.cast("bfloat16")
+    assert str(net.weight.dtype) == "bfloat16"
+    out = net(nd.ones((1, 2)).astype("bfloat16"))
+    assert str(out.dtype) == "bfloat16"
+
+
+def test_clip_global_norm():
+    arrays = [nd.array([3.0, 4.0]), nd.array([0.0])]
+    total = gluon.utils.clip_global_norm(arrays, 1.0)
+    assert abs(total - 5.0) < 1e-4
+    assert_almost_equal(arrays[0].asnumpy(),
+                        np.array([0.6, 0.8], np.float32), rtol=1e-3)
+
+
+def test_block_repr_and_summary():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=2))
+    net.initialize()
+    assert "Dense" in repr(net)
+    assert "Total params" in net.summary()

@@ -1,0 +1,157 @@
+"""Data pipeline tests (reference test_gluon_data.py + test_io.py +
+test_recordio.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import data as gdata
+from mxnet_tpu.gluon.data.vision import MNIST, transforms
+from mxnet_tpu.io import DataBatch, NDArrayIter, ResizeIter
+from mxnet_tpu import recordio
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_array_dataset_and_loader():
+    X = np.random.rand(10, 3).astype(np.float32)
+    Y = np.arange(10).astype(np.float32)
+    ds = gdata.ArrayDataset(X, Y)
+    assert len(ds) == 10
+    x0, y0 = ds[3]
+    assert float(y0) == 3.0
+    loader = gdata.DataLoader(ds, batch_size=4, last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (4, 3)
+    assert batches[2][0].shape == (2, 3)
+
+
+def test_dataloader_shuffle_and_workers():
+    X = np.arange(32).astype(np.float32).reshape(32, 1)
+    ds = gdata.ArrayDataset(X)
+    loader = gdata.DataLoader(ds, batch_size=8, shuffle=True,
+                              num_workers=2)
+    seen = np.concatenate([b.asnumpy().ravel() for b in loader])
+    assert sorted(seen.tolist()) == list(range(32))
+
+
+def test_samplers():
+    assert list(gdata.SequentialSampler(4)) == [0, 1, 2, 3]
+    assert sorted(gdata.RandomSampler(5)) == [0, 1, 2, 3, 4]
+    bs = gdata.BatchSampler(gdata.SequentialSampler(5), 2, "discard")
+    assert list(bs) == [[0, 1], [2, 3]]
+    bs2 = gdata.BatchSampler(gdata.SequentialSampler(5), 2, "keep")
+    assert list(bs2)[-1] == [4]
+
+
+def test_dataset_transform():
+    ds = gdata.SimpleDataset(list(range(5))).transform(lambda x: x * 2)
+    assert ds[2] == 4
+    ds2 = gdata.ArrayDataset(np.ones((4, 2), np.float32),
+                             np.zeros(4, np.float32)).transform_first(
+        lambda x: x + 1)
+    x, y = ds2[0]
+    assert (np.asarray(x) == 2).all()
+
+
+def test_mnist_synthetic():
+    ds = MNIST(root="/tmp/mxtpu_mnist_test", train=True)
+    assert len(ds) > 0
+    img, label = ds[0]
+    assert img.shape == (28, 28, 1)
+    assert 0 <= int(label) < 10
+
+
+def test_transforms():
+    img = nd.array(np.random.randint(0, 255, (8, 6, 3)), dtype="uint8")
+    t = transforms.ToTensor()(img)
+    assert t.shape == (3, 8, 6)
+    assert float(t.max().asscalar()) <= 1.0
+    norm = transforms.Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5])(t)
+    assert norm.shape == (3, 8, 6)
+    r = transforms.Resize(4)(img)
+    assert r.shape == (4, 4, 3)
+    c = transforms.CenterCrop(4)(img)
+    assert c.shape == (4, 4, 3)
+    comp = transforms.Compose([transforms.ToTensor()])
+    assert comp(img).shape == (3, 8, 6)
+
+
+def test_ndarray_iter():
+    X = np.random.rand(10, 2).astype(np.float32)
+    Y = np.arange(10).astype(np.float32)
+    it = NDArrayIter(X, Y, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 4
+    it2 = NDArrayIter({"data": X}, {"label": Y}, batch_size=5)
+    b = next(iter(it2))
+    assert b.data[0].shape == (5, 2)
+    assert it2.provide_data[0].shape == (5, 2)
+
+
+def test_resize_iter():
+    X = np.random.rand(4, 2).astype(np.float32)
+    base = NDArrayIter(X, batch_size=2)
+    resized = ResizeIter(base, 5)
+    assert len(list(resized)) == 5
+
+
+def test_recordio_roundtrip(tmp_path):
+    fname = str(tmp_path / "test.rec")
+    writer = recordio.MXRecordIO(fname, "w")
+    for i in range(5):
+        writer.write(b"record%d" % i)
+    writer.close()
+    reader = recordio.MXRecordIO(fname, "r")
+    for i in range(5):
+        assert reader.read() == b"record%d" % i
+    assert reader.read() is None
+    reader.close()
+
+
+def test_indexed_recordio_and_pack_img(tmp_path):
+    rec = str(tmp_path / "data.rec")
+    idx = str(tmp_path / "data.idx")
+    writer = recordio.MXIndexedRecordIO(idx, rec, "w")
+    img = np.random.randint(0, 255, (4, 4, 3)).astype(np.uint8)
+    for i in range(3):
+        header = recordio.IRHeader(0, float(i), i, 0)
+        writer.write_idx(i, recordio.pack_img(header, img))
+    writer.close()
+    reader = recordio.MXIndexedRecordIO(idx, rec, "r")
+    hdr, img2 = recordio.unpack_img(reader.read_idx(1))
+    assert hdr.label == 1.0
+    assert (img2 == img).all()
+
+
+def test_image_record_dataset(tmp_path):
+    rec = str(tmp_path / "imgs.rec")
+    idx = str(tmp_path / "imgs.idx")
+    writer = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(4):
+        img = np.full((5, 5, 3), i, np.uint8)
+        writer.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 2), i, 0), img))
+    writer.close()
+    from mxnet_tpu.gluon.data.vision.datasets import ImageRecordDataset
+
+    ds = ImageRecordDataset(rec)
+    assert len(ds) == 4
+    img, label = ds[2]
+    assert img.asnumpy()[0, 0, 0] == 2
+    assert label == 0.0
+
+
+def test_batchify():
+    from mxnet_tpu.gluon.data.batchify import Pad, Stack, Group
+
+    out = Stack()([np.ones((2,)), np.zeros((2,))])
+    assert out.shape == (2, 2)
+    padded = Pad(axis=0, val=-1)([np.ones((2,)), np.ones((4,))])
+    assert padded.shape == (2, 4)
+    assert padded.asnumpy()[0, 3] == -1

@@ -1,0 +1,140 @@
+"""Loss + metric tests (reference test_loss.py / test_metric.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import loss as gloss, metric as gmetric
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_l2_l1():
+    pred = nd.array([[1.0, 2.0]])
+    label = nd.array([[2.0, 4.0]])
+    l2 = gloss.L2Loss()(pred, label)
+    assert_almost_equal(l2.asnumpy(), np.array([(1 + 4) / 2 / 2],
+                                               np.float32))
+    l1 = gloss.L1Loss()(pred, label)
+    assert_almost_equal(l1.asnumpy(), np.array([1.5], np.float32))
+
+
+def test_softmax_ce_loss():
+    pred = nd.array([[10.0, 0.0, 0.0], [0.0, 10.0, 0.0]])
+    label = nd.array([0, 1])
+    loss = gloss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert (loss.asnumpy() < 1e-3).all()
+    # dense label
+    dense = nd.one_hot(label.astype("int32"), 3)
+    loss2 = gloss.SoftmaxCrossEntropyLoss(sparse_label=False)(pred, dense)
+    assert_almost_equal(loss.asnumpy(), loss2.asnumpy(), rtol=1e-4)
+
+
+def test_bce_loss():
+    pred = nd.array([[100.0, -100.0]])
+    label = nd.array([[1.0, 0.0]])
+    loss = gloss.SigmoidBCELoss()(pred, label)
+    assert float(loss.asscalar()) < 1e-3
+
+
+def test_kl_huber_hinge():
+    pred = nd.log_softmax(nd.array([[1.0, 2.0, 3.0]]))
+    label = nd.softmax(nd.array([[1.0, 2.0, 3.0]]))
+    kl = gloss.KLDivLoss()(pred, label)
+    assert float(kl.asscalar()) < 1e-5
+    h = gloss.HuberLoss()(nd.array([[0.5]]), nd.array([[0.0]]))
+    assert abs(float(h.asscalar()) - 0.125) < 1e-5
+    hinge = gloss.HingeLoss()(nd.array([[2.0]]), nd.array([[1.0]]))
+    assert float(hinge.asscalar()) == 0.0
+
+
+def test_ctc_loss_block():
+    loss = gloss.CTCLoss(layout="NTC")
+    pred = nd.array(np.random.rand(2, 8, 5).astype(np.float32))
+    label = nd.array([[1, 2, -1, -1], [1, 2, 3, -1]])
+    out = loss(pred, label,
+               label_lengths=nd.array([2, 3], dtype="int32"))
+    assert out.shape == (2,)
+    assert (out.asnumpy() > 0).all()
+
+
+def test_triplet_cosine():
+    a = nd.array(np.random.rand(2, 4).astype(np.float32))
+    p = nd.array(np.random.rand(2, 4).astype(np.float32))
+    n = nd.array(np.random.rand(2, 4).astype(np.float32))
+    t = gloss.TripletLoss()(a, p, n)
+    assert t.shape == (2,)
+    c = gloss.CosineEmbeddingLoss()(a, p, nd.ones((2,)))
+    assert c.shape == (2,)
+
+
+def test_losses_differentiable():
+    pred = nd.array(np.random.rand(3, 4).astype(np.float32))
+    pred.attach_grad()
+    label = nd.array([0, 1, 2])
+    with autograd.record():
+        L = gloss.SoftmaxCrossEntropyLoss()(pred, label).mean()
+    L.backward()
+    assert np.abs(pred.grad.asnumpy()).sum() > 0
+
+
+def test_accuracy_metric():
+    acc = gmetric.Accuracy()
+    pred = nd.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]])
+    label = nd.array([0, 1, 1])
+    acc.update([label], [pred])
+    name, value = acc.get()
+    assert abs(value - 2.0 / 3) < 1e-6
+    acc.reset()
+    assert np.isnan(acc.get()[1])
+
+
+def test_topk_f1_mcc():
+    topk = gmetric.TopKAccuracy(top_k=2)
+    pred = nd.array([[0.3, 0.5, 0.2], [0.6, 0.3, 0.1]])
+    label = nd.array([2, 0])
+    topk.update([label], [pred])
+    assert abs(topk.get()[1] - 0.5) < 1e-6
+    f1 = gmetric.F1()
+    f1.update([nd.array([1, 0, 1])],
+              [nd.array([[0.2, 0.8], [0.9, 0.1], [0.3, 0.7]])])
+    assert f1.get()[1] == 1.0
+    mcc = gmetric.MCC()
+    mcc.update([nd.array([1, 0])], [nd.array([[0.1, 0.9], [0.8, 0.2]])])
+    assert mcc.get()[1] == 1.0
+
+
+def test_mse_rmse_mae_pearson():
+    mse = gmetric.MSE()
+    mse.update([nd.array([1.0, 2.0])], [nd.array([1.0, 4.0])])
+    assert abs(mse.get()[1] - 2.0) < 1e-6
+    rmse = gmetric.RMSE()
+    rmse.update([nd.array([0.0])], [nd.array([3.0])])
+    assert abs(rmse.get()[1] - 3.0) < 1e-6
+    mae = gmetric.MAE()
+    mae.update([nd.array([0.0, 2.0])], [nd.array([1.0, 2.0])])
+    assert abs(mae.get()[1] - 0.5) < 1e-6
+    pr = gmetric.PearsonCorrelation()
+    pr.update([nd.array([1.0, 2.0, 3.0])], [nd.array([2.0, 4.0, 6.0])])
+    assert abs(pr.get()[1] - 1.0) < 1e-5
+
+
+def test_perplexity_and_ce():
+    prob = nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = nd.array([0, 0])
+    ce = gmetric.CrossEntropy()
+    ce.update([label], [prob])
+    expected = -(np.log(0.5) + np.log(0.9)) / 2
+    assert abs(ce.get()[1] - expected) < 1e-5
+    ppl = gmetric.Perplexity()
+    ppl.update([label], [prob])
+    assert abs(ppl.get()[1] - np.exp(expected)) < 1e-4
+
+
+def test_composite_and_create():
+    comp = gmetric.create(["accuracy", "mse"])
+    assert isinstance(comp, gmetric.CompositeEvalMetric)
+    m = gmetric.create("rmse")
+    assert isinstance(m, gmetric.RMSE)
+    custom = gmetric.np(lambda l, p: float((l == p.argmax(-1)).mean()))
+    custom.update([nd.array([0])], [nd.array([[0.9, 0.1]])])
+    assert custom.get()[1] == 1.0

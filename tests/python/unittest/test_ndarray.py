@@ -1,0 +1,154 @@
+"""NDArray tests (reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == np.float32
+    b = nd.ones((2, 2), dtype="int32")
+    assert b.asnumpy().sum() == 4
+    c = nd.full((2, 2), 7.0)
+    assert c.asnumpy().mean() == 7.0
+    d = nd.arange(0, 10, 2)
+    assert d.asnumpy().tolist() == [0, 2, 4, 6, 8]
+    e = nd.array([[1, 2], [3, 4]])
+    assert e.shape == (2, 2)
+    assert nd.eye(3).asnumpy().trace() == 3.0
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[5.0, 6.0], [7.0, 8.0]])
+    assert_almost_equal((a + b).asnumpy(), a.asnumpy() + b.asnumpy())
+    assert_almost_equal((a - b).asnumpy(), a.asnumpy() - b.asnumpy())
+    assert_almost_equal((a * b).asnumpy(), a.asnumpy() * b.asnumpy())
+    assert_almost_equal((a / b).asnumpy(), a.asnumpy() / b.asnumpy())
+    assert_almost_equal((a ** 2).asnumpy(), a.asnumpy() ** 2)
+    assert_almost_equal((2 + a).asnumpy(), 2 + a.asnumpy())
+    assert_almost_equal((2 - a).asnumpy(), 2 - a.asnumpy())
+    assert_almost_equal((-a).asnumpy(), -a.asnumpy())
+    assert ((a > 2).asnumpy() == (a.asnumpy() > 2)).all()
+    assert ((a == a).asnumpy()).all()
+
+
+def test_indexing():
+    a = nd.array(np.arange(24).reshape(2, 3, 4).astype(np.float32))
+    assert_almost_equal(a[0].asnumpy(), a.asnumpy()[0])
+    assert_almost_equal(a[:, 1].asnumpy(), a.asnumpy()[:, 1])
+    assert_almost_equal(a[1, 2, 3].asnumpy(), a.asnumpy()[1, 2, 3])
+    assert_almost_equal(a[:, ::2].asnumpy(), a.asnumpy()[:, ::2])
+    a[0, 0, 0] = 42.0
+    assert a.asnumpy()[0, 0, 0] == 42.0
+    idx = nd.array([1, 0], dtype="int32")
+    assert a.take(idx, axis=0).shape == (2, 3, 4)
+
+
+def test_shape_ops():
+    a = nd.array(np.arange(12).reshape(3, 4).astype(np.float32))
+    assert a.reshape(4, 3).shape == (4, 3)
+    assert a.reshape((-1,)).shape == (12,)
+    assert a.reshape(0, 2, 2).shape == (3, 2, 2)
+    assert a.T.shape == (4, 3)
+    assert a.expand_dims(0).shape == (1, 3, 4)
+    assert a.expand_dims(0).squeeze(0).shape == (3, 4)
+    assert nd.concat(a, a, dim=0).shape == (6, 4)
+    assert nd.stack(a, a, axis=0).shape == (2, 3, 4)
+    outs = nd.split(a, num_outputs=2, axis=1)
+    assert outs[0].shape == (3, 2)
+    assert a.flatten().shape == (3, 4)
+    assert a.tile((2, 1)).shape == (6, 4)
+    assert a.repeat(2, axis=0).shape == (6, 4)
+    assert nd.flip(a, axis=1).asnumpy()[0, 0] == 3
+
+
+def test_reductions():
+    x = np.random.rand(3, 4, 5).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(a.sum().asnumpy(), x.sum())
+    assert_almost_equal(a.mean(axis=1).asnumpy(), x.mean(axis=1))
+    assert_almost_equal(a.max(axis=(0, 2)).asnumpy(), x.max(axis=(0, 2)))
+    assert_almost_equal(a.min().asnumpy(), x.min())
+    assert_almost_equal(nd.norm(a).asnumpy(),
+                        np.sqrt((x ** 2).sum()), rtol=1e-4)
+    assert a.argmax(axis=1).shape == (3, 5)
+
+
+def test_dot():
+    x = np.random.rand(4, 5).astype(np.float32)
+    y = np.random.rand(5, 6).astype(np.float32)
+    assert_almost_equal(nd.dot(nd.array(x), nd.array(y)).asnumpy(),
+                        x @ y, rtol=1e-4, atol=1e-4)
+    bx = np.random.rand(2, 4, 5).astype(np.float32)
+    by = np.random.rand(2, 5, 3).astype(np.float32)
+    assert_almost_equal(
+        nd.batch_dot(nd.array(bx), nd.array(by)).asnumpy(), bx @ by,
+        rtol=1e-4, atol=1e-4)
+
+
+def test_astype_copy():
+    a = nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.copy()
+    c[0] = 99.0
+    assert a.asnumpy()[0] == 1.5
+    d = nd.zeros((2,))
+    a.copyto(d)
+    assert_almost_equal(d.asnumpy(), a.asnumpy())
+
+
+def test_bfloat16():
+    a = nd.ones((4, 4)).astype("bfloat16")
+    assert str(a.dtype) == "bfloat16"
+    b = (a @ a).astype("float32")
+    assert_almost_equal(b.asnumpy(), np.full((4, 4), 4.0), rtol=1e-2)
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrs.npz")
+    d = {"w": nd.array([1.0, 2.0]), "b": nd.ones((2, 2))}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    assert_almost_equal(loaded["w"].asnumpy(), d["w"].asnumpy())
+    nd.save(fname, [nd.array([3.0])])
+    assert nd.load(fname)[0].asnumpy()[0] == 3.0
+
+
+def test_waitall_and_scalar():
+    a = nd.array([3.5])
+    assert a.asscalar() == 3.5
+    assert float(a) == 3.5
+    nd.waitall()
+    a.wait_to_read()
+
+
+def test_sparse_roundtrip():
+    from mxnet_tpu.ndarray import sparse
+
+    dense = np.zeros((6, 3), np.float32)
+    dense[1] = 1.0
+    dense[4] = 2.0
+    rs = sparse.row_sparse_array(dense)
+    assert rs.stype == "row_sparse"
+    assert_almost_equal(rs.tostype("default").asnumpy(), dense)
+    cs = sparse.csr_matrix(dense)
+    assert cs.stype == "csr"
+    assert_almost_equal(cs.tostype("default").asnumpy(), dense)
+
+
+def test_one_hot_pick_topk():
+    idx = nd.array([0, 2], dtype="int32")
+    oh = nd.one_hot(idx, 3)
+    assert oh.asnumpy().tolist() == [[1, 0, 0], [0, 0, 1]]
+    x = nd.array([[0.1, 0.9, 0.5], [0.8, 0.2, 0.3]])
+    p = nd.pick(x, nd.array([1, 0]), axis=1)
+    assert_almost_equal(p.asnumpy(), np.array([0.9, 0.8], np.float32))
+    t = nd.topk(x, k=2, ret_typ="value")
+    assert t.shape == (2, 2)

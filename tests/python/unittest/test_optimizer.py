@@ -1,0 +1,113 @@
+"""Optimizer tests (reference tests/python/unittest/test_optimizer.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, optimizer as opt
+from mxnet_tpu.test_utils import assert_almost_equal
+
+ALL_OPTS = ["sgd", "nag", "adam", "adamw", "adamax", "nadam", "lamb",
+            "lans", "lars", "ftrl", "ftml", "adagrad", "adadelta",
+            "rmsprop", "sgld", "signum", "dcasgd", "lbsgd"]
+
+
+@pytest.mark.parametrize("name", ALL_OPTS)
+def test_optimizer_decreases_quadratic(name):
+    """Each optimizer should reduce f(w)=|w|^2 from a fixed start."""
+    optimizer = opt.create(name, learning_rate=0.05)
+    w = nd.array(np.ones(8, np.float32) * 2.0)
+    state = optimizer.create_state(0, w)
+    for _ in range(30):
+        grad = w * 2.0
+        optimizer.update(0, w, grad, state)
+    final = float((w * w).sum().asscalar())
+    assert final < 8 * 4.0, "%s failed to decrease: %f" % (name, final)
+
+
+def test_sgd_momentum_reference():
+    optimizer = opt.SGD(learning_rate=0.1, momentum=0.9)
+    w = nd.array([1.0])
+    state = optimizer.create_state(0, w)
+    g = nd.array([1.0])
+    optimizer.update(0, w, g, state)
+    assert_almost_equal(w.asnumpy(), np.array([0.9], np.float32))
+    optimizer.update(0, w, g, state)
+    # mom = 0.9*(-0.1) - 0.1 = -0.19; w = 0.9 - 0.19 = 0.71
+    assert_almost_equal(w.asnumpy(), np.array([0.71], np.float32),
+                        rtol=1e-5)
+
+
+def test_adam_step_reference():
+    optimizer = opt.Adam(learning_rate=0.1)
+    w = nd.array([1.0])
+    state = optimizer.create_state(0, w)
+    optimizer.update(0, w, nd.array([1.0]), state)
+    # bias-corrected first step ≈ lr * g/|g|
+    assert_almost_equal(w.asnumpy(), np.array([0.9], np.float32),
+                        rtol=1e-3)
+
+
+def test_wd_and_clip():
+    optimizer = opt.SGD(learning_rate=0.1, wd=0.1, clip_gradient=0.5)
+    w = nd.array([1.0])
+    optimizer.update(0, w, nd.array([10.0]), None)
+    # clipped grad 0.5 + wd 0.1*1 => 0.6; w = 1 - 0.06
+    assert_almost_equal(w.asnumpy(), np.array([0.94], np.float32))
+
+
+def test_lr_scheduler_factor():
+    sched = opt.FactorScheduler(step=2, factor=0.5, base_lr=1.0)
+    optimizer = opt.SGD(learning_rate=1.0, lr_scheduler=sched)
+    w = nd.array([0.0])
+    for _ in range(10):
+        optimizer.update(0, w, nd.array([0.0]), None)
+    assert optimizer.learning_rate < 1.0
+
+
+def test_cosine_poly_schedulers():
+    cos = opt.CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.1)
+    assert abs(cos(0) - 1.0) < 1e-6
+    assert abs(cos(100) - 0.1) < 1e-6
+    assert 0.1 < cos(50) < 1.0
+    poly = opt.PolyScheduler(max_update=100, base_lr=1.0, pwr=2)
+    assert abs(poly(0) - 1.0) < 1e-6
+    assert poly(100) == 0
+    warm = opt.CosineScheduler(max_update=100, base_lr=1.0,
+                               warmup_steps=10, warmup_begin_lr=0.0)
+    assert warm(5) < 1.0
+
+
+def test_multi_precision():
+    optimizer = opt.SGD(learning_rate=0.1, momentum=0.9,
+                        multi_precision=True)
+    w = nd.ones((4,)).astype("bfloat16")
+    state = optimizer.create_state_multi_precision(0, w)
+    g = nd.ones((4,)).astype("bfloat16")
+    optimizer.update_multi_precision(0, w, g, state)
+    assert str(w.dtype) == "bfloat16"
+    assert_almost_equal(w.astype("float32").asnumpy(),
+                        np.full(4, 0.9, np.float32), rtol=1e-2)
+
+
+def test_lr_wd_mult_via_param():
+    from mxnet_tpu.gluon import Parameter
+
+    p = Parameter("w", shape=(1,))
+    p.initialize()
+    p.lr_mult = 0.0
+    optimizer = opt.SGD(learning_rate=1.0, param_dict={0: p})
+    w = p.data()
+    before = w.asnumpy().copy()
+    optimizer.update(0, w, nd.array([1.0]), None)
+    assert_almost_equal(w.asnumpy(), before)
+
+
+def test_updater_states_pickle():
+    optimizer = opt.Adam()
+    updater = opt.get_updater(optimizer)
+    w = nd.ones((3,))
+    updater(0, nd.ones((3,)), w)
+    blob = updater.get_states()
+    updater2 = opt.get_updater(opt.Adam())
+    updater2.set_states(blob)
+    assert 0 in updater2.states
