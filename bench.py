@@ -1,0 +1,58 @@
+"""Headline benchmark: ResNet-50 training throughput, single chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (BASELINE.md): reference MXNet ResNet-50 training fp32 batch 128 on
+1xV100 = 363.69 img/s (docs/static_site/src/pages/api/faq/perf.md:243-252).
+The full step here is forward + backward + SGD-momentum update fused into a
+single XLA program (FusedTrainer) — the TPU-native CachedOp+kvstore path.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_IMGS_PER_SEC = 363.69  # ResNet-50 train fp32 bs128, 1xV100
+BATCH = 128
+WARMUP = 3
+ITERS = 10
+
+
+def main():
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    net = vision.resnet50_v1()
+    net.initialize()
+    trainer = parallel.FusedTrainer(
+        net, loss="softmax_ce", optimizer="sgd",
+        optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
+    rs = np.random.RandomState(0)
+    x = rs.rand(BATCH, 3, 224, 224).astype(np.float32)
+    y = rs.randint(0, 1000, BATCH).astype(np.int32)
+
+    for _ in range(WARMUP):
+        loss = trainer.step(x, y)
+    loss.wait_to_read()
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss = trainer.step(x, y)
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_fp32_bs%d_imgs_per_sec" % BATCH,
+        "value": round(imgs_per_sec, 2),
+        "unit": "img/s",
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""Language models: LSTM LM (BASELINE.md config-5 target) and a GPT-style
+decoder-only transformer LM.
+
+Reference anchors: the fused RNN op (src/operator/rnn.cc:295, cuDNN
+descriptors) is here a lax.scan-lowered LSTM (gluon/rnn/rnn_layer.py) — the
+whole unrolled sequence compiles into one XLA while-loop with fused cell
+math.  The reference's word-LM lived in example/rnn; in-tree here so the
+benchmark is self-contained.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from .. import nn, rnn
+from ..block import HybridBlock
+
+__all__ = ["StandardRNNLM", "TransformerLM", "standard_lstm_lm_200",
+           "standard_lstm_lm_650", "standard_lstm_lm_1500", "gpt_lm"]
+
+
+class StandardRNNLM(HybridBlock):
+    """Embedding -> (L)STM stack -> (tied) softmax decoder."""
+
+    def __init__(self, vocab_size, embed_size=200, hidden_size=200,
+                 num_layers=2, dropout=0.2, tie_weights=False, mode="lstm",
+                 **kwargs):
+        super().__init__()
+        if tie_weights and embed_size != hidden_size:
+            raise MXNetError("tied weights need embed_size == hidden_size")
+        self._tie = tie_weights
+        self._vocab_size = vocab_size
+        self.embedding = nn.Embedding(vocab_size, embed_size)
+        self.embed_dropout = nn.Dropout(dropout) if dropout else None
+        rnn_cls = {"lstm": rnn.LSTM, "gru": rnn.GRU, "rnn": rnn.RNN}[mode]
+        self.encoder = rnn_cls(hidden_size, num_layers=num_layers,
+                               dropout=dropout, layout="NTC")
+        self.out_dropout = nn.Dropout(dropout) if dropout else None
+        if not tie_weights:
+            self.decoder = nn.Dense(vocab_size, flatten=False)
+
+    def forward(self, inputs, states=None):
+        """inputs: (B, T) ids -> (logits (B, T, V), new_states)."""
+        from ... import ndarray as nd
+
+        x = self.embedding(inputs)
+        if self.embed_dropout is not None:
+            x = self.embed_dropout(x)
+        if states is None:
+            out = self.encoder(x)
+            new_states = None
+        else:
+            out, new_states = self.encoder(x, states)
+        if self.out_dropout is not None:
+            out = self.out_dropout(out)
+        if self._tie:
+            emb = self.embedding.weight.data()
+            logits = nd.dot(out.reshape((-1, out.shape[-1])), emb.T) \
+                .reshape(out.shape[:-1] + (self._vocab_size,))
+        else:
+            logits = self.decoder(out)
+        return (logits, new_states) if states is not None else logits
+
+    def begin_state(self, batch_size, **kwargs):
+        return self.encoder.begin_state(batch_size, **kwargs)
+
+
+class TransformerLM(HybridBlock):
+    """Decoder-only (GPT-style) causal LM on TransformerEncoder cells with
+    causal attention; pairs with ring attention for long context."""
+
+    def __init__(self, vocab_size, units=256, hidden_size=1024,
+                 num_layers=4, num_heads=8, max_length=1024, dropout=0.1,
+                 tie_weights=True, **kwargs):
+        super().__init__()
+        self._tie = tie_weights
+        self._vocab_size = vocab_size
+        self.embedding = nn.Embedding(vocab_size, units)
+        self.pos_embed = nn.PositionalEmbedding(max_length, units)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+        self.layers = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.layers.add(nn.TransformerEncoderCell(
+                units, hidden_size, num_heads, dropout=dropout,
+                pre_norm=True, causal=True))
+        self.final_ln = nn.LayerNorm()
+        if not tie_weights:
+            self.decoder = nn.Dense(vocab_size, flatten=False)
+
+    def forward(self, inputs):
+        from ... import ndarray as nd
+
+        x = self.pos_embed(self.embedding(inputs))
+        if self.dropout is not None:
+            x = self.dropout(x)
+        for cell in self.layers:
+            x = cell(x)
+        x = self.final_ln(x)
+        if self._tie:
+            emb = self.embedding.weight.data()
+            return nd.dot(x.reshape((-1, x.shape[-1])), emb.T) \
+                .reshape(x.shape[:-1] + (self._vocab_size,))
+        return self.decoder(x)
+
+
+def standard_lstm_lm_200(vocab_size=33278, **kwargs):
+    return StandardRNNLM(vocab_size, 200, 200, 2, dropout=0.2, **kwargs)
+
+
+def standard_lstm_lm_650(vocab_size=33278, **kwargs):
+    return StandardRNNLM(vocab_size, 650, 650, 2, dropout=0.5, **kwargs)
+
+
+def standard_lstm_lm_1500(vocab_size=33278, **kwargs):
+    return StandardRNNLM(vocab_size, 1500, 1500, 2, dropout=0.65, **kwargs)
+
+
+def gpt_lm(vocab_size=50257, **kwargs):
+    return TransformerLM(vocab_size, **kwargs)

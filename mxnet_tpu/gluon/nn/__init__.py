@@ -1,6 +1,7 @@
 """Gluon neural-net layers (reference python/mxnet/gluon/nn/)."""
 from .basic_layers import *  # noqa: F401,F403
 from .conv_layers import *  # noqa: F401,F403
-from . import basic_layers, conv_layers
+from .transformer import *  # noqa: F401,F403
+from . import basic_layers, conv_layers, transformer
 
-__all__ = basic_layers.__all__ + conv_layers.__all__
+__all__ = basic_layers.__all__ + conv_layers.__all__ + transformer.__all__

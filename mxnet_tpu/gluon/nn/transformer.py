@@ -1,0 +1,204 @@
+"""Transformer layers.
+
+Reference coverage: the reference's transformer support is only the fused
+attention GEMM ops ``_contrib_interleaved_matmul_selfatt_qk/valatt`` and
+encdec variants (src/operator/contrib/transformer.cc:650-826) plus masking
+utilities — users assembled blocks by hand (gluon-nlp did it downstream).
+Here the block layer is first-class and TPU-native:
+
+- the attention core is one fused einsum chain on the MXU
+  (ops/nn.py multi_head_attention), with a Pallas flash-attention kernel
+  for long sequences; for sequence-parallel long-context training use
+  mxnet_tpu.parallel.ring_attention / ulysses_attention directly inside a
+  pjit'd step (SURVEY §5.7);
+- Dense weights carry tensor-parallel sharding hints (Megatron layout:
+  qkv/ffn-in column-parallel over 'tp', out/ffn-out row-parallel) so a
+  pjit'd trainer shards the whole block with zero user code.
+"""
+from __future__ import annotations
+
+import math
+
+from ... import ndarray as nd
+from ...base import MXNetError
+from ..block import HybridBlock
+from .basic_layers import Dense, Dropout, Embedding, HybridSequential, \
+    LayerNorm
+
+__all__ = ["MultiHeadAttention", "PositionwiseFFN",
+           "TransformerEncoderCell", "TransformerEncoder",
+           "PositionalEmbedding", "SinusoidalPositionalEmbedding"]
+
+
+class MultiHeadAttention(HybridBlock):
+    """Multi-head (self/cross) attention with TP-sharded projections.
+
+    forward(query, key=None, value=None, mask=None): key/value default to
+    query (self-attention).  mask broadcasts against (B, H, Tq, Tk).
+    ``dropout`` drops attention *probabilities* (the BERT recipe), active
+    only in training mode; it forces the dense attention path.
+    """
+
+    def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
+                 causal=False, attention_impl="auto", **kwargs):
+        super().__init__()
+        if units % num_heads:
+            raise MXNetError("units %d not divisible by num_heads %d"
+                             % (units, num_heads))
+        self._units = units
+        self._num_heads = num_heads
+        self._causal = causal
+        self._impl = attention_impl
+        self._dropout = dropout
+        # column-parallel in-projections, row-parallel out-projection
+        self.query_proj = Dense(units, use_bias=use_bias, flatten=False)
+        self.key_proj = Dense(units, use_bias=use_bias, flatten=False)
+        self.value_proj = Dense(units, use_bias=use_bias, flatten=False)
+        self.out_proj = Dense(units, use_bias=use_bias, flatten=False)
+        self.out_proj.weight.sharding = (None, "tp")
+        if self.out_proj.bias is not None:
+            self.out_proj.bias.sharding = (None,)
+
+    def forward(self, query, key=None, value=None, mask=None):
+        from ... import autograd, random as mxrandom
+
+        key = query if key is None else key
+        value = key if value is None else value
+        q = self.query_proj(query)
+        k = self.key_proj(key)
+        v = self.value_proj(value)
+        attn_kwargs = {}
+        if self._dropout > 0.0 and autograd.is_training():
+            attn_kwargs = dict(attn_dropout=self._dropout,
+                               dropout_key=mxrandom.take_key(),
+                               impl="dense")
+        else:
+            attn_kwargs = dict(impl=self._impl)
+        out = nd.multi_head_attention(
+            q, k, v, num_heads=self._num_heads, mask=mask,
+            causal=self._causal, **attn_kwargs)
+        return self.out_proj(out)
+
+
+class PositionwiseFFN(HybridBlock):
+    """Transformer FFN: dense -> activation -> dense (+dropout), Megatron
+    TP layout (ffn-in column-parallel, ffn-out row-parallel)."""
+
+    def __init__(self, units, hidden_size, activation="gelu", dropout=0.0,
+                 use_bias=True, **kwargs):
+        super().__init__()
+        self.ffn_1 = Dense(hidden_size, use_bias=use_bias, flatten=False,
+                           activation=activation)
+        self.ffn_2 = Dense(units, use_bias=use_bias, flatten=False)
+        self.ffn_2.weight.sharding = (None, "tp")
+        if self.ffn_2.bias is not None:
+            self.ffn_2.bias.sharding = (None,)
+        self.dropout = Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.ffn_2(self.ffn_1(x))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return out
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Pre/post-LN encoder block: MHA + FFN with residuals."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 attention_dropout=0.0, activation="gelu", pre_norm=False,
+                 layer_norm_eps=1e-12, causal=False, **kwargs):
+        super().__init__()
+        self._pre_norm = pre_norm
+        self.attention = MultiHeadAttention(units, num_heads,
+                                            dropout=attention_dropout,
+                                            causal=causal)
+        self.attn_ln = LayerNorm(epsilon=layer_norm_eps)
+        self.ffn = PositionwiseFFN(units, hidden_size, activation=activation,
+                                   dropout=dropout)
+        self.ffn_ln = LayerNorm(epsilon=layer_norm_eps)
+        self.dropout = Dropout(dropout) if dropout else None
+
+    def forward(self, x, mask=None):
+        if self._pre_norm:
+            h = self.attention(self.attn_ln(x), mask=mask)
+            x = x + (self.dropout(h) if self.dropout is not None else h)
+            h = self.ffn(self.ffn_ln(x))
+            return x + h
+        h = self.attention(x, mask=mask)
+        if self.dropout is not None:
+            h = self.dropout(h)
+        x = self.attn_ln(x + h)
+        h = self.ffn(x)
+        return self.ffn_ln(x + h)
+
+
+class TransformerEncoder(HybridBlock):
+    """Stack of encoder cells."""
+
+    def __init__(self, num_layers, units, hidden_size, num_heads,
+                 dropout=0.0, attention_dropout=0.0, activation="gelu",
+                 pre_norm=False, layer_norm_eps=1e-12, causal=False,
+                 **kwargs):
+        super().__init__()
+        self._num_layers = num_layers
+        self.layers = HybridSequential()
+        for _ in range(num_layers):
+            self.layers.add(TransformerEncoderCell(
+                units, hidden_size, num_heads, dropout=dropout,
+                attention_dropout=attention_dropout, activation=activation,
+                pre_norm=pre_norm, layer_norm_eps=layer_norm_eps,
+                causal=causal))
+
+    def forward(self, x, mask=None):
+        for cell in self.layers:
+            x = cell(x, mask=mask)
+        return x
+
+
+class PositionalEmbedding(HybridBlock):
+    """Learned positional embedding (BERT-style)."""
+
+    def __init__(self, max_length, units, **kwargs):
+        super().__init__()
+        self.embed = Embedding(max_length, units)
+        self._max_length = max_length
+
+    def forward(self, x):
+        """x: (B, T, C) token embeddings -> x + pos[:T]."""
+        T = x.shape[1]
+        if T > self._max_length:
+            raise MXNetError(
+                "sequence length %d exceeds max_length %d of the learned "
+                "positional table" % (T, self._max_length))
+        pos = nd.arange(T)
+        return x + self.embed(pos).reshape((1, T, -1))
+
+
+class SinusoidalPositionalEmbedding(HybridBlock):
+    """Fixed sin/cos positional encoding (Vaswani et al.)."""
+
+    def __init__(self, units, **kwargs):
+        super().__init__()
+        self._units = units
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        from ...ops.registry import apply_op
+
+        T, C = x.shape[1], self._units
+
+        def add_pe(data):
+            pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+            dim = jnp.arange(0, C, 2, dtype=jnp.float32)[None, :]
+            angle = pos / jnp.power(10000.0, dim / C)
+            n_cos = C // 2  # odd units: one fewer cos slot than sin
+            pe = jnp.zeros((T, C), data.dtype)
+            pe = pe.at[:, 0::2].set(jnp.sin(angle).astype(data.dtype))
+            pe = pe.at[:, 1::2].set(
+                jnp.cos(angle[:, :n_cos]).astype(data.dtype))
+            return data + pe[None]
+
+        add_pe.__name__ = "sinusoidal_pe"
+        return apply_op(add_pe, x)

@@ -491,12 +491,21 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
 
 @register("multi_head_attention")
 def multi_head_attention(q, k, v, num_heads=1, mask=None, scale=None,
-                         causal=False):
+                         causal=False, impl="auto", attn_dropout=0.0,
+                         dropout_key=None):
     """Batched SDPA: q,k,v (B, T, H*D).  Reference equivalent:
     _contrib_interleaved_matmul_selfatt_qk/valatt (contrib/transformer.cc:
     650-826) which exist only to feed cuBLAS strided GEMMs; on TPU one
     einsum chain fuses and lands on the MXU, and the Pallas flash kernel
-    (mxnet_tpu/ops/pallas_attention.py) takes over for long sequences."""
+    (mxnet_tpu/ops/pallas_attention.py) takes over for long sequences.
+
+    impl: 'auto' | 'dense' | 'flash' (blockwise scan) | 'pallas'.
+    attn_dropout (+ dropout_key) drops attention probabilities — only the
+    dense path materializes them, so flash/pallas reject it explicitly.
+    """
+    from ..base import MXNetError
+    from . import pallas_attention as pa
+
     B, Tq, HD = q.shape
     Tk = k.shape[1]
     D = HD // num_heads
@@ -504,6 +513,28 @@ def multi_head_attention(q, k, v, num_heads=1, mask=None, scale=None,
     kh = k.reshape(B, Tk, num_heads, D).transpose(0, 2, 1, 3)
     vh = v.reshape(B, Tk, num_heads, D).transpose(0, 2, 1, 3)
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    if attn_dropout > 0.0 and dropout_key is None:
+        raise MXNetError("attn_dropout > 0 requires dropout_key (draw one "
+                         "with mxnet_tpu.random.take_key())")
+    has_dropout = attn_dropout > 0.0
+    if impl == "auto":
+        impl = ("pallas" if pa.use_flash(Tq, Tk, D, mask is not None)
+                and not has_dropout else "dense")
+    if impl in ("pallas", "flash"):
+        if mask is not None:
+            raise MXNetError(
+                "impl=%r does not support an arbitrary mask (only causal=); "
+                "use impl='dense' or drop the mask" % impl)
+        if has_dropout:
+            raise MXNetError(
+                "impl=%r does not support attention-probability dropout; "
+                "use impl='dense' or attn_dropout=0" % impl)
+        if impl == "pallas":
+            out = pa.flash_attention(qh, kh, vh, causal, scale)
+        else:
+            out = pa.blockwise_attention(qh, kh, vh, causal=causal,
+                                         sm_scale=scale)
+        return out.transpose(0, 2, 1, 3).reshape(B, Tq, HD)
     scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
                         preferred_element_type=jnp.float32) * scale
     if causal:
@@ -512,5 +543,9 @@ def multi_head_attention(q, k, v, num_heads=1, mask=None, scale=None,
     if mask is not None:
         scores = jnp.where(mask.astype(bool), scores, -1e30)
     w = jax.nn.softmax(scores, axis=-1).astype(qh.dtype)
+    if has_dropout:
+        keep = 1.0 - attn_dropout
+        dmask = jax.random.bernoulli(dropout_key, keep, w.shape)
+        w = w * dmask.astype(w.dtype) / keep
     out = jnp.einsum("bhqk,bhkd->bhqd", w, vh)
     return out.transpose(0, 2, 1, 3).reshape(B, Tq, HD)

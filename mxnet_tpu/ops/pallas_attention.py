@@ -1,0 +1,209 @@
+"""Flash attention: Pallas TPU kernel + blockwise-JAX fallback.
+
+Reference parity: the reference's fastest attention path is
+``_contrib_interleaved_matmul_selfatt_qk/valatt`` (src/operator/contrib/
+transformer.cc:650-826) — cuBLAS strided-batch GEMMs that still materialize
+the (Tq, Tk) score matrix in HBM.  The TPU-native design never materializes
+it: the Pallas kernel streams K/V blocks through VMEM with an online-softmax
+running (m, l, acc) state, so memory is O(T·D) and the MXU sees back-to-back
+(block_q × D) @ (D × block_k) matmuls.
+
+Three tiers:
+- ``flash_attention``     — Pallas kernel (TPU; ``interpret=True`` elsewhere
+                            so the same kernel is testable on CPU).
+- ``blockwise_attention`` — pure-JAX lax.scan online softmax; differentiable;
+                            the custom-vjp backward recomputes through this.
+- dense                   — plain einsum chain (ops/nn.py), best for short T.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# blockwise (pure JAX) — the reference semantics + the backward path
+# ---------------------------------------------------------------------------
+def blockwise_attention(q, k, v, causal=False, sm_scale=None, block_k=256):
+    """Memory-efficient attention via lax.scan over K/V blocks.
+
+    q, k, v: (B, H, T, D).  Differentiable; O(T·D + T·block_k) live memory.
+    """
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    scale = sm_scale if sm_scale is not None else 1.0 / (D ** 0.5)
+    block_k = min(block_k, Tk)
+    nk = -(-Tk // block_k)
+    pad = nk * block_k - Tk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = k.reshape(B, H, nk, block_k, D).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(B, H, nk, block_k, D).transpose(2, 0, 1, 3, 4)
+    qs = q.astype(jnp.float32) * scale
+    q_idx = jnp.arange(Tq)
+
+    def body(carry, inp):
+        m, l, acc = carry
+        kblk, vblk, j = inp
+        s = jnp.einsum("bhqd,bhkd->bhqk", qs, kblk.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+        k_idx = j * block_k + jnp.arange(block_k)
+        valid = k_idx < Tk
+        if causal:
+            valid = valid[None, :] & (k_idx[None, :] <= q_idx[:, None])
+            s = jnp.where(valid[None, None], s, _NEG_INF)
+        else:
+            s = jnp.where(valid[None, None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vblk.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        return (m_new, l, acc), None
+
+    m0 = jnp.full((B, H, Tq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Tq), jnp.float32)
+    a0 = jnp.zeros((B, H, Tq, D), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(
+        body, (m0, l0, a0), (kb, vb, jnp.arange(nk)))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
+                  block_k, seq_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # (block_q, D)
+    D = q.shape[-1]
+    nk = pl.cdiv(seq_k, block_k)
+    if causal:
+        # skip fully-masked K blocks right of the diagonal
+        nk = jnp.minimum(nk, pl.cdiv((qi + 1) * block_q, block_k))
+
+    def body(j, carry):
+        m, l, acc = carry
+        kblk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (block_q, block_k)
+        k_idx = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_idx < seq_k
+        if causal:
+            q_idx = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = valid & (k_idx <= q_idx)
+        s = jnp.where(valid, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(-1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    a0 = jnp.zeros((block_q, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, a0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    scale = sm_scale if sm_scale is not None else 1.0 / (D ** 0.5)
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    nq = -(-Tq // block_q)
+    nk = -(-Tk // block_k)
+    pad_q = nq * block_q - Tq
+    pad_k = nk * block_k - Tk
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        # k/v must be padded to a block multiple: pl.ds clamps its start at
+        # the array edge, which would misalign rows against the k_idx mask
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    Tk_pad = Tk + pad_k
+    qf = q.reshape(B * H, nq * block_q, D)
+    kf = k.reshape(B * H, Tk_pad, D)
+    vf = v.reshape(B * H, Tk_pad, D)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_k=Tk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Tk_pad, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Tk_pad, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, nq * block_q, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    out = out.reshape(B, H, nq * block_q, D)
+    return out[:, :, :Tq] if pad_q else out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=512,
+                    block_k=512, interpret=None):
+    """Flash attention, (B, H, T, D) layout.
+
+    Forward runs the Pallas kernel (interpret mode off-TPU); backward
+    recomputes through ``blockwise_attention`` so residual memory stays
+    O(T·D) — the flash-attention trade (extra FLOPs for HBM locality) that
+    the MXU absorbs.
+    """
+    interpret = _default_interpret() if interpret is None else interpret
+    return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    interpret = _default_interpret() if interpret is None else interpret
+    out = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                         interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, causal=causal, sm_scale=sm_scale, block_k=block_k),
+        q, k, v)
+    return vjp(do)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _default_interpret():
+    return jax.default_backend() != "tpu"
+
+
+def use_flash(seq_q, seq_k, head_dim, has_mask):
+    """Dispatch heuristic for impl='auto': flash pays off once the score
+    matrix no longer fits the fusion footprint; dense einsum wins short-T."""
+    if has_mask:
+        return False
+    return seq_q * seq_k >= 256 * 256 and head_dim <= 256

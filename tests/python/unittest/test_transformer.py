@@ -1,0 +1,222 @@
+"""Transformer layers, flash attention, BERT, and LM tests (CPU mesh)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import model_zoo, nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+import jax
+import jax.numpy as jnp
+
+
+def _rand(*shape):
+    return np.random.RandomState(hash(shape) % (2**31)).rand(*shape) \
+        .astype(np.float32)
+
+
+# ---- attention impl consistency -------------------------------------------
+@pytest.mark.parametrize("causal", [False, True])
+def test_blockwise_matches_dense(causal):
+    from mxnet_tpu.ops import pallas_attention as pa
+
+    B, H, T, D = 2, 3, 64, 16
+    q, k, v = (jnp.asarray(_rand(B, H, T, D)) for _ in range(3))
+    out = pa.blockwise_attention(q, k, v, causal=causal, block_k=16)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+    assert_almost_equal(np.asarray(out), np.asarray(ref), rtol=1e-4,
+                        atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_flash_matches_dense(causal):
+    """interpret=True runs the identical kernel logic on CPU."""
+    from mxnet_tpu.ops import pallas_attention as pa
+
+    B, H, T, D = 1, 2, 128, 8
+    q, k, v = (jnp.asarray(_rand(B, H, T, D)) for _ in range(3))
+    out = pa.flash_attention(q, k, v, causal, None, 32, 32, True)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+    assert_almost_equal(np.asarray(out), np.asarray(ref), rtol=1e-4,
+                        atol=1e-5)
+
+
+def test_flash_attention_grad():
+    from mxnet_tpu.ops import pallas_attention as pa
+
+    B, H, T, D = 1, 1, 32, 8
+    q, k, v = (jnp.asarray(_rand(B, H, T, D)) for _ in range(3))
+
+    def loss_flash(q_, k_, v_):
+        return pa.flash_attention(q_, k_, v_, True, None, 16, 16,
+                                  True).sum()
+
+    def loss_dense(q_, k_, v_):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_, k_) / np.sqrt(D)
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
+        return jnp.einsum("bhqk,bhkd->bhqd",
+                          jax.nn.softmax(s, -1), v_).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        assert_almost_equal(np.asarray(a), np.asarray(b), rtol=1e-3,
+                            atol=1e-4)
+
+
+def test_mha_op_impl_dispatch():
+    B, T, H, D = 2, 32, 4, 8
+    q = nd.array(_rand(B, T, H * D))
+    dense = nd.multi_head_attention(q, q, q, num_heads=H, impl="dense")
+    flash = nd.multi_head_attention(q, q, q, num_heads=H, impl="flash")
+    assert_almost_equal(dense.asnumpy(), flash.asnumpy(), rtol=1e-4,
+                        atol=1e-5)
+
+
+# ---- layers ----------------------------------------------------------------
+def test_multi_head_attention_layer():
+    layer = nn.MultiHeadAttention(32, 4)
+    layer.initialize()
+    x = nd.array(_rand(2, 10, 32))
+    out = layer(x)
+    assert out.shape == (2, 10, 32)
+    # cross attention
+    mem = nd.array(_rand(2, 7, 32))
+    out = layer(x, mem, mem)
+    assert out.shape == (2, 10, 32)
+    # TP hints: out_proj row-parallel
+    assert layer.out_proj.weight.sharding == (None, "tp")
+    assert layer.query_proj.weight.sharding == ("tp", None)
+
+
+def test_transformer_encoder_shapes_and_grad():
+    enc = nn.TransformerEncoder(2, 16, 64, 4, dropout=0.1)
+    enc.initialize()
+    x = nd.array(_rand(2, 12, 16))
+    x.attach_grad()
+    with autograd.record():
+        out = enc(x)
+        loss = (out * out).sum()
+    loss.backward()
+    assert out.shape == (2, 12, 16)
+    assert np.isfinite(x.grad.asnumpy()).all()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+
+
+def test_transformer_hybridize_consistent():
+    enc = nn.TransformerEncoder(1, 8, 32, 2, dropout=0.0)
+    enc.initialize()
+    x = nd.array(_rand(2, 6, 8))
+    eager = enc(x).asnumpy()
+    enc.hybridize()
+    hybrid = enc(x).asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-5, atol=1e-6)
+
+
+def test_sinusoidal_positional_embedding():
+    pe = nn.SinusoidalPositionalEmbedding(16)
+    x = nd.zeros((1, 5, 16))
+    out = pe(x).asnumpy()
+    assert_almost_equal(out[0, 0, 0::2], np.sin(np.zeros(8)), atol=1e-6)
+    assert np.abs(out[0, 1:]).max() > 0
+
+
+# ---- BERT ------------------------------------------------------------------
+def test_bert_model_forward():
+    net = model_zoo.BERTModel(vocab_size=100, units=32, hidden_size=64,
+                              num_layers=2, num_heads=4, max_length=16)
+    net.initialize()
+    B, T = 2, 12
+    ids = nd.array(np.random.RandomState(0).randint(0, 100, (B, T)))
+    tt = nd.zeros((B, T))
+    vlen = nd.array(np.array([12, 7], np.float32))
+    seq, pooled = net(ids, tt, vlen)
+    assert seq.shape == (B, T, 32)
+    assert pooled.shape == (B, 32)
+
+
+def test_bert_pretraining_step_decreases_loss():
+    from mxnet_tpu.gluon.model_zoo.bert import pretraining_loss
+
+    rs = np.random.RandomState(1)
+    net = model_zoo.BERTForPretraining(
+        vocab_size=50, units=16, hidden_size=32, num_layers=1, num_heads=2,
+        max_length=16, dropout=0.0)
+    net.initialize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 1e-2})
+    B, T, M = 4, 8, 2
+    ids = nd.array(rs.randint(0, 50, (B, T)))
+    pos = nd.array(np.tile(np.array([1, 3]), (B, 1)).astype(np.int32))
+    labels = nd.array(rs.randint(0, 50, (B, M)))
+    weights = nd.ones((B, M))
+    nsp = nd.array(rs.randint(0, 2, (B,)))
+
+    losses = []
+    for _ in range(8):
+        with autograd.record():
+            mlm, nsp_s = net(ids, None, None, pos)
+            L = pretraining_loss(mlm, nsp_s, labels, weights, nsp)
+        L.backward()
+        trainer.step(1)
+        losses.append(float(L.asscalar()))
+    assert losses[-1] < losses[0]
+
+
+# ---- language models -------------------------------------------------------
+def test_lstm_lm_forward_and_state():
+    net = model_zoo.StandardRNNLM(vocab_size=40, embed_size=16,
+                                  hidden_size=16, num_layers=2, dropout=0.0)
+    net.initialize()
+    ids = nd.array(np.random.RandomState(2).randint(0, 40, (3, 7)))
+    logits = net(ids)
+    assert logits.shape == (3, 7, 40)
+    states = net.begin_state(3)
+    logits, new_states = net(ids, states)
+    assert logits.shape == (3, 7, 40)
+    assert new_states[0].shape == states[0].shape
+
+
+def test_lstm_lm_trains():
+    rs = np.random.RandomState(3)
+    net = model_zoo.standard_lstm_lm_200(vocab_size=30)
+    net.initialize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 1e-2})
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    x = nd.array(rs.randint(0, 30, (4, 6)))
+    y = nd.array(rs.randint(0, 30, (4, 6)))
+    losses = []
+    for _ in range(5):
+        with autograd.record():
+            logits = net(x)
+            L = loss_fn(logits.reshape((-1, 30)),
+                        y.reshape((-1,))).mean()
+        L.backward()
+        trainer.step(1)
+        losses.append(float(L.asscalar()))
+    assert losses[-1] < losses[0]
+
+
+def test_gpt_lm_causal():
+    """Future tokens must not affect past logits (causality check)."""
+    net = model_zoo.TransformerLM(vocab_size=20, units=16, hidden_size=32,
+                                  num_layers=1, num_heads=2, max_length=16,
+                                  dropout=0.0)
+    net.initialize()
+    rs = np.random.RandomState(4)
+    ids = rs.randint(0, 20, (1, 8))
+    logits1 = net(nd.array(ids)).asnumpy()
+    ids2 = ids.copy()
+    ids2[0, -1] = (ids2[0, -1] + 1) % 20
+    logits2 = net(nd.array(ids2)).asnumpy()
+    assert_almost_equal(logits1[0, :-1], logits2[0, :-1], rtol=1e-4,
+                        atol=1e-5)
+    assert np.abs(logits1[0, -1] - logits2[0, -1]).max() > 1e-6
