@@ -1,0 +1,49 @@
+"""Force jax onto an n-device virtual CPU platform.
+
+SURVEY §4 "fake-backend note": multi-chip tests/dryruns execute on
+``xla_force_host_platform_device_count`` virtual CPU devices.  The axon PJRT
+plugin (TPU tunnel) registers itself via sitecustomize in every interpreter
+and may eagerly initialize the TPU backend before we run, so env vars alone
+are not enough — if jax is already loaded we must also flip its config and
+drop the live backend so the next resolution lands on the virtual CPU
+platform.
+
+Shared by ``conftest.py`` (pytest) and ``__graft_entry__.py`` (driver
+dryrun) so the version-sensitive backend-reset dance lives in ONE place.
+"""
+import os
+import sys
+
+
+def force_virtual_cpu(n):
+    """Make ``jax.devices()`` return ``n`` virtual CPU devices."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=%d" % n).strip()
+    sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+
+    if "jax" not in sys.modules:
+        # jax not imported yet: the env vars above are read at first client
+        # creation, nothing else to do.
+        return
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        import jax.extend.backend as _jeb
+
+        _jeb.clear_backends()
+    except Exception:  # pragma: no cover - older jax fallback
+        from jax._src import xla_bridge as _xb
+
+        _xb.backends.cache_clear()
+    try:
+        # must come AFTER clear_backends: the knob refuses to change while a
+        # backend is live.  (XLA_FLAGS is parsed once per process at first
+        # client creation, so re-setting it here would be too late — the
+        # config knob is the only reliable in-process path.)
+        jax.config.update("jax_num_cpu_devices", n)
+    except Exception:  # pragma: no cover - knob absent on older jax
+        pass

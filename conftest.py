@@ -2,38 +2,9 @@
 "fake-backend note": multi-chip tests run on
 xla_force_host_platform_device_count virtual devices).
 
-The axon PJRT plugin (TPU tunnel) registers itself via sitecustomize in every
-interpreter and eagerly initializes the TPU backend BEFORE this conftest runs,
-so setting env vars alone is not enough — we must also flip the already-loaded
-jax config and drop the initialized backends so the next resolution lands on
-the 8-device virtual CPU platform.
+The backend-reset logic lives in _virtual_devices.force_virtual_cpu, shared
+with __graft_entry__.dryrun_multichip.
 """
-import os
-import sys
+from _virtual_devices import force_virtual_cpu
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
-
-sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
-
-if "jax" in sys.modules:
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    try:
-        # XLA_FLAGS set above is only read at first CPU-client creation; if
-        # a CPU backend already exists this config knob still applies.
-        jax.config.update("jax_num_cpu_devices", 8)
-    except Exception:  # pragma: no cover - knob absent on older jax
-        pass
-    try:
-        import jax.extend.backend as _jeb
-
-        _jeb.clear_backends()
-    except Exception:  # pragma: no cover - older jax fallback
-        from jax._src import xla_bridge as _xb
-
-        _xb.backends.cache_clear()
+force_virtual_cpu(8)
