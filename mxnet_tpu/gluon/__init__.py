@@ -6,6 +6,7 @@ from .trainer import Trainer
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock", "Parameter", "Constant",
            "Trainer", "nn", "rnn", "loss", "metric", "data", "utils",
-           "model_zoo", "contrib"]
+           "model_zoo", "contrib", "probability"]
 
 from . import contrib  # noqa: E402
+from . import probability  # noqa: E402

@@ -1,0 +1,20 @@
+"""``mx.gluon.probability`` — probabilistic programming toolkit.
+
+Reference capability: python/mxnet/gluon/probability/ (~8k LoC) —
+20+ distributions, bijective transformations, StochasticBlock for
+variational layers (SURVEY.md §2.2).
+
+TPU-native redesign: every density computation is built from framework
+ops (differentiable on the autograd tape, jit-traceable inside
+hybridize); sampling draws stateless threefry keys from
+``mxnet_tpu.random`` so a compiled training step keeps its randomness
+inside the fused XLA program.
+"""
+from .distributions import *  # noqa: F401,F403
+from .distributions import __all__ as _dist_all
+from .transformation import *  # noqa: F401,F403
+from .transformation import __all__ as _trans_all
+from .block import StochasticBlock, StochasticSequential  # noqa: F401
+
+__all__ = list(_dist_all) + list(_trans_all) + [
+    "StochasticBlock", "StochasticSequential"]

@@ -1,0 +1,99 @@
+"""StochasticBlock — Gluon blocks with auxiliary (KL/entropy) losses.
+
+Reference capability: python/mxnet/gluon/probability/block/stochastic_block
+— a HybridBlock whose forward can register intermediate losses via
+``self.add_loss`` inside a ``@StochasticBlock.collectLoss``-decorated
+forward; collected losses surface on ``.losses`` after the call (the
+variational-autoencoder ELBO pattern).
+"""
+from __future__ import annotations
+
+import functools
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["StochasticBlock", "StochasticSequential"]
+
+
+class StochasticBlock(HybridBlock):
+    """HybridBlock with an auxiliary-loss channel."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._losses = []
+        self._losscache = []
+        self._flag = False
+
+    @property
+    def losses(self):
+        return self._losses
+
+    def add_loss(self, loss):
+        self._losscache.append(loss)
+
+    def hybridize(self, active=True, **kwargs):
+        """The ``add_loss`` side-channel must stay eager: a jit trace of this
+        block would capture the losses as leaked tracers and cached calls
+        would skip ``forward`` entirely, silently dropping them.  Hybridize
+        therefore applies to the children only; this container always runs
+        its own forward eagerly (each child still compiles to a fused XLA
+        computation)."""
+        for child in self._children.values():
+            if isinstance(child, HybridBlock):
+                child.hybridize(active, **kwargs)
+
+    @staticmethod
+    def collectLoss(forward_fn):
+        """Decorator marking a forward whose add_loss calls are collected
+        (reference stochastic_block.py collectLoss)."""
+
+        @functools.wraps(forward_fn)
+        def wrapped(self, *args, **kwargs):
+            self._losscache = []
+            out = forward_fn(self, *args, **kwargs)
+            self._flag = True
+            return out
+
+        wrapped._collect_loss = True
+        return wrapped
+
+    def __call__(self, *args, **kwargs):
+        self._flag = False
+        out = super().__call__(*args, **kwargs)
+        if not self._flag and self._losscache:
+            raise MXNetError(
+                "add_loss was called outside a @StochasticBlock.collectLoss-"
+                "decorated forward; losses would be dropped")
+        self._losses = list(self._losscache)
+        self._losscache = []
+        return out
+
+
+class StochasticSequential(StochasticBlock):
+    """Sequential container propagating child losses
+    (reference stochastic_block.py StochasticSequential)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._layers = []
+
+    def add(self, *blocks):
+        for block in blocks:
+            self._layers.append(block)
+            self.register_child(block)
+
+    @StochasticBlock.collectLoss
+    def forward(self, x, *args):
+        for block in self._layers:
+            x = block(x)
+            if isinstance(block, StochasticBlock):
+                for loss in block.losses:
+                    self.add_loss(loss)
+        return x
+
+    def __getitem__(self, key):
+        return self._layers[key]
+
+    def __len__(self):
+        return len(self._layers)

@@ -1,0 +1,131 @@
+"""Parameter/support constraints (reference gluon/probability/distributions/
+constraint.py capability): lightweight validators used when a distribution
+is constructed with ``validate_args=True``."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+
+__all__ = ["Constraint", "Real", "Positive", "NonNegative", "Interval",
+           "UnitInterval", "GreaterThan", "LessThan", "IntegerInterval",
+           "NonNegativeInteger", "PositiveInteger", "Boolean", "Simplex",
+           "LowerCholesky", "real", "positive", "nonnegative",
+           "unit_interval", "boolean", "simplex", "nonnegative_integer",
+           "positive_integer", "lower_cholesky"]
+
+
+def _as_np(x):
+    from ...ndarray.ndarray import NDArray
+
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+class Constraint:
+    """Base constraint: ``check(value)`` raises on violation."""
+
+    def is_satisfied(self, value):
+        raise NotImplementedError
+
+    def check(self, value, name="value"):
+        if not bool(self.is_satisfied(value)):
+            raise MXNetError("constraint %s violated for %s"
+                             % (type(self).__name__, name))
+        return value
+
+
+class Real(Constraint):
+    def is_satisfied(self, value):
+        return _np.isfinite(_as_np(value)).all()
+
+
+class Positive(Constraint):
+    def is_satisfied(self, value):
+        return (_as_np(value) > 0).all()
+
+
+class NonNegative(Constraint):
+    def is_satisfied(self, value):
+        return (_as_np(value) >= 0).all()
+
+
+class GreaterThan(Constraint):
+    def __init__(self, lower):
+        self.lower = lower
+
+    def is_satisfied(self, value):
+        return (_as_np(value) > self.lower).all()
+
+
+class LessThan(Constraint):
+    def __init__(self, upper):
+        self.upper = upper
+
+    def is_satisfied(self, value):
+        return (_as_np(value) < self.upper).all()
+
+
+class Interval(Constraint):
+    def __init__(self, lower, upper):
+        self.lower = lower
+        self.upper = upper
+
+    def is_satisfied(self, value):
+        v = _as_np(value)
+        return ((v >= self.lower) & (v <= self.upper)).all()
+
+
+class UnitInterval(Interval):
+    def __init__(self):
+        super().__init__(0.0, 1.0)
+
+
+class IntegerInterval(Interval):
+    def is_satisfied(self, value):
+        v = _as_np(value)
+        return super().is_satisfied(value) and (v == _np.floor(v)).all()
+
+
+class NonNegativeInteger(Constraint):
+    def is_satisfied(self, value):
+        v = _as_np(value)
+        return ((v >= 0) & (v == _np.floor(v))).all()
+
+
+class PositiveInteger(Constraint):
+    def is_satisfied(self, value):
+        v = _as_np(value)
+        return ((v > 0) & (v == _np.floor(v))).all()
+
+
+class Boolean(Constraint):
+    def is_satisfied(self, value):
+        v = _as_np(value)
+        return ((v == 0) | (v == 1)).all()
+
+
+class Simplex(Constraint):
+    def is_satisfied(self, value):
+        v = _as_np(value)
+        return (v >= 0).all() and _np.allclose(v.sum(-1), 1.0, atol=1e-4)
+
+
+class LowerCholesky(Constraint):
+    def is_satisfied(self, value):
+        v = _as_np(value)
+        diag_ok = (_np.diagonal(v, axis1=-2, axis2=-1) > 0).all()
+        upper = _np.triu(v, k=1)
+        return diag_ok and _np.allclose(upper, 0.0)
+
+
+real = Real()
+positive = Positive()
+nonnegative = NonNegative()
+unit_interval = UnitInterval()
+boolean = Boolean()
+simplex = Simplex()
+nonnegative_integer = NonNegativeInteger()
+positive_integer = PositiveInteger()
+lower_cholesky = LowerCholesky()

@@ -1,0 +1,1639 @@
+"""Distribution classes.
+
+Reference capability: python/mxnet/gluon/probability/distributions/ — a
+Distribution base class with sample/sample_n/log_prob/cdf/icdf/moments,
+20+ concrete families, a KL-divergence registry and Monte-Carlo fallback.
+
+TPU-native design: densities are composed from framework ops (so every
+``log_prob`` is differentiable on the autograd tape and jit-traceable);
+samples draw stateless threefry keys via ``mxnet_tpu.random.take_key`` —
+inside a hybridized/jitted step the key folds into the traced base key, so
+sampling compiles into the fused XLA program (no host RNG round-trip).
+Reparameterized families (``has_grad=True``) build their samples from the
+parameters with recorded ops, giving pathwise gradients like the
+reference's ``rsample`` path.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _onp
+
+import jax
+import jax.numpy as jnp
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from ... import random as _random
+from . import constraint as _constraint
+
+__all__ = ["Distribution", "Normal", "LogNormal", "HalfNormal", "Laplace",
+           "Cauchy", "HalfCauchy", "Uniform", "Exponential", "Gamma", "Beta",
+           "Chi2", "StudentT", "FisherSnedecor", "Gumbel", "Weibull",
+           "Pareto", "Poisson", "Bernoulli", "Binomial", "Geometric",
+           "NegativeBinomial", "Categorical", "OneHotCategorical",
+           "Multinomial", "Dirichlet", "MultivariateNormal", "Independent",
+           "RelaxedBernoulli", "RelaxedOneHotCategorical",
+           "register_kl", "kl_divergence", "empirical_kl"]
+
+_EPS = 1e-12
+
+
+def _wrap(p):
+    """Promote scalars / numpy to float32 NDArray; keep NDArrays
+    (tape-linked) untouched."""
+    if isinstance(p, NDArray):
+        return p
+    return NDArray(jnp.asarray(p, dtype=jnp.float32))
+
+
+def _value(v, like=None):
+    if isinstance(v, NDArray):
+        return v
+    return NDArray(jnp.asarray(v))
+
+
+def _size(size):
+    if size is None:
+        return ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def _bshape(*params):
+    shape = ()
+    for p in params:
+        shape = jnp.broadcast_shapes(shape, tuple(p.shape))
+    return shape
+
+
+class Distribution:
+    """Base distribution (reference distribution.py capability)."""
+
+    has_grad = False          # reparameterized (pathwise) sampling
+    has_enumerate_support = False
+    arg_constraints = {}
+    support = None
+    event_dim = 0
+
+    def __init__(self, F=None, event_dim=None, validate_args=None):
+        # ``F`` kept for reference API parity (mx.nd/mx.sym dispatch); the
+        # TPU build has a single execution path.
+        self.F = F
+        if event_dim is not None:
+            self.event_dim = event_dim
+        self._validate_args = bool(validate_args)
+        if validate_args:
+            for name, con in self.arg_constraints.items():
+                val = getattr(self, name, None)
+                if val is not None:
+                    con.check(val, name)
+
+    # -- shapes -------------------------------------------------------------
+    @property
+    def batch_shape(self):
+        raise NotImplementedError
+
+    @property
+    def event_shape(self):
+        return ()
+
+    # -- core API -----------------------------------------------------------
+    def sample(self, size=None):
+        raise NotImplementedError
+
+    def sample_n(self, n):
+        return self.sample(_size(n))
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return self.log_prob(value).exp()
+
+    def cdf(self, value):
+        raise MXNetError("%s.cdf not implemented" % type(self).__name__)
+
+    def icdf(self, value):
+        raise MXNetError("%s.icdf not implemented" % type(self).__name__)
+
+    @property
+    def mean(self):
+        raise MXNetError("%s.mean undefined" % type(self).__name__)
+
+    @property
+    def variance(self):
+        raise MXNetError("%s.variance undefined" % type(self).__name__)
+
+    @property
+    def stddev(self):
+        return self.variance.sqrt()
+
+    def entropy(self):
+        raise MXNetError("%s.entropy not implemented" % type(self).__name__)
+
+    def perplexity(self):
+        return self.entropy().exp()
+
+    def enumerate_support(self):
+        raise MXNetError("%s has no enumerable support" % type(self).__name__)
+
+    def broadcast_to(self, batch_shape):
+        new = self.__class__.__new__(self.__class__)
+        new.__dict__.update(self.__dict__)
+        n_batch = len(tuple(self.batch_shape))
+        for name in self.arg_constraints:
+            val = getattr(self, name, None)
+            if isinstance(val, NDArray):
+                # keep the parameter's event dims (the part beyond the
+                # distribution's batch shape, e.g. Dirichlet alpha's last dim)
+                event_part = tuple(val.shape)[n_batch:]
+                setattr(new, name,
+                        val.broadcast_to(tuple(batch_shape) + event_part))
+        return new
+
+    def __repr__(self):
+        args = ", ".join("%s=%s" % (k, getattr(self, k, None) is not None)
+                         for k in self.arg_constraints)
+        return "%s(%s)" % (type(self).__name__, args)
+
+
+# ---------------------------------------------------------------------------
+# continuous, reparameterized
+# ---------------------------------------------------------------------------
+
+class Normal(Distribution):
+    has_grad = True
+    arg_constraints = {"loc": _constraint.real, "scale": _constraint.positive}
+    support = _constraint.real
+
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        self.loc = _wrap(loc)
+        self.scale = _wrap(scale)
+        super().__init__(**kwargs)
+
+    @property
+    def batch_shape(self):
+        return _bshape(self.loc, self.scale)
+
+    def sample(self, size=None):
+        shape = _size(size) + self.batch_shape
+        eps = NDArray(jax.random.normal(_random.take_key(), shape,
+                                        dtype=jnp.float32))
+        return self.loc + self.scale * eps
+
+    rsample = sample
+
+    def log_prob(self, value):
+        value = _value(value)
+        var = self.scale * self.scale
+        return (-((value - self.loc) ** 2) / (2 * var)
+                - self.scale.log() - 0.5 * math.log(2 * math.pi))
+
+    def cdf(self, value):
+        value = _value(value)
+        z = (value - self.loc) / (self.scale * math.sqrt(2.0))
+        from ... import ndarray as nd
+
+        return 0.5 * (1 + nd.erf(z))
+
+    def icdf(self, value):
+        from ... import ndarray as nd
+
+        value = _value(value)
+        return self.loc + self.scale * math.sqrt(2.0) * nd.erfinv(
+            2 * value - 1)
+
+    @property
+    def mean(self):
+        return self.loc * (self.scale * 0 + 1)
+
+    @property
+    def variance(self):
+        return self.scale * self.scale
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + self.scale.log()
+
+
+class LogNormal(Distribution):
+    has_grad = True
+    arg_constraints = {"loc": _constraint.real, "scale": _constraint.positive}
+    support = _constraint.positive
+
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        self.loc = _wrap(loc)
+        self.scale = _wrap(scale)
+        self._base = Normal(self.loc, self.scale)
+        super().__init__(**kwargs)
+
+    @property
+    def batch_shape(self):
+        return self._base.batch_shape
+
+    def sample(self, size=None):
+        return self._base.sample(size).exp()
+
+    def log_prob(self, value):
+        value = _value(value)
+        return self._base.log_prob(value.log()) - value.log()
+
+    @property
+    def mean(self):
+        return (self.loc + self.scale * self.scale / 2).exp()
+
+    @property
+    def variance(self):
+        s2 = self.scale * self.scale
+        return (s2.exp() - 1) * (2 * self.loc + s2).exp()
+
+    def entropy(self):
+        return self._base.entropy() + self.loc
+
+
+class HalfNormal(Distribution):
+    has_grad = True
+    arg_constraints = {"scale": _constraint.positive}
+    support = _constraint.nonnegative
+
+    def __init__(self, scale=1.0, **kwargs):
+        self.scale = _wrap(scale)
+        self._base = Normal(0.0, self.scale)
+        super().__init__(**kwargs)
+
+    @property
+    def batch_shape(self):
+        return tuple(self.scale.shape)
+
+    def sample(self, size=None):
+        return self._base.sample(size).abs()
+
+    def log_prob(self, value):
+        from ... import ndarray as nd
+
+        value = _value(value)
+        lp = self._base.log_prob(value) + math.log(2.0)
+        return nd.where(value >= 0, lp, lp * 0 - jnp.inf)
+
+    def cdf(self, value):
+        value = _value(value)
+        return (2 * self._base.cdf(value) - 1).clip(0.0, 1.0)
+
+    @property
+    def mean(self):
+        return self.scale * math.sqrt(2.0 / math.pi)
+
+    @property
+    def variance(self):
+        return self.scale * self.scale * (1 - 2.0 / math.pi)
+
+
+class Laplace(Distribution):
+    has_grad = True
+    arg_constraints = {"loc": _constraint.real, "scale": _constraint.positive}
+    support = _constraint.real
+
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        self.loc = _wrap(loc)
+        self.scale = _wrap(scale)
+        super().__init__(**kwargs)
+
+    @property
+    def batch_shape(self):
+        return _bshape(self.loc, self.scale)
+
+    def sample(self, size=None):
+        shape = _size(size) + self.batch_shape
+        u = NDArray(jax.random.uniform(_random.take_key(), shape,
+                                       minval=-0.5 + 1e-7, maxval=0.5))
+        return self.loc - self.scale * u.sign() * (1 - 2 * u.abs()).log()
+
+    def log_prob(self, value):
+        value = _value(value)
+        return (-(value - self.loc).abs() / self.scale
+                - self.scale.log() - math.log(2.0))
+
+    def cdf(self, value):
+        value = _value(value)
+        z = (value - self.loc) / self.scale
+        return 0.5 + 0.5 * z.sign() * (1 - (-z.abs()).exp())
+
+    @property
+    def mean(self):
+        return self.loc * (self.scale * 0 + 1)
+
+    @property
+    def variance(self):
+        return 2 * self.scale * self.scale
+
+    def entropy(self):
+        return 1 + (2 * self.scale).log()
+
+
+class Cauchy(Distribution):
+    has_grad = True
+    arg_constraints = {"loc": _constraint.real, "scale": _constraint.positive}
+    support = _constraint.real
+
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        self.loc = _wrap(loc)
+        self.scale = _wrap(scale)
+        super().__init__(**kwargs)
+
+    @property
+    def batch_shape(self):
+        return _bshape(self.loc, self.scale)
+
+    def sample(self, size=None):
+        shape = _size(size) + self.batch_shape
+        u = NDArray(jax.random.uniform(_random.take_key(), shape,
+                                       minval=1e-7, maxval=1.0 - 1e-7))
+        from ... import ndarray as nd
+
+        return self.loc + self.scale * nd.tan(math.pi * (u - 0.5))
+
+    def log_prob(self, value):
+        value = _value(value)
+        z = (value - self.loc) / self.scale
+        return -(math.pi * self.scale * (1 + z * z)).log()
+
+    def cdf(self, value):
+        from ... import ndarray as nd
+
+        value = _value(value)
+        return nd.arctan((value - self.loc) / self.scale) / math.pi + 0.5
+
+    def entropy(self):
+        return (4 * math.pi * self.scale).log()
+
+
+class HalfCauchy(Distribution):
+    has_grad = True
+    arg_constraints = {"scale": _constraint.positive}
+    support = _constraint.nonnegative
+
+    def __init__(self, scale=1.0, **kwargs):
+        self.scale = _wrap(scale)
+        self._base = Cauchy(0.0, self.scale)
+        super().__init__(**kwargs)
+
+    @property
+    def batch_shape(self):
+        return tuple(self.scale.shape)
+
+    def sample(self, size=None):
+        return self._base.sample(size).abs()
+
+    def log_prob(self, value):
+        from ... import ndarray as nd
+
+        value = _value(value)
+        lp = self._base.log_prob(value) + math.log(2.0)
+        return nd.where(value >= 0, lp, lp * 0 - jnp.inf)
+
+    def cdf(self, value):
+        value = _value(value)
+        return (2 * self._base.cdf(value) - 1).clip(0.0, 1.0)
+
+
+class Uniform(Distribution):
+    has_grad = True
+    arg_constraints = {"low": _constraint.real, "high": _constraint.real}
+
+    def __init__(self, low=0.0, high=1.0, **kwargs):
+        self.low = _wrap(low)
+        self.high = _wrap(high)
+        super().__init__(**kwargs)
+
+    @property
+    def batch_shape(self):
+        return _bshape(self.low, self.high)
+
+    def sample(self, size=None):
+        shape = _size(size) + self.batch_shape
+        u = NDArray(jax.random.uniform(_random.take_key(), shape))
+        return self.low + (self.high - self.low) * u
+
+    def log_prob(self, value):
+        from ... import ndarray as nd
+
+        value = _value(value)
+        inside = nd.logical_and(value >= self.low, value <= self.high)
+        return nd.where(inside, -(self.high - self.low).log(),
+                        value * 0 - jnp.inf)
+
+    def cdf(self, value):
+        value = _value(value)
+        return ((value - self.low) / (self.high - self.low)).clip(0.0, 1.0)
+
+    def icdf(self, value):
+        return self.low + (self.high - self.low) * _value(value)
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2
+
+    @property
+    def variance(self):
+        d = self.high - self.low
+        return d * d / 12
+
+    def entropy(self):
+        return (self.high - self.low).log()
+
+
+class Exponential(Distribution):
+    has_grad = True
+    arg_constraints = {"scale": _constraint.positive}
+    support = _constraint.nonnegative
+
+    def __init__(self, scale=1.0, **kwargs):
+        self.scale = _wrap(scale)
+        super().__init__(**kwargs)
+
+    @property
+    def batch_shape(self):
+        return tuple(self.scale.shape)
+
+    def sample(self, size=None):
+        shape = _size(size) + self.batch_shape
+        u = NDArray(jax.random.uniform(_random.take_key(), shape,
+                                       minval=1e-7, maxval=1.0))
+        return -self.scale * u.log()
+
+    def log_prob(self, value):
+        value = _value(value)
+        return -value / self.scale - self.scale.log()
+
+    def cdf(self, value):
+        return 1 - (-_value(value) / self.scale).exp()
+
+    def icdf(self, value):
+        return -self.scale * (1 - _value(value)).log()
+
+    @property
+    def mean(self):
+        return self.scale * 1
+
+    @property
+    def variance(self):
+        return self.scale * self.scale
+
+    def entropy(self):
+        return 1 + self.scale.log()
+
+
+class Gamma(Distribution):
+    """Gamma(shape=concentration, scale)."""
+
+    has_grad = True  # jax.random.gamma is reparameterized (implicit grads)
+    arg_constraints = {"shape": _constraint.positive,
+                       "scale": _constraint.positive}
+    support = _constraint.positive
+
+    def __init__(self, shape=1.0, scale=1.0, **kwargs):
+        self.shape = _wrap(shape)
+        self.scale = _wrap(scale)
+        super().__init__(**kwargs)
+
+    @property
+    def batch_shape(self):
+        return _bshape(self.shape, self.scale)
+
+    def sample(self, size=None):
+        out_shape = _size(size) + self.batch_shape
+        from ...ops.registry import apply_op
+
+        key = _random.take_key()
+
+        def draw(a, s):
+            return jax.random.gamma(key, jnp.broadcast_to(a, out_shape)) * s
+
+        draw.__name__ = "gamma_sample"
+        return apply_op(draw, self.shape, self.scale)
+
+    def log_prob(self, value):
+        from ... import ndarray as nd
+
+        value = _value(value)
+        a = self.shape
+        return ((a - 1) * value.log() - value / self.scale
+                - nd.gammaln(a) - a * self.scale.log())
+
+    @property
+    def mean(self):
+        return self.shape * self.scale
+
+    @property
+    def variance(self):
+        return self.shape * self.scale * self.scale
+
+    def entropy(self):
+        from ... import ndarray as nd
+
+        a = self.shape
+        return (a + self.scale.log() + nd.gammaln(a)
+                + (1 - a) * nd.digamma(a))
+
+
+class Beta(Distribution):
+    has_grad = True
+    arg_constraints = {"alpha": _constraint.positive,
+                       "beta": _constraint.positive}
+    support = _constraint.unit_interval
+
+    def __init__(self, alpha=1.0, beta=1.0, **kwargs):
+        self.alpha = _wrap(alpha)
+        self.beta = _wrap(beta)
+        super().__init__(**kwargs)
+
+    @property
+    def batch_shape(self):
+        return _bshape(self.alpha, self.beta)
+
+    def sample(self, size=None):
+        out_shape = _size(size) + self.batch_shape
+        from ...ops.registry import apply_op
+
+        k1, k2 = _random.take_key(), _random.take_key()
+
+        def draw(a, b):
+            ga = jax.random.gamma(k1, jnp.broadcast_to(a, out_shape))
+            gb = jax.random.gamma(k2, jnp.broadcast_to(b, out_shape))
+            return ga / (ga + gb)
+
+        draw.__name__ = "beta_sample"
+        return apply_op(draw, self.alpha, self.beta)
+
+    def log_prob(self, value):
+        from ... import ndarray as nd
+
+        value = _value(value)
+        lbeta = (nd.gammaln(self.alpha) + nd.gammaln(self.beta)
+                 - nd.gammaln(self.alpha + self.beta))
+        return ((self.alpha - 1) * value.log()
+                + (self.beta - 1) * (1 - value).log() - lbeta)
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s * s * (s + 1))
+
+    def entropy(self):
+        from ... import ndarray as nd
+
+        a, b = self.alpha, self.beta
+        lbeta = nd.gammaln(a) + nd.gammaln(b) - nd.gammaln(a + b)
+        return (lbeta - (a - 1) * nd.digamma(a) - (b - 1) * nd.digamma(b)
+                + (a + b - 2) * nd.digamma(a + b))
+
+
+class Chi2(Gamma):
+    arg_constraints = {"df": _constraint.positive}
+
+    def __init__(self, df, **kwargs):
+        self.df = _wrap(df)
+        super().__init__(shape=self.df / 2, scale=2.0, **kwargs)
+
+
+class StudentT(Distribution):
+    has_grad = True
+    arg_constraints = {"df": _constraint.positive, "loc": _constraint.real,
+                       "scale": _constraint.positive}
+    support = _constraint.real
+
+    def __init__(self, df, loc=0.0, scale=1.0, **kwargs):
+        self.df = _wrap(df)
+        self.loc = _wrap(loc)
+        self.scale = _wrap(scale)
+        super().__init__(**kwargs)
+
+    @property
+    def batch_shape(self):
+        return _bshape(self.df, self.loc, self.scale)
+
+    def sample(self, size=None):
+        shape = _size(size) + self.batch_shape
+        from ...ops.registry import apply_op
+
+        key = _random.take_key()
+
+        def draw(df, loc, scale):
+            t = jax.random.t(key, jnp.broadcast_to(df, shape), shape)
+            return loc + scale * t
+
+        draw.__name__ = "t_sample"
+        return apply_op(draw, self.df, self.loc, self.scale)
+
+    def log_prob(self, value):
+        from ... import ndarray as nd
+
+        value = _value(value)
+        z = (value - self.loc) / self.scale
+        df = self.df
+        return (nd.gammaln((df + 1) / 2) - nd.gammaln(df / 2)
+                - 0.5 * (math.pi * df).log() - self.scale.log()
+                - (df + 1) / 2 * (1 + z * z / df).log())
+
+    @property
+    def mean(self):
+        return self.loc * 1
+
+    @property
+    def variance(self):
+        from ... import ndarray as nd
+
+        df = self.df
+        v = self.scale * self.scale * df / (df - 2)
+        return nd.where(df > 2, v, v * jnp.nan)
+
+
+class FisherSnedecor(Distribution):
+    """F-distribution (reference fishersnedecor.py)."""
+
+    has_grad = True
+    arg_constraints = {"df1": _constraint.positive,
+                       "df2": _constraint.positive}
+    support = _constraint.positive
+
+    def __init__(self, df1, df2, **kwargs):
+        self.df1 = _wrap(df1)
+        self.df2 = _wrap(df2)
+        super().__init__(**kwargs)
+
+    @property
+    def batch_shape(self):
+        return _bshape(self.df1, self.df2)
+
+    def sample(self, size=None):
+        shape = _size(size) + self.batch_shape
+        from ...ops.registry import apply_op
+
+        k1, k2 = _random.take_key(), _random.take_key()
+
+        def draw(d1, d2):
+            g1 = jax.random.gamma(k1, jnp.broadcast_to(d1 / 2, shape)) * 2
+            g2 = jax.random.gamma(k2, jnp.broadcast_to(d2 / 2, shape)) * 2
+            return (g1 / d1) / jnp.maximum(g2 / d2, _EPS)
+
+        draw.__name__ = "f_sample"
+        return apply_op(draw, self.df1, self.df2)
+
+    def log_prob(self, value):
+        from ... import ndarray as nd
+
+        value = _value(value)
+        d1, d2 = self.df1, self.df2
+        lbeta = (nd.gammaln(d1 / 2) + nd.gammaln(d2 / 2)
+                 - nd.gammaln((d1 + d2) / 2))
+        return (d1 / 2 * (d1 / d2).log() + (d1 / 2 - 1) * value.log()
+                - (d1 + d2) / 2 * (1 + d1 * value / d2).log() - lbeta)
+
+    @property
+    def mean(self):
+        from ... import ndarray as nd
+
+        m = self.df2 / (self.df2 - 2)
+        return nd.where(self.df2 > 2, m, m * jnp.nan)
+
+
+class Gumbel(Distribution):
+    has_grad = True
+    arg_constraints = {"loc": _constraint.real, "scale": _constraint.positive}
+    support = _constraint.real
+
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        self.loc = _wrap(loc)
+        self.scale = _wrap(scale)
+        super().__init__(**kwargs)
+
+    @property
+    def batch_shape(self):
+        return _bshape(self.loc, self.scale)
+
+    def sample(self, size=None):
+        shape = _size(size) + self.batch_shape
+        g = NDArray(jax.random.gumbel(_random.take_key(), shape))
+        return self.loc + self.scale * g
+
+    def log_prob(self, value):
+        value = _value(value)
+        z = (value - self.loc) / self.scale
+        return -(z + (-z).exp()) - self.scale.log()
+
+    def cdf(self, value):
+        value = _value(value)
+        return (-((-(value - self.loc) / self.scale).exp())).exp()
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * 0.57721566490153286
+
+    @property
+    def variance(self):
+        return (math.pi ** 2 / 6) * self.scale * self.scale
+
+    def entropy(self):
+        return self.scale.log() + 1 + 0.57721566490153286
+
+
+class Weibull(Distribution):
+    has_grad = True
+    arg_constraints = {"concentration": _constraint.positive,
+                       "scale": _constraint.positive}
+    support = _constraint.positive
+
+    def __init__(self, concentration, scale=1.0, **kwargs):
+        self.concentration = _wrap(concentration)
+        self.scale = _wrap(scale)
+        super().__init__(**kwargs)
+
+    @property
+    def batch_shape(self):
+        return _bshape(self.concentration, self.scale)
+
+    def sample(self, size=None):
+        shape = _size(size) + self.batch_shape
+        u = NDArray(jax.random.uniform(_random.take_key(), shape,
+                                       minval=1e-7, maxval=1.0))
+        return self.scale * ((-u.log()) ** (1.0 / self.concentration))
+
+    def log_prob(self, value):
+        value = _value(value)
+        k, lam = self.concentration, self.scale
+        z = value / lam
+        return (k.log() - lam.log() + (k - 1) * z.log() - z ** k)
+
+    def cdf(self, value):
+        z = _value(value) / self.scale
+        return 1 - (-(z ** self.concentration)).exp()
+
+    @property
+    def mean(self):
+        from ... import ndarray as nd
+
+        return self.scale * nd.gammaln(1 + 1 / self.concentration).exp()
+
+
+class Pareto(Distribution):
+    has_grad = True
+    arg_constraints = {"alpha": _constraint.positive,
+                       "scale": _constraint.positive}
+
+    def __init__(self, alpha, scale=1.0, **kwargs):
+        self.alpha = _wrap(alpha)
+        self.scale = _wrap(scale)
+        super().__init__(**kwargs)
+
+    @property
+    def batch_shape(self):
+        return _bshape(self.alpha, self.scale)
+
+    def sample(self, size=None):
+        shape = _size(size) + self.batch_shape
+        u = NDArray(jax.random.uniform(_random.take_key(), shape,
+                                       minval=1e-7, maxval=1.0))
+        return self.scale * (u ** (-1.0 / self.alpha))
+
+    def log_prob(self, value):
+        value = _value(value)
+        return (self.alpha.log() + self.alpha * self.scale.log()
+                - (self.alpha + 1) * value.log())
+
+    def cdf(self, value):
+        return 1 - (self.scale / _value(value)) ** self.alpha
+
+    @property
+    def mean(self):
+        from ... import ndarray as nd
+
+        m = self.alpha * self.scale / (self.alpha - 1)
+        # mean is +inf for alpha <= 1 (m itself is negative/undefined there)
+        return nd.where(self.alpha > 1, m, self.alpha * 0 + jnp.inf)
+
+
+# ---------------------------------------------------------------------------
+# discrete
+# ---------------------------------------------------------------------------
+
+def _logits_from_prob(prob):
+    return prob.clip(_EPS, 1.0).log() - (1 - prob).clip(_EPS, 1.0).log()
+
+
+def _prob_from_logits(logit):
+    return logit.sigmoid()
+
+
+class Bernoulli(Distribution):
+    arg_constraints = {"prob": _constraint.unit_interval,
+                       "logit": _constraint.real}
+    support = _constraint.boolean
+    has_enumerate_support = True
+
+    def __init__(self, prob=None, logit=None, **kwargs):
+        if (prob is None) == (logit is None):
+            raise MXNetError("pass exactly one of prob / logit")
+        self._prob = _wrap(prob) if prob is not None else None
+        self._logit = _wrap(logit) if logit is not None else None
+        super().__init__(**kwargs)
+
+    @property
+    def prob(self):
+        return self._prob if self._prob is not None else _prob_from_logits(
+            self._logit)
+
+    @property
+    def logit(self):
+        return self._logit if self._logit is not None else _logits_from_prob(
+            self._prob)
+
+    @property
+    def batch_shape(self):
+        p = self._prob if self._prob is not None else self._logit
+        return tuple(p.shape)
+
+    def sample(self, size=None):
+        shape = _size(size) + self.batch_shape
+        p = self.prob
+        return NDArray(jax.random.bernoulli(
+            _random.take_key(), jnp.broadcast_to(p._data, shape)).astype(
+                jnp.float32))
+
+    def log_prob(self, value):
+        from ... import ndarray as nd
+
+        value = _value(value)
+        # -BCE(logits, value): numerically-stable via softplus
+        logit = self.logit
+        return value * logit - nd.logaddexp(logit * 0, logit)
+
+    @property
+    def mean(self):
+        return self.prob * 1
+
+    @property
+    def variance(self):
+        p = self.prob
+        return p * (1 - p)
+
+    def entropy(self):
+        from ... import ndarray as nd
+
+        logit = self.logit
+        p = self.prob
+        return nd.logaddexp(logit * 0, logit) - p * logit
+
+    def enumerate_support(self):
+        return NDArray(jnp.arange(2, dtype=jnp.float32))
+
+
+class Geometric(Distribution):
+    """Number of failures before first success."""
+
+    arg_constraints = {"prob": _constraint.unit_interval,
+                       "logit": _constraint.real}
+    support = _constraint.nonnegative_integer
+
+    def __init__(self, prob=None, logit=None, **kwargs):
+        if (prob is None) == (logit is None):
+            raise MXNetError("pass exactly one of prob / logit")
+        self._prob = _wrap(prob) if prob is not None else None
+        self._logit = _wrap(logit) if logit is not None else None
+        super().__init__(**kwargs)
+
+    @property
+    def prob(self):
+        return self._prob if self._prob is not None else _prob_from_logits(
+            self._logit)
+
+    @property
+    def batch_shape(self):
+        p = self._prob if self._prob is not None else self._logit
+        return tuple(p.shape)
+
+    def sample(self, size=None):
+        shape = _size(size) + self.batch_shape
+        u = NDArray(jax.random.uniform(_random.take_key(), shape,
+                                       minval=1e-7, maxval=1.0))
+        p = self.prob
+        return (u.log() / (1 - p).clip(_EPS, 1.0).log()).floor()
+
+    def log_prob(self, value):
+        value = _value(value)
+        p = self.prob
+        return value * (1 - p).clip(_EPS, 1.0).log() + p.clip(
+            _EPS, 1.0).log()
+
+    @property
+    def mean(self):
+        p = self.prob
+        return (1 - p) / p
+
+    @property
+    def variance(self):
+        p = self.prob
+        return (1 - p) / (p * p)
+
+    def entropy(self):
+        p = self.prob
+        q = 1 - p
+        return -(q * q.clip(_EPS, 1.0).log()
+                 + p * p.clip(_EPS, 1.0).log()) / p
+
+
+class Poisson(Distribution):
+    arg_constraints = {"rate": _constraint.positive}
+    support = _constraint.nonnegative_integer
+
+    def __init__(self, rate=1.0, **kwargs):
+        self.rate = _wrap(rate)
+        super().__init__(**kwargs)
+
+    @property
+    def batch_shape(self):
+        return tuple(self.rate.shape)
+
+    def sample(self, size=None):
+        shape = _size(size) + self.batch_shape
+        return NDArray(jax.random.poisson(
+            _random.take_key(), jnp.broadcast_to(self.rate._data, shape),
+            shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        from ... import ndarray as nd
+
+        value = _value(value)
+        return (value * self.rate.log() - self.rate
+                - nd.gammaln(value + 1))
+
+    @property
+    def mean(self):
+        return self.rate * 1
+
+    @property
+    def variance(self):
+        return self.rate * 1
+
+
+class Binomial(Distribution):
+    arg_constraints = {"n": _constraint.nonnegative_integer,
+                       "prob": _constraint.unit_interval}
+    has_enumerate_support = True
+
+    def __init__(self, n=1, prob=0.5, **kwargs):
+        self.n = int(n)
+        self.prob = _wrap(prob)
+        super().__init__(**kwargs)
+
+    @property
+    def batch_shape(self):
+        return tuple(self.prob.shape)
+
+    def sample(self, size=None):
+        shape = _size(size) + self.batch_shape
+        p = jnp.broadcast_to(self.prob._data, shape)
+        draws = jax.random.bernoulli(
+            _random.take_key(), p[None].repeat(self.n, 0) if self.n else
+            p[None])
+        out = draws.astype(jnp.float32).sum(0) if self.n else jnp.zeros(shape)
+        return NDArray(out)
+
+    def log_prob(self, value):
+        from ... import ndarray as nd
+
+        value = _value(value)
+        n = self.n
+        p = self.prob
+        log_comb = (nd.gammaln(value * 0 + n + 1) - nd.gammaln(value + 1)
+                    - nd.gammaln(n - value + 1))
+        return (log_comb + value * p.clip(_EPS, 1).log()
+                + (n - value) * (1 - p).clip(_EPS, 1).log())
+
+    @property
+    def mean(self):
+        return self.n * self.prob
+
+    @property
+    def variance(self):
+        return self.n * self.prob * (1 - self.prob)
+
+    def enumerate_support(self):
+        return NDArray(jnp.arange(self.n + 1, dtype=jnp.float32))
+
+
+class NegativeBinomial(Distribution):
+    """Failures before the n-th success."""
+
+    arg_constraints = {"n": _constraint.positive,
+                       "prob": _constraint.unit_interval}
+
+    def __init__(self, n, prob, **kwargs):
+        self.n = _wrap(n)
+        self.prob = _wrap(prob)
+        super().__init__(**kwargs)
+
+    @property
+    def batch_shape(self):
+        return _bshape(self.n, self.prob)
+
+    def sample(self, size=None):
+        shape = _size(size) + self.batch_shape
+        key1, key2 = _random.take_key(), _random.take_key()
+        # Gamma-Poisson mixture
+        n = jnp.broadcast_to(self.n._data, shape)
+        p = jnp.broadcast_to(self.prob._data, shape)
+        lam = jax.random.gamma(key1, n) * (1 - p) / jnp.maximum(p, _EPS)
+        return NDArray(jax.random.poisson(key2, lam, shape).astype(
+            jnp.float32))
+
+    def log_prob(self, value):
+        from ... import ndarray as nd
+
+        value = _value(value)
+        n, p = self.n, self.prob
+        log_comb = (nd.gammaln(value + n) - nd.gammaln(value + 1)
+                    - nd.gammaln(n))
+        return (log_comb + n * p.clip(_EPS, 1).log()
+                + value * (1 - p).clip(_EPS, 1).log())
+
+    @property
+    def mean(self):
+        return self.n * (1 - self.prob) / self.prob
+
+    @property
+    def variance(self):
+        return self.n * (1 - self.prob) / (self.prob * self.prob)
+
+
+class Categorical(Distribution):
+    arg_constraints = {"prob": _constraint.simplex, "logit": _constraint.real}
+    has_enumerate_support = True
+
+    def __init__(self, num_events=None, prob=None, logit=None, **kwargs):
+        if (prob is None) == (logit is None):
+            raise MXNetError("pass exactly one of prob / logit")
+        self._prob = _wrap(prob) if prob is not None else None
+        self._logit = _wrap(logit) if logit is not None else None
+        src = self._prob if self._prob is not None else self._logit
+        self.num_events = int(num_events or src.shape[-1])
+        super().__init__(**kwargs)
+
+    @property
+    def prob(self):
+        if self._prob is not None:
+            return self._prob
+        return self._logit.softmax(axis=-1)
+
+    @property
+    def logit(self):
+        if self._logit is not None:
+            return self._logit.log_softmax(axis=-1)
+        return self._prob.clip(_EPS, 1.0).log()
+
+    @property
+    def batch_shape(self):
+        src = self._prob if self._prob is not None else self._logit
+        return tuple(src.shape)[:-1]
+
+    def sample(self, size=None):
+        shape = _size(size) + self.batch_shape
+        logits = jnp.broadcast_to(self.logit._data,
+                                  shape + (self.num_events,))
+        idx = jax.random.categorical(_random.take_key(), logits, axis=-1)
+        return NDArray(idx.astype(jnp.float32))
+
+    def log_prob(self, value):
+        from ... import ndarray as nd
+
+        value = _value(value)
+        logp = self.logit
+        return nd.pick(logp, value, axis=-1)
+
+    @property
+    def mean(self):
+        raise MXNetError("Categorical.mean undefined")
+
+    def entropy(self):
+        p = self.prob
+        return -(p * self.logit).sum(axis=-1)
+
+    def enumerate_support(self):
+        return NDArray(jnp.arange(self.num_events, dtype=jnp.float32))
+
+
+class OneHotCategorical(Distribution):
+    arg_constraints = {"prob": _constraint.simplex, "logit": _constraint.real}
+    has_enumerate_support = True
+
+    def __init__(self, num_events=None, prob=None, logit=None, **kwargs):
+        self._cat = Categorical(num_events, prob=prob, logit=logit)
+        self.num_events = self._cat.num_events
+        super().__init__(**kwargs)
+
+    prob = property(lambda self: self._cat.prob)
+    logit = property(lambda self: self._cat.logit)
+
+    @property
+    def batch_shape(self):
+        return self._cat.batch_shape
+
+    @property
+    def event_shape(self):
+        return (self.num_events,)
+
+    def sample(self, size=None):
+        from ... import ndarray as nd
+
+        idx = self._cat.sample(size)
+        return nd.one_hot(idx, self.num_events)
+
+    def log_prob(self, value):
+        value = _value(value)
+        return (value * self._cat.logit).sum(axis=-1)
+
+    @property
+    def mean(self):
+        return self._cat.prob * 1
+
+    @property
+    def variance(self):
+        p = self._cat.prob
+        return p * (1 - p)
+
+    def entropy(self):
+        return self._cat.entropy()
+
+    def enumerate_support(self):
+        return NDArray(jnp.eye(self.num_events, dtype=jnp.float32))
+
+
+class Multinomial(Distribution):
+    arg_constraints = {"prob": _constraint.simplex}
+
+    def __init__(self, num_events=None, prob=None, logit=None,
+                 total_count=1, **kwargs):
+        self._cat = Categorical(num_events, prob=prob, logit=logit)
+        self.num_events = self._cat.num_events
+        self.total_count = int(total_count)
+        super().__init__(**kwargs)
+
+    prob = property(lambda self: self._cat.prob)
+
+    @property
+    def batch_shape(self):
+        return self._cat.batch_shape
+
+    @property
+    def event_shape(self):
+        return (self.num_events,)
+
+    def sample(self, size=None):
+        shape = _size(size) + self.batch_shape
+        logits = jnp.broadcast_to(self._cat.logit._data,
+                                  shape + (self.num_events,))
+        idx = jax.random.categorical(
+            _random.take_key(), logits[..., None, :], axis=-1,
+            shape=shape + (self.total_count,))
+        counts = jax.nn.one_hot(idx, self.num_events).sum(-2)
+        return NDArray(counts)
+
+    def log_prob(self, value):
+        from ... import ndarray as nd
+
+        value = _value(value)
+        logp = self._cat.logit
+        log_factorial = nd.gammaln(value.sum(axis=-1, keepdims=True) + 1)
+        return ((value * logp).sum(axis=-1)
+                + log_factorial.squeeze(axis=-1)
+                - nd.gammaln(value + 1).sum(axis=-1))
+
+    @property
+    def mean(self):
+        return self.total_count * self._cat.prob
+
+
+class Dirichlet(Distribution):
+    has_grad = True
+    arg_constraints = {"alpha": _constraint.positive}
+    support = _constraint.simplex
+
+    def __init__(self, alpha, **kwargs):
+        self.alpha = _wrap(alpha)
+        super().__init__(**kwargs)
+
+    @property
+    def batch_shape(self):
+        return tuple(self.alpha.shape)[:-1]
+
+    @property
+    def event_shape(self):
+        return tuple(self.alpha.shape)[-1:]
+
+    def sample(self, size=None):
+        shape = _size(size) + tuple(self.alpha.shape)
+        from ...ops.registry import apply_op
+
+        key = _random.take_key()
+
+        def draw(a):
+            g = jax.random.gamma(key, jnp.broadcast_to(a, shape))
+            return g / g.sum(-1, keepdims=True)
+
+        draw.__name__ = "dirichlet_sample"
+        return apply_op(draw, self.alpha)
+
+    def log_prob(self, value):
+        from ... import ndarray as nd
+
+        value = _value(value)
+        a = self.alpha
+        lbeta = (nd.gammaln(a).sum(axis=-1)
+                 - nd.gammaln(a.sum(axis=-1)))
+        return ((a - 1) * value.clip(_EPS, 1.0).log()).sum(axis=-1) - lbeta
+
+    @property
+    def mean(self):
+        return self.alpha / self.alpha.sum(axis=-1, keepdims=True)
+
+    @property
+    def variance(self):
+        a0 = self.alpha.sum(axis=-1, keepdims=True)
+        m = self.alpha / a0
+        return m * (1 - m) / (a0 + 1)
+
+    def entropy(self):
+        from ... import ndarray as nd
+
+        a = self.alpha
+        a0 = a.sum(axis=-1)
+        k = a.shape[-1]
+        lbeta = nd.gammaln(a).sum(axis=-1) - nd.gammaln(a0)
+        return (lbeta + (a0 - k) * nd.digamma(a0)
+                - ((a - 1) * nd.digamma(a)).sum(axis=-1))
+
+
+class MultivariateNormal(Distribution):
+    has_grad = True
+    event_dim = 1
+
+    def __init__(self, loc, cov=None, scale_tril=None, **kwargs):
+        if (cov is None) == (scale_tril is None):
+            raise MXNetError("pass exactly one of cov / scale_tril")
+        self.loc = _wrap(loc)
+        if scale_tril is not None:
+            self.scale_tril = _wrap(scale_tril)
+            self.cov = None
+        else:
+            self.cov = _wrap(cov)
+            from ...ops.registry import apply_op
+
+            def chol(c):
+                return jnp.linalg.cholesky(c)
+
+            chol.__name__ = "cholesky"
+            self.scale_tril = apply_op(chol, self.cov)
+        super().__init__(**kwargs)
+
+    @property
+    def batch_shape(self):
+        return tuple(self.loc.shape)[:-1]
+
+    @property
+    def event_shape(self):
+        return tuple(self.loc.shape)[-1:]
+
+    def sample(self, size=None):
+        shape = _size(size) + tuple(self.loc.shape)
+        eps = NDArray(jax.random.normal(_random.take_key(), shape))
+        from ...ops.registry import apply_op
+
+        def combine(loc, L, e):
+            return loc + jnp.einsum("...ij,...j->...i", L, e)
+
+        combine.__name__ = "mvn_sample"
+        return apply_op(combine, self.loc, self.scale_tril, eps)
+
+    def log_prob(self, value):
+        from ...ops.registry import apply_op
+
+        value = _value(value)
+
+        def lp(loc, L, v):
+            d = v - loc
+            batch = jnp.broadcast_shapes(d.shape[:-1], L.shape[:-2])
+            Lb = jnp.broadcast_to(L, batch + L.shape[-2:])
+            db = jnp.broadcast_to(d, batch + d.shape[-1:])
+            sol = jax.scipy.linalg.solve_triangular(
+                Lb, db[..., None], lower=True)[..., 0]
+            k = loc.shape[-1]
+            halflogdet = jnp.log(jnp.abs(jnp.diagonal(
+                L, axis1=-2, axis2=-1))).sum(-1)
+            return (-0.5 * (sol * sol).sum(-1) - halflogdet
+                    - 0.5 * k * math.log(2 * math.pi))
+
+        lp.__name__ = "mvn_log_prob"
+        return apply_op(lp, self.loc, self.scale_tril, value)
+
+    @property
+    def mean(self):
+        return self.loc * 1
+
+    @property
+    def variance(self):
+        from ...ops.registry import apply_op
+
+        def var(L):
+            return jnp.square(L).sum(-1)
+
+        var.__name__ = "mvn_variance"
+        return apply_op(var, self.scale_tril)
+
+    def entropy(self):
+        from ...ops.registry import apply_op
+
+        def ent(L):
+            k = L.shape[-1]
+            halflogdet = jnp.log(jnp.abs(jnp.diagonal(
+                L, axis1=-2, axis2=-1))).sum(-1)
+            return 0.5 * k * (1 + math.log(2 * math.pi)) + halflogdet
+
+        ent.__name__ = "mvn_entropy"
+        return apply_op(ent, self.scale_tril)
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (reference independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_ndims, **kwargs):
+        self.base_dist = base
+        self.num_dims = int(reinterpreted_batch_ndims)
+        super().__init__(**kwargs)
+
+    @property
+    def has_grad(self):
+        return self.base_dist.has_grad
+
+    @property
+    def batch_shape(self):
+        bs = self.base_dist.batch_shape
+        return bs[:len(bs) - self.num_dims]
+
+    @property
+    def event_shape(self):
+        bs = self.base_dist.batch_shape
+        return bs[len(bs) - self.num_dims:] + tuple(
+            self.base_dist.event_shape)
+
+    def sample(self, size=None):
+        return self.base_dist.sample(size)
+
+    def log_prob(self, value):
+        lp = self.base_dist.log_prob(value)
+        for _ in range(self.num_dims):
+            lp = lp.sum(axis=-1)
+        return lp
+
+    @property
+    def mean(self):
+        return self.base_dist.mean
+
+    @property
+    def variance(self):
+        return self.base_dist.variance
+
+    def entropy(self):
+        ent = self.base_dist.entropy()
+        for _ in range(self.num_dims):
+            ent = ent.sum(axis=-1)
+        return ent
+
+
+class RelaxedBernoulli(Distribution):
+    """Concrete/Gumbel-sigmoid relaxation (reference relaxed_bernoulli.py)."""
+
+    has_grad = True
+
+    def __init__(self, T=1.0, prob=None, logit=None, **kwargs):
+        if (prob is None) == (logit is None):
+            raise MXNetError("pass exactly one of prob / logit")
+        self.T = _wrap(T)
+        self._b = Bernoulli(prob=prob, logit=logit)
+        super().__init__(**kwargs)
+
+    logit = property(lambda self: self._b.logit)
+    prob = property(lambda self: self._b.prob)
+
+    @property
+    def batch_shape(self):
+        return self._b.batch_shape
+
+    def sample(self, size=None):
+        shape = _size(size) + self.batch_shape
+        u = NDArray(jax.random.uniform(_random.take_key(), shape,
+                                       minval=1e-7, maxval=1.0 - 1e-7))
+        logistic = u.log() - (1 - u).log()
+        return ((self.logit + logistic) / self.T).sigmoid()
+
+    def log_prob(self, value):
+        value = _value(value)
+        t = self.T
+        logit = self.logit
+        diff = logit - value.clip(_EPS, 1 - 1e-7).log() * t \
+            + (1 - value).clip(_EPS, 1 - 1e-7).log() * t
+        from ... import ndarray as nd
+
+        return (t.log() + diff - 2 * nd.logaddexp(diff * 0, diff)
+                - value.clip(_EPS, 1.0).log()
+                - (1 - value).clip(_EPS, 1.0).log())
+
+
+class RelaxedOneHotCategorical(Distribution):
+    """Gumbel-softmax relaxation (reference relaxed_one_hot_categorical)."""
+
+    has_grad = True
+
+    def __init__(self, T=1.0, num_events=None, prob=None, logit=None,
+                 **kwargs):
+        self.T = _wrap(T)
+        self._cat = Categorical(num_events, prob=prob, logit=logit)
+        self.num_events = self._cat.num_events
+        super().__init__(**kwargs)
+
+    logit = property(lambda self: self._cat.logit)
+    prob = property(lambda self: self._cat.prob)
+
+    @property
+    def batch_shape(self):
+        return self._cat.batch_shape
+
+    @property
+    def event_shape(self):
+        return (self.num_events,)
+
+    def sample(self, size=None):
+        shape = _size(size) + self.batch_shape + (self.num_events,)
+        g = NDArray(jax.random.gumbel(_random.take_key(), shape))
+        return ((self.logit + g) / self.T).softmax(axis=-1)
+
+    def log_prob(self, value):
+        from ... import ndarray as nd
+
+        value = _value(value)
+        k = self.num_events
+        t = self.T
+        logit = self.logit
+        log_scale = nd.gammaln(_wrap(float(k))) + (k - 1) * t.log()
+        score = (logit - t * value.clip(_EPS, 1.0).log())
+        lse = nd.logsumexp(score, axis=-1, keepdims=True)
+        return ((score - lse).sum(axis=-1) + log_scale
+                - value.clip(_EPS, 1.0).log().sum(axis=-1))
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (reference divergence.py)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    """KL(p||q); falls back to Monte-Carlo estimate when no closed form."""
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is not None:
+        return fn(p, q)
+    for (tp, tq), f in _KL_REGISTRY.items():
+        if isinstance(p, tp) and isinstance(q, tq):
+            return f(p, q)
+    return empirical_kl(p, q)
+
+
+def empirical_kl(p, q, n_samples=32):
+    x = p.sample((n_samples,))
+    return (p.log_prob(x) - q.log_prob(x)).mean(axis=0)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1 - var_ratio.log())
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    pp, qq = p.prob, q.prob
+    return (pp * (pp.clip(_EPS, 1).log() - qq.clip(_EPS, 1).log())
+            + (1 - pp) * ((1 - pp).clip(_EPS, 1).log()
+                          - (1 - qq).clip(_EPS, 1).log()))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    return (p.prob * (p.logit - q.logit)).sum(axis=-1)
+
+
+@register_kl(OneHotCategorical, OneHotCategorical)
+def _kl_onehot(p, q):
+    return _kl_categorical(p._cat, q._cat)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    # rates λ = 1/scale: KL = log(λp/λq) + λq/λp − 1
+    return (q.scale / p.scale).log() + p.scale / q.scale - 1
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    from ... import ndarray as nd
+
+    a_p, b_p = p.shape, 1 / p.scale
+    a_q, b_q = q.shape, 1 / q.scale
+    return ((a_p - a_q) * nd.digamma(a_p) - nd.gammaln(a_p)
+            + nd.gammaln(a_q) + a_q * (b_p.log() - b_q.log())
+            + a_p * (b_q - b_p) / b_p)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    from ... import ndarray as nd
+
+    sum_p = p.alpha + p.beta
+    t1 = (nd.gammaln(q.alpha) + nd.gammaln(q.beta)
+          - nd.gammaln(q.alpha + q.beta))
+    t2 = (nd.gammaln(p.alpha) + nd.gammaln(p.beta) - nd.gammaln(sum_p))
+    return (t1 - t2 + (p.alpha - q.alpha) * nd.digamma(p.alpha)
+            + (p.beta - q.beta) * nd.digamma(p.beta)
+            + (q.alpha - p.alpha + q.beta - p.beta) * nd.digamma(sum_p))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    from ... import ndarray as nd
+
+    a0 = p.alpha.sum(axis=-1)
+    t1 = nd.gammaln(a0) - nd.gammaln(p.alpha).sum(axis=-1)
+    t2 = (nd.gammaln(q.alpha).sum(axis=-1)
+          - nd.gammaln(q.alpha.sum(axis=-1)))
+    t3 = ((p.alpha - q.alpha) * (nd.digamma(p.alpha)
+                                 - nd.digamma(a0).expand_dims(-1))).sum(
+        axis=-1)
+    return t1 + t2 + t3
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    scale_ratio = p.scale / q.scale
+    d = (p.loc - q.loc).abs()
+    return (q.scale.log() - p.scale.log()
+            + scale_ratio * (-(d / p.scale)).exp()
+            + d / q.scale - 1)
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p, q):
+    return p.rate * (p.rate.log() - q.rate.log()) - (p.rate - q.rate)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    from ... import ndarray as nd
+
+    r = (q.high - q.low) / (p.high - p.low)
+    inside = nd.logical_and(q.low <= p.low, q.high >= p.high)
+    return nd.where(inside, r.log(), r * jnp.inf)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    from ...ops.registry import apply_op
+
+    def kl(lp, Lp, lq, Lq):
+        k = lp.shape[-1]
+        sol = jax.scipy.linalg.solve_triangular(Lq, Lp, lower=True)
+        tr = jnp.square(sol).sum((-2, -1))
+        d = lq - lp
+        md = jax.scipy.linalg.solve_triangular(
+            Lq, d[..., None], lower=True)[..., 0]
+        maha = jnp.square(md).sum(-1)
+        logdet = (jnp.log(jnp.abs(jnp.diagonal(Lq, axis1=-2, axis2=-1))
+                          ).sum(-1)
+                  - jnp.log(jnp.abs(jnp.diagonal(Lp, axis1=-2, axis2=-1))
+                            ).sum(-1))
+        return 0.5 * (tr + maha - k) + logdet
+
+    kl.__name__ = "mvn_kl"
+    return apply_op(kl, p.loc, p.scale_tril, q.loc, q.scale_tril)

@@ -417,6 +417,26 @@ class NDArray:
 
         return ceil(self)
 
+    def sign(self):
+        from . import sign
+
+        return sign(self)
+
+    def square(self):
+        from . import square
+
+        return square(self)
+
+    def expm1(self):
+        from . import expm1
+
+        return expm1(self)
+
+    def log1p(self):
+        from . import log1p
+
+        return log1p(self)
+
     def dot(self, other):
         from . import dot
 
