@@ -68,6 +68,14 @@ def _bshape(*params):
     return shape
 
 
+def _mask_support(lp, inside):
+    """-inf log-density outside the support (reference masks via constraint
+    checks); keeps in-support gradients intact."""
+    from ... import ndarray as nd
+
+    return nd.where(inside, lp, lp * 0 - jnp.inf)
+
+
 class Distribution:
     """Base distribution (reference distribution.py capability)."""
 
@@ -237,7 +245,9 @@ class LogNormal(Distribution):
 
     def log_prob(self, value):
         value = _value(value)
-        return self._base.log_prob(value.log()) - value.log()
+        v = value.clip(_EPS, None)
+        lp = self._base.log_prob(v.log()) - v.log()
+        return _mask_support(lp, value > 0)
 
     @property
     def mean(self):
@@ -464,10 +474,11 @@ class Exponential(Distribution):
 
     def log_prob(self, value):
         value = _value(value)
-        return -value / self.scale - self.scale.log()
+        v = value.clip(0.0, None)
+        return _mask_support(-v / self.scale - self.scale.log(), value >= 0)
 
     def cdf(self, value):
-        return 1 - (-_value(value) / self.scale).exp()
+        return (1 - (-_value(value) / self.scale).exp()).clip(0.0, None)
 
     def icdf(self, value):
         return -self.scale * (1 - _value(value)).log()
@@ -517,9 +528,11 @@ class Gamma(Distribution):
         from ... import ndarray as nd
 
         value = _value(value)
+        v = value.clip(_EPS, None)
         a = self.shape
-        return ((a - 1) * value.log() - value / self.scale
-                - nd.gammaln(a) - a * self.scale.log())
+        lp = ((a - 1) * v.log() - v / self.scale
+              - nd.gammaln(a) - a * self.scale.log())
+        return _mask_support(lp, value > 0)
 
     @property
     def mean(self):
@@ -570,10 +583,12 @@ class Beta(Distribution):
         from ... import ndarray as nd
 
         value = _value(value)
+        v = value.clip(_EPS, 1.0 - 1e-7)
         lbeta = (nd.gammaln(self.alpha) + nd.gammaln(self.beta)
                  - nd.gammaln(self.alpha + self.beta))
-        return ((self.alpha - 1) * value.log()
-                + (self.beta - 1) * (1 - value).log() - lbeta)
+        lp = ((self.alpha - 1) * v.log()
+              + (self.beta - 1) * (1 - v).log() - lbeta)
+        return _mask_support(lp, nd.logical_and(value >= 0, value <= 1))
 
     @property
     def mean(self):
@@ -599,6 +614,11 @@ class Chi2(Gamma):
     def __init__(self, df, **kwargs):
         self.df = _wrap(df)
         super().__init__(shape=self.df / 2, scale=2.0, **kwargs)
+
+    def broadcast_to(self, batch_shape):
+        # rebuild: the generic path would broadcast df but leave the
+        # derived Gamma shape/scale parameters at their original shapes
+        return Chi2(self.df.broadcast_to(tuple(batch_shape)))
 
 
 class StudentT(Distribution):
@@ -766,12 +786,14 @@ class Weibull(Distribution):
     def log_prob(self, value):
         value = _value(value)
         k, lam = self.concentration, self.scale
-        z = value / lam
-        return (k.log() - lam.log() + (k - 1) * z.log() - z ** k)
+        z = (value / lam).clip(_EPS, None)
+        lp = (k.log() - lam.log() + (k - 1) * z.log() - z ** k)
+        return _mask_support(lp, value > 0)
 
     def cdf(self, value):
         z = _value(value) / self.scale
-        return 1 - (-(z ** self.concentration)).exp()
+        return (1 - (-(z.clip(0.0, None)
+                       ** self.concentration)).exp()).clip(0.0, None)
 
     @property
     def mean(self):
@@ -801,12 +823,19 @@ class Pareto(Distribution):
         return self.scale * (u ** (-1.0 / self.alpha))
 
     def log_prob(self, value):
+        from ... import ndarray as nd
+
         value = _value(value)
-        return (self.alpha.log() + self.alpha * self.scale.log()
-                - (self.alpha + 1) * value.log())
+        v = nd.maximum(value, self.scale)
+        lp = (self.alpha.log() + self.alpha * self.scale.log()
+              - (self.alpha + 1) * v.log())
+        return _mask_support(lp, value >= self.scale)
 
     def cdf(self, value):
-        return 1 - (self.scale / _value(value)) ** self.alpha
+        from ... import ndarray as nd
+
+        v = nd.maximum(_value(value), self.scale)
+        return 1 - (self.scale / v) ** self.alpha
 
     @property
     def mean(self):
@@ -926,8 +955,9 @@ class Geometric(Distribution):
     def log_prob(self, value):
         value = _value(value)
         p = self.prob
-        return value * (1 - p).clip(_EPS, 1.0).log() + p.clip(
-            _EPS, 1.0).log()
+        lp = (value.clip(0.0, None) * (1 - p).clip(_EPS, 1.0).log()
+              + p.clip(_EPS, 1.0).log())
+        return _mask_support(lp, value >= 0)
 
     @property
     def mean(self):
@@ -968,8 +998,9 @@ class Poisson(Distribution):
         from ... import ndarray as nd
 
         value = _value(value)
-        return (value * self.rate.log() - self.rate
-                - nd.gammaln(value + 1))
+        v = value.clip(0.0, None)
+        lp = v * self.rate.log() - self.rate - nd.gammaln(v + 1)
+        return _mask_support(lp, value >= 0)
 
     @property
     def mean(self):

@@ -224,6 +224,7 @@ def load(fname):
 # submodules / namespaces
 from .. import random  # noqa: E402  (mx.nd.random mirror)
 from . import sparse  # noqa: E402
+from . import contrib  # noqa: E402
 
 __all__ = ["NDArray", "waitall", "array", "zeros", "ones", "full", "empty",
            "arange", "linspace", "eye", "save", "load", "concatenate",

@@ -1,0 +1,369 @@
+"""``mx.nd.contrib`` — control-flow operators (and contrib helpers).
+
+Reference: src/operator/control_flow.cc:1096,1157,1218 — ``_foreach``,
+``_while_loop``, ``_cond`` stateful ops executing subgraph attributes, the
+reference's mechanism for RNN-style loops over symbolic subgraphs, exposed
+in python as ``mx.nd.contrib.foreach/while_loop/cond``.
+
+TPU-native redesign: the subgraph machinery collapses into XLA structured
+control flow — ``foreach`` is ``lax.scan`` (one compiled body, static trip
+count, differentiable), ``while_loop`` is a ``lax.scan`` of at most
+``max_iterations`` steps with a done-mask (keeps reverse-mode autodiff and
+static shapes, which raw ``lax.while_loop`` would lose), and ``cond``
+evaluates eagerly when the predicate is concrete (the reference's
+imperative path) or lowers to ``lax.cond`` under a trace.  The python body
+functions run on NDArray-wrapped tracers, so every framework op works
+unchanged inside a loop body.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from ..ops.registry import apply_op
+from .ndarray import NDArray
+
+__all__ = ["foreach", "while_loop", "cond", "isfinite", "isnan", "isinf",
+           "arange_like", "index_copy", "index_array", "getnnz",
+           "boolean_mask"]
+
+
+def _flatten(x, out):
+    """Flatten nested lists/tuples of NDArray into out; return spec."""
+    if isinstance(x, NDArray):
+        out.append(x)
+        return "_"
+    if isinstance(x, (list, tuple)):
+        return [_flatten(v, out) for v in x]
+    if x is None:
+        return None
+    raise MXNetError("control flow states must be NDArray or nested "
+                     "lists, got %r" % (type(x),))
+
+
+def _unflatten(spec, it, wrap):
+    if spec == "_":
+        return wrap(next(it))
+    if spec is None:
+        return None
+    return [_unflatten(s, it, wrap) for s in spec]
+
+
+def _is_traced(arrays):
+    return any(isinstance(a._data, jax.core.Tracer) for a in arrays)
+
+
+def _recording():
+    from ..base import thread_state
+
+    return thread_state.is_recording
+
+
+def foreach(body, data, init_states, name="foreach"):
+    """Run ``body`` over axis 0 of ``data``, threading states.
+
+    ``body(data_slice, states) -> (outputs, new_states)``.
+    Returns ``(outputs, final_states)`` with per-step outputs stacked on
+    axis 0 (reference foreach semantics, control_flow.cc:1096).
+
+    Execution strategy mirrors the reference: under eager autograd
+    recording the loop runs imperatively step-by-step so gradients flow to
+    every array the body touches (including closure captures / cell
+    parameters — reference imperative mode); under a jit/hybridize trace
+    or plain inference it lowers to one ``lax.scan``.
+    """
+    flat_data = []
+    data_spec = _flatten(data, flat_data)
+    flat_states = []
+    state_spec = _flatten(init_states, flat_states)
+    if not flat_data:
+        raise MXNetError("foreach needs at least one data array")
+    n_data = len(flat_data)
+    length = flat_data[0].shape[0]
+    for d in flat_data:
+        if d.shape[0] != length:
+            raise MXNetError("foreach data arrays must share axis-0 length")
+
+    if _recording() and not _is_traced(flat_data + flat_states):
+        # eager tape path: per-op recording, full closure-capture gradients
+        from . import stack as _stack
+
+        states = init_states
+        flat_outs = None
+        out_spec = None
+        for t in range(length):
+            x_t = _unflatten(data_spec, iter([d[t] for d in flat_data]),
+                             lambda x: x)
+            outs, states = body(x_t, states)
+            step_flat = []
+            out_spec = _flatten(outs, step_flat)
+            if flat_outs is None:
+                flat_outs = [[] for _ in step_flat]
+            for i, o in enumerate(step_flat):
+                flat_outs[i].append(o)
+        stacked = [_stack(*os, axis=0) for os in flat_outs]
+        return (_unflatten(out_spec, iter(stacked), lambda x: x), states)
+
+    if not _is_traced(flat_data + flat_states):
+        # pre-flight one eager step: resolves deferred parameter shapes
+        # (Gluon cells) OUTSIDE the scan trace — otherwise their init would
+        # be staged into the trace and leak tracers into Parameter._data
+        from .. import autograd
+
+        with autograd.pause():
+            body(_unflatten(data_spec, iter([d[0] for d in flat_data]),
+                            lambda x: x), init_states)
+
+    def pure(*arrs):
+        xs = tuple(a for a in arrs[:n_data])
+        carry0 = tuple(a for a in arrs[n_data:])
+
+        def step(carry, x):
+            x_nd = _unflatten(data_spec, iter(x), NDArray)
+            s_nd = _unflatten(state_spec, iter(carry), NDArray)
+            outs, new_states = body(x_nd, s_nd)
+            flat_out = []
+            _flatten(outs, flat_out)
+            flat_new = []
+            new_spec = _flatten(new_states, flat_new)
+            if len(flat_new) != len(carry):
+                raise MXNetError("foreach body changed the number of states")
+            pure.out_spec = _flatten(outs, [])
+            pure.new_spec = new_spec
+            return (tuple(s._data for s in flat_new),
+                    tuple(o._data for o in flat_out))
+
+        carry, ys = lax.scan(step, carry0, xs)
+        return tuple(ys) + tuple(carry)
+
+    pure.__name__ = name
+    pure.out_spec = None
+    res = apply_op(pure, *flat_data, *flat_states)
+    if not isinstance(res, tuple):
+        res = (res,)
+    n_out = len(res) - len(flat_states)
+    outs = _unflatten(pure.out_spec, iter(res[:n_out]), lambda x: x)
+    states = _unflatten(state_spec, iter(res[n_out:]), lambda x: x)
+    return outs, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None, name="while_loop"):
+    """Reference while_loop (control_flow.cc:1157): run ``func`` while
+    ``cond(*loop_vars)`` holds, up to ``max_iterations``.
+
+    Returns ``(outputs, states)``: per-step outputs stacked over a
+    ``max_iterations``-long axis 0 (steps after termination hold zeros —
+    the reference pads undefined memory; zeros keep gradients clean), and
+    the final loop states.  Implemented as a masked ``lax.scan`` so the
+    trip count is static (TPU/XLA-friendly) and reverse-mode autodiff
+    works through the loop.
+    """
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations")
+    max_iterations = int(max_iterations)
+    flat_vars = []
+    var_spec = _flatten(loop_vars, flat_vars)
+
+    if _recording() and not _is_traced(flat_vars):
+        # eager tape path (reference imperative while_loop): python loop,
+        # gradients flow to closure captures; outputs zero-padded to
+        # max_iterations for shape parity with the compiled path.
+        from . import stack as _stack, zeros_like as _zeros_like
+
+        cur = loop_vars
+        step_outs = []
+        out_spec = None
+        steps = 0
+        while steps < max_iterations:
+            pred = cond(*cur) if isinstance(cur, list) else cond(cur)
+            if not bool(jnp.asarray(
+                    pred._data if isinstance(pred, NDArray) else pred
+                    ).reshape(())):
+                break
+            outs, cur = func(*cur) if isinstance(cur, list) else func(cur)
+            flat_out = []
+            out_spec = _flatten(outs, flat_out)
+            step_outs.append(flat_out)
+            steps += 1
+        if out_spec is None:
+            # zero live iterations: probe func once (no tape) to learn the
+            # output template, then emit all-zero padded outputs — matching
+            # the compiled path's semantics for an initially-false condition
+            from .. import autograd
+
+            with autograd.pause():
+                outs, _ = func(*cur) if isinstance(cur, list) else func(cur)
+            flat_out = []
+            out_spec = _flatten(outs, flat_out)
+            step_outs.append([_zeros_like(o) for o in flat_out])
+            steps = 1  # one all-zero row; padding below fills the rest
+        cols = list(zip(*step_outs))
+        stacked = []
+        for col in cols:
+            pads = [_zeros_like(col[0])] * (max_iterations - steps)
+            stacked.append(_stack(*(list(col) + pads), axis=0))
+        return (_unflatten(out_spec, iter(stacked), lambda x: x), cur)
+
+    if not _is_traced(flat_vars):
+        # pre-flight (see foreach): resolve deferred params outside the trace
+        from .. import autograd
+
+        with autograd.pause():
+            cur0 = _unflatten(var_spec, iter(flat_vars), lambda x: x)
+            func(*cur0) if isinstance(cur0, list) else func(cur0)
+
+    def pure(*arrs):
+        meta = {"out_spec": None, "n_out": 0}
+
+        def step(carry, _):
+            done, cur = carry
+            cur_nd = _unflatten(var_spec, iter(cur), NDArray)
+            pred = cond(*cur_nd) if isinstance(cur_nd, list) else cond(cur_nd)
+            pred_val = jnp.logical_and(
+                jnp.asarray(pred._data if isinstance(pred, NDArray)
+                            else pred).reshape(()).astype(bool),
+                jnp.logical_not(done))
+            step_out = func(*cur_nd) if isinstance(cur_nd, list) \
+                else func(cur_nd)
+            if not (isinstance(step_out, tuple) and len(step_out) == 2):
+                raise MXNetError("while_loop func must return "
+                                 "(outputs, new_loop_vars)")
+            outs, new_vars = step_out
+            flat_out = []
+            meta["out_spec"] = _flatten(outs, flat_out)
+            meta["n_out"] = len(flat_out)
+            flat_new = []
+            _flatten(new_vars, flat_new)
+            if len(flat_new) != len(cur):
+                raise MXNetError("while_loop func changed loop var count")
+            # keep old vars where the loop has terminated
+            kept = tuple(jnp.where(pred_val, n._data, c)
+                         for n, c in zip(flat_new, cur))
+            emitted = tuple(jnp.where(pred_val, o._data,
+                                      jnp.zeros_like(o._data))
+                            for o in flat_out)
+            new_done = jnp.logical_or(done, jnp.logical_not(pred_val))
+            return (new_done, kept), emitted + (pred_val,)
+
+        init = (jnp.asarray(False), tuple(arrs))
+        (done, final), ys = lax.scan(step, init,
+                                     jnp.arange(max_iterations))
+        pure.out_spec = meta["out_spec"]
+        *outs, steps_mask = ys
+        n_steps = steps_mask.sum().astype(jnp.int32)
+        return tuple(outs) + tuple(final) + (n_steps,)
+
+    pure.__name__ = name
+    pure.out_spec = None
+    res = apply_op(pure, *flat_vars)
+    if not isinstance(res, tuple):
+        res = (res,)
+    res, _n_steps = res[:-1], res[-1]
+    n_out = len(res) - len(flat_vars)
+    outs = _unflatten(pure.out_spec, iter(res[:n_out]), lambda x: x)
+    states = _unflatten(var_spec, iter(res[n_out:]), lambda x: x)
+    return outs, states
+
+
+def cond(pred, then_func, else_func, name="cond"):
+    """Reference cond (control_flow.cc:1218).  Imperative path: evaluate the
+    predicate and run one branch eagerly (what the reference's imperative
+    mode does) — both branches stay differentiable.  Under a jit/hybridize
+    trace the predicate is abstract, so lower to ``lax.cond``."""
+    p = pred._data if isinstance(pred, NDArray) else pred
+    if isinstance(p, jax.core.Tracer):
+        def _then(_):
+            out = then_func()
+            flat = []
+            cond.spec = _flatten(out, flat)
+            return tuple(o._data for o in flat)
+
+        def _else(_):
+            out = else_func()
+            flat = []
+            _flatten(out, flat)
+            return tuple(o._data for o in flat)
+
+        cond.spec = None
+        res = lax.cond(jnp.asarray(p).reshape(()).astype(bool),
+                       _then, _else, None)
+        return _unflatten(cond.spec, (NDArray(r) for r in res),
+                          lambda x: x)
+    taken = bool(jnp.asarray(p).reshape(()))
+    return then_func() if taken else else_func()
+
+
+# ---- small contrib helpers (reference contrib op surface) -----------------
+
+def isfinite(data):
+    return apply_op(lambda x: jnp.isfinite(x).astype(jnp.float32), data)
+
+
+def isnan(data):
+    return apply_op(lambda x: jnp.isnan(x).astype(jnp.float32), data)
+
+
+def isinf(data):
+    return apply_op(lambda x: jnp.isinf(x).astype(jnp.float32), data)
+
+
+def arange_like(data, start=0.0, step=1.0, axis=None):
+    """reference _contrib_arange_like"""
+
+    def fn(x):
+        if axis is None:
+            n = x.size
+            return (start + step * jnp.arange(n)).reshape(x.shape)
+        return start + step * jnp.arange(x.shape[axis])
+
+    fn.__name__ = "arange_like"
+    return apply_op(fn, data)
+
+
+def index_copy(old_tensor, index_vector, new_tensor):
+    """reference _contrib_index_copy"""
+
+    def fn(old, idx, new):
+        return old.at[idx.astype(jnp.int32)].set(new)
+
+    fn.__name__ = "index_copy"
+    return apply_op(fn, old_tensor, index_vector, new_tensor)
+
+
+def index_array(data, axes=None):
+    """reference _contrib_index_array: element coordinates."""
+
+    def fn(x):
+        idx = jnp.stack(jnp.meshgrid(*[jnp.arange(s) for s in x.shape],
+                                     indexing="ij"), axis=-1)
+        if axes is not None:
+            idx = idx[..., list(axes)]
+        return idx.astype(jnp.int32)
+
+    fn.__name__ = "index_array"
+    return apply_op(fn, data)
+
+
+def getnnz(data, axis=None):
+    def fn(x):
+        return (x != 0).sum(axis=axis).astype(jnp.int32)
+
+    fn.__name__ = "getnnz"
+    return apply_op(fn, data)
+
+
+def boolean_mask(data, index, axis=0):
+    """reference _contrib_boolean_mask.  Note: output size is
+    data-dependent; eager-only (not jit-traceable), like the reference's
+    dynamic-shape ops.  The mask is a static selector; gradients flow to
+    ``data`` (scatter of zeros into dropped rows)."""
+    i = index._data if isinstance(index, NDArray) else index
+    keep = jnp.asarray(i).astype(bool)
+
+    def fn(x):
+        return jnp.compress(keep, x, axis=axis)
+
+    fn.__name__ = "boolean_mask"
+    return apply_op(fn, data)

@@ -1,0 +1,236 @@
+"""Control-flow op tests (reference tests/python/unittest/
+test_contrib_control_flow.py strategy: foreach vs python loop, while_loop
+semantics + max_iterations padding, cond branches, gradients through loops)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import contrib
+
+
+def setup_function(_f):
+    mx.random.seed(0)
+
+
+def test_foreach_cumsum():
+    data = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    init = mx.nd.zeros((3,))
+
+    def body(x, s):
+        new_s = s + x
+        return new_s, new_s
+
+    outs, final = contrib.foreach(body, data, init)
+    want = np.cumsum(np.arange(12, dtype=np.float32).reshape(4, 3), axis=0)
+    np.testing.assert_allclose(outs.asnumpy(), want, rtol=1e-6)
+    np.testing.assert_allclose(final.asnumpy(), want[-1], rtol=1e-6)
+
+
+def test_foreach_multiple_states_and_outputs():
+    data = mx.nd.array(np.ones((5, 2), np.float32))
+    s1 = mx.nd.zeros((2,))
+    s2 = mx.nd.ones((2,))
+
+    def body(x, states):
+        a, b = states
+        return [a + x, b * 2], [a + x, b * 2]
+
+    outs, states = contrib.foreach(body, data, [s1, s2])
+    assert outs[0].shape == (5, 2) and outs[1].shape == (5, 2)
+    np.testing.assert_allclose(states[0].asnumpy(), 5 * np.ones(2))
+    np.testing.assert_allclose(states[1].asnumpy(), 32 * np.ones(2))
+
+
+def test_foreach_gradient():
+    """Gradient through scan: d/dw sum(cumprod-ish recurrence)."""
+    data = mx.nd.array(np.ones((3, 2), np.float32))
+    w = mx.nd.array(np.array([2.0, 3.0], np.float32))
+    w.attach_grad()
+    init = mx.nd.ones((2,))
+
+    def body(x, s):
+        new_s = s * w + x
+        return new_s, new_s
+
+    with mx.autograd.record():
+        outs, final = contrib.foreach(body, data, init)
+        loss = outs.sum()
+    loss.backward()
+    # analytic: s0=1; s1=w+1; s2=w^2+w+1; s3=w^3+w^2+w+1
+    # sum = s1+s2+s3; d/dw = (1) + (2w+1) + (3w^2+2w+1)
+    wv = np.array([2.0, 3.0])
+    want = 1 + (2 * wv + 1) + (3 * wv ** 2 + 2 * wv + 1)
+    np.testing.assert_allclose(w.grad.asnumpy(), want, rtol=1e-5)
+
+
+def test_foreach_rnn_style():
+    """The reference's headline use: run an RNN cell over time steps."""
+    from mxnet_tpu.gluon import rnn
+
+    cell = rnn.RNNCell(4)
+    cell.initialize()
+    seq = mx.nd.random.uniform(shape=(6, 2, 3))  # (T, N, C)
+    h0 = mx.nd.zeros((2, 4))
+
+    def body(x, states):
+        out, new_states = cell(x, states)
+        return out, new_states
+
+    outs, final = contrib.foreach(body, seq, [h0])
+    assert outs.shape == (6, 2, 4)
+    # parity vs python loop
+    states = [h0]
+    got = []
+    for t in range(6):
+        o, states = cell(seq[t], states)
+        got.append(o.asnumpy())
+    np.testing.assert_allclose(outs.asnumpy(), np.stack(got), rtol=2e-5,
+                               atol=1e-5)
+
+
+def test_while_loop():
+    def cond_fn(i, s):
+        return i < 5
+
+    def func(i, s):
+        return i * 2, [i + 1, s + i]
+
+    outs, states = contrib.while_loop(
+        cond_fn, func,
+        [mx.nd.array(np.array([0.0], np.float32)),
+         mx.nd.array(np.array([0.0], np.float32))],
+        max_iterations=8)
+    # 5 live steps emit i*2 = 0,2,4,6,8; remaining 3 padded with zeros
+    np.testing.assert_allclose(
+        outs.asnumpy().ravel(),
+        [0.0, 2.0, 4.0, 6.0, 8.0, 0.0, 0.0, 0.0])
+    np.testing.assert_allclose(states[0].asnumpy(), [5.0])
+    np.testing.assert_allclose(states[1].asnumpy(), [10.0])
+
+
+def test_while_loop_gradient():
+    x = mx.nd.array(np.array([1.5], np.float32))
+    x.attach_grad()
+
+    def cond_fn(v):
+        return (v < 10.0).sum() > 0
+
+    def func(v):
+        return v, [v * 2]
+
+    with mx.autograd.record():
+        outs, states = contrib.while_loop(cond_fn, func, [x],
+                                          max_iterations=6)
+        loss = states[0].sum()
+    loss.backward()
+    # 1.5 -> 3 -> 6 -> 12 (3 doublings) => d final/dx = 8
+    np.testing.assert_allclose(states[0].asnumpy(), [12.0])
+    np.testing.assert_allclose(x.grad.asnumpy(), [8.0], rtol=1e-6)
+
+
+def test_cond_eager():
+    a = mx.nd.array(np.array([1.0], np.float32))
+    b = mx.nd.array(np.array([2.0], np.float32))
+    out = contrib.cond((a < b).sum() > 0, lambda: a + b, lambda: a - b)
+    np.testing.assert_allclose(out.asnumpy(), [3.0])
+    out2 = contrib.cond((a > b).sum() > 0, lambda: a + b, lambda: a - b)
+    np.testing.assert_allclose(out2.asnumpy(), [-1.0])
+
+
+def test_cond_gradient():
+    a = mx.nd.array(np.array([3.0], np.float32))
+    a.attach_grad()
+    with mx.autograd.record():
+        out = contrib.cond(a.sum() > 0, lambda: a * a, lambda: a * 2)
+    out.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [6.0])
+
+
+def test_contrib_helpers():
+    x = mx.nd.array(np.array([1.0, np.inf, np.nan, -2.0], np.float32))
+    np.testing.assert_allclose(contrib.isfinite(x).asnumpy(), [1, 0, 0, 1])
+    np.testing.assert_allclose(contrib.isnan(x).asnumpy(), [0, 0, 1, 0])
+    np.testing.assert_allclose(contrib.isinf(x).asnumpy(), [0, 1, 0, 0])
+
+    d = mx.nd.zeros((2, 3))
+    al = contrib.arange_like(d)
+    assert al.shape == (2, 3)
+    np.testing.assert_allclose(al.asnumpy().ravel(), np.arange(6))
+    al2 = contrib.arange_like(d, start=1.0, axis=1)
+    np.testing.assert_allclose(al2.asnumpy(), [1, 2, 3])
+
+    old = mx.nd.zeros((4, 2))
+    new = mx.nd.ones((2, 2))
+    idx = mx.nd.array(np.array([1, 3], np.float32))
+    out = contrib.index_copy(old, idx, new)
+    np.testing.assert_allclose(out.asnumpy()[[1, 3]], np.ones((2, 2)))
+    np.testing.assert_allclose(out.asnumpy()[[0, 2]], np.zeros((2, 2)))
+
+    ia = contrib.index_array(mx.nd.zeros((2, 2)))
+    assert ia.shape == (2, 2, 2)
+
+    nz = contrib.getnnz(mx.nd.array(np.array([[1.0, 0.0], [2.0, 3.0]],
+                                             np.float32)))
+    assert int(nz.asnumpy()) == 3
+
+    bm = contrib.boolean_mask(
+        mx.nd.array(np.arange(8, dtype=np.float32).reshape(4, 2)),
+        mx.nd.array(np.array([1, 0, 1, 0], np.float32)))
+    np.testing.assert_allclose(bm.asnumpy(), [[0, 1], [4, 5]])
+
+
+def test_boolean_mask_gradient():
+    """boolean_mask must be differentiable (regression)."""
+    x = mx.nd.array(np.arange(8, dtype=np.float32).reshape(4, 2))
+    x.attach_grad()
+    mask = mx.nd.array(np.array([1, 0, 1, 0], np.float32))
+    with mx.autograd.record():
+        out = contrib.boolean_mask(x, mask)
+        loss = out.sum()
+    loss.backward()
+    want = np.array([[1, 1], [0, 0], [1, 1], [0, 0]], np.float32)
+    np.testing.assert_allclose(x.grad.asnumpy(), want)
+
+
+def test_while_loop_zero_iterations_recording():
+    """cond false on entry inside record() must not crash (regression)."""
+    v = mx.nd.array(np.array([5.0], np.float32))
+    v.attach_grad()
+    with mx.autograd.record():
+        outs, states = contrib.while_loop(
+            lambda a: (a < 0.0).sum() > 0,
+            lambda a: (a, [a * 2]), [v], max_iterations=3)
+    assert outs.shape == (3, 1)
+    np.testing.assert_allclose(outs.asnumpy(), np.zeros((3, 1)))
+    np.testing.assert_allclose(states[0].asnumpy(), [5.0])
+
+
+def test_foreach_inside_hybridize():
+    """foreach must compile inside a hybridized block (scan in the jitted
+    program)."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    class Scanner(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.proj = nn.Dense(4, flatten=False)
+
+        def forward(self, seq):
+            h = self.proj(seq)  # (T, N, 4)
+
+            def body(x, s):
+                new_s = (s + x).tanh()
+                return new_s, new_s
+
+            outs, _ = contrib.foreach(body, h,
+                                      mx.nd.zeros((h.shape[1], 4)))
+            return outs
+
+    net = Scanner()
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(5, 2, 3))
+    eager = net(x)
+    net.hybridize()
+    hybrid = net(x)
+    np.testing.assert_allclose(eager.asnumpy(), hybrid.asnumpy(), rtol=2e-5,
+                               atol=1e-5)

@@ -396,6 +396,35 @@ def test_half_distributions_support_mask():
     assert float(_np(mgp.Pareto(0.5, 1.0).mean)) == np.inf
 
 
+def test_support_masking():
+    """log_prob is -inf outside the support (regression)."""
+    neg = mx.nd.array(np.array([-1.0], np.float32))
+    assert _np(mgp.Exponential(1.0).log_prob(neg))[0] == -np.inf
+    assert _np(mgp.Gamma(2.0, 1.0).log_prob(neg))[0] == -np.inf
+    assert _np(mgp.Weibull(1.5, 1.0).log_prob(neg))[0] == -np.inf
+    assert _np(mgp.Geometric(prob=0.4).log_prob(neg))[0] == -np.inf
+    assert _np(mgp.Poisson(2.0).log_prob(neg))[0] == -np.inf
+    assert _np(mgp.LogNormal(0.0, 1.0).log_prob(neg))[0] == -np.inf
+    below_scale = mx.nd.array(np.array([0.5], np.float32))
+    assert _np(mgp.Pareto(1.0, 1.0).log_prob(below_scale))[0] == -np.inf
+    out_of_unit = mx.nd.array(np.array([1.5], np.float32))
+    assert _np(mgp.Beta(2.0, 2.0).log_prob(out_of_unit))[0] == -np.inf
+    # in-support gradient stays finite after masking
+    a = mx.nd.array(np.array([2.0], np.float32))
+    a.attach_grad()
+    with mx.autograd.record():
+        lp = mgp.Gamma(a, 1.0).log_prob(
+            mx.nd.array(np.array([1.5], np.float32)))
+    lp.backward()
+    assert np.isfinite(_np(a.grad)).all()
+
+
+def test_chi2_broadcast_to():
+    b = mgp.Chi2(np.array([4.0], np.float32)).broadcast_to((3,))
+    assert b.batch_shape == (3,)
+    assert b.sample().shape == (3,)
+
+
 def test_validate_args():
     with pytest.raises(Exception):
         mgp.Normal(0.0, -1.0, validate_args=True)
