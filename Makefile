@@ -1,0 +1,24 @@
+# Native host runtime (src/native): recordio, threaded dependency engine,
+# pooled allocator, libjpeg image pipeline.  `make native` builds the
+# shared library the mxnet_tpu.native ctypes bindings load (the bindings
+# also build it on demand at import).
+CXX ?= g++
+CXXFLAGS ?= -std=c++17 -O2 -fPIC -Wall -pthread
+LDLIBS ?= -ljpeg -lz
+
+SRCS := $(wildcard src/native/*.cc)
+SO := build/libmxtpu_native.so
+
+.PHONY: native test clean
+
+native: $(SO)
+
+$(SO): $(SRCS) $(wildcard src/native/*.h)
+	@mkdir -p build
+	$(CXX) $(CXXFLAGS) -shared $(SRCS) -o $@ $(LDLIBS)
+
+test: native
+	python -m pytest tests/ -q
+
+clean:
+	rm -rf build

@@ -35,17 +35,35 @@ def imread(filename, flag=1, to_rgb=True):
 def imdecode(buf, flag=1, to_rgb=True):
     import io
 
+    from . import native
+
+    buf_bytes = bytes(buf)
+    if buf_bytes[:2] == b"\xff\xd8" and native.available():
+        rgb = native.decode_jpeg(buf_bytes)
+        if flag == 0:  # grayscale request: BT.601 luma, keep (H, W, 1)
+            gray = (0.299 * rgb[:, :, 0] + 0.587 * rgb[:, :, 1]
+                    + 0.114 * rgb[:, :, 2]).astype(_np.uint8)
+            return nd.array(gray[:, :, None], dtype="uint8")
+        return nd.array(rgb, dtype="uint8")
     try:
         from PIL import Image
 
-        img = Image.open(io.BytesIO(buf))
+        img = Image.open(io.BytesIO(buf_bytes))
         img = img.convert("RGB" if flag else "L")
-        return nd.array(_np.asarray(img), dtype="uint8")
+        arr = _np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return nd.array(arr, dtype="uint8")
     except Exception:
-        return nd.array(_np.load(io.BytesIO(buf)), dtype="uint8")
+        return nd.array(_np.load(io.BytesIO(buf_bytes)), dtype="uint8")
 
 
 def imresize(src, w, h, interp=1):
+    from . import native
+
+    if (native.available() and src.dtype == _np.uint8):
+        return nd.array(native.resize_bilinear(src.asnumpy(), h, w),
+                        dtype="uint8")
     import jax
 
     data = src._data.astype("float32")

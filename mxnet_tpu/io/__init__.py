@@ -263,31 +263,64 @@ class MNISTIter(NDArrayIter):
 
 class ImageRecordIter(DataIter):
     """RecordIO image pipeline (reference src/io/iter_image_recordio_2.cc:
-    887 — decode thread pool + augment + batch + prefetch).  Python/thread
-    version; the native C++ pipeline is tracked in native/."""
+    887 — decode thread pool + augment + batch + prefetch).
+
+    Uses the native C++ pipeline (src/native/dataloader.cc: pread record
+    access + libjpeg decode workers + double-buffered float32-NCHW batch
+    staging) when the native runtime is available; falls back to the
+    python Gluon DataLoader path otherwise."""
 
     def __init__(self, path_imgrec, data_shape, batch_size=1, shuffle=False,
                  label_width=1, mean_r=0, mean_g=0, mean_b=0, scale=1.0,
                  rand_crop=False, rand_mirror=False, preprocess_threads=4,
-                 **kwargs):
+                 seed=0, **kwargs):
         super().__init__(batch_size)
-        from ..gluon.data.vision.datasets import ImageRecordDataset
-        from ..gluon.data import DataLoader
-
-        self._dataset = ImageRecordDataset(path_imgrec)
         self._shape = tuple(data_shape)
-        self._scale = scale
-        self._mean = _np.array([mean_r, mean_g, mean_b],
-                               dtype=_np.float32).reshape(3, 1, 1)
-        self._loader = DataLoader(self._dataset, batch_size=batch_size,
-                                  shuffle=shuffle, last_batch="discard",
-                                  num_workers=preprocess_threads)
+        self._native = None
+        from .. import native
+
+        if native.available():
+            try:
+                self._native = native.ImageRecordLoader(
+                    path_imgrec, batch_size=batch_size,
+                    data_shape=self._shape, label_width=label_width,
+                    num_workers=preprocess_threads, shuffle=shuffle,
+                    seed=seed, rand_mirror=rand_mirror, rand_crop=rand_crop,
+                    mean=(mean_r, mean_g, mean_b), scale=scale)
+            except Exception:
+                self._native = None
+        if self._native is None:
+            from ..gluon.data.vision.datasets import ImageRecordDataset
+            from ..gluon.data import DataLoader
+
+            self._dataset = ImageRecordDataset(path_imgrec)
+            self._scale = scale
+            self._mean = _np.array([mean_r, mean_g, mean_b],
+                                   dtype=_np.float32).reshape(3, 1, 1)
+            self._loader = DataLoader(self._dataset, batch_size=batch_size,
+                                      shuffle=shuffle, last_batch="discard",
+                                      num_workers=preprocess_threads)
         self._it = None
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self._shape)]
 
     def reset(self):
         self._it = None
+        if self._native is not None:
+            self._native.reset()
 
     def next(self):
+        if self._native is not None:
+            out = self._native.next()
+            if out is None:
+                raise StopIteration
+            data, label, n = out
+            return DataBatch([nd.array(data)],
+                             [nd.array(label[:, 0] if label.shape[1] == 1
+                                       else label)],
+                             pad=self.batch_size - n)
         if self._it is None:
             self._it = iter(self._loader)
         try:

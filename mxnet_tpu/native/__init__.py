@@ -1,0 +1,426 @@
+"""ctypes bindings for the native host runtime (src/native/*.cc).
+
+Reference architecture note: the reference ships its runtime as libmxnet.so
+reached through a ctypes C API (python/mxnet/base.py _LIB).  Here the
+DEVICE runtime is XLA/PJRT; the native library covers the HOST runtime —
+RecordIO, the threaded dependency engine, the pooled allocator and the
+image/data pipeline (SURVEY.md §2.1 engine/storage/IO rows).
+
+The shared library is built on demand with g++ (cached next to the
+sources); every consumer degrades to the pure-python path when the
+toolchain or library is unavailable (`native.available() -> False`), so
+the framework stays importable anywhere.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+
+__all__ = ["available", "lib", "NativeEngine", "MemoryPool",
+           "RecordWriter", "RecordReader"]
+
+_here = os.path.dirname(os.path.abspath(__file__))
+_repo = os.path.dirname(os.path.dirname(_here))
+_src_dir = os.path.join(_repo, "src", "native")
+_build_dir = os.path.join(_repo, "build")
+_so_path = os.path.join(_build_dir, "libmxtpu_native.so")
+
+_lib = None
+_lock = threading.Lock()
+_tried = False
+
+
+def _needs_build():
+    if not os.path.exists(_so_path):
+        return True
+    so_mtime = os.path.getmtime(_so_path)
+    for fn in os.listdir(_src_dir):
+        if fn.endswith((".cc", ".h")):
+            if os.path.getmtime(os.path.join(_src_dir, fn)) > so_mtime:
+                return True
+    return False
+
+
+def _build():
+    os.makedirs(_build_dir, exist_ok=True)
+    srcs = sorted(
+        os.path.join(_src_dir, f) for f in os.listdir(_src_dir)
+        if f.endswith(".cc"))
+    cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
+           "-Wall"] + srcs + ["-o", _so_path, "-ljpeg", "-lz"]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError("native build failed:\n%s" % proc.stderr[-4000:])
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("MXNET_TPU_NO_NATIVE"):
+            return None
+        try:
+            if _needs_build():
+                _build()
+            lib = ctypes.CDLL(_so_path)
+        except Exception as exc:  # toolchain missing, build error, ...
+            sys.stderr.write(
+                "mxnet_tpu: native runtime unavailable (%s); "
+                "using python fallbacks\n" % exc)
+            return None
+        _declare(lib)
+        _lib = lib
+        return _lib
+
+
+def _declare(lib):
+    c = ctypes
+    lib.MXTGetLastError.restype = c.c_char_p
+    # recordio
+    lib.MXTRecordWriterCreate.restype = c.c_void_p
+    lib.MXTRecordWriterCreate.argtypes = [c.c_char_p]
+    lib.MXTRecordWriterWrite.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64]
+    lib.MXTRecordWriterTell.restype = c.c_int64
+    lib.MXTRecordWriterTell.argtypes = [c.c_void_p]
+    lib.MXTRecordWriterClose.argtypes = [c.c_void_p]
+    lib.MXTRecordReaderCreate.restype = c.c_void_p
+    lib.MXTRecordReaderCreate.argtypes = [c.c_char_p]
+    lib.MXTRecordReaderNext.restype = c.c_int64
+    lib.MXTRecordReaderNext.argtypes = [c.c_void_p,
+                                        c.POINTER(c.POINTER(c.c_uint8))]
+    lib.MXTRecordReaderSeek.argtypes = [c.c_void_p, c.c_int64]
+    lib.MXTRecordReaderTell.restype = c.c_int64
+    lib.MXTRecordReaderTell.argtypes = [c.c_void_p]
+    lib.MXTRecordReaderReadAt.restype = c.c_int64
+    lib.MXTRecordReaderReadAt.argtypes = [c.c_void_p, c.c_int64,
+                                          c.POINTER(c.c_uint8), c.c_uint64]
+    lib.MXTRecordReaderClose.argtypes = [c.c_void_p]
+    # pool
+    lib.MXTPoolCreate.restype = c.c_void_p
+    lib.MXTPoolCreate.argtypes = [c.c_uint64, c.c_uint64]
+    lib.MXTPoolAlloc.restype = c.c_void_p
+    lib.MXTPoolAlloc.argtypes = [c.c_void_p, c.c_uint64]
+    lib.MXTPoolFree.argtypes = [c.c_void_p, c.c_void_p, c.c_uint64]
+    lib.MXTPoolStats.argtypes = [c.c_void_p, c.POINTER(c.c_uint64)]
+    lib.MXTPoolRelease.argtypes = [c.c_void_p]
+    lib.MXTPoolDestroy.argtypes = [c.c_void_p]
+    # engine
+    lib.MXTEngineCreate.restype = c.c_void_p
+    lib.MXTEngineCreate.argtypes = [c.c_int]
+    lib.MXTEngineNewVar.restype = c.c_int64
+    lib.MXTEngineNewVar.argtypes = [c.c_void_p]
+    lib.MXTEnginePushAsync.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_void_p, c.POINTER(c.c_int64), c.c_int,
+        c.POINTER(c.c_int64), c.c_int, c.c_int]
+    lib.MXTEngineWaitForVar.argtypes = [c.c_void_p, c.c_int64]
+    lib.MXTEngineWaitAll.argtypes = [c.c_void_p]
+    lib.MXTEnginePending.restype = c.c_int64
+    lib.MXTEnginePending.argtypes = [c.c_void_p]
+    lib.MXTEngineDestroy.argtypes = [c.c_void_p]
+    # image (optional — present when built with libjpeg)
+    if hasattr(lib, "MXTDecodeJPEG"):
+        lib.MXTDecodeJPEG.restype = c.c_int
+        lib.MXTDecodeJPEG.argtypes = [
+            c.POINTER(c.c_uint8), c.c_uint64, c.POINTER(c.c_void_p),
+            c.POINTER(c.c_int), c.POINTER(c.c_int), c.POINTER(c.c_int)]
+        lib.MXTEncodeJPEG.restype = c.c_int
+        lib.MXTEncodeJPEG.argtypes = [
+            c.POINTER(c.c_uint8), c.c_int, c.c_int, c.c_int, c.c_int,
+            c.POINTER(c.c_void_p), c.POINTER(c.c_uint64)]
+        lib.MXTImageResizeBilinear.argtypes = [
+            c.POINTER(c.c_uint8), c.c_int, c.c_int, c.c_int,
+            c.POINTER(c.c_uint8), c.c_int, c.c_int]
+        lib.MXTBufFree.argtypes = [c.c_void_p]
+    if hasattr(lib, "MXTLoaderCreate"):
+        lib.MXTLoaderCreate.restype = c.c_void_p
+        lib.MXTLoaderCreate.argtypes = [
+            c.c_char_p, c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_int,
+            c.c_int, c.c_int, c.c_uint64, c.c_int, c.c_int,
+            c.POINTER(c.c_float), c.c_float]
+        lib.MXTLoaderNext.restype = c.c_int
+        lib.MXTLoaderNext.argtypes = [c.c_void_p, c.POINTER(c.c_float),
+                                      c.POINTER(c.c_float)]
+        lib.MXTLoaderReset.argtypes = [c.c_void_p]
+        lib.MXTLoaderDestroy.argtypes = [c.c_void_p]
+
+
+def lib():
+    return _load()
+
+
+def available():
+    return _load() is not None
+
+
+def _err():
+    return _load().MXTGetLastError().decode()
+
+
+class RecordWriter:
+    """Native sequential record writer (same framing as mx.recordio)."""
+
+    def __init__(self, path):
+        self._lib = _load()
+        self._h = self._lib.MXTRecordWriterCreate(path.encode())
+        if not self._h:
+            raise IOError(_err())
+
+    def write(self, buf):
+        if self._lib.MXTRecordWriterWrite(self._h, bytes(buf),
+                                          len(buf)) != 0:
+            raise IOError("record write failed")
+
+    def tell(self):
+        return self._lib.MXTRecordWriterTell(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.MXTRecordWriterClose(self._h)
+            self._h = None
+
+    __del__ = close
+
+
+class RecordReader:
+    """Native sequential/random-access record reader."""
+
+    def __init__(self, path):
+        self._lib = _load()
+        self._h = self._lib.MXTRecordReaderCreate(path.encode())
+        if not self._h:
+            raise IOError(_err())
+
+    def read(self):
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.MXTRecordReaderNext(self._h, ctypes.byref(out))
+        if n == 0:
+            return None
+        if n < 0:
+            raise IOError(_err())
+        return ctypes.string_at(out, n)
+
+    def read_at(self, offset):
+        cap = 1 << 16
+        while True:
+            buf = (ctypes.c_uint8 * cap)()
+            n = self._lib.MXTRecordReaderReadAt(self._h, offset, buf, cap)
+            if n < 0:
+                raise IOError(_err())
+            if n == 0:
+                return None
+            if n <= cap:
+                return bytes(bytearray(buf[:n]))
+            cap = int(n)
+
+    def seek(self, offset):
+        self._lib.MXTRecordReaderSeek(self._h, offset)
+
+    def tell(self):
+        return self._lib.MXTRecordReaderTell(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.MXTRecordReaderClose(self._h)
+            self._h = None
+
+    __del__ = close
+
+
+class MemoryPool:
+    """Pooled aligned host allocator (staging buffers for infeed)."""
+
+    def __init__(self, max_cached_bytes=0, alignment=64):
+        self._lib = _load()
+        self._h = self._lib.MXTPoolCreate(max_cached_bytes, alignment)
+
+    def alloc(self, size):
+        ptr = self._lib.MXTPoolAlloc(self._h, size)
+        if not ptr:
+            raise MemoryError(_err())
+        return ptr
+
+    def free(self, ptr, size):
+        self._lib.MXTPoolFree(self._h, ptr, size)
+
+    def stats(self):
+        out = (ctypes.c_uint64 * 5)()
+        self._lib.MXTPoolStats(self._h, out)
+        return {"allocated": out[0], "cached": out[1], "peak": out[2],
+                "hits": out[3], "misses": out[4]}
+
+    def release(self):
+        self._lib.MXTPoolRelease(self._h)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.MXTPoolDestroy(self._h)
+            self._h = None
+
+
+def decode_jpeg(buf):
+    """Decode JPEG bytes to an RGB uint8 HWC numpy array (libjpeg)."""
+    import numpy as np
+
+    l = _load()
+    data = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
+    out = ctypes.c_void_p()
+    h = ctypes.c_int()
+    w = ctypes.c_int()
+    c = ctypes.c_int()
+    if l.MXTDecodeJPEG(data, len(buf), ctypes.byref(out), ctypes.byref(h),
+                       ctypes.byref(w), ctypes.byref(c)) != 0:
+        raise ValueError(_err())
+    n = h.value * w.value * c.value
+    arr = np.ctypeslib.as_array(
+        ctypes.cast(out, ctypes.POINTER(ctypes.c_uint8)), (n,)).copy()
+    l.MXTBufFree(out)
+    return arr.reshape(h.value, w.value, c.value)
+
+
+def encode_jpeg(img, quality=95):
+    """Encode an HWC uint8 array (1 or 3 channels) to JPEG bytes."""
+    import numpy as np
+
+    l = _load()
+    img = np.ascontiguousarray(img, dtype=np.uint8)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    h, w, c = img.shape
+    src = img.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    out = ctypes.c_void_p()
+    out_len = ctypes.c_uint64()
+    if l.MXTEncodeJPEG(src, h, w, c, quality, ctypes.byref(out),
+                       ctypes.byref(out_len)) != 0:
+        raise ValueError(_err())
+    buf = ctypes.string_at(out, out_len.value)
+    l.MXTBufFree(out)
+    return buf
+
+
+def resize_bilinear(img, dh, dw):
+    """Bilinear-resize an HWC uint8 array natively."""
+    import numpy as np
+
+    l = _load()
+    img = np.ascontiguousarray(img, dtype=np.uint8)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    h, w, c = img.shape
+    dst = np.empty((dh, dw, c), np.uint8)
+    l.MXTImageResizeBilinear(
+        img.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), h, w, c,
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), dh, dw)
+    return dst
+
+
+class ImageRecordLoader:
+    """Threaded native ImageRecord pipeline (decode+augment+batch+prefetch),
+    the src/io/iter_image_recordio_2.cc equivalent."""
+
+    def __init__(self, rec_path, batch_size, data_shape, label_width=1,
+                 num_workers=2, shuffle=False, seed=0, rand_mirror=False,
+                 rand_crop=False, mean=(0.0, 0.0, 0.0), scale=1.0):
+        import numpy as np
+
+        self._lib = _load()
+        c, h, w = data_shape
+        flags = (1 if rand_mirror else 0) | (2 if rand_crop else 0)
+        mean_arr = (ctypes.c_float * 3)(*[float(m) for m in mean])
+        self._h = self._lib.MXTLoaderCreate(
+            rec_path.encode(), b"", batch_size, c, h, w, label_width,
+            num_workers, seed, int(shuffle), flags, mean_arr, float(scale))
+        if not self._h:
+            raise IOError(_err())
+        self.batch_size = batch_size
+        self.data_shape = (c, h, w)
+        self.label_width = label_width
+        self._data_buf = np.empty((batch_size, c, h, w), np.float32)
+        self._label_buf = np.empty((batch_size, label_width), np.float32)
+
+    def next(self):
+        """Returns (data, label, count) or None at epoch end; data is
+        float32 NCHW."""
+        n = self._lib.MXTLoaderNext(
+            self._h,
+            self._data_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._label_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if n == 0:
+            return None
+        return self._data_buf, self._label_buf, n
+
+    def reset(self):
+        self._lib.MXTLoaderReset(self._h)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.MXTLoaderDestroy(self._h)
+            self._h = None
+
+    __del__ = close
+
+
+_ENGINE_CB = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
+
+
+class NativeEngine:
+    """Threaded dependency engine for host-side tasks.
+
+    push(fn, const_vars, mutable_vars): fn is a python callable run on a
+    worker thread once its dependencies resolve; raising marks every
+    mutable var failed and the error code resurfaces from wait_for_var
+    (the reference's ExceptionRef contract)."""
+
+    def __init__(self, num_workers=4):
+        self._lib = _load()
+        self._h = self._lib.MXTEngineCreate(num_workers)
+        self._callbacks = []  # keep CFUNCTYPE objects alive
+        self._cb_lock = threading.Lock()
+
+    def new_var(self):
+        return self._lib.MXTEngineNewVar(self._h)
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=False):
+        def trampoline(_arg, _fn=fn):
+            try:
+                _fn()
+                return 0
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+                return 1
+
+        cb = _ENGINE_CB(trampoline)
+        with self._cb_lock:
+            self._callbacks.append(cb)
+        cv = (ctypes.c_int64 * max(1, len(const_vars)))(*const_vars)
+        mv = (ctypes.c_int64 * max(1, len(mutable_vars)))(*mutable_vars)
+        rc = self._lib.MXTEnginePushAsync(
+            self._h, ctypes.cast(cb, ctypes.c_void_p), None,
+            cv, len(const_vars), mv, len(mutable_vars), int(priority))
+        if rc != 0:
+            raise RuntimeError(_err())
+
+    def wait_for_var(self, var):
+        rc = self._lib.MXTEngineWaitForVar(self._h, var)
+        if rc != 0:
+            raise RuntimeError("engine op writing var %d failed (code %d)"
+                               % (var, rc))
+
+    def wait_all(self):
+        self._lib.MXTEngineWaitAll(self._h)
+        with self._cb_lock:
+            self._callbacks.clear()
+
+    def pending(self):
+        return self._lib.MXTEnginePending(self._h)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.MXTEngineDestroy(self._h)
+            self._h = None

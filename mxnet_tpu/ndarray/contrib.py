@@ -55,6 +55,22 @@ def _is_traced(arrays):
     return any(isinstance(a._data, jax.core.Tracer) for a in arrays)
 
 
+# body callables whose deferred Gluon parameters have been resolved by a
+# pre-flight step (keyed weakly on the body's code object so repeated calls
+# don't re-pay one eager body execution per call)
+import weakref as _weakref  # noqa: E402
+
+_PREFLIGHTED = _weakref.WeakSet()
+
+
+def _needs_preflight(body):
+    code = getattr(body, "__code__", None)
+    if code is None or code in _PREFLIGHTED:
+        return False
+    _PREFLIGHTED.add(code)
+    return True
+
+
 def _recording():
     from ..base import thread_state
 
@@ -106,10 +122,11 @@ def foreach(body, data, init_states, name="foreach"):
         stacked = [_stack(*os, axis=0) for os in flat_outs]
         return (_unflatten(out_spec, iter(stacked), lambda x: x), states)
 
-    if not _is_traced(flat_data + flat_states):
-        # pre-flight one eager step: resolves deferred parameter shapes
-        # (Gluon cells) OUTSIDE the scan trace — otherwise their init would
-        # be staged into the trace and leak tracers into Parameter._data
+    if not _is_traced(flat_data + flat_states) and _needs_preflight(body):
+        # pre-flight one eager step (first call per body only): resolves
+        # deferred parameter shapes (Gluon cells) OUTSIDE the scan trace —
+        # otherwise their init would be staged into the trace and leak
+        # tracers into Parameter._data
         from .. import autograd
 
         with autograd.pause():
@@ -206,7 +223,7 @@ def while_loop(cond, func, loop_vars, max_iterations=None, name="while_loop"):
             stacked.append(_stack(*(list(col) + pads), axis=0))
         return (_unflatten(out_spec, iter(stacked), lambda x: x), cur)
 
-    if not _is_traced(flat_vars):
+    if not _is_traced(flat_vars) and _needs_preflight(func):
         # pre-flight (see foreach): resolve deferred params outside the trace
         from .. import autograd
 
@@ -274,10 +291,12 @@ def cond(pred, then_func, else_func, name="cond"):
     trace the predicate is abstract, so lower to ``lax.cond``."""
     p = pred._data if isinstance(pred, NDArray) else pred
     if isinstance(p, jax.core.Tracer):
+        spec_holder = {}  # per-call: reentrant under nested/threaded traces
+
         def _then(_):
             out = then_func()
             flat = []
-            cond.spec = _flatten(out, flat)
+            spec_holder["spec"] = _flatten(out, flat)
             return tuple(o._data for o in flat)
 
         def _else(_):
@@ -286,10 +305,9 @@ def cond(pred, then_func, else_func, name="cond"):
             _flatten(out, flat)
             return tuple(o._data for o in flat)
 
-        cond.spec = None
         res = lax.cond(jnp.asarray(p).reshape(()).astype(bool),
                        _then, _else, None)
-        return _unflatten(cond.spec, (NDArray(r) for r in res),
+        return _unflatten(spec_holder["spec"], (NDArray(r) for r in res),
                           lambda x: x)
     taken = bool(jnp.asarray(p).reshape(()))
     return then_func() if taken else else_func()

@@ -156,14 +156,42 @@ def unpack(s):
     return IRHeader(flag, label, id_, id2), s
 
 
-def pack_img(header, img, quality=95, img_fmt=".npy"):
-    """Pack an image array (npy payload — no OpenCV here)."""
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image array.  JPEG via the native libjpeg encoder (reference
+    pack_img uses cv2.imencode); npy fallback when the native runtime is
+    unavailable or a lossless payload is requested (img_fmt='.npy')."""
+    from . import native
+
+    arr = _np.asarray(img)
+    jpeg_able = (arr.dtype == _np.uint8
+                 and (arr.ndim == 2 or (arr.ndim == 3
+                                        and arr.shape[2] in (1, 3))))
+    if img_fmt.lower() in (".jpg", ".jpeg") and native.available() \
+            and jpeg_able:
+        try:
+            return pack(header, native.encode_jpeg(arr, quality=quality))
+        except Exception:
+            pass  # fall through to lossless npy payload
     buf = _io.BytesIO()
-    _np.save(buf, _np.asarray(img))
+    _np.save(buf, arr)
     return pack(header, buf.getvalue())
 
 
 def unpack_img(s, iscolor=-1):
     header, payload = unpack(s)
+    if payload[:2] == b"\xff\xd8":  # JPEG magic
+        from . import native
+
+        if native.available():
+            return header, native.decode_jpeg(payload)
+        try:  # pure-python fallback decoder
+            from PIL import Image
+
+            img = _np.asarray(Image.open(_io.BytesIO(payload))
+                              .convert("RGB"))
+            return header, img
+        except ImportError as exc:
+            raise MXNetError("JPEG payload needs the native runtime or "
+                             "PIL") from exc
     img = _np.load(_io.BytesIO(payload), allow_pickle=False)
     return header, img

@@ -1,0 +1,13 @@
+#include "common.h"
+
+namespace mxt {
+
+static thread_local std::string g_last_error;
+
+void SetLastError(const std::string& msg) { g_last_error = msg; }
+
+}  // namespace mxt
+
+extern "C" MXT_EXPORT const char* MXTGetLastError() {
+  return mxt::g_last_error.c_str();
+}

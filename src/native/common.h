@@ -1,0 +1,34 @@
+// Common helpers for the mxnet_tpu native host runtime.
+//
+// Role in the TPU-native design (SURVEY.md §7): device-side scheduling,
+// memory and kernels belong to XLA/PJRT; what stays native is the HOST
+// runtime around it — record IO, the threaded dependency engine for
+// host-side async tasks, pooled host memory for infeed staging, and the
+// image decode/augment pipeline feeding the chips.  These mirror the
+// reference's src/{engine,storage,io} responsibilities (see SURVEY.md §2.1)
+// with a fresh implementation.
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#if defined(_WIN32)
+#define MXT_EXPORT __declspec(dllexport)
+#else
+#define MXT_EXPORT __attribute__((visibility("default")))
+#endif
+
+extern "C" {
+// every API returns 0 on success or a negative error code; the message of
+// the last error on this thread is available via MXTGetLastError.
+MXT_EXPORT const char* MXTGetLastError();
+}
+
+namespace mxt {
+
+void SetLastError(const std::string& msg);
+
+}  // namespace mxt

@@ -1,0 +1,413 @@
+// Threaded ImageRecord data pipeline.
+//
+// Reference capability: src/io/iter_image_recordio_2.cc (registered :887) —
+// file read, a decode thread pool, augmentation (crop/mirror), batching and
+// a double-buffered prefetcher (iter_prefetcher.h), all behind a simple
+// next() call.
+//
+// Fresh TPU-first design: workers pull record indices from a shared
+// cursor, read via pread (lock-free random access using the .idx offsets),
+// decode JPEG (libjpeg) or raw npy u8 payloads, resize/crop/mirror, then
+// normalize straight into one of `kNumBuffers` preallocated float32 NCHW
+// batch buffers (the infeed staging layout jax.device_put consumes
+// zero-conversion).  A per-batch countdown flips the buffer to ready; the
+// consumer blocks on a bounded ready queue — classic double buffering, so
+// host decode overlaps device steps.
+#include "common.h"
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+extern "C" {
+int MXTDecodeJPEG(const uint8_t* buf, uint64_t len, void** out, int* h,
+                  int* w, int* c);
+void MXTImageResizeBilinear(const uint8_t* src, int sh, int sw, int c,
+                            uint8_t* dst, int dh, int dw);
+void MXTBufFree(void* ptr);
+void* MXTRecordReaderCreate(const char* path);
+int64_t MXTRecordReaderNext(void* handle, const uint8_t** out);
+int64_t MXTRecordReaderTell(void* handle);
+int64_t MXTRecordReaderReadAt(void* handle, int64_t offset, uint8_t* dst,
+                              uint64_t cap);
+int MXTRecordReaderClose(void* handle);
+}
+
+namespace {
+
+#pragma pack(push, 1)
+struct IRHeader {  // same layout as recordio.py _IR_FORMAT "<IfQQ"
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+#pragma pack(pop)
+
+// minimal npy parser for u8/float32 C-order arrays (payloads written by
+// mx.recordio.pack_img without OpenCV)
+bool ParseNpy(const uint8_t* buf, uint64_t len, const uint8_t** data,
+              int* h, int* w, int* c, bool* is_f32) {
+  if (len < 10 || std::memcmp(buf, "\x93NUMPY", 6) != 0) return false;
+  int major = buf[6];
+  uint64_t hlen, hoff;
+  if (major == 1) {
+    hlen = buf[8] | (buf[9] << 8);
+    hoff = 10;
+  } else {
+    if (len < 12) return false;
+    hlen = buf[8] | (buf[9] << 8) | (uint64_t(buf[10]) << 16) |
+           (uint64_t(buf[11]) << 24);
+    hoff = 12;
+  }
+  if (hoff + hlen > len) return false;
+  std::string hdr(reinterpret_cast<const char*>(buf + hoff), hlen);
+  *is_f32 = hdr.find("<f4") != std::string::npos;
+  bool is_u8 = hdr.find("|u1") != std::string::npos;
+  if (!*is_f32 && !is_u8) return false;
+  auto p = hdr.find("'shape':");
+  if (p == std::string::npos) return false;
+  p = hdr.find('(', p);
+  auto q = hdr.find(')', p);
+  if (p == std::string::npos || q == std::string::npos) return false;
+  std::string dims = hdr.substr(p + 1, q - p - 1);
+  int vals[3] = {1, 1, 1}, nv = 0;
+  const char* s = dims.c_str();
+  while (*s && nv < 3) {
+    while (*s == ' ' || *s == ',') ++s;
+    if (*s < '0' || *s > '9') break;
+    vals[nv++] = std::atoi(s);
+    while (*s >= '0' && *s <= '9') ++s;
+  }
+  if (nv < 2) return false;
+  *h = vals[0];
+  *w = vals[1];
+  *c = nv == 3 ? vals[2] : 1;
+  *data = buf + hoff + hlen;
+  return true;
+}
+
+struct Batch {
+  std::vector<float> data;      // N*C*H*W
+  std::vector<float> label;     // N*label_width
+  std::atomic<int> remaining{0};
+  int count = 0;                // valid samples
+  int64_t batch_no = -1;
+  enum State { kFree, kFilling, kReady } state = kFree;
+};
+
+struct Loader {
+  // config
+  std::string rec_path;
+  int batch, H, W, C;
+  int label_width;
+  bool shuffle, rand_mirror, rand_crop;
+  float mean[3] = {0, 0, 0};
+  float scale = 1.0f;
+  uint64_t seed = 0;
+
+  // record index: byte offset of every record
+  std::vector<int64_t> offsets;
+  std::vector<int64_t> order;  // iteration order (shuffled per epoch)
+
+  static constexpr int kNumBuffers = 3;
+  Batch buffers[kNumBuffers];
+  std::deque<int> ready;   // buffer idx in completion order
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_free;
+
+  std::atomic<int64_t> cursor{0};  // next sample position in epoch
+  int64_t epoch_len = 0;
+  int64_t served = 0;              // batches handed to the consumer
+  int epoch = 0;
+  bool stop = false;
+  std::atomic<bool> abort{false};  // epoch abort for Reset
+  std::vector<std::thread> workers;
+  void* reader = nullptr;  // pread handle
+
+  ~Loader() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stop = true;
+    }
+    cv_ready.notify_all();
+    cv_free.notify_all();
+    for (auto& t : workers) t.join();
+    if (reader) MXTRecordReaderClose(reader);
+  }
+
+  bool Index() {
+    void* r = MXTRecordReaderCreate(rec_path.c_str());
+    if (!r) return false;
+    const uint8_t* p;
+    for (;;) {
+      int64_t off = MXTRecordReaderTell(r);
+      int64_t n = MXTRecordReaderNext(r, &p);
+      if (n <= 0) break;
+      offsets.push_back(off);
+    }
+    MXTRecordReaderClose(r);
+    epoch_len = offsets.size();
+    order.assign(offsets.begin(), offsets.end());
+    return epoch_len > 0;
+  }
+
+  void Shuffle(int ep) {
+    order.assign(offsets.begin(), offsets.end());
+    if (shuffle) {
+      std::mt19937_64 rng(seed + ep);
+      std::shuffle(order.begin(), order.end(), rng);
+    }
+  }
+
+  // which batch buffer owns epoch position `pos`, blocking until free
+  Batch* AcquireBuffer(int64_t pos, int* bidx) {
+    int idx = int((pos / batch) % kNumBuffers);
+    Batch& b = buffers[idx];
+    std::unique_lock<std::mutex> lk(mu);
+    int64_t batch_no = pos / batch;
+    // wait until this buffer is assigned to our batch (kFilling with the
+    // right remaining) or free to claim
+    for (;;) {
+      if (stop || abort.load()) return nullptr;
+      if (b.state == Batch::kFilling && b.batch_no == batch_no) break;
+      if (b.state == Batch::kFree) {
+        int64_t first = batch_no * batch;
+        int n = int(std::min<int64_t>(batch, epoch_len - first));
+        b.state = Batch::kFilling;
+        b.batch_no = batch_no;
+        b.count = n;
+        b.remaining.store(n);
+        break;
+      }
+      cv_free.wait(lk);
+    }
+    *bidx = idx;
+    return &b;
+  }
+
+  bool LoadSample(int64_t pos, Batch* b, std::mt19937_64* rng) {
+    int64_t off = order[pos];
+    // read record (grow-once local buffer)
+    thread_local std::vector<uint8_t> rec;
+    if (rec.size() < (1u << 16)) rec.resize(1u << 16);
+    int64_t n = MXTRecordReaderReadAt(reader, off, rec.data(), rec.size());
+    if (n > int64_t(rec.size())) {
+      rec.resize(n);
+      n = MXTRecordReaderReadAt(reader, off, rec.data(), rec.size());
+    }
+    if (n < int64_t(sizeof(IRHeader))) return false;
+    IRHeader hdr;
+    std::memcpy(&hdr, rec.data(), sizeof(hdr));
+    const uint8_t* payload = rec.data() + sizeof(hdr);
+    uint64_t payload_len = n - sizeof(hdr);
+    int slot = int(pos % batch);
+    float* lbl = b->label.data() + size_t(slot) * label_width;
+    if (hdr.flag == 0) {
+      lbl[0] = hdr.label;
+      for (int i = 1; i < label_width; ++i) lbl[i] = 0.f;
+    } else {
+      const float* extra = reinterpret_cast<const float*>(payload);
+      int nl = std::min<int>(hdr.flag, label_width);
+      for (int i = 0; i < nl; ++i) lbl[i] = extra[i];
+      for (int i = nl; i < label_width; ++i) lbl[i] = 0.f;
+      payload += size_t(hdr.flag) * 4;
+      payload_len -= size_t(hdr.flag) * 4;
+    }
+
+    // decode payload to u8 HWC
+    const uint8_t* img = nullptr;
+    void* decoded = nullptr;
+    int ih = 0, iw = 0, ic = 0;
+    bool is_f32 = false;
+    if (payload_len >= 2 && payload[0] == 0xFF && payload[1] == 0xD8) {
+      if (MXTDecodeJPEG(payload, payload_len, &decoded, &ih, &iw, &ic) != 0)
+        return false;
+      img = static_cast<const uint8_t*>(decoded);
+    } else if (ParseNpy(payload, payload_len, &img, &ih, &iw, &ic,
+                        &is_f32)) {
+      if (is_f32) return false;  // u8 images only in this pipeline
+    } else {
+      return false;
+    }
+
+    // resize (+optional random crop margin) then crop/mirror
+    std::vector<uint8_t> resized;
+    int ch = std::min(ic, C);
+    int th = H, tw = W;
+    int x0 = 0, y0 = 0;
+    if (rand_crop && (ih > H || iw > W)) {
+      // random crop from the (possibly larger) source after a bounding
+      // resize that keeps at least target size
+      th = std::max(H, int(H * 1.14f));
+      tw = std::max(W, int(W * 1.14f));
+    }
+    if (ih != th || iw != tw) {
+      resized.resize(size_t(th) * tw * ic);
+      MXTImageResizeBilinear(img, ih, iw, ic, resized.data(), th, tw);
+      img = resized.data();
+      ih = th;
+      iw = tw;
+    }
+    if (rand_crop && (ih > H || iw > W)) {
+      y0 = int((*rng)() % (ih - H + 1));
+      x0 = int((*rng)() % (iw - W + 1));
+    }
+    bool mirror = rand_mirror && ((*rng)() & 1);
+
+    // normalize into NCHW float32 slot
+    float* dst = b->data.data() + size_t(slot) * C * H * W;
+    for (int k = 0; k < C; ++k) {
+      int sk = k < ch ? k : 0;
+      float mk = k < 3 ? mean[k] : 0.f;
+      for (int y = 0; y < H; ++y) {
+        const uint8_t* srow = img + (size_t(y0 + y) * iw + x0) * ic + sk;
+        float* drow = dst + (size_t(k) * H + y) * W;
+        if (mirror) {
+          for (int x = 0; x < W; ++x)
+            drow[x] = (float(srow[size_t(W - 1 - x) * ic]) - mk) * scale;
+        } else {
+          for (int x = 0; x < W; ++x)
+            drow[x] = (float(srow[size_t(x) * ic]) - mk) * scale;
+        }
+      }
+    }
+    if (decoded) MXTBufFree(decoded);
+    return true;
+  }
+
+  void WorkerLoop(int wid) {
+    std::mt19937_64 rng(seed * 9176 + wid + 1);
+    for (;;) {
+      if (abort.load()) return;
+      int64_t pos = cursor.fetch_add(1);
+      if (pos >= epoch_len) return;  // epoch exhausted; worker parks
+      int bidx;
+      Batch* b = AcquireBuffer(pos, &bidx);
+      if (!b) return;
+      if (!LoadSample(pos, b, &rng)) {
+        // zero the slot on decode failure; keep the batch flowing
+        int slot = int(pos % batch);
+        std::memset(b->data.data() + size_t(slot) * C * H * W, 0,
+                    size_t(C) * H * W * sizeof(float));
+      }
+      if (b->remaining.fetch_sub(1) == 1) {
+        std::lock_guard<std::mutex> lk(mu);
+        b->state = Batch::kReady;
+        cv_ready.notify_all();
+      }
+    }
+  }
+
+  void StartEpoch(int num_workers) {
+    for (auto& t : workers) t.join();
+    workers.clear();
+    Shuffle(epoch);
+    cursor.store(0);
+    for (int i = 0; i < num_workers; ++i)
+      workers.emplace_back([this, i] { WorkerLoop(i); });
+    ++epoch;
+  }
+
+  int num_workers_ = 2;
+};
+
+}  // namespace
+
+// give Batch the batch_no field referenced above
+// (declared here to keep the struct POD-ish ordering clear)
+namespace {
+}  // namespace
+
+extern "C" {
+
+MXT_EXPORT void* MXTLoaderCreate(const char* rec_path, const char* unused_idx,
+                                 int batch, int C, int H, int W,
+                                 int label_width, int num_workers,
+                                 uint64_t seed, int shuffle, int flags,
+                                 const float* mean3, float scale) {
+  (void)unused_idx;
+  auto* L = new Loader();
+  L->rec_path = rec_path;
+  L->batch = batch;
+  L->C = C;
+  L->H = H;
+  L->W = W;
+  L->label_width = label_width < 1 ? 1 : label_width;
+  L->num_workers_ = num_workers < 1 ? 1 : num_workers;
+  L->seed = seed;
+  L->shuffle = shuffle != 0;
+  L->rand_mirror = (flags & 1) != 0;
+  L->rand_crop = (flags & 2) != 0;
+  if (mean3)
+    for (int i = 0; i < 3; ++i) L->mean[i] = mean3[i];
+  L->scale = scale;
+  if (!L->Index()) {
+    delete L;
+    return nullptr;
+  }
+  L->reader = MXTRecordReaderCreate(rec_path);
+  if (!L->reader) {
+    delete L;
+    return nullptr;
+  }
+  for (auto& b : L->buffers) {
+    b.data.resize(size_t(batch) * C * H * W);
+    b.label.resize(size_t(batch) * L->label_width);
+  }
+  L->StartEpoch(L->num_workers_);
+  return L;
+}
+
+// copy the next batch into out_data (batch*C*H*W floats) and out_label
+// (batch*label_width); returns the number of valid samples, 0 at epoch end.
+// Batches are delivered strictly in epoch order (batch_no == served), so
+// an unshuffled .rec is consumed deterministically regardless of worker
+// completion order.
+MXT_EXPORT int MXTLoaderNext(void* h, float* out_data, float* out_label) {
+  auto* L = static_cast<Loader*>(h);
+  std::unique_lock<std::mutex> lk(L->mu);
+  int64_t total_batches = (L->epoch_len + L->batch - 1) / L->batch;
+  if (L->served >= total_batches) return 0;
+  Batch& b = L->buffers[int(L->served % Loader::kNumBuffers)];
+  L->cv_ready.wait(lk, [L, &b] {
+    return L->stop ||
+           (b.state == Batch::kReady && b.batch_no == L->served);
+  });
+  if (L->stop) return 0;
+  std::memcpy(out_data, b.data.data(), b.data.size() * sizeof(float));
+  std::memcpy(out_label, b.label.data(), b.label.size() * sizeof(float));
+  int count = b.count;
+  b.state = Batch::kFree;
+  ++L->served;
+  L->cv_free.notify_all();
+  return count;
+}
+
+MXT_EXPORT void MXTLoaderReset(void* h) {
+  auto* L = static_cast<Loader*>(h);
+  // abort the in-flight epoch, park every worker, then reset buffer state
+  L->abort.store(true);
+  L->cv_free.notify_all();
+  L->cv_ready.notify_all();
+  for (auto& t : L->workers) t.join();
+  L->workers.clear();
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->ready.clear();
+    for (auto& b : L->buffers) b.state = Batch::kFree;
+    L->served = 0;
+  }
+  L->abort.store(false);
+  L->StartEpoch(L->num_workers_);
+}
+
+MXT_EXPORT void MXTLoaderDestroy(void* h) { delete static_cast<Loader*>(h); }
+
+}  // extern "C"

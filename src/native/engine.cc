@@ -1,0 +1,260 @@
+// Threaded dependency engine for host-side async tasks.
+//
+// Reference capability: src/engine/threaded_engine.cc — ops are pushed with
+// const-vars (reads) and mutable-vars (writes); the engine orders them by
+// RAW/WAR/WAW hazards and runs ready ops on worker threads, with per-var
+// exception propagation rethrown at sync points (threaded_engine.h:64,
+// WaitForVar threaded_engine.cc:379).
+//
+// TPU-native role: DEVICE scheduling belongs to XLA/PJRT async dispatch
+// (SURVEY.md §7 rule 1), so this engine schedules the HOST side — record
+// reads, decode jobs, checkpoint writes, rendezvous callbacks — with the
+// same dependency semantics the reference gives every op.  Fresh design:
+// a single state mutex guarding per-var grant queues + a two-lane
+// (priority/normal) ready queue feeding a worker pool.
+#include "common.h"
+
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using Callback = int (*)(void*);  // returns nonzero on failure
+
+struct Opr;
+
+struct Var {
+  int active_readers = 0;
+  bool active_writer = false;
+  int pending_writes = 0;  // queued or running writers (for WaitForVar)
+  int err = 0;             // sticky error from a failed writer
+  std::deque<std::pair<Opr*, bool>> waiting;  // (op, is_write)
+};
+
+struct Opr {
+  Callback fn = nullptr;
+  void* arg = nullptr;
+  std::vector<Var*> const_vars;
+  std::vector<Var*> mutable_vars;
+  int wait = 0;
+  bool priority = false;
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_workers) {
+    if (num_workers < 1) num_workers = 1;
+    for (int i = 0; i < num_workers; ++i)
+      workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  ~Engine() {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_ready_.notify_all();
+    for (auto& t : workers_) t.join();
+    for (auto& kv : vars_) delete kv.second;
+  }
+
+  int64_t NewVar() {
+    std::lock_guard<std::mutex> lk(mu_);
+    int64_t id = next_var_++;
+    vars_[id] = new Var();
+    return id;
+  }
+
+  Var* GetVar(int64_t id) {
+    auto it = vars_.find(id);
+    return it == vars_.end() ? nullptr : it->second;
+  }
+
+  int Push(Callback fn, void* arg, const int64_t* cvars, int nc,
+           const int64_t* mvars, int nm, int priority) {
+    auto* op = new Opr();
+    op->fn = fn;
+    op->arg = arg;
+    op->priority = priority != 0;
+    std::lock_guard<std::mutex> lk(mu_);
+    // resolve every var id BEFORE touching any state: a partially-granted
+    // op left queued on some vars after a failed push would be freed while
+    // still referenced (use-after-free) and leak pending_writes counts
+    for (int i = 0; i < nc; ++i) {
+      Var* v = GetVar(cvars[i]);
+      if (!v) return Fail(op, "unknown const var");
+      op->const_vars.push_back(v);
+    }
+    for (int i = 0; i < nm; ++i) {
+      Var* v = GetVar(mvars[i]);
+      if (!v) return Fail(op, "unknown mutable var");
+      op->mutable_vars.push_back(v);
+    }
+    ++pending_;
+    for (Var* v : op->mutable_vars) ++v->pending_writes;
+    // request grants; count the ones not immediately available
+    for (Var* v : op->const_vars) {
+      if (!v->active_writer && v->waiting.empty()) {
+        ++v->active_readers;
+      } else {
+        v->waiting.emplace_back(op, false);
+        ++op->wait;
+      }
+    }
+    for (Var* v : op->mutable_vars) {
+      if (!v->active_writer && v->active_readers == 0 && v->waiting.empty()) {
+        v->active_writer = true;
+      } else {
+        v->waiting.emplace_back(op, true);
+        ++op->wait;
+      }
+    }
+    if (op->wait == 0) Enqueue(op);
+    return 0;
+  }
+
+  int WaitForVar(int64_t var_id) {
+    std::unique_lock<std::mutex> lk(mu_);
+    Var* v = GetVar(var_id);
+    if (!v) return -1;
+    cv_done_.wait(lk, [v] { return v->pending_writes == 0; });
+    return v->err;
+  }
+
+  void WaitAll() {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_done_.wait(lk, [this] { return pending_ == 0; });
+  }
+
+  int64_t Pending() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return pending_;
+  }
+
+ private:
+  // only called before any state mutation (validation phase of Push)
+  int Fail(Opr* op, const char* msg) {
+    mxt::SetLastError(msg);
+    delete op;
+    return -1;
+  }
+
+  // mu_ held
+  void Enqueue(Opr* op) {
+    (op->priority ? ready_hi_ : ready_).push_back(op);
+    cv_ready_.notify_one();
+  }
+
+  // mu_ held: release op's grants, wake successors
+  void Release(Opr* op, int status) {
+    for (Var* v : op->const_vars) {
+      --v->active_readers;
+      if (v->active_readers == 0) GrantNext(v);
+    }
+    for (Var* v : op->mutable_vars) {
+      v->active_writer = false;
+      --v->pending_writes;
+      if (status != 0) v->err = status;
+      GrantNext(v);
+    }
+    --pending_;
+    cv_done_.notify_all();
+  }
+
+  // mu_ held: grant the head of v's queue — one writer, or a run of readers
+  void GrantNext(Var* v) {
+    while (!v->waiting.empty()) {
+      auto [op, is_write] = v->waiting.front();
+      if (is_write) {
+        if (v->active_readers > 0 || v->active_writer) return;
+        v->waiting.pop_front();
+        v->active_writer = true;
+        if (--op->wait == 0) Enqueue(op);
+        return;  // writer is exclusive
+      }
+      if (v->active_writer) return;
+      v->waiting.pop_front();
+      ++v->active_readers;
+      if (--op->wait == 0) Enqueue(op);
+      // keep granting consecutive readers
+    }
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Opr* op = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_ready_.wait(lk, [this] {
+          return stop_ || !ready_hi_.empty() || !ready_.empty();
+        });
+        if (stop_ && ready_hi_.empty() && ready_.empty()) return;
+        if (!ready_hi_.empty()) {
+          op = ready_hi_.front();
+          ready_hi_.pop_front();
+        } else {
+          op = ready_.front();
+          ready_.pop_front();
+        }
+      }
+      int status = 0;
+      if (op->fn) status = op->fn(op->arg);
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        Release(op, status);
+      }
+      delete op;
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_ready_, cv_done_;
+  std::deque<Opr*> ready_, ready_hi_;
+  std::unordered_map<int64_t, Var*> vars_;
+  int64_t next_var_ = 1;
+  int64_t pending_ = 0;
+  bool stop_ = false;
+  std::vector<std::thread> workers_;
+};
+
+}  // namespace
+
+extern "C" {
+
+MXT_EXPORT void* MXTEngineCreate(int num_workers) {
+  return new Engine(num_workers);
+}
+
+MXT_EXPORT int64_t MXTEngineNewVar(void* h) {
+  return static_cast<Engine*>(h)->NewVar();
+}
+
+MXT_EXPORT int MXTEnginePushAsync(void* h, int (*fn)(void*), void* arg,
+                                  const int64_t* const_vars, int n_const,
+                                  const int64_t* mutable_vars, int n_mutable,
+                                  int priority) {
+  return static_cast<Engine*>(h)->Push(fn, arg, const_vars, n_const,
+                                       mutable_vars, n_mutable, priority);
+}
+
+MXT_EXPORT int MXTEngineWaitForVar(void* h, int64_t var_id) {
+  return static_cast<Engine*>(h)->WaitForVar(var_id);
+}
+
+MXT_EXPORT void MXTEngineWaitAll(void* h) {
+  static_cast<Engine*>(h)->WaitAll();
+}
+
+MXT_EXPORT int64_t MXTEnginePending(void* h) {
+  return static_cast<Engine*>(h)->Pending();
+}
+
+MXT_EXPORT void MXTEngineDestroy(void* h) { delete static_cast<Engine*>(h); }
+
+}  // extern "C"

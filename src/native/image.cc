@@ -1,0 +1,155 @@
+// JPEG decode/encode + bilinear resize.
+//
+// Reference capability: the decode stage of src/io/iter_image_recordio_2.cc
+// (OpenCV imdecode + augmenters).  Here: libjpeg directly (no OpenCV in the
+// image) plus a small bilinear resampler — enough for the standard
+// ImageNet-style resize/crop/mirror pipeline, run on host worker threads.
+#include "common.h"
+
+#include <jpeglib.h>
+
+#include <csetjmp>
+#include <vector>
+
+namespace {
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+void JpegErrExit(j_common_ptr cinfo) {
+  auto* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  char msg[JMSG_LENGTH_MAX];
+  (*cinfo->err->format_message)(cinfo, msg);
+  mxt::SetLastError(std::string("libjpeg: ") + msg);
+  longjmp(err->jb, 1);
+}
+
+}  // namespace
+
+extern "C" {
+
+MXT_EXPORT void MXTBufFree(void* ptr) { std::free(ptr); }
+
+// Decode JPEG to packed RGB u8 (HWC).  *out is malloc'd; free with
+// MXTBufFree.  Returns 0 on success.
+MXT_EXPORT int MXTDecodeJPEG(const uint8_t* buf, uint64_t len, void** out,
+                             int* height, int* width, int* channels) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = JpegErrExit;
+  // volatile: modified between setjmp and longjmp, read after longjmp
+  uint8_t* volatile data = nullptr;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    std::free(const_cast<uint8_t*>(data));
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, buf, len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  int h = cinfo.output_height, w = cinfo.output_width;
+  int c = cinfo.output_components;  // 3 for JCS_RGB
+  data = static_cast<uint8_t*>(std::malloc(size_t(h) * w * c));
+  if (!data) {
+    mxt::SetLastError("decode alloc failed");
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = data + size_t(cinfo.output_scanline) * w * c;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  *out = data;
+  *height = h;
+  *width = w;
+  *channels = c;
+  return 0;
+}
+
+// Encode packed RGB/grayscale u8 (HWC) to JPEG.  *out malloc'd.
+MXT_EXPORT int MXTEncodeJPEG(const uint8_t* img, int height, int width,
+                             int channels, int quality, void** out,
+                             uint64_t* out_len) {
+  jpeg_compress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = JpegErrExit;
+  // heap-held output slot: locals written between setjmp and longjmp have
+  // indeterminate values after the jump; memp/sizep themselves are set
+  // once before setjmp, so reading them in the handler is well-defined
+  auto* memp =
+      static_cast<unsigned char**>(std::calloc(1, sizeof(unsigned char*)));
+  auto* sizep =
+      static_cast<unsigned long*>(std::calloc(1, sizeof(unsigned long)));
+  if (!memp || !sizep) {
+    std::free(memp);
+    std::free(sizep);
+    mxt::SetLastError("encode alloc failed");
+    return -1;
+  }
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_compress(&cinfo);
+    std::free(*memp);
+    std::free(memp);
+    std::free(sizep);
+    return -1;
+  }
+  jpeg_create_compress(&cinfo);
+  jpeg_mem_dest(&cinfo, memp, sizep);
+  cinfo.image_width = width;
+  cinfo.image_height = height;
+  cinfo.input_components = channels;
+  cinfo.in_color_space = channels == 1 ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, quality, TRUE);
+  jpeg_start_compress(&cinfo, TRUE);
+  while (cinfo.next_scanline < cinfo.image_height) {
+    const uint8_t* row = img + size_t(cinfo.next_scanline) * width * channels;
+    jpeg_write_scanlines(&cinfo, const_cast<uint8_t**>(&row), 1);
+  }
+  jpeg_finish_compress(&cinfo);
+  jpeg_destroy_compress(&cinfo);
+  *out = *memp;
+  *out_len = *sizep;
+  std::free(memp);
+  std::free(sizep);
+  return 0;
+}
+
+// Bilinear resize of packed u8 HWC.
+MXT_EXPORT void MXTImageResizeBilinear(const uint8_t* src, int sh, int sw,
+                                       int c, uint8_t* dst, int dh, int dw) {
+  const float ry = dh > 1 ? float(sh - 1) / (dh - 1) : 0.f;
+  const float rx = dw > 1 ? float(sw - 1) / (dw - 1) : 0.f;
+  for (int y = 0; y < dh; ++y) {
+    float fy = y * ry;
+    int y0 = int(fy);
+    int y1 = y0 + 1 < sh ? y0 + 1 : y0;
+    float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = x * rx;
+      int x0 = int(fx);
+      int x1 = x0 + 1 < sw ? x0 + 1 : x0;
+      float wx = fx - x0;
+      for (int k = 0; k < c; ++k) {
+        float v00 = src[(size_t(y0) * sw + x0) * c + k];
+        float v01 = src[(size_t(y0) * sw + x1) * c + k];
+        float v10 = src[(size_t(y1) * sw + x0) * c + k];
+        float v11 = src[(size_t(y1) * sw + x1) * c + k];
+        float top = v00 + wx * (v01 - v00);
+        float bot = v10 + wx * (v11 - v10);
+        dst[(size_t(y) * dw + x) * c + k] =
+            static_cast<uint8_t>(top + wy * (bot - top) + 0.5f);
+      }
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,145 @@
+// RecordIO container: sequential magic-framed records.
+//
+// Same on-disk format as mxnet_tpu/recordio.py (and the reference's dmlc
+// recordio that src/io/iter_image_recordio_2.cc consumes): little-endian
+// u32 magic 0xced7230a, u32 payload length, payload, zero-pad to 4 bytes.
+// Fresh implementation; buffered stdio with a growable record buffer, plus
+// pread-based random access used by the threaded data loader for
+// lock-free parallel reads.
+#include "common.h"
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+struct Writer {
+  FILE* f = nullptr;
+};
+
+struct Reader {
+  FILE* f = nullptr;
+  std::vector<uint8_t> buf;
+  int fd = -1;  // for pread random access
+};
+
+}  // namespace
+
+extern "C" {
+
+MXT_EXPORT void* MXTRecordWriterCreate(const char* path) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) {
+    mxt::SetLastError(std::string("cannot open for write: ") + path);
+    return nullptr;
+  }
+  auto* w = new Writer();
+  w->f = f;
+  return w;
+}
+
+MXT_EXPORT int MXTRecordWriterWrite(void* handle, const uint8_t* data,
+                                    uint64_t len) {
+  auto* w = static_cast<Writer*>(handle);
+  uint32_t head[2] = {kMagic, static_cast<uint32_t>(len)};
+  if (std::fwrite(head, sizeof(head), 1, w->f) != 1) return -1;
+  if (len && std::fwrite(data, 1, len, w->f) != len) return -1;
+  static const uint8_t zeros[4] = {0, 0, 0, 0};
+  uint64_t pad = (4 - len % 4) % 4;
+  if (pad && std::fwrite(zeros, 1, pad, w->f) != pad) return -1;
+  return 0;
+}
+
+MXT_EXPORT int64_t MXTRecordWriterTell(void* handle) {
+  return std::ftell(static_cast<Writer*>(handle)->f);
+}
+
+MXT_EXPORT int MXTRecordWriterClose(void* handle) {
+  auto* w = static_cast<Writer*>(handle);
+  int rc = std::fclose(w->f);
+  delete w;
+  return rc;
+}
+
+MXT_EXPORT void* MXTRecordReaderCreate(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) {
+    mxt::SetLastError(std::string("cannot open for read: ") + path);
+    return nullptr;
+  }
+  auto* r = new Reader();
+  r->f = f;
+  r->fd = fileno(f);
+  return r;
+}
+
+// Returns payload length, 0 at EOF, <0 on error.  *out points into an
+// internal buffer valid until the next call on this reader.
+MXT_EXPORT int64_t MXTRecordReaderNext(void* handle, const uint8_t** out) {
+  auto* r = static_cast<Reader*>(handle);
+  uint32_t head[2];
+  size_t n = std::fread(head, sizeof(uint32_t), 2, r->f);
+  if (n == 0) return 0;  // clean EOF
+  if (n != 2 || head[0] != kMagic) {
+    mxt::SetLastError("corrupt record header");
+    return -1;
+  }
+  uint32_t len = head[1];
+  r->buf.resize(len);
+  if (len && std::fread(r->buf.data(), 1, len, r->f) != len) {
+    mxt::SetLastError("truncated record payload");
+    return -1;
+  }
+  uint32_t pad = (4 - len % 4) % 4;
+  if (pad) std::fseek(r->f, pad, SEEK_CUR);
+  *out = r->buf.data();
+  return static_cast<int64_t>(len);
+}
+
+MXT_EXPORT int MXTRecordReaderSeek(void* handle, int64_t offset) {
+  return std::fseek(static_cast<Reader*>(handle)->f, offset, SEEK_SET);
+}
+
+MXT_EXPORT int64_t MXTRecordReaderTell(void* handle) {
+  return std::ftell(static_cast<Reader*>(handle)->f);
+}
+
+// Thread-safe random access (no seek of the shared FILE*): read the record
+// at byte `offset` via pread into caller buffer of capacity `cap`.
+// Returns payload length (which may exceed cap — call again with a bigger
+// buffer), 0 at EOF/short-read, <0 on corrupt data.
+MXT_EXPORT int64_t MXTRecordReaderReadAt(void* handle, int64_t offset,
+                                         uint8_t* dst, uint64_t cap) {
+  auto* r = static_cast<Reader*>(handle);
+  uint32_t head[2];
+  ssize_t n = pread(r->fd, head, sizeof(head), offset);
+  if (n != static_cast<ssize_t>(sizeof(head))) return 0;
+  if (head[0] != kMagic) {
+    mxt::SetLastError("corrupt record header (ReadAt)");
+    return -1;
+  }
+  uint32_t len = head[1];
+  if (len <= cap) {
+    ssize_t got = pread(r->fd, dst, len, offset + sizeof(head));
+    if (got != static_cast<ssize_t>(len)) {
+      mxt::SetLastError("truncated record payload (ReadAt)");
+      return -1;
+    }
+  }
+  return static_cast<int64_t>(len);
+}
+
+MXT_EXPORT int MXTRecordReaderClose(void* handle) {
+  auto* r = static_cast<Reader*>(handle);
+  int rc = std::fclose(r->f);
+  delete r;
+  return rc;
+}
+
+}  // extern "C"

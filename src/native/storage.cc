@@ -1,0 +1,118 @@
+// Pooled aligned host allocator.
+//
+// Reference capability: src/storage/pooled_storage_manager.h — per-context
+// memory pools with round-to-pow2 bucketing, reuse free lists, and a
+// release threshold; plus storage profiling counters (storage_profiler.h).
+// TPU-native role: device HBM is owned by PJRT, so this pool serves HOST
+// memory — staging buffers for infeed/outfeed and the data pipeline, where
+// allocation churn (one batch buffer per step) would otherwise hit malloc.
+// Fresh implementation: size-bucketed free lists under one mutex with
+// aligned allocation and byte-capped caching.
+#include "common.h"
+
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Pool {
+  std::mutex mu;
+  // bucket (log2 size) -> free chunks
+  std::unordered_map<int, std::vector<void*>> free_list;
+  uint64_t cached_bytes = 0;
+  uint64_t max_cached_bytes;
+  uint64_t allocated_bytes = 0;  // live, handed to users
+  uint64_t peak_bytes = 0;
+  uint64_t hits = 0, misses = 0;
+  size_t alignment;
+};
+
+int BucketOf(uint64_t size) {
+  int b = 6;  // min bucket 64 B
+  while ((1ull << b) < size) ++b;
+  return b;
+}
+
+}  // namespace
+
+extern "C" {
+
+MXT_EXPORT void* MXTPoolCreate(uint64_t max_cached_bytes, uint64_t alignment) {
+  auto* p = new Pool();
+  p->max_cached_bytes = max_cached_bytes ? max_cached_bytes : (1ull << 30);
+  p->alignment = alignment ? alignment : 64;
+  return p;
+}
+
+MXT_EXPORT void* MXTPoolAlloc(void* handle, uint64_t size) {
+  auto* p = static_cast<Pool*>(handle);
+  int b = BucketOf(size);
+  uint64_t bsize = 1ull << b;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    auto it = p->free_list.find(b);
+    if (it != p->free_list.end() && !it->second.empty()) {
+      void* ptr = it->second.back();
+      it->second.pop_back();
+      p->cached_bytes -= bsize;
+      p->allocated_bytes += bsize;
+      if (p->allocated_bytes > p->peak_bytes)
+        p->peak_bytes = p->allocated_bytes;
+      ++p->hits;
+      return ptr;
+    }
+    ++p->misses;
+    p->allocated_bytes += bsize;
+    if (p->allocated_bytes > p->peak_bytes) p->peak_bytes = p->allocated_bytes;
+  }
+  void* ptr = nullptr;
+  if (posix_memalign(&ptr, p->alignment, bsize) != 0) {
+    mxt::SetLastError("posix_memalign failed");
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->allocated_bytes -= bsize;
+    return nullptr;
+  }
+  return ptr;
+}
+
+MXT_EXPORT void MXTPoolFree(void* handle, void* ptr, uint64_t size) {
+  auto* p = static_cast<Pool*>(handle);
+  int b = BucketOf(size);
+  uint64_t bsize = 1ull << b;
+  std::lock_guard<std::mutex> lk(p->mu);
+  p->allocated_bytes -= bsize;
+  if (p->cached_bytes + bsize <= p->max_cached_bytes) {
+    p->free_list[b].push_back(ptr);
+    p->cached_bytes += bsize;
+  } else {
+    std::free(ptr);
+  }
+}
+
+// stats: [allocated, cached, peak, hits, misses]
+MXT_EXPORT void MXTPoolStats(void* handle, uint64_t* out5) {
+  auto* p = static_cast<Pool*>(handle);
+  std::lock_guard<std::mutex> lk(p->mu);
+  out5[0] = p->allocated_bytes;
+  out5[1] = p->cached_bytes;
+  out5[2] = p->peak_bytes;
+  out5[3] = p->hits;
+  out5[4] = p->misses;
+}
+
+MXT_EXPORT void MXTPoolRelease(void* handle) {
+  auto* p = static_cast<Pool*>(handle);
+  std::lock_guard<std::mutex> lk(p->mu);
+  for (auto& kv : p->free_list)
+    for (void* ptr : kv.second) std::free(ptr);
+  p->free_list.clear();
+  p->cached_bytes = 0;
+}
+
+MXT_EXPORT void MXTPoolDestroy(void* handle) {
+  MXTPoolRelease(handle);
+  delete static_cast<Pool*>(handle);
+}
+
+}  // extern "C"

@@ -121,7 +121,9 @@ def test_indexed_recordio_and_pack_img(tmp_path):
     img = np.random.randint(0, 255, (4, 4, 3)).astype(np.uint8)
     for i in range(3):
         header = recordio.IRHeader(0, float(i), i, 0)
-        writer.write_idx(i, recordio.pack_img(header, img))
+        # .npy payload: lossless round trip (default .jpg is lossy,
+        # covered by test_native.test_pack_unpack_img_jpeg)
+        writer.write_idx(i, recordio.pack_img(header, img, img_fmt=".npy"))
     writer.close()
     reader = recordio.MXIndexedRecordIO(idx, rec, "r")
     hdr, img2 = recordio.unpack_img(reader.read_idx(1))

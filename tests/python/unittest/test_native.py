@@ -1,0 +1,263 @@
+"""Native host-runtime tests (reference tests/cpp/{engine,storage} +
+tests/python recordio/io coverage, driven from python via ctypes)."""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import native, recordio
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native runtime unavailable")
+
+
+def test_recordio_native_python_interop(tmp_path):
+    path = str(tmp_path / "a.rec")
+    w = native.RecordWriter(path)
+    payloads = [bytes([i]) * (i * 7 + 1) for i in range(20)]
+    offsets = []
+    for p in payloads:
+        offsets.append(w.tell())
+        w.write(p)
+    w.close()
+
+    # native reads
+    r = native.RecordReader(path)
+    got = []
+    while True:
+        b = r.read()
+        if b is None:
+            break
+        got.append(b)
+    assert got == payloads
+    # random access via pread
+    assert r.read_at(offsets[7]) == payloads[7]
+    assert r.read_at(offsets[19]) == payloads[19]
+    r.close()
+
+    # python reader parses the native file
+    pr = recordio.MXRecordIO(path, "r")
+    assert pr.read() == payloads[0]
+    assert pr.read() == payloads[1]
+    pr.close()
+
+    # native reads a python-written file
+    path2 = str(tmp_path / "b.rec")
+    pw = recordio.MXRecordIO(path2, "w")
+    pw.write(b"hello-from-python")
+    pw.close()
+    r2 = native.RecordReader(path2)
+    assert r2.read() == b"hello-from-python"
+    r2.close()
+
+
+def test_memory_pool():
+    pool = native.MemoryPool(max_cached_bytes=1 << 20)
+    a = pool.alloc(1000)
+    assert a % 64 == 0  # aligned
+    pool.free(a, 1000)
+    b = pool.alloc(700)  # same 1024 bucket -> pooled hit
+    s = pool.stats()
+    assert s["hits"] == 1 and s["misses"] == 1
+    assert s["allocated"] == 1024
+    pool.free(b, 700)
+    pool.release()
+    assert pool.stats()["cached"] == 0
+
+
+def test_engine_write_read_ordering():
+    eng = native.NativeEngine(num_workers=4)
+    v = eng.new_var()
+    log = []
+
+    def writer():
+        time.sleep(0.05)
+        log.append("w")
+
+    eng.push(writer, mutable_vars=[v])
+    eng.push(lambda: log.append("r1"), const_vars=[v])
+    eng.push(lambda: log.append("r2"), const_vars=[v])
+    eng.wait_all()
+    assert log[0] == "w" and set(log[1:]) == {"r1", "r2"}
+
+
+def test_engine_waw_order():
+    eng = native.NativeEngine(num_workers=4)
+    v = eng.new_var()
+    log = []
+    for i in range(5):
+        eng.push(lambda i=i: log.append(i), mutable_vars=[v])
+    eng.wait_for_var(v)
+    assert log == [0, 1, 2, 3, 4]  # writers serialize in push order
+
+
+def test_engine_parallel_readers():
+    eng = native.NativeEngine(num_workers=4)
+    v = eng.new_var()
+    barrier = threading.Barrier(3, timeout=5)
+
+    def reader():
+        barrier.wait()  # deadlocks unless 3 readers run concurrently
+
+    for _ in range(3):
+        eng.push(reader, const_vars=[v])
+    eng.wait_all()
+
+
+def test_engine_error_propagation():
+    eng = native.NativeEngine(num_workers=2)
+    v = eng.new_var()
+
+    def boom():
+        raise ValueError("expected test error")
+
+    eng.push(boom, mutable_vars=[v])
+    with pytest.raises(RuntimeError):
+        eng.wait_for_var(v)
+
+
+def test_engine_independent_vars_run_concurrently():
+    eng = native.NativeEngine(num_workers=2)
+    v1, v2 = eng.new_var(), eng.new_var()
+    barrier = threading.Barrier(2, timeout=5)
+    eng.push(barrier.wait, mutable_vars=[v1])
+    eng.push(barrier.wait, mutable_vars=[v2])
+    eng.wait_all()
+
+
+def test_jpeg_codec_roundtrip():
+    rs = np.random.RandomState(0)
+    # smooth image compresses faithfully
+    x = np.linspace(0, 255, 64 * 48 * 3).reshape(64, 48, 3).astype(np.uint8)
+    buf = native.encode_jpeg(x, quality=95)
+    assert buf[:2] == b"\xff\xd8"
+    y = native.decode_jpeg(buf)
+    assert y.shape == (64, 48, 3)
+    assert np.abs(y.astype(float) - x.astype(float)).mean() < 4.0
+    # grayscale
+    g = rs.randint(0, 255, (32, 32)).astype(np.uint8)
+    gb = native.encode_jpeg(g)
+    gd = native.decode_jpeg(gb)
+    assert gd.shape[2] == 3  # decoded as RGB
+    with pytest.raises(ValueError):
+        native.decode_jpeg(b"not a jpeg")
+
+
+def test_resize_bilinear():
+    x = np.zeros((4, 4, 3), np.uint8)
+    x[:2] = 100
+    y = native.resize_bilinear(x, 8, 8)
+    assert y.shape == (8, 8, 3)
+    assert y[0, 0, 0] == 100 and y[7, 7, 0] == 0
+
+
+def _write_img_rec(path, n=10, seed=0):
+    rs = np.random.RandomState(seed)
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(n):
+        img = rs.randint(0, 255, (36 + i, 42, 3)).astype(np.uint8)
+        w.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, img_fmt=".jpg"))
+    w.close()
+
+
+def test_image_record_loader(tmp_path):
+    path = str(tmp_path / "imgs.rec")
+    _write_img_rec(path)
+    loader = native.ImageRecordLoader(path, batch_size=4,
+                                      data_shape=(3, 32, 32),
+                                      num_workers=3, scale=1 / 255.0)
+    labels, batches = [], 0
+    while True:
+        out = loader.next()
+        if out is None:
+            break
+        data, label, n = out
+        assert data.shape == (4, 3, 32, 32)
+        assert np.isfinite(data).all() and data.max() <= 1.001
+        labels.extend(label[:n, 0].astype(int).tolist())
+        batches += 1
+    assert batches == 3  # 2 full + 1 partial
+    assert sorted(labels) == list(range(10))
+    # second epoch after reset
+    loader.reset()
+    out = loader.next()
+    assert out is not None and out[2] == 4
+    loader.close()
+
+
+def test_image_record_loader_deterministic_order(tmp_path):
+    """Unshuffled loader yields batches in file order regardless of worker
+    completion order (regression)."""
+    path = str(tmp_path / "imgs.rec")
+    _write_img_rec(path, n=24)
+    for workers in (1, 4):
+        loader = native.ImageRecordLoader(path, batch_size=4,
+                                          data_shape=(3, 16, 16),
+                                          num_workers=workers)
+        labels = []
+        while True:
+            out = loader.next()
+            if out is None:
+                break
+            labels.extend(out[1][:out[2], 0].astype(int).tolist())
+        assert labels == list(range(24)), (workers, labels)
+        loader.close()
+
+
+def test_image_record_loader_shuffle_augment(tmp_path):
+    path = str(tmp_path / "imgs.rec")
+    _write_img_rec(path)
+    loader = native.ImageRecordLoader(
+        path, batch_size=5, data_shape=(3, 24, 24), num_workers=2,
+        shuffle=True, seed=7, rand_mirror=True, rand_crop=True)
+    labels = []
+    while True:
+        out = loader.next()
+        if out is None:
+            break
+        labels.extend(out[1][:out[2], 0].astype(int).tolist())
+    assert sorted(labels) == list(range(10))
+    loader.close()
+
+
+def test_image_record_iter_native(tmp_path):
+    """mx.io.ImageRecordIter rides the native pipeline end to end."""
+    path = str(tmp_path / "imgs.rec")
+    _write_img_rec(path)
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 28, 28),
+                               batch_size=4, preprocess_threads=2,
+                               scale=1 / 255.0)
+    assert it._native is not None
+    count = 0
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 28, 28)
+        count += 1
+    assert count == 3
+    it.reset()
+    assert next(iter(it)).data[0].shape == (4, 3, 28, 28)
+
+
+def test_pack_unpack_img_jpeg():
+    img = np.linspace(0, 255, 30 * 20 * 3).reshape(30, 20, 3).astype(
+        np.uint8)
+    s = recordio.pack_img(recordio.IRHeader(0, 3.0, 1, 0), img)
+    header, out = recordio.unpack_img(s)
+    assert header.label == 3.0
+    assert out.shape == (30, 20, 3)
+    assert np.abs(out.astype(float) - img.astype(float)).mean() < 4.0
+
+
+def test_imdecode_imresize_native():
+    from mxnet_tpu import image
+
+    img = np.linspace(0, 255, 40 * 40 * 3).reshape(40, 40, 3).astype(
+        np.uint8)
+    buf = native.encode_jpeg(img)
+    dec = image.imdecode(buf)
+    assert dec.shape == (40, 40, 3)
+    resized = image.imresize(dec, 20, 10)
+    assert resized.shape == (10, 20, 3)
