@@ -1,4 +1,4 @@
 """Contrib (reference python/mxnet/contrib/ — amp, onnx, tensorboard...)."""
-from . import amp
+from . import amp, quantization
 
-__all__ = ["amp"]
+__all__ = ["amp", "quantization"]

@@ -1,0 +1,331 @@
+"""INT8 post-training quantization driver.
+
+Reference capability: src/operator/quantization/{quantize_graph_pass.cc,
+calibrate.cc} + the (pre-2.0) python quantize_model flow: calibrate
+activation ranges over a calibration set (naive min/max, percentile, or
+KL-entropy), rewrite the graph to quantized ops, and keep excluded layers
+in float.
+
+TPU-native redesign: calibration hooks on Gluon blocks collect activation
+histograms; ``quantize_net`` swaps Dense/Conv2D children for
+Quantized{Dense,Conv2D} wrappers whose int8 GEMMs hit the MXU int8 path
+(ops/quantization.py) with pre-quantized weights and calibrated input
+scales.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..gluon.block import Block, HybridBlock
+from ..gluon import nn as _nn
+
+__all__ = ["calib_entropy_threshold", "LayerCalibrator", "quantize_net",
+           "QuantizedDense", "QuantizedConv2D"]
+
+
+def calib_entropy_threshold(hist, bin_edges, num_quantized_bins=255):
+    """KL-divergence-optimal |threshold| from an |activation| histogram
+    (the standard TensorRT/MXNet entropy calibration algorithm,
+    reference calibrate.cc).  Returns the chosen absolute threshold."""
+    hist = _np.asarray(hist, dtype=_np.float64)
+    num_bins = len(hist)
+    if num_bins < num_quantized_bins + 2:
+        return float(bin_edges[-1])
+
+    def smooth(d, eps=1e-4):
+        # move eps mass onto empty bins so KL stays finite (the standard
+        # _smooth_distribution step of the entropy calibration algorithm)
+        is_zero = d == 0
+        n_zero = is_zero.sum()
+        n_nonzero = d.size - n_zero
+        if n_nonzero == 0:
+            return d
+        eps1 = eps * float(n_zero) / float(n_nonzero)
+        out = d.astype(_np.float64).copy()
+        out[is_zero] = eps
+        out[~is_zero] -= eps1
+        return out
+
+    best_kl = _np.inf
+    best_thr = float(bin_edges[-1])
+    for i in range(num_quantized_bins, num_bins + 1):
+        ref = hist[:i].copy()
+        # outliers clipped into the last kept bin
+        ref[i - 1] += hist[i:].sum()
+        p = ref / max(ref.sum(), 1e-12)
+        # quantize the first i bins down to num_quantized_bins
+        chunks = _np.array_split(hist[:i], num_quantized_bins)
+        q = _np.concatenate([
+            _np.full(len(c), (c.sum() / max((c > 0).sum(), 1)) if
+                     (c > 0).any() else 0.0) for c in chunks])
+        q[hist[:i] == 0] = 0.0
+        q = q / max(q.sum(), 1e-12)
+        p, q = smooth(p), smooth(q)
+        kl = float(_np.sum(p * _np.log(_np.maximum(p, 1e-12)
+                                       / _np.maximum(q, 1e-12))))
+        if kl < best_kl:
+            best_kl = kl
+            best_thr = float(bin_edges[i])
+    return best_thr
+
+
+class LayerCalibrator:
+    """Forward-pre-hook collector for one layer's input range.
+
+    Fixed-size state regardless of how many batches flow through
+    (reference calibrate.cc accumulates a histogram, not raw samples):
+    a 2048-bin |activation| histogram that is rescaled in place whenever a
+    new batch extends the observed range."""
+
+    def __init__(self, mode="naive", num_bins=2048, percentile=99.99):
+        self.mode = mode
+        self.num_bins = num_bins
+        self.percentile = percentile
+        self.amax = 0.0
+        self.hist = _np.zeros(num_bins, dtype=_np.float64)
+
+    def _rescale(self, new_amax):
+        """Re-bin the accumulated histogram onto the wider range."""
+        old = self.hist
+        self.hist = _np.zeros(self.num_bins, dtype=_np.float64)
+        if self.amax > 0:
+            centers = (_np.arange(self.num_bins) + 0.5) * (
+                self.amax / self.num_bins)
+            idx = _np.minimum(
+                (centers / new_amax * self.num_bins).astype(_np.int64),
+                self.num_bins - 1)
+            _np.add.at(self.hist, idx, old)
+        self.amax = new_amax
+
+    def observe(self, x):
+        arr = _np.abs(x.asnumpy().astype(_np.float32)).ravel()
+        if arr.size == 0:
+            return
+        cur_max = float(arr.max())
+        if cur_max > self.amax:
+            self._rescale(cur_max)
+        if self.amax > 0:
+            h, _ = _np.histogram(arr, bins=self.num_bins,
+                                 range=(0, self.amax))
+            self.hist += h
+
+    def threshold(self):
+        if self.amax == 0.0:
+            return 1.0
+        if self.mode == "naive":
+            return self.amax
+        edges = _np.linspace(0, self.amax, self.num_bins + 1)
+        if self.mode == "percentile":
+            cdf = _np.cumsum(self.hist)
+            total = cdf[-1]
+            if total == 0:
+                return self.amax
+            k = int(_np.searchsorted(cdf, total * self.percentile / 100.0))
+            return float(edges[min(k + 1, self.num_bins)])
+        return calib_entropy_threshold(self.hist, edges)
+
+
+def _const_param(name, value, dtype=None):
+    """Non-learnable registered parameter holding concrete data, so the
+    quantized layer serializes through save/load_parameters."""
+    from ..gluon.parameter import Parameter
+
+    arr = value if isinstance(value, nd.NDArray) else nd.array(
+        _np.asarray(value, dtype=dtype or _np.float32), dtype=dtype)
+    p = Parameter(name, grad_req="null", shape=arr.shape,
+                  dtype=dtype or arr.dtype, differentiable=False)
+    p.set_data(arr)
+    return p
+
+
+def _quantize_weight(w):
+    arr = w.asnumpy()
+    amax = max(float(_np.abs(arr).max()), 1e-12)
+    scale = 127.0 / amax
+    q = _np.clip(_np.round(arr * scale), -127, 127).astype(_np.int8)
+    return q, scale
+
+
+class QuantizedDense(HybridBlock):
+    """int8 replacement for nn.Dense built from a calibrated float layer.
+    All state (int8 weight, f32 bias, input threshold, weight scale) lives
+    in registered null-grad Parameters so save/load_parameters round-trips
+    the quantized model."""
+
+    def __init__(self, dense, input_threshold):
+        super().__init__()
+        self._units = dense._units
+        self._flatten = dense._flatten
+        self._activation = dense._activation
+        q, scale_w = _quantize_weight(dense.weight.data())
+        self.weight_q = _const_param("weight_q", q, dtype="int8")
+        self.scale_w = _const_param("scale_w", [scale_w])
+        self.thr_in = _const_param("thr_in", [float(input_threshold)])
+        self.bias = (_const_param("bias", dense.bias.data())
+                     if dense.bias is not None else None)
+
+    def forward(self, x):
+        thr = self.thr_in.data()
+        q, _mn, _mx = nd.quantize_v2(x, min_calib_range=-thr,
+                                     max_calib_range=thr)
+        out = nd.quantized_fully_connected(
+            q, self.weight_q.data(),
+            self.bias.data() if self.bias is not None else None,
+            127.0 / thr, self.scale_w.data(),
+            num_hidden=self._units, flatten=self._flatten,
+            no_bias=self.bias is None)
+        if self._activation:
+            out = nd.Activation(out, act_type=self._activation)
+        return out
+
+    def __repr__(self):
+        return "QuantizedDense(-> %d, thr=%.4g)" % (
+            self._units, float(self.thr_in.data().asnumpy()[0]))
+
+
+class QuantizedConv2D(HybridBlock):
+    """int8 replacement for nn.Conv2D (layout-aware; same Parameter
+    serialization contract as QuantizedDense)."""
+
+    def __init__(self, conv, input_threshold):
+        super().__init__()
+        self._kernel = conv._kernel
+        self._strides = conv._strides
+        self._padding = conv._padding
+        self._dilation = conv._dilation
+        self._channels = conv._channels
+        self._groups = conv._groups
+        self._layout = conv._layout
+        self._activation = getattr(conv, "_activation", None)
+        q, scale_w = _quantize_weight(conv.weight.data())
+        self.weight_q = _const_param("weight_q", q, dtype="int8")
+        self.scale_w = _const_param("scale_w", [scale_w])
+        self.thr_in = _const_param("thr_in", [float(input_threshold)])
+        self.bias = (_const_param("bias", conv.bias.data())
+                     if conv.bias is not None else None)
+
+    def forward(self, x):
+        thr = self.thr_in.data()
+        q, _mn, _mx = nd.quantize_v2(x, min_calib_range=-thr,
+                                     max_calib_range=thr)
+        out = nd.quantized_conv(
+            q, self.weight_q.data(),
+            self.bias.data() if self.bias is not None else None,
+            127.0 / thr, self.scale_w.data(),
+            kernel=self._kernel, stride=self._strides, dilate=self._dilation,
+            pad=self._padding, num_filter=self._channels,
+            num_group=self._groups, no_bias=self.bias is None,
+            layout=self._layout)
+        if self._activation:
+            out = nd.Activation(out, act_type=self._activation)
+        return out
+
+
+_QUANTIZABLE = {}
+
+
+def _register_quantizable():
+    _QUANTIZABLE[_nn.Dense] = QuantizedDense
+    if hasattr(_nn, "Conv2D"):
+        _QUANTIZABLE[_nn.Conv2D] = QuantizedConv2D
+
+
+def quantize_net(net, calib_data=None, calib_mode="naive",
+                 quantized_dtype="int8", exclude_layers=None,
+                 num_calib_batches=None, logger=None):
+    """Post-training-quantize a Gluon network in place.
+
+    calib_data: iterable of input batches (NDArray) run through the net to
+    collect per-layer input ranges.  calib_mode: 'naive' | 'percentile' |
+    'entropy'.  Layers named in exclude_layers keep float32.
+    Returns the (mutated) net.  Reference flow: quantize_graph_pass +
+    calibrate.cc + quantize_model."""
+    if quantized_dtype != "int8":
+        raise MXNetError("only int8 quantization is supported")
+    if not _QUANTIZABLE:
+        _register_quantizable()
+    exclude = set(exclude_layers or [])
+
+    # deactivate hybridization for the whole calibration+rewrite pass: the
+    # cached-op path skips forward hooks (calibration would silently see
+    # nothing) and its compiled programs become stale once children are
+    # swapped.  Restored (with cleared caches) at the end.
+    hybrid_state = []
+
+    def walk_hybrids(block):
+        if isinstance(block, HybridBlock):
+            hybrid_state.append((block, block._active))
+            block._active = False
+            block._cached_ops = {}
+        for child in block._children.values():
+            walk_hybrids(child)
+
+    walk_hybrids(net)
+
+    # 1. find quantizable leaves and hook calibrators on them
+    targets = []  # (parent, name, child)
+
+    def visit(block, prefix):
+        for name, child in list(block._children.items()):
+            path = "%s.%s" % (prefix, name) if prefix else name
+            if type(child) in _QUANTIZABLE and path not in exclude \
+                    and name not in exclude:
+                targets.append((block, name, path, child))
+            else:
+                visit(child, path)
+
+    visit(net, "")
+    if not targets:
+        return net
+
+    calibrators = {}
+    handles = []
+    for _parent, _name, path, child in targets:
+        cal = LayerCalibrator(mode=calib_mode)
+        calibrators[path] = cal
+
+        def make_hook(c):
+            def hook(_block, inputs):
+                c.observe(inputs[0])
+
+            return hook
+
+        handles.append(child.register_forward_pre_hook(make_hook(cal)))
+
+    # 2. run calibration data
+    if calib_data is not None:
+        for i, batch in enumerate(calib_data):
+            if num_calib_batches is not None and i >= num_calib_batches:
+                break
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            net(x)
+    for h in handles:
+        h.detach()
+
+    # 3. swap children for quantized replacements
+    for parent, name, path, child in targets:
+        thr = calibrators[path].threshold() if calib_data is not None else 1.0
+        qcls = _QUANTIZABLE[type(child)]
+        qlayer = qcls(child, thr)
+        setattr(parent, name, qlayer)
+        # containers keep extra references to children beyond _children:
+        # Sequential._layers drives forward; register_child stores a
+        # _child_<name> attribute (set via object.__setattr__ to bypass
+        # Block's registration logic)
+        layers = getattr(parent, "_layers", None)
+        if isinstance(layers, list):
+            for i, layer in enumerate(layers):
+                if layer is child:
+                    layers[i] = qlayer
+        if getattr(parent, "_child_%s" % name, None) is child:
+            object.__setattr__(parent, "_child_%s" % name, qlayer)
+        if logger:
+            logger.info("quantized %s (threshold %.4g)", path, thr)
+
+    # restore hybridization with fresh caches (graph changed under them)
+    for block, active in hybrid_state:
+        block._active = active
+        block._cached_ops = {}
+    return net

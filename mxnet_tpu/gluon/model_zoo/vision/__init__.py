@@ -6,7 +6,7 @@ import importlib
 from ....base import MXNetError
 
 _MODULE_NAMES = ("resnet", "vgg", "alexnet", "mobilenet", "squeezenet",
-                 "densenet")
+                 "densenet", "inception")
 _models = {}
 for _mod_name in _MODULE_NAMES:
     _mod = importlib.import_module("." + _mod_name, __name__)

@@ -1,0 +1,171 @@
+"""Inception-V3 (reference model_zoo/vision/inception.py — the Szegedy
+et al. architecture with factorized 7x7 convolutions and grid-reduction
+blocks; the reference's Inception training row is a headline benchmark in
+docs perf.md:243-252)."""
+from __future__ import annotations
+
+from .... import ndarray as nd
+from ... import nn
+from ...block import HybridBlock
+
+__all__ = ["Inception3", "inception_v3"]
+
+
+def _conv2d(channels, kernel_size, strides=1, padding=0):
+    out = nn.HybridSequential()
+    out.add(nn.Conv2D(channels, kernel_size, strides=strides,
+                      padding=padding, use_bias=False))
+    out.add(nn.BatchNorm(epsilon=0.001))
+    out.add(nn.Activation("relu"))
+    return out
+
+
+class _Concurrent(HybridBlock):
+    """Parallel branches concatenated on the channel axis (reference
+    gluon.contrib.nn.HybridConcurrent)."""
+
+    def __init__(self):
+        super().__init__()
+        self._branches = []
+
+    def add(self, block):
+        self._branches.append(block)
+        self.register_child(block)
+
+    def forward(self, x):
+        return nd.concat(*[b(x) for b in self._branches], dim=1)
+
+
+def _make_A(pool_features):
+    out = _Concurrent()
+    b1 = _conv2d(64, 1)
+    out.add(b1)
+    b2 = nn.HybridSequential()
+    b2.add(_conv2d(48, 1), _conv2d(64, 5, padding=2))
+    out.add(b2)
+    b3 = nn.HybridSequential()
+    b3.add(_conv2d(64, 1), _conv2d(96, 3, padding=1),
+           _conv2d(96, 3, padding=1))
+    out.add(b3)
+    b4 = nn.HybridSequential()
+    b4.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1),
+           _conv2d(pool_features, 1))
+    out.add(b4)
+    return out
+
+
+def _make_B():
+    """35x35 -> 17x17 grid reduction."""
+    out = _Concurrent()
+    out.add(_conv2d(384, 3, strides=2))
+    b2 = nn.HybridSequential()
+    b2.add(_conv2d(64, 1), _conv2d(96, 3, padding=1),
+           _conv2d(96, 3, strides=2))
+    out.add(b2)
+    out.add(nn.MaxPool2D(pool_size=3, strides=2))
+    return out
+
+
+def _make_C(channels_7x7):
+    out = _Concurrent()
+    out.add(_conv2d(192, 1))
+    c = channels_7x7
+    b2 = nn.HybridSequential()
+    b2.add(_conv2d(c, 1), _conv2d(c, (1, 7), padding=(0, 3)),
+           _conv2d(192, (7, 1), padding=(3, 0)))
+    out.add(b2)
+    b3 = nn.HybridSequential()
+    b3.add(_conv2d(c, 1), _conv2d(c, (7, 1), padding=(3, 0)),
+           _conv2d(c, (1, 7), padding=(0, 3)),
+           _conv2d(c, (7, 1), padding=(3, 0)),
+           _conv2d(192, (1, 7), padding=(0, 3)))
+    out.add(b3)
+    b4 = nn.HybridSequential()
+    b4.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1),
+           _conv2d(192, 1))
+    out.add(b4)
+    return out
+
+
+def _make_D():
+    """17x17 -> 8x8 grid reduction."""
+    out = _Concurrent()
+    b1 = nn.HybridSequential()
+    b1.add(_conv2d(192, 1), _conv2d(320, 3, strides=2))
+    out.add(b1)
+    b2 = nn.HybridSequential()
+    b2.add(_conv2d(192, 1), _conv2d(192, (1, 7), padding=(0, 3)),
+           _conv2d(192, (7, 1), padding=(3, 0)),
+           _conv2d(192, 3, strides=2))
+    out.add(b2)
+    out.add(nn.MaxPool2D(pool_size=3, strides=2))
+    return out
+
+
+class _BranchSplit(HybridBlock):
+    """1x3 + 3x1 factorized pair, concatenated."""
+
+    def __init__(self):
+        super().__init__()
+        self.a = _conv2d(384, (1, 3), padding=(0, 1))
+        self.b = _conv2d(384, (3, 1), padding=(1, 0))
+
+    def forward(self, x):
+        return nd.concat(self.a(x), self.b(x), dim=1)
+
+
+def _make_E():
+    out = _Concurrent()
+    out.add(_conv2d(320, 1))
+    b2 = nn.HybridSequential()
+    b2.add(_conv2d(384, 1), _BranchSplit())
+    out.add(b2)
+    b3 = nn.HybridSequential()
+    b3.add(_conv2d(448, 1), _conv2d(384, 3, padding=1), _BranchSplit())
+    out.add(b3)
+    b4 = nn.HybridSequential()
+    b4.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1),
+           _conv2d(192, 1))
+    out.add(b4)
+    return out
+
+
+class Inception3(HybridBlock):
+    """Inception-V3 (input 3x299x299)."""
+
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__()
+        self.features = nn.HybridSequential()
+        self.features.add(_conv2d(32, 3, strides=2))
+        self.features.add(_conv2d(32, 3))
+        self.features.add(_conv2d(64, 3, padding=1))
+        self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+        self.features.add(_conv2d(80, 1))
+        self.features.add(_conv2d(192, 3))
+        self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+        self.features.add(_make_A(32))
+        self.features.add(_make_A(64))
+        self.features.add(_make_A(64))
+        self.features.add(_make_B())
+        self.features.add(_make_C(128))
+        self.features.add(_make_C(160))
+        self.features.add(_make_C(160))
+        self.features.add(_make_C(192))
+        self.features.add(_make_D())
+        self.features.add(_make_E())
+        self.features.add(_make_E())
+        self.features.add(nn.AvgPool2D(pool_size=8))
+        self.features.add(nn.Dropout(0.5))
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+def inception_v3(pretrained=False, classes=1000, **kwargs):
+    """Inception-V3 constructor (reference inception.py inception_v3)."""
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled in this "
+                         "environment; initialize() and train instead")
+    return Inception3(classes=classes, **kwargs)

@@ -225,7 +225,8 @@ def load(fname):
 from .. import random  # noqa: E402  (mx.nd.random mirror)
 from . import sparse  # noqa: E402
 from . import contrib  # noqa: E402
+from ..operator import Custom  # noqa: E402  (mx.nd.Custom, reference name)
 
 __all__ = ["NDArray", "waitall", "array", "zeros", "ones", "full", "empty",
            "arange", "linspace", "eye", "save", "load", "concatenate",
-           "random", "sparse"] + list_ops()
+           "random", "sparse", "contrib", "Custom"] + list_ops()

@@ -7,7 +7,17 @@ from ..ndarray import (Activation, BatchNorm, Convolution, Deconvolution,
                        Embedding, FullyConnected, LayerNorm, Pooling,
                        dropout, one_hot, pick, relu, sigmoid, softmax,
                        log_softmax, topk, gamma, erf, erfinv,
-                       sequence_mask, gather_nd, reshape, batch_dot)
+                       sequence_mask, gather_nd, reshape, batch_dot,
+                       leaky_relu, smooth_l1, group_norm, instance_norm,
+                       rms_norm, l2_normalization, ctc_loss,
+                       multi_head_attention, quantize, quantize_v2,
+                       dequantize, requantize, sort, argsort,
+                       take_along_axis, scatter_nd, sequence_last,
+                       sequence_reverse, cast)
+from ..ndarray.contrib import (foreach, while_loop, cond, isfinite, isnan,
+                               isinf, arange_like, index_copy, index_array,
+                               boolean_mask)
+from ..operator import Custom  # noqa: F401  (npx.Custom)
 from ..util import (is_np_array, is_np_shape, reset_np, set_np, use_np,
                     use_np_array, use_np_shape)
 from ..context import cpu, current_context, gpu, num_gpus, num_tpus, tpu

@@ -1,0 +1,159 @@
+"""``mx.operator`` — user-defined (python) operators.
+
+Reference capability: python/mxnet/operator.py (1,185 LoC) CustomOp /
+CustomOpProp + src/operator/custom/custom-inl.h: python forward/backward
+callbacks registered by name and invoked as ordinary ops, with autograd
+support (``need_top_grad``) and req-aware output assignment.
+
+TPU-native redesign: no callback thread pool is needed — the custom
+forward runs eagerly on NDArrays (XLA dispatch keeps the async contract),
+and autograd integration is a TapeNode whose vjp calls the user's
+``backward`` (the reference pushes the same callbacks through
+CustomOperator's engine thread, custom-inl.h:76).  Custom ops execute
+op-by-op and are excluded from hybridize fusion, matching the reference's
+behavior where Custom breaks bulking segments.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered",
+           "Custom"]
+
+_CUSTOM_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for user ops (reference operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise MXNetError("backward not implemented for this CustomOp")
+
+    def assign(self, dst, req, src):
+        """req-aware store (reference CustomOp.assign)."""
+        if req == "null":
+            return
+        src = src if isinstance(src, NDArray) else NDArray(src)
+        if req in ("write", "inplace"):
+            dst._data = src._data
+        elif req == "add":
+            dst._data = dst._data + src._data
+        else:
+            raise MXNetError("unknown req %r" % req)
+
+
+class CustomOpProp:
+    """Describes a custom op (reference operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Register a CustomOpProp under a name (reference operator.py
+    register; C++ side MXNET_REGISTER_OP_PROPERTY for Custom)."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_all_registered():
+    return dict(_CUSTOM_REGISTRY)
+
+
+def Custom(*inputs, op_type=None, **kwargs):
+    """Invoke a registered custom op: ``mx.nd.Custom(x, op_type='sigmoid')``
+    (reference: the generated Custom op wrapper → CustomOperator::Push).
+    """
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    prop_cls = _CUSTOM_REGISTRY.get(op_type)
+    if prop_cls is None:
+        raise MXNetError("custom op %r is not registered" % op_type)
+    str_kwargs = {k: str(v) for k, v in kwargs.items()}
+    prop = prop_cls(**str_kwargs)
+
+    in_data = [x if isinstance(x, NDArray) else NDArray(x) for x in inputs]
+    n_args = len(prop.list_arguments())
+    if len(in_data) != n_args:
+        raise MXNetError("custom op %r expects %d inputs, got %d"
+                         % (op_type, n_args, len(in_data)))
+    in_shapes = [list(x.shape) for x in in_data]
+    ishapes, oshapes, aux_shapes = prop.infer_shape(in_shapes)
+    itypes, otypes, aux_types = prop.infer_type(
+        [x.dtype for x in in_data])
+    op = prop.create_operator(None, ishapes, itypes)
+
+    import jax.numpy as jnp
+
+    out_data = [NDArray(jnp.zeros(tuple(s), dtype=_np.dtype(t)))
+                for s, t in zip(oshapes, otypes)]
+    aux = [NDArray(jnp.zeros(tuple(s), dtype=_np.dtype(t)))
+           for s, t in zip(aux_shapes, aux_types)]
+
+    from .base import thread_state
+    from . import autograd
+
+    is_train = autograd.is_training() or thread_state.is_recording
+    op.forward(is_train, ["write"] * len(out_data), in_data, out_data, aux)
+
+    recordable = thread_state.is_recording and any(
+        getattr(x, "_marked", False) or getattr(x, "_entry", None)
+        for x in in_data)
+    if recordable:
+        from .autograd import TapeNode
+
+        def vjp_wrapper(out_cts, _op=op, _in=in_data, _out=out_data,
+                        _aux=aux):
+            in_grad = [NDArray(jnp.zeros(x.shape, x.dtype)) for x in _in]
+            out_grad = [NDArray(ct) for ct in out_cts]
+            _op.backward(["write"] * len(in_grad), out_grad, _in, _out,
+                         in_grad, _aux)
+            return [g._data for g in in_grad]
+
+        node = TapeNode(vjp_wrapper, in_data, len(out_data),
+                        out_avals=[(o.shape, o.dtype) for o in out_data],
+                        name="Custom:%s" % op_type)
+        for i, o in enumerate(out_data):
+            if _np.issubdtype(o.dtype, _np.floating):
+                o._entry = (node, i)
+
+    return out_data[0] if len(out_data) == 1 else tuple(out_data)

@@ -4,7 +4,7 @@ Reference: src/operator/ (201k LoC across nn/tensor/numpy/contrib/random) —
 here each op is a pure JAX function registered into mxnet_tpu.ops.registry
 (see registry.py for the dispatch design).
 """
-from . import core, nn  # noqa: F401  (registration side effects)
+from . import core, nn, quantization  # noqa: F401  (registration effects)
 from .registry import Operator, apply_op, get_op, invoke, list_ops, register
 
 __all__ = ["Operator", "register", "get_op", "list_ops", "invoke", "apply_op"]

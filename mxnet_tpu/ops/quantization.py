@@ -1,0 +1,126 @@
+"""INT8 quantization operators.
+
+Reference capability: src/operator/quantization/ — quantize/dequantize/
+requantize ops plus quantized conv/FC kernels (MKLDNN int8 on CPU,
+cuDNN int8 on GPU) and the calibration machinery (calibrate.cc).
+
+TPU-native redesign: symmetric int8 quantization (zero-point 0) feeding
+``lax.dot_general``/``lax.conv_general_dilated`` with
+``preferred_element_type=int32`` — the layout XLA lowers onto the MXU's
+int8 systolic path; scales stay per-tensor f32 scalars so the requantize
+epilogue fuses into the matmul.  The graph-rewrite driver lives in
+mxnet_tpu/contrib/quantization.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = ["quantize", "quantize_v2", "dequantize", "requantize",
+           "quantized_fully_connected", "quantized_conv"]
+
+
+def _scale_of(min_range, max_range, dtype):
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    amax = jnp.maximum(amax, 1e-12)
+    qmax = 127.0 if dtype == "int8" else 255.0
+    return qmax / amax
+
+
+@register("quantize", differentiable=False, num_outputs=3)
+def quantize(data, min_range, max_range, out_type="int8"):
+    """f32 -> int8 with explicit range (reference quantize.cc).  Returns
+    (quantized, min_range, max_range) like the reference's 3-output op."""
+    scale = _scale_of(min_range, max_range, out_type)
+    q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    return q, jnp.asarray(min_range, jnp.float32), jnp.asarray(
+        max_range, jnp.float32)
+
+
+@register("quantize_v2", differentiable=False, num_outputs=3)
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8"):
+    """Range-auto quantize (reference quantize_v2.cc): calibrated range if
+    given, else the tensor's observed min/max."""
+    if min_calib_range is None or max_calib_range is None:
+        amax = jnp.max(jnp.abs(data))
+        mn, mx = -amax, amax
+    else:
+        mn = jnp.asarray(min_calib_range, jnp.float32)
+        mx = jnp.asarray(max_calib_range, jnp.float32)
+    scale = _scale_of(mn, mx, out_type)
+    q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    return q, jnp.asarray(mn, jnp.float32), jnp.asarray(mx, jnp.float32)
+
+
+@register("dequantize", differentiable=False)
+def dequantize(data, min_range, max_range, out_type="float32"):
+    scale = _scale_of(min_range, max_range, "int8")
+    return data.astype(jnp.float32) / scale
+
+
+@register("requantize", differentiable=False, num_outputs=3)
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    """int32 accumulator -> int8 (reference requantize.cc): rescale the
+    wide accumulator into the calibrated int8 output range."""
+    # data: int32 with implied scale (min_range..max_range per int32 unit)
+    real = data.astype(jnp.float32) * (
+        jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) / (2.0 ** 31))
+    if min_calib_range is None:
+        amax = jnp.max(jnp.abs(real))
+        mn, mx = -amax, amax
+    else:
+        mn = jnp.asarray(min_calib_range, jnp.float32)
+        mx = jnp.asarray(max_calib_range, jnp.float32)
+    scale = _scale_of(mn, mx, "int8")
+    q = jnp.clip(jnp.round(real * scale), -127, 127).astype(jnp.int8)
+    return q, mn, mx
+
+
+@register("quantized_fully_connected", differentiable=False)
+def quantized_fully_connected(x_q, w_q, bias, scale_x, scale_w,
+                              num_hidden=None, flatten=True, no_bias=False):
+    """int8 × int8 → int32 on the MXU, f32 epilogue (reference
+    quantized_fully_connected.cc).  x_q: (N, K) int8; w_q: (O, K) int8;
+    bias: f32 (unquantized — added after rescale); scales: f32 scalars."""
+    if flatten and x_q.ndim > 2:
+        x_q = x_q.reshape(x_q.shape[0], -1)
+    acc = lax.dot_general(x_q, w_q, (((x_q.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    y = acc.astype(jnp.float32) / (scale_x * scale_w)
+    if bias is not None and not no_bias:
+        y = y + bias
+    return y
+
+
+@register("quantized_conv", differentiable=False)
+def quantized_conv(x_q, w_q, bias, scale_x, scale_w, kernel=None,
+                   stride=None, dilate=None, pad=None, num_filter=None,
+                   num_group=1, no_bias=False, layout=None):
+    """int8 convolution, int32 accumulation (reference quantized_conv.cc);
+    activation layout per ``layout`` (default NCHW), OIHW weights —
+    mirrors ops/nn.py convolution's dimension handling."""
+    from .nn import _conv_dims
+
+    nd = x_q.ndim
+    nspatial = nd - 2
+    stride = tuple(stride) if stride else (1,) * nspatial
+    dilate = tuple(dilate) if dilate else (1,) * nspatial
+    pad = tuple(pad) if pad else (0,) * nspatial
+    dn_layout = _conv_dims(nd, layout)
+    dn = lax.conv_dimension_numbers(
+        x_q.shape, w_q.shape, dn_layout[:2] + (dn_layout[2],))
+    acc = lax.conv_general_dilated(
+        x_q, w_q, window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group, preferred_element_type=jnp.int32)
+    y = acc.astype(jnp.float32) / (scale_x * scale_w)
+    if bias is not None and not no_bias:
+        c_axis = dn_layout[0].index("C")
+        shape = [1] * nd
+        shape[c_axis] = bias.shape[0]
+        y = y + bias.reshape(shape)
+    return y

@@ -1,0 +1,130 @@
+"""Custom python operator tests (reference tests/python/unittest/
+test_operator.py::test_custom_op strategy: forward parity, backward via
+declared dependency, multi-output, req handling)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+class Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0]
+        y = 1.0 / (1.0 + (-x).exp())
+        self.assign(out_data[0], req[0], y)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * y * (1 - y))
+
+
+@mx.operator.register("test_sigmoid")
+class SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sigmoid()
+
+
+class SplitHalf(mx.operator.CustomOp):
+    """Two-output op: (x, 2x)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0])
+        self.assign(out_data[1], req[1], in_data[0] * 2)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0] + out_grad[1] * 2)
+
+
+@mx.operator.register("test_splithalf")
+class SplitHalfProp(mx.operator.CustomOpProp):
+    def list_outputs(self):
+        return ["same", "double"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return SplitHalf()
+
+
+def test_custom_forward():
+    x = mx.nd.array(np.array([-1.0, 0.0, 2.0], np.float32))
+    y = mx.nd.Custom(x, op_type="test_sigmoid")
+    want = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(y.asnumpy(), want, rtol=1e-6)
+
+
+def test_custom_backward():
+    x = mx.nd.array(np.array([-1.0, 0.5, 2.0], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Custom(x, op_type="test_sigmoid")
+        loss = (y * 3).sum()
+    loss.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(x.grad.asnumpy(), 3 * s * (1 - s), rtol=1e-5)
+
+
+def test_custom_composes_with_builtin_ops():
+    x = mx.nd.array(np.array([0.3, -0.7], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        h = x * 2
+        y = mx.nd.Custom(h, op_type="test_sigmoid")
+        loss = y.sum()
+    loss.backward()
+    s = 1 / (1 + np.exp(-2 * x.asnumpy()))
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * s * (1 - s), rtol=1e-5)
+
+
+def test_custom_multi_output():
+    x = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    x.attach_grad()
+    a, b = mx.nd.Custom(x, op_type="test_splithalf")
+    np.testing.assert_allclose(a.asnumpy(), [1, 2])
+    np.testing.assert_allclose(b.asnumpy(), [2, 4])
+    with mx.autograd.record():
+        a, b = mx.nd.Custom(x, op_type="test_splithalf")
+        loss = (a + b).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [3.0, 3.0])
+
+
+def test_custom_in_gluon_block():
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    class Net(gluon.Block):
+        def __init__(self):
+            super().__init__()
+            self.dense = nn.Dense(4)
+
+        def forward(self, x):
+            return mx.nd.Custom(self.dense(x), op_type="test_sigmoid")
+
+    net = Net()
+    net.initialize()
+    x = mx.nd.ones((2, 3))
+    x.attach_grad()
+    with mx.autograd.record():
+        out = net(x)
+        out.sum().backward()
+    assert out.shape == (2, 4)
+    assert np.isfinite(x.grad.asnumpy()).all()
+    w = list(net.collect_params().values())[0]
+    assert w.grad() is not None
+    assert np.abs(w.grad().asnumpy()).sum() > 0
+
+
+def test_custom_errors():
+    with pytest.raises(Exception):
+        mx.nd.Custom(mx.nd.ones((2,)), op_type="not_registered_op")
+    with pytest.raises(Exception):
+        mx.nd.Custom(mx.nd.ones((2,)), mx.nd.ones((2,)),
+                     op_type="test_sigmoid")  # wrong arity

@@ -1,0 +1,46 @@
+"""Model-zoo smoke tests (reference tests/python/unittest/
+test_gluon_model_zoo.py strategy: construct every model, forward a tiny
+batch, check output shape)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def setup_function(_f):
+    mx.random.seed(0)
+
+
+@pytest.mark.parametrize("name,insize", [
+    ("resnet18_v1", 32), ("resnet18_v2", 32), ("squeezenet1_0", 64),
+    ("mobilenet0_25", 32), ("mobilenet_v2_0_25", 32),
+    ("densenet121", 32), ("alexnet", 224), ("vgg11", 32),
+])
+def test_model_forward(name, insize):
+    net = vision.get_model(name, classes=7)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).rand(
+        1, 3, insize, insize).astype(np.float32))
+    out = net(x)
+    assert out.shape == (1, 7)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_inception_v3_forward_backward():
+    net = vision.get_model("inception_v3", classes=5)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).rand(
+        2, 3, 299, 299).astype(np.float32))
+    with mx.autograd.record():
+        out = net(x)
+        loss = out.sum()
+    loss.backward()
+    assert out.shape == (2, 5)
+    w = net.output.weight
+    assert np.abs(w.grad().asnumpy()).sum() > 0
+
+
+def test_get_model_unknown():
+    with pytest.raises(Exception):
+        vision.get_model("resnet999")
