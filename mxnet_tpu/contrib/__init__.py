@@ -1,4 +1,4 @@
 """Contrib (reference python/mxnet/contrib/ — amp, onnx, tensorboard...)."""
-from . import amp, quantization
+from . import amp, onnx, quantization
 
-__all__ = ["amp", "quantization"]
+__all__ = ["amp", "quantization", "onnx"]

@@ -1,0 +1,16 @@
+"""``mx.contrib.onnx`` — ONNX export/import.
+
+Reference capability: python/mxnet/contrib/onnx (~8k LoC of op-by-op
+mx2onnx/onnx2mx converters).
+
+TPU-native build: layer-structured Gluon nets (Sequential trees of the
+standard layers) export to real ONNX ModelProto files written with the
+bundled wire-format codec (_proto.py — no onnx package in this
+environment), and such files import back into runnable Gluon nets with
+weights.  ``export_model``/``import_model`` keep the reference entry-point
+names.
+"""
+from .mx2onnx import export_model  # noqa: F401
+from .onnx2mx import import_model  # noqa: F401
+
+__all__ = ["export_model", "import_model"]

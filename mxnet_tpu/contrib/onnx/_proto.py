@@ -1,0 +1,151 @@
+"""Minimal protobuf wire-format codec for the ONNX message subset.
+
+The environment bundles no ``onnx`` package, so the exporter/importer
+(mx2onnx.py / onnx2mx.py) serialize ModelProto directly on the protobuf
+wire format (varint/length-delimited encoding per the public protobuf
+spec).  Only the fields the exporter emits are modeled; unknown fields
+are skipped on decode, so files produced by other tools still parse for
+the supported subset.
+"""
+from __future__ import annotations
+
+import struct
+
+# wire types
+_VARINT = 0
+_I64 = 1
+_LEN = 2
+_I32 = 5
+
+
+def _enc_varint(v):
+    out = bytearray()
+    v &= (1 << 64) - 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _dec_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _signed(v):
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _key(field, wtype):
+    return _enc_varint((field << 3) | wtype)
+
+
+class Writer:
+    def __init__(self):
+        self._parts = []
+
+    def varint(self, field, value):
+        self._parts.append(_key(field, _VARINT) + _enc_varint(int(value)))
+        return self
+
+    def string(self, field, value):
+        data = value.encode() if isinstance(value, str) else bytes(value)
+        self._parts.append(_key(field, _LEN) + _enc_varint(len(data)) + data)
+        return self
+
+    def message(self, field, sub):
+        data = sub.bytes() if isinstance(sub, Writer) else bytes(sub)
+        self._parts.append(_key(field, _LEN) + _enc_varint(len(data)) + data)
+        return self
+
+    def floats_packed(self, field, values):
+        data = struct.pack("<%df" % len(values), *values)
+        self._parts.append(_key(field, _LEN) + _enc_varint(len(data)) + data)
+        return self
+
+    def ints_packed(self, field, values):
+        data = b"".join(_enc_varint(int(v)) for v in values)
+        self._parts.append(_key(field, _LEN) + _enc_varint(len(data)) + data)
+        return self
+
+    def float32(self, field, value):
+        self._parts.append(_key(field, _I32) + struct.pack("<f", value))
+        return self
+
+    def bytes(self):
+        return b"".join(self._parts)
+
+
+def parse(buf):
+    """Decode one message into {field: [(wire_type, value), ...]}.
+    LEN fields yield raw bytes (caller re-parses nested messages)."""
+    fields = {}
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _dec_varint(buf, pos)
+        field, wtype = key >> 3, key & 7
+        if wtype == _VARINT:
+            v, pos = _dec_varint(buf, pos)
+        elif wtype == _LEN:
+            ln, pos = _dec_varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wtype == _I32:
+            v = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wtype == _I64:
+            v = struct.unpack("<d", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise ValueError("unsupported wire type %d" % wtype)
+        fields.setdefault(field, []).append((wtype, v))
+    return fields
+
+
+def get_str(fields, field, default=""):
+    vals = fields.get(field)
+    return vals[0][1].decode() if vals else default
+
+
+def get_int(fields, field, default=0):
+    vals = fields.get(field)
+    return _signed(vals[0][1]) if vals else default
+
+
+def get_msgs(fields, field):
+    return [v for _w, v in fields.get(field, [])]
+
+
+def get_packed_ints(fields, field):
+    out = []
+    for wtype, v in fields.get(field, []):
+        if wtype == _VARINT:
+            out.append(_signed(v))
+        else:
+            pos = 0
+            while pos < len(v):
+                val, pos = _dec_varint(v, pos)
+                out.append(_signed(val))
+    return out
+
+
+def get_packed_floats(fields, field):
+    out = []
+    for wtype, v in fields.get(field, []):
+        if wtype == _I32:
+            out.append(v)
+        else:
+            out.extend(struct.unpack("<%df" % (len(v) // 4), v))
+    return out

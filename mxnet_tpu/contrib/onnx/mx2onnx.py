@@ -1,0 +1,213 @@
+"""Gluon → ONNX exporter (reference contrib/onnx/mx2onnx converters)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+from . import _proto
+
+# ONNX enums
+_FLOAT = 1
+_ATTR_FLOAT, _ATTR_INT, _ATTR_INTS = 1, 2, 7
+
+_OPSET = 13
+
+
+def _tensor(name, arr):
+    arr = _np.ascontiguousarray(arr, dtype=_np.float32)
+    w = _proto.Writer()
+    for d in arr.shape:
+        w.varint(1, d)            # dims
+    w.varint(2, _FLOAT)           # data_type
+    w.string(8, name)             # name
+    w.string(9, arr.tobytes())    # raw_data
+    return w
+
+
+def _attr_int(name, value):
+    return (_proto.Writer().string(1, name).varint(3, int(value))
+            .varint(20, _ATTR_INT))
+
+
+def _attr_ints(name, values):
+    return (_proto.Writer().string(1, name).ints_packed(8, values)
+            .varint(20, _ATTR_INTS))
+
+
+def _attr_float(name, value):
+    return (_proto.Writer().string(1, name).float32(2, float(value))
+            .varint(20, _ATTR_FLOAT))
+
+
+def _node(op_type, inputs, outputs, name, attrs=()):
+    w = _proto.Writer()
+    for i in inputs:
+        w.string(1, i)
+    for o in outputs:
+        w.string(2, o)
+    w.string(3, name)
+    w.string(4, op_type)
+    for a in attrs:
+        w.message(5, a)
+    return w
+
+
+def _value_info(name, shape):
+    dims = _proto.Writer()
+    for d in shape:
+        dims.message(1, _proto.Writer().varint(1, d))
+    ttype = (_proto.Writer().varint(1, _FLOAT).message(2, dims))
+    typ = _proto.Writer().message(1, ttype)
+    return _proto.Writer().string(1, name).message(2, typ)
+
+
+class _Exporter:
+    def __init__(self):
+        self.nodes = []
+        self.inits = []
+        self.counter = 0
+
+    def uniq(self, base):
+        self.counter += 1
+        return "%s_%d" % (base, self.counter)
+
+    def add_init(self, base, arr):
+        name = self.uniq(base)
+        self.inits.append(_tensor(name, arr))
+        return name
+
+    # ---- per-layer emitters -----------------------------------------------
+    def emit(self, layer, cur):
+        from ...gluon import nn
+
+        kind = type(layer).__name__
+        if isinstance(layer, (nn.HybridSequential, nn.Sequential)):
+            for child in layer:
+                cur = self.emit(child, cur)
+            return cur
+        if isinstance(layer, nn.Dense):
+            if layer._flatten:
+                out = self.uniq("flat")
+                self.nodes.append(_node("Flatten", [cur], [out],
+                                        self.uniq("Flatten"),
+                                        [_attr_int("axis", 1)]))
+                cur = out
+            w_name = self.add_init("weight", layer.weight.data().asnumpy())
+            inputs = [cur, w_name]
+            if layer.bias is not None:
+                inputs.append(self.add_init("bias",
+                                            layer.bias.data().asnumpy()))
+            out = self.uniq("gemm")
+            self.nodes.append(_node(
+                "Gemm", inputs, [out], self.uniq("Gemm"),
+                [_attr_int("transB", 1), _attr_float("alpha", 1.0),
+                 _attr_float("beta", 1.0)]))
+            cur = out
+            if layer._activation:
+                cur = self._activation(layer._activation, cur)
+            return cur
+        if kind == "Conv2D":
+            if layer._layout != "NCHW":
+                raise MXNetError("onnx export supports NCHW convs only")
+            w_name = self.add_init("weight", layer.weight.data().asnumpy())
+            inputs = [cur, w_name]
+            if layer.bias is not None:
+                inputs.append(self.add_init("bias",
+                                            layer.bias.data().asnumpy()))
+            out = self.uniq("conv")
+            k = layer._kernel if isinstance(layer._kernel, tuple) else \
+                (layer._kernel, layer._kernel)
+            self.nodes.append(_node(
+                "Conv", inputs, [out], self.uniq("Conv"),
+                [_attr_ints("kernel_shape", k),
+                 _attr_ints("strides", layer._strides),
+                 _attr_ints("pads", tuple(layer._padding) * 2),
+                 _attr_ints("dilations", layer._dilation),
+                 _attr_int("group", layer._groups)]))
+            cur = out
+            if layer._activation:
+                cur = self._activation(layer._activation, cur)
+            return cur
+        if kind == "BatchNorm":
+            inputs = [cur,
+                      self.add_init("gamma", layer.gamma.data().asnumpy()),
+                      self.add_init("beta", layer.beta.data().asnumpy()),
+                      self.add_init("mean",
+                                    layer.running_mean.data().asnumpy()),
+                      self.add_init("var",
+                                    layer.running_var.data().asnumpy())]
+            out = self.uniq("bn")
+            self.nodes.append(_node(
+                "BatchNormalization", inputs, [out], self.uniq("BN"),
+                [_attr_float("epsilon", layer._eps),
+                 _attr_float("momentum", layer._momentum)]))
+            return out
+        if kind == "Activation":
+            return self._activation(layer._act_type, cur)
+        if kind == "Flatten":
+            out = self.uniq("flat")
+            self.nodes.append(_node("Flatten", [cur], [out],
+                                    self.uniq("Flatten"),
+                                    [_attr_int("axis", 1)]))
+            return out
+        if kind == "Dropout":
+            out = self.uniq("drop")
+            self.nodes.append(_node("Dropout", [cur], [out],
+                                    self.uniq("Dropout"),
+                                    [_attr_float("ratio", layer._rate)]))
+            return out
+        if kind in ("MaxPool2D", "AvgPool2D"):
+            op = "MaxPool" if kind == "MaxPool2D" else "AveragePool"
+            out = self.uniq("pool")
+            k = layer._kernel
+            stride = layer._stride if isinstance(layer._stride, tuple) \
+                else (layer._stride,) * len(k)
+            pad = layer._pad if isinstance(layer._pad, tuple) \
+                else (layer._pad,) * len(k)
+            self.nodes.append(_node(
+                op, [cur], [out], self.uniq(op),
+                [_attr_ints("kernel_shape", k),
+                 _attr_ints("strides", stride),
+                 _attr_ints("pads", pad * 2)]))
+            return out
+        if kind == "GlobalAvgPool2D":
+            out = self.uniq("gap")
+            self.nodes.append(_node("GlobalAveragePool", [cur], [out],
+                                    self.uniq("GlobalAveragePool")))
+            return out
+        raise MXNetError("onnx export: unsupported layer %s" % kind)
+
+    def _activation(self, act, cur):
+        table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+                 "softrelu": "Softplus"}
+        if act not in table:
+            raise MXNetError("onnx export: unsupported activation %s" % act)
+        out = self.uniq(act)
+        self.nodes.append(_node(table[act], [cur], [out], self.uniq(act)))
+        return out
+
+
+def export_model(net, input_shape, onnx_file_path="model.onnx",
+                 model_name="mxnet_tpu_model"):
+    """Export a layer-structured Gluon net to an ONNX file (reference
+    contrib/onnx export_model).  ``input_shape`` includes the batch dim."""
+    ex = _Exporter()
+    out_name = ex.emit(net, "data")
+
+    graph = _proto.Writer()
+    for n in ex.nodes:
+        graph.message(1, n)
+    graph.string(2, model_name)
+    for t in ex.inits:
+        graph.message(5, t)
+    graph.message(11, _value_info("data", input_shape))
+    # output shape is graph-dependent; emit rank-only (dim_value 0 allowed)
+    graph.message(12, _value_info(out_name, ()))
+
+    opset = _proto.Writer().string(1, "").varint(2, _OPSET)
+    model = (_proto.Writer().varint(1, 8)          # ir_version
+             .string(2, "mxnet_tpu")               # producer_name
+             .message(7, graph).message(8, opset))
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.bytes())
+    return onnx_file_path

@@ -1,0 +1,150 @@
+"""ONNX → Gluon importer (reference contrib/onnx/onnx2mx converters)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+from . import _proto
+
+_FLOAT = 1
+
+
+def _parse_tensor(buf):
+    f = _proto.parse(buf)
+    dims = _proto.get_packed_ints(f, 1)
+    name = _proto.get_str(f, 8)
+    raw = f.get(9)
+    if raw:
+        arr = _np.frombuffer(raw[0][1], dtype=_np.float32)
+    else:
+        arr = _np.asarray(_proto.get_packed_floats(f, 4), _np.float32)
+    return name, arr.reshape(dims)
+
+
+def _parse_attrs(node_fields):
+    attrs = {}
+    for buf in _proto.get_msgs(node_fields, 5):
+        f = _proto.parse(buf)
+        name = _proto.get_str(f, 1)
+        atype = _proto.get_int(f, 20)
+        if atype == 1:    # FLOAT
+            attrs[name] = _proto.get_packed_floats(f, 2)[0]
+        elif atype == 2:  # INT
+            attrs[name] = _proto.get_int(f, 3)
+        elif atype == 3:  # STRING
+            attrs[name] = _proto.get_str(f, 4)
+        elif atype == 7:  # INTS
+            attrs[name] = _proto.get_packed_ints(f, 8)
+        elif atype == 6:  # FLOATS
+            attrs[name] = _proto.get_packed_floats(f, 7)
+    return attrs
+
+
+def _parse_node(buf):
+    f = _proto.parse(buf)
+    return {
+        "inputs": [v.decode() for _w, v in f.get(1, [])],
+        "outputs": [v.decode() for _w, v in f.get(2, [])],
+        "name": _proto.get_str(f, 3),
+        "op_type": _proto.get_str(f, 4),
+        "attrs": _parse_attrs(f),
+    }
+
+
+_ACT = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+        "Softplus": "softrelu"}
+
+
+def import_model(onnx_file_path, ctx=None):
+    """Build a runnable Gluon net + loaded params from an ONNX file.
+    Returns (net, arg_params_dict) — reference import_model returns
+    (sym, arg_params, aux_params); here the net carries its params.
+    Supports the layer set mx2onnx emits (Gemm/Conv/BN/activations/
+    pooling/Flatten/Dropout) in feed-forward chains."""
+    from ... import nd as nd_mod
+    from ...gluon import nn
+
+    with open(onnx_file_path, "rb") as f:
+        model = _proto.parse(f.read())
+    graph_bufs = _proto.get_msgs(model, 7)
+    if not graph_bufs:
+        raise MXNetError("no graph in onnx file")
+    graph = _proto.parse(graph_bufs[0])
+
+    inits = {}
+    for buf in _proto.get_msgs(graph, 5):
+        name, arr = _parse_tensor(buf)
+        inits[name] = arr
+    nodes = [_parse_node(buf) for buf in _proto.get_msgs(graph, 1)]
+
+    net = nn.HybridSequential()
+    pending_weights = []  # (layer, {param: array})
+
+    for node in nodes:
+        op = node["op_type"]
+        attrs = node["attrs"]
+        ins = node["inputs"]
+        if op == "Flatten":
+            net.add(nn.Flatten())
+        elif op == "Gemm":
+            w = inits[ins[1]]
+            bias = inits[ins[2]] if len(ins) > 2 else None
+            if not attrs.get("transB", 0):
+                w = w.T
+            layer = nn.Dense(w.shape[0], in_units=w.shape[1],
+                             use_bias=bias is not None, flatten=False)
+            net.add(layer)
+            pending_weights.append((layer, {"weight": w, "bias": bias}))
+        elif op == "Conv":
+            w = inits[ins[1]]
+            bias = inits[ins[2]] if len(ins) > 2 else None
+            pads = attrs.get("pads", [0, 0, 0, 0])
+            layer = nn.Conv2D(
+                w.shape[0], kernel_size=tuple(attrs["kernel_shape"]),
+                strides=tuple(attrs.get("strides", (1, 1))),
+                padding=tuple(pads[:2]),
+                dilation=tuple(attrs.get("dilations", (1, 1))),
+                groups=int(attrs.get("group", 1)),
+                in_channels=w.shape[1] * int(attrs.get("group", 1)),
+                use_bias=bias is not None)
+            net.add(layer)
+            pending_weights.append((layer, {"weight": w, "bias": bias}))
+        elif op == "BatchNormalization":
+            gamma, beta = inits[ins[1]], inits[ins[2]]
+            mean, var = inits[ins[3]], inits[ins[4]]
+            layer = nn.BatchNorm(epsilon=attrs.get("epsilon", 1e-5),
+                                 momentum=attrs.get("momentum", 0.9),
+                                 in_channels=gamma.shape[0])
+            net.add(layer)
+            pending_weights.append((layer, {
+                "gamma": gamma, "beta": beta, "running_mean": mean,
+                "running_var": var}))
+        elif op in _ACT:
+            net.add(nn.Activation(_ACT[op]))
+        elif op == "Dropout":
+            net.add(nn.Dropout(attrs.get("ratio", 0.5)))
+        elif op in ("MaxPool", "AveragePool"):
+            cls = nn.MaxPool2D if op == "MaxPool" else nn.AvgPool2D
+            pads = attrs.get("pads", [0, 0, 0, 0])
+            k = attrs["kernel_shape"]
+            # ONNX spec: strides default to 1 along each spatial axis
+            strides = attrs.get("strides", [1] * len(k))
+            net.add(cls(pool_size=tuple(k), strides=tuple(strides),
+                        padding=tuple(pads[:2])))
+        elif op == "GlobalAveragePool":
+            net.add(nn.GlobalAvgPool2D())
+        else:
+            raise MXNetError("onnx import: unsupported op %s" % op)
+
+    net.initialize()
+    arg_params = {}
+    for layer, params in pending_weights:
+        for pname, arr in params.items():
+            if arr is None:
+                continue
+            param = getattr(layer, pname)
+            param.shape = arr.shape
+            param.set_data(nd_mod.array(arr))
+            arg_params["%s_%s" % (layer._name if hasattr(layer, "_name")
+                                  else type(layer).__name__, pname)] = arr
+    return net, arg_params

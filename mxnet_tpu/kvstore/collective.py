@@ -44,10 +44,16 @@ class CollectiveKVStore(KVStoreBase):
         return jax.process_count()
 
     def set_gradient_compression(self, compression_params):
-        """Reference: 2-bit gradient compression (gradient_compression.h).
-        On TPU, ICI bandwidth makes compression counterproductive intra-pod;
-        honored as bf16 cast for cross-DCN pushes."""
-        self._compression = compression_params
+        """2-bit gradient compression with error feedback (reference
+        gradient_compression.h; kvstore.py set_gradient_compression).
+        Targets cross-slice DCN pushes — ICI makes compression
+        counterproductive intra-pod."""
+        from .gradient_compression import GradientCompression
+
+        params = dict(compression_params or {})
+        self._compression = GradientCompression(
+            type=params.get("type", "2bit"),
+            threshold=float(params.get("threshold", 0.5)))
 
     def _allreduce(self, arr):
         """Sum across all worker processes (engine-free: XLA collective)."""
@@ -83,10 +89,13 @@ class CollectiveKVStore(KVStoreBase):
         keys, values = _pair(key, value)
         for k, v in zip(keys, values):
             merged = _reduce(v)
-            if self._compression:
-                merged = NDArray(merged._data.astype(jnp.bfloat16)
-                                 .astype(merged._data.dtype))
-            self._store[str(k)] = NDArray(self._allreduce(merged._data))
+            if self._compression is not None:
+                # compressed path: quantize (+error feedback), exchange
+                # packed 2-bit codes, decode-sum — replaces the raw allreduce
+                self._store[str(k)] = NDArray(self._compression.allreduce(
+                    str(k), merged._data))
+            else:
+                self._store[str(k)] = NDArray(self._allreduce(merged._data))
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _pair(key, out)

@@ -21,6 +21,7 @@ class KVStore(KVStoreBase):
         self._store = {}
         self._updater = None
         self._optimizer = None
+        self._compression = None
 
     @property
     def type(self):
@@ -35,11 +36,27 @@ class KVStore(KVStoreBase):
         for k, v in zip(keys, values):
             self._store[self._key(k)] = v.copy()
 
+    def set_gradient_compression(self, compression_params):
+        """2-bit compression with error feedback on pushed gradients
+        (reference kvstore.py set_gradient_compression; local stores apply
+        it at merge time like the reference's device store)."""
+        from .gradient_compression import GradientCompression
+
+        params = dict(compression_params or {})
+        self._compression = GradientCompression(
+            type=params.get("type", "2bit"),
+            threshold=float(params.get("threshold", 0.5)))
+
     def push(self, key, value, priority=0):
         keys, values = _pair(key, value)
         for k, v in zip(keys, values):
             merged = _reduce(v)
             k = self._key(k)
+            if self._compression is not None:
+                from ..ndarray.ndarray import NDArray
+
+                gc = self._compression
+                merged = NDArray(gc.decompress(gc.compress(k, merged._data)))
             if self._updater is not None:
                 if k not in self._store:
                     raise MXNetError("key %s not initialized" % k)
@@ -59,13 +76,16 @@ class KVStore(KVStoreBase):
         for k, v in zip(keys, values):
             merged = _reduce(v)
             kk = self._key(k)
+            if self._compression is not None:
+                from ..ndarray.ndarray import NDArray
+
+                merged = NDArray(self._compression.decompress(
+                    self._compression.compress(kk, merged._data)))
             if self._updater is not None and kk in self._store:
                 self._updater(kk, merged, self._store[kk])
                 merged = self._store[kk]
             else:
                 self._store[kk] = merged
-            if out is not None:
-                _, outs = _pair(key, out)
         if out is not None:
             keys2, outs = _pair(key, out)
             for k, o in zip(keys2, outs):

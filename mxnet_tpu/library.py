@@ -1,0 +1,127 @@
+"""``mx.library`` — runtime-loadable operator extension libraries.
+
+Reference capability: include/mxnet/lib_api.h (stable-ABI plugin header:
+``CustomOp`` fcompute/inferShape fn-pointer tables, ``REGISTER_OP``) +
+``mx.library.load`` (python/mxnet/library.py) — external .so files add
+ops without recompiling the framework.
+
+TPU-native redesign: the plugin ABI is a small C contract (below); each
+exported op computes on dense f32 host buffers and is registered as a
+framework op whose TPU execution path is ``jax.pure_callback`` — the op
+participates in jit-compiled programs as a host custom-call, mirroring how
+the reference's custom ops run on CPU inside a GPU graph.
+
+Plugin C ABI (implement in any language that can export C symbols):
+
+    int  mxt_ext_op_count(void);
+    const char* mxt_ext_op_name(int idx);
+    // infer output shape from input shape (rank<=8), return out rank
+    int  mxt_ext_op_infer_shape(int idx, const int64_t* in_shape,
+                                int in_rank, int64_t* out_shape);
+    // dense f32 compute: in/out are contiguous buffers
+    int  mxt_ext_op_compute(int idx, const float* in, int64_t in_size,
+                            float* out, int64_t out_size);
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["load", "loaded_libs"]
+
+_LOADED = {}
+
+
+def loaded_libs():
+    return dict(_LOADED)
+
+
+def load(path, verbose=True):
+    """Load an extension library and register its ops
+    (reference library.py load → MXLoadLib)."""
+    path = os.path.abspath(path)
+    if path in _LOADED:  # idempotent reload (reference MXLoadLib behavior)
+        return _LOADED[path]
+    if not os.path.exists(path):
+        raise MXNetError("extension library not found: %s" % path)
+    lib = ctypes.CDLL(path)
+    for sym in ("mxt_ext_op_count", "mxt_ext_op_name",
+                "mxt_ext_op_infer_shape", "mxt_ext_op_compute"):
+        if not hasattr(lib, sym):
+            raise MXNetError("%s does not export %s — not a mxnet_tpu "
+                             "extension library" % (path, sym))
+    lib.mxt_ext_op_count.restype = ctypes.c_int
+    lib.mxt_ext_op_name.restype = ctypes.c_char_p
+    lib.mxt_ext_op_name.argtypes = [ctypes.c_int]
+    lib.mxt_ext_op_infer_shape.restype = ctypes.c_int
+    lib.mxt_ext_op_infer_shape.argtypes = [
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.mxt_ext_op_compute.restype = ctypes.c_int
+    lib.mxt_ext_op_compute.argtypes = [
+        ctypes.c_int, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+
+    from .ops.registry import _OP_REGISTRY, register
+
+    names = []
+    n = lib.mxt_ext_op_count()
+    for idx in range(n):
+        opname = lib.mxt_ext_op_name(idx).decode()
+        if opname in _OP_REGISTRY:
+            raise MXNetError("extension op %r collides with an existing op"
+                             % opname)
+
+        def make_fn(i, name_):
+            def infer_out_shape(in_shape):
+                ins = (ctypes.c_int64 * 8)(*in_shape)
+                outs = (ctypes.c_int64 * 8)()
+                rank = lib.mxt_ext_op_infer_shape(i, ins, len(in_shape),
+                                                  outs)
+                if rank < 0:
+                    raise MXNetError("extension op %s: infer_shape failed"
+                                     % name_)
+                return tuple(outs[k] for k in range(rank))
+
+            def host_compute(x):
+                x = _np.ascontiguousarray(x, dtype=_np.float32)
+                out_shape = infer_out_shape(x.shape)
+                out = _np.empty(out_shape, _np.float32)
+                rc = lib.mxt_ext_op_compute(
+                    i, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    x.size, out.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_float)), out.size)
+                if rc != 0:
+                    raise MXNetError("extension op %s failed (code %d)"
+                                     % (name_, rc))
+                return out
+
+            def op_fn(x):
+                import jax
+                import jax.numpy as jnp
+
+                out_shape = infer_out_shape(x.shape)
+                return jax.pure_callback(
+                    host_compute,
+                    jax.ShapeDtypeStruct(out_shape, jnp.float32),
+                    x, vmap_method="sequential")
+
+            op_fn.__name__ = name_
+            op_fn.__doc__ = ("extension op %r from %s (host custom-call "
+                             "via pure_callback)" % (name_, path))
+            return op_fn
+
+        register(opname, differentiable=False)(make_fn(idx, opname))
+        names.append(opname)
+        # surface on the nd namespace like generated ops
+        from . import ndarray as nd_mod
+
+        setattr(nd_mod, opname, _OP_REGISTRY[opname])
+    _LOADED[path] = names
+    if verbose:
+        print("loaded library %s: ops %s" % (path, names))
+    return names

@@ -1,0 +1,249 @@
+"""Extension library + ONNX + gradient compression tests (reference
+example/extensions/lib_custom_op, tests onnx suites, and
+tests/nightly dist gradient-compression checks)."""
+import os
+import shutil
+import subprocess
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.kvstore.gradient_compression import GradientCompression
+
+
+def setup_function(_f):
+    mx.random.seed(0)
+
+
+# ---------------------------------------------------------------------------
+# mx.library extension loading
+# ---------------------------------------------------------------------------
+
+_EXT_SRC = textwrap.dedent("""
+    #include <cstdint>
+    #include <cmath>
+    extern "C" {
+    int mxt_ext_op_count(void) { return 2; }
+    const char* mxt_ext_op_name(int idx) {
+        return idx == 0 ? "ext_square" : "ext_halve";
+    }
+    int mxt_ext_op_infer_shape(int idx, const int64_t* in_shape,
+                               int in_rank, int64_t* out_shape) {
+        for (int i = 0; i < in_rank; ++i) out_shape[i] = in_shape[i];
+        return in_rank;
+    }
+    int mxt_ext_op_compute(int idx, const float* in, int64_t in_size,
+                           float* out, int64_t out_size) {
+        for (int64_t i = 0; i < in_size; ++i)
+            out[i] = idx == 0 ? in[i] * in[i] : in[i] * 0.5f;
+        return 0;
+    }
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def ext_lib(tmp_path_factory):
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    d = tmp_path_factory.mktemp("ext")
+    src = d / "ext.cc"
+    src.write_text(_EXT_SRC)
+    so = d / "libext.so"
+    subprocess.run(["g++", "-O2", "-fPIC", "-shared", str(src), "-o",
+                    str(so)], check=True)
+    return str(so)
+
+
+def test_library_load_and_run(ext_lib):
+    names = mx.library.load(ext_lib, verbose=False)
+    assert set(names) == {"ext_square", "ext_halve"}
+    x = mx.nd.array(np.array([1.0, -2.0, 3.0], np.float32))
+    np.testing.assert_allclose(mx.nd.ext_square(x).asnumpy(), [1, 4, 9])
+    np.testing.assert_allclose(mx.nd.ext_halve(x).asnumpy(),
+                               [0.5, -1.0, 1.5])
+    assert ext_lib in mx.library.loaded_libs()
+
+
+def test_library_op_inside_jit(ext_lib):
+    """Extension ops participate in jitted programs via pure_callback."""
+    if "ext_square" not in mx.nd.list_ops():
+        mx.library.load(ext_lib, verbose=False)
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.registry import get_op
+
+    fn = get_op("ext_square").fn
+
+    @jax.jit
+    def prog(v):
+        return fn(v) + 1.0
+
+    out = prog(jnp.asarray([2.0, 3.0], jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), [5.0, 10.0])
+
+
+def test_library_errors(tmp_path):
+    with pytest.raises(Exception):
+        mx.library.load(str(tmp_path / "missing.so"))
+    bad = tmp_path / "bad.so"
+    src = tmp_path / "bad.cc"
+    src.write_text("extern \"C\" int nothing(void){return 0;}")
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    subprocess.run(["g++", "-O2", "-fPIC", "-shared", str(src), "-o",
+                    str(bad)], check=True)
+    with pytest.raises(Exception):
+        mx.library.load(str(bad))
+
+
+# ---------------------------------------------------------------------------
+# ONNX export/import
+# ---------------------------------------------------------------------------
+
+def test_onnx_mlp_roundtrip(tmp_path):
+    from mxnet_tpu.contrib import onnx as onnx_mx
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dropout(0.3), nn.Dense(4))
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(3, 8).astype(np.float32))
+    want = net(x).asnumpy()
+
+    path = str(tmp_path / "mlp.onnx")
+    onnx_mx.export_model(net, (3, 8), path)
+    assert os.path.getsize(path) > 100
+
+    net2, params = onnx_mx.import_model(path)
+    got = net2(x).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_cnn_roundtrip(tmp_path):
+    from mxnet_tpu.contrib import onnx as onnx_mx
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(6, 3, padding=1, activation="relu"),
+            nn.BatchNorm(),
+            nn.MaxPool2D(pool_size=2, strides=2),
+            nn.Conv2D(4, 3, padding=1),
+            nn.GlobalAvgPool2D(),
+            nn.Flatten(),
+            nn.Dense(3))
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(1).randn(
+        2, 3, 8, 8).astype(np.float32))
+    want = net(x).asnumpy()  # inference mode: BN uses running stats
+
+    path = str(tmp_path / "cnn.onnx")
+    onnx_mx.export_model(net, (2, 3, 8, 8), path)
+    net2, _params = onnx_mx.import_model(path)
+    got = net2(x).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_unsupported_layer(tmp_path):
+    from mxnet_tpu.contrib import onnx as onnx_mx
+
+    net = nn.HybridSequential()
+    net.add(nn.Embedding(10, 4))
+    net.initialize()
+    with pytest.raises(Exception):
+        onnx_mx.export_model(net, (2,), str(tmp_path / "x.onnx"))
+
+
+# ---------------------------------------------------------------------------
+# 2-bit gradient compression
+# ---------------------------------------------------------------------------
+
+def test_gradient_compression_quantize():
+    import jax.numpy as jnp
+
+    gc = GradientCompression(threshold=0.5)
+    g = jnp.asarray([0.7, -0.6, 0.2, -0.1, 0.0], jnp.float32)
+    codes = gc.compress("k", g)
+    np.testing.assert_array_equal(np.asarray(codes), [1, -1, 0, 0, 0])
+    # residual keeps the quantization error
+    res = np.asarray(gc._residual["k"])
+    np.testing.assert_allclose(res, [0.2, -0.1, 0.2, -0.1, 0.0], atol=1e-6)
+
+
+def test_gradient_compression_error_feedback_accumulates():
+    """Small gradients below threshold eventually fire via residual."""
+    import jax.numpy as jnp
+
+    gc = GradientCompression(threshold=0.5)
+    fired = 0.0
+    for _ in range(10):
+        codes = gc.compress("k", jnp.asarray([0.2], jnp.float32))
+        fired += float(np.asarray(gc.decompress(codes))[0])
+    # 10 * 0.2 = 2.0 total signal; quantized emissions approach it
+    assert abs(fired - 2.0) <= 0.5
+
+
+def test_gradient_compression_pack_unpack():
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(0)
+    codes = jnp.asarray(rs.randint(-1, 2, 37), jnp.int8)
+    packed = GradientCompression.pack(codes)
+    assert packed.size == (37 + 3) // 4  # 16x smaller than f32
+    restored = GradientCompression.unpack(packed, 37)
+    np.testing.assert_array_equal(np.asarray(restored), np.asarray(codes))
+
+
+def test_kvstore_with_compression():
+    kv = mx.kvstore.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", mx.nd.zeros((4,)))
+    g = mx.nd.array(np.array([1.0, -0.9, 0.1, 0.0], np.float32))
+    out = mx.nd.zeros((4,))
+    kv.push("w", g)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0])
+    # second push: residual (0.5, -0.4, 0.1, 0) + new grad fires again
+    kv.push("w", g)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0])
+
+
+def test_trainer_pushpull_applies_compression():
+    """Trainer.step goes through kv.pushpull — compression must engage
+    there too (regression: pushpull bypassed it)."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4))
+    net.initialize()
+    kv = mx.kvstore.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.01})
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=kv)
+    x = mx.nd.ones((2, 3))
+    with mx.autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    trainer.step(1)
+    assert kv._compression is not None
+    assert len(kv._compression._residual) > 0  # compress() actually ran
+
+
+def test_contrib_onnx_attribute():
+    assert hasattr(mx.contrib, "onnx")
+    assert callable(mx.contrib.onnx.export_model)
+
+
+def test_library_load_idempotent(ext_lib):
+    names1 = mx.library.load(ext_lib, verbose=False)
+    names2 = mx.library.load(ext_lib, verbose=False)  # no collision error
+    assert names1 == names2
+
+
+def test_gradient_compression_rejects_bad_params():
+    with pytest.raises(Exception):
+        GradientCompression(type="4bit")
+    with pytest.raises(Exception):
+        GradientCompression(threshold=0.0)
