@@ -10,7 +10,9 @@ from . import _proto
 _FLOAT = 1
 _ATTR_FLOAT, _ATTR_INT, _ATTR_INTS = 1, 2, 7
 
-_OPSET = 13
+# opset 11: the last opset where Dropout.ratio is an attribute (it became
+# an input at 12); everything else emitted here is 11-compatible
+_OPSET = 11
 
 
 def _tensor(name, arr):
@@ -157,6 +159,8 @@ class _Exporter:
                                     [_attr_float("ratio", layer._rate)]))
             return out
         if kind in ("MaxPool2D", "AvgPool2D"):
+            if layer._layout != "NCHW":
+                raise MXNetError("onnx export supports NCHW pooling only")
             op = "MaxPool" if kind == "MaxPool2D" else "AveragePool"
             out = self.uniq("pool")
             k = layer._kernel
@@ -171,6 +175,8 @@ class _Exporter:
                  _attr_ints("pads", pad * 2)]))
             return out
         if kind == "GlobalAvgPool2D":
+            if layer._layout != "NCHW":
+                raise MXNetError("onnx export supports NCHW pooling only")
             out = self.uniq("gap")
             self.nodes.append(_node("GlobalAveragePool", [cur], [out],
                                     self.uniq("GlobalAveragePool")))

@@ -55,6 +55,17 @@ _ACT = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
         "Softplus": "softrelu"}
 
 
+def _sym_pads(attrs, op):
+    """ONNX pads are [begin..., end...]; gluon layers pad symmetrically —
+    reject asymmetric padding instead of silently dropping the end pads."""
+    pads = list(attrs.get("pads", [0, 0, 0, 0]))
+    half = len(pads) // 2
+    if pads[:half] != pads[half:]:
+        raise MXNetError("onnx import: asymmetric pads %s on %s are not "
+                         "supported" % (pads, op))
+    return pads
+
+
 def import_model(onnx_file_path, ctx=None):
     """Build a runnable Gluon net + loaded params from an ONNX file.
     Returns (net, arg_params_dict) — reference import_model returns
@@ -89,6 +100,10 @@ def import_model(onnx_file_path, ctx=None):
         elif op == "Gemm":
             w = inits[ins[1]]
             bias = inits[ins[2]] if len(ins) > 2 else None
+            if attrs.get("alpha", 1.0) != 1.0 or \
+                    attrs.get("beta", 1.0) != 1.0:
+                raise MXNetError("onnx import: Gemm alpha/beta != 1 is "
+                                 "not supported")
             if not attrs.get("transB", 0):
                 w = w.T
             layer = nn.Dense(w.shape[0], in_units=w.shape[1],
@@ -98,7 +113,7 @@ def import_model(onnx_file_path, ctx=None):
         elif op == "Conv":
             w = inits[ins[1]]
             bias = inits[ins[2]] if len(ins) > 2 else None
-            pads = attrs.get("pads", [0, 0, 0, 0])
+            pads = _sym_pads(attrs, op)
             layer = nn.Conv2D(
                 w.shape[0], kernel_size=tuple(attrs["kernel_shape"]),
                 strides=tuple(attrs.get("strides", (1, 1))),
@@ -125,7 +140,7 @@ def import_model(onnx_file_path, ctx=None):
             net.add(nn.Dropout(attrs.get("ratio", 0.5)))
         elif op in ("MaxPool", "AveragePool"):
             cls = nn.MaxPool2D if op == "MaxPool" else nn.AvgPool2D
-            pads = attrs.get("pads", [0, 0, 0, 0])
+            pads = _sym_pads(attrs, op)
             k = attrs["kernel_shape"]
             # ONNX spec: strides default to 1 along each spatial axis
             strides = attrs.get("strides", [1] * len(k))

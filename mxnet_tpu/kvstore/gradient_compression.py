@@ -82,9 +82,12 @@ class GradientCompression:
 
         packed = self.pack(codes)
         gathered = multihost_utils.process_allgather(packed)  # (P, B)
-        total = None
-        for p in range(gathered.shape[0]):
-            part = self.unpack(gathered[p], grad.size).astype(jnp.int32)
-            total = part if total is None else total + part
+        # one vectorized decode: unpack flattens, so run it over the whole
+        # (P, B) block and reduce on device (not P separate host dispatches)
+        n_proc = gathered.shape[0]
+        all_codes = self.unpack(gathered.reshape(-1),
+                                n_proc * 4 * gathered.shape[1])
+        per_proc = all_codes.reshape(n_proc, -1)[:, :grad.size]
+        total = per_proc.astype(jnp.int32).sum(axis=0)
         return (total.astype(jnp.float32) * self.threshold).reshape(
             grad.shape)

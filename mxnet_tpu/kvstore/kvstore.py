@@ -36,6 +36,16 @@ class KVStore(KVStoreBase):
         for k, v in zip(keys, values):
             self._store[self._key(k)] = v.copy()
 
+    def _apply_compression(self, key, merged):
+        """Quantize-dequantize round trip (+error feedback) when 2-bit
+        compression is enabled — shared by push and pushpull."""
+        if self._compression is None:
+            return merged
+        from ..ndarray.ndarray import NDArray
+
+        gc = self._compression
+        return NDArray(gc.decompress(gc.compress(key, merged._data)))
+
     def set_gradient_compression(self, compression_params):
         """2-bit compression with error feedback on pushed gradients
         (reference kvstore.py set_gradient_compression; local stores apply
@@ -52,11 +62,7 @@ class KVStore(KVStoreBase):
         for k, v in zip(keys, values):
             merged = _reduce(v)
             k = self._key(k)
-            if self._compression is not None:
-                from ..ndarray.ndarray import NDArray
-
-                gc = self._compression
-                merged = NDArray(gc.decompress(gc.compress(k, merged._data)))
+            merged = self._apply_compression(k, merged)
             if self._updater is not None:
                 if k not in self._store:
                     raise MXNetError("key %s not initialized" % k)
@@ -76,11 +82,7 @@ class KVStore(KVStoreBase):
         for k, v in zip(keys, values):
             merged = _reduce(v)
             kk = self._key(k)
-            if self._compression is not None:
-                from ..ndarray.ndarray import NDArray
-
-                merged = NDArray(self._compression.decompress(
-                    self._compression.compress(kk, merged._data)))
+            merged = self._apply_compression(kk, merged)
             if self._updater is not None and kk in self._store:
                 self._updater(kk, merged, self._store[kk])
                 merged = self._store[kk]

@@ -68,14 +68,16 @@ def load(path, verbose=True):
 
     from .ops.registry import _OP_REGISTRY, register
 
-    names = []
     n = lib.mxt_ext_op_count()
-    for idx in range(n):
-        opname = lib.mxt_ext_op_name(idx).decode()
+    op_names = [lib.mxt_ext_op_name(i).decode() for i in range(n)]
+    # validate ALL names before registering ANY — a mid-loop collision
+    # would leave earlier ops live but the library unrecorded
+    for opname in op_names:
         if opname in _OP_REGISTRY:
             raise MXNetError("extension op %r collides with an existing op"
                              % opname)
-
+    names = []
+    for idx, opname in enumerate(op_names):
         def make_fn(i, name_):
             def infer_out_shape(in_shape):
                 ins = (ctypes.c_int64 * 8)(*in_shape)

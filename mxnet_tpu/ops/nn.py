@@ -7,8 +7,8 @@ dropout, activation + the cuDNN/MKLDNN dispatch trees).
 TPU-native: each op is a single lax/jnp expression that XLA tiles onto the
 MXU (conv/FC) or fuses into surrounding elementwise chains (activations,
 norms).  The cuDNN/MKLDNN forks disappear — XLA:TPU is the one backend.
-bf16 inputs use f32 accumulation (preferred_element_type), the MXU-native
-mixed-precision mode.
+bf16 contractions rely on the MXU's native f32 accumulation — the
+hardware's mixed-precision mode (see _amp_pair).
 """
 # pylint: disable=redefined-builtin
 from __future__ import annotations
@@ -119,6 +119,17 @@ def softmin(x, axis=-1):
 # ---- dense (reference nn/fully_connected.cc; MXU GEMM) --------------------
 
 
+def _amp_pair(x, weight):
+    """Mixed-precision dtype alignment: when exactly one side is bf16 (AMP
+    casts weights, normalization keeps f32), compute the contraction in
+    bf16 — the MXU accumulates bf16 products in f32 natively, so no
+    explicit preferred_element_type is needed (and requesting one breaks
+    the conv/dot transpose rules under value_and_grad)."""
+    if x.dtype != weight.dtype and jnp.bfloat16 in (x.dtype, weight.dtype):
+        return x.astype(jnp.bfloat16), weight.astype(jnp.bfloat16)
+    return x, weight
+
+
 @register("fully_connected")
 def fully_connected(x, weight, bias=None, num_hidden=None, flatten=True,
                     no_bias=False):
@@ -126,12 +137,13 @@ def fully_connected(x, weight, bias=None, num_hidden=None, flatten=True,
     (fully_connected.cc shape conventions) and feeds the MXU directly."""
     if flatten and x.ndim > 2:
         x = x.reshape(x.shape[0], -1)
-    pref = jnp.float32 if x.dtype == jnp.bfloat16 else None
-    y = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
-                        preferred_element_type=pref)
-    y = y.astype(x.dtype)
+    x, weight = _amp_pair(x, weight)
+    # bf16 contractions accumulate in f32 on the MXU natively; an explicit
+    # preferred_element_type=f32 breaks the conv/dot transpose rules under
+    # value_and_grad (mixed-dtype cotangents), so rely on the hardware
+    y = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())))
     if bias is not None and not no_bias:
-        y = y + bias
+        y = y + bias.astype(y.dtype)
     return y
 
 
@@ -159,20 +171,19 @@ def convolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
     dn_layout = _conv_dims(nd, layout)
     dn = lax.conv_dimension_numbers(x.shape, weight.shape, dn_layout[:2] +
                                     (dn_layout[2],))
-    pref = jnp.float32 if x.dtype == jnp.bfloat16 else None
+    x, weight = _amp_pair(x, weight)
+    # (see fully_connected) bf16 convs accumulate f32 on the MXU natively
     y = lax.conv_general_dilated(
         x, weight, window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate, dimension_numbers=dn,
-        feature_group_count=num_group,
-        preferred_element_type=pref)
-    y = y.astype(x.dtype)
+        feature_group_count=num_group)
     if bias is not None and not no_bias:
         lay = dn_layout[0]
         c_axis = lay.index("C")
         shape = [1] * nd
         shape[c_axis] = bias.shape[0]
-        y = y + bias.reshape(shape)
+        y = y + bias.reshape(shape).astype(y.dtype)
     return y
 
 
@@ -290,18 +301,23 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
     g = jnp.ones_like(gamma) if fix_gamma else gamma
+    # statistics and normalization math in f32 even under AMP (bf16 x with
+    # f32 gamma/beta/running stats); output back in x's dtype
+    xf = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
     if training and not use_global_stats:
-        m = jnp.mean(x, axis=reduce_axes)
-        v = jnp.var(x, axis=reduce_axes)
-        new_mean = moving_mean * momentum + m * (1 - momentum)
-        new_var = moving_var * momentum + v * (1 - momentum)
+        m = jnp.mean(xf, axis=reduce_axes)
+        v = jnp.var(xf, axis=reduce_axes)
+        new_mean = moving_mean * momentum + m.astype(moving_mean.dtype) * \
+            (1 - momentum)
+        new_var = moving_var * momentum + v.astype(moving_var.dtype) * \
+            (1 - momentum)
     else:
         m, v = moving_mean, moving_var
         new_mean, new_var = moving_mean, moving_var
-    inv = lax.rsqrt(v.astype(jnp.float32) + eps).astype(x.dtype)
-    out = (x - m.reshape(shape)) * (g * inv).reshape(shape) + \
+    inv = lax.rsqrt(v.astype(jnp.float32) + eps)
+    out = (xf - m.reshape(shape)) * (g * inv).reshape(shape) + \
         beta.reshape(shape)
-    return out, new_mean, new_var
+    return out.astype(x.dtype), new_mean, new_var
 
 
 @register("layer_norm")

@@ -197,6 +197,36 @@ def test_gradient_compression_pack_unpack():
     np.testing.assert_array_equal(np.asarray(restored), np.asarray(codes))
 
 
+def test_gradient_compression_batched_decode():
+    """Vectorized (P, B) decode matches per-row unpack (regression)."""
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(3)
+    size = 37
+    rows = []
+    for _ in range(4):
+        codes = jnp.asarray(rs.randint(-1, 2, size), jnp.int8)
+        rows.append((codes, GradientCompression.pack(codes)))
+    gathered = jnp.stack([p for _c, p in rows])
+    n_proc, nbytes = gathered.shape
+    all_codes = GradientCompression.unpack(gathered.reshape(-1),
+                                           n_proc * 4 * nbytes)
+    per_proc = all_codes.reshape(n_proc, -1)[:, :size]
+    for i, (codes, _p) in enumerate(rows):
+        np.testing.assert_array_equal(np.asarray(per_proc[i]),
+                                      np.asarray(codes))
+
+
+def test_onnx_rejects_asymmetric_and_bad_gemm(tmp_path):
+    """Foreign-model safety: asymmetric pads and scaled Gemm raise instead
+    of silently mis-importing (regression)."""
+    from mxnet_tpu.contrib.onnx import onnx2mx
+
+    with pytest.raises(Exception):
+        onnx2mx._sym_pads({"pads": [1, 1, 0, 0]}, "Conv")
+    assert onnx2mx._sym_pads({"pads": [1, 1, 1, 1]}, "Conv") == [1, 1, 1, 1]
+
+
 def test_kvstore_with_compression():
     kv = mx.kvstore.create("local")
     kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
