@@ -13,7 +13,8 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
+           "LibSVMIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -259,6 +260,63 @@ class MNISTIter(NDArrayIter):
             data = data.reshape(len(data), -1)
         super().__init__(data, ds._label.astype(_np.float32),
                          batch_size=batch_size, shuffle=shuffle)
+
+
+class LibSVMIter(DataIter):
+    """LibSVM-format iterator yielding CSR batches (reference
+    src/io/iter_libsvm.cc + iter_sparse_prefetcher.h)."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size=1,
+                 label_libsvm=None, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        self._num_features = int(data_shape[0] if isinstance(
+            data_shape, (tuple, list)) else data_shape)
+        self._rows = []   # (label, {col: val})
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                label = float(parts[0])
+                feats = {}
+                for tok in parts[1:]:
+                    c, v = tok.split(":")
+                    feats[int(c)] = float(v)
+                self._rows.append((label, feats))
+        self._cursor = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._num_features))]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        if self._cursor >= len(self._rows):
+            raise StopIteration
+        from ..ndarray import sparse as sp
+
+        rows = self._rows[self._cursor:self._cursor + self.batch_size]
+        pad = self.batch_size - len(rows)
+        self._cursor += self.batch_size
+        data, indices, indptr, labels = [], [], [0], []
+        for label, feats in rows:
+            for c in sorted(feats):
+                indices.append(c)
+                data.append(feats[c])
+            indptr.append(len(indices))
+            labels.append(label)
+        for _ in range(pad):
+            indptr.append(len(indices))
+            labels.append(0.0)
+        csr = sp.csr_matrix(
+            (_np.asarray(data, _np.float32),
+             _np.asarray(indices, _np.int64),
+             _np.asarray(indptr, _np.int64)),
+            shape=(self.batch_size, self._num_features))
+        return DataBatch([csr], [nd.array(_np.asarray(labels, _np.float32))],
+                         pad=pad)
 
 
 class ImageRecordIter(DataIter):

@@ -19,7 +19,8 @@ from ..base import MXNetError, _as_np_dtype
 from .ndarray import NDArray
 
 __all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
-           "zeros"]
+           "zeros", "dot", "add", "retain", "cast_storage", "where_nonzero",
+           "sparse_embedding_grad"]
 
 
 def _jnp():
@@ -69,6 +70,28 @@ class RowSparseNDArray(BaseSparseNDArray):
         dense = jnp.zeros(self._shape, self._data.dtype)
         idx = self.indices_.astype(jnp.int32)
         return NDArray(dense.at[idx].add(self._data))
+
+    def retain(self, indices):
+        """Keep only the given rows (reference sparse retain op) — the
+        kvstore row_sparse-pull primitive."""
+        jnp = _jnp()
+        keep = jnp.asarray(
+            indices._data if isinstance(indices, NDArray) else indices
+        ).astype(jnp.int32)
+        # membership of each stored row in `keep`
+        mask = (self.indices_[:, None] == keep[None, :]).any(axis=1)
+        sel = _np.where(_np.asarray(mask))[0]
+        return RowSparseNDArray(self._data[sel], self.indices_[sel],
+                                self._shape)
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            jnp = _jnp()
+            return RowSparseNDArray(
+                jnp.concatenate([self._data, other._data]),
+                jnp.concatenate([self.indices_, other.indices_]),
+                self._shape)
+        return self.tostype("default") + other
 
     def __repr__(self):
         return "<RowSparseNDArray %s>" % (self._shape,)
@@ -120,7 +143,7 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
         data, indices = arg1
         data = jnp.asarray(_np.asarray(data, dtype=_as_np_dtype(dtype)
                                        if dtype else _np.float32))
-        indices = jnp.asarray(_np.asarray(indices, dtype=_np.int64))
+        indices = jnp.asarray(_np.asarray(indices, dtype=_np.int32))
         return RowSparseNDArray(data, indices, shape)
     arr = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
     nz = _np.where(_np.any(arr.reshape(arr.shape[0], -1) != 0, axis=1))[0]
@@ -133,8 +156,8 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, tuple) and len(arg1) == 3:
         data, indices, indptr = arg1
         return CSRNDArray(jnp.asarray(_np.asarray(data)),
-                          jnp.asarray(_np.asarray(indices, _np.int64)),
-                          jnp.asarray(_np.asarray(indptr, _np.int64)), shape)
+                          jnp.asarray(_np.asarray(indices, _np.int32)),
+                          jnp.asarray(_np.asarray(indptr, _np.int32)), shape)
     arr = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
     m, n = arr.shape
     indptr = [0]
@@ -146,9 +169,104 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
         data.extend(arr[i, nz].tolist())
         indptr.append(len(indices))
     return CSRNDArray(jnp.asarray(_np.asarray(data, arr.dtype)),
-                      jnp.asarray(_np.asarray(indices, _np.int64)),
-                      jnp.asarray(_np.asarray(indptr, _np.int64)),
+                      jnp.asarray(_np.asarray(indices, _np.int32)),
+                      jnp.asarray(_np.asarray(indptr, _np.int32)),
                       shape or arr.shape)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (reference tensor/dot-inl.h sparse kernels):
+
+    - CSR × dense  → dense (BCOO dot_general, the TPU gather/segment path)
+    - CSR.T × dense → dense
+    - row_sparse.T × dense → row-scattered dense (embedding-grad pattern)
+    - dense falls through to the dense dot op.
+    """
+    import jax.numpy as jnp
+
+    if isinstance(lhs, CSRNDArray):
+        from jax.experimental import sparse as jsparse
+
+        m, n = lhs._shape
+        indptr = _np.asarray(lhs.indptr_)
+        rows = jnp.asarray(_np.repeat(_np.arange(m), _np.diff(indptr)))
+        coo = jsparse.BCOO(
+            (lhs._data, jnp.stack([rows, lhs.indices_.astype(jnp.int32)],
+                                  axis=1)),
+            shape=(m, n))
+        if transpose_a:
+            coo = coo.T
+        r = rhs._data if isinstance(rhs, NDArray) else rhs
+        if transpose_b:
+            r = r.T
+        return NDArray(coo @ r)
+    if isinstance(lhs, RowSparseNDArray):
+        if not transpose_a:
+            return NDArray(
+                lhs.tostype("default")._data @ (
+                    rhs._data.T if transpose_b else rhs._data))
+        # lhs.T @ rhs with lhs row-sparse: only stored rows contribute —
+        # gather the matching rhs rows and contract over them
+        jnp = _jnp()
+        r = rhs._data if isinstance(rhs, NDArray) else rhs
+        sel = r[lhs.indices_.astype(jnp.int32)]
+        return NDArray(jnp.einsum("kr,kc->rc", lhs._data, sel))
+    from . import dot as dense_dot
+
+    return dense_dot(lhs, rhs, transpose_a=transpose_a,
+                     transpose_b=transpose_b)
+
+
+def add(lhs, rhs):
+    """Sparse-aware add: same-stype sparse stays sparse, else densify."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs,
+                                                        RowSparseNDArray):
+        return lhs + rhs
+    a = lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) else lhs
+    b = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
+    return a + b
+
+
+def cast_storage(arr, stype):
+    """reference tensor/cast_storage op."""
+    if isinstance(arr, BaseSparseNDArray):
+        return arr.tostype(stype)
+    if stype == "default":
+        return arr
+    if stype == "row_sparse":
+        return row_sparse_array(arr)
+    if stype == "csr":
+        return csr_matrix(arr)
+    raise MXNetError("unknown stype %r" % stype)
+
+
+def where_nonzero(arr):
+    """Row indices with any nonzero (helper for building row_sparse)."""
+    a = arr.asnumpy()
+    return _np.where(_np.any(a.reshape(a.shape[0], -1) != 0, axis=1))[0]
+
+
+def sparse_embedding_grad(grad_out, token_ids, vocab_size):
+    """Build the row_sparse gradient of an embedding lookup (reference:
+    Embedding with grad_stype='row_sparse', the big-vocab memory saver).
+
+    grad_out: (..., dim) cotangent of the lookup; token_ids: (...) int ids.
+    Returns RowSparseNDArray of shape (vocab_size, dim) holding one stored
+    row per *unique* token (segment-sum over duplicate tokens — the
+    XLA-friendly scatter-add form).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    g = grad_out._data if isinstance(grad_out, NDArray) else grad_out
+    ids = token_ids._data if isinstance(token_ids, NDArray) else token_ids
+    flat_g = g.reshape(-1, g.shape[-1])
+    flat_ids = ids.reshape(-1).astype(jnp.int32)
+    uniq, inverse = _np.unique(_np.asarray(flat_ids), return_inverse=True)
+    seg = jnp.asarray(inverse.astype(_np.int32))
+    summed = jax.ops.segment_sum(flat_g, seg, num_segments=len(uniq))
+    return RowSparseNDArray(summed, jnp.asarray(uniq.astype(_np.int32)),
+                            (vocab_size, g.shape[-1]))
 
 
 def zeros(stype, shape, ctx=None, dtype="float32"):
@@ -156,10 +274,10 @@ def zeros(stype, shape, ctx=None, dtype="float32"):
     dt = _as_np_dtype(dtype)
     if stype == "row_sparse":
         return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dt),
-                                jnp.zeros((0,), jnp.int64), shape)
+                                jnp.zeros((0,), jnp.int32), shape)
     if stype == "csr":
-        return CSRNDArray(jnp.zeros((0,), dt), jnp.zeros((0,), jnp.int64),
-                          jnp.zeros((shape[0] + 1,), jnp.int64), shape)
+        return CSRNDArray(jnp.zeros((0,), dt), jnp.zeros((0,), jnp.int32),
+                          jnp.zeros((shape[0] + 1,), jnp.int32), shape)
     from . import zeros as dense_zeros
 
     return dense_zeros(shape, ctx=ctx, dtype=dtype)

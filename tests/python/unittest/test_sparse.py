@@ -1,0 +1,142 @@
+"""Sparse NDArray tests (reference tests/python/unittest/test_sparse_ndarray
+.py / test_sparse_operator.py strategy: construction round trips, sparse
+dot vs dense oracle, cast_storage, retain, embedding-grad pattern)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse as sp
+
+
+def setup_function(_f):
+    mx.random.seed(0)
+
+
+def _rand_sparse(m, n, density, rs):
+    arr = rs.rand(m, n).astype(np.float32)
+    arr[arr > density] = 0
+    return arr
+
+
+def test_csr_construction_roundtrip():
+    rs = np.random.RandomState(0)
+    arr = _rand_sparse(6, 5, 0.4, rs)
+    csr = sp.csr_matrix(arr)
+    assert csr.stype == "csr"
+    assert csr.shape == (6, 5)
+    np.testing.assert_allclose(csr.asnumpy(), arr)
+    # explicit (data, indices, indptr) form
+    csr2 = sp.csr_matrix((csr.data.asnumpy(), csr.indices.asnumpy(),
+                          csr.indptr.asnumpy()), shape=(6, 5))
+    np.testing.assert_allclose(csr2.asnumpy(), arr)
+
+
+def test_row_sparse_construction_roundtrip():
+    rs = np.random.RandomState(1)
+    arr = np.zeros((8, 3), np.float32)
+    arr[[1, 4, 6]] = rs.rand(3, 3)
+    rsp = sp.row_sparse_array(arr)
+    assert rsp.stype == "row_sparse"
+    assert sorted(rsp.indices.asnumpy().tolist()) == [1, 4, 6]
+    np.testing.assert_allclose(rsp.asnumpy(), arr)
+
+
+def test_csr_dot_dense():
+    rs = np.random.RandomState(2)
+    a = _rand_sparse(7, 5, 0.5, rs)
+    b = rs.rand(5, 4).astype(np.float32)
+    csr = sp.csr_matrix(a)
+    out = sp.dot(csr, mx.nd.array(b))
+    np.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5)
+    # transposed
+    out_t = sp.dot(csr, mx.nd.array(rs.rand(7, 3).astype(np.float32)),
+                   transpose_a=True)
+    assert out_t.shape == (5, 3)
+
+
+def test_row_sparse_dot_transpose():
+    """rsp.T @ dense — the embedding-gradient contraction."""
+    rs = np.random.RandomState(3)
+    arr = np.zeros((10, 4), np.float32)
+    arr[[2, 5]] = rs.rand(2, 4)
+    rsp = sp.row_sparse_array(arr)
+    dense = rs.rand(10, 6).astype(np.float32)
+    out = sp.dot(rsp, mx.nd.array(dense), transpose_a=True)
+    np.testing.assert_allclose(out.asnumpy(), arr.T @ dense, rtol=1e-5)
+
+
+def test_cast_storage():
+    rs = np.random.RandomState(4)
+    arr = _rand_sparse(5, 5, 0.4, rs)
+    nd_arr = mx.nd.array(arr)
+    csr = sp.cast_storage(nd_arr, "csr")
+    assert csr.stype == "csr"
+    back = sp.cast_storage(csr, "default")
+    np.testing.assert_allclose(back.asnumpy(), arr)
+    rsp = sp.cast_storage(nd_arr, "row_sparse")
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_allclose(rsp.asnumpy(), arr)
+
+
+def test_retain():
+    arr = np.zeros((8, 2), np.float32)
+    arr[[1, 3, 5]] = [[1, 1], [3, 3], [5, 5]]
+    rsp = sp.row_sparse_array(arr)
+    kept = rsp.retain(mx.nd.array(np.array([3, 5], np.float32)))
+    want = np.zeros_like(arr)
+    want[[3, 5]] = arr[[3, 5]]
+    np.testing.assert_allclose(kept.asnumpy(), want)
+
+
+def test_row_sparse_add():
+    a = np.zeros((6, 2), np.float32)
+    a[[0, 2]] = 1.0
+    b = np.zeros((6, 2), np.float32)
+    b[[2, 4]] = 2.0
+    out = sp.add(sp.row_sparse_array(a), sp.row_sparse_array(b))
+    assert out.stype == "row_sparse"
+    np.testing.assert_allclose(out.asnumpy(), a + b)
+
+
+def test_sparse_embedding_grad():
+    rs = np.random.RandomState(5)
+    grads = rs.rand(2, 3, 4).astype(np.float32)  # (batch, seq, dim)
+    ids = np.array([[1, 7, 1], [3, 7, 1]], np.float32)
+    rsp = sp.sparse_embedding_grad(mx.nd.array(grads), mx.nd.array(ids),
+                                   vocab_size=10)
+    assert rsp.shape == (10, 4)
+    dense = rsp.asnumpy()
+    want = np.zeros((10, 4), np.float32)
+    for g, t in zip(grads.reshape(-1, 4), ids.reshape(-1).astype(int)):
+        want[t] += g
+    np.testing.assert_allclose(dense, want, rtol=1e-5)
+    assert len(rsp.indices.asnumpy()) == 3  # unique tokens {1, 3, 7}
+
+
+def test_sparse_zeros():
+    z = sp.zeros("row_sparse", (4, 3))
+    np.testing.assert_allclose(z.asnumpy(), np.zeros((4, 3)))
+    zc = sp.zeros("csr", (4, 3))
+    np.testing.assert_allclose(zc.asnumpy(), np.zeros((4, 3)))
+
+
+def test_libsvm_iter(tmp_path):
+    path = str(tmp_path / "data.libsvm")
+    with open(path, "w") as f:
+        f.write("1 0:1.5 3:2.0\n")
+        f.write("0 1:0.5\n")
+        f.write("1 2:3.0 4:1.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(5,), batch_size=2)
+    batch1 = it.next()
+    x = batch1.data[0]
+    assert x.stype == "csr"
+    dense = x.asnumpy()
+    np.testing.assert_allclose(dense[0], [1.5, 0, 0, 2.0, 0])
+    np.testing.assert_allclose(dense[1], [0, 0.5, 0, 0, 0])
+    np.testing.assert_allclose(batch1.label[0].asnumpy(), [1, 0])
+    batch2 = it.next()
+    assert batch2.pad == 1
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    assert it.next().pad == 0
