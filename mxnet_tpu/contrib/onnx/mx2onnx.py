@@ -168,11 +168,16 @@ class _Exporter:
                 else (layer._stride,) * len(k)
             pad = layer._pad if isinstance(layer._pad, tuple) \
                 else (layer._pad,) * len(k)
-            self.nodes.append(_node(
-                op, [cur], [out], self.uniq(op),
-                [_attr_ints("kernel_shape", k),
-                 _attr_ints("strides", stride),
-                 _attr_ints("pads", pad * 2)]))
+            attrs = [_attr_ints("kernel_shape", k),
+                     _attr_ints("strides", stride),
+                     _attr_ints("pads", pad * 2)]
+            if op == "AveragePool":
+                # this framework's AvgPool counts padding by default while
+                # the ONNX default excludes it — emit the attr explicitly
+                attrs.append(_attr_int(
+                    "count_include_pad",
+                    1 if getattr(layer, "_count_include_pad", True) else 0))
+            self.nodes.append(_node(op, [cur], [out], self.uniq(op), attrs))
             return out
         if kind == "GlobalAvgPool2D":
             if layer._layout != "NCHW":

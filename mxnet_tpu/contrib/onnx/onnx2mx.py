@@ -144,8 +144,13 @@ def import_model(onnx_file_path, ctx=None):
             k = attrs["kernel_shape"]
             # ONNX spec: strides default to 1 along each spatial axis
             strides = attrs.get("strides", [1] * len(k))
+            kwargs = {}
+            if op == "AveragePool":
+                # honor the ONNX attr (default 0 = exclude padding)
+                kwargs["count_include_pad"] = bool(
+                    attrs.get("count_include_pad", 0))
             net.add(cls(pool_size=tuple(k), strides=tuple(strides),
-                        padding=tuple(pads[:2])))
+                        padding=tuple(pads[:2]), **kwargs))
         elif op == "GlobalAveragePool":
             net.add(nn.GlobalAvgPool2D())
         else:

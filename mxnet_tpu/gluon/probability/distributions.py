@@ -152,12 +152,22 @@ class Distribution:
         new.__dict__.update(self.__dict__)
         n_batch = len(tuple(self.batch_shape))
         for name in self.arg_constraints:
-            val = getattr(self, name, None)
+            # prob/logit-style families expose read-only properties over
+            # backing _prob/_logit fields; write to the backing field when
+            # the public name is a property
+            target = name
+            if isinstance(getattr(type(self), name, None), property):
+                target = "_" + name
+                if getattr(self, target, None) is None:
+                    continue  # unset side of a prob/logit pair
+                val = getattr(self, target)
+            else:
+                val = getattr(self, name, None)
             if isinstance(val, NDArray):
                 # keep the parameter's event dims (the part beyond the
                 # distribution's batch shape, e.g. Dirichlet alpha's last dim)
                 event_part = tuple(val.shape)[n_batch:]
-                setattr(new, name,
+                setattr(new, target,
                         val.broadcast_to(tuple(batch_shape) + event_part))
         return new
 
@@ -1040,10 +1050,15 @@ class Binomial(Distribution):
         value = _value(value)
         n = self.n
         p = self.prob
-        log_comb = (nd.gammaln(value * 0 + n + 1) - nd.gammaln(value + 1)
-                    - nd.gammaln(n - value + 1))
-        return (log_comb + value * p.clip(_EPS, 1).log()
-                + (n - value) * (1 - p).clip(_EPS, 1).log())
+        # clip into support before gammaln (negative args yield finite
+        # garbage), then mask out-of-support values to -inf like Poisson
+        v = value.clip(0, n)
+        log_comb = (nd.gammaln(v * 0 + n + 1) - nd.gammaln(v + 1)
+                    - nd.gammaln(n - v + 1))
+        lp = (log_comb + v * p.clip(_EPS, 1).log()
+              + (n - v) * (1 - p).clip(_EPS, 1).log())
+        return _mask_support(
+            lp, nd.logical_and(value >= 0, value <= n))
 
     @property
     def mean(self):

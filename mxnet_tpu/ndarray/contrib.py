@@ -56,19 +56,24 @@ def _is_traced(arrays):
 
 
 # body callables whose deferred Gluon parameters have been resolved by a
-# pre-flight step (keyed weakly on the body's code object so repeated calls
-# don't re-pay one eager body execution per call)
+# pre-flight step.  Keyed weakly on the FUNCTION OBJECT, not its code
+# object: two closures sharing one code object (a second model instance, or
+# cells created in a loop) must each preflight, since each closes over its
+# own possibly-deferred parameters.  A fresh closure per call re-pays one
+# eager body execution — correct over fast.
 import weakref as _weakref  # noqa: E402
 
 _PREFLIGHTED = _weakref.WeakSet()
 
 
 def _needs_preflight(body):
-    code = getattr(body, "__code__", None)
-    if code is None or code in _PREFLIGHTED:
-        return False
-    _PREFLIGHTED.add(code)
-    return True
+    try:
+        if body in _PREFLIGHTED:
+            return False
+        _PREFLIGHTED.add(body)
+        return True
+    except TypeError:  # non-weakrefable callable (e.g. some builtins)
+        return True
 
 
 def _recording():

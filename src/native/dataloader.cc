@@ -99,6 +99,11 @@ struct Batch {
   std::atomic<int> remaining{0};
   int count = 0;                // valid samples
   int64_t batch_no = -1;
+  // the only batch this buffer may be claimed for next; buffers serve
+  // batches idx, idx+kNumBuffers, idx+2*kNumBuffers, ... strictly in
+  // order, so a worker racing ahead (batch k+kNumBuffers) cannot steal a
+  // just-freed buffer from batch k's still-pending workers
+  int64_t next_claim = -1;
   enum State { kFree, kFilling, kReady } state = kFree;
 };
 
@@ -177,11 +182,12 @@ struct Loader {
     for (;;) {
       if (stop || abort.load()) return nullptr;
       if (b.state == Batch::kFilling && b.batch_no == batch_no) break;
-      if (b.state == Batch::kFree) {
+      if (b.state == Batch::kFree && batch_no == b.next_claim) {
         int64_t first = batch_no * batch;
         int n = int(std::min<int64_t>(batch, epoch_len - first));
         b.state = Batch::kFilling;
         b.batch_no = batch_no;
+        b.next_claim = batch_no + kNumBuffers;
         b.count = n;
         b.remaining.store(n);
         break;
@@ -310,6 +316,7 @@ struct Loader {
     workers.clear();
     Shuffle(epoch);
     cursor.store(0);
+    for (int i = 0; i < kNumBuffers; ++i) buffers[i].next_claim = i;
     for (int i = 0; i < num_workers; ++i)
       workers.emplace_back([this, i] { WorkerLoop(i); });
     ++epoch;

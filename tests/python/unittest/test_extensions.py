@@ -277,3 +277,25 @@ def test_gradient_compression_rejects_bad_params():
         GradientCompression(type="4bit")
     with pytest.raises(Exception):
         GradientCompression(threshold=0.0)
+
+
+def test_onnx_padded_avgpool_count_include_pad(tmp_path):
+    """Padded AvgPool round-trips with correct count_include_pad semantics
+    (regression: exported AveragePool lacked the attr, so foreign runtimes
+    and re-import used the ONNX exclude-pad default)."""
+    from mxnet_tpu.contrib import onnx as onnx_mx
+
+    for cip in (True, False):
+        net = nn.HybridSequential()
+        net.add(nn.AvgPool2D(pool_size=2, strides=2, padding=1,
+                             count_include_pad=cip))
+        net.initialize()
+        x = mx.nd.array(np.random.RandomState(2).rand(
+            1, 2, 6, 6).astype(np.float32))
+        want = net(x).asnumpy()
+        path = str(tmp_path / ("avg_%s.onnx" % cip))
+        onnx_mx.export_model(net, (1, 2, 6, 6), path)
+        net2, _ = onnx_mx.import_model(path)
+        got = net2(x).asnumpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        assert net2[0]._count_include_pad == cip

@@ -261,3 +261,23 @@ def test_imdecode_imresize_native():
     assert dec.shape == (40, 40, 3)
     resized = image.imresize(dec, 20, 10)
     assert resized.shape == (10, 20, 3)
+
+
+def test_image_record_loader_small_batch_many_workers(tmp_path):
+    """batch_size < num_workers: buffers must be claimed in batch order or
+    a worker racing ahead can steal a just-freed buffer and deadlock
+    (regression, dataloader.cc AcquireBuffer next_claim gate)."""
+    path = str(tmp_path / "imgs.rec")
+    _write_img_rec(path, n=12)
+    for _ in range(3):
+        loader = native.ImageRecordLoader(path, batch_size=1,
+                                          data_shape=(3, 16, 16),
+                                          num_workers=4)
+        labels = []
+        while True:
+            out = loader.next()
+            if out is None:
+                break
+            labels.append(int(out[1][0, 0]))
+        assert labels == list(range(12))
+        loader.close()

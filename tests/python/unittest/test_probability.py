@@ -431,3 +431,24 @@ def test_validate_args():
     mgp.Normal(0.0, 1.0, validate_args=True)
     with pytest.raises(Exception):
         mgp.Bernoulli(prob=0.3, logit=0.1)
+
+
+def test_broadcast_to_logit_parameterized():
+    """broadcast_to must work for property-backed prob/logit families
+    (regression: setattr on a read-only property raised AttributeError)."""
+    b = mgp.Bernoulli(logit=mx.nd.array(np.array([0.3], np.float32)))
+    bb = b.broadcast_to((4,))
+    assert tuple(bb.logit.shape) == (4,)
+    lp = _np(bb.log_prob(mx.nd.array(np.ones(4, np.float32))))
+    assert np.isfinite(lp).all()
+    g = mgp.Geometric(prob=np.array([0.4], np.float32)).broadcast_to((3,))
+    assert tuple(g.prob.shape) == (3,)
+
+
+def test_binomial_log_prob_support_mask():
+    """Out-of-support values get -inf, not finite garbage (regression)."""
+    bn = mgp.Binomial(n=5, prob=0.6)
+    x = mx.nd.array(np.array([-1.0, 2.0, 7.0], np.float32))
+    lp = _np(bn.log_prob(x))
+    assert lp[0] == -np.inf and lp[2] == -np.inf
+    assert np.isfinite(lp[1])
