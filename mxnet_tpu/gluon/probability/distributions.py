@@ -152,17 +152,19 @@ class Distribution:
         new.__dict__.update(self.__dict__)
         n_batch = len(tuple(self.batch_shape))
         for name in self.arg_constraints:
-            # prob/logit-style families expose read-only properties over
-            # backing _prob/_logit fields; write to the backing field when
-            # the public name is a property
-            target = name
-            if isinstance(getattr(type(self), name, None), property):
+            # prob/logit-style families store backing _prob/_logit fields
+            # (whether or not a public property exists for the name);
+            # broadcast the stored field, never a derived property value
+            if name in self.__dict__:
+                target = name
+                val = self.__dict__[name]
+            elif "_" + name in self.__dict__:
                 target = "_" + name
-                if getattr(self, target, None) is None:
+                val = self.__dict__[target]
+                if val is None:
                     continue  # unset side of a prob/logit pair
-                val = getattr(self, target)
             else:
-                val = getattr(self, name, None)
+                continue
             if isinstance(val, NDArray):
                 # keep the parameter's event dims (the part beyond the
                 # distribution's batch shape, e.g. Dirichlet alpha's last dim)

@@ -15,14 +15,23 @@ synchronous all-reduce over the ICI mesh:
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as _np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .base import KVStoreBase
 from .kvstore import _pair, _reduce
+
+# fuse keys into ~this many bytes per collective program (reference:
+# MXNET_KVSTORE_BIGARRAY_BOUND splits big arrays; here the knob bounds how
+# many small keys fuse into one psum launch)
+_BUCKET_BYTES = int(os.environ.get("MXNET_KVSTORE_BUCKET_BYTES",
+                                   4 << 20))
 
 
 class CollectiveKVStore(KVStoreBase):
@@ -30,6 +39,8 @@ class CollectiveKVStore(KVStoreBase):
         self._mode = mode
         self._store = {}
         self._compression = None
+        self._sum_cache = {}
+        self._mesh = None
 
     @property
     def type(self):
@@ -55,16 +66,74 @@ class CollectiveKVStore(KVStoreBase):
             type=params.get("type", "2bit"),
             threshold=float(params.get("threshold", 0.5)))
 
-    def _allreduce(self, arr):
-        """Sum across all worker processes (engine-free: XLA collective)."""
-        if jax.process_count() == 1:
-            return arr
-        from jax.experimental import multihost_utils
+    def _global_mesh(self):
+        if self._mesh is None:
+            devs = _np.asarray(jax.devices()).reshape(
+                jax.process_count(), -1)
+            self._mesh = Mesh(devs, ("proc", "local"))
+        return self._mesh
 
-        # all-gather to every host then sum — executed as one XLA program
-        # over the global device set (psum over DCN/ICI).
-        gathered = multihost_utils.process_allgather(arr)
-        return jnp.sum(gathered, axis=0)
+    def _sum_program(self, shape, dtype):
+        """Cached jitted cross-process sum: in = (nproc, L) sharded over the
+        proc axis, out = (L,) fully replicated.  XLA lowers this to one
+        all-reduce over DCN/ICI — no host round-trip, no O(N·size)
+        gather."""
+        key = (shape, str(dtype))
+        fn = self._sum_cache.get(key)
+        if fn is None:
+            mesh = self._global_mesh()
+            fn = jax.jit(
+                lambda a: jnp.sum(a, axis=0),
+                in_shardings=NamedSharding(mesh, P("proc")),
+                out_shardings=NamedSharding(mesh, P()))
+            self._sum_cache[key] = fn
+        return fn
+
+    def _allreduce_many(self, datas):
+        """Sum each jax array across worker processes.
+
+        Keys are fused into ~_BUCKET_BYTES flat buckets (per dtype) and
+        each bucket is reduced by ONE compiled collective program.  All
+        ranks push the same keys in the same order (same training script),
+        so program sequences match across processes."""
+        if jax.process_count() == 1:
+            return list(datas)
+        out = [None] * len(datas)
+        bucket = []  # list of (index, array)
+        nbytes = 0
+
+        def flush():
+            nonlocal bucket, nbytes
+            if not bucket:
+                return
+            flat = jnp.concatenate(
+                [jnp.ravel(a) for _, a in bucket]) if len(bucket) > 1 \
+                else jnp.ravel(bucket[0][1])
+            sharding = NamedSharding(self._global_mesh(), P("proc"))
+            garr = jax.make_array_from_process_local_data(
+                sharding, _np.asarray(flat)[None],
+                (jax.process_count(),) + flat.shape)
+            summed = self._sum_program(flat.shape, flat.dtype)(garr)
+            off = 0
+            for i, a in bucket:
+                n = a.size
+                out[i] = summed[off:off + n].reshape(a.shape)
+                off += n
+            bucket = []
+            nbytes = 0
+
+        last_dtype = None
+        for i, d in enumerate(datas):
+            d = jnp.asarray(d)
+            if last_dtype is not None and d.dtype != last_dtype:
+                flush()  # buckets are per-dtype (concat needs one dtype)
+            last_dtype = d.dtype
+            bucket.append((i, d))
+            nbytes += d.size * d.dtype.itemsize
+            if nbytes >= _BUCKET_BYTES:
+                flush()
+        flush()
+        return out
 
     def init(self, key, value):
         keys, values = _pair(key, value)
@@ -87,15 +156,17 @@ class CollectiveKVStore(KVStoreBase):
 
     def push(self, key, value, priority=0):
         keys, values = _pair(key, value)
-        for k, v in zip(keys, values):
-            merged = _reduce(v)
-            if self._compression is not None:
+        if self._compression is not None:
+            for k, v in zip(keys, values):
                 # compressed path: quantize (+error feedback), exchange
                 # packed 2-bit codes, decode-sum — replaces the raw allreduce
+                merged = _reduce(v)
                 self._store[str(k)] = NDArray(self._compression.allreduce(
                     str(k), merged._data))
-            else:
-                self._store[str(k)] = NDArray(self._allreduce(merged._data))
+            return
+        merged = [_reduce(v)._data for v in values]
+        for k, data in zip(keys, self._allreduce_many(merged)):
+            self._store[str(k)] = NDArray(data)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _pair(key, out)

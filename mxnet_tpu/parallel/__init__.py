@@ -30,7 +30,11 @@ from .ring import ring_attention, ulysses_attention
 
 __all__ = ["make_mesh", "FusedTrainer", "make_train_step", "ring_attention",
            "ulysses_attention", "P", "Mesh", "NamedSharding",
-           "shard_params", "param_pspec"]
+           "shard_params", "param_pspec", "SUPPORTS_ZERO"]
+
+# feature gate for the driver dryrun: FusedTrainer(zero=True) shards
+# optimizer state over dp (ZeRO-1)
+SUPPORTS_ZERO = True
 
 
 def make_mesh(axes=None, devices=None):
@@ -110,12 +114,22 @@ class FusedTrainer:
 
     def __init__(self, block, loss=None, optimizer="sgd",
                  optimizer_params=None, mesh=None, loss_fn=None,
-                 batch_axes=("dp",), dtype=None, grad_accum=1):
+                 batch_axes=("dp",), dtype=None, grad_accum=1, zero=False):
         self._block = block
         self._mesh = mesh
         self._batch_axes = tuple(a for a in batch_axes
                                  if mesh is not None and
                                  a in mesh.axis_names)
+        if grad_accum < 1:
+            raise MXNetError("grad_accum must be >= 1, got %r" % grad_accum)
+        self._grad_accum = int(grad_accum)
+        # ZeRO-1: shard optimizer state over dp (reduce-scatter the grads,
+        # all-gather the updated shards — XLA derives both collectives from
+        # the state shardings; PAPERS.md cross-replica weight-update
+        # sharding pattern)
+        if zero and (mesh is None or "dp" not in mesh.axis_names):
+            raise MXNetError("zero=True requires a mesh with a dp axis")
+        self._zero = bool(zero) and mesh.shape["dp"] > 1 if zero else False
         optimizer_params = dict(optimizer_params or {})
         self._lr = optimizer_params.pop("learning_rate", 0.01)
         self._opt_init, self._opt_update = make_optimizer(
@@ -152,7 +166,37 @@ class FusedTrainer:
         self._params = params
         self._opt_state = self._opt_init(
             {n: v for n, v in params.items() if n in self._trainable})
+        if self._zero:
+            self._state_specs = self._make_zero_specs(self._opt_state)
+            self._opt_state = jax.tree_util.tree_map(
+                lambda v, s: jax.device_put(
+                    v, NamedSharding(self._mesh, s)),
+                self._opt_state, self._state_specs)
+        else:
+            self._state_specs = None
         self._build_step()
+
+    def _make_zero_specs(self, opt_state):
+        """Per-leaf PartitionSpecs sharding optimizer state over dp.
+
+        Each state leaf mirrors its parameter's shape: keep the param's own
+        (tp) sharding and additionally split the first dp-divisible
+        unsharded axis across dp.  Leaves with no divisible axis stay
+        replicated (biases etc. — negligible memory)."""
+        dp = self._mesh.shape["dp"]
+
+        def spec_for(name, leaf):
+            base = list(self._param_specs.get(name, P()))
+            base += [None] * (leaf.ndim - len(base))
+            for ax in range(leaf.ndim):
+                if base[ax] is None and leaf.shape[ax] % dp == 0 \
+                        and leaf.shape[ax] > 0:
+                    base[ax] = "dp"
+                    break
+            return P(*base)
+
+        return {k: jax.tree_util.tree_map(lambda v: spec_for(k, v), leaf)
+                for k, leaf in opt_state.items()}
 
     def _build_step(self):
         apply_fn = self._apply
@@ -160,20 +204,58 @@ class FusedTrainer:
         trainable = self._trainable
         opt_update = self._opt_update
         lr = self._lr
+        accum = self._grad_accum
+
+        def loss_of(tp, frozen, rng, x, y):
+            full = dict(frozen)
+            full.update(tp)
+            outs, new_states = apply_fn(full, rng, x)
+            loss = loss_fn(outs[0], y)
+            return jnp.mean(loss), new_states
 
         def step(params, opt_state, step_i, rng, x, y):
             train_p = {n: v for n, v in params.items() if n in trainable}
             frozen = {n: v for n, v in params.items() if n not in trainable}
+            vg = jax.value_and_grad(loss_of, has_aux=True)
 
-            def loss_of(tp):
-                full = dict(frozen)
-                full.update(tp)
-                outs, new_states = apply_fn(full, rng, x)
-                loss = loss_fn(outs[0], y)
-                return jnp.mean(loss), new_states
+            if accum == 1:
+                (loss, new_states), grads = vg(train_p, frozen, rng, x, y)
+            else:
+                if x.shape[0] % accum != 0:
+                    raise MXNetError(
+                        "batch size %d not divisible by grad_accum=%d"
+                        % (x.shape[0], accum))
+                # k microbatches through ONE jitted scan: grads averaged
+                # across microbatches (mean-of-means == mean over the full
+                # batch for equal microbatch sizes), a single optimizer
+                # update at the end.  Peak activation memory drops ~k×.
+                xm = x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
+                ym = y.reshape((accum, y.shape[0] // accum) + y.shape[1:])
+                # independent dropout etc. per microbatch
+                (loss0, states0), g0 = vg(train_p, frozen,
+                                          jax.random.fold_in(rng, 0),
+                                          xm[0], ym[0])
 
-            (loss, new_states), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(train_p)
+                def body(carry, xy):
+                    acc_loss, acc_g, states, i = carry
+                    xi, yi = xy
+                    # thread running stats (BN etc.) sequentially through
+                    # the microbatches, like k small steps with no param
+                    # update in between
+                    fz = dict(frozen)
+                    fz.update(states)
+                    (li, si), gi = vg(train_p, fz,
+                                      jax.random.fold_in(rng, i), xi, yi)
+                    acc_g = jax.tree_util.tree_map(jnp.add, acc_g, gi)
+                    return (acc_loss + li, acc_g, si, i + 1), None
+
+                (loss, grads, new_states, _i), _ = jax.lax.scan(
+                    body, (loss0, g0, states0, jnp.uint32(1)),
+                    (xm[1:], ym[1:]))
+                loss = loss / accum
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / accum, grads)
+
             new_train, new_opt = opt_update(step_i, train_p, grads,
                                             opt_state, lr)
             new_params = dict(frozen)
@@ -185,12 +267,21 @@ class FusedTrainer:
             batch_spec = P(self._batch_axes if self._batch_axes else None)
             param_sh = {n: NamedSharding(self._mesh, self._param_specs[n])
                         for n in self._params}
+            state_sh = None
+            out_state_sh = None
+            if self._zero:
+                state_sh = jax.tree_util.tree_map(
+                    lambda s: NamedSharding(self._mesh, s),
+                    self._state_specs,
+                    is_leaf=lambda s: isinstance(s, P))
+                out_state_sh = state_sh
             with self._mesh:
                 self._step_fn = jax.jit(
                     step,
-                    in_shardings=(param_sh, None, None, None,
+                    in_shardings=(param_sh, state_sh, None, None,
                                   NamedSharding(self._mesh, batch_spec),
                                   NamedSharding(self._mesh, batch_spec)),
+                    out_shardings=(param_sh, out_state_sh, None),
                     donate_argnums=(0, 1))
         else:
             self._step_fn = jax.jit(step, donate_argnums=(0, 1))

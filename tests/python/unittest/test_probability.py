@@ -452,3 +452,13 @@ def test_binomial_log_prob_support_mask():
     lp = _np(bn.log_prob(x))
     assert lp[0] == -np.inf and lp[2] == -np.inf
     assert np.isfinite(lp[1])
+
+
+def test_broadcast_to_geometric_logit():
+    """Geometric stores _logit with no public logit property; broadcast_to
+    must broadcast the backing field, not silently no-op (regression)."""
+    g = mgp.Geometric(logit=mx.nd.array(np.array([0.3], np.float32)))
+    gb = g.broadcast_to((4,))
+    assert tuple(gb.batch_shape) == (4,)
+    assert np.isfinite(_np(gb.log_prob(
+        mx.nd.array(np.ones(4, np.float32))))).all()
