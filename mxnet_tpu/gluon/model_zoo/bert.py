@@ -31,13 +31,15 @@ class BERTModel(HybridBlock):
         self.word_embed = nn.Embedding(vocab_size, units)
         self.token_type_embed = nn.Embedding(token_type_vocab_size, units)
         self.pos_embed = nn.PositionalEmbedding(max_length, units)
-        self.embed_ln = nn.LayerNorm(epsilon=layer_norm_eps)
+        self.embed_ln = nn.LayerNorm(epsilon=layer_norm_eps,
+                                     in_channels=units)
         self.embed_dropout = nn.Dropout(dropout) if dropout else None
         self.encoder = nn.TransformerEncoder(
             num_layers, units, hidden_size, num_heads, dropout=dropout,
             attention_dropout=dropout, activation="gelu",
             layer_norm_eps=layer_norm_eps)
-        self.pooler = (nn.Dense(units, activation="tanh", flatten=False)
+        self.pooler = (nn.Dense(units, activation="tanh", flatten=False,
+                                in_units=units)
                        if use_pooler else None)
 
     def forward(self, inputs, token_types=None, valid_length=None):
@@ -80,11 +82,12 @@ class BERTForPretraining(HybridBlock):
         self._tie = tie_weights
         units = self.bert._units
         self.mlm_transform = nn.Dense(units, activation="gelu",
-                                      flatten=False)
-        self.mlm_ln = nn.LayerNorm(epsilon=layer_norm_eps)
+                                      flatten=False, in_units=units)
+        self.mlm_ln = nn.LayerNorm(epsilon=layer_norm_eps, in_channels=units)
         if not tie_weights:
-            self.mlm_decoder = nn.Dense(vocab_size, flatten=False)
-        self.nsp_classifier = nn.Dense(2, flatten=False)
+            self.mlm_decoder = nn.Dense(vocab_size, flatten=False,
+                                        in_units=units)
+        self.nsp_classifier = nn.Dense(2, flatten=False, in_units=units)
 
     def forward(self, inputs, token_types=None, valid_length=None,
                 masked_positions=None):

@@ -40,7 +40,7 @@ class MultiHeadAttention(HybridBlock):
     """
 
     def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
-                 causal=False, attention_impl="auto", **kwargs):
+                 causal=False, attention_impl="auto", in_units=0, **kwargs):
         super().__init__()
         if units % num_heads:
             raise MXNetError("units %d not divisible by num_heads %d"
@@ -50,11 +50,17 @@ class MultiHeadAttention(HybridBlock):
         self._causal = causal
         self._impl = attention_impl
         self._dropout = dropout
-        # column-parallel in-projections, row-parallel out-projection
-        self.query_proj = Dense(units, use_bias=use_bias, flatten=False)
-        self.key_proj = Dense(units, use_bias=use_bias, flatten=False)
-        self.value_proj = Dense(units, use_bias=use_bias, flatten=False)
-        self.out_proj = Dense(units, use_bias=use_bias, flatten=False)
+        # column-parallel in-projections, row-parallel out-projection.
+        # in_units (when the caller knows the input dim) skips deferred
+        # shape resolution — no eager probe pass is needed before jit.
+        self.query_proj = Dense(units, use_bias=use_bias, flatten=False,
+                                in_units=in_units)
+        self.key_proj = Dense(units, use_bias=use_bias, flatten=False,
+                              in_units=in_units)
+        self.value_proj = Dense(units, use_bias=use_bias, flatten=False,
+                                in_units=in_units)
+        self.out_proj = Dense(units, use_bias=use_bias, flatten=False,
+                              in_units=units)
         self.out_proj.weight.sharding = (None, "tp")
         if self.out_proj.bias is not None:
             self.out_proj.bias.sharding = (None,)
@@ -85,11 +91,12 @@ class PositionwiseFFN(HybridBlock):
     TP layout (ffn-in column-parallel, ffn-out row-parallel)."""
 
     def __init__(self, units, hidden_size, activation="gelu", dropout=0.0,
-                 use_bias=True, **kwargs):
+                 use_bias=True, in_units=0, **kwargs):
         super().__init__()
         self.ffn_1 = Dense(hidden_size, use_bias=use_bias, flatten=False,
-                           activation=activation)
-        self.ffn_2 = Dense(units, use_bias=use_bias, flatten=False)
+                           activation=activation, in_units=in_units)
+        self.ffn_2 = Dense(units, use_bias=use_bias, flatten=False,
+                           in_units=hidden_size)
         self.ffn_2.weight.sharding = (None, "tp")
         if self.ffn_2.bias is not None:
             self.ffn_2.bias.sharding = (None,)
@@ -110,13 +117,15 @@ class TransformerEncoderCell(HybridBlock):
                  layer_norm_eps=1e-12, causal=False, **kwargs):
         super().__init__()
         self._pre_norm = pre_norm
+        # the residual (x + h) pins the cell's input dim to units, so all
+        # in_units are static — no deferred-shape probe needed
         self.attention = MultiHeadAttention(units, num_heads,
                                             dropout=attention_dropout,
-                                            causal=causal)
-        self.attn_ln = LayerNorm(epsilon=layer_norm_eps)
+                                            causal=causal, in_units=units)
+        self.attn_ln = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
         self.ffn = PositionwiseFFN(units, hidden_size, activation=activation,
-                                   dropout=dropout)
-        self.ffn_ln = LayerNorm(epsilon=layer_norm_eps)
+                                   dropout=dropout, in_units=units)
+        self.ffn_ln = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
         self.dropout = Dropout(dropout) if dropout else None
 
     def forward(self, x, mask=None):

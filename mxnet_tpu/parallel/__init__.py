@@ -28,9 +28,9 @@ from ..ndarray.ndarray import NDArray
 from .optim import make_optimizer
 from .ring import ring_attention, ulysses_attention
 
-__all__ = ["make_mesh", "FusedTrainer", "make_train_step", "ring_attention",
-           "ulysses_attention", "P", "Mesh", "NamedSharding",
-           "shard_params", "param_pspec", "SUPPORTS_ZERO"]
+__all__ = ["make_mesh", "FusedTrainer", "PipelineTrainer", "make_train_step",
+           "ring_attention", "ulysses_attention", "P", "Mesh",
+           "NamedSharding", "shard_params", "param_pspec", "SUPPORTS_ZERO"]
 
 # feature gate for the driver dryrun: FusedTrainer(zero=True) shards
 # optimizer state over dp (ZeRO-1)
@@ -117,6 +117,17 @@ class FusedTrainer:
                  batch_axes=("dp",), dtype=None, grad_accum=1, zero=False):
         self._block = block
         self._mesh = mesh
+        # mixed precision: fp32 master weights; compute in dtype (bf16 is
+        # the TPU-native mode — MXU bf16 matmuls accumulate f32, no loss
+        # scaling needed; reference contrib/amp did fp16 + LossScaler)
+        if dtype in (None, "float32", "fp32"):
+            self._dtype = None
+        elif dtype in ("bfloat16", "bf16", jnp.bfloat16):
+            self._dtype = jnp.bfloat16
+        elif dtype in ("float16", "fp16", jnp.float16):
+            self._dtype = jnp.float16
+        else:
+            raise MXNetError("unsupported FusedTrainer dtype %r" % (dtype,))
         self._batch_axes = tuple(a for a in batch_axes
                                  if mesh is not None and
                                  a in mesh.axis_names)
@@ -134,6 +145,10 @@ class FusedTrainer:
         self._lr = optimizer_params.pop("learning_rate", 0.01)
         self._opt_init, self._opt_update = make_optimizer(
             optimizer, learning_rate=self._lr, **optimizer_params)
+        # a user loss_fn receives ALL model outputs and ALL labels:
+        # loss_fn(outputs_list, *labels) -> scalar/per-example loss
+        # (multi-input models pass x as a tuple, multi-label as y tuple)
+        self._user_loss = loss_fn is not None
         self._loss_fn = loss_fn or _make_loss(loss)
         self._apply = None
         self._params = None
@@ -205,43 +220,78 @@ class FusedTrainer:
         opt_update = self._opt_update
         lr = self._lr
         accum = self._grad_accum
+        compute_dtype = self._dtype
+        from ..contrib.amp import FP32_PARAM_SUFFIXES as _fp32_sufs
 
-        def loss_of(tp, frozen, rng, x, y):
+        user_loss = self._user_loss
+
+        def cast_in(full, xs):
+            """Mixed-precision boundary: cast f32 weights + inputs to the
+            compute dtype; normalization params/statistics stay f32 (the
+            per-op safety list — batch_norm/layer_norm then normalize in
+            f32 and emit the compute dtype)."""
+            if compute_dtype is None:
+                return full, xs
+            full = {n: (v.astype(compute_dtype)
+                        if v.dtype == jnp.float32 and
+                        not n.split(".")[-1] in _fp32_sufs else v)
+                    for n, v in full.items()}
+            xs = tuple(x.astype(compute_dtype)
+                       if jnp.issubdtype(x.dtype, jnp.floating) else x
+                       for x in xs)
+            return full, xs
+
+        def loss_of(tp, frozen, rng, xs, ys):
             full = dict(frozen)
             full.update(tp)
-            outs, new_states = apply_fn(full, rng, x)
-            loss = loss_fn(outs[0], y)
+            full, xs = cast_in(full, xs)
+            outs, new_states = apply_fn(full, rng, *xs)
+            if user_loss:
+                loss = loss_fn(outs, *ys)
+            else:
+                loss = loss_fn(outs[0], ys[0])
             return jnp.mean(loss), new_states
 
-        def step(params, opt_state, step_i, rng, x, y):
+        def step(params, opt_state, step_i, rng, xs, ys):
             train_p = {n: v for n, v in params.items() if n in trainable}
             frozen = {n: v for n, v in params.items() if n not in trainable}
             vg = jax.value_and_grad(loss_of, has_aux=True)
 
             if accum == 1:
-                (loss, new_states), grads = vg(train_p, frozen, rng, x, y)
+                (loss, new_states), grads = vg(train_p, frozen, rng, xs, ys)
             else:
-                if x.shape[0] % accum != 0:
+                if xs[0].shape[0] % accum != 0:
                     raise MXNetError(
                         "batch size %d not divisible by grad_accum=%d"
-                        % (x.shape[0], accum))
+                        % (xs[0].shape[0], accum))
                 # k microbatches through ONE jitted scan: grads averaged
                 # across microbatches (mean-of-means == mean over the full
                 # batch for equal microbatch sizes), a single optimizer
                 # update at the end.  Peak activation memory drops ~k×.
-                xm = x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
-                ym = y.reshape((accum, y.shape[0] // accum) + y.shape[1:])
-                # independent dropout etc. per microbatch
-                (loss0, states0), g0 = vg(train_p, frozen,
-                                          jax.random.fold_in(rng, 0),
-                                          xm[0], ym[0])
+                def mb(a):
+                    return a.reshape((accum, a.shape[0] // accum)
+                                     + a.shape[1:])
+
+                xm = tuple(mb(x) for x in xs)
+                ym = tuple(mb(y) for y in ys)
+                # ALL k microbatches inside one scan (the fwd+bwd XLA code
+                # appears once in the program, not twice): the state-dict
+                # structure is discovered with eval_shape (zero FLOPs) and
+                # the carry starts from the current running stats.
+                state_struct = jax.eval_shape(
+                    lambda: vg(train_p, frozen, rng,
+                               tuple(x[0] for x in xm),
+                               tuple(y[0] for y in ym)))[0][1]
+                states0 = {k: (frozen[k] if k in frozen else train_p[k])
+                           for k in state_struct}
+                g0 = jax.tree_util.tree_map(jnp.zeros_like, train_p)
 
                 def body(carry, xy):
                     acc_loss, acc_g, states, i = carry
                     xi, yi = xy
                     # thread running stats (BN etc.) sequentially through
                     # the microbatches, like k small steps with no param
-                    # update in between
+                    # update in between; independent dropout per microbatch
                     fz = dict(frozen)
                     fz.update(states)
                     (li, si), gi = vg(train_p, fz,
@@ -250,8 +300,8 @@ class FusedTrainer:
                     return (acc_loss + li, acc_g, si, i + 1), None
 
                 (loss, grads, new_states, _i), _ = jax.lax.scan(
-                    body, (loss0, g0, states0, jnp.uint32(1)),
-                    (xm[1:], ym[1:]))
+                    body, (jnp.float32(0), g0, states0, jnp.uint32(0)),
+                    (xm, ym))
                 loss = loss / accum
                 grads = jax.tree_util.tree_map(
                     lambda g: g / accum, grads)
@@ -288,16 +338,24 @@ class FusedTrainer:
 
     # -- public -------------------------------------------------------------
     def step(self, x, y):
+        """One fused training step.  ``x``/``y`` may each be a single array
+        or a tuple (multi-input models / multi-label losses); all leading
+        dims are the batch."""
         from .. import random as mxrandom
 
-        x = x._data if isinstance(x, NDArray) else jnp.asarray(x)
-        y = y._data if isinstance(y, NDArray) else jnp.asarray(y)
+        def as_jax(v):
+            return v._data if isinstance(v, NDArray) else jnp.asarray(v)
+
+        xs = tuple(as_jax(v) for v in x) if isinstance(x, (tuple, list)) \
+            else (as_jax(x),)
+        ys = tuple(as_jax(v) for v in y) if isinstance(y, (tuple, list)) \
+            else (as_jax(y),)
         if self._step_fn is None:
-            self._setup(x)
+            self._setup(*xs)
         rng = mxrandom.take_key()
         self._params, self._opt_state, loss = self._step_fn(
             self._params, self._opt_state, jnp.uint32(self._step_count),
-            rng, x, y)
+            rng, xs, ys)
         self._step_count += 1
         return NDArray(loss)
 
@@ -321,14 +379,17 @@ def _make_loss(loss):
 
     if loss in (None, "softmax_ce", "softmax_cross_entropy"):
         def fn(pred, label):
-            logp = jax.nn.log_softmax(pred, axis=-1)
+            # loss math in f32 regardless of compute dtype (bf16 logits
+            # lose ~3 decimal digits in the log-sum-exp otherwise)
+            logp = jax.nn.log_softmax(pred.astype(jnp.float32), axis=-1)
             lbl = label.astype(jnp.int32)
             return -jnp.take_along_axis(logp, lbl[..., None],
                                         axis=-1)[..., 0]
 
         return fn
     if loss == "l2":
-        return lambda pred, label: 0.5 * jnp.square(pred - label)
+        return lambda pred, label: 0.5 * jnp.square(
+            pred.astype(jnp.float32) - label.astype(jnp.float32))
     if callable(loss):
         return loss
     raise MXNetError("unknown fused loss %r" % loss)
@@ -339,3 +400,7 @@ def make_train_step(block, loss="softmax_ce", optimizer="sgd",
     return FusedTrainer(block, loss=loss, optimizer=optimizer,
                         optimizer_params=optimizer_params, mesh=mesh,
                         **kwargs)
+
+
+# imported last: pipeline.py pulls _make_loss from this module
+from .pipeline import PipelineTrainer  # noqa: E402

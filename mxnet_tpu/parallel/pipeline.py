@@ -1,0 +1,377 @@
+"""GPipe pipeline parallelism over a ``pp`` mesh axis.
+
+TPU-native design (SURVEY §2.3 PP row — absent in the reference; nearest
+ancestor is the subgraph control-flow machinery,
+/root/reference/src/operator/control_flow.cc:1096):
+
+- A ``HybridSequential`` is partitioned into S contiguous stages balanced
+  by parameter count.
+- Each stage's parameters are flattened to one f32 vector, zero-padded to
+  the longest stage, and stacked into an ``(S, Lmax)`` array sharded
+  ``P('pp', None)`` — every device materializes ONLY its own stage's
+  weights (true pipeline memory scaling; optimizer state is stacked and
+  sharded the same way, so state sharding comes for free).
+- The schedule is a ``lax.scan`` over ``M + S - 1`` ticks inside
+  ``shard_map``: each tick every device runs *its* stage via
+  ``lax.switch(axis_index('pp'), ...)`` on a uniform zero-padded activation
+  buffer and hands the result to the next stage with ``lax.ppermute``
+  (stage boundaries ride the ICI ring).  Microbatches enter at stage 0 on
+  consecutive ticks (fill) and losses leave the last stage as they
+  complete (drain) — the classic GPipe schedule expressed as data flow,
+  compiled into ONE XLA program.
+- The backward schedule is not hand-written: differentiating the scan
+  transposes it tick-for-tick (ppermute transposes to the reverse ring),
+  which IS the GPipe backward fill/drain.
+
+A ``dp`` mesh axis (if present) batch-shards every microbatch; gradients
+reduce over dp implicitly through the shardings.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .optim import make_optimizer
+
+__all__ = ["PipelineTrainer"]
+
+
+def _partition_stages(children, n_stages):
+    """Contiguous split of child blocks into n_stages groups, balanced by
+    parameter count (the reference-era heuristic is FLOP balance; params
+    are the proxy that also balances the stacked-weight padding)."""
+    sizes = []
+    for c in children:
+        n = 0
+        for p in c.collect_params().values():
+            if p.shape and 0 not in p.shape:
+                n += int(_np.prod(p.shape))
+            else:
+                n += 1
+        sizes.append(max(n, 1))
+    n = len(children)
+    if n < n_stages:
+        raise MXNetError("cannot split %d layers into %d non-empty stages"
+                         % (n, n_stages))
+    # DP over contiguous splits minimizing the max stage weight (layer
+    # counts are small, O(n^2 * S) is fine and — unlike a quantile sweep —
+    # never produces empty stages for skewed weight distributions)
+    prefix = [0]
+    for s in sizes:
+        prefix.append(prefix[-1] + s)
+
+    INF = float("inf")
+    # best[k][i]: minimal max-weight splitting children[:i] into k stages
+    best = [[INF] * (n + 1) for _ in range(n_stages + 1)]
+    cut = [[0] * (n + 1) for _ in range(n_stages + 1)]
+    best[0][0] = 0.0
+    for k in range(1, n_stages + 1):
+        for i in range(k, n + 1):
+            for j in range(k - 1, i):
+                w = max(best[k - 1][j], prefix[i] - prefix[j])
+                if w < best[k][i]:
+                    best[k][i] = w
+                    cut[k][i] = j
+    bounds = [n]
+    for k in range(n_stages, 0, -1):
+        bounds.append(cut[k][bounds[-1]])
+    bounds.reverse()
+    return [children[bounds[i]:bounds[i + 1]] for i in range(n_stages)]
+
+
+class PipelineTrainer:
+    """GPipe trainer: ``PipelineTrainer(net, loss, optimizer, ..., mesh,
+    num_microbatches)`` with ``mesh`` carrying a ``pp`` axis (and optionally
+    ``dp``).  ``net`` must be a ``HybridSequential``-like block whose
+    children form the pipeline body.
+
+    Limitations (v1): stages must be stateless in the running-statistics
+    sense (LayerNorm/Dense/Conv/attention fine; BatchNorm's moving-stat
+    update is rejected — its cross-microbatch semantics in a pipeline are
+    ill-defined anyway).
+    """
+
+    def __init__(self, block, loss=None, optimizer="sgd",
+                 optimizer_params=None, mesh=None, loss_fn=None,
+                 num_microbatches=4, dtype=None):
+        from . import _make_loss  # shared loss factory
+
+        if mesh is None or "pp" not in mesh.axis_names:
+            raise MXNetError("PipelineTrainer needs a mesh with a 'pp' axis")
+        self._mesh = mesh
+        self._S = int(mesh.shape["pp"])
+        self._dp = int(mesh.shape["dp"]) if "dp" in mesh.axis_names else 1
+        if self._S < 2:
+            raise MXNetError("pp axis must have >= 2 devices")
+        self._block = block
+        self._M = int(num_microbatches)
+        if self._M < self._S:
+            raise MXNetError(
+                "num_microbatches (%d) must be >= pipeline stages (%d) for "
+                "a working fill/drain schedule" % (self._M, self._S))
+        optimizer_params = dict(optimizer_params or {})
+        self._lr = optimizer_params.pop("learning_rate", 0.01)
+        self._opt_init, self._opt_update = make_optimizer(
+            optimizer, learning_rate=self._lr, **optimizer_params)
+        self._user_loss = loss_fn is not None
+        self._loss_fn = loss_fn or _make_loss(loss)
+        if dtype not in (None, "float32", "fp32"):
+            raise MXNetError("PipelineTrainer v1 computes in f32 (got "
+                             "dtype=%r)" % (dtype,))
+        self._step_fn = None
+        self._step_count = 0
+
+    # -- setup --------------------------------------------------------------
+    def _setup(self, x, y):
+        from .. import autograd
+
+        block = self._block
+        children = list(block)
+        if len(children) < self._S:
+            raise MXNetError("model has %d layers < %d pipeline stages"
+                             % (len(children), self._S))
+        # resolve deferred shapes with one eager probe
+        if any(p._data is None for p in block.collect_params().values()):
+            with autograd.pause():
+                block(NDArray(x))
+
+        B = x.shape[0]
+        M, S, dp = self._M, self._S, self._dp
+        if B % M:
+            raise MXNetError("batch %d not divisible by num_microbatches %d"
+                             % (B, M))
+        mb = B // M
+        if mb % dp:
+            raise MXNetError("microbatch %d not divisible by dp=%d"
+                             % (mb, dp))
+        mb_loc = mb // dp
+
+        # per-stage pure apply fns + param flattening metadata
+        from ..gluon.nn import HybridSequential
+
+        stage_children = _partition_stages(children, S)
+        self._applies = []
+        self._metas = []     # per stage: list of (name, param_obj, shape, n)
+        flats = []
+        rng0 = jax.random.PRNGKey(0)
+        a_shape = (mb_loc,) + x.shape[1:]
+        a_dtype = x.dtype
+        self._in_shapes = []
+        self._out_shapes = []
+        abstract = jax.ShapeDtypeStruct(a_shape, a_dtype)
+        for si, kids in enumerate(stage_children):
+            seq = HybridSequential()
+            seq.add(*kids)
+            apply_fn, params = seq.export_pure(training=True)
+            named = seq.collect_params()
+            meta = []
+            vec = []
+            for n, v in params.items():
+                if v.dtype != jnp.float32:
+                    raise MXNetError(
+                        "pipeline v1 requires f32 params (%s is %s)"
+                        % (n, v.dtype))
+                meta.append((n, named[n], v.shape, int(v.size)))
+                vec.append(_np.asarray(v).ravel())
+            outs, states = jax.eval_shape(apply_fn, params, rng0, abstract)
+            if states:
+                raise MXNetError(
+                    "pipeline stage %d updates running statistics (%s) — "
+                    "BatchNorm-style layers are not supported in the "
+                    "pipeline body" % (si, list(states)))
+            if len(outs) != 1:
+                raise MXNetError("pipeline stages must be single-output")
+            self._in_shapes.append(abstract.shape)
+            self._out_shapes.append(outs[0].shape)
+            abstract = jax.ShapeDtypeStruct(outs[0].shape, outs[0].dtype)
+            self._applies.append(apply_fn)
+            self._metas.append(meta)
+            flats.append(_np.concatenate(vec) if vec else
+                         _np.zeros((0,), _np.float32))
+
+        self._Lmax = max(1, max(f.size for f in flats))
+        stacked = _np.zeros((S, self._Lmax), _np.float32)
+        for i, f in enumerate(flats):
+            stacked[i, :f.size] = f
+        self._pspec = P("pp", None)
+        psh = NamedSharding(self._mesh, self._pspec)
+        self._stacked = jax.device_put(jnp.asarray(stacked), psh)
+        self._opt_state = jax.tree_util.tree_map(
+            lambda v: jax.device_put(v, psh),
+            self._opt_init({"stacked": self._stacked}))
+
+        # uniform circulating activation buffer: (mb_loc, Amax) where Amax
+        # covers every stage boundary (padding is zeros; each branch slices
+        # its true shape back out)
+        feat = lambda s: int(_np.prod(s[1:])) if len(s) > 1 else 1
+        self._Amax = max(max(feat(s) for s in self._in_shapes),
+                         max(feat(s) for s in self._out_shapes))
+        self._mb_loc = mb_loc
+        self._build_step()
+
+    def _branches(self):
+        """One closure per stage: (flat_params, inp_buf, label, rng) ->
+        (out_buf, loss).  Identical signatures so lax.switch can pick by
+        axis_index('pp')."""
+        S, Amax, mb = self._S, self._Amax, self._mb_loc
+        loss_fn = self._loss_fn
+        user_loss = self._user_loss
+        branches = []
+        for s in range(S):
+            apply_fn = self._applies[s]
+            meta = self._metas[s]
+            in_shape = self._in_shapes[s]
+            out_shape = self._out_shapes[s]
+            in_feat = int(_np.prod(in_shape[1:])) if len(in_shape) > 1 else 1
+            last = s == S - 1
+
+            def br(flat, inp, label, rng, apply_fn=apply_fn, meta=meta,
+                   in_shape=in_shape, out_shape=out_shape, in_feat=in_feat,
+                   last=last, stage_id=s):
+                # decorrelate dropout across stages: stage s at tick t works
+                # on microbatch t-s, so a tick-only key would repeat across
+                # (stage, microbatch) pairs
+                rng = jax.random.fold_in(rng, stage_id)
+                params = {}
+                off = 0
+                for n, _p, shape, size in meta:
+                    params[n] = flat[off:off + size].reshape(shape)
+                    off += size
+                xin = inp[:, :in_feat].reshape(in_shape)
+                outs, _ = apply_fn(params, rng, xin)
+                out = outs[0].reshape(mb, -1).astype(jnp.float32)
+                pad = Amax - out.shape[1]
+                if pad:
+                    out = jnp.pad(out, ((0, 0), (0, pad)))
+                if last:
+                    if user_loss:
+                        loss = jnp.mean(loss_fn([outs[0]], label))
+                    else:
+                        loss = jnp.mean(loss_fn(outs[0], label))
+                else:
+                    loss = jnp.float32(0)
+                return out, loss
+
+            branches.append(br)
+        return branches
+
+    def _build_step(self):
+        try:
+            from jax import shard_map
+        except ImportError:  # older jax
+            from jax.experimental.shard_map import shard_map
+
+        mesh = self._mesh
+        S, M, dp = self._S, self._M, self._dp
+        mb_loc, Amax = self._mb_loc, self._Amax
+        opt_update = self._opt_update
+        lr = self._lr
+        branches = self._branches()
+        has_dp = "dp" in mesh.axis_names and dp > 1
+        batch_axes = ("dp",) if has_dp else ()
+        perm = [(i, i + 1) for i in range(S - 1)]
+
+        def pipe_loss(stacked, rng, xm, ym):
+            # xm: (M, mb_loc, ...) local; ym: (M, mb_loc, ...) local
+            flat = stacked.reshape(stacked.shape[-1])  # (1, Lmax) -> (Lmax,)
+            stage = lax.axis_index("pp")
+
+            def tick(carry, t):
+                buf, acc = carry
+                mi = jnp.clip(t, 0, M - 1)
+                x_t = lax.dynamic_index_in_dim(xm, mi, 0, keepdims=False)
+                x_flat = x_t.reshape(mb_loc, -1).astype(jnp.float32)
+                pad = Amax - x_flat.shape[1]
+                if pad:
+                    x_flat = jnp.pad(x_flat, ((0, 0), (0, pad)))
+                # stage 0 ingests microbatch t (zeros during drain);
+                # everyone else consumes what ppermute delivered
+                feed = jnp.where(t < M, x_flat, jnp.zeros_like(x_flat))
+                inp = jnp.where(stage == 0, feed, buf)
+                li = jnp.clip(t - (S - 1), 0, M - 1)
+                label = lax.dynamic_index_in_dim(ym, li, 0, keepdims=False)
+                rng_t = jax.random.fold_in(rng, t)
+                out, loss = lax.switch(stage, branches, flat, inp, label,
+                                       rng_t)
+                acc = acc + jnp.where(t >= S - 1, loss, 0.0)
+                buf = lax.ppermute(out, "pp", perm)
+                return (buf, acc), None
+
+            buf0 = jnp.zeros((mb_loc, Amax), jnp.float32)
+            (_, acc), _ = lax.scan(tick, (buf0, jnp.float32(0)),
+                                   jnp.arange(M + S - 1))
+            axes = ("pp",) + batch_axes
+            return lax.psum(acc, axes) / (M * dp)
+
+        in_specs = (self._pspec, P(),
+                    P(None, *batch_axes) if batch_axes else P(),
+                    P(None, *batch_axes) if batch_axes else P())
+        import inspect
+
+        smap_kwargs = {"mesh": mesh, "in_specs": in_specs,
+                       "out_specs": P()}
+        sig = inspect.signature(shard_map).parameters
+        # psum-of-partial values is not "replicated" in the varying-manual
+        # axes sense the checker wants; disable the rep check by whichever
+        # name this jax spells it
+        if "check_vma" in sig:
+            smap_kwargs["check_vma"] = False
+        elif "check_rep" in sig:
+            smap_kwargs["check_rep"] = False
+        smapped = shard_map(pipe_loss, **smap_kwargs)
+
+        def train_step(stacked, opt_state, step_i, rng, xm, ym):
+            loss, g = jax.value_and_grad(
+                lambda w: smapped(w, rng, xm, ym))(stacked)
+            new_p, new_opt = opt_update(step_i, {"stacked": stacked},
+                                        {"stacked": g}, opt_state, lr)
+            return new_p["stacked"], new_opt, loss
+
+        psh = NamedSharding(mesh, self._pspec)
+        bsh = NamedSharding(mesh, P(None, *batch_axes)
+                            if batch_axes else P())
+        opt_sh = jax.tree_util.tree_map(lambda _: psh, self._opt_state)
+        with mesh:
+            self._step_fn = jax.jit(
+                train_step,
+                in_shardings=(psh, opt_sh, None, None, bsh, bsh),
+                out_shardings=(psh, opt_sh, None),
+                donate_argnums=(0, 1))
+
+    # -- public -------------------------------------------------------------
+    def step(self, x, y):
+        from .. import random as mxrandom
+
+        x = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        y = y._data if isinstance(y, NDArray) else jnp.asarray(y)
+        if self._step_fn is None:
+            self._setup(x, y)
+        M = self._M
+        xm = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+        ym = y.reshape((M, y.shape[0] // M) + y.shape[1:])
+        rng = mxrandom.take_key()
+        self._stacked, self._opt_state, loss = self._step_fn(
+            self._stacked, self._opt_state, jnp.uint32(self._step_count),
+            rng, xm, ym)
+        self._step_count += 1
+        return NDArray(loss)
+
+    def sync_block(self):
+        """Write the trained stage weights back into the Gluon block."""
+        host = _np.asarray(self._stacked)
+        for si, meta in enumerate(self._metas):
+            off = 0
+            for _n, param, shape, size in meta:
+                val = host[si, off:off + size].reshape(shape)
+                param._data._data = jnp.asarray(val)
+                off += size
+
+    @property
+    def params(self):
+        return self._stacked
