@@ -2,6 +2,8 @@
 from .basic_layers import *  # noqa: F401,F403
 from .conv_layers import *  # noqa: F401,F403
 from .transformer import *  # noqa: F401,F403
-from . import basic_layers, conv_layers, transformer
+from .moe import *  # noqa: F401,F403
+from . import basic_layers, conv_layers, moe, transformer
 
-__all__ = basic_layers.__all__ + conv_layers.__all__ + transformer.__all__
+__all__ = basic_layers.__all__ + conv_layers.__all__ + \
+    transformer.__all__ + moe.__all__

@@ -1,0 +1,112 @@
+"""Mixture-of-Experts layer with expert parallelism hooks.
+
+The reference has no MoE (SURVEY §2.3 EP row: "Absent") — this is a
+new-capability component designed TPU-first: experts are STACKED along a
+leading dim carrying an ``'ep'`` sharding hint, so the same layer runs
+dense single-chip or expert-parallel over an ep mesh axis, where
+``mxnet_tpu.parallel.moe_apply`` turns the token dispatch into
+``all_to_all`` traffic over ICI (the GShard/Switch pattern).
+
+The eager ``forward`` is the semantic reference: dense-gather top-k
+routing with NO capacity limit (every token reaches its chosen experts).
+``parallel.moe_apply`` is the scalable path with a capacity factor; with
+``capacity_factor`` high enough the two agree exactly, which is what the
+unit test pins.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["MoE"]
+
+
+class MoE(HybridBlock):
+    """Top-k routed mixture of FFN experts.
+
+    Parameters
+    ----------
+    num_experts : int
+        Number of experts E (shardable over the ``ep`` mesh axis).
+    hidden_size : int
+        Expert FFN hidden width.
+    units : int
+        Output width (and input width unless ``in_units`` given).
+    top_k : int
+        Experts per token.
+    activation : str
+        Expert hidden activation ('relu'/'gelu').
+    """
+
+    def __init__(self, num_experts, hidden_size, units, top_k=2,
+                 in_units=0, activation="relu", **kwargs):
+        super().__init__()
+        if top_k < 1 or top_k > num_experts:
+            raise MXNetError("top_k must be in [1, num_experts]")
+        self._E = int(num_experts)
+        self._hidden = int(hidden_size)
+        self._units = int(units)
+        self._k = int(top_k)
+        self._act = activation
+        in_units = int(in_units) or int(units)
+        self._in_units = in_units
+        # experts stacked on a leading dim sharded over 'ep'
+        self.w1 = Parameter("w1", shape=(self._E, in_units, hidden_size),
+                            sharding=("ep", None, None))
+        self.b1 = Parameter("b1", shape=(self._E, hidden_size),
+                            init="zeros", sharding=("ep", None))
+        self.w2 = Parameter("w2", shape=(self._E, hidden_size, units),
+                            sharding=("ep", None, None))
+        self.b2 = Parameter("b2", shape=(self._E, units),
+                            init="zeros", sharding=("ep", None))
+        self.gate = Parameter("gate", shape=(self._E, in_units))
+
+    def _activation(self, jnp, h):
+        if self._act == "relu":
+            return jnp.maximum(h, 0)
+        if self._act == "gelu":
+            import jax
+
+            return jax.nn.gelu(h)
+        raise MXNetError("unknown MoE activation %r" % (self._act,))
+
+    def forward(self, x):
+        """Dense-gather reference path: every expert sees every token, the
+        top-k combine picks.  O(T*E) compute — fine for eval/small E; use
+        ``parallel.moe_apply`` for the scalable dispatch."""
+        import jax
+        import jax.numpy as jnp
+
+        from ...ops.registry import apply_op
+
+        lead = x.shape[:-1]
+        if x.ndim != 2:
+            x = x.reshape((-1, x.shape[-1]))
+        E, k = self._E, self._k
+        w1, b1 = self.w1.data(), self.b1.data()
+        w2, b2 = self.w2.data(), self.b2.data()
+        gate = self.gate.data()
+
+        def moe_dense(x_, w1_, b1_, w2_, b2_, gate_):
+            logits = jnp.einsum("td,ed->te", x_, gate_)
+            probs = jax.nn.softmax(logits, axis=-1)
+            top_vals, top_idx = jax.lax.top_k(probs, k)      # (T, k)
+            norm = top_vals / jnp.maximum(
+                top_vals.sum(-1, keepdims=True), 1e-9)
+            h = jnp.einsum("td,edh->eth", x_, w1_) + b1_[:, None]
+            h = self._activation(jnp, h)
+            y_all = jnp.einsum("eth,ehu->etu", h, w2_) + b2_[:, None]
+            combine = (jax.nn.one_hot(top_idx, E, dtype=x_.dtype) *
+                       norm[..., None]).sum(1)                # (T, E)
+            return jnp.einsum("te,etu->tu", combine, y_all)
+
+        moe_dense.__name__ = "moe_dense"
+        out = apply_op(moe_dense, x, w1, b1, w2, b2, gate)
+        if lead != out.shape[:-1]:
+            out = out.reshape(lead + (out.shape[-1],))
+        return out
+
+    def __repr__(self):
+        return "MoE(experts=%d, hidden=%d, units=%d, top_k=%d)" % (
+            self._E, self._hidden, self._units, self._k)

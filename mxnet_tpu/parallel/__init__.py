@@ -404,3 +404,6 @@ def make_train_step(block, loss="softmax_ce", optimizer="sgd",
 
 # imported last: pipeline.py pulls _make_loss from this module
 from .pipeline import PipelineTrainer  # noqa: E402
+from .moe import moe_apply  # noqa: E402
+
+__all__ += ["moe_apply"]

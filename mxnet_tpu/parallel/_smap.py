@@ -1,0 +1,23 @@
+"""jax.shard_map version-compat shim shared by pipeline/moe/ring paths."""
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map_fn
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_fn
+
+_PARAMS = inspect.signature(_shard_map_fn).parameters
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """shard_map with the replication check disabled under whichever
+    keyword this jax version spells it (psum-of-partial outputs are not
+    'replicated' in the varying-manual-axes sense the checker wants)."""
+    kw = {"mesh": mesh, "in_specs": in_specs, "out_specs": out_specs}
+    if "check_vma" in _PARAMS:
+        kw["check_vma"] = False
+    elif "check_rep" in _PARAMS:
+        kw["check_rep"] = False
+    return _shard_map_fn(fn, **kw)

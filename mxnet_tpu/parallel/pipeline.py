@@ -262,10 +262,7 @@ class PipelineTrainer:
         return branches
 
     def _build_step(self):
-        try:
-            from jax import shard_map
-        except ImportError:  # older jax
-            from jax.experimental.shard_map import shard_map
+        from ._smap import shard_map_compat
 
         mesh = self._mesh
         S, M, dp = self._S, self._M, self._dp
@@ -312,19 +309,8 @@ class PipelineTrainer:
         in_specs = (self._pspec, P(),
                     P(None, *batch_axes) if batch_axes else P(),
                     P(None, *batch_axes) if batch_axes else P())
-        import inspect
-
-        smap_kwargs = {"mesh": mesh, "in_specs": in_specs,
-                       "out_specs": P()}
-        sig = inspect.signature(shard_map).parameters
-        # psum-of-partial values is not "replicated" in the varying-manual
-        # axes sense the checker wants; disable the rep check by whichever
-        # name this jax spells it
-        if "check_vma" in sig:
-            smap_kwargs["check_vma"] = False
-        elif "check_rep" in sig:
-            smap_kwargs["check_rep"] = False
-        smapped = shard_map(pipe_loss, **smap_kwargs)
+        smapped = shard_map_compat(pipe_loss, mesh=mesh,
+                                   in_specs=in_specs, out_specs=P())
 
         def train_step(stacked, opt_state, step_i, rng, xm, ym):
             loss, g = jax.value_and_grad(
