@@ -103,10 +103,9 @@ def bert_train_flops_per_step(batch, seq, n_mask, layers=12, units=768,
     """Analytic BERT train flops (MACs x2, fwd x3 for fwd+bwd+param-grads)."""
     c, ff = units, ffn
     per_tok = layers * (8 * c * c + 4 * seq * c + 4 * c * ff)
-    per_tok += 2 * c * c  # MLM transform (applied to masked slots only,
-    # counted per masked token below would be exact; keep conservative)
-    decoder = 2 * c * vocab
-    fwd = per_tok * batch * seq + decoder * batch * n_mask
+    # MLM transform + vocab decoder run on the masked slots only
+    per_masked = 2 * c * c + 2 * c * vocab
+    fwd = per_tok * batch * seq + per_masked * batch * n_mask
     return 3 * fwd
 
 

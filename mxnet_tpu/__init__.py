@@ -19,6 +19,33 @@ from __future__ import annotations
 
 __version__ = "2.0.0-tpu0"
 
+
+def _maybe_init_distributed():
+    """Join the process group when launched by tools/launch.py.
+
+    The launcher exports MXNET_DIST_{COORDINATOR,NUM_WORKERS,RANK}; this
+    replaces the ps-lite scheduler handshake (reference tools/launch.py +
+    kvstore_dist.h rendezvous) with jax.distributed's coordination
+    service.  Must run before the first jax backend initialization."""
+    import os
+    import sys
+
+    coord = os.environ.get("MXNET_DIST_COORDINATOR")
+    if not coord:
+        return
+    if os.environ.get("MXNET_DIST_STRIP_AXON"):
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(os.environ["MXNET_DIST_NUM_WORKERS"]),
+        process_id=int(os.environ["MXNET_DIST_RANK"]))
+
+
+_maybe_init_distributed()
+
 from . import autograd, base, context, engine
 from . import ndarray
 from . import ndarray as nd

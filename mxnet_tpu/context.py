@@ -25,16 +25,20 @@ def _jax():
 
 def _devices_for(device_type):
     jax = _jax()
+    # process-LOCAL devices only: under jax.distributed (tools/launch.py /
+    # multi-host pods) the global list contains other ranks' devices,
+    # which are non-addressable — ctx device ids index this rank's chips,
+    # exactly like the reference's per-worker gpu(i) numbering
     if device_type == "cpu":
         try:
-            return jax.devices("cpu")
+            return jax.local_devices(backend="cpu")
         except RuntimeError:
             # No explicit cpu backend registered: fall back to default devices
             # if they are cpu, else empty.
-            devs = jax.devices()
+            devs = jax.local_devices()
             return [d for d in devs if d.platform == "cpu"]
     # Any accelerator platform counts as "tpu"/"gpu" here.
-    devs = jax.devices()
+    devs = jax.local_devices()
     accel = [d for d in devs if d.platform != "cpu"]
     return accel
 

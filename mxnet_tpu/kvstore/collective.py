@@ -110,14 +110,24 @@ class CollectiveKVStore(KVStoreBase):
                 [jnp.ravel(a) for _, a in bucket]) if len(bucket) > 1 \
                 else jnp.ravel(bucket[0][1])
             sharding = NamedSharding(self._global_mesh(), P("proc"))
-            garr = jax.make_array_from_process_local_data(
-                sharding, _np.asarray(flat)[None],
-                (jax.process_count(),) + flat.shape)
+            # assemble the (nproc, L) global array directly from device
+            # buffers — no host round-trip; the per-local-device put is a
+            # device-to-device copy (the P('proc') shard is replicated over
+            # the local axis).  Flushes are async dispatches, so successive
+            # buckets overlap on the interconnect.
+            local = flat[None]
+            arrs = [jax.device_put(local, d) for d in jax.local_devices()]
+            garr = jax.make_array_from_single_device_arrays(
+                (jax.process_count(),) + flat.shape, sharding, arrs)
             summed = self._sum_program(flat.shape, flat.dtype)(garr)
+            # detach the replicated global result into this process's local
+            # buffer (still on device) — downstream eager ops must not mix
+            # multi-process global arrays with single-device arrays
+            local_sum = summed.addressable_shards[0].data
             off = 0
             for i, a in bucket:
                 n = a.size
-                out[i] = summed[off:off + n].reshape(a.shape)
+                out[i] = local_sum[off:off + n].reshape(a.shape)
                 off += n
             bucket = []
             nbytes = 0
@@ -147,7 +157,12 @@ class CollectiveKVStore(KVStoreBase):
             if jax.process_count() > 1:
                 from jax.experimental import multihost_utils
 
-                data = multihost_utils.broadcast_one_to_all(v._data)
+                # host-staged numpy in/out: init-time only, and the result
+                # must be a process-local array — eager consumers (copyto
+                # etc.) must never see non-addressable global devices
+                data = multihost_utils.broadcast_one_to_all(
+                    _np.asarray(v._data))
+                data = jnp.asarray(data)
             else:
                 data = v._data
             self._store[str(k)] = NDArray(data)

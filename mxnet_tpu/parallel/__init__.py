@@ -249,6 +249,11 @@ class FusedTrainer:
             if user_loss:
                 loss = loss_fn(outs, *ys)
             else:
+                if len(ys) > 1:
+                    raise MXNetError(
+                        "built-in losses take ONE label array; pass a "
+                        "custom loss_fn(outputs, *labels) for multi-label "
+                        "training (got %d label arrays)" % len(ys))
                 loss = loss_fn(outs[0], ys[0])
             return jnp.mean(loss), new_states
 

@@ -119,7 +119,16 @@ def moe_apply(moe, x, mesh=None, axis_name="ep", capacity_factor=2.0,
     pspec = {"w1": P(axis_name), "b1": P(axis_name),
              "w2": P(axis_name), "b2": P(axis_name), "gate": P()}
     psh = {n: NamedSharding(mesh, s) for n, s in pspec.items()}
-    params = {n: jax.device_put(v, psh[n]) for n, v in params.items()}
+    # cache the sharded weights keyed on the source buffers: repeated
+    # moe_apply calls with unchanged weights must not re-scatter the full
+    # expert stack over ICI every step (a new param array — new id —
+    # invalidates the entry)
+    pkey = (id(mesh), tuple(sorted((n, id(v)) for n, v in params.items())))
+    cached = getattr(moe, "_ep_param_cache", None)
+    if cached is None or cached[0] != pkey:
+        sharded = {n: jax.device_put(v, psh[n]) for n, v in params.items()}
+        moe._ep_param_cache = cached = (pkey, sharded)
+    params = cached[1]
     xv = jax.device_put(xv, NamedSharding(mesh, P(axis_name)))
     # compile once per (mesh, shapes, capacity) and cache on the block —
     # jit's own cache is keyed on function identity, so a fresh lambda per

@@ -1,0 +1,117 @@
+"""Rank-aware distributed kvstore test, run as N local processes by
+tools/launch.py (reference tests/nightly/dist_sync_kvstore.py:30-35 +
+tools/launch.py local mode — SURVEY §4 "multi-node = multi-process on
+localhost").
+
+Launch::
+
+    python tools/launch.py -n 4 --backend cpu \
+        python tests/nightly/dist_sync_kvstore.py
+
+Asserts, on every rank:
+1. pushpull of rank-dependent values == the closed-form global sum
+   (exercises the bucketed on-device allreduce across processes),
+2. bucketing boundaries: many small keys + one large key fuse/split
+   correctly,
+3. after a distributed Trainer step, weights are IDENTICAL on all ranks.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, kvstore, nd
+from mxnet_tpu.gluon import nn
+
+
+def check_diff(arr, expected):
+    got = arr.asnumpy() if isinstance(arr, nd.NDArray) else np.asarray(arr)
+    assert np.allclose(got, expected, rtol=1e-5, atol=1e-6), \
+        "rank %d: got %r expected %r" % (kv.rank, got[:4], expected)
+
+
+kv = kvstore.create("dist_sync")
+nw = kv.num_workers
+rank = kv.rank
+assert nw > 1, "run through tools/launch.py -n N (N>1)"
+
+# 1) closed-form allreduce: every rank pushes (rank+1) * ones
+kv.init("a", nd.zeros((8,)))
+out = nd.zeros((8,))
+kv.pushpull("a", nd.full((8,), float(rank + 1)), out=out)
+expected = sum(range(1, nw + 1))
+check_diff(out, np.full(8, expected, np.float32))
+
+# 1b) broadcast: rank-0 value wins everywhere
+binit = nd.full((5,), float(rank * 100 + 7))
+bout = nd.zeros((5,))
+kv.broadcast("b", binit, out=bout)
+check_diff(bout, np.full(5, 7.0, np.float32))  # rank 0 pushed 7s
+
+# 2) bucketing: 40 small f32 keys + 1 large key (crosses bucket bound) +
+#    an int32 key (forces a dtype flush)
+keys = ["k%d" % i for i in range(40)]
+vals = [nd.full((17,), float(rank + 1) * (i + 1)) for i in range(40)]
+outs = [nd.zeros((17,)) for _ in keys]
+for k, v in zip(keys, vals):
+    kv.init(k, nd.zeros((17,)))
+kv.pushpull(keys, vals, out=outs)
+for i, o in enumerate(outs):
+    check_diff(o, np.full(17, expected * (i + 1), np.float32))
+
+big = nd.full((3 << 20,), float(rank + 1))  # 12 MB > bucket bound
+kv.init("big", nd.zeros(big.shape))
+obig = nd.zeros(big.shape)
+kv.pushpull("big", big, out=obig)
+check_diff(obig[:64], np.full(64, expected, np.float32))
+
+# int32 key between f32 keys: exercises the per-dtype bucket flush
+kv.init("i32", nd.zeros((6,), dtype="int32"))
+mixed_out = [nd.zeros((17,)), nd.zeros((6,), dtype="int32"),
+             nd.zeros((17,))]
+kv.pushpull(["k0", "i32", "k1"],
+            [nd.full((17,), float(rank + 1)),
+             nd.array(np.full(6, rank + 1, np.int32)),
+             nd.full((17,), float(rank + 1) * 2)],
+            out=mixed_out)
+check_diff(mixed_out[0], np.full(17, expected, np.float32))
+check_diff(mixed_out[1], np.full(6, expected, np.int32))
+check_diff(mixed_out[2], np.full(17, expected * 2, np.float32))
+
+# 3) distributed Trainer: same data on every rank => same weights; the
+#    grads flow through the collective store, so weight equality across
+#    ranks after N steps proves the allreduce path end-to-end
+mx.random.seed(42)  # identical init on every rank
+net = nn.HybridSequential()
+net.add(nn.Dense(16, activation="relu", in_units=8), nn.Dense(4,
+        in_units=16))
+net.initialize()
+trainer = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.1}, kvstore=kv)
+loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+rs = np.random.RandomState(7)  # same batch everywhere
+X = nd.array(rs.rand(8, 8).astype(np.float32))
+Y = nd.array(rs.randint(0, 4, 8).astype(np.float32))
+from mxnet_tpu import autograd
+
+for _ in range(3):
+    with autograd.record():
+        L = loss_fn(net(X), Y).mean()
+    L.backward()
+    trainer.step(8)
+
+# gather every rank's weight checksum and compare
+sums = []
+for name, p in sorted(net.collect_params().items()):
+    sums.append(float(p.data().asnumpy().sum()))
+local = nd.array(np.asarray(sums, np.float32))
+kv.init("wsum", nd.zeros(local.shape))
+agg = nd.zeros(local.shape)
+kv.pushpull("wsum", local, out=agg)
+# identical weights => aggregated sum == nw * local sum
+check_diff(agg, np.asarray(sums, np.float32) * nw)
+
+print("rank %d/%d: dist_sync_kvstore OK" % (rank, nw))
+sys.stdout.flush()

@@ -1,0 +1,40 @@
+"""Multi-process distributed validation (SURVEY §4: "multi-node =
+multi-process on localhost", reference tests/nightly/dist_sync_kvstore.py
+launched via tools/launch.py -n 4 --launcher local).
+
+Spawns 4 worker processes through tools/launch.py; each runs the
+rank-aware assertions in tests/nightly/dist_sync_kvstore.py — this is the
+ONLY place the collective kvstore's jax.process_count()>1 branches
+execute, so it must stay in the default test run.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                     "..", "..", ".."))
+
+
+def test_dist_sync_kvstore_4proc():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # children must NOT inherit this pytest process's forced 8-device
+    # virtual CPU flags; the launcher sets its own platform env
+    env.pop("XLA_FLAGS", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+             "-n", "4", "--backend", "cpu", sys.executable,
+             os.path.join(_REPO, "tests", "nightly",
+                          "dist_sync_kvstore.py")],
+            env=env, capture_output=True, text=True, timeout=540)
+    except OSError as exc:  # pragma: no cover - sandboxed env
+        pytest.skip("cannot spawn subprocesses: %s" % exc)
+    assert proc.returncode == 0, (
+        "dist test failed\n--- stdout ---\n%s\n--- stderr ---\n%s"
+        % (proc.stdout[-3000:], proc.stderr[-3000:]))
+    # children share the stdout pipe, so lines can interleave without
+    # newlines — count occurrences, not lines
+    assert proc.stdout.count("dist_sync_kvstore OK") == 4, proc.stdout

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Multi-process training launcher.
+
+Reference: tools/launch.py (dmlc-core tracker spawning scheduler + server +
+worker processes for the ps-lite kvstore, /root/reference/tools/launch.py:
+25-60).  The TPU-native stack has NO server role (SURVEY §5.8: collectives
+replace push/pull), so the launcher's job shrinks to: start N worker
+processes with a shared rendezvous address and rank, and let
+``jax.distributed.initialize`` + the collective kvstore do the rest.
+
+Usage::
+
+    python tools/launch.py -n 4 python train.py --my-args
+    python tools/launch.py -n 2 --backend cpu python tests/nightly/dist_sync_kvstore.py
+
+Each child gets the rendezvous/world env vars (MXNET_DIST_*); user code
+just calls ``mxnet_tpu.kvstore.create('dist_sync')`` (or builds any
+cross-process collective) — ``mxnet_tpu`` auto-initializes
+jax.distributed from these variables at import.
+
+``--backend cpu`` forces the XLA CPU platform in children (the multi-
+process CI path per SURVEY §4: N local processes, Gloo collectives); the
+default inherits the environment (TPU pods use one process per host).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def find_free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="launch N distributed worker processes")
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("--backend", default=None, choices=[None, "cpu"],
+                        help="force JAX_PLATFORMS in children")
+    parser.add_argument("--coordinator", default=None,
+                        help="host:port rendezvous (default: free local "
+                             "port)")
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no command given")
+    coord = args.coordinator or ("127.0.0.1:%d" % find_free_port())
+
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env["MXNET_DIST_COORDINATOR"] = coord
+        env["MXNET_DIST_NUM_WORKERS"] = str(args.num_workers)
+        env["MXNET_DIST_RANK"] = str(rank)
+        if args.backend == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+            env["MXNET_DIST_STRIP_AXON"] = "1"
+            # drop any PJRT-plugin sitecustomize dirs (e.g. the axon TPU
+            # tunnel) from the child's import path: their sitecustomize
+            # runs before user code and overrides JAX_PLATFORMS via jax
+            # config, which would hang every child on a remote backend
+            env["PYTHONPATH"] = os.pathsep.join(
+                p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                if p and ".axon_site" not in p)
+        procs.append(subprocess.Popen(args.command, env=env))
+
+    def _kill_all(*_a):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    signal.signal(signal.SIGINT, _kill_all)
+    signal.signal(signal.SIGTERM, _kill_all)
+    # poll ALL workers: a crash in any rank (while peers block in a
+    # collective waiting for it) must tear the job down, not hang behind
+    # a rank-order wait
+    import time
+
+    rc = 0
+    live = list(procs)
+    while live:
+        for p in list(live):
+            code = p.poll()
+            if code is None:
+                continue
+            live.remove(p)
+            if code != 0 and rc == 0:
+                rc = code
+                _kill_all()
+        if live:
+            time.sleep(0.2)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
