@@ -225,6 +225,7 @@ def load(fname):
 from .. import random  # noqa: E402  (mx.nd.random mirror)
 from . import sparse  # noqa: E402
 from . import contrib  # noqa: E402
+from . import linalg  # noqa: E402  (mx.nd.linalg, reference la_op family)
 from ..operator import Custom  # noqa: E402  (mx.nd.Custom, reference name)
 
 __all__ = ["NDArray", "waitall", "array", "zeros", "ones", "full", "empty",

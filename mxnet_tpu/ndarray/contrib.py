@@ -27,7 +27,22 @@ from .ndarray import NDArray
 
 __all__ = ["foreach", "while_loop", "cond", "isfinite", "isnan", "isinf",
            "arange_like", "index_copy", "index_array", "getnnz",
-           "boolean_mask"]
+           "boolean_mask", "box_iou", "box_nms", "box_encode", "box_decode",
+           "bipartite_matching", "ROIAlign", "MultiBoxPrior",
+           "MultiBoxDetection"]
+
+# detection family (reference src/operator/contrib/bounding_box.cc,
+# roi_align.cc, multibox_*.cc — surfaced as mx.nd.contrib.* there too)
+from ..ops.registry import get_op as _get_op  # noqa: E402
+
+box_iou = _get_op("box_iou")
+box_nms = _get_op("box_nms")
+box_encode = _get_op("box_encode")
+box_decode = _get_op("box_decode")
+bipartite_matching = _get_op("bipartite_matching")
+ROIAlign = _get_op("roi_align")
+MultiBoxPrior = _get_op("multibox_prior")
+MultiBoxDetection = _get_op("multibox_detection")
 
 
 def _flatten(x, out):

@@ -1,0 +1,24 @@
+"""``mx.nd.linalg`` — LAPACK-family namespace.
+
+Reference: python/mxnet/ndarray/linalg.py (generated wrappers over the
+``_linalg_*`` ops, src/operator/tensor/la_op.cc) plus the numpy-linalg
+front-end (src/operator/numpy/linalg/).  Short names here map onto the
+registered ``linalg_*`` operators.
+"""
+from __future__ import annotations
+
+from ..ops.registry import get_op as _get_op
+
+_SHORT = [
+    "gemm", "gemm2", "potrf", "potri", "trmm", "trsm", "syrk", "gelqf",
+    "syevd", "sumlogdiag", "extractdiag", "makediag", "extracttrian",
+    "maketrian", "inverse", "det", "slogdet", "cholesky", "qr", "svd",
+    "svdvals", "eig", "eigh", "eigvals", "eigvalsh", "solve", "lstsq",
+    "pinv", "matrix_rank", "matrix_power", "norm", "cond", "multi_dot",
+    "tensorinv", "tensorsolve",
+]
+
+for _name in _SHORT:
+    globals()[_name] = _get_op("linalg_" + _name)
+
+__all__ = list(_SHORT)

@@ -578,6 +578,34 @@ class NDArray:
         arr = self.asnumpy()
         return arr.astype(dtype) if dtype is not None else arr
 
+    # NEP-18/NEP-13 dispatch (reference numpy/multiarray.py:367 +
+    # numpy_dispatch_protocol.py): numpy API calls on NDArray operands
+    # route through mx.np — so np.mean(mx_arr) stays on-device and on the
+    # autograd tape instead of silently densifying to host numpy
+    def __array_function__(self, func, types, args, kwargs):
+        from .. import numpy as _mxnp
+
+        target = _mxnp
+        mod = getattr(func, "__module__", "") or ""
+        for part in mod.split(".")[1:]:  # e.g. numpy.linalg -> .linalg
+            target = getattr(target, part, None)
+            if target is None:
+                return NotImplemented
+        f = getattr(target, func.__name__, None)
+        if f is None:
+            return NotImplemented
+        return f(*args, **kwargs)
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        if method != "__call__" or kwargs.get("out") is not None:
+            return NotImplemented
+        from .. import numpy as _mxnp
+
+        f = getattr(_mxnp, ufunc.__name__, None)
+        if f is None:
+            return NotImplemented
+        return f(*inputs, **kwargs)
+
     def __dlpack__(self, stream=None):
         return self._data.__dlpack__(stream=stream)
 

@@ -72,30 +72,59 @@ def _wrap_out(out):
     return out
 
 
+class _Seq:
+    """Marker for a positional list/tuple arg containing NDArrays."""
+
+    __slots__ = ("container", "items")
+
+    def __init__(self, container, items):
+        self.container = container
+        self.items = items
+
+
 def _adapt(name, fn):
     def wrapped(*args, **kwargs):
-        has_nd = any(isinstance(a, NDArray) for a in args) or any(
-            isinstance(a, NDArray)
-            for arg in args if isinstance(arg, (list, tuple)) for a in arg)
         nd_args = []
-        positions = []
-        flat_args = list(args)
-        # split NDArray positional args from static ones so attrs stay static
+        positions = []  # (arg index, sub index | None)
+        # split NDArray positional args from static ones so attrs stay
+        # static — including NDArrays nested one level inside list/tuple
+        # args (concatenate/stack/...), which must ALSO ride the record
+        # path or backward would silently return zero grads for them
         plain_args = []
-        for i, a in enumerate(flat_args):
+        for i, a in enumerate(args):
             if isinstance(a, NDArray):
-                positions.append(i)
+                positions.append((i, None))
                 nd_args.append(a)
                 plain_args.append(None)
+            elif isinstance(a, (list, tuple)) and any(
+                    isinstance(v, NDArray) for v in a):
+                sub = []
+                for j, v in enumerate(a):
+                    if isinstance(v, NDArray):
+                        positions.append((i, j))
+                        nd_args.append(v)
+                        sub.append(None)
+                    else:
+                        sub.append(_unwrap(v))
+                plain_args.append(_Seq(type(a), sub))
             else:
                 plain_args.append(_unwrap(a))
         kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
 
         def pure(*datas):
-            merged = list(plain_args)
-            for p, d in zip(positions, datas):
-                merged[p] = d
-            return fn(*merged, **kwargs)
+            merged = [list(p.items) if isinstance(p, _Seq) else p
+                      for p in plain_args]
+            for (i, j), d in zip(positions, datas):
+                if j is None:
+                    merged[i] = d
+                else:
+                    merged[i][j] = d
+            final = [orig.container(m) if isinstance(orig, _Seq) else m
+                     for orig, m in zip(plain_args, merged)]
+            out = fn(*final, **kwargs)
+            # list outputs (split/meshgrid/...) -> tuple: the invoke path
+            # treats tuples as multi-output, lists as a single array
+            return tuple(out) if isinstance(out, list) else out
 
         pure.__name__ = "np." + name
         if name in _NON_DIFF or not nd_args:
@@ -110,10 +139,20 @@ def _adapt(name, fn):
     return wrapped
 
 
+# Adapted attributes are cached in a SEPARATE dict, never setattr'd onto
+# the module: module attributes ARE the globals of every function defined
+# in this file, so caching e.g. mx.np.any as an attribute would shadow the
+# builtin ``any`` inside _adapt.wrapped and recurse infinitely.
+_adapted_cache = {}
+
+
 class _NPModule(types.ModuleType):
     def __getattr__(self, name):
         if name.startswith("__"):
             raise AttributeError(name)
+        cached = _adapted_cache.get(name)
+        if cached is not None:
+            return cached
         jnp = _jnp()
         target = getattr(jnp, name, None)
         if target is None:
@@ -122,15 +161,13 @@ class _NPModule(types.ModuleType):
             if target is None:
                 raise AttributeError("mx.np has no attribute %r" % name)
         if isinstance(target, types.ModuleType):
-            sub = _SubModule("%s.%s" % (__name__, name), target)
-            setattr(self, name, sub)
-            return sub
-        if callable(target):
-            fn = _adapt(name, target)
-            setattr(self, name, fn)
-            return fn
-        setattr(self, name, target)
-        return target
+            out = _SubModule("%s.%s" % (__name__, name), target)
+        elif callable(target):
+            out = _adapt(name, target)
+        else:
+            out = target
+        _adapted_cache[name] = out
+        return out
 
 
 class _SubModule(types.ModuleType):
@@ -139,15 +176,18 @@ class _SubModule(types.ModuleType):
     def __init__(self, name, target):
         super().__init__(name)
         self._target = target
+        self._cache = {}
 
     def __getattr__(self, name):
         if name.startswith("_"):
             raise AttributeError(name)
+        cached = self.__dict__["_cache"].get(name)
+        if cached is not None:
+            return cached
         obj = getattr(self._target, name)
         if callable(obj):
-            fn = _adapt(name, obj)
-            setattr(self, name, fn)
-            return fn
+            obj = _adapt(name, obj)
+        self.__dict__["_cache"][name] = obj
         return obj
 
 

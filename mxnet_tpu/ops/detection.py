@@ -1,0 +1,342 @@
+"""Detection / bounding-box operator family.
+
+Reference parity: /root/reference/src/operator/contrib/bounding_box.cc
+(box_iou, box_nms, box_encode, box_decode, bipartite_matching),
+roi_align.cc, and the multibox family (multibox_prior.cc,
+multibox_detection.cc).
+
+TPU-native notes: everything is expressed with static shapes so XLA can
+compile it — NMS keeps the box count fixed and marks suppressed entries
+with -1 scores (exactly the reference's in-place -1 convention,
+bounding_box.cc BoxNMSForward), selection loops are lax.fori_loop /
+top_k, and ROI Align is a gather + bilinear-weights einsum that lands on
+the MXU instead of the reference's per-pixel CUDA kernel.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = []
+
+
+def _corner(boxes, fmt):
+    """-> (xmin, ymin, xmax, ymax) from 'corner' or 'center' format."""
+    if fmt == "corner":
+        return boxes[..., 0], boxes[..., 1], boxes[..., 2], boxes[..., 3]
+    cx, cy, w, h = (boxes[..., 0], boxes[..., 1], boxes[..., 2],
+                    boxes[..., 3])
+    return cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2
+
+
+@register("box_iou")
+def box_iou(lhs, rhs, format="corner"):
+    """Pairwise IoU: lhs (..., N, 4) x rhs (..., M, 4) -> (..., N, M)
+    (bounding_box.cc box_iou)."""
+    lx1, ly1, lx2, ly2 = _corner(lhs[..., :, None, :], format)
+    rx1, ry1, rx2, ry2 = _corner(rhs[..., None, :, :], format)
+    ix = jnp.maximum(jnp.minimum(lx2, rx2) - jnp.maximum(lx1, rx1), 0)
+    iy = jnp.maximum(jnp.minimum(ly2, ry2) - jnp.maximum(ly1, ry1), 0)
+    inter = ix * iy
+    area_l = jnp.maximum(lx2 - lx1, 0) * jnp.maximum(ly2 - ly1, 0)
+    area_r = jnp.maximum(rx2 - rx1, 0) * jnp.maximum(ry2 - ry1, 0)
+    union = area_l + area_r - inter
+    # guard the denominator BEFORE dividing: a where() around an unguarded
+    # division still produces NaN cotangents for union==0 rows (zero-padded
+    # box lists) through the vjp
+    safe_union = jnp.where(union > 0, union, 1.0)
+    return jnp.where(union > 0, inter / safe_union, 0.0)
+
+
+@register("box_nms", differentiable=False)
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, force_suppress=False,
+            in_format="corner", out_format="corner"):
+    """Non-maximum suppression (bounding_box.cc BoxNMSForward).
+
+    data: (..., N, K) rows [id?, score, x1, y1, x2, y2, ...]; suppressed
+    rows get score -1 (shape-stable, reference convention)."""
+    batch_shape = data.shape[:-2]
+    N, K = data.shape[-2], data.shape[-1]
+    flat = data.reshape((-1, N, K))
+
+    def one(batch):
+        scores = batch[:, score_index]
+        boxes = batch[:, coord_start:coord_start + 4]
+        valid = scores > valid_thresh
+        order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+        if topk > 0:
+            keep_rank = jnp.arange(N) < topk
+        else:
+            keep_rank = jnp.ones((N,), bool)
+        iou = box_iou.fn(boxes, boxes, format=in_format)
+        same_class = jnp.ones((N, N), bool)
+        if not force_suppress and id_index >= 0:
+            ids = batch[:, id_index]
+            same_class = ids[:, None] == ids[None, :]
+
+        def body(i, keep):
+            # suppress everything the i-th ranked (kept, valid) box
+            # overlaps; fori_loop keeps the program static-shape
+            bi = order[i]
+            active = keep[bi] & valid[bi] & keep_rank[i]
+            overl = (iou[bi] > overlap_thresh) & same_class[bi]
+            overl = overl.at[bi].set(False)
+            return jnp.where(active, keep & ~overl, keep)
+
+        keep = lax.fori_loop(0, N, body, valid & keep_rank[
+            jnp.argsort(order)])
+        new_scores = jnp.where(keep, scores, -1.0)
+        batch = batch.at[:, score_index].set(new_scores)
+        if in_format != out_format:
+            if out_format == "corner":
+                x1, y1, x2, y2 = _corner(boxes, in_format)
+                conv = jnp.stack([x1, y1, x2, y2], axis=-1)
+            else:  # corner -> center
+                w = boxes[:, 2] - boxes[:, 0]
+                h = boxes[:, 3] - boxes[:, 1]
+                conv = jnp.stack([boxes[:, 0] + w / 2, boxes[:, 1] + h / 2,
+                                  w, h], axis=-1)
+            batch = batch.at[:, coord_start:coord_start + 4].set(conv)
+        return batch
+
+    out = jax.vmap(one)(flat)
+    return out.reshape(batch_shape + (N, K))
+
+
+@register("box_encode")
+def box_encode(samples, matches, anchors, refs, means=(0., 0., 0., 0.),
+               stds=(0.1, 0.1, 0.2, 0.2)):
+    """Encode matched gt boxes as anchor offsets (bounding_box.cc
+    box_encode; SSD target convention)."""
+    ax1, ay1, ax2, ay2 = (anchors[..., 0], anchors[..., 1], anchors[..., 2],
+                          anchors[..., 3])
+    aw, ah = ax2 - ax1, ay2 - ay1
+    acx, acy = ax1 + aw / 2, ay1 + ah / 2
+    g = jnp.take_along_axis(refs, matches[..., None].astype(jnp.int32)
+                            .clip(0), axis=-2)
+    gx1, gy1, gx2, gy2 = g[..., 0], g[..., 1], g[..., 2], g[..., 3]
+    gw, gh = gx2 - gx1, gy2 - gy1
+    gcx, gcy = gx1 + gw / 2, gy1 + gh / 2
+    means = jnp.asarray(means, anchors.dtype)
+    stds = jnp.asarray(stds, anchors.dtype)
+    t = jnp.stack([(gcx - acx) / jnp.maximum(aw, 1e-12),
+                   (gcy - acy) / jnp.maximum(ah, 1e-12),
+                   jnp.log(jnp.maximum(gw, 1e-12) / jnp.maximum(aw, 1e-12)),
+                   jnp.log(jnp.maximum(gh, 1e-12) / jnp.maximum(ah, 1e-12))],
+                  axis=-1)
+    t = (t - means) / stds
+    mask = (samples > 0.5)[..., None]
+    return jnp.where(mask, t, 0.0), mask.astype(anchors.dtype)
+
+
+@register("box_decode")
+def box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+               clip=-1.0, format="corner"):
+    """Invert box_encode (bounding_box.cc box_decode)."""
+    ax1, ay1, ax2, ay2 = _corner(anchors, format)
+    aw, ah = ax2 - ax1, ay2 - ay1
+    acx, acy = ax1 + aw / 2, ay1 + ah / 2
+    stds = jnp.asarray([std0, std1, std2, std3], data.dtype)
+    d = data * stds
+    pcx = d[..., 0] * aw + acx
+    pcy = d[..., 1] * ah + acy
+    dw, dh = d[..., 2], d[..., 3]
+    if clip > 0:
+        dw = jnp.minimum(dw, clip)
+        dh = jnp.minimum(dh, clip)
+    pw, ph = jnp.exp(dw) * aw, jnp.exp(dh) * ah
+    return jnp.stack([pcx - pw / 2, pcy - ph / 2,
+                      pcx + pw / 2, pcy + ph / 2], axis=-1)
+
+
+@register("bipartite_matching", num_outputs=2, differentiable=False)
+def bipartite_matching(data, threshold=0.5, is_ascend=False, topk=-1):
+    """Greedy bipartite matching on a score matrix (bounding_box.cc):
+    returns (row_match, col_match) index vectors, -1 for unmatched."""
+    N, M = data.shape[-2], data.shape[-1]
+    batch_shape = data.shape[:-2]
+    flat = data.reshape((-1, N, M))
+    k = N if topk <= 0 else min(topk, N)
+
+    def one(mat):
+        score = mat if not is_ascend else -mat
+        row_match = jnp.full((N,), -1, jnp.int32)
+        col_match = jnp.full((M,), -1, jnp.int32)
+
+        def body(_, carry):
+            rm, cm, s = carry
+            idx = jnp.argmax(s)
+            i, j = idx // M, idx % M
+            ok = s[i, j] >= (threshold if not is_ascend else -threshold)
+            rm = jnp.where(ok, rm.at[i].set(j.astype(jnp.int32)), rm)
+            cm = jnp.where(ok, cm.at[j].set(i.astype(jnp.int32)), cm)
+            s = jnp.where(ok, s.at[i, :].set(-jnp.inf).at[:, j]
+                          .set(-jnp.inf), s)
+            return rm, cm, s
+
+        rm, cm, _ = lax.fori_loop(0, k, body,
+                                  (row_match, col_match, score))
+        return rm, cm
+
+    rm, cm = jax.vmap(one)(flat)
+    return (rm.reshape(batch_shape + (N,)),
+            cm.reshape(batch_shape + (M,)))
+
+
+@register("roi_align")
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=2, position_sensitive=False, aligned=True):
+    """ROI Align (contrib/roi_align.cc, Mask R-CNN): bilinear sampling at
+    sample_ratio^2 points per output bin, averaged.
+
+    data: (B, C, H, W); rois: (R, 5) rows [batch_idx, x1, y1, x2, y2] in
+    image coords.  Differentiable (gather + weights)."""
+    B, C, H, W = data.shape
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+    sr = max(int(sample_ratio), 1)
+    offset = 0.5 if aligned else 0.0
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - offset
+        y1 = roi[2] * spatial_scale - offset
+        x2 = roi[3] * spatial_scale - offset
+        y2 = roi[4] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bin_w, bin_h = rw / pw, rh / ph
+        # sample grid: (ph, sr) x (pw, sr)
+        iy = (jnp.arange(ph)[:, None] + (jnp.arange(sr)[None, :] + 0.5)
+              / sr).reshape(-1)                       # (ph*sr,)
+        ix = (jnp.arange(pw)[:, None] + (jnp.arange(sr)[None, :] + 0.5)
+              / sr).reshape(-1)                       # (pw*sr,)
+        ys = y1 + iy * bin_h
+        xs = x1 + ix * bin_w
+
+        def bilinear(img, ys, xs):
+            # img: (C, H, W); sample at outer grid ys x xs.
+            # out-of-bounds handling mirrors roi_align.cc exactly: reject
+            # samples beyond [-1, H]/[−1, W], CLAMP coords to 0 BEFORE
+            # deriving the weights (else boundary bins blend a phantom
+            # row/col), then bilinear-blend the 4 neighbors
+            oob_y = (ys < -1.0) | (ys > H)
+            oob_x = (xs < -1.0) | (xs > W)
+            ys = jnp.clip(ys, 0.0, None)
+            xs = jnp.clip(xs, 0.0, None)
+            y0 = jnp.floor(ys)
+            x0 = jnp.floor(xs)
+            wy1 = ys - y0
+            wx1 = xs - x0
+            y0i = jnp.clip(y0.astype(jnp.int32), 0, H - 1)
+            y1i = jnp.clip(y0i + 1, 0, H - 1)
+            x0i = jnp.clip(x0.astype(jnp.int32), 0, W - 1)
+            x1i = jnp.clip(x0i + 1, 0, W - 1)
+            g = img[:, y0i][:, :, x0i] * ((1 - wy1)[:, None] *
+                                          (1 - wx1)[None, :]) + \
+                img[:, y1i][:, :, x0i] * (wy1[:, None] *
+                                          (1 - wx1)[None, :]) + \
+                img[:, y0i][:, :, x1i] * ((1 - wy1)[:, None] *
+                                          wx1[None, :]) + \
+                img[:, y1i][:, :, x1i] * (wy1[:, None] * wx1[None, :])
+            mask = (~oob_y)[:, None] & (~oob_x)[None, :]
+            return g * mask[None]
+
+        img = data[bidx]                              # (C, H, W)
+        samples = bilinear(img, ys, xs)               # (C, ph*sr, pw*sr)
+        samples = samples.reshape(C, ph, sr, pw, sr)
+        pooled = samples.mean(axis=(2, 4))            # (C, ph, pw)
+        if position_sensitive:
+            # PS-ROIAlign (R-FCN): channel group c*ph*pw + i*pw + j feeds
+            # output bin (i, j) of class-channel c
+            C_out = C // (ph * pw)
+            cidx = (jnp.arange(C_out)[:, None, None] * (ph * pw)
+                    + jnp.arange(ph)[None, :, None] * pw
+                    + jnp.arange(pw)[None, None, :])
+            pooled = pooled[cidx,
+                            jnp.arange(ph)[None, :, None],
+                            jnp.arange(pw)[None, None, :]]
+        return pooled
+
+    if position_sensitive and C % (pooled_size[0] * pooled_size[1]
+                                   if isinstance(pooled_size, (tuple, list))
+                                   else pooled_size ** 2):
+        raise ValueError("position_sensitive=True needs channels divisible "
+                         "by ph*pw (got C=%d)" % C)
+    return jax.vmap(one_roi)(rois)
+
+
+@register("multibox_prior", differentiable=False)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """SSD anchor generation (multibox_prior.cc): (1, H*W*A, 4) corners."""
+    H, W = data.shape[-2], data.shape[-1]
+    sizes = _np.asarray(sizes, _np.float32)
+    ratios = _np.asarray(ratios, _np.float32)
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H) + offsets[0]) * step_y
+    cx = (jnp.arange(W) + offsets[1]) * step_x
+    # anchors per pixel in the REFERENCE order (multibox_prior.cc: all
+    # sizes with ratios[0] first, then ratios[1:] with sizes[0]); widths
+    # carry the in_height/in_width aspect correction so boxes stay square
+    # in image space on non-square feature maps
+    aspect = float(H) / float(W)
+    ws, hs = [], []
+    for s in sizes:
+        ws.append(s * aspect * _np.sqrt(ratios[0]))
+        hs.append(s / _np.sqrt(ratios[0]))
+    for r in ratios[1:]:
+        ws.append(sizes[0] * aspect * _np.sqrt(r))
+        hs.append(sizes[0] / _np.sqrt(r))
+    ws = jnp.asarray(_np.asarray(ws) / 2)
+    hs = jnp.asarray(_np.asarray(hs) / 2)
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
+    cyg = cyg[..., None]
+    cxg = cxg[..., None]
+    boxes = jnp.stack([cxg - ws, cyg - hs, cxg + ws, cyg + hs], axis=-1)
+    boxes = boxes.reshape(1, -1, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+@register("multibox_detection", differentiable=False)
+def multibox_detection(cls_prob, loc_pred, anchors, clip=True,
+                       threshold=0.01, nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """SSD detection head (multibox_detection.cc): decode + per-class
+    scores + NMS.  cls_prob (B, CLS, N) with class 0 = background,
+    loc_pred (B, N*4), anchors (1, N, 4 center-format) ->
+    (B, N, 6) rows [cls_id, score, x1, y1, x2, y2], invalid rows -1."""
+    B, CLS, N = cls_prob.shape
+    loc = loc_pred.reshape(B, N, 4)
+    # decode against center-format anchors
+    acx, acy, aw, ah = (anchors[..., 0], anchors[..., 1], anchors[..., 2],
+                        anchors[..., 3])
+    v = variances
+    pcx = loc[..., 0] * v[0] * aw + acx
+    pcy = loc[..., 1] * v[1] * ah + acy
+    pw = jnp.exp(loc[..., 2] * v[2]) * aw
+    ph = jnp.exp(loc[..., 3] * v[3]) * ah
+    boxes = jnp.stack([pcx - pw / 2, pcy - ph / 2,
+                       pcx + pw / 2, pcy + ph / 2], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    scores = cls_prob[:, 1:, :]                      # drop background
+    best = jnp.argmax(scores, axis=1).astype(jnp.float32)  # (B, N)
+    best_score = jnp.max(scores, axis=1)
+    keep = best_score > threshold
+    cls_id = jnp.where(keep, best, -1.0)
+    score = jnp.where(keep, best_score, -1.0)
+    det = jnp.concatenate([cls_id[..., None], score[..., None], boxes],
+                          axis=-1)
+    return box_nms.fn(det, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                      topk=nms_topk, coord_start=2, score_index=1,
+                      id_index=0, force_suppress=force_suppress)

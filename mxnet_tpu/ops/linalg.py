@@ -1,0 +1,280 @@
+"""Linear-algebra operator family.
+
+Reference parity: the LAPACK-backed ``_linalg_*`` ops
+(/root/reference/src/operator/tensor/la_op.cc — gemm, gemm2, potrf, potri,
+trmm, trsm, syrk, gelqf, syevd, sumlogdiag, extract/make diag+trian,
+inverse, det, slogdet) and the numpy linalg front-end
+(/root/reference/src/operator/numpy/linalg/ — svd/eig/eigh/qr/solve/
+lstsq/pinv/...).
+
+TPU-native: everything XLA lowers natively (cholesky, qr, svd, eigh,
+triangular solves, det) is a pure jnp/lax expression — batched, fused,
+and differentiable through the standard vjp record path.  The
+nonsymmetric eigendecomposition has no TPU lowering (same as the
+reference, where it is LAPACK-on-CPU); it uses the documented host
+fallback (``jax.pure_callback`` to numpy) — the SURVEY §7
+storage-fallback pattern.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = []
+
+
+def _t(x):
+    """Batched matrix transpose (leading dims are batch)."""
+    return jnp.swapaxes(x, -1, -2)
+
+
+# ---- la_op.cc family ------------------------------------------------------
+
+@register("linalg_gemm")
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2):
+    """C' = alpha * op(A) @ op(B) + beta * C  (la_op.cc LaMatrixMacOp)."""
+    a = _t(A) if transpose_a else A
+    b = _t(B) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("linalg_gemm2")
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0):
+    a = _t(A) if transpose_a else A
+    b = _t(B) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_potrf")
+def linalg_potrf(A):
+    """Cholesky factor L with A = L L^T (la_op.cc potrf)."""
+    return jnp.linalg.cholesky(A)
+
+
+@register("linalg_potri")
+def linalg_potri(L):
+    """Inverse of A from its Cholesky factor: A^-1 = (L L^T)^-1."""
+    eye = jnp.broadcast_to(jnp.eye(L.shape[-1], dtype=L.dtype), L.shape)
+    linv = lax.linalg.triangular_solve(L, eye, left_side=True, lower=True)
+    return jnp.matmul(_t(linv), linv)
+
+
+@register("linalg_trmm")
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Triangular matrix multiply: out = alpha * op(A) @ B (or B @ op(A))."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    tri = _t(tri) if transpose else tri
+    out = jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B)
+    return alpha * out
+
+
+@register("linalg_trsm")
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Solve op(A) X = alpha B (or X op(A) = alpha B), A triangular."""
+    return lax.linalg.triangular_solve(
+        A, alpha * B, left_side=not rightside, lower=lower,
+        transpose_a=transpose)
+
+
+@register("linalg_syrk")
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    """Symmetric rank-k: alpha * A A^T (or A^T A when transpose)."""
+    return alpha * (jnp.matmul(_t(A), A) if transpose
+                    else jnp.matmul(A, _t(A)))
+
+
+@register("linalg_gelqf", num_outputs=2)
+def linalg_gelqf(A):
+    """LQ factorization A = L Q with Q orthonormal rows (la_op.cc gelqf)."""
+    q, r = jnp.linalg.qr(_t(A), mode="reduced")
+    return _t(r), _t(q)
+
+
+@register("linalg_syevd", num_outputs=2)
+def linalg_syevd(A):
+    """Symmetric eigendecomposition: returns (U, lambda) with A = U^T
+    diag(lambda) U (la_op.cc syevd row-vector convention)."""
+    w, v = jnp.linalg.eigh(A)
+    return _t(v), w
+
+
+@register("linalg_sumlogdiag")
+def linalg_sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg_extractdiag")
+def linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_makediag")
+def linalg_makediag(a, offset=0):
+    base = jnp.apply_along_axis(jnp.diag, -1, a) if a.ndim > 1 else \
+        jnp.diag(a)
+    if offset == 0:
+        return base
+    n = a.shape[-1] + abs(offset)
+    out_shape = a.shape[:-1] + (n, n)
+    out = jnp.zeros(out_shape, a.dtype)
+    idx = jnp.arange(a.shape[-1])
+    rows = idx if offset >= 0 else idx - offset
+    cols = idx + offset if offset >= 0 else idx
+    return out.at[..., rows, cols].set(a)
+
+
+@register("linalg_extracttrian")
+def linalg_extracttrian(A, offset=0, lower=True):
+    """Pack the (lower/upper) triangle into a vector (la_op.cc)."""
+    n = A.shape[-1]
+    rows, cols = _np.tril_indices(n, k=offset) if lower else \
+        _np.triu_indices(n, k=offset)
+    return A[..., rows, cols]
+
+
+@register("linalg_maketrian")
+def linalg_maketrian(a, offset=0, lower=True):
+    # infer n from packed length L = n(n+1)/2 (+/- offset handling as in
+    # la_op.cc: offset shrinks the triangle)
+    L = a.shape[-1]
+    k = abs(offset)
+    n = int((_np.sqrt(8 * L + 1) - 1) / 2) + k
+    rows, cols = _np.tril_indices(n, k=-k if offset <= 0 else 0) if lower \
+        else _np.triu_indices(n, k=k if offset >= 0 else 0)
+    rows, cols = rows[:L], cols[:L]
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    return out.at[..., rows, cols].set(a)
+
+
+@register("linalg_inverse")
+def linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("linalg_det")
+def linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("linalg_slogdet", num_outputs=2)
+def linalg_slogdet(A):
+    sign, logdet = jnp.linalg.slogdet(A)
+    return sign, logdet
+
+
+# ---- numpy/linalg front-end ----------------------------------------------
+
+@register("linalg_cholesky")
+def linalg_cholesky(A, upper=False):
+    L = jnp.linalg.cholesky(A)
+    return _t(L) if upper else L
+
+
+@register("linalg_qr", num_outputs=2)
+def linalg_qr(A, mode="reduced"):
+    q, r = jnp.linalg.qr(A, mode=mode)
+    return q, r
+
+
+@register("linalg_svd", num_outputs=3)
+def linalg_svd(A, full_matrices=False):
+    u, s, vt = jnp.linalg.svd(A, full_matrices=full_matrices)
+    return u, s, vt
+
+
+@register("linalg_svdvals")
+def linalg_svdvals(A):
+    return jnp.linalg.svd(A, compute_uv=False)
+
+
+@register("linalg_eigh", num_outputs=2)
+def linalg_eigh(A, UPLO="L"):
+    w, v = jnp.linalg.eigh(A, UPLO=UPLO)
+    return w, v
+
+
+@register("linalg_eigvalsh")
+def linalg_eigvalsh(A, UPLO="L"):
+    return jnp.linalg.eigvalsh(A, UPLO=UPLO)
+
+
+def _host_eig(A):
+    w, v = _np.linalg.eig(_np.asarray(A))
+    return w.astype(_np.complex64), v.astype(_np.complex64)
+
+
+@register("linalg_eig", num_outputs=2, differentiable=False)
+def linalg_eig(A):
+    """Nonsymmetric eigendecomposition.  No TPU lowering exists (XLA
+    restriction; the reference is LAPACK-on-CPU too, c_lapack_api.h) —
+    host fallback via pure_callback, complex64 outputs."""
+    out_shapes = (jax.ShapeDtypeStruct(A.shape[:-1], jnp.complex64),
+                  jax.ShapeDtypeStruct(A.shape, jnp.complex64))
+    return jax.pure_callback(_host_eig, out_shapes, A, vmap_method="sequential")
+
+
+@register("linalg_eigvals", differentiable=False)
+def linalg_eigvals(A):
+    out_shape = jax.ShapeDtypeStruct(A.shape[:-1], jnp.complex64)
+    return jax.pure_callback(
+        lambda a: _np.linalg.eigvals(_np.asarray(a)).astype(_np.complex64),
+        out_shape, A, vmap_method="sequential")
+
+
+@register("linalg_solve")
+def linalg_solve(A, b):
+    return jnp.linalg.solve(A, b)
+
+
+@register("linalg_lstsq", num_outputs=4, differentiable=False)
+def linalg_lstsq(A, b, rcond=None):
+    x, resid, rank, sv = jnp.linalg.lstsq(A, b, rcond=rcond)
+    return x, resid, rank, sv
+
+
+@register("linalg_pinv")
+def linalg_pinv(A, rcond=None):
+    return jnp.linalg.pinv(A, rcond=rcond)
+
+
+@register("linalg_matrix_rank", differentiable=False)
+def linalg_matrix_rank(A, tol=None):
+    return jnp.linalg.matrix_rank(A, tol=tol)
+
+
+@register("linalg_matrix_power")
+def linalg_matrix_power(A, n=1):
+    return jnp.linalg.matrix_power(A, n)
+
+
+@register("linalg_norm")
+def linalg_norm(A, ord=None, axis=None, keepdims=False):
+    return jnp.linalg.norm(A, ord=ord, axis=axis, keepdims=keepdims)
+
+
+@register("linalg_cond", differentiable=False)
+def linalg_cond(A, p=None):
+    return jnp.linalg.cond(A, p=p)
+
+
+@register("linalg_multi_dot")
+def linalg_multi_dot(*arrays):
+    return jnp.linalg.multi_dot(list(arrays))
+
+
+@register("linalg_tensorinv")
+def linalg_tensorinv(A, ind=2):
+    return jnp.linalg.tensorinv(A, ind=ind)
+
+
+@register("linalg_tensorsolve")
+def linalg_tensorsolve(A, b, axes=None):
+    return jnp.linalg.tensorsolve(A, b, axes=axes)

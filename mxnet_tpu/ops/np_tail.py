@@ -1,0 +1,155 @@
+"""NumPy-semantics long-tail operators.
+
+Reference parity: the ``_npi_*`` registrations under
+/root/reference/src/operator/numpy/ (216 ops — percentile, cross, pad,
+unique, window functions, polynomial, insert/delete, nan-reductions,
+bitwise family, ...).  Each op here is the jnp expression XLA fuses
+directly; the point of registering them (vs. the mx.np jnp adapter) is
+that they flow through the SAME invoke/record path as every other op —
+autograd tape, deferred-compute tracing, profiler naming — and surface
+under ``mx.nd`` / ``mx.npx`` with reference names.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _reg(name, fn, differentiable=True, num_outputs=1):
+    fn.__name__ = name
+    register(name, num_outputs=num_outputs,
+             differentiable=differentiable)(fn)
+
+
+# ---- reductions / statistics ---------------------------------------------
+
+_reg("percentile", lambda a, q=50.0, axis=None, keepdims=False:
+     jnp.percentile(a, q, axis=axis, keepdims=keepdims))
+_reg("quantile", lambda a, q=0.5, axis=None, keepdims=False:
+     jnp.quantile(a, q, axis=axis, keepdims=keepdims))
+_reg("median", lambda a, axis=None, keepdims=False:
+     jnp.median(a, axis=axis, keepdims=keepdims))
+_reg("average", lambda a, weights=None, axis=None:
+     jnp.average(a, axis=axis, weights=weights))
+_reg("cov", lambda m, y=None, rowvar=True, bias=False:
+     jnp.cov(m, y, rowvar=rowvar, bias=bias))
+_reg("corrcoef", lambda x, y=None, rowvar=True:
+     jnp.corrcoef(x, y, rowvar=rowvar))
+_reg("ptp", lambda a, axis=None, keepdims=False:
+     jnp.ptp(a, axis=axis, keepdims=keepdims))
+_reg("nanmax", lambda a, axis=None, keepdims=False:
+     jnp.nanmax(a, axis=axis, keepdims=keepdims))
+_reg("nanmin", lambda a, axis=None, keepdims=False:
+     jnp.nanmin(a, axis=axis, keepdims=keepdims))
+_reg("nansum", lambda a, axis=None, keepdims=False:
+     jnp.nansum(a, axis=axis, keepdims=keepdims))
+_reg("nanprod", lambda a, axis=None, keepdims=False:
+     jnp.nanprod(a, axis=axis, keepdims=keepdims))
+_reg("nanmean", lambda a, axis=None, keepdims=False:
+     jnp.nanmean(a, axis=axis, keepdims=keepdims))
+_reg("nanstd", lambda a, axis=None, ddof=0, keepdims=False:
+     jnp.nanstd(a, axis=axis, ddof=ddof, keepdims=keepdims))
+_reg("nanvar", lambda a, axis=None, ddof=0, keepdims=False:
+     jnp.nanvar(a, axis=axis, ddof=ddof, keepdims=keepdims))
+_reg("count_nonzero", lambda a, axis=None:
+     jnp.count_nonzero(a, axis=axis), differentiable=False)
+_reg("bincount", lambda x, weights=None, minlength=0:
+     jnp.bincount(x, weights=weights, minlength=minlength),
+     differentiable=False)
+_reg("digitize", lambda x, bins, right=False:
+     jnp.digitize(x, bins, right=right), differentiable=False)
+_reg("searchsorted", lambda a, v, side="left":
+     jnp.searchsorted(a, v, side=side), differentiable=False)
+
+# ---- elementwise / math ---------------------------------------------------
+
+_reg("interp", lambda x, xp, fp, left=None, right=None:
+     jnp.interp(x, xp, fp, left=left, right=right))
+_reg("nan_to_num", lambda x, nan=0.0, posinf=None, neginf=None:
+     jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf))
+_reg("heaviside", lambda x1, x2: jnp.heaviside(x1, x2))
+_reg("copysign", lambda x1, x2: jnp.copysign(x1, x2))
+_reg("ldexp", lambda x1, x2: jnp.ldexp(x1, x2))
+_reg("signbit", lambda x: jnp.signbit(x), differentiable=False)
+_reg("float_power", lambda x1, x2: jnp.float_power(x1, x2))
+_reg("fmod", lambda x1, x2: jnp.fmod(x1, x2))
+_reg("remainder", lambda x1, x2: jnp.remainder(x1, x2))
+_reg("gcd", lambda x1, x2: jnp.gcd(x1, x2), differentiable=False)
+_reg("lcm", lambda x1, x2: jnp.lcm(x1, x2), differentiable=False)
+_reg("bitwise_and", lambda x1, x2: jnp.bitwise_and(x1, x2),
+     differentiable=False)
+_reg("bitwise_or", lambda x1, x2: jnp.bitwise_or(x1, x2),
+     differentiable=False)
+_reg("bitwise_xor", lambda x1, x2: jnp.bitwise_xor(x1, x2),
+     differentiable=False)
+_reg("bitwise_not", lambda x: jnp.bitwise_not(x), differentiable=False)
+_reg("left_shift", lambda x1, x2: jnp.left_shift(x1, x2),
+     differentiable=False)
+_reg("right_shift", lambda x1, x2: jnp.right_shift(x1, x2),
+     differentiable=False)
+_reg("cross", lambda a, b, axis=-1: jnp.cross(a, b, axis=axis))
+_reg("polyval", lambda p, x: jnp.polyval(p, x))
+_reg("vander", lambda x, N=None, increasing=False:
+     jnp.vander(x, N=N, increasing=increasing))
+_reg("ediff1d", lambda a, to_end=None, to_begin=None:
+     jnp.ediff1d(a, to_end=to_end, to_begin=to_begin))
+_reg("diff", lambda a, n=1, axis=-1: jnp.diff(a, n=n, axis=axis))
+_reg("trapz", lambda y, x=None, dx=1.0, axis=-1:
+     jnp.trapezoid(y, x=x, dx=dx, axis=axis))
+_reg("unwrap", lambda p, axis=-1: jnp.unwrap(p, axis=axis))
+_reg("isclose", lambda a, b, rtol=1e-5, atol=1e-8, equal_nan=False:
+     jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+     differentiable=False)
+_reg("isposinf", lambda x: jnp.isposinf(x), differentiable=False)
+_reg("isneginf", lambda x: jnp.isneginf(x), differentiable=False)
+
+# ---- shape / assembly -----------------------------------------------------
+
+_reg("hstack", lambda *arrays: jnp.hstack(arrays))
+_reg("vstack", lambda *arrays: jnp.vstack(arrays))
+_reg("dstack", lambda *arrays: jnp.dstack(arrays))
+_reg("column_stack", lambda *arrays: jnp.column_stack(arrays))
+_reg("atleast_1d", lambda a: jnp.atleast_1d(a))
+_reg("atleast_2d", lambda a: jnp.atleast_2d(a))
+_reg("atleast_3d", lambda a: jnp.atleast_3d(a))
+_reg("moveaxis", lambda a, source, destination:
+     jnp.moveaxis(a, source, destination))
+_reg("rollaxis", lambda a, axis, start=0: jnp.rollaxis(a, axis, start))
+_reg("append", lambda arr, values, axis=None:
+     jnp.append(arr, values, axis=axis))
+_reg("insert", lambda arr, obj, values, axis=None:
+     jnp.insert(arr, obj, values, axis=axis))
+_reg("delete", lambda arr, obj, axis=None:
+     jnp.delete(arr, obj, axis=axis))
+_reg("resize_array", lambda a, new_shape: jnp.resize(a, new_shape))
+_reg("trim_zeros", lambda filt, trim="fb": jnp.trim_zeros(filt, trim=trim),
+     differentiable=False)
+_reg("flatnonzero", lambda a: jnp.flatnonzero(a), differentiable=False)
+_reg("argwhere", lambda a: jnp.argwhere(a), differentiable=False)
+_reg("compress", lambda condition, a, axis=None:
+     jnp.compress(condition, a, axis=axis))
+_reg("extract", lambda condition, arr: jnp.extract(condition, arr),
+     differentiable=False)
+_reg("choose", lambda a, *choices: jnp.choose(a, list(choices),
+                                              mode="clip"))
+_reg("unravel_index", lambda indices, shape:
+     jnp.stack(jnp.unravel_index(indices, shape)), differentiable=False)
+_reg("ravel_multi_index", lambda multi_index, dims:
+     jnp.ravel_multi_index(tuple(multi_index), dims, mode="clip"),
+     differentiable=False)
+_reg("tri", lambda N, M=None, k=0: jnp.tri(N, M=M, k=k),
+     differentiable=False)
+_reg("fill_diagonal", lambda a, val:
+     jnp.fill_diagonal(a, val, inplace=False))
+
+# ---- window functions -----------------------------------------------------
+
+_reg("hamming", lambda M: jnp.hamming(M), differentiable=False)
+_reg("hanning", lambda M: jnp.hanning(M), differentiable=False)
+_reg("blackman", lambda M: jnp.blackman(M), differentiable=False)
+_reg("bartlett", lambda M: jnp.bartlett(M), differentiable=False)
+_reg("kaiser", lambda M, beta=14.0: jnp.kaiser(M, beta),
+     differentiable=False)

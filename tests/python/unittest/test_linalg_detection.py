@@ -1,0 +1,322 @@
+"""linalg (la_op.cc family + numpy/linalg) and detection
+(bounding_box.cc / roi_align.cc / multibox) operator tests.
+
+Every differentiable op gets a numeric-gradient check (reference
+test_utils.py check_numeric_gradient pattern); decompositions are pinned
+by reconstruction identities rather than raw-value comparison (sign/phase
+conventions differ legitimately)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def _spd(n, rs, batch=()):
+    a = rs.rand(*batch, n, n).astype(np.float32)
+    at = np.swapaxes(a, -1, -2)
+    return np.matmul(a, at) + n * np.eye(n, dtype=np.float32)
+
+
+rs = np.random.RandomState(0)
+
+
+# ---- la_op family ---------------------------------------------------------
+
+def test_linalg_gemm_and_gemm2():
+    A = rs.rand(2, 3, 4).astype(np.float32)
+    B = rs.rand(2, 4, 5).astype(np.float32)
+    C = rs.rand(2, 3, 5).astype(np.float32)
+    out = nd.linalg.gemm(nd.array(A), nd.array(B), nd.array(C),
+                         alpha=2.0, beta=0.5)
+    assert_almost_equal(out.asnumpy(), 2.0 * A @ B + 0.5 * C, rtol=1e-5,
+                        atol=1e-5)
+    out2 = nd.linalg.gemm2(nd.array(A), nd.array(B))
+    assert_almost_equal(out2.asnumpy(), A @ B, rtol=1e-5, atol=1e-5)
+    out3 = nd.linalg.gemm2(nd.array(A), nd.array(C), transpose_a=True)
+    assert_almost_equal(out3.asnumpy(),
+                        np.swapaxes(A, -1, -2) @ C, rtol=1e-5, atol=1e-5)
+
+
+def test_linalg_potrf_potri():
+    S = _spd(4, rs)
+    L = nd.linalg.potrf(nd.array(S)).asnumpy()
+    assert_almost_equal(L @ L.T, S, rtol=1e-4, atol=1e-4)
+    Sinv = nd.linalg.potri(nd.array(L)).asnumpy()
+    assert_almost_equal(Sinv, np.linalg.inv(S), rtol=1e-3, atol=1e-3)
+
+
+def test_linalg_potrf_gradient():
+    S = _spd(3, rs)
+    check_numeric_gradient(
+        lambda a: nd.sum(nd.linalg.potrf(a)), [S])
+
+
+def test_linalg_trmm_trsm():
+    A = np.tril(rs.rand(4, 4).astype(np.float32)) + 2 * np.eye(
+        4, dtype=np.float32)
+    B = rs.rand(4, 3).astype(np.float32)
+    out = nd.linalg.trmm(nd.array(A), nd.array(B)).asnumpy()
+    assert_almost_equal(out, np.tril(A) @ B, rtol=1e-5, atol=1e-5)
+    X = nd.linalg.trsm(nd.array(A), nd.array(B)).asnumpy()
+    assert_almost_equal(np.tril(A) @ X, B, rtol=1e-4, atol=1e-4)
+    check_numeric_gradient(
+        lambda a, b: nd.sum(nd.linalg.trsm(a, b)), [A, B])
+
+
+def test_linalg_syrk_gelqf_syevd():
+    A = rs.rand(3, 5).astype(np.float32)
+    assert_almost_equal(nd.linalg.syrk(nd.array(A)).asnumpy(), A @ A.T,
+                        rtol=1e-5, atol=1e-5)
+    L, Q = nd.linalg.gelqf(nd.array(A))
+    assert_almost_equal((L.asnumpy() @ Q.asnumpy()), A, rtol=1e-4,
+                        atol=1e-4)
+    # Q has orthonormal rows
+    assert_almost_equal(Q.asnumpy() @ Q.asnumpy().T,
+                        np.eye(3, dtype=np.float32), rtol=1e-4, atol=1e-4)
+    S = _spd(4, rs)
+    U, lam = nd.linalg.syevd(nd.array(S))
+    recon = U.asnumpy().T @ np.diag(lam.asnumpy()) @ U.asnumpy()
+    assert_almost_equal(recon, S, rtol=1e-3, atol=1e-3)
+
+
+def test_linalg_diag_trian_roundtrips():
+    S = rs.rand(4, 4).astype(np.float32)
+    d = nd.linalg.extractdiag(nd.array(S))
+    assert_almost_equal(d.asnumpy(), np.diag(S), rtol=1e-6, atol=1e-6)
+    D = nd.linalg.makediag(d)
+    assert_almost_equal(D.asnumpy(), np.diag(np.diag(S)), rtol=1e-6,
+                        atol=1e-6)
+    packed = nd.linalg.extracttrian(nd.array(S))
+    unpacked = nd.linalg.maketrian(packed)
+    assert_almost_equal(unpacked.asnumpy(), np.tril(S), rtol=1e-6,
+                        atol=1e-6)
+    slog = nd.linalg.sumlogdiag(nd.array(_spd(4, rs)))
+    assert np.isfinite(float(slog.asscalar()))
+
+
+def test_linalg_det_slogdet_inverse_solve():
+    S = _spd(4, rs)
+    assert_almost_equal(nd.linalg.det(nd.array(S)).asnumpy(),
+                        np.linalg.det(S), rtol=1e-3, atol=1e-3)
+    sign, logdet = nd.linalg.slogdet(nd.array(S))
+    assert float(sign.asscalar()) == pytest.approx(1.0)
+    assert float(logdet.asscalar()) == pytest.approx(
+        np.log(np.linalg.det(S)), rel=1e-3)
+    assert_almost_equal(nd.linalg.inverse(nd.array(S)).asnumpy(),
+                        np.linalg.inv(S), rtol=1e-3, atol=1e-3)
+    b = rs.rand(4, 2).astype(np.float32)
+    x = nd.linalg.solve(nd.array(S), nd.array(b)).asnumpy()
+    assert_almost_equal(S @ x, b, rtol=1e-3, atol=1e-3)
+    check_numeric_gradient(
+        lambda a: nd.sum(nd.linalg.inverse(a)), [S])
+
+
+def test_linalg_svd_qr_eigh():
+    A = rs.rand(4, 3).astype(np.float32)
+    u, s, vt = nd.linalg.svd(nd.array(A))
+    recon = u.asnumpy() @ np.diag(s.asnumpy()) @ vt.asnumpy()
+    assert_almost_equal(recon, A, rtol=1e-4, atol=1e-4)
+    sv = nd.linalg.svdvals(nd.array(A)).asnumpy()
+    assert_almost_equal(np.sort(sv), np.sort(s.asnumpy()), rtol=1e-4,
+                        atol=1e-4)
+    q, r = nd.linalg.qr(nd.array(A))
+    assert_almost_equal(q.asnumpy() @ r.asnumpy(), A, rtol=1e-4, atol=1e-4)
+    S = _spd(4, rs)
+    w, v = nd.linalg.eigh(nd.array(S))
+    assert_almost_equal(v.asnumpy() @ np.diag(w.asnumpy())
+                        @ v.asnumpy().T, S, rtol=1e-3, atol=1e-3)
+    assert_almost_equal(nd.linalg.eigvalsh(nd.array(S)).asnumpy(),
+                        w.asnumpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_linalg_eig_host_fallback():
+    A = rs.rand(4, 4).astype(np.float32)
+    w, v = nd.linalg.eig(nd.array(A))
+    wn = np.asarray(w.asnumpy())
+    ref = np.linalg.eigvals(A)
+    assert_almost_equal(np.sort(wn.real), np.sort(ref.real), rtol=1e-3,
+                        atol=1e-3)
+    assert_almost_equal(np.sort(np.asarray(
+        nd.linalg.eigvals(nd.array(A)).asnumpy()).real),
+        np.sort(ref.real), rtol=1e-3, atol=1e-3)
+
+
+def test_linalg_lstsq_pinv_misc():
+    A = rs.rand(6, 3).astype(np.float32)
+    b = rs.rand(6, 2).astype(np.float32)
+    x, _res, rank, _sv = nd.linalg.lstsq(nd.array(A), nd.array(b))
+    xr = np.linalg.lstsq(A, b, rcond=None)[0]
+    assert_almost_equal(x.asnumpy(), xr, rtol=1e-3, atol=1e-3)
+    assert int(rank.asscalar()) == 3
+    assert_almost_equal(nd.linalg.pinv(nd.array(A)).asnumpy(),
+                        np.linalg.pinv(A), rtol=1e-3, atol=1e-3)
+    assert int(nd.linalg.matrix_rank(nd.array(A)).asscalar()) == 3
+    S = _spd(3, rs)
+    assert_almost_equal(nd.linalg.matrix_power(nd.array(S), 2).asnumpy(),
+                        S @ S, rtol=1e-3, atol=1e-3)
+    assert float(nd.linalg.norm(nd.array(A)).asscalar()) == pytest.approx(
+        np.linalg.norm(A), rel=1e-4)
+    C = nd.linalg.multi_dot(nd.array(A), nd.array(S), nd.array(S))
+    assert_almost_equal(C.asnumpy(), A @ S @ S, rtol=1e-3, atol=1e-3)
+
+
+# ---- detection family -----------------------------------------------------
+
+def _iou_ref(b1, b2):
+    x1 = max(b1[0], b2[0]); y1 = max(b1[1], b2[1])
+    x2 = min(b1[2], b2[2]); y2 = min(b1[3], b2[3])
+    inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+    a1 = (b1[2] - b1[0]) * (b1[3] - b1[1])
+    a2 = (b2[2] - b2[0]) * (b2[3] - b2[1])
+    return inter / (a1 + a2 - inter) if a1 + a2 - inter > 0 else 0.0
+
+
+def test_box_iou():
+    a = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    b = np.array([[0, 0, 2, 2], [2, 2, 4, 4], [5, 5, 6, 6]], np.float32)
+    out = nd.contrib.box_iou(nd.array(a), nd.array(b)).asnumpy()
+    for i in range(2):
+        for j in range(3):
+            assert out[i, j] == pytest.approx(_iou_ref(a[i], b[j]),
+                                              abs=1e-6)
+
+
+def test_box_nms():
+    # three boxes: #0 and #1 overlap heavily, #2 is distinct
+    data = np.array([
+        [0, 0.9, 0, 0, 2, 2],
+        [0, 0.8, 0.1, 0.1, 2.1, 2.1],
+        [1, 0.7, 5, 5, 7, 7]], np.float32)
+    out = nd.contrib.box_nms(nd.array(data), overlap_thresh=0.5,
+                             coord_start=2, score_index=1,
+                             id_index=0).asnumpy()
+    assert out[0, 1] == pytest.approx(0.9)   # best box kept
+    assert out[1, 1] == pytest.approx(-1.0)  # suppressed
+    assert out[2, 1] == pytest.approx(0.7)   # far box kept
+    # class-aware: no suppression across ids when force_suppress=False
+    data2 = data.copy()
+    data2[1, 0] = 1  # different class
+    out2 = nd.contrib.box_nms(nd.array(data2), overlap_thresh=0.5,
+                              coord_start=2, score_index=1, id_index=0,
+                              force_suppress=False).asnumpy()
+    assert out2[1, 1] == pytest.approx(0.8)
+
+
+def test_box_encode_decode_roundtrip():
+    anchors = np.array([[0, 0, 2, 2], [1, 1, 4, 5]], np.float32)
+    gt = np.array([[0.2, 0.1, 2.5, 2.2], [0.8, 1.3, 4.5, 5.2]], np.float32)
+    samples = np.ones((2,), np.float32)
+    matches = np.arange(2).astype(np.float32)
+    enc, _mask = nd.contrib.box_encode(
+        nd.array(samples[None]), nd.array(matches[None]),
+        nd.array(anchors[None]), nd.array(gt[None]))
+    dec = nd.contrib.box_decode(enc, nd.array(anchors[None])).asnumpy()
+    assert_almost_equal(dec[0], gt, rtol=1e-3, atol=1e-3)
+
+
+def test_bipartite_matching():
+    score = np.array([[0.9, 0.1], [0.8, 0.85]], np.float32)
+    rm, cm = nd.contrib.bipartite_matching(nd.array(score), threshold=0.05)
+    # greedy: (0,0)=0.9 first, then (1,1)=0.85
+    assert rm.asnumpy().tolist() == [0, 1]
+    assert cm.asnumpy().tolist() == [0, 1]
+
+
+def test_roi_align_matches_manual():
+    # constant image: any pooling must return the constant
+    data = np.full((1, 2, 8, 8), 3.0, np.float32)
+    rois = np.array([[0, 1, 1, 5, 5]], np.float32)
+    out = nd.contrib.ROIAlign(nd.array(data), nd.array(rois),
+                              pooled_size=(2, 2)).asnumpy()
+    assert out.shape == (1, 2, 2, 2)
+    assert_almost_equal(out, np.full((1, 2, 2, 2), 3.0, np.float32),
+                        rtol=1e-5, atol=1e-5)
+    # linear-in-x image: pooled values must increase along x
+    ramp = np.tile(np.arange(8, dtype=np.float32)[None, None, None, :],
+                   (1, 1, 8, 1))
+    out2 = nd.contrib.ROIAlign(nd.array(ramp), nd.array(rois),
+                               pooled_size=(1, 2)).asnumpy()
+    assert out2[0, 0, 0, 1] > out2[0, 0, 0, 0]
+    check_numeric_gradient(
+        lambda d: nd.sum(nd.contrib.ROIAlign(d, nd.array(rois),
+                                             pooled_size=(2, 2))),
+        [np.random.rand(1, 2, 8, 8).astype(np.float32)])
+
+
+def test_multibox_prior_and_detection():
+    feat = nd.zeros((1, 3, 4, 4))
+    anchors = nd.contrib.MultiBoxPrior(feat, sizes=(0.5, 0.25),
+                                       ratios=(1.0, 2.0))
+    A = 3  # sizes + ratios - 1
+    assert anchors.shape == (1, 4 * 4 * A, 4)
+    an = anchors.asnumpy()
+    assert np.all(an[..., 2] >= an[..., 0]) and np.all(
+        an[..., 3] >= an[..., 1])
+    # detection: one anchor, one foreground class, zero offsets
+    cls_prob = nd.array(np.array([[[0.1], [0.9]]], np.float32))  # (1,2,1)
+    loc_pred = nd.zeros((1, 4))
+    anch = nd.array(np.array([[[0.5, 0.5, 0.2, 0.2]]], np.float32))
+    det = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anch).asnumpy()
+    assert det.shape == (1, 1, 6)
+    assert det[0, 0, 0] == pytest.approx(0.0)      # class id 0 (fg)
+    assert det[0, 0, 1] == pytest.approx(0.9)      # score
+    assert_almost_equal(det[0, 0, 2:], np.array([0.4, 0.4, 0.6, 0.6],
+                                                np.float32),
+                        rtol=1e-5, atol=1e-5)
+
+
+def test_box_iou_zero_padding_grads_finite():
+    """Zero-padded box rows (union=0) must not produce NaN gradients
+    (the where-div vjp trap)."""
+    boxes = np.array([[0, 0, 2, 2], [0, 0, 0, 0]], np.float32)
+
+    def f(b):
+        return nd.sum(nd.contrib.box_iou(b, b))
+
+    check_numeric_gradient(f, [boxes])
+
+
+def test_multibox_prior_aspect_and_order():
+    """Non-square maps carry the H/W width correction; anchor order is
+    sizes-with-ratio0 first (multibox_prior.cc layout)."""
+    feat = nd.zeros((1, 3, 2, 4))  # H=2, W=4 -> aspect 0.5
+    an = nd.contrib.MultiBoxPrior(feat, sizes=(0.5, 0.25),
+                                  ratios=(1.0,)).asnumpy()
+    w0 = an[0, 0, 2] - an[0, 0, 0]
+    h0 = an[0, 0, 3] - an[0, 0, 1]
+    assert w0 == pytest.approx(0.5 * 0.5, abs=1e-6)  # size * H/W
+    assert h0 == pytest.approx(0.5, abs=1e-6)
+    # second anchor at the same pixel = second SIZE (not second ratio)
+    w1 = an[0, 1, 2] - an[0, 1, 0]
+    assert w1 == pytest.approx(0.25 * 0.5, abs=1e-6)
+
+
+def test_roi_align_position_sensitive():
+    ph = pw = 2
+    C = 3 * ph * pw
+    data = np.zeros((1, C, 4, 4), np.float32)
+    # channel k has constant value k: PS output bin (i,j) of class c must
+    # equal c*ph*pw + i*pw + j
+    for k in range(C):
+        data[0, k] = k
+    rois = np.array([[0, 0, 0, 4, 4]], np.float32)
+    out = nd.contrib.ROIAlign(nd.array(data), nd.array(rois),
+                              pooled_size=(ph, pw),
+                              position_sensitive=True).asnumpy()
+    assert out.shape == (1, 3, ph, pw)
+    for c in range(3):
+        for i in range(ph):
+            for j in range(pw):
+                assert out[0, c, i, j] == pytest.approx(
+                    c * ph * pw + i * pw + j, abs=1e-5)
+
+
+def test_box_nms_format_conversion():
+    data = np.array([[0.9, 1.0, 1.0, 2.0, 2.0]], np.float32)  # center fmt
+    out = nd.contrib.box_nms(nd.array(data), coord_start=1, score_index=0,
+                             in_format="center",
+                             out_format="corner").asnumpy()
+    assert_almost_equal(out[0, 1:], np.array([0., 0., 2., 2.], np.float32),
+                        rtol=1e-5, atol=1e-6)
