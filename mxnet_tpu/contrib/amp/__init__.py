@@ -24,13 +24,51 @@ __all__ = ["init", "init_trainer", "convert_model", "convert_hybrid_block",
 FP32_PARAM_SUFFIXES = ("gamma", "beta", "running_mean", "running_var",
                        "moving_mean", "moving_var")
 
+# ---- per-op safety lists (reference contrib/amp/lists/symbol_fp16.py +
+# the ReducePrecision graph pass, src/nnvm/low_precision_pass.cc).  On TPU
+# the "graph rewrite" happens at op-invoke time: every eager call AND every
+# hybridize/export trace flows through ops.registry.invoke, which consults
+# these sets when AMP is active — so one mechanism covers both the
+# imperative and the compiled path.
+
+# matmul-class ops: run in the target dtype (MXU-bound, f32-accumulated)
+TARGET_DTYPE_OPS = {
+    "fully_connected", "convolution", "deconvolution", "dot", "batch_dot",
+    "matmul", "einsum", "tensordot", "inner", "outer",
+    "multi_head_attention", "linalg_gemm", "linalg_gemm2",
+}
+
+# numerically-sensitive ops: force f32 inputs (reference FP32_FUNCS)
+FP32_OPS = {
+    "softmax", "log_softmax", "softmin", "softmax_cross_entropy", "exp",
+    "expm1", "log", "log2", "log10", "log1p", "power", "rsqrt", "rcbrt",
+    "reciprocal", "norm", "logsumexp", "batch_norm", "layer_norm",
+    "group_norm", "instance_norm", "rms_norm", "l2_normalization",
+    "lrn", "cumsum", "cumprod", "sum", "prod", "mean", "var", "std",
+    "erfinv", "gamma", "gammaln", "digamma",
+}
+
 _initialized = {"on": False, "dtype": "bfloat16"}
 
 
+def is_active():
+    return _initialized["on"]
+
+
+def target_dtype():
+    return _initialized["dtype"]
+
+
 def init(target_dtype="bfloat16"):
-    """Enable AMP (reference amp.py init)."""
+    """Enable AMP (reference amp.py init): from here on, ops in
+    TARGET_DTYPE_OPS compute in the target dtype and FP32_OPS are forced
+    back to f32 — applied at invoke/trace time to every execution path."""
     _initialized["on"] = True
     _initialized["dtype"] = target_dtype
+
+
+def disable():
+    _initialized["on"] = False
 
 
 amp_init = init

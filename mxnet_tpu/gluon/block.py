@@ -345,6 +345,12 @@ class HybridBlock(Block):
         return self(x, *args)
 
     def __call__(self, *args, **kwargs):
+        # remember input signatures so export() can trace without being
+        # handed example inputs (reference export also requires one prior
+        # forward pass)
+        nds = [a for a in args if isinstance(a, NDArray)]
+        if nds:
+            self._last_input_avals = [(x.shape, str(x.dtype)) for x in nds]
         if self._active:
             return self._call_cached_op(*args, **kwargs)
         return super().__call__(*args, **kwargs)
@@ -373,7 +379,13 @@ class HybridBlock(Block):
         in_spec = _flatten_nd(list(args), flat_inputs)
         nd_inputs = [x for x in flat_inputs if isinstance(x, NDArray)]
         training = autograd.is_training()
+        from ..contrib import amp as _amp
+
         key = (training, tuple(sorted(kwargs.items())),
+               # AMP toggles must invalidate cached traces: the op-list
+               # rewrite happens at trace time, so a cached f32 program
+               # would silently ignore a later amp.init()
+               (_amp.is_active(), _amp.target_dtype()),
                tuple((x.shape, str(x.dtype)) if isinstance(x, NDArray)
                      else ("static", repr(x)) for x in flat_inputs))
         centry = self._cached_ops.get(key)
@@ -499,15 +511,95 @@ class HybridBlock(Block):
         return apply_fn, {n: p._data._data for n, p in zip(names,
                                                            params_list)}
 
-    def export(self, path, epoch=0, remove_amp_cast=True):
-        """Serialize params (+ a manifest) for deployment (reference
-        HybridBlock.export → model-symbol.json + .params)."""
+    def export(self, path, epoch=0, remove_amp_cast=True, inputs=None):
+        """Serialize the model SELF-DESCRIBINGLY for deployment (reference
+        HybridBlock.export -> model-symbol.json + model-0000.params,
+        block.py:1300: the json alone reconstructs the graph without the
+        defining Python class).
+
+        The TPU-native "symbol" is the traced StableHLO program
+        (jax.export) with a symbolic batch dimension, base64-embedded in
+        the json next to the input/param metadata.  ``SymbolBlock.imports``
+        rebuilds a runnable block from the two files alone.
+
+        inputs: example input array(s)/shapes; defaults to the shapes of
+        the block's most recent call.
+        """
+        import base64
         import json
 
+        import jax
+        from jax import export as jax_export
+
+        if inputs is None:
+            inputs = getattr(self, "_last_input_avals", None)
+            if inputs is None:
+                raise MXNetError(
+                    "export() needs example inputs: call the block once "
+                    "or pass inputs=")
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        elif inputs and all(isinstance(d, int) for d in inputs):
+            inputs = [tuple(inputs)]  # a bare shape tuple = one input
+        avals = []
+        for x in inputs:
+            if isinstance(x, NDArray):
+                avals.append((x.shape, str(x.dtype)))
+            elif hasattr(x, "shape"):
+                avals.append((tuple(x.shape), str(x.dtype)))
+            elif (isinstance(x, tuple) and len(x) == 2
+                  and isinstance(x[0], (tuple, list))
+                  and isinstance(x[1], str)):
+                avals.append((tuple(x[0]), x[1]))  # _last_input_avals entry
+            else:
+                avals.append((tuple(x), "float32"))
+
         self.save_parameters("%s-%04d.params" % (path, epoch))
+        apply_fn, params = self.export_pure(training=False)
+        names = list(params)
+
+        def runner(param_list, *xs):
+            pd = dict(zip(names, param_list))
+            outs, _states = apply_fn(pd, jax.random.PRNGKey(0), *xs)
+            return tuple(outs)
+
+        def specs(symbolic):
+            out = []
+            if symbolic:
+                b = jax_export.symbolic_shape("b")[0]
+            for shape, dt in avals:
+                s = ((b,) + tuple(shape[1:])
+                     if symbolic and len(shape) >= 1 else tuple(shape))
+                out.append(jax.ShapeDtypeStruct(s, dt))
+            return out
+
+        param_specs = [jax.ShapeDtypeStruct(v.shape, v.dtype)
+                       for v in params.values()]
+        try:
+            exported = jax_export.export(jax.jit(runner))(
+                param_specs, *specs(symbolic=True))
+            poly = True
+        except Exception:
+            # shape-polymorphic tracing can fail for batch-entangled
+            # programs; fall back to the exact exported shapes
+            exported = jax_export.export(jax.jit(runner))(
+                param_specs, *specs(symbolic=False))
+            poly = False
+
+        # vjp_order=1: the deserialized program stays differentiable, so
+        # an imported SymbolBlock can be fine-tuned (reference SymbolBlock
+        # is trainable)
+        try:
+            blob = exported.serialize(vjp_order=1)
+        except Exception:
+            blob = exported.serialize()
         manifest = {
-            "format": "mxnet_tpu-hybrid-1",
+            "format": "mxnet_tpu-hybrid-2",
             "class": type(self).__name__,
+            "program": base64.b64encode(blob).decode(),
+            "batch_polymorphic": poly,
+            "inputs": [{"shape": list(s), "dtype": d} for s, d in avals],
+            "param_names": names,
             "params": {n: {"shape": list(p.shape or ()),
                            "dtype": str(p.dtype)}
                        for n, p in self.collect_params().items()},
@@ -520,17 +612,69 @@ class HybridBlock(Block):
 class SymbolBlock(HybridBlock):
     """Load an exported model back (reference gluon/block.py:1500).
 
-    The TPU format stores a manifest + params; reconstruction requires the
-    original class importable — construct with the factory then load."""
+    ``SymbolBlock.imports(symbol_file, input_names, param_file)``
+    reconstructs a runnable block from the exported StableHLO program —
+    the defining Python class is NOT needed.  ``block_factory`` remains as
+    an escape hatch for legacy format-1 manifests."""
+
+    def __init__(self, exported=None, param_names=None, param_meta=None):
+        super().__init__()
+        self._exported = exported
+        self._param_names = list(param_names or [])
+        from .parameter import Parameter
+
+        for n in self._param_names:
+            meta = (param_meta or {}).get(n, {})
+            self._reg_params[n] = Parameter(
+                n, shape=tuple(meta.get("shape", ())) or None,
+                dtype=meta.get("dtype", "float32"), init="zeros")
+
+    def forward(self, *args):
+        from ..ops.registry import Operator, invoke
+
+        pvals = [self._reg_params[n].data() for n in self._param_names]
+        np_ = len(pvals)
+
+        def call(*datas, _exp=self._exported, _np=np_):
+            return tuple(_exp.call(list(datas[:_np]), *datas[_np:]))
+
+        call.__name__ = "symbol_block"
+        # differentiable: export() serializes with vjp_order=1, so jax can
+        # differentiate through the deserialized program (fine-tuning an
+        # imported model works, matching the reference SymbolBlock)
+        op = Operator("symbol_block", call, num_outputs=0,
+                      differentiable=True)
+        out = invoke(op, tuple(pvals) + tuple(args), {})
+        if isinstance(out, tuple) and len(out) == 1:
+            return out[0]
+        return out
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None,
                 block_factory=None):
+        import base64
+        import json
+
+        with open(symbol_file) as f:
+            manifest = json.load(f)
+        if manifest.get("format") == "mxnet_tpu-hybrid-2" and \
+                "program" in manifest:
+            from jax import export as jax_export
+
+            exported = jax_export.deserialize(
+                base64.b64decode(manifest["program"]))
+            blk = SymbolBlock(exported, manifest["param_names"],
+                              manifest.get("params"))
+            blk.initialize()
+            if param_file:
+                blk.load_parameters(param_file, ctx=ctx,
+                                    allow_missing=False)
+            return blk
         if block_factory is None:
             raise MXNetError(
-                "SymbolBlock.imports on mxnet_tpu needs block_factory= "
-                "(a callable building the architecture); the manifest "
-                "format stores params + metadata, not code")
+                "legacy format-1 manifest: SymbolBlock.imports needs "
+                "block_factory= (re-export with the current version for "
+                "self-describing loading)")
         block = block_factory()
         if param_file:
             block.load_parameters(param_file, ctx=ctx, allow_missing=False)

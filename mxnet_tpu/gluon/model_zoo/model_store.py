@@ -1,0 +1,79 @@
+"""Pretrained-weight store: the local-cache contract behind
+``pretrained=True``.
+
+Reference: python/mxnet/gluon/model_zoo/model_store.py — a sha1-pinned
+registry of weight files fetched into ``~/.mxnet/models`` and loaded by
+name.  This environment has no egress, so the download step is replaced by
+a documented local-cache contract: ``get_model_file(name)`` resolves
+``{name}.params`` (or the reference's ``{name}-{sha1[:8]}.params``) under
+the cache root and raises a clear placement hint when absent.  Everything
+above it — ``get_model(..., pretrained=True)``, parameter loading, cache
+layout — works exactly as in the reference, and a future downloader only
+needs to fill ``_download``.
+"""
+from __future__ import annotations
+
+import os
+
+from ...base import MXNetError
+
+__all__ = ["get_model_file", "purge", "data_dir"]
+
+_checksums = {
+    # name -> sha1 (reference model_store.py _model_sha1 layout); empty
+    # entries mean "any local file accepted" (no canonical upstream hash)
+}
+
+
+def data_dir():
+    """Cache root (reference: MXNET_HOME/models, default ~/.mxnet)."""
+    return os.path.expanduser(
+        os.environ.get("MXNET_HOME", os.path.join("~", ".mxnet")))
+
+
+def get_model_file(name, root=None):
+    """Resolve a pretrained weight file for ``name`` in the local cache.
+
+    Accepts ``{name}.params`` and sha1-tagged ``{name}-XXXXXXXX.params``
+    (the reference's on-disk naming).  Raises with a placement hint when
+    the cache has no match (no-egress environment: weights must be staged
+    by the user or a deployment pipeline)."""
+    root = os.path.expanduser(root) if root else \
+        os.path.join(data_dir(), "models")
+    exact = os.path.join(root, "%s.params" % name)
+    if os.path.exists(exact):
+        return exact
+    if os.path.isdir(root):
+        tagged = sorted(f for f in os.listdir(root)
+                        if f.startswith("%s-" % name)
+                        and f.endswith(".params"))
+        if tagged:
+            return os.path.join(root, tagged[-1])
+    raise MXNetError(
+        "no pretrained weights for %r in %s (no-egress environment: place "
+        "%s.params there, e.g. via Block.save_parameters from a trained "
+        "run, then pretrained=True loads it)" % (name, root, name))
+
+
+def purge(root=None):
+    """Remove cached weight files (reference model_store.purge)."""
+    root = os.path.expanduser(root) if root else \
+        os.path.join(data_dir(), "models")
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            os.remove(os.path.join(root, f))
+
+
+def load_pretrained(block, name, root=None, ctx=None):
+    """Resolve + load weights into ``block`` (the pretrained=True path)."""
+    block.load_parameters(get_model_file(name, root=root), ctx=ctx)
+    return block
+
+
+def apply_pretrained(block, name, pretrained, root=None, ctx=None):
+    """Shared pretrained=True handling for every model constructor."""
+    if pretrained:
+        load_pretrained(block, name, root=root, ctx=ctx)
+    return block

@@ -36,4 +36,7 @@ class AlexNet(HybridBlock):
 
 
 def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
-    return AlexNet(**kwargs)
+    from ..model_store import apply_pretrained
+
+    return apply_pretrained(AlexNet(**kwargs), "alexnet", pretrained,
+                            root, ctx)

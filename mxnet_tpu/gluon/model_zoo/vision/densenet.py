@@ -81,9 +81,14 @@ densenet_spec = {
 }
 
 
-def _get_densenet(num_layers, **kwargs):
+def _get_densenet(num_layers, pretrained=False, ctx=None, root=None,
+                  **kwargs):
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
-    return DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    from ..model_store import apply_pretrained
+
+    return apply_pretrained(
+        DenseNet(num_init_features, growth_rate, block_config, **kwargs),
+        "densenet%d" % num_layers, pretrained, root, ctx)
 
 
 def densenet121(**kwargs):

@@ -163,9 +163,10 @@ class Inception3(HybridBlock):
         return self.output(x)
 
 
-def inception_v3(pretrained=False, classes=1000, **kwargs):
+def inception_v3(pretrained=False, classes=1000, ctx=None, root=None,
+                 **kwargs):
     """Inception-V3 constructor (reference inception.py inception_v3)."""
-    if pretrained:
-        raise ValueError("pretrained weights are not bundled in this "
-                         "environment; initialize() and train instead")
-    return Inception3(classes=classes, **kwargs)
+    from ..model_store import apply_pretrained
+
+    return apply_pretrained(Inception3(classes=classes, **kwargs),
+                            "inceptionv3", pretrained, root, ctx)

@@ -98,12 +98,20 @@ class MobileNetV2(HybridBlock):
 
 def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None,
                   **kwargs):
-    return MobileNet(multiplier, **kwargs)
+    from ..model_store import apply_pretrained
+
+    name = "mobilenet%s" % str(multiplier)  # reference dotted name
+    return apply_pretrained(MobileNet(multiplier, **kwargs), name,
+                            pretrained, root, ctx)
 
 
 def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
                      **kwargs):
-    return MobileNetV2(multiplier, **kwargs)
+    from ..model_store import apply_pretrained
+
+    name = "mobilenetv2_%s" % str(multiplier)
+    return apply_pretrained(MobileNetV2(multiplier, **kwargs), name,
+                            pretrained, root, ctx)
 
 
 def mobilenet1_0(**kwargs):

@@ -78,9 +78,15 @@ class SqueezeNet(HybridBlock):
         return self.output(self.features(x))
 
 
-def squeezenet1_0(**kwargs):
-    return SqueezeNet("1.0", **kwargs)
+def squeezenet1_0(pretrained=False, ctx=None, root=None, **kwargs):
+    from ..model_store import apply_pretrained
+
+    return apply_pretrained(SqueezeNet("1.0", **kwargs), "squeezenet1.0",
+                            pretrained, root, ctx)
 
 
-def squeezenet1_1(**kwargs):
-    return SqueezeNet("1.1", **kwargs)
+def squeezenet1_1(pretrained=False, ctx=None, root=None, **kwargs):
+    from ..model_store import apply_pretrained
+
+    return apply_pretrained(SqueezeNet("1.1", **kwargs), "squeezenet1.1",
+                            pretrained, root, ctx)

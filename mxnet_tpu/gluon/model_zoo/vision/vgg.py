@@ -45,7 +45,12 @@ def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     if num_layers not in vgg_spec:
         raise MXNetError("invalid vgg depth %d" % num_layers)
     layers, filters = vgg_spec[num_layers]
-    return VGG(layers, filters, **kwargs)
+    from ..model_store import apply_pretrained
+
+    name = "vgg%d%s" % (num_layers,
+                         "_bn" if kwargs.get("batch_norm") else "")
+    return apply_pretrained(VGG(layers, filters, **kwargs), name,
+                            pretrained, root, ctx)
 
 
 def vgg11(**kwargs):

@@ -131,7 +131,7 @@ def box_encode(samples, matches, anchors, refs, means=(0., 0., 0., 0.),
                    jnp.log(jnp.maximum(gh, 1e-12) / jnp.maximum(ah, 1e-12))],
                   axis=-1)
     t = (t - means) / stds
-    mask = (samples > 0.5)[..., None]
+    mask = jnp.broadcast_to((samples > 0.5)[..., None], t.shape)
     return jnp.where(mask, t, 0.0), mask.astype(anchors.dtype)
 
 
@@ -200,7 +200,15 @@ def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
     B, C, H, W = data.shape
     ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
               else (pooled_size, pooled_size))
-    sr = max(int(sample_ratio), 1)
+    if sample_ratio <= 0:
+        # the reference's adaptive grid (ceil(roi_size/pooled_size) samples
+        # per bin) is data-dependent — impossible in one static-shape XLA
+        # program.  Fail loudly instead of silently diverging.
+        raise ValueError(
+            "roi_align on TPU needs an explicit sample_ratio >= 1 (the "
+            "reference's adaptive sample_ratio<=0 grid is data-dependent); "
+            "sample_ratio=2 matches the common detectron recipe")
+    sr = int(sample_ratio)
     offset = 0.5 if aligned else 0.0
 
     def one_roi(roi):
@@ -264,9 +272,7 @@ def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
                             jnp.arange(pw)[None, None, :]]
         return pooled
 
-    if position_sensitive and C % (pooled_size[0] * pooled_size[1]
-                                   if isinstance(pooled_size, (tuple, list))
-                                   else pooled_size ** 2):
+    if position_sensitive and C % (ph * pw):
         raise ValueError("position_sensitive=True needs channels divisible "
                          "by ph*pw (got C=%d)" % C)
     return jax.vmap(one_roi)(rois)

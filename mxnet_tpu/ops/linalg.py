@@ -38,10 +38,18 @@ def _t(x):
 @register("linalg_gemm")
 def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
                 beta=1.0, axis=-2):
-    """C' = alpha * op(A) @ op(B) + beta * C  (la_op.cc LaMatrixMacOp)."""
+    """C' = alpha * op(A) @ op(B) + beta * C  (la_op.cc LaMatrixMacOp).
+    ``axis`` names the matrix-row axis (reference semantics): for
+    axis != -2 the row axis is moved into place, multiplied, and moved
+    back."""
+    if axis != -2:
+        A = jnp.moveaxis(A, axis, -2)
+        B = jnp.moveaxis(B, axis, -2)
+        C = jnp.moveaxis(C, axis, -2)
     a = _t(A) if transpose_a else A
     b = _t(B) if transpose_b else B
-    return alpha * jnp.matmul(a, b) + beta * C
+    out = alpha * jnp.matmul(a, b) + beta * C
+    return jnp.moveaxis(out, -2, axis) if axis != -2 else out
 
 
 @register("linalg_gemm2")

@@ -103,6 +103,7 @@ def invoke(op, inputs, attrs):
         fn = functools.partial(op.fn, **attrs)
     else:
         fn = op.fn
+    fn = _amp_rewrite(op.name, fn)
 
     recordable = (
         thread_state.is_recording
@@ -143,6 +144,41 @@ def invoke(op, inputs, attrs):
 
 def _on_tape(x):
     return getattr(x, "_marked", False) or getattr(x, "_entry", None) is not None
+
+
+def _amp_rewrite(op_name, fn):
+    """AMP per-op dtype rewrite (reference low_precision_pass.cc applied a
+    graph pass; here EVERY path — eager and traced — funnels through
+    invoke, so wrapping the op fn at this chokepoint IS the pass).  The
+    casts live INSIDE the differentiated function so vjp cotangents cast
+    back to each input's original dtype automatically."""
+    from ..contrib import amp as _amp
+
+    if not _amp.is_active():
+        return fn
+    import jax.numpy as jnp
+
+    if op_name in _amp.TARGET_DTYPE_OPS:
+        to = jnp.dtype(_amp.target_dtype())
+
+        def low_fn(*args):
+            return fn(*[a.astype(to)
+                        if hasattr(a, "dtype") and a.dtype == jnp.float32
+                        else a for a in args])
+
+        low_fn.__name__ = getattr(fn, "__name__", op_name)
+        return low_fn
+    if op_name in _amp.FP32_OPS:
+        low = (jnp.bfloat16, jnp.float16)
+
+        def high_fn(*args):
+            return fn(*[a.astype(jnp.float32)
+                        if hasattr(a, "dtype") and a.dtype in low else a
+                        for a in args])
+
+        high_fn.__name__ = getattr(fn, "__name__", op_name)
+        return high_fn
+    return fn
 
 
 def apply_op(fn, *inputs, **attrs):

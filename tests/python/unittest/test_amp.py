@@ -103,3 +103,79 @@ def test_amp_init_trainer():
     amp.init()
     amp.init_trainer(trainer)
     assert hasattr(trainer, "_amp_loss_scaler")
+
+
+def test_amp_op_list_rewrite():
+    """amp.init() applies the per-op dtype lists at invoke time: matmul-
+    class ops compute in bf16, FP32_OPS are forced back to f32
+    (reference low_precision_pass.cc + lists/symbol_fp16.py)."""
+    amp.init("bfloat16")
+    try:
+        x = mx.nd.ones((4, 8))            # f32
+        w = mx.nd.ones((8, 8))
+        y = mx.nd.dot(x, w)               # TARGET_DTYPE op
+        assert y.dtype == np.dtype("bfloat16"), y.dtype
+        s = mx.nd.softmax(y)              # FP32 op on bf16 input
+        assert s.dtype == np.float32, s.dtype
+        # neutral ops (widest rule): dtype flows through unchanged
+        r = mx.nd.relu(y)
+        assert r.dtype == np.dtype("bfloat16")
+    finally:
+        amp.disable()
+    # after disable: f32 stays f32
+    y2 = mx.nd.dot(mx.nd.ones((2, 3)), mx.nd.ones((3, 3)))
+    assert y2.dtype == np.float32
+
+
+def test_amp_rewrite_gradients_match_dtype():
+    """Casts live inside the differentiated fn: grads come back in the
+    input's ORIGINAL dtype, and a small training step still learns."""
+    from mxnet_tpu import autograd
+
+    amp.init("bfloat16")
+    try:
+        x = mx.nd.array(np.random.RandomState(0).rand(4, 8)
+                        .astype(np.float32))
+        w = mx.nd.array(np.random.RandomState(1).rand(8, 2)
+                        .astype(np.float32))
+        w.attach_grad()
+        with autograd.record():
+            out = mx.nd.dot(x, w)          # computes in bf16
+            loss = mx.nd.sum(out * out)
+        loss.backward()
+        assert w.grad is not None
+        assert w.grad.dtype == np.float32  # cotangent cast back
+        assert np.isfinite(w.grad.asnumpy()).all()
+    finally:
+        amp.disable()
+
+
+def test_amp_rewrite_traced_path():
+    """The rewrite applies inside hybridize traces too (the chokepoint is
+    invoke, shared by eager and deferred-compute paths)."""
+    amp.init("bfloat16")
+    try:
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+        net.initialize()
+        net.hybridize()
+        out = net(mx.nd.ones((2, 4)))
+        assert out.dtype == np.dtype("bfloat16")
+    finally:
+        amp.disable()
+
+
+def test_loss_scaler_overflow_cycle():
+    """Overflow-injected fp16-style step: scale halves on overflow, grows
+    back after scale_window clean steps (reference amp/loss_scaler.py)."""
+    scaler = amp.LossScaler(init_scale=2.0 ** 8, scale_factor=2.0,
+                            scale_window=2)
+    inf_grad = mx.nd.array(np.array([np.inf, 1.0], np.float32))
+    ok_grad = mx.nd.array(np.array([1.0, 1.0], np.float32))
+    assert scaler.has_overflow([inf_grad])
+    scaler.update_scale(True)
+    assert scaler.loss_scale == 2.0 ** 7
+    assert not scaler.has_overflow([ok_grad])
+    scaler.update_scale(False)
+    scaler.update_scale(False)  # window=2 clean steps -> scale doubles
+    assert scaler.loss_scale == 2.0 ** 8

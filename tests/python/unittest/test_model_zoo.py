@@ -44,3 +44,91 @@ def test_inception_v3_forward_backward():
 def test_get_model_unknown():
     with pytest.raises(Exception):
         vision.get_model("resnet999")
+
+
+def test_pretrained_local_cache_roundtrip(tmp_path):
+    """pretrained=True loads from the local model_store cache (reference
+    model_store.py contract, download step replaced by local staging)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.gluon.model_zoo.model_store import get_model_file
+
+    root = str(tmp_path)
+    mx.random.seed(3)
+    src = vision.resnet18_v1()
+    src.initialize()
+    x = mx.nd.ones((1, 3, 32, 32))
+    ref = src(x).asnumpy()
+    src.save_parameters("%s/resnet18_v1.params" % root)
+
+    assert get_model_file("resnet18_v1", root=root).endswith(
+        "resnet18_v1.params")
+    mx.random.seed(99)  # different init must be overwritten by the load
+    net = vision.resnet18_v1(pretrained=True, root=root)
+    out = net(x).asnumpy()
+    assert np.allclose(out, ref, atol=1e-5)
+
+
+def test_pretrained_missing_raises_with_hint(tmp_path):
+    import pytest
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    with pytest.raises(mx.MXNetError, match="place"):
+        vision.alexnet(pretrained=True, root=str(tmp_path))
+
+
+def test_self_describing_export_import(tmp_path):
+    """export() -> SymbolBlock.imports round trip with NO block_factory
+    (reference gluon/block.py:1300,1500 contract)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.block import SymbolBlock
+
+    mx.random.seed(5)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).rand(4, 6).astype(np.float32))
+    ref = net(x).asnumpy()
+    prefix = "%s/model" % tmp_path
+    net.export(prefix)
+
+    blk = SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                              prefix + "-0000.params")
+    assert np.allclose(blk(x).asnumpy(), ref, atol=1e-5)
+    # polymorphic batch: a new batch size runs without retracing the class
+    x2 = mx.nd.array(np.random.RandomState(1).rand(7, 6).astype(np.float32))
+    assert np.allclose(blk(x2).asnumpy(), net(x2).asnumpy(), atol=1e-5)
+
+
+def test_symbol_block_finetune_gradients(tmp_path):
+    """Imported SymbolBlocks stay differentiable (vjp_order=1 export): a
+    fine-tuning backward reaches the imported weights."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.block import SymbolBlock
+
+    mx.random.seed(8)
+    net = nn.Dense(3, in_units=5)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).rand(4, 5).astype(np.float32))
+    net(x)
+    prefix = "%s/ft" % tmp_path
+    net.export(prefix)
+    blk = SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                              prefix + "-0000.params")
+    with autograd.record():
+        loss = mx.nd.sum(blk(x) ** 2)
+    loss.backward()
+    grads = [p.grad() for p in blk.collect_params().values()]
+    assert any(float(mx.nd.sum(mx.nd.abs(g)).asscalar()) > 0
+               for g in grads)
