@@ -178,12 +178,48 @@ def _bench_bert(batch=16, seq=512, dropout=0.1, iters=10):
             "mfu": round(tflops / _peak_bf16_tflops(), 3)}
 
 
+def _bench_lstm_lm(batch=32, seq=64, vocab=10000, hidden=650, iters=10):
+    """BASELINE config 5: LSTM language model (the fused-RNN replacement,
+    reference rnn.cc:295 -> lax.scan)."""
+    import numpy as np
+
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+    from mxnet_tpu.gluon.model_zoo import language_model as lm
+
+    mx.random.seed(0)
+    net = lm.StandardRNNLM(vocab, embed_size=hidden, hidden_size=hidden,
+                           num_layers=2, dropout=0.0)
+    net.initialize()
+    trainer = parallel.FusedTrainer(
+        net, loss_fn=None, loss="softmax_ce", optimizer="sgd",
+        optimizer_params={"learning_rate": 1.0})
+    rs = np.random.RandomState(0)
+    x = jax.device_put(rs.randint(0, vocab, (batch, seq)).astype(np.int32))
+    y = jax.device_put(rs.randint(0, vocab, (batch, seq)).astype(np.int32))
+
+    for _ in range(WARMUP):
+        loss = trainer.step(x, y)
+    float(loss.asnumpy())
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = trainer.step(x, y)
+    float(loss.asnumpy())
+    dt = time.perf_counter() - t0
+    return {"tokens_per_sec": round(batch * seq * iters / dt, 1),
+            "step_ms": round(1000 * dt / iters, 2), "batch": batch,
+            "seq": seq, "hidden": hidden, "dtype": "float32"}
+
+
 def main():
     extra = {}
     extra["resnet50_fp32"] = _bench_resnet("float32", 128)
     bf16 = _bench_resnet("bfloat16", 128)
     extra["resnet50_bf16"] = bf16
     extra["bert_base_pretrain_bf16"] = _bench_bert()
+    extra["lstm_lm_650"] = _bench_lstm_lm()
     extra["peak_bf16_tflops"] = _peak_bf16_tflops()
     print(json.dumps({
         "metric": "resnet50_train_bf16_bs128_imgs_per_sec",

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Op-level performance harness (reference benchmark/opperf/: per-op
+forward/backward time dumped to json for regression tracking).
+
+Usage::
+
+    python benchmark/opperf/opperf.py                   # full covered set
+    python benchmark/opperf/opperf.py --ops dot,softmax
+    python benchmark/opperf/opperf.py --out results.json --iters 50
+
+Methodology: each op runs through the SAME registry invoke path users
+hit; timing is steady-state (warmup first), hard-synced by a device->host
+transfer (block_until_ready is unreliable over the axon TPU tunnel).
+Backward = value_and_grad of sum(op(*args)) for differentiable ops.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _specs():
+    """op name -> list of positional numpy inputs (attrs via lambda)."""
+    rs = np.random.RandomState(0)
+    M = rs.rand(1024, 1024).astype(np.float32)
+    N = rs.rand(1024, 1024).astype(np.float32)
+    V = rs.rand(1 << 20).astype(np.float32)
+    C = rs.rand(32, 64, 56, 56).astype(np.float32)
+    K = rs.rand(64, 64, 3, 3).astype(np.float32) * 0.1
+    E = rs.rand(32, 128, 768).astype(np.float32)
+    idx = rs.randint(0, 1000, (32, 128)).astype(np.int32)
+    emb = rs.rand(1000, 768).astype(np.float32)
+    g = {"gamma": np.ones(768, np.float32), "beta": np.zeros(768, np.float32)}
+
+    specs = {
+        # elementwise / math (bandwidth-bound)
+        "add": [V, V], "multiply": [V, V], "divide": [V, V + 0.5],
+        "exp": [V], "log": [V + 0.5], "sqrt": [V], "tanh": [V],
+        "sigmoid": [V], "relu": [V], "gelu": [V], "erf": [V],
+        "square": [V], "abs": [V], "clip": [V],
+        # reductions
+        "sum": [M], "mean": [M], "max": [M], "min": [M], "prod": [M + 1.0],
+        "argmax": [M], "norm": [M], "logsumexp": [M],
+        "cumsum": [V], "topk": [M], "sort": [V], "argsort": [V],
+        # MXU
+        "dot": [M, N], "matmul": [M, N], "batch_dot": [
+            rs.rand(32, 128, 128).astype(np.float32),
+            rs.rand(32, 128, 128).astype(np.float32)],
+        "fully_connected": [rs.rand(256, 1024).astype(np.float32),
+                            rs.rand(512, 1024).astype(np.float32)],
+        "einsum": None,  # handled specially below
+        # nn
+        "convolution": [C, K],
+        "pooling": [C],
+        "batch_norm": [C, np.ones(64, np.float32), np.zeros(64, np.float32),
+                       np.zeros(64, np.float32), np.ones(64, np.float32)],
+        "layer_norm": [E, g["gamma"], g["beta"]],
+        "rms_norm": [E, g["gamma"]],
+        "softmax": [E], "log_softmax": [E],
+        "embedding": [idx, emb],
+        "multi_head_attention": [E, E, E],
+        "dropout": [E],
+        # shape ops
+        "transpose": [M], "reshape": [M], "concat": [M, N],
+        "take": [emb, idx], "one_hot": [idx],
+        "where": [(V > 0.5), V, V],
+        # linalg
+        "linalg_potrf": [M @ M.T / 1024 + np.eye(1024, dtype=np.float32)],
+        "linalg_gemm2": [M, N],
+        "linalg_syrk": [M],
+        # detection
+        "box_iou": [rs.rand(256, 4).astype(np.float32),
+                    rs.rand(256, 4).astype(np.float32)],
+    }
+    attrs = {
+        "pooling": {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"},
+        "convolution": {"kernel": (3, 3), "pad": (1, 1),
+                        "num_filter": 64},
+        "clip": {"a_min": 0.2, "a_max": 0.8},
+        "one_hot": {"depth": 1000},
+        "multi_head_attention": {"num_heads": 12},
+        "batch_norm": {"training": True},
+        "topk": {"k": 16},
+    }
+    return specs, attrs
+
+
+def bench_op(name, arrays, attrs, iters, warmup=3):
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+    from mxnet_tpu.ops.registry import get_op
+
+    op = get_op(name)
+    nd_in = [nd.array(a) if isinstance(a, np.ndarray) else nd.array(a)
+             for a in arrays]
+
+    def run_fwd():
+        return op(*nd_in, **attrs)
+
+    def sync(out):
+        o = out[0] if isinstance(out, tuple) else out
+        np.asarray(o.asnumpy().ravel()[:1])
+
+    for _ in range(warmup):
+        out = run_fwd()
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run_fwd()
+    sync(out)
+    fwd_ms = (time.perf_counter() - t0) / iters * 1000
+
+    bwd_ms = None
+    if op.differentiable:
+        grad_ins = [x for x in nd_in
+                    if np.issubdtype(np.asarray(x.asnumpy()).dtype,
+                                     np.floating)]
+        if grad_ins:
+            for x in grad_ins:
+                x.attach_grad()
+
+            def run_bwd():
+                with autograd.record():
+                    o = op(*nd_in, **attrs)
+                    o = o[0] if isinstance(o, tuple) else o
+                    L = nd.sum(o)
+                L.backward()
+                return grad_ins[0].grad
+
+            for _ in range(warmup):
+                gout = run_bwd()
+            sync(gout)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                gout = run_bwd()
+            sync(gout)
+            bwd_ms = (time.perf_counter() - t0) / iters * 1000
+    return {"fwd_ms": round(fwd_ms, 4),
+            "fwd_bwd_ms": round(bwd_ms, 4) if bwd_ms is not None else None}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--ops", default=None,
+                        help="comma-separated subset (default: all covered)")
+    parser.add_argument("--out", default=None, help="json output path")
+    parser.add_argument("--iters", type=int, default=20)
+    args = parser.parse_args(argv)
+
+    specs, attrs = _specs()
+    todo = (args.ops.split(",") if args.ops else
+            [k for k, v in specs.items() if v is not None])
+    results = {}
+    import jax
+
+    results["_meta"] = {
+        "device": str(jax.devices()[0]),
+        "iters": args.iters,
+    }
+    for name in todo:
+        arrays = specs.get(name)
+        if arrays is None:
+            results[name] = {"error": "no input spec"}
+            continue
+        try:
+            results[name] = bench_op(name, arrays, attrs.get(name, {}),
+                                     args.iters)
+        except Exception as exc:  # keep the sweep going
+            results[name] = {"error": str(exc)[:200]}
+        print("%-24s %s" % (name, results[name]))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=2)
+    n_err = sum(1 for v in results.values()
+                if isinstance(v, dict) and "error" in v)
+    print("ops: %d, errors: %d" % (len(todo), n_err))
+    return 0 if n_err == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

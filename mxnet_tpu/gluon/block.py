@@ -588,15 +588,19 @@ class HybridBlock(Block):
 
         # vjp_order=1: the deserialized program stays differentiable, so
         # an imported SymbolBlock can be fine-tuned (reference SymbolBlock
-        # is trainable)
+        # is trainable).  The manifest records whether the vjp shipped so
+        # imports can fail LOUDLY at record time instead of deep in jax.
+        has_vjp = True
         try:
             blob = exported.serialize(vjp_order=1)
         except Exception:
             blob = exported.serialize()
+            has_vjp = False
         manifest = {
             "format": "mxnet_tpu-hybrid-2",
             "class": type(self).__name__,
             "program": base64.b64encode(blob).decode(),
+            "vjp": has_vjp,
             "batch_polymorphic": poly,
             "inputs": [{"shape": list(s), "dtype": d} for s, d in avals],
             "param_names": names,
@@ -617,10 +621,12 @@ class SymbolBlock(HybridBlock):
     the defining Python class is NOT needed.  ``block_factory`` remains as
     an escape hatch for legacy format-1 manifests."""
 
-    def __init__(self, exported=None, param_names=None, param_meta=None):
+    def __init__(self, exported=None, param_names=None, param_meta=None,
+                 differentiable=True):
         super().__init__()
         self._exported = exported
         self._param_names = list(param_names or [])
+        self._differentiable = bool(differentiable)
         from .parameter import Parameter
 
         for n in self._param_names:
@@ -639,11 +645,18 @@ class SymbolBlock(HybridBlock):
             return tuple(_exp.call(list(datas[:_np]), *datas[_np:]))
 
         call.__name__ = "symbol_block"
-        # differentiable: export() serializes with vjp_order=1, so jax can
-        # differentiate through the deserialized program (fine-tuning an
-        # imported model works, matching the reference SymbolBlock)
+        # differentiable iff the export shipped its vjp (manifest "vjp"
+        # flag); a no-vjp import records nothing and fails loudly below
+        # instead of deep inside jax
+        if not self._differentiable:
+            if autograd.is_recording():
+                raise MXNetError(
+                    "this SymbolBlock was exported WITHOUT a vjp "
+                    "(serialize(vjp_order=1) failed at export time); it "
+                    "is inference-only — re-export with a newer jax to "
+                    "fine-tune")
         op = Operator("symbol_block", call, num_outputs=0,
-                      differentiable=True)
+                      differentiable=self._differentiable)
         out = invoke(op, tuple(pvals) + tuple(args), {})
         if isinstance(out, tuple) and len(out) == 1:
             return out[0]
@@ -664,7 +677,8 @@ class SymbolBlock(HybridBlock):
             exported = jax_export.deserialize(
                 base64.b64decode(manifest["program"]))
             blk = SymbolBlock(exported, manifest["param_names"],
-                              manifest.get("params"))
+                              manifest.get("params"),
+                              differentiable=manifest.get("vjp", False))
             blk.initialize()
             if param_file:
                 blk.load_parameters(param_file, ctx=ctx,

@@ -623,11 +623,23 @@ def _clean_key(key):
 
 def waitall():
     """Block on every pending computation (reference ndarray.py:231 waitall →
-    Engine::WaitForAll)."""
-    import jax
+    Engine::WaitForAll).
 
-    (jax.device_put(0) + 0).block_until_ready()
+    Guarantee: PJRT executes programs in enqueue order per device, so a
+    host fetch of a freshly enqueued trivial program on EACH local device
+    completes only after everything enqueued before it on that device —
+    the same fence Engine::WaitForAll provided.  The fetch goes through a
+    device->host transfer because ``block_until_ready`` alone is not
+    reliable on tunneled backends (axon)."""
+    import jax
+    import numpy as _np_
+
     jax.effects_barrier()
+    for d in jax.local_devices():
+        # the +0 matters: a bare transfer is not ordered after enqueued
+        # programs, but an enqueued trivial PROGRAM is — fetching its
+        # result to host is the fence
+        _np_.asarray(jax.device_put(0, d) + 0)
 
 
 def from_jax(x):

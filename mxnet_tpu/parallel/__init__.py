@@ -190,6 +190,10 @@ class FusedTrainer:
         else:
             self._state_specs = None
         self._build_step()
+        pending = getattr(self, "_pending_state", None)
+        if pending is not None:
+            self._pending_state = None
+            self._apply_state(pending)
 
     def _make_zero_specs(self, opt_state):
         """Per-leaf PartitionSpecs sharding optimizer state over dp.
@@ -210,8 +214,19 @@ class FusedTrainer:
                     break
             return P(*base)
 
-        return {k: jax.tree_util.tree_map(lambda v: spec_for(k, v), leaf)
-                for k, leaf in opt_state.items()}
+        specs = {k: jax.tree_util.tree_map(lambda v: spec_for(k, v), leaf)
+                 for k, leaf in opt_state.items()}
+        flat_specs = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda s: isinstance(s, P))
+        if flat_specs and not any("dp" in s for s in flat_specs):
+            import warnings
+
+            warnings.warn(
+                "zero=True had no effect: no optimizer-state dimension is "
+                "divisible by dp=%d, so every shard is a full replica "
+                "(pad the model dims or lower dp to actually shard)" % dp,
+                stacklevel=3)
+        return specs
 
     def _build_step(self):
         apply_fn = self._apply
@@ -373,6 +388,41 @@ class FusedTrainer:
                 if self._mesh is not None:
                     v = jnp.asarray(_np.asarray(v))
                 named[n]._data._data = v
+
+    # -- checkpoint/resume (mxnet_tpu.elastic contract) ---------------------
+    def state_dict(self):
+        """Full training state as a jax pytree (params + optimizer state +
+        step counter) for CheckpointManager.  Returns None before the
+        first step (structure unknown until _setup)."""
+        if self._params is None:
+            return None
+        return {"params": self._params, "opt_state": self._opt_state,
+                "step": jnp.uint32(self._step_count)}
+
+    def load_state_dict(self, state):
+        """Restore training state.  Safe BEFORE the first step too: the
+        state is parked and applied after _setup builds the program (a
+        fresh-process resume must not be overwritten by _setup's fresh
+        init)."""
+        if self._params is None:
+            self._pending_state = state
+            return
+        self._apply_state(state)
+
+    def _apply_state(self, state):
+        params, opt_state = state["params"], state["opt_state"]
+        if self._mesh is not None and self._param_specs is not None:
+            params = {n: jax.device_put(
+                v, NamedSharding(self._mesh, self._param_specs[n]))
+                for n, v in params.items()}
+            if self._zero and self._state_specs is not None:
+                opt_state = jax.tree_util.tree_map(
+                    lambda v, s: jax.device_put(
+                        v, NamedSharding(self._mesh, s)),
+                    opt_state, self._state_specs)
+        self._params = params
+        self._opt_state = opt_state
+        self._step_count = int(state["step"])
 
     @property
     def params(self):

@@ -126,6 +126,8 @@ class PipelineTrainer:
                              "dtype=%r)" % (dtype,))
         self._step_fn = None
         self._step_count = 0
+        self._stacked = None
+        self._opt_state = None
 
     # -- setup --------------------------------------------------------------
     def _setup(self, x, y):
@@ -214,6 +216,10 @@ class PipelineTrainer:
                          max(feat(s) for s in self._out_shapes))
         self._mb_loc = mb_loc
         self._build_step()
+        pending = getattr(self, "_pending_state", None)
+        if pending is not None:
+            self._pending_state = None
+            self._apply_state(pending)
 
     def _branches(self):
         """One closure per stage: (flat_params, inp_buf, label, rng) ->
@@ -347,6 +353,28 @@ class PipelineTrainer:
             rng, xm, ym)
         self._step_count += 1
         return NDArray(loss)
+
+    # -- checkpoint/resume (mxnet_tpu.elastic contract) ---------------------
+    def state_dict(self):
+        """None before the first step (stage structure unknown)."""
+        if self._stacked is None or self._step_fn is None:
+            return None
+        return {"stacked": self._stacked, "opt_state": self._opt_state,
+                "step": jnp.uint32(self._step_count)}
+
+    def load_state_dict(self, state):
+        """Safe before the first step: parked and applied after _setup."""
+        if self._stacked is None or self._step_fn is None:
+            self._pending_state = state
+            return
+        self._apply_state(state)
+
+    def _apply_state(self, state):
+        psh = NamedSharding(self._mesh, self._pspec)
+        self._stacked = jax.device_put(state["stacked"], psh)
+        self._opt_state = jax.tree_util.tree_map(
+            lambda v: jax.device_put(v, psh), state["opt_state"])
+        self._step_count = int(state["step"])
 
     def sync_block(self):
         """Write the trained stage weights back into the Gluon block."""

@@ -188,10 +188,23 @@ class Symbol:
         with open(fname, "w") as f:
             f.write(self.tojson())
 
+    _KNOWN_BACKENDS = {None, "", "xla", "tpu", "default"}
+
     def optimize_for(self, backend=None, args=None, aux=None, ctx=None,
                      **kwargs):
-        """Graph-partition backends collapse into XLA; returns self
-        (reference symbol.py:1477)."""
+        """Graph-partition backends collapse into XLA (reference
+        symbol.py:1477 ran the registered SubgraphProperty).  Unknown
+        backend strings fail loudly — the reference errored for
+        unregistered backends too; silently succeeding would fake
+        MKLDNN/TensorRT support."""
+        if isinstance(backend, str) and backend.lower() not in \
+                self._KNOWN_BACKENDS:
+            from ..base import MXNetError
+
+            raise MXNetError(
+                "unknown partitioning backend %r: the TPU build has one "
+                "compiler backend (XLA); MKLDNN/TensorRT-style plugin "
+                "partitioners do not exist here" % (backend,))
         return self
 
     def __repr__(self):

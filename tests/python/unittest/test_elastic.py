@@ -1,0 +1,167 @@
+"""Failure detection + checkpoint auto-resume tests (SURVEY §5.3 — the
+explicit gap-to-close; the reference has no elastic machinery, recovery
+was manual restart from CheckpointHandler files)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel
+from mxnet_tpu.elastic import (CheckpointManager, FaultTolerantRunner,
+                               device_health_check)
+from mxnet_tpu.gluon import nn
+
+
+def _trainer(seed):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=8),
+            nn.Dense(4, in_units=16))
+    net.initialize()
+    return parallel.FusedTrainer(
+        net, loss="softmax_ce", optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+
+
+def _batches(step):
+    rs = np.random.RandomState(step % 7)
+    return (rs.rand(16, 8).astype(np.float32),
+            rs.randint(0, 4, 16).astype(np.int32))
+
+
+def test_device_health_check():
+    report = device_health_check()
+    assert report and all(v == "ok" for v in report.values()), report
+
+
+def test_checkpoint_manager_roundtrip_and_retention(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_keep=2)
+    tr = _trainer(1)
+    tr.step(*_batches(0))
+    for s in (10, 20, 30):
+        mgr.save(s, tr.state_dict())
+    assert mgr.steps() == [20, 30]  # rolling retention
+    st, state = mgr.restore(tr.state_dict())
+    assert st == 30
+    tr2 = _trainer(2)
+    tr2.step(*_batches(0))
+    tr2.load_state_dict(state)
+    # restored params identical to the saved trainer's
+    for k in tr.params:
+        np.testing.assert_allclose(np.asarray(tr.params[k]),
+                                   np.asarray(tr2.params[k]), rtol=1e-6)
+    assert tr2._step_count == tr._step_count
+
+
+def test_fault_tolerant_runner_resumes_and_matches(tmp_path):
+    """A mid-training crash must auto-resume from checkpoint and land on
+    the SAME final weights as an uninterrupted run (steps are a pure
+    function of the step index)."""
+    n_steps = 12
+
+    # uninterrupted reference
+    ref = _trainer(7)
+    for s in range(n_steps):
+        ref.step(*_batches(s))
+
+    # faulty run: blows up once at step 8 (after ckpt at step 7)
+    tr = _trainer(7)
+    mgr = CheckpointManager(str(tmp_path))
+    boom = {"armed": True}
+    real_step = tr.step
+
+    def flaky_step(x, y):
+        if boom["armed"] and tr._step_count == 8:
+            boom["armed"] = False
+            raise RuntimeError("injected device failure")
+        return real_step(x, y)
+
+    tr.step = flaky_step
+    failures = []
+    runner = FaultTolerantRunner(tr, mgr, checkpoint_every=4,
+                                 max_restarts=2,
+                                 on_failure=lambda s, e: failures.append(s))
+    runner.run(_batches, n_steps)
+    assert failures == [8]
+    assert runner.restarts == 1
+    for k in ref.params:
+        np.testing.assert_allclose(np.asarray(tr.params[k]),
+                                   np.asarray(ref.params[k]), rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_fault_tolerant_runner_gives_up(tmp_path):
+    tr = _trainer(9)
+
+    def always_fails(x, y):
+        raise RuntimeError("permanently broken")
+
+    tr.step = always_fails
+    runner = FaultTolerantRunner(tr, CheckpointManager(str(tmp_path)),
+                                 max_restarts=2)
+    with pytest.raises(mx.MXNetError, match="after 2 restarts"):
+        runner.run(_batches, 5)
+
+
+def test_runner_resumes_across_process_boundary(tmp_path):
+    """A fresh runner with the same manager picks up where the old one
+    stopped (the restart-the-job path)."""
+    n_steps = 10
+    mgr = CheckpointManager(str(tmp_path), max_keep=3)
+    tr = _trainer(11)
+    r1 = FaultTolerantRunner(tr, mgr, checkpoint_every=2)
+    r1.run(_batches, 6)  # stops at step 6; last ckpt at step 5
+    # FRESH trainer, no prior step: the checkpoint's embedded structure
+    # spec must carry the resume (the real restart-the-job path)
+    tr2 = _trainer(11)
+    r2 = FaultTolerantRunner(tr2, mgr, checkpoint_every=2)
+    r2.run(_batches, n_steps)
+    ref = _trainer(11)
+    for s in range(n_steps):
+        ref.step(*_batches(s))
+    for k in ref.params:
+        np.testing.assert_allclose(np.asarray(tr2.params[k]),
+                                   np.asarray(ref.params[k]), rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_load_state_dict_before_first_step_survives_setup(tmp_path):
+    """load_state_dict on a never-stepped trainer must not be overwritten
+    by _setup's fresh init (the silent-restart bug)."""
+    mgr = CheckpointManager(str(tmp_path))
+    tr = _trainer(21)
+    for s in range(4):
+        tr.step(*_batches(s))
+    mgr.save(3, tr.state_dict())
+
+    tr2 = _trainer(22)  # different init
+    _step, state = mgr.restore()
+    tr2.load_state_dict(state)       # BEFORE any step
+    tr2.step(*_batches(4))           # triggers _setup; must keep the load
+    ref = _trainer(21)
+    for s in range(5):
+        ref.step(*_batches(s))
+    for k in ref.params:
+        np.testing.assert_allclose(np.asarray(tr2.params[k]),
+                                   np.asarray(ref.params[k]), rtol=1e-5,
+                                   atol=1e-6)
+    assert tr2._step_count == 5
+
+
+def test_runner_loss_series_no_duplicates(tmp_path):
+    """Resume replay must not duplicate loss entries."""
+    tr = _trainer(31)
+    mgr = CheckpointManager(str(tmp_path))
+    boom = {"armed": True}
+    real = tr.step
+
+    def flaky(x, y):
+        if boom["armed"] and tr._step_count == 6:
+            boom["armed"] = False
+            raise RuntimeError("injected")
+        return real(x, y)
+
+    tr.step = flaky
+    runner = FaultTolerantRunner(tr, mgr, checkpoint_every=4,
+                                 max_restarts=2)
+    losses = runner.run(_batches, 10)
+    assert len(losses) == 10, len(losses)

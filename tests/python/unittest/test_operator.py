@@ -192,3 +192,15 @@ def test_activation_dispatch():
     for act in ("leaky", "elu", "selu", "gelu"):
         y = nd.LeakyReLU(x, act_type=act)
         assert y.shape == x.shape
+
+
+def test_optimize_for_rejects_unknown_backend():
+    import pytest
+
+    import mxnet_tpu as mx
+
+    sym_x = mx.sym.Variable("x")
+    sym_y = sym_x + 1
+    sym_y.optimize_for("XLA")  # known: no-op
+    with pytest.raises(mx.MXNetError, match="unknown partitioning"):
+        sym_y.optimize_for("MKLDNN")

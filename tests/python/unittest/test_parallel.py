@@ -421,3 +421,21 @@ def test_moe_apply_aux_loss_and_capacity_drop():
     out2 = parallel.moe_apply(moe, nd.array(x), mesh=mesh, axis_name="ep",
                               capacity_factor=0.25)
     assert np.all(np.isfinite(out2.asnumpy()))
+
+
+def test_zero_warns_when_nothing_shards():
+    import warnings
+
+    mesh = _mesh_or_skip({"dp": 8})
+    mx.random.seed(13)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(3, in_units=5))  # no dim divisible by 8
+    net.initialize()
+    tr = parallel.FusedTrainer(net, loss="softmax_ce", optimizer="adam",
+                               mesh=mesh, zero=True)
+    X = np.random.rand(8, 5).astype(np.float32)
+    Y = np.random.randint(0, 3, 8).astype(np.int32)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        tr.step(X, Y)
+    assert any("zero=True had no effect" in str(x.message) for x in w)
