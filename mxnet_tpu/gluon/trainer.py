@@ -145,8 +145,21 @@ class Trainer:
                                        out=param.list_data())
                 continue
             self._maybe_init_states(i, param)
+            grad = param.grad()
+            if param.grad_stype == "row_sparse" and \
+                    getattr(self._optimizer, "lazy_update", False):
+                # reference parameter.py:90-136: embedding grads flow as
+                # row_sparse so the optimizer touches only live rows; the
+                # XLA backward materializes dense, so compress eagerly —
+                # but ONLY for optimizers with a row_sparse update rule
+                # (SGD/Adam lazy paths); others keep the dense grad
+                from ..ndarray.sparse import (RowSparseNDArray,
+                                              row_sparse_from_dense)
+
+                if not isinstance(grad, RowSparseNDArray):
+                    grad = row_sparse_from_dense(grad)
             self._optimizer.update_multi_precision(
-                i, param.data(), param.grad(), self._states[i])
+                i, param.data(), grad, self._states[i])
 
     # ---- persistence ------------------------------------------------------
     def save_states(self, fname):

@@ -142,3 +142,13 @@ class ImageIter:
 
     def next(self):
         return self._iter.next()
+
+
+# detection augmenters + iterator (reference python/mxnet/image/detection.py)
+from .image_detection import (  # noqa: E402,F401
+    CreateDetAugmenter, DetAugmenter, DetBorrowAug, DetHorizontalFlipAug,
+    DetRandomCropAug, DetRandomPadAug, DetRandomSelectAug, ImageDetIter)
+
+__all__ += ["CreateDetAugmenter", "DetAugmenter", "DetBorrowAug",
+            "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+            "DetRandomSelectAug", "ImageDetIter"]

@@ -1,0 +1,233 @@
+"""Detection image augmenters (reference python/mxnet/image/detection.py —
+DetBorrowAug, DetRandomSelectAug, DetHorizontalFlipAug, DetRandomCropAug,
+DetRandomPadAug, CreateDetAugmenter, ImageDetIter).
+
+Labels are (N, 5+) rows [cls, x1, y1, x2, y2, ...] with coordinates
+NORMALIZED to [0, 1] of the image (the reference convention), so every
+geometric augmenter transforms image and boxes together.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import image as _img
+from . import ndarray as nd
+from .base import MXNetError
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "CreateDetAugmenter", "ImageDetIter"]
+
+
+class DetAugmenter:
+    """Base detection augmenter: __call__(src, label) -> (src, label)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap a plain image augmenter that does not move pixels relative to
+    boxes (color jitter etc.) — reference detection.py DetBorrowAug."""
+
+    def __init__(self, augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly pick ONE of the given augmenters (or skip) per sample."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        self.aug_list = list(aug_list)
+        self.skip_prob = float(skip_prob)
+
+    def __call__(self, src, label):
+        if _np.random.rand() < self.skip_prob or not self.aug_list:
+            return src, label
+        aug = self.aug_list[_np.random.randint(len(self.aug_list))]
+        return aug(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image + x-coordinates (reference DetHorizontalFlipAug)."""
+
+    def __init__(self, p=0.5):
+        self.p = float(p)
+
+    def __call__(self, src, label):
+        if _np.random.rand() >= self.p:
+            return src, label
+        arr = src.asnumpy()[:, ::-1]
+        lab = _np.array(label.asnumpy() if isinstance(label, nd.NDArray)
+                        else label, copy=True)
+        x1 = lab[:, 1].copy()
+        lab[:, 1] = 1.0 - lab[:, 3]
+        lab[:, 3] = 1.0 - x1
+        return nd.array(arr.copy(), dtype=src.dtype), nd.array(lab)
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop constrained by box overlap (reference
+    DetRandomCropAug): sample a crop with area in [min_object_covered
+    -respecting] range; boxes are clipped to the crop, and boxes whose
+    center falls outside are dropped (marked cls=-1, shape-stable)."""
+
+    def __init__(self, min_object_covered=0.3, min_crop_scale=0.3,
+                 max_crop_scale=1.0, max_attempts=20):
+        self.min_object_covered = float(min_object_covered)
+        self.scale_range = (float(min_crop_scale), float(max_crop_scale))
+        self.max_attempts = int(max_attempts)
+
+    def __call__(self, src, label):
+        arr = src.asnumpy()
+        H, W = arr.shape[:2]
+        lab = _np.array(label.asnumpy() if isinstance(label, nd.NDArray)
+                        else label, copy=True)
+        valid = lab[:, 0] >= 0
+        for _ in range(self.max_attempts):
+            s = _np.random.uniform(*self.scale_range)
+            cw, ch = s, s
+            cx = _np.random.uniform(0, 1 - cw)
+            cy = _np.random.uniform(0, 1 - ch)
+            # fraction of each box covered by the crop
+            ix1 = _np.maximum(lab[:, 1], cx)
+            iy1 = _np.maximum(lab[:, 2], cy)
+            ix2 = _np.minimum(lab[:, 3], cx + cw)
+            iy2 = _np.minimum(lab[:, 4], cy + ch)
+            inter = _np.maximum(ix2 - ix1, 0) * _np.maximum(iy2 - iy1, 0)
+            area = _np.maximum((lab[:, 3] - lab[:, 1]) *
+                               (lab[:, 4] - lab[:, 2]), 1e-12)
+            cover = inter / area
+            if not _np.any(valid) or \
+                    cover[valid].max() >= self.min_object_covered:
+                px1, py1 = int(cx * W), int(cy * H)
+                px2, py2 = int((cx + cw) * W), int((cy + ch) * H)
+                out = arr[py1:py2, px1:px2]
+                # re-normalize boxes into crop coords
+                nl = lab.copy()
+                nl[:, 1] = (lab[:, 1] - cx) / cw
+                nl[:, 2] = (lab[:, 2] - cy) / ch
+                nl[:, 3] = (lab[:, 3] - cx) / cw
+                nl[:, 4] = (lab[:, 4] - cy) / ch
+                centers_x = (nl[:, 1] + nl[:, 3]) / 2
+                centers_y = (nl[:, 2] + nl[:, 4]) / 2
+                keep = ((centers_x > 0) & (centers_x < 1) &
+                        (centers_y > 0) & (centers_y < 1) & valid)
+                nl[:, 1:5] = _np.clip(nl[:, 1:5], 0.0, 1.0)
+                nl[~keep, 0] = -1  # invalid marker, shape-stable
+                return nd.array(out.copy(), dtype=src.dtype), nd.array(nl)
+        return src, nd.array(lab)
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expand/pad (reference DetRandomPadAug): place the image in
+    a larger mean-filled canvas; boxes shrink accordingly."""
+
+    def __init__(self, max_pad_scale=2.0, pad_val=(127, 127, 127)):
+        self.max_pad_scale = float(max_pad_scale)
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        arr = src.asnumpy()
+        H, W, C = arr.shape
+        s = _np.random.uniform(1.0, self.max_pad_scale)
+        if s <= 1.0:
+            return src, label
+        nh, nw = int(H * s), int(W * s)
+        oy = _np.random.randint(0, nh - H + 1)
+        ox = _np.random.randint(0, nw - W + 1)
+        canvas = _np.empty((nh, nw, C), arr.dtype)
+        canvas[...] = _np.asarray(self.pad_val, arr.dtype)[:C]
+        canvas[oy:oy + H, ox:ox + W] = arr
+        lab = _np.array(label.asnumpy() if isinstance(label, nd.NDArray)
+                        else label, copy=True)
+        lab[:, 1] = (lab[:, 1] * W + ox) / nw
+        lab[:, 3] = (lab[:, 3] * W + ox) / nw
+        lab[:, 2] = (lab[:, 2] * H + oy) / nh
+        lab[:, 4] = (lab[:, 4] * H + oy) / nh
+        return nd.array(canvas, dtype=src.dtype), nd.array(lab)
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_mirror=False, mean=None, std=None,
+                       min_object_covered=0.3, max_pad_scale=2.0,
+                       **kwargs):
+    """Standard detection augmenter chain (reference detection.py
+    CreateDetAugmenter)."""
+    augs = []
+    if rand_crop > 0:
+        augs.append(DetRandomSelectAug(
+            [DetRandomCropAug(min_object_covered=min_object_covered)],
+            skip_prob=1.0 - rand_crop))
+    if rand_pad > 0:
+        augs.append(DetRandomSelectAug(
+            [DetRandomPadAug(max_pad_scale=max_pad_scale)],
+            skip_prob=1.0 - rand_pad))
+    if rand_mirror:
+        augs.append(DetHorizontalFlipAug(0.5))
+    return augs
+
+
+class ImageDetIter:
+    """Detection data iterator (reference image/detection.py
+    ImageDetIter): wraps an (images, labels) source, applies the det
+    augmenter chain per sample, resizes to data_shape, and yields
+    (data (B,C,H,W) f32, label (B,N,5)) batches."""
+
+    def __init__(self, batch_size, data_shape, images=None, labels=None,
+                 aug_list=None, shuffle=False, **kwargs):
+        if images is None or labels is None:
+            raise MXNetError("ImageDetIter needs images= (list of HWC "
+                             "uint8 arrays) and labels= (list of (N,5))")
+        if len(images) != len(labels):
+            raise MXNetError("images/labels length mismatch")
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self._images = list(images)
+        self._labels = [_np.asarray(l, _np.float32) for l in labels]
+        self._max_boxes = max(l.shape[0] for l in self._labels)
+        self._augs = aug_list if aug_list is not None else []
+        self._shuffle = shuffle
+        self._order = _np.arange(len(images))
+        self.reset()
+
+    def reset(self):
+        self._cursor = 0
+        if self._shuffle:
+            _np.random.shuffle(self._order)
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def next(self):
+        n_left = len(self._images) - self._cursor
+        if n_left <= 0:
+            raise StopIteration
+        # pad the final partial batch by wrapping (reference ImageDetIter
+        # pads and reports DataBatch.pad so no sample is ever dropped)
+        pad = max(0, self.batch_size - n_left)
+        C, H, W = self.data_shape
+        data = _np.zeros((self.batch_size, C, H, W), _np.float32)
+        label = _np.full((self.batch_size, self._max_boxes,
+                          self._labels[0].shape[1]), -1.0, _np.float32)
+        for i in range(self.batch_size):
+            j = self._order[(self._cursor + i) % len(self._images)]
+            img = nd.array(self._images[j], dtype="uint8")
+            lab = nd.array(self._labels[j])
+            for aug in self._augs:
+                img, lab = aug(img, lab)
+            img = _img.imresize(img, W, H)
+            arr = img.asnumpy().astype(_np.float32)
+            data[i] = arr.transpose(2, 0, 1)
+            ln = lab.asnumpy()
+            label[i, :ln.shape[0]] = ln
+        self._cursor += self.batch_size
+        from .io import DataBatch
+
+        return DataBatch([nd.array(data)], [nd.array(label)], pad=pad)
+
+    __next__ = next

@@ -19,6 +19,7 @@ from ..base import MXNetError, _as_np_dtype
 from .ndarray import NDArray
 
 __all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "row_sparse_from_dense",
            "zeros", "dot", "add", "retain", "cast_storage", "where_nonzero",
            "sparse_embedding_grad"]
 
@@ -244,6 +245,18 @@ def where_nonzero(arr):
     """Row indices with any nonzero (helper for building row_sparse)."""
     a = arr.asnumpy()
     return _np.where(_np.any(a.reshape(a.shape[0], -1) != 0, axis=1))[0]
+
+
+def row_sparse_from_dense(arr):
+    """Dense NDArray -> RowSparseNDArray with the mask/gather computed ON
+    DEVICE (the Trainer hot-loop path: only the small index vector syncs
+    to host, not the whole (vocab, dim) gradient)."""
+    jnp = _jnp()
+    data = arr._data if isinstance(arr, NDArray) else jnp.asarray(arr)
+    flat = data.reshape(data.shape[0], -1)
+    mask = jnp.any(flat != 0, axis=1)
+    idx = jnp.nonzero(mask)[0].astype(jnp.int32)  # eager: concrete size
+    return RowSparseNDArray(data[idx], idx, data.shape)
 
 
 def sparse_embedding_grad(grad_out, token_ids, vocab_size):

@@ -131,6 +131,26 @@ class Optimizer:
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
         return g
 
+    def _preprocess_sparse_grad(self, grad):
+        """(indices, rows) for a RowSparseNDArray grad: duplicate indices
+        segment-summed (matching the dense path's .at[].add semantics),
+        then rescale/clip — the shared front half of every lazy update."""
+        jnp = _jnp()
+        idx = grad.indices_
+        rows = grad._data.astype(jnp.float32)
+        host_idx = _np.asarray(idx)
+        uniq, inv = _np.unique(host_idx, return_inverse=True)
+        if len(uniq) != rows.shape[0]:
+            rows = jnp.zeros((len(uniq),) + rows.shape[1:],
+                             jnp.float32).at[jnp.asarray(inv)].add(rows)
+            idx = jnp.asarray(uniq.astype(_np.int32))
+        else:
+            idx = idx.astype(jnp.int32)
+        rows = rows * self.rescale_grad
+        if self.clip_gradient is not None:
+            rows = jnp.clip(rows, -self.clip_gradient, self.clip_gradient)
+        return idx, rows
+
     # ---- state ------------------------------------------------------------
     def create_state(self, index, weight):
         return None
@@ -154,8 +174,18 @@ class Optimizer:
                               str(weight.dtype) == "bfloat16")
         if use_mp and isinstance(state, tuple) and len(state) == 2 and \
                 isinstance(state[0], NDArray):
+            from ..ndarray.sparse import RowSparseNDArray
+
             master, substate = state
-            grad32 = grad.astype("float32")
+            if isinstance(grad, RowSparseNDArray):
+                # cast the packed rows only — a plain .astype would
+                # collapse the sparse handle into a (nnz, dim) dense array
+                # and lose the indices
+                grad32 = RowSparseNDArray(
+                    grad._data.astype(_jnp().float32), grad.indices_,
+                    grad._shape)
+            else:
+                grad32 = grad.astype("float32")
             self.update(index, master, grad32, substate)
             weight._data = master._data.astype(weight._data.dtype)
         else:
@@ -176,10 +206,11 @@ class SGD(Optimizer):
     """SGD w/ momentum (reference optimizer/sgd.py; multi-precision at
     sgd.py:96-106)."""
 
-    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=True,
                  **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.momentum = momentum
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -187,7 +218,31 @@ class SGD(Optimizer):
         return _zeros_like(weight)
 
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+
         jnp = _jnp()
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            # row_sparse lazy update (reference sgd.py lazy_update=True +
+            # sgd_update kernel over grad.indices only): weight/momentum
+            # rows NOT touched by the gradient are left untouched — the
+            # big-embedding update cost scales with touched rows, not
+            # vocab size
+            self._update_count(index)
+            lr, wd = self._get_lr(index), self._get_wd(index)
+            idx, g = self._preprocess_sparse_grad(grad)
+            w_rows = weight._data[idx].astype(jnp.float32)
+            g = g + wd * w_rows
+            if state is not None:
+                mom_rows = state._data[idx] * self.momentum - lr * g
+                state._data = state._data.at[idx].set(mom_rows)
+                new_rows = w_rows + mom_rows
+            else:
+                new_rows = w_rows - lr * g
+            weight._data = weight._data.at[idx].set(
+                new_rows.astype(weight._data.dtype))
+            return
+        if isinstance(grad, RowSparseNDArray):
+            grad = grad.tostype("default")
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         g = self._preprocess_grad(grad)
@@ -225,15 +280,42 @@ class NAG(SGD):
 @register
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, lazy_update=False, **kwargs):
+                 epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return (_zeros_like(weight), _zeros_like(weight))
 
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+
         jnp = _jnp()
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            # row_sparse lazy Adam (reference adam_update FComputeEx for
+            # kRowSparseStorage): m/v rows for untouched indices keep
+            # their values and skip the bias-corrected step entirely
+            self._update_count(index)
+            lr, wd = self._get_lr(index), self._get_wd(index)
+            t = self._index_update_count[index]
+            idx, g = self._preprocess_sparse_grad(grad)
+            w_rows = weight._data[idx].astype(jnp.float32)
+            g = g + wd * w_rows
+            m, v = state
+            m_rows = self.beta1 * m._data[idx] + (1 - self.beta1) * g
+            v_rows = self.beta2 * v._data[idx] + \
+                (1 - self.beta2) * jnp.square(g)
+            m._data = m._data.at[idx].set(m_rows)
+            v._data = v._data.at[idx].set(v_rows)
+            mhat = m_rows / (1 - self.beta1 ** t)
+            vhat = v_rows / (1 - self.beta2 ** t)
+            new_rows = w_rows - lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
+            weight._data = weight._data.at[idx].set(
+                new_rows.astype(weight._data.dtype))
+            return
+        if isinstance(grad, RowSparseNDArray):
+            grad = grad.tostype("default")
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         t = self._index_update_count[index]

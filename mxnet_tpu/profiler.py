@@ -17,8 +17,9 @@ import time
 from .base import MXNetError, get_env
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
-           "resume", "Domain", "Task", "Frame", "Event", "Counter", "Marker",
-           "profiler_set_config", "profiler_set_state"]
+           "resume", "device_op_stats", "memory_info", "Domain", "Task",
+           "Frame", "Event", "Counter", "Marker", "profiler_set_config",
+           "profiler_set_state"]
 
 _config = {
     "filename": "profile.json",
@@ -92,7 +93,8 @@ def dump(finished=True, profile_process="worker"):
 
 def dumps(reset=False, format="table", sort_by="total", ascending=False):
     """Aggregate stats string (reference profiler.py:154 + aggregate_
-    stats.cc)."""
+    stats.cc): user span aggregates, plus the device-op table when a
+    trace was captured and aggregate_stats is enabled."""
     by_name = {}
     for ev in _state["events"]:
         agg = by_name.setdefault(ev["name"], [0, 0.0])
@@ -102,9 +104,80 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
     for name, (calls, total) in sorted(by_name.items(),
                                        key=lambda kv: -kv[1][1]):
         lines.append("%-40s %8d %12.3f" % (name, calls, total * 1e3))
+    if _config.get("aggregate_stats") and _state.get("trace_dir"):
+        dev = device_op_stats()
+        if dev:
+            lines.append("")
+            lines.append("%-48s %8s %12s" % ("Device op category",
+                                             "Count", "Time(ms)"))
+            for row in dev:
+                lines.append("%-48s %8d %12.3f" % (
+                    row["name"][:48], row["occurrences"],
+                    row["time_ms"]))
     if reset:
         _state["events"].clear()
     return "\n".join(lines)
+
+
+def device_op_stats(trace_dir=None, top=25):
+    """Aggregate device-op table from the captured xplane (reference
+    aggregate_stats.cc tables, rebuilt from the XLA profiler's data).
+
+    Returns [{name, occurrences, time_ms}, ...] sorted by time, or [] if
+    no trace/parser is available (xprof/tensorboard-plugin-profile parses
+    the xplane)."""
+    import glob
+
+    trace_dir = trace_dir or _state.get("trace_dir")
+    if not trace_dir:
+        return []
+    files = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins", "profile", "*", "*.xplane.pb")))
+    if not files:
+        return []
+    try:
+        from xprof.convert import raw_to_tool_data as _rtd
+
+        out, _ = _rtd.xspace_to_tool_data(files[-1:], "op_profile", {})
+        data = json.loads(out.decode() if isinstance(out, bytes) else out)
+    except Exception:
+        return []
+    rows = []
+
+    def walk(node, depth):
+        m = node.get("metrics", {})
+        if depth == 2 and m.get("rawTime"):
+            rows.append({"name": node.get("name", "?"),
+                         "occurrences": int(m.get("occurrences", 0)),
+                         "time_ms": m["rawTime"] / 1e9})
+        for c in node.get("children", []):
+            walk(c, depth + 1)
+
+    root = data.get("byCategory") or data.get("byProgram") or {}
+    walk(root, 0)
+    rows.sort(key=lambda r: -r["time_ms"])
+    return rows[:top]
+
+
+def memory_info(device=None):
+    """Device memory profiler (reference storage_profiler.cc GPU memory
+    stats): per-device bytes in use / peak / limit from PJRT.  Backends
+    without memory_stats (CPU) report {}."""
+    import jax
+
+    devices = [device] if device is not None else jax.local_devices()
+    report = {}
+    for d in devices:
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            stats = {}
+        report[str(d)] = {
+            k: stats[k] for k in (
+                "bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                "largest_alloc_size", "num_allocs")
+            if k in stats}
+    return report
 
 
 class Domain:

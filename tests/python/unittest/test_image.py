@@ -1,0 +1,83 @@
+"""mx.image tests: core utilities + detection augmenters (reference
+image/detection.py — previously untested module per round-2 VERDICT)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as mximg
+from mxnet_tpu import nd
+
+
+def _img(h=32, w=48):
+    rs = np.random.RandomState(0)
+    return (rs.rand(h, w, 3) * 255).astype(np.uint8)
+
+
+def _label():
+    # [cls, x1, y1, x2, y2] normalized
+    return np.array([[0, 0.1, 0.2, 0.5, 0.6],
+                     [1, 0.6, 0.5, 0.9, 0.9]], np.float32)
+
+
+def test_imresize_and_crops():
+    src = nd.array(_img(), dtype="uint8")
+    out = mximg.imresize(src, 16, 24)
+    assert out.shape == (24, 16, 3)
+    c = mximg.center_crop(src, (16, 16))
+    c = c[0] if isinstance(c, tuple) else c
+    assert c.shape[0] == 16 and c.shape[1] == 16
+
+
+def test_det_horizontal_flip_moves_boxes():
+    np.random.seed(0)
+    aug = mximg.DetHorizontalFlipAug(p=1.0)
+    src = nd.array(_img(), dtype="uint8")
+    img2, lab2 = aug(src, nd.array(_label()))
+    l0, l2 = _label(), lab2.asnumpy()
+    # x mirrored: new x1 = 1 - old x2
+    assert np.allclose(l2[:, 1], 1.0 - l0[:, 3], atol=1e-6)
+    assert np.allclose(l2[:, 3], 1.0 - l0[:, 1], atol=1e-6)
+    # y untouched; image actually mirrored
+    assert np.allclose(l2[:, 2], l0[:, 2])
+    assert np.allclose(img2.asnumpy(), _img()[:, ::-1])
+
+
+def test_det_random_crop_keeps_normalized_boxes():
+    np.random.seed(1)
+    aug = mximg.DetRandomCropAug(min_object_covered=0.1,
+                                 min_crop_scale=0.5)
+    src = nd.array(_img(64, 64), dtype="uint8")
+    img2, lab2 = aug(src, nd.array(_label()))
+    l2 = lab2.asnumpy()
+    kept = l2[l2[:, 0] >= 0]
+    if len(kept):
+        assert np.all(kept[:, 1:5] >= -1e-6)
+        assert np.all(kept[:, 1:5] <= 1 + 1e-6)
+    assert img2.shape[2] == 3
+
+
+def test_det_random_pad_shrinks_boxes():
+    np.random.seed(2)
+    aug = mximg.DetRandomPadAug(max_pad_scale=2.0)
+    src = nd.array(_img(32, 32), dtype="uint8")
+    img2, lab2 = aug(src, nd.array(_label()))
+    l0, l2 = _label(), lab2.asnumpy()
+    w2 = l2[:, 3] - l2[:, 1]
+    w0 = l0[:, 3] - l0[:, 1]
+    assert np.all(w2 <= w0 + 1e-6)  # padding can only shrink boxes
+
+
+def test_image_det_iter_batches():
+    np.random.seed(3)
+    images = [_img(40, 40) for _ in range(6)]
+    labels = [_label() for _ in range(6)]
+    augs = mximg.CreateDetAugmenter((3, 32, 32), rand_mirror=True,
+                                    rand_crop=0.5, rand_pad=0.5)
+    it = mximg.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                            images=images, labels=labels, aug_list=augs,
+                            shuffle=True)
+    batches = list(it)
+    assert len(batches) == 3
+    for b in batches:
+        assert b.data[0].shape == (2, 3, 32, 32)
+        assert b.label[0].shape[0] == 2 and b.label[0].shape[2] == 5

@@ -1,0 +1,75 @@
+"""mx.profiler tests (reference tests/python/unittest/test_profiler.py —
+set_config/set_state lifecycle, Task/Frame/Counter/Marker objects, dumps
+aggregates; plus the TPU-native device_op_stats/memory_info additions)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, profiler
+
+
+def test_profiler_lifecycle_and_dump(tmp_path):
+    fname = str(tmp_path / "profile.json")
+    profiler.set_config(filename=fname, aggregate_stats=True)
+    assert profiler.state() == "stop"
+    profiler.set_state("run")
+    assert profiler.state() == "run"
+    a = nd.array(np.random.rand(64, 64).astype(np.float32))
+    with profiler.Task(profiler.Domain("test"), "mm"):
+        b = nd.dot(a, a)
+        float(b.asnumpy().sum())
+    profiler.set_state("stop")
+    out = profiler.dump()
+    assert out == fname and os.path.exists(fname)
+    with open(fname) as f:
+        trace = json.load(f)
+    names = [ev["name"] for ev in trace["traceEvents"]]
+    assert "mm" in names
+
+
+def test_profiler_spans_counters_markers():
+    dom = profiler.Domain("d")
+    task = dom.new_task("t")
+    task.start()
+    task.stop()
+    frame = dom.new_frame("f")
+    with frame:
+        pass
+    ev = dom.new_event("e")
+    with ev:
+        pass
+    c = dom.new_counter("ctr", 5)
+    c += 3
+    c -= 1
+    assert c.value == 7
+    dom.new_marker("mk").mark()
+    table = profiler.dumps()
+    assert "t" in table and "Calls" in table
+
+
+def test_profiler_invalid_state():
+    with pytest.raises(mx.MXNetError):
+        profiler.set_state("bogus")
+
+
+def test_device_op_stats_shape(tmp_path):
+    """device_op_stats returns a (possibly empty on CPU) list of
+    {name, occurrences, time_ms} rows without error."""
+    fname = str(tmp_path / "p.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    a = nd.array(np.random.rand(128, 128).astype(np.float32))
+    float(nd.dot(a, a).asnumpy().sum())
+    profiler.set_state("stop")
+    rows = profiler.device_op_stats()
+    assert isinstance(rows, list)
+    for r in rows:
+        assert set(r) == {"name", "occurrences", "time_ms"}
+
+
+def test_memory_info_shape():
+    report = profiler.memory_info()
+    assert report and all(isinstance(v, dict) for v in report.values())

@@ -5,6 +5,7 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
+from mxnet_tpu import nd
 from mxnet_tpu.ndarray import sparse as sp
 
 
@@ -140,3 +141,129 @@ def test_libsvm_iter(tmp_path):
         it.next()
     it.reset()
     assert it.next().pad == 0
+
+
+# ---- row_sparse lazy optimizer path (reference parameter.py:90-136 +
+# sgd.py lazy_update / adam FComputeEx kRowSparseStorage) -------------------
+
+def test_sgd_lazy_update_touches_only_grad_rows():
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.ndarray.sparse import row_sparse_array
+
+    w = nd.array(np.ones((6, 3), np.float32))
+    mom_opt = opt.SGD(learning_rate=0.5, momentum=0.9, lazy_update=True)
+    state = mom_opt.create_state(0, w)
+    g = row_sparse_array((np.full((2, 3), 1.0, np.float32), [1, 4]),
+                         shape=(6, 3))
+    mom_opt.update(0, w, g, state)
+    out = w.asnumpy()
+    # untouched rows unchanged, touched rows stepped
+    for r in (0, 2, 3, 5):
+        assert np.allclose(out[r], 1.0), out[r]
+    for r in (1, 4):
+        assert np.allclose(out[r], 0.5), out[r]  # 1 - lr*1
+    # momentum state for untouched rows remains zero
+    st = state.asnumpy()
+    assert np.allclose(st[[0, 2, 3, 5]], 0.0)
+    assert not np.allclose(st[[1, 4]], 0.0)
+
+
+def test_adam_lazy_matches_dense_on_touched_rows():
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.ndarray.sparse import row_sparse_array
+
+    rs = np.random.RandomState(0)
+    w0 = rs.rand(5, 4).astype(np.float32)
+    grows = rs.rand(2, 4).astype(np.float32)
+    idx = [0, 3]
+    dense_g = np.zeros((5, 4), np.float32)
+    dense_g[idx] = grows
+
+    w_lazy = nd.array(w0.copy())
+    o1 = opt.Adam(learning_rate=0.1, lazy_update=True)
+    s1 = o1.create_state(0, w_lazy)
+    o1.update(0, w_lazy, row_sparse_array((grows, idx), shape=(5, 4)), s1)
+
+    w_dense = nd.array(w0.copy())
+    o2 = opt.Adam(learning_rate=0.1, lazy_update=False)
+    s2 = o2.create_state(0, w_dense)
+    o2.update(0, w_dense, nd.array(dense_g), s2)
+
+    a, b = w_lazy.asnumpy(), w_dense.asnumpy()
+    # touched rows match the dense update exactly
+    assert np.allclose(a[idx], b[idx], rtol=1e-6), (a[idx], b[idx])
+    # untouched rows: lazy keeps them frozen; dense Adam moves them only
+    # via bias-corrected zero-grad (they stay equal since m=v=0 -> 0 step)
+    assert np.allclose(a, b, rtol=1e-6)
+
+
+def test_trainer_row_sparse_grad_end_to_end():
+    """Embedding with grad_stype='row_sparse': Trainer compresses the
+    dense backward grad and the optimizer updates only live rows."""
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    embed = nn.Embedding(50, 8)
+    embed.initialize()
+    embed.weight.grad_stype = "row_sparse"
+    before = embed.weight.data().asnumpy().copy()
+    trainer = gluon.Trainer(embed.collect_params(), "sgd",
+                            {"learning_rate": 1.0})
+    tokens = nd.array(np.array([1, 3, 3, 7], np.int32))
+    with autograd.record():
+        out = embed(tokens)
+        loss = nd.sum(out * out)
+    loss.backward()
+    trainer.step(1)
+    after = embed.weight.data().asnumpy()
+    changed = np.where(np.any(before != after, axis=1))[0].tolist()
+    assert changed == [1, 3, 7], changed
+
+
+def test_lazy_update_duplicate_indices_sum():
+    """Duplicate row indices must segment-sum like the dense .at[].add
+    path, not last-write-wins."""
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.ndarray.sparse import row_sparse_array
+
+    w = nd.array(np.ones((4, 2), np.float32))
+    o = opt.SGD(learning_rate=1.0, momentum=0.0, lazy_update=True)
+    g = row_sparse_array((np.array([[1., 1.], [2., 2.], [4., 4.]],
+                                   np.float32), [2, 1, 2]), shape=(4, 2))
+    o.update(0, w, g, None)
+    out = w.asnumpy()
+    assert np.allclose(out[1], 1 - 2.0)       # single row
+    assert np.allclose(out[2], 1 - (1 + 4.0))  # summed duplicates
+    assert np.allclose(out[[0, 3]], 1.0)
+
+
+def test_trainer_dense_grad_for_non_lazy_optimizer():
+    """row_sparse grad_stype with an optimizer lacking a sparse rule must
+    keep the dense path (no crash, correct update)."""
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(4)
+    embed = nn.Embedding(20, 4)
+    embed.initialize()
+    embed.weight.grad_stype = "row_sparse"
+    trainer = gluon.Trainer(embed.collect_params(), "adagrad",
+                            {"learning_rate": 0.5})
+    toks = nd.array(np.array([2, 5], np.int32))
+    with autograd.record():
+        loss = nd.sum(embed(toks) ** 2)
+    loss.backward()
+    trainer.step(1)  # must not crash
+    assert np.isfinite(embed.weight.data().asnumpy()).all()
+
+
+def test_row_sparse_from_dense_device_path():
+    from mxnet_tpu.ndarray.sparse import row_sparse_from_dense
+
+    dense = np.zeros((5, 3), np.float32)
+    dense[1] = 1.0
+    dense[4] = 2.0
+    rs_arr = row_sparse_from_dense(nd.array(dense))
+    assert rs_arr.indices.asnumpy().tolist() == [1, 4]
+    assert np.allclose(rs_arr.tostype("default").asnumpy(), dense)
