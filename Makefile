@@ -9,7 +9,7 @@ LDLIBS ?= -ljpeg -lz
 SRCS := $(wildcard src/native/*.cc)
 SO := build/libmxtpu_native.so
 
-.PHONY: native test clean
+.PHONY: native test cpptest clean
 
 native: $(SO)
 
@@ -17,7 +17,16 @@ $(SO): $(SRCS) $(wildcard src/native/*.h)
 	@mkdir -p build
 	$(CXX) $(CXXFLAGS) -shared $(SRCS) -o $@ $(LDLIBS)
 
-test: native
+# in-process C++ unit tests (reference tests/cpp/ engine/storage suites)
+CPPTEST := build/test_native
+cpptest: $(CPPTEST)
+	$(CPPTEST)
+
+$(CPPTEST): tests/cpp/test_native_main.cc $(SRCS) $(wildcard src/native/*.h)
+	@mkdir -p build
+	$(CXX) $(CXXFLAGS) tests/cpp/test_native_main.cc $(SRCS) -o $@ $(LDLIBS)
+
+test: native cpptest
 	python -m pytest tests/ -q
 
 clean:

@@ -78,4 +78,5 @@ from . import operator  # noqa: E402  (mx.operator CustomOp API)
 from . import library  # noqa: E402  (extension .so loading)
 from . import image  # noqa: E402
 from . import elastic  # noqa: E402  (failure detection + auto-resume)
+from . import config  # noqa: E402  (env-var registry, reference env_var.md)
 from .util import is_np_array, set_np, reset_np, use_np  # noqa: E402

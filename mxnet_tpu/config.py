@@ -1,0 +1,87 @@
+"""Environment-variable configuration registry.
+
+Reference: the ~102 documented ``MXNET_*`` env vars
+(/root/reference/docs/static_site/src/pages/api/faq/env_var.md) read via
+``dmlc::GetEnv`` across the codebase.  The TPU-native runtime needs far
+fewer knobs (XLA owns scheduling/fusion/memory planning), but the ones
+that DO exist are declared here in one typed registry — so ``mx.config.
+describe()`` is the env_var.md equivalent and unknown ``MXNET_*`` vars
+can be flagged instead of silently ignored.
+"""
+from __future__ import annotations
+
+import os
+
+from .base import get_env
+
+__all__ = ["ENV_VARS", "describe", "current", "check_unknown"]
+
+# name -> (type, default, doc)
+ENV_VARS = {
+    "MXNET_HOME": (
+        str, "~/.mxnet",
+        "Cache root for pretrained weights and datasets "
+        "(model_zoo/model_store.py; reference base.data_dir())."),
+    "MXNET_ENGINE_TYPE": (
+        str, "ThreadedEnginePerDevice",
+        "Accepted for reference compatibility (engine.py facade); device "
+        "scheduling is XLA/PJRT's regardless."),
+    "MXNET_KVSTORE_BUCKET_BYTES": (
+        int, 4 << 20,
+        "Collective kvstore gradient-fusion bucket size in bytes "
+        "(kvstore/collective.py; replaces MXNET_KVSTORE_BIGARRAY_BOUND)."),
+    "MXNET_TPU_NO_NATIVE": (
+        bool, False,
+        "Disable the C++ native host runtime (pure-python fallbacks for "
+        "recordio/jpeg/loader)."),
+    "MXNET_DIST_COORDINATOR": (
+        str, None,
+        "host:port rendezvous address; set by tools/launch.py — presence "
+        "triggers jax.distributed.initialize at import."),
+    "MXNET_DIST_NUM_WORKERS": (
+        int, None, "World size for the process group (tools/launch.py)."),
+    "MXNET_DIST_RANK": (
+        int, None, "This process's rank (tools/launch.py)."),
+    "MXNET_DIST_STRIP_AXON": (
+        bool, False,
+        "Remove PJRT-plugin sitecustomize dirs from child import paths "
+        "(CPU multi-process CI mode)."),
+    "MXNET_PROFILER_AUTOSTART": (
+        bool, False,
+        "Start the profiler at import (reference env_var.md)."),
+    "MXNET_STORAGE_FALLBACK_LOG_VERBOSE": (
+        bool, False,
+        "Log when a sparse op densifies (the storage-fallback path, "
+        "ndarray/sparse.py)."),
+}
+
+
+def describe():
+    """Human-readable table of every supported env var (the env_var.md
+    equivalent)."""
+    lines = ["%-38s %-8s %-22s %s" % ("Variable", "Type", "Default", "Doc")]
+    for name, (typ, default, doc) in sorted(ENV_VARS.items()):
+        lines.append("%-38s %-8s %-22s %s"
+                     % (name, typ.__name__, repr(default), doc))
+    return "\n".join(lines)
+
+
+def current():
+    """{name: effective value} for every registered var."""
+    return {name: get_env(name, typ, default)
+            for name, (typ, default, _doc) in ENV_VARS.items()}
+
+
+def check_unknown(warn=True):
+    """Return MXNET_* vars set in the environment but NOT registered —
+    typo'd or reference-only knobs that silently do nothing here."""
+    unknown = sorted(k for k in os.environ
+                     if k.startswith("MXNET_") and k not in ENV_VARS)
+    if unknown and warn:
+        import warnings
+
+        warnings.warn(
+            "unrecognized MXNET_* environment variables (no effect in "
+            "mxnet_tpu): %s — see mxnet_tpu.config.describe()" % unknown,
+            stacklevel=2)
+    return unknown

@@ -1,0 +1,191 @@
+// In-process C++ unit tests for the native host runtime
+// (reference tests/cpp/: engine/threaded_engine_test.cc ordering +
+// shutdown semantics, storage/storage_test.cc pool reuse — rebuilt as an
+// assert-based standalone binary: `make cpptest`).
+//
+// Exercises the SAME extern "C" surface the ctypes bindings use, but
+// in-process with real C function pointers and cross-thread hazards that
+// are awkward to express from Python.
+#include <atomic>
+#include <cassert>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* MXTEngineCreate(int num_workers);
+int64_t MXTEngineNewVar(void* h);
+int MXTEnginePushAsync(void* h, int (*fn)(void*), void* arg,
+                       const int64_t* const_vars, int n_const,
+                       const int64_t* mutable_vars, int n_mutable,
+                       int priority);
+int MXTEngineWaitForVar(void* h, int64_t var_id);
+void MXTEngineWaitAll(void* h);
+int64_t MXTEnginePending(void* h);
+void MXTEngineDestroy(void* h);
+
+void* MXTPoolCreate(uint64_t max_cached_bytes, uint64_t alignment);
+void* MXTPoolAlloc(void* handle, uint64_t size);
+void MXTPoolFree(void* handle, void* ptr, uint64_t size);
+void MXTPoolStats(void* handle, uint64_t* out5);
+void MXTPoolRelease(void* handle);
+void MXTPoolDestroy(void* handle);
+
+void* MXTRecordWriterCreate(const char* path);
+int MXTRecordWriterWrite(void* handle, const uint8_t* data, uint64_t len);
+int MXTRecordWriterClose(void* handle);
+void* MXTRecordReaderCreate(const char* path);
+int64_t MXTRecordReaderNext(void* handle, const uint8_t** out);
+int MXTRecordReaderClose(void* handle);
+}
+
+#define CHECK(cond)                                                     \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      std::fprintf(stderr, "FAILED %s:%d: %s\n", __FILE__, __LINE__,    \
+                   #cond);                                              \
+      return 1;                                                         \
+    }                                                                   \
+  } while (0)
+
+namespace {
+
+// ---- engine: RAW/WAR/WAW hazard ordering --------------------------------
+struct AppendArg {
+  std::vector<int>* log;
+  std::mutex* mu;
+  int value;
+  int sleep_ms;
+};
+
+int append_fn(void* p) {
+  auto* a = static_cast<AppendArg*>(p);
+  if (a->sleep_ms)
+    std::this_thread::sleep_for(std::chrono::milliseconds(a->sleep_ms));
+  std::lock_guard<std::mutex> lk(*a->mu);
+  a->log->push_back(a->value);
+  return 0;
+}
+
+int test_engine_hazard_order() {
+  void* eng = MXTEngineCreate(4);
+  std::vector<int> log;
+  std::mutex mu;
+  int64_t var = MXTEngineNewVar(eng);
+  // three writers on ONE var: must run in push order despite sleeps
+  AppendArg a{&log, &mu, 1, 30}, b{&log, &mu, 2, 10}, c{&log, &mu, 3, 0};
+  CHECK(MXTEnginePushAsync(eng, append_fn, &a, nullptr, 0, &var, 1, 0) == 0);
+  CHECK(MXTEnginePushAsync(eng, append_fn, &b, nullptr, 0, &var, 1, 0) == 0);
+  CHECK(MXTEnginePushAsync(eng, append_fn, &c, nullptr, 0, &var, 1, 0) == 0);
+  CHECK(MXTEngineWaitForVar(eng, var) == 0);
+  CHECK(log.size() == 3);
+  CHECK(log[0] == 1 && log[1] == 2 && log[2] == 3);
+  MXTEngineDestroy(eng);
+  return 0;
+}
+
+std::atomic<int> g_readers_running{0};
+std::atomic<int> g_max_parallel_readers{0};
+std::atomic<bool> g_writer_ran{false};
+std::atomic<bool> g_reader_saw_writer{false};
+
+int reader_fn(void*) {
+  int cur = ++g_readers_running;
+  int prev = g_max_parallel_readers.load();
+  while (cur > prev &&
+         !g_max_parallel_readers.compare_exchange_weak(prev, cur)) {
+  }
+  if (g_writer_ran.load()) g_reader_saw_writer = true;
+  std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  --g_readers_running;
+  return 0;
+}
+
+int writer_fn(void*) {
+  // WAR: must not run while any reader holds the var
+  if (g_readers_running.load() != 0) return 1;
+  g_writer_ran = true;
+  return 0;
+}
+
+int test_engine_parallel_reads_exclusive_write() {
+  void* eng = MXTEngineCreate(4);
+  int64_t var = MXTEngineNewVar(eng);
+  for (int i = 0; i < 4; ++i)
+    CHECK(MXTEnginePushAsync(eng, reader_fn, nullptr, &var, 1, nullptr, 0,
+                             0) == 0);
+  CHECK(MXTEnginePushAsync(eng, writer_fn, nullptr, nullptr, 0, &var, 1,
+                           0) == 0);
+  MXTEngineWaitAll(eng);
+  CHECK(MXTEnginePending(eng) == 0);
+  CHECK(g_max_parallel_readers.load() >= 2);  // reads overlapped
+  CHECK(g_writer_ran.load());                 // write ran after reads
+  CHECK(!g_reader_saw_writer.load());         // no read saw the write
+  MXTEngineDestroy(eng);
+  return 0;
+}
+
+// ---- storage: pooled allocator reuse + stats ----------------------------
+int test_pool_reuse_and_stats() {
+  void* pool = MXTPoolCreate(1 << 20, 64);
+  void* p1 = MXTPoolAlloc(pool, 1000);
+  CHECK(p1 != nullptr);
+  CHECK((reinterpret_cast<uintptr_t>(p1) % 64) == 0);
+  std::memset(p1, 0xAB, 1000);
+  MXTPoolFree(pool, p1, 1000);
+  void* p2 = MXTPoolAlloc(pool, 900);  // same bucket: must be recycled
+  CHECK(p2 == p1);
+  uint64_t s[5];
+  MXTPoolStats(pool, s);
+  CHECK(s[3] == 1);  // one hit
+  CHECK(s[4] >= 1);  // at least one miss
+  CHECK(s[2] >= 1024);  // peak covers the bucketed alloc
+  MXTPoolFree(pool, p2, 900);
+  MXTPoolRelease(pool);
+  MXTPoolStats(pool, s);
+  CHECK(s[1] == 0);  // cache drained
+  MXTPoolDestroy(pool);
+  return 0;
+}
+
+// ---- recordio: wire-format roundtrip ------------------------------------
+int test_recordio_roundtrip() {
+  const char* path = "/tmp/mxt_cpptest.rec";
+  void* w = MXTRecordWriterCreate(path);
+  CHECK(w != nullptr);
+  const char* msgs[3] = {"alpha", "bb", "record-three"};
+  for (const char* m : msgs)
+    CHECK(MXTRecordWriterWrite(w, reinterpret_cast<const uint8_t*>(m),
+                               std::strlen(m)) == 0);
+  CHECK(MXTRecordWriterClose(w) == 0);
+  void* r = MXTRecordReaderCreate(path);
+  CHECK(r != nullptr);
+  for (const char* m : msgs) {
+    const uint8_t* buf = nullptr;
+    int64_t len = MXTRecordReaderNext(r, &buf);
+    CHECK(len == static_cast<int64_t>(std::strlen(m)));
+    CHECK(std::memcmp(buf, m, len) == 0);
+  }
+  const uint8_t* buf = nullptr;
+  CHECK(MXTRecordReaderNext(r, &buf) == 0);  // EOF
+  CHECK(MXTRecordReaderClose(r) == 0);
+  std::remove(path);
+  return 0;
+}
+
+}  // namespace
+
+int main() {
+  int rc = 0;
+  rc |= test_engine_hazard_order();
+  rc |= test_engine_parallel_reads_exclusive_write();
+  rc |= test_pool_reuse_and_stats();
+  rc |= test_recordio_roundtrip();
+  if (rc == 0) std::printf("ALL C++ NATIVE TESTS PASSED\n");
+  return rc;
+}

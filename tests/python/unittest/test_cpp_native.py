@@ -1,0 +1,21 @@
+"""Builds and runs the in-process C++ native test binary (reference
+tests/cpp/ engine/storage googletest suites — here an assert-based main,
+tests/cpp/test_native_main.cc, exercising hazard ordering, pooled
+allocation, and the RecordIO wire format from C++)."""
+import os
+import shutil
+import subprocess
+
+import pytest
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                     "..", "..", ".."))
+
+
+def test_cpp_native_suite():
+    if shutil.which("g++") is None or shutil.which("make") is None:
+        pytest.skip("no C++ toolchain")
+    proc = subprocess.run(["make", "cpptest"], cwd=_REPO,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "ALL C++ NATIVE TESTS PASSED" in proc.stdout

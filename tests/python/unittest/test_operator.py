@@ -204,3 +204,20 @@ def test_optimize_for_rejects_unknown_backend():
     sym_y.optimize_for("XLA")  # known: no-op
     with pytest.raises(mx.MXNetError, match="unknown partitioning"):
         sym_y.optimize_for("MKLDNN")
+
+
+def test_config_env_registry():
+    import os
+
+    import mxnet_tpu as mx
+
+    table = mx.config.describe()
+    assert "MXNET_KVSTORE_BUCKET_BYTES" in table
+    cur = mx.config.current()
+    assert cur["MXNET_KVSTORE_BUCKET_BYTES"] == 4 << 20
+    os.environ["MXNET_TYPO_VAR"] = "1"
+    try:
+        unknown = mx.config.check_unknown(warn=False)
+        assert "MXNET_TYPO_VAR" in unknown
+    finally:
+        del os.environ["MXNET_TYPO_VAR"]
