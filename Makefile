@@ -26,7 +26,8 @@ $(CPPTEST): tests/cpp/test_native_main.cc $(SRCS) $(wildcard src/native/*.h)
 	@mkdir -p build
 	$(CXX) $(CXXFLAGS) tests/cpp/test_native_main.cc $(SRCS) -o $@ $(LDLIBS)
 
-test: native cpptest
+# cpptest runs inside the pytest suite (test_cpp_native.py)
+test: native
 	python -m pytest tests/ -q
 
 clean:

@@ -33,7 +33,8 @@ def _maybe_init_distributed():
     coord = os.environ.get("MXNET_DIST_COORDINATOR")
     if not coord:
         return
-    if os.environ.get("MXNET_DIST_STRIP_AXON"):
+    strip = os.environ.get("MXNET_DIST_STRIP_AXON", "")
+    if strip.lower() not in ("", "0", "false", "off", "no"):
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
     import jax
@@ -79,4 +80,7 @@ from . import library  # noqa: E402  (extension .so loading)
 from . import image  # noqa: E402
 from . import elastic  # noqa: E402  (failure detection + auto-resume)
 from . import config  # noqa: E402  (env-var registry, reference env_var.md)
+
+if base.get_env("MXNET_PROFILER_AUTOSTART", bool, False):
+    profiler.set_state("run")  # reference env_var.md MXNET_PROFILER_AUTOSTART
 from .util import is_np_array, set_np, reset_np, use_np  # noqa: E402

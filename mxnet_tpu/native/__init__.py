@@ -62,7 +62,9 @@ def _load():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if os.environ.get("MXNET_TPU_NO_NATIVE"):
+        from ..base import get_env
+
+        if get_env("MXNET_TPU_NO_NATIVE", bool, False):
             return None
         try:
             if _needs_build():

@@ -30,6 +30,19 @@ def _jnp():
     return jnp
 
 
+def _log_storage_fallback(stype, shape):
+    """MXNET_STORAGE_FALLBACK_LOG_VERBOSE (reference env_var.md): announce
+    sparse->dense fallbacks so silent densification is debuggable."""
+    from ..base import get_env
+
+    if get_env("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", bool, False):
+        import logging
+
+        logging.getLogger("mxnet_tpu").warning(
+            "storage fallback: densifying %s array of shape %s", stype,
+            shape)
+
+
 class BaseSparseNDArray(NDArray):
     __slots__ = ("indices_", "indptr_", "_shape")
 
@@ -67,6 +80,7 @@ class RowSparseNDArray(BaseSparseNDArray):
             return self
         if stype != "default":
             raise MXNetError("cast_storage row_sparse->%s unsupported" % stype)
+        _log_storage_fallback("row_sparse", self._shape)
         jnp = _jnp()
         dense = jnp.zeros(self._shape, self._data.dtype)
         idx = self.indices_.astype(jnp.int32)
@@ -126,6 +140,7 @@ class CSRNDArray(BaseSparseNDArray):
             return self
         if stype != "default":
             raise MXNetError("cast_storage csr->%s unsupported" % stype)
+        _log_storage_fallback("csr", self._shape)
         jnp = _jnp()
         m, n = self._shape
         indptr = _np.asarray(self.indptr_)

@@ -155,7 +155,8 @@ int test_pool_reuse_and_stats() {
 
 // ---- recordio: wire-format roundtrip ------------------------------------
 int test_recordio_roundtrip() {
-  const char* path = "/tmp/mxt_cpptest.rec";
+  const char* path = "build/mxt_cpptest.rec";
+  std::remove(path);
   void* w = MXTRecordWriterCreate(path);
   CHECK(w != nullptr);
   const char* msgs[3] = {"alpha", "bb", "record-three"};

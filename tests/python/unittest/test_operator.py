@@ -206,18 +206,15 @@ def test_optimize_for_rejects_unknown_backend():
         sym_y.optimize_for("MKLDNN")
 
 
-def test_config_env_registry():
-    import os
-
+def test_config_env_registry(monkeypatch):
     import mxnet_tpu as mx
 
     table = mx.config.describe()
     assert "MXNET_KVSTORE_BUCKET_BYTES" in table
-    cur = mx.config.current()
-    assert cur["MXNET_KVSTORE_BUCKET_BYTES"] == 4 << 20
-    os.environ["MXNET_TYPO_VAR"] = "1"
-    try:
-        unknown = mx.config.check_unknown(warn=False)
-        assert "MXNET_TYPO_VAR" in unknown
-    finally:
-        del os.environ["MXNET_TYPO_VAR"]
+    monkeypatch.delenv("MXNET_KVSTORE_BUCKET_BYTES", raising=False)
+    assert mx.config.current()["MXNET_KVSTORE_BUCKET_BYTES"] == 4 << 20
+    monkeypatch.setenv("MXNET_KVSTORE_BUCKET_BYTES", "8388608")
+    assert mx.config.current()["MXNET_KVSTORE_BUCKET_BYTES"] == 8388608
+    monkeypatch.setenv("MXNET_TYPO_VAR", "1")
+    unknown = mx.config.check_unknown(warn=False)
+    assert "MXNET_TYPO_VAR" in unknown
