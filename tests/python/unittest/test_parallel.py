@@ -439,3 +439,24 @@ def test_zero_warns_when_nothing_shards():
         warnings.simplefilter("always")
         tr.step(X, Y)
     assert any("zero=True had no effect" in str(x.message) for x in w)
+
+
+def test_pipeline_transformer_stack():
+    """GPipe over transformer encoder cells: rank-3 (B,T,C) activations
+    flow through the padded boundary buffers; loss decreases."""
+    mesh = _mesh_or_skip({"pp": 2})
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    for _ in range(4):
+        net.add(nn.TransformerEncoderCell(16, 32, 4, dropout=0.0))
+    net.add(nn.Dense(8, flatten=False, in_units=16))
+    net.initialize()
+    tr = parallel.PipelineTrainer(
+        net, loss_fn=lambda outs, y: ((outs[0] - y) ** 2).mean(),
+        optimizer="adam", optimizer_params={"learning_rate": 1e-3},
+        mesh=mesh, num_microbatches=2)
+    rs = np.random.RandomState(0)
+    X = rs.rand(4, 6, 16).astype(np.float32)
+    Y = rs.rand(4, 6, 8).astype(np.float32)
+    losses = [float(tr.step(X, Y).asscalar()) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
