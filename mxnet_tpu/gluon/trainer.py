@@ -27,7 +27,7 @@ __all__ = ["Trainer"]
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None):
+                 update_on_kvstore=None, zero=False, mesh=None):
         if isinstance(params, (dict,)):
             param_dict = dict(params)
         elif isinstance(params, (list, tuple)):
@@ -52,6 +52,11 @@ class Trainer:
         self._kv_initialized = False
         self._states = {}
         self._params_to_init = list(self._params)
+        self._zero = zero
+        self._zero_mesh = mesh
+        if zero and (mesh is None or "dp" not in getattr(mesh, "shape", {})):
+            raise MXNetError("Trainer(zero=True) needs mesh= (a "
+                             "jax.sharding.Mesh with a 'dp' axis)")
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -104,8 +109,60 @@ class Trainer:
     # ---- the step ---------------------------------------------------------
     def _maybe_init_states(self, i, param):
         if i not in self._states:
-            self._states[i] = \
-                self._optimizer.create_state_multi_precision(i, param.data())
+            state = self._optimizer.create_state_multi_precision(
+                i, param.data())
+            if self._zero:
+                state = self._shard_state(state)
+            self._states[i] = state
+
+    def _shard_state(self, state):
+        """ZeRO-1 for the imperative path: place each optimizer-state array
+        sharded over the mesh's dp axis (first divisible dim).  The per-param
+        jnp update then runs SPMD under XLA with the state never fully
+        materialized on one device — the FusedTrainer(zero=True) layout
+        (parallel/__init__.py:198) brought to reference-style
+        ``Trainer.step`` training."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..ndarray.ndarray import NDArray
+
+        dp = self._zero_mesh.shape["dp"]
+
+        def place(leaf):
+            if not isinstance(leaf, NDArray):
+                return leaf
+            spec = [None] * leaf.ndim
+            for ax, dim in enumerate(leaf.shape):
+                if dim % dp == 0 and dim > 0:
+                    spec[ax] = "dp"
+                    break
+            arr = jax.device_put(
+                leaf._data, NamedSharding(self._zero_mesh, P(*spec)))
+            return NDArray(arr)
+
+        return jax.tree_util.tree_map(
+            place, state,
+            is_leaf=lambda x: isinstance(x, NDArray))
+
+    def _zero_update(self, i, param, grad):
+        """Run one imperative update SPMD over the mesh: weight/grad enter
+        replicated, the state stays dp-sharded (each device touches only its
+        state shard — the ZeRO-1 memory contract), and the fresh weight is
+        brought back to the param's home device for the eager forward."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..ndarray.ndarray import NDArray
+
+        rep = NamedSharding(self._zero_mesh, P())
+        pdata = param.data()
+        home = next(iter(pdata._data.devices()))
+        wrap = NDArray(jax.device_put(pdata._data, rep))
+        gwrap = NDArray(jax.device_put(grad._data, rep))
+        self._optimizer.update_multi_precision(i, wrap, gwrap,
+                                               self._states[i])
+        pdata._data = jax.device_put(wrap._data, home)
 
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce_grads + update (reference trainer.py:334)."""
@@ -158,8 +215,11 @@ class Trainer:
 
                 if not isinstance(grad, RowSparseNDArray):
                     grad = row_sparse_from_dense(grad)
-            self._optimizer.update_multi_precision(
-                i, param.data(), grad, self._states[i])
+            if self._zero and getattr(grad, "stype", "default") == "default":
+                self._zero_update(i, param, grad)
+            else:
+                self._optimizer.update_multi_precision(
+                    i, param.data(), grad, self._states[i])
 
     # ---- persistence ------------------------------------------------------
     def save_states(self, fname):
@@ -186,3 +246,9 @@ class Trainer:
         with open(fname, "rb") as f:
             self._states = {k: _state_nd(v)
                             for k, v in pickle.load(f).items()}
+        if self._zero:
+            # re-establish the dp-sharded placement — a plain load would
+            # leave every state replicated and silently void the ZeRO-1
+            # memory contract after checkpoint resume
+            self._states = {k: self._shard_state(v)
+                            for k, v in self._states.items()}

@@ -11,7 +11,14 @@ from . import ndarray as nd
 from .base import MXNetError
 
 __all__ = ["imread", "imresize", "imdecode", "resize_short", "center_crop",
-           "random_crop", "ImageIter", "CreateAugmenter"]
+           "random_crop", "fixed_crop", "random_size_crop", "color_normalize",
+           "scale_down", "ImageIter", "CreateAugmenter", "Augmenter",
+           "SequentialAug", "RandomOrderAug", "ResizeAug", "ForceResizeAug",
+           "RandomCropAug", "RandomSizedCropAug", "CenterCropAug",
+           "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+           "HueJitterAug", "ColorJitterAug", "LightingAug",
+           "ColorNormalizeAug", "RandomGrayAug", "HorizontalFlipAug",
+           "CastAug"]
 
 
 def imread(filename, flag=1, to_rgb=True):
@@ -98,25 +105,345 @@ def random_crop(src, size, interp=2):
     return out, (x0, y0, new_w, new_h)
 
 
-def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
-                    rand_mirror=False, mean=None, std=None, **kwargs):
-    augs = []
-    if resize > 0:
-        augs.append(lambda img: resize_short(img, resize))
-    if rand_crop:
-        augs.append(lambda img: random_crop(img, (data_shape[2],
-                                                  data_shape[1]))[0])
-    else:
-        augs.append(lambda img: center_crop(img, (data_shape[2],
-                                                  data_shape[1]))[0])
-    if rand_mirror:
-        def mirror(img):
-            if _np.random.rand() < 0.5:
-                return img[:, ::-1, :]
-            return img
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """Crop at a fixed window, optionally resizing (reference
+    image/image.py:470 fixed_crop)."""
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
 
-        augs.append(mirror)
-    return augs
+
+def random_size_crop(src, size, area, ratio, interp=2, **kwargs):
+    """Inception-style random-area/aspect crop (reference image.py:529);
+    falls back to center crop after 10 failed draws, like the reference."""
+    h, w = src.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _np.random.uniform(area[0], area[1]) * src_area
+        log_ratio = (_np.log(ratio[0]), _np.log(ratio[1]))
+        new_ratio = _np.exp(_np.random.uniform(*log_ratio))
+        new_w = int(round(_np.sqrt(target_area * new_ratio)))
+        new_h = int(round(_np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = _np.random.randint(0, w - new_w + 1)
+            y0 = _np.random.randint(0, h - new_h + 1)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    out, coord = center_crop(src, size, interp)
+    return out, coord
+
+
+def color_normalize(src, mean, std=None):
+    """(src - mean) / std over HWC float (reference image.py:625)."""
+    src = src.astype("float32") if src.dtype != _np.float32 else src
+    out = src - nd.array(_np.asarray(mean, _np.float32))
+    if std is not None:
+        out = out / nd.array(_np.asarray(std, _np.float32))
+    return out
+
+
+def scale_down(src_size, size):
+    """Scale crop size down to fit in src (reference image.py:378)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+# ---------------------------------------------------------------------------
+# Augmenter classes (reference python/mxnet/image/image.py:700-1100 — each
+# carries its params for serialization via dumps(); __call__(src) -> src)
+# ---------------------------------------------------------------------------
+class Augmenter:
+    """Image augmenter base (reference image.py:700)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        for k, v in kwargs.items():
+            if isinstance(v, nd.NDArray):
+                kwargs[k] = v.asnumpy().tolist()
+            elif isinstance(v, _np.ndarray):
+                kwargs[k] = v.tolist()
+
+    def dumps(self):
+        import json
+
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    """Compose a list of augmenters in order (reference image.py:730)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(),
+                [t.dumps() for t in self.ts]]
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    """Apply children in random order (reference image.py:750)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(),
+                [t.dumps() for t in self.ts]]
+
+    def __call__(self, src):
+        order = _np.random.permutation(len(self.ts))
+        for i in order:
+            src = self.ts[i](src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size if isinstance(size, tuple) else (size, size)
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2, **kwargs):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size, self.area, self.ratio, self.interp = \
+            size, area, ratio, interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size if isinstance(size, tuple) else (size, size)
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class BrightnessJitterAug(Augmenter):
+    """src *= 1 + U(-brightness, brightness)  (reference image.py:860)."""
+
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _np.random.uniform(-self.brightness, self.brightness)
+        return src.astype("float32") * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], _np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + _np.random.uniform(-self.contrast, self.contrast)
+        x = src.asnumpy().astype(_np.float32)
+        gray = (x * self._coef).sum() * (3.0 / x.size)
+        return nd.array(x * alpha + gray * (1.0 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], _np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + _np.random.uniform(-self.saturation, self.saturation)
+        x = src.asnumpy().astype(_np.float32)
+        gray = (x * self._coef).sum(axis=2, keepdims=True)
+        return nd.array(x * alpha + gray * (1.0 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    """YIQ-rotation hue jitter (reference image.py:930 uses the same
+    tyiq/ityiq matrices)."""
+
+    _tyiq = _np.array([[0.299, 0.587, 0.114],
+                       [0.596, -0.274, -0.321],
+                       [0.211, -0.523, 0.311]], _np.float32)
+    _ityiq = _np.array([[1.0, 0.956, 0.621],
+                        [1.0, -0.272, -0.647],
+                        [1.0, -1.107, 1.705]], _np.float32)
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        alpha = _np.random.uniform(-self.hue, self.hue)
+        u, w = _np.cos(alpha * _np.pi), _np.sin(alpha * _np.pi)
+        bt = _np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                       _np.float32)
+        t = _np.dot(_np.dot(self._ityiq, bt), self._tyiq).T
+        x = src.asnumpy().astype(_np.float32)
+        return nd.array(_np.dot(x, t))
+
+
+class ColorJitterAug(RandomOrderAug):
+    """brightness/contrast/saturation in random order (reference
+    image.py:960)."""
+
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """AlexNet-style PCA lighting noise (reference image.py:980)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = _np.asarray(eigval, _np.float32)
+        self.eigvec = _np.asarray(eigvec, _np.float32)
+
+    def __call__(self, src):
+        alpha = _np.random.normal(0, self.alphastd, size=(3,))
+        rgb = _np.dot(self.eigvec * alpha, self.eigval)
+        return src.astype("float32") + nd.array(rgb.astype(_np.float32))
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    _coef = _np.array([[0.299], [0.587], [0.114]], _np.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _np.random.rand() < self.p:
+            x = src.asnumpy().astype(_np.float32)
+            gray = _np.dot(x, self._coef)
+            return nd.array(_np.broadcast_to(gray, x.shape).copy())
+        return src
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _np.random.rand() < self.p:
+            return src[:, ::-1, :]
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the reference's standard augmentation list
+    (image/image.py:1140 CreateAugmenter — same kwargs, same order)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None and len(_np.atleast_1d(mean)):
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
 
 
 class ImageIter:

@@ -40,9 +40,9 @@ broadcast_greater = _core.greater
 broadcast_greater_equal = _core.greater_equal
 broadcast_lesser = _core.lesser
 broadcast_lesser_equal = _core.lesser_equal
-broadcast_like = _core.broadcast_to
-Activation = _nn.relu  # overridden below by proper dispatcher
-Embedding = _core.embedding
+# broadcast_like / Embedding / Activation resolve from the registry
+# (ops/tensor_tail.py, ops/legacy.py) — 1.x signatures incl. the
+# input_dim/output_dim declarative attrs
 FullyConnected = _nn.fully_connected
 Convolution = _nn.convolution
 Deconvolution = _nn.deconvolution
@@ -63,51 +63,16 @@ UpSampling = _nn.upsampling
 BlockGrad = stop_gradient = _core.stop_gradient
 
 
-def Activation(data, act_type="relu"):  # noqa: F811
-    """Reference: src/operator/nn/activation.cc act_type dispatch."""
-    fns = {"relu": _nn.relu, "sigmoid": _nn.sigmoid, "tanh": _core.tanh,
-           "softrelu": _nn.softrelu, "softsign": _nn.softsign,
-           "log_sigmoid": _nn.log_sigmoid, "mish": _nn.mish,
-           "gelu": _nn.gelu, "silu": _nn.silu}
-    return fns[act_type](data)
-
-
-def LeakyReLU(data, gamma=None, act_type="leaky", slope=0.25,
-              lower_bound=0.125, upper_bound=0.334):
-    """Reference: src/operator/leaky_relu.cc."""
-    if act_type == "leaky":
-        return _nn.leaky_relu(data, slope=slope)
-    if act_type == "prelu":
-        return _nn.prelu(data, gamma)
-    if act_type == "elu":
-        return _nn.elu(data, alpha=slope)
-    if act_type == "selu":
-        return _nn.selu(data)
-    if act_type == "gelu":
-        return _nn.gelu(data)
-    if act_type == "rrelu":
-        from .. import autograd as _ag
-        if _ag.is_training():
-            from .. import random as _rnd
-            u = _rnd.uniform(lower_bound, upper_bound, shape=data.shape)
-            return _nn.prelu(data, u)
-        return _nn.leaky_relu(data, slope=(lower_bound + upper_bound) / 2)
-    raise MXNetError("unknown act_type %s" % act_type)
-
-
+# Activation / LeakyReLU / Dropout resolve from the registry (ops/legacy.py)
+# — one act_type dispatcher for nd AND sym, stochastic rrelu in training,
+# implicit-RNG train-gated dropout.
 def dropout(data, p=0.5, mode="training", axes=None):
-    """Imperative dropout: draws a key from the global RNG state
-    (reference nn/dropout.cc; active only in autograd training mode)."""
-    from .. import autograd as _ag
-    from .. import random as _rnd
+    """Keyless imperative dropout — delegates to the legacy Dropout op
+    (ops/legacy.py; reference nn/dropout.cc)."""
+    from ..ops.registry import get_op
 
-    if mode == "always" or (_ag.is_training() and p > 0.0):
-        return _nn.dropout(data, _rnd.take_key(), p=p,
-                           axes=tuple(axes) if axes else None)
-    return data
-
-
-Dropout = dropout
+    return get_op("Dropout")(data, p=p, mode=mode,
+                             axes=tuple(axes) if axes else None)
 
 
 # ---- creation -------------------------------------------------------------

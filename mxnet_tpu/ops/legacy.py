@@ -1,0 +1,156 @@
+"""Legacy CamelCase op names — MXNet 1.x's original operator surface.
+
+Reference: the CamelCase registrations scattered through src/operator/
+(Activation: nn/activation.cc:158, LeakyReLU: leaky_relu.cc:135, Dropout:
+nn/dropout.cc:151, Pooling: nn/pooling.cc:372, ROIPooling: roi_pooling.cc:
+224, SwapAxis: swapaxis.cc:76, UpSampling: nn/upsampling.cc:142, ...).
+MXNet 2.0 kept them alive for 1.x model compatibility; a user switching
+frameworks expects ``mx.nd.Convolution(...)`` to work verbatim, so the names
+are first-class registry entries here:
+
+- where the snake_case op already uses the reference attr names, the
+  CamelCase name is a registry alias (same Operator object);
+- where the 1.x signature differs (act_type dispatchers, Dropout's implicit
+  train-mode RNG), a thin adapter fn maps 1.x attrs onto the TPU-native op.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, thread_state
+from . import core, nn
+from .registry import alias, register
+
+# ---- direct aliases: snake_case op already speaks the 1.x attr names ------
+for _camel, _snake in [
+        ("Cast", "cast"), ("Concat", "concat"), ("Flatten", "flatten"),
+        ("Reshape", "reshape"), ("Pad", "pad"), ("SwapAxis", "swapaxes"),
+        ("SliceChannel", "split"), ("UpSampling", "upsampling"),
+        ("BatchNorm", "batch_norm"), ("LayerNorm", "layer_norm"),
+        ("GroupNorm", "group_norm"), ("InstanceNorm", "instance_norm"),
+        ("LRN", "lrn"), ("CTCLoss", "ctc_loss"),
+        ("SequenceMask", "sequence_mask"), ("SequenceLast", "sequence_last"),
+        ("SequenceReverse", "sequence_reverse"),
+        ("FullyConnected", "fully_connected"),
+        ("Convolution", "convolution"), ("Deconvolution", "deconvolution"),
+        ("Pooling", "pooling"), ("slice_channel", "split")]:
+    alias(_camel, _snake)
+
+
+_ACTIVATIONS = {
+    "relu": nn.relu, "sigmoid": nn.sigmoid, "tanh": core.tanh,
+    "softrelu": nn.softrelu, "softsign": nn.softsign,
+    "log_sigmoid": nn.log_sigmoid, "mish": nn.mish,
+    "gelu": nn.gelu, "silu": nn.silu,
+}
+
+
+@register("Activation")
+def Activation(data, act_type="relu"):
+    """act_type dispatcher [nn/activation.cc:158]."""
+    try:
+        return _ACTIVATIONS[act_type].fn(data)
+    except KeyError:
+        raise MXNetError("Activation: unknown act_type %r" % (act_type,))
+
+
+@register("LeakyReLU")
+def LeakyReLU(data, gamma=None, act_type="leaky", slope=0.25,
+              lower_bound=0.125, upper_bound=0.334):
+    """act_type dispatcher [leaky_relu.cc:135].  rrelu samples a per-element
+    slope in training (the reference drew from the resource-pool RNG) and
+    uses the midpoint slope at inference."""
+    if act_type == "leaky":
+        return nn.leaky_relu.fn(data, slope=slope)
+    if act_type == "prelu":
+        return nn.prelu.fn(data, gamma)
+    if act_type == "elu":
+        return nn.elu.fn(data, alpha=slope)
+    if act_type == "selu":
+        return nn.selu.fn(data)
+    if act_type == "gelu":
+        return nn.gelu.fn(data)
+    if act_type == "rrelu":
+        if thread_state.is_training:
+            from .. import random as _random
+
+            u = jax.random.uniform(
+                _random.take_key(), data.shape, jnp.float32,
+                lower_bound, upper_bound).astype(data.dtype)
+            return jnp.where(data >= 0, data, data * u)
+        return nn.leaky_relu.fn(
+            data, slope=(lower_bound + upper_bound) / 2.0)
+    raise MXNetError("LeakyReLU: unknown act_type %r" % (act_type,))
+
+
+@register("Dropout")
+def Dropout(data, p=0.5, mode="training", axes=None, cudnn_off=False):
+    """1.x Dropout [nn/dropout.cc:151]: RNG is implicit (the reference
+    pulled from the per-device resource pool; here the framework RNG stream,
+    mxnet_tpu/random.py) and train-mode gating follows autograd state."""
+    active = mode == "always" or (mode == "training"
+                                  and thread_state.is_training)
+    if not active or p <= 0.0:
+        return data
+    from .. import random as _random
+
+    return nn.dropout.fn(data, _random.take_key(), p=p, axes=axes)
+
+
+@register("Embedding")
+def Embedding(data, weight, input_dim=None, output_dim=None,
+              dtype="float32", sparse_grad=False):
+    """1.x Embedding [indexing_op.cc Embedding]: input_dim/output_dim are
+    declarative (shape inference in the reference); the lookup is the same
+    gather."""
+    return core.embedding.fn(data, weight)
+
+
+@register("ROIPooling")
+def ROIPooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """Max-pool ROI quantized to the feature grid [roi_pooling.cc:224].
+    rois: (R, 5) of [batch_idx, x1, y1, x2, y2] in image coords.
+
+    Vectorized as two masked max-reductions (H then W): each output bin
+    row/col builds a membership mask against the rounded roi bin edges —
+    no data-dependent shapes, so it jits on TPU.
+    """
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+    N, C, H, W = data.shape
+    R = rois.shape[0]
+    bidx = rois[:, 0].astype(jnp.int32)
+    # reference: round(coord * scale); end-inclusive grid, min size 1
+    x1 = jnp.round(rois[:, 1] * spatial_scale)
+    y1 = jnp.round(rois[:, 2] * spatial_scale)
+    x2 = jnp.round(rois[:, 3] * spatial_scale)
+    y2 = jnp.round(rois[:, 4] * spatial_scale)
+    roi_h = jnp.maximum(y2 - y1 + 1.0, 1.0)
+    roi_w = jnp.maximum(x2 - x1 + 1.0, 1.0)
+    bin_h = roi_h / ph
+    bin_w = roi_w / pw
+
+    hh = jnp.arange(H, dtype=jnp.float32)
+    ww = jnp.arange(W, dtype=jnp.float32)
+    pi = jnp.arange(ph, dtype=jnp.float32)
+    pj = jnp.arange(pw, dtype=jnp.float32)
+    # (R, ph, H): h in [floor(y1 + i*bin_h), ceil(y1 + (i+1)*bin_h))
+    hstart = jnp.floor(y1[:, None] + pi[None, :] * bin_h[:, None])
+    hend = jnp.ceil(y1[:, None] + (pi[None, :] + 1.0) * bin_h[:, None])
+    hmask = (hh[None, None, :] >= hstart[..., None]) & \
+            (hh[None, None, :] < hend[..., None])
+    wstart = jnp.floor(x1[:, None] + pj[None, :] * bin_w[:, None])
+    wend = jnp.ceil(x1[:, None] + (pj[None, :] + 1.0) * bin_w[:, None])
+    wmask = (ww[None, None, :] >= wstart[..., None]) & \
+            (ww[None, None, :] < wend[..., None])
+
+    neg = jnp.asarray(-jnp.inf, data.dtype)
+    x = data[bidx]                                   # (R, C, H, W)
+    # reduce H: (R, C, ph, W)
+    xh = jnp.max(jnp.where(hmask[:, None, :, :, None], x[:, :, None], neg),
+                 axis=3)
+    # reduce W: (R, C, ph, pw)
+    out = jnp.max(jnp.where(wmask[:, None, None, :, :],
+                            xh[:, :, :, None, :], neg), axis=4)
+    return jnp.where(jnp.isfinite(out), out, 0.0)

@@ -30,16 +30,28 @@ _OP_REGISTRY = {}
 
 
 class Operator:
-    """A registered op: name, pure fn, doc, and dispatch metadata."""
+    """A registered op: name, pure fn, doc, and dispatch metadata.
 
-    __slots__ = ("name", "fn", "num_outputs", "differentiable", "doc")
+    ``num_outputs``/``mutates`` may be callables of the attr dict, mirroring
+    the reference's ``set_num_outputs(lambda attrs: ...)`` /
+    ``FMutateInputs`` registrations (optimizer_op.cc:322,941).  A mutating
+    op's fn stays PURE: it returns ``(*primary_outputs, *new_state_values)``
+    and invoke() writes the trailing values back into the NDArray handles at
+    the declared input positions — the functional rendering of the
+    reference's in-place state update contract.
+    """
 
-    def __init__(self, name, fn, num_outputs=1, differentiable=True, doc=None):
+    __slots__ = ("name", "fn", "num_outputs", "differentiable", "doc",
+                 "mutates")
+
+    def __init__(self, name, fn, num_outputs=1, differentiable=True, doc=None,
+                 mutates=None):
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs
         self.differentiable = differentiable
         self.doc = doc or fn.__doc__
+        self.mutates = mutates
 
     def __call__(self, *inputs, **attrs):
         return invoke(self, inputs, attrs)
@@ -48,7 +60,7 @@ class Operator:
         return "Operator(%s)" % self.name
 
 
-def register(name=None, num_outputs=1, differentiable=True):
+def register(name=None, num_outputs=1, differentiable=True, mutates=None):
     """Register a pure JAX function as a framework op.
 
     Usage::
@@ -62,11 +74,22 @@ def register(name=None, num_outputs=1, differentiable=True):
         opname = name or fn.__name__
         if opname in _OP_REGISTRY:
             raise MXNetError("op '%s' registered twice" % opname)
-        op = Operator(opname, fn, num_outputs, differentiable)
+        op = Operator(opname, fn, num_outputs, differentiable, mutates=mutates)
         _OP_REGISTRY[opname] = op
         return op
 
     return deco
+
+
+def alias(new_name, existing):
+    """Register an additional registry name for an existing op (the
+    reference's ``.add_alias`` — e.g. ``Flatten``/``flatten``,
+    elemwise_op_common.h usage throughout)."""
+    op = existing if isinstance(existing, Operator) else get_op(existing)
+    if new_name in _OP_REGISTRY:
+        raise MXNetError("op '%s' registered twice" % new_name)
+    _OP_REGISTRY[new_name] = op
+    return op
 
 
 def get_op(name):
@@ -95,6 +118,7 @@ def invoke(op, inputs, attrs):
     """
     from ..ndarray.ndarray import NDArray
 
+    out_arg = attrs.pop("out", None) if attrs else None
     datas = [x._data if isinstance(x, NDArray) else x for x in inputs]
     if attrs:
         # array-valued attrs (e.g. length masks) ride along as constants
@@ -134,12 +158,45 @@ def invoke(op, inputs, attrs):
         for i, o in enumerate(outs):
             if _is_float(o._data):
                 o._entry = (node, i)
-        return outs[0] if (op.num_outputs == 1 and len(outs) == 1) else tuple(outs)
+        one = op.num_outputs == 1 and len(outs) == 1
+        return _deliver(outs[0] if one else tuple(outs), out_arg)
 
     out = fn(*datas)
-    if isinstance(out, tuple):
-        return tuple(NDArray(o) for o in out)
-    return NDArray(out)
+    if not isinstance(out, tuple):
+        return _deliver(NDArray(out), out_arg)
+    outs = list(out)
+    n_primary = op.num_outputs(attrs) if callable(op.num_outputs) \
+        else op.num_outputs
+    mut = op.mutates(attrs) if callable(op.mutates) else op.mutates
+    if mut:
+        # reference FMutateInputs: trailing fn outputs are the new values of
+        # the state inputs at these positions; write them back to the handles
+        for pos, val in zip(mut, outs[n_primary:]):
+            tgt = inputs[pos]
+            if isinstance(tgt, NDArray):
+                tgt._data = val
+        outs = outs[:n_primary]
+    result = (NDArray(outs[0]) if len(outs) == 1
+              else tuple(NDArray(o) for o in outs))
+    return _deliver(result, out_arg)
+
+
+def _deliver(result, out_arg):
+    """Honor the generated-wrapper ``out=`` contract (reference
+    register.py:265 wrappers forward ``out`` to MXImperativeInvoke): write
+    the result into the caller-provided handle(s) and return them."""
+    if out_arg is None:
+        return result
+    results = result if isinstance(result, tuple) else (result,)
+    targets = out_arg if isinstance(out_arg, (tuple, list)) else (out_arg,)
+    if len(results) != len(targets):
+        raise MXNetError("out= expects %d arrays, got %d"
+                         % (len(results), len(targets)))
+    for tgt, res in zip(targets, results):
+        tgt._data = res._data
+        tgt._entry = getattr(res, "_entry", None)
+    return out_arg if isinstance(out_arg, tuple) or not isinstance(
+        out_arg, (tuple, list)) else tuple(targets)
 
 
 def _on_tape(x):

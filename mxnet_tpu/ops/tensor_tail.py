@@ -1,0 +1,159 @@
+"""Tensor-op long tail: indexing/layout/shape utilities.
+
+Reference: src/operator/tensor/matrix_op.cc (reverse:827, depth_to_space:953,
+space_to_depth:997, reshape_like, broadcast_like), indexing_op.cc
+(batch_take:730), nn/moments.cc:34, nn/im2col.h, contrib/krprod.cc:75.
+Each op is one fused jnp/lax expression; im2col rides
+``conv_general_dilated_patches`` (the MXU-friendly unfold) and col2im is its
+exact adjoint via ``jax.vjp`` — the reference needed a hand-written scatter
+kernel (im2col.h:157) for the same thing.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("batch_take")
+def batch_take(a, indices):
+    """output[i] = a[i, indices[i]]  [indexing_op.cc:730; deprecated alias
+    of pick]."""
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32)[:, None], axis=1)[:, 0]
+
+
+@register("broadcast_like")
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    """Broadcast lhs to rhs's shape [matrix_op.cc broadcast_like]; with
+    axes given, only those dims are matched."""
+    if lhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    shape = list(lhs.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        shape[la % lhs.ndim] = rhs.shape[ra % rhs.ndim]
+    return jnp.broadcast_to(lhs, tuple(shape))
+
+
+@register("reshape_like")
+def reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
+    """Reshape lhs to rhs's shape [matrix_op.cc reshape_like]; the begin/end
+    window swaps only that slice of the shape."""
+    if lhs_begin is None and rhs_begin is None:
+        return lhs.reshape(rhs.shape)
+    ls = list(lhs.shape)
+    lb = 0 if lhs_begin is None else lhs_begin % (lhs.ndim + 1)
+    le = lhs.ndim if lhs_end is None else lhs_end % (lhs.ndim + 1)
+    rb = 0 if rhs_begin is None else rhs_begin % (rhs.ndim + 1)
+    re_ = rhs.ndim if rhs_end is None else rhs_end % (rhs.ndim + 1)
+    return lhs.reshape(tuple(ls[:lb]) + rhs.shape[rb:re_] + tuple(ls[le:]))
+
+
+@register("reverse")
+def reverse(data, axis=0):
+    """Flip along axis, alias of flip [matrix_op.cc:827]."""
+    axes = axis if isinstance(axis, (tuple, list)) else (axis,)
+    return jnp.flip(data, axes)
+
+
+@register("slice")
+def slice(data, begin, end, step=None):  # noqa: A001 - reference op name
+    """Basic strided slice with None-tolerant begin/end/step
+    [matrix_op.cc slice]."""
+    import builtins
+
+    slices = []
+    step = step or (None,) * len(begin)
+    for i, (b, e) in enumerate(zip(begin, end)):
+        s = step[i] if i < len(step) else None
+        slices.append(builtins.slice(b, e, s))
+    for _ in range(data.ndim - len(slices)):
+        slices.append(builtins.slice(None))
+    return data[tuple(slices)]
+
+
+@register("moments", num_outputs=2)
+def moments(data, axes=None, keepdims=False):
+    """mean, var over axes [nn/moments.cc:34]."""
+    ax = tuple(axes) if axes is not None else None
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.mean(jnp.square(data - mean), axis=ax, keepdims=keepdims)
+    if not keepdims:
+        mean = mean.reshape(var.shape)
+    return mean, var
+
+
+@register("depth_to_space")
+def depth_to_space(data, block_size):
+    """NCHW depth→space [matrix_op.cc:953 — reshape/transpose chain kept
+    verbatim so the element order matches DCR mode]."""
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def space_to_depth(data, block_size):
+    """NCHW space→depth, inverse of depth_to_space [matrix_op.cc:997]."""
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+def _im2col(data, kernel, stride=(1, 1), dilate=(1, 1), pad=(0, 0)):
+    n, c, _, _ = data.shape
+    kh, kw = kernel
+    patches = jax.lax.conv_general_dilated_patches(
+        data, filter_shape=(kh, kw), window_strides=tuple(stride),
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        rhs_dilation=tuple(dilate))
+    # patches: (N, C*kh*kw, OH, OW) with channel-major ordering = reference's
+    # (c * kh + ki) * kw + kj layout (im2col.h:87)
+    return patches.reshape(n, c * kh * kw, -1)
+
+
+@register("im2col")
+def im2col(data, kernel, stride=(1, 1), dilate=(1, 1), pad=(0, 0)):
+    """Unfold conv patches to columns (N, C*kh*kw, L) [nn/im2col.h:87]."""
+    return _im2col(data, tuple(kernel), tuple(stride), tuple(dilate),
+                   tuple(pad))
+
+
+@register("col2im")
+def col2im(data, input_size, kernel, stride=(1, 1), dilate=(1, 1),
+           pad=(0, 0)):
+    """Fold columns back, summing overlaps [nn/im2col.h:157] — computed as
+    the exact vjp (adjoint) of im2col at the target geometry."""
+    n = data.shape[0]
+    shape = (n, input_size[0], input_size[1], input_size[2]) \
+        if len(input_size) == 3 else tuple(input_size)
+    f = functools.partial(_im2col, kernel=tuple(kernel),
+                          stride=tuple(stride), dilate=tuple(dilate),
+                          pad=tuple(pad))
+    _, vjp = jax.vjp(f, jnp.zeros(shape, data.dtype))
+    return vjp(data)[0]
+
+
+@register("khatri_rao")
+def khatri_rao(*matrices):
+    """Column-wise Khatri-Rao product [contrib/krprod.cc:75]."""
+    out = matrices[0]
+    for m in matrices[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, m.shape[1])
+    return out
+
+
+@register("argmax_channel", differentiable=False)
+def argmax_channel(data):
+    """Argmax along the trailing axis of the 2-D flattened input
+    [broadcast_reduce_op_index.cc:82]."""
+    return jnp.argmax(data.reshape(data.shape[0], -1), axis=-1).astype(
+        jnp.float32)

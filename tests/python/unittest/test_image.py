@@ -81,3 +81,78 @@ def test_image_det_iter_batches():
     for b in batches:
         assert b.data[0].shape == (2, 3, 32, 32)
         assert b.label[0].shape[0] == 2 and b.label[0].shape[2] == 5
+
+
+# ---------------------------------------------------------------------------
+# classification augmenter classes (reference image/image.py:700-1200)
+# ---------------------------------------------------------------------------
+def test_augmenter_dumps_roundtrip():
+    aug = mximg.ResizeAug(32)
+    s = aug.dumps()
+    assert "resizeaug" in s and "32" in s
+
+
+def test_color_jitter_augs_change_pixels():
+    np.random.seed(0)
+    src = mx.nd.array(np.random.randint(0, 255, (16, 16, 3)), dtype="uint8")
+    for aug in (mximg.BrightnessJitterAug(0.5), mximg.ContrastJitterAug(0.5),
+                mximg.SaturationJitterAug(0.5), mximg.HueJitterAug(0.5)):
+        out = aug(src)
+        assert out.shape == (16, 16, 3)
+        assert not np.allclose(out.asnumpy(),
+                               src.asnumpy().astype(np.float32))
+
+
+def test_lighting_and_gray_augs():
+    np.random.seed(1)
+    src = mx.nd.array(np.full((8, 8, 3), 100.0, np.float32))
+    eigval = np.array([55.46, 4.794, 1.148])
+    eigvec = np.random.rand(3, 3).astype(np.float32)
+    out = mximg.LightingAug(0.1, eigval, eigvec)(src)
+    assert out.shape == (8, 8, 3)
+    gray = mximg.RandomGrayAug(1.0)(src)
+    g = gray.asnumpy()
+    np.testing.assert_allclose(g[..., 0], g[..., 1], rtol=1e-5)
+
+
+def test_color_normalize_aug():
+    src = mx.nd.array(np.full((4, 4, 3), 10.0, np.float32))
+    out = mximg.ColorNormalizeAug([10.0, 10.0, 10.0], [2.0, 2.0, 2.0])(src)
+    np.testing.assert_allclose(out.asnumpy(), np.zeros((4, 4, 3)), atol=1e-6)
+
+
+def test_random_sized_crop_and_fixed_crop():
+    np.random.seed(2)
+    src = mx.nd.array(np.random.randint(0, 255, (40, 50, 3)), dtype="uint8")
+    out = mximg.RandomSizedCropAug((16, 16), (0.3, 1.0), (0.75, 1.333))(src)
+    assert out.shape == (16, 16, 3)
+    fc = mximg.fixed_crop(src, 5, 5, 20, 20, size=(8, 8))
+    assert fc.shape == (8, 8, 3)
+
+
+def test_create_augmenter_full_pipeline():
+    np.random.seed(3)
+    augs = mximg.CreateAugmenter((3, 24, 24), resize=28, rand_crop=True,
+                                 rand_mirror=True, mean=True, std=True,
+                                 brightness=0.1, contrast=0.1,
+                                 saturation=0.1, hue=0.1, pca_noise=0.05,
+                                 rand_gray=0.2)
+    src = mx.nd.array(np.random.randint(0, 255, (60, 48, 3)), dtype="uint8")
+    out = src
+    for a in augs:
+        out = a(out)
+    assert out.shape == (24, 24, 3)
+    assert out.dtype == np.float32
+
+
+def test_sequential_and_random_order_aug():
+    src = mx.nd.array(np.full((6, 6, 3), 50.0, np.float32))
+    seq = mximg.SequentialAug([mximg.CastAug("float32"),
+                               mximg.BrightnessJitterAug(0.0)])
+    out = seq(src)
+    np.testing.assert_allclose(out.asnumpy(), src.asnumpy())
+    assert isinstance(seq.dumps(), list)
+
+
+def test_scale_down():
+    assert mximg.scale_down((30, 40), (50, 60)) == (30, 36)

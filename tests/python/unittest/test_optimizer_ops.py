@@ -1,0 +1,384 @@
+"""Optimizer-update op family + tensor tail + legacy CamelCase surface.
+
+Reference test model: tests/python/unittest/test_optimizer.py (compares op
+updates against Python re-implementations) and test_operator.py's per-op
+numeric checks.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _arr(a):
+    return nd.array(np.asarray(a, dtype=np.float32))
+
+
+def _rs(seed=0):
+    return np.random.RandomState(seed)
+
+
+# ---------------------------------------------------------------------------
+# single-tensor updaters vs numpy ground truth
+# ---------------------------------------------------------------------------
+class TestUpdaters:
+    def test_sgd_update(self):
+        rs = _rs()
+        w, g = rs.randn(5, 3).astype(np.float32), rs.randn(5, 3).astype(
+            np.float32)
+        out = nd.sgd_update(_arr(w), _arr(g), lr=0.1, wd=0.01,
+                            rescale_grad=0.5)
+        ref = w - 0.1 * (0.5 * g + 0.01 * w)
+        np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-6)
+
+    def test_sgd_update_clip(self):
+        w = np.zeros(4, np.float32)
+        g = np.array([10.0, -10.0, 0.5, -0.5], np.float32)
+        out = nd.sgd_update(_arr(w), _arr(g), lr=1.0, clip_gradient=1.0)
+        np.testing.assert_allclose(out.asnumpy(),
+                                   [-1.0, 1.0, -0.5, 0.5], rtol=1e-6)
+
+    def test_sgd_mom_update_mutates_state(self):
+        rs = _rs(1)
+        w, g = rs.randn(4).astype(np.float32), rs.randn(4).astype(np.float32)
+        mom0 = rs.randn(4).astype(np.float32)
+        mom = _arr(mom0)
+        wnd = _arr(w)
+        new_w = nd.sgd_mom_update(wnd, _arr(g), mom, lr=0.1, momentum=0.9,
+                                  wd=0.0)
+        ref_mom = 0.9 * mom0 - 0.1 * g
+        np.testing.assert_allclose(mom.asnumpy(), ref_mom, rtol=1e-6)
+        np.testing.assert_allclose(new_w.asnumpy(), w + ref_mom, rtol=1e-6)
+
+    def test_out_kwarg_updates_in_place(self):
+        w = _arr(np.ones(3))
+        nd.sgd_update(w, _arr(np.full(3, 2.0)), lr=0.5, out=w)
+        np.testing.assert_allclose(w.asnumpy(), np.ones(3) - 1.0, rtol=1e-6)
+
+    def test_adam_update(self):
+        rs = _rs(2)
+        w, g = rs.randn(6).astype(np.float32), rs.randn(6).astype(np.float32)
+        m0 = np.zeros(6, np.float32)
+        v0 = np.zeros(6, np.float32)
+        m, v = _arr(m0), _arr(v0)
+        out = nd.adam_update(_arr(w), _arr(g), m, v, lr=0.01, beta1=0.9,
+                             beta2=0.999, epsilon=1e-8)
+        m_ref = 0.1 * g
+        v_ref = 0.001 * g * g
+        ref = w - 0.01 * m_ref / (np.sqrt(v_ref) + 1e-8)
+        np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5)
+        np.testing.assert_allclose(m.asnumpy(), m_ref, rtol=1e-5)
+        np.testing.assert_allclose(v.asnumpy(), v_ref, rtol=1e-5)
+
+    def test_nag_mom_update(self):
+        rs = _rs(3)
+        w, g = rs.randn(4).astype(np.float32), rs.randn(4).astype(np.float32)
+        mom0 = rs.randn(4).astype(np.float32)
+        mom = _arr(mom0)
+        out = nd.nag_mom_update(_arr(w), _arr(g), mom, lr=0.1, momentum=0.9)
+        ref_mom = 0.9 * mom0 - 0.1 * g
+        ref_w = w + 0.9 * ref_mom - 0.1 * g
+        np.testing.assert_allclose(out.asnumpy(), ref_w, rtol=1e-5)
+        np.testing.assert_allclose(mom.asnumpy(), ref_mom, rtol=1e-5)
+
+    def test_rmsprop_update(self):
+        rs = _rs(4)
+        w, g = rs.randn(5).astype(np.float32), rs.randn(5).astype(np.float32)
+        n = _arr(np.zeros(5))
+        out = nd.rmsprop_update(_arr(w), _arr(g), n, lr=0.01, gamma1=0.9,
+                                epsilon=1e-8)
+        n_ref = 0.1 * g * g
+        ref = w - 0.01 * g / np.sqrt(n_ref + 1e-8)
+        np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5)
+
+    def test_ftrl_update(self):
+        rs = _rs(5)
+        w = rs.randn(5).astype(np.float32)
+        g = rs.randn(5).astype(np.float32)
+        z0, n0 = np.zeros(5, np.float32), np.zeros(5, np.float32)
+        z, n = _arr(z0), _arr(n0)
+        lr, lamda1, beta = 0.1, 0.01, 1.0
+        out = nd.ftrl_update(_arr(w), _arr(g), z, n, lr=lr, lamda1=lamda1,
+                             beta=beta)
+        z_ref = z0 + g - (np.sqrt(n0 + g * g) - np.sqrt(n0)) * w / lr
+        n_ref = n0 + g * g
+        ref = ((np.sign(z_ref) * lamda1 - z_ref)
+               / ((beta + np.sqrt(n_ref)) / lr) * (np.abs(z_ref) > lamda1))
+        np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+
+    def test_signsgd_signum(self):
+        rs = _rs(6)
+        w, g = rs.randn(4).astype(np.float32), rs.randn(4).astype(np.float32)
+        out = nd.signsgd_update(_arr(w), _arr(g), lr=0.1)
+        np.testing.assert_allclose(out.asnumpy(), w - 0.1 * np.sign(g),
+                                   rtol=1e-6)
+        mom = _arr(np.zeros(4))
+        out2 = nd.signum_update(_arr(w), _arr(g), mom, lr=0.1, momentum=0.9)
+        ref_mom = -(1 - 0.9) * g
+        np.testing.assert_allclose(out2.asnumpy(),
+                                   w + 0.1 * np.sign(ref_mom), rtol=1e-6)
+
+    def test_ftml_update(self):
+        rs = _rs(7)
+        w, g = rs.randn(4).astype(np.float32), rs.randn(4).astype(np.float32)
+        d, v, z = _arr(np.zeros(4)), _arr(np.zeros(4)), _arr(np.zeros(4))
+        out = nd.ftml_update(_arr(w), _arr(g), d, v, z, lr=0.02, beta1=0.6,
+                             beta2=0.999, epsilon=1e-8, t=1)
+        v_ref = 0.001 * g * g
+        d_ref = (1 - 0.6) / 0.02 * (np.sqrt(v_ref / (1 - 0.999)) + 1e-8)
+        sigma = d_ref  # d_{t-1} = 0
+        z_ref = (1 - 0.6) * g - sigma * w
+        np.testing.assert_allclose(out.asnumpy(), -z_ref / d_ref, rtol=1e-4)
+
+    def test_lamb_phases(self):
+        rs = _rs(8)
+        w = rs.randn(6).astype(np.float32)
+        g = rs.randn(6).astype(np.float32)
+        mean, var = _arr(np.zeros(6)), _arr(np.zeros(6))
+        gdir = nd.lamb_update_phase1(_arr(w), _arr(g), mean, var, beta1=0.9,
+                                     beta2=0.999, epsilon=1e-6, t=1, wd=0.01)
+        m_ref = 0.1 * g
+        v_ref = 0.001 * g * g
+        mh = m_ref / (1 - 0.9)
+        vh = v_ref / (1 - 0.999)
+        g_ref = mh / (np.sqrt(vh) + 1e-6) + 0.01 * w
+        np.testing.assert_allclose(gdir.asnumpy(), g_ref, rtol=1e-4)
+        r1 = _arr([np.linalg.norm(w)])
+        r2 = _arr([np.linalg.norm(g_ref)])
+        out = nd.lamb_update_phase2(_arr(w), gdir, r1, r2, lr=0.001)
+        ratio = np.linalg.norm(w) / np.linalg.norm(g_ref)
+        np.testing.assert_allclose(out.asnumpy(), w - 0.001 * ratio * g_ref,
+                                   rtol=1e-4)
+
+    def test_mp_sgd_update_keeps_f32_master(self):
+        w32 = np.linspace(-1, 1, 8).astype(np.float32)
+        w16 = _arr(w32).astype("bfloat16")
+        g16 = _arr(np.full(8, 0.5)).astype("bfloat16")
+        master = _arr(w32)
+        out = nd.mp_sgd_update(w16, g16, master, lr=0.1)
+        assert out.dtype == np.dtype("bfloat16") or str(out.dtype) == \
+            "bfloat16"
+        np.testing.assert_allclose(master.asnumpy(), w32 - 0.05, rtol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# multi-tensor + LARS + AMP helpers
+# ---------------------------------------------------------------------------
+class TestMultiTensor:
+    def test_multi_sgd_update(self):
+        rs = _rs(9)
+        ws = [rs.randn(4).astype(np.float32) for _ in range(3)]
+        gs = [rs.randn(4).astype(np.float32) for _ in range(3)]
+        flat = []
+        for w, g in zip(ws, gs):
+            flat += [_arr(w), _arr(g)]
+        outs = nd.multi_sgd_update(*flat, lrs=[0.1, 0.2, 0.3],
+                                   wds=[0.0, 0.01, 0.0], num_weights=3)
+        for i, (w, g) in enumerate(zip(ws, gs)):
+            lr = [0.1, 0.2, 0.3][i]
+            wd = [0.0, 0.01, 0.0][i]
+            np.testing.assert_allclose(outs[i].asnumpy(),
+                                       w - lr * (g + wd * w), rtol=1e-5)
+
+    def test_multi_sgd_mom_update_state(self):
+        rs = _rs(10)
+        ws = [rs.randn(3).astype(np.float32) for _ in range(2)]
+        gs = [rs.randn(3).astype(np.float32) for _ in range(2)]
+        moms = [_arr(np.zeros(3)) for _ in range(2)]
+        flat = []
+        for w, g, m in zip(ws, gs, moms):
+            flat += [_arr(w), _arr(g), m]
+        outs = nd.multi_sgd_mom_update(*flat, lrs=0.1, wds=0.0,
+                                       momentum=0.9, num_weights=2)
+        for i in range(2):
+            np.testing.assert_allclose(moms[i].asnumpy(), -0.1 * gs[i],
+                                       rtol=1e-5)
+            np.testing.assert_allclose(outs[i].asnumpy(),
+                                       ws[i] - 0.1 * gs[i], rtol=1e-5)
+
+    def test_preloaded_multi_sgd(self):
+        ws = [np.ones(3, np.float32), np.full(3, 2.0, np.float32)]
+        gs = [np.full(3, 1.0, np.float32)] * 2
+        flat = []
+        for w, g in zip(ws, gs):
+            flat += [_arr(w), _arr(g)]
+        lrs = _arr([0.1, 0.2])
+        wds = _arr([0.0, 0.0])
+        outs = nd.preloaded_multi_sgd_update(*flat, lrs, wds, num_weights=2)
+        np.testing.assert_allclose(outs[0].asnumpy(), ws[0] - 0.1, rtol=1e-6)
+        np.testing.assert_allclose(outs[1].asnumpy(), ws[1] - 0.2, rtol=1e-6)
+
+    def test_multi_sum_sq_and_lars(self):
+        rs = _rs(11)
+        arrs = [rs.randn(5).astype(np.float32) for _ in range(3)]
+        ss = nd.multi_sum_sq(*[_arr(a) for a in arrs], num_arrays=3)
+        np.testing.assert_allclose(ss.asnumpy(),
+                                   [np.sum(a * a) for a in arrs], rtol=1e-5)
+        lrs = nd.multi_lars(_arr([0.1, 0.1, 0.1]), ss,
+                            nd.multi_sum_sq(*[_arr(a) for a in arrs],
+                                            num_arrays=3),
+                            _arr([0.0, 0.0, 0.0]), eta=0.001, eps=1e-8)
+        # ||w|| == ||g|| here so ratio = eta/(1) * 1 -> lr * eta... verify
+        w_norm = np.array([np.linalg.norm(a) for a in arrs])
+        ratio = 0.001 * w_norm / (w_norm + 1e-8)
+        np.testing.assert_allclose(lrs.asnumpy(), 0.1 * ratio, rtol=1e-5)
+
+    def test_all_finite(self):
+        assert float(nd.all_finite(_arr(np.ones(4))).asnumpy()[0]) == 1.0
+        bad = np.ones(4, np.float32)
+        bad[2] = np.inf
+        assert float(nd.all_finite(_arr(bad)).asnumpy()[0]) == 0.0
+        got = nd.multi_all_finite(_arr(np.ones(3)), _arr(bad), num_arrays=2)
+        assert float(got.asnumpy()[0]) == 0.0
+
+    def test_amp_cast_multicast(self):
+        x = nd.amp_cast(_arr(np.ones(4)), dtype="bfloat16")
+        assert str(x.dtype) == "bfloat16"
+        a16 = _arr(np.ones(3)).astype("bfloat16")
+        b32 = _arr(np.full(3, 2.0))
+        oa, ob = nd.amp_multicast(a16, b32, num_outputs=2)
+        assert oa.dtype == ob.dtype == np.float32
+
+    def test_reset_arrays(self):
+        a, b = _arr(np.ones(4)), _arr(np.full((2, 2), 3.0))
+        nd.reset_arrays(a, b, num_arrays=2)
+        np.testing.assert_allclose(a.asnumpy(), np.zeros(4))
+        np.testing.assert_allclose(b.asnumpy(), np.zeros((2, 2)))
+
+
+# ---------------------------------------------------------------------------
+# tensor tail
+# ---------------------------------------------------------------------------
+class TestTensorTail:
+    def test_batch_take(self):
+        x = _arr([[1.0, 2], [3, 4], [5, 6]])
+        idx = nd.array(np.array([0, 1, 0], np.int32))
+        np.testing.assert_allclose(nd.batch_take(x, idx).asnumpy(),
+                                   [1.0, 4.0, 5.0])
+
+    def test_broadcast_reshape_like(self):
+        a = _arr(np.ones((1, 3)))
+        b = _arr(np.zeros((4, 3)))
+        assert nd.broadcast_like(a, b).shape == (4, 3)
+        c = _arr(np.arange(6))
+        assert nd.reshape_like(c, _arr(np.zeros((2, 3)))).shape == (2, 3)
+        # windowed variant: only dims [1:3) of rhs replace dims [0:1) of lhs
+        d = _arr(np.arange(12).reshape(12,))
+        got = nd.reshape_like(d, _arr(np.zeros((5, 3, 4))), lhs_begin=0,
+                              lhs_end=1, rhs_begin=1, rhs_end=3)
+        assert got.shape == (3, 4)
+
+    def test_reverse_slice(self):
+        x = _arr(np.arange(10).reshape(2, 5))
+        np.testing.assert_allclose(nd.reverse(x, axis=0).asnumpy(),
+                                   np.arange(10).reshape(2, 5)[::-1])
+        got = nd.slice(x, begin=(0, 1), end=(2, 4))
+        np.testing.assert_allclose(got.asnumpy(),
+                                   np.arange(10).reshape(2, 5)[0:2, 1:4])
+        got = nd.slice(x, begin=(None, 4), end=(None, 0), step=(None, -2))
+        np.testing.assert_allclose(got.asnumpy(),
+                                   np.arange(10).reshape(2, 5)[:, 4:0:-2])
+
+    def test_moments(self):
+        x = _arr([[1.0, 2, 3], [4, 5, 6]])
+        mean, var = nd.moments(x, axes=[0])
+        np.testing.assert_allclose(mean.asnumpy(), [2.5, 3.5, 4.5])
+        np.testing.assert_allclose(var.asnumpy(), [2.25, 2.25, 2.25])
+        mean, var = nd.moments(x, axes=[0, 1])
+        np.testing.assert_allclose(var.asnumpy(), 2.9166667, rtol=1e-5)
+
+    def test_depth_space_roundtrip(self):
+        rs = _rs(12)
+        x = rs.randn(2, 8, 3, 4).astype(np.float32)
+        d = nd.depth_to_space(_arr(x), 2)
+        assert d.shape == (2, 2, 6, 8)
+        back = nd.space_to_depth(d, 2)
+        np.testing.assert_allclose(back.asnumpy(), x, rtol=1e-6)
+
+    def test_im2col_col2im(self):
+        rs = _rs(13)
+        x = rs.randn(1, 2, 4, 4).astype(np.float32)
+        cols = nd.im2col(_arr(x), kernel=(3, 3), stride=(1, 1), pad=(1, 1))
+        assert cols.shape == (1, 2 * 9, 16)
+        # identity kernel position recovers the input
+        folded = nd.col2im(cols, input_size=(2, 4, 4), kernel=(3, 3),
+                           stride=(1, 1), pad=(1, 1))
+        # col2im(im2col(x)) multiplies each pixel by its patch coverage
+        ones = nd.im2col(_arr(np.ones_like(x)), kernel=(3, 3), stride=(1, 1),
+                         pad=(1, 1))
+        cover = nd.col2im(ones, input_size=(2, 4, 4), kernel=(3, 3),
+                          stride=(1, 1), pad=(1, 1))
+        np.testing.assert_allclose(folded.asnumpy(),
+                                   x * cover.asnumpy(), rtol=1e-4)
+
+    def test_khatri_rao(self):
+        A = _arr([[1.0, -1], [2, -3]])
+        B = _arr([[1.0, 4], [2, 5], [3, 6]])
+        ref = np.array([[1, -4], [2, -5], [3, -6], [2, -12], [4, -15],
+                        [6, -18]], np.float32)
+        np.testing.assert_allclose(nd.khatri_rao(A, B).asnumpy(), ref)
+
+    def test_argmax_channel(self):
+        x = _arr([[0.0, 1, 2], [5, 4, 3]])
+        np.testing.assert_allclose(nd.argmax_channel(x).asnumpy(), [2.0, 0.0])
+
+
+# ---------------------------------------------------------------------------
+# legacy CamelCase surface
+# ---------------------------------------------------------------------------
+class TestLegacyOps:
+    def test_activation_dispatch(self):
+        x = _arr([-2.0, 0.0, 2.0])
+        np.testing.assert_allclose(
+            nd.Activation(x, act_type="relu").asnumpy(), [0, 0, 2])
+        np.testing.assert_allclose(
+            nd.Activation(x, act_type="tanh").asnumpy(), np.tanh([-2, 0, 2]),
+            rtol=1e-6)
+
+    def test_leakyrelu_dispatch(self):
+        x = _arr([-1.0, 1.0])
+        np.testing.assert_allclose(
+            nd.LeakyReLU(x, act_type="leaky", slope=0.1).asnumpy(),
+            [-0.1, 1.0], rtol=1e-6)
+        np.testing.assert_allclose(
+            nd.LeakyReLU(x, act_type="elu", slope=1.0).asnumpy(),
+            [np.expm1(-1.0), 1.0], rtol=1e-6)
+
+    def test_camelcase_aliases_exist_and_run(self):
+        x = _arr(np.ones((2, 3)))
+        assert nd.Flatten(x).shape == (2, 3)
+        assert nd.Cast(x, dtype="int32").dtype == np.int32
+        y = nd.Reshape(x, shape=(3, 2))
+        assert y.shape == (3, 2)
+        w = _arr(np.ones((4, 3)))
+        out = nd.FullyConnected(x, w, None, num_hidden=4, no_bias=True)
+        assert out.shape == (2, 4)
+
+    def test_dropout_respects_train_mode(self):
+        from mxnet_tpu import autograd
+
+        x = _arr(np.ones((8, 8)))
+        # inference: identity
+        np.testing.assert_allclose(nd.Dropout(x, p=0.5).asnumpy(),
+                                   np.ones((8, 8)))
+        with autograd.train_mode():
+            y = nd.Dropout(x, p=0.5).asnumpy()
+        assert (y == 0).any() and not (y == 0).all()
+
+    def test_embedding_legacy(self):
+        weight = _arr(np.arange(12).reshape(4, 3))
+        idx = nd.array(np.array([0, 3], np.int32))
+        out = nd.Embedding(idx, weight, input_dim=4, output_dim=3)
+        np.testing.assert_allclose(out.asnumpy(),
+                                   [[0, 1, 2], [9, 10, 11]])
+
+    def test_roi_pooling(self):
+        # 1x1x4x4 feature map, one roi covering the left 2x4 block
+        x = _arr(np.arange(16).reshape(1, 1, 4, 4))
+        rois = _arr([[0, 0, 0, 1, 3]])  # batch 0, x1=0,y1=0,x2=1,y2=3
+        out = nd.ROIPooling(x, rois, pooled_size=(2, 2), spatial_scale=1.0)
+        assert out.shape == (1, 1, 2, 2)
+        # bins: h {0,1}x{2,3}, w {0}x{1} -> maxima 4,5 / 12,13
+        np.testing.assert_allclose(out.asnumpy()[0, 0],
+                                   [[4.0, 5.0], [12.0, 13.0]])
