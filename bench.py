@@ -36,11 +36,30 @@ the reference's NCHW family; no layout plumbing is warranted.
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 RESNET_BASELINE_IMGS_PER_SEC = 363.69  # ResNet-50 train fp32 bs128, 1xV100
 RESNET_FWD_GFLOP_PER_IMG = 4.089
 WARMUP = 3
+# Wall-clock budget: the tunnel makes compile times unpredictable; after
+# this many seconds the remaining secondary rows are skipped so the
+# headline JSON line ALWAYS lands within the driver's window.
+BUDGET_S = float(os.environ.get("MXNET_BENCH_BUDGET_S", "1800"))
+_T0 = time.time()
+
+
+def _log(msg):
+    print("[bench +%6.1fs] %s" % (time.time() - _T0, msg), file=sys.stderr,
+          flush=True)
+
+
+def _over_budget(phase):
+    if time.time() - _T0 > BUDGET_S:
+        _log("budget exceeded; skipping " + phase)
+        return True
+    return False
 
 
 def _peak_bf16_tflops():
@@ -78,9 +97,11 @@ def _bench_resnet(dtype, batch, iters=20):
     x = jax.device_put(rs.rand(batch, 3, 224, 224).astype(np.float32))
     y = jax.device_put(rs.randint(0, 1000, batch).astype(np.int32))
 
+    _log("resnet50 %s: model built, compiling+warmup" % dtype)
     for _ in range(WARMUP):
         loss = trainer.step(x, y)
     float(loss.asnumpy())  # hard sync: device round-trip
+    _log("resnet50 %s: warm, timing" % dtype)
 
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -160,9 +181,11 @@ def _bench_bert(batch=16, seq=512, dropout=0.1, iters=10):
         rs.randint(0, vocab, (batch, n_mask)).astype(np.int32),
         rs.randint(0, 2, batch).astype(np.int32)))
 
+    _log("bert: model built, compiling+warmup")
     for _ in range(WARMUP):
         loss = trainer.step(x, y)
     float(loss.asnumpy())
+    _log("bert: warm, timing")
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = trainer.step(x, y)
@@ -213,13 +236,69 @@ def _bench_lstm_lm(batch=32, seq=64, vocab=10000, hidden=650, iters=10):
             "seq": seq, "hidden": hidden, "dtype": "float32"}
 
 
+def _bench_resnet_infer(dtype="bfloat16", batch=32, iters=30):
+    """Inference row (reference perf.md:185-215: 1,076 img/s fp32 /
+    2,085 img/s fp16 on V100, batch 32)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    net = vision.resnet50_v1()
+    net.initialize()
+    apply_fn, params = net.export_pure(training=False)
+    if dtype != "float32":
+        dt = jnp.dtype(dtype)
+        params = {n: (v.astype(dt) if v.dtype == jnp.float32 else v)
+                  for n, v in params.items()}
+
+    @jax.jit
+    def fwd(p, x):
+        outs, _ = apply_fn(p, None, x)
+        return outs[0]
+
+    rs = np.random.RandomState(0)
+    x = jax.device_put(rs.rand(batch, 3, 224, 224).astype(
+        np.float32 if dtype == "float32" else dtype))
+    for _ in range(WARMUP):
+        out = fwd(params, x)
+    float(out.sum().astype(jnp.float32))  # hard sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fwd(params, x)
+    float(out.sum().astype(jnp.float32))
+    dt_s = time.perf_counter() - t0
+    return {"imgs_per_sec": round(batch * iters / dt_s, 2),
+            "step_ms": round(1000 * dt_s / iters, 3),
+            "batch": batch, "dtype": dtype}
+
+
 def main():
     extra = {}
-    extra["resnet50_fp32"] = _bench_resnet("float32", 128)
+    _log("start; budget %.0fs" % BUDGET_S)
     bf16 = _bench_resnet("bfloat16", 128)
     extra["resnet50_bf16"] = bf16
-    extra["bert_base_pretrain_bf16"] = _bench_bert()
-    extra["lstm_lm_650"] = _bench_lstm_lm()
+    _log("resnet50 bf16 done: %s img/s" % bf16["imgs_per_sec"])
+    for phase, fn, key in (
+            ("resnet50_fp32", lambda: _bench_resnet("float32", 128),
+             "resnet50_fp32"),
+            ("bert", _bench_bert, "bert_base_pretrain_bf16"),
+            ("lstm_lm", _bench_lstm_lm, "lstm_lm_650"),
+            ("resnet50_infer_bf16", _bench_resnet_infer,
+             "resnet50_infer_bf16_bs32")):
+        if _over_budget(phase):
+            extra[key] = {"skipped": "time budget"}
+            continue
+        try:
+            extra[key] = fn()
+            _log("%s done" % phase)
+        except Exception as exc:  # pragma: no cover - keep headline alive
+            _log("%s FAILED: %r" % (phase, exc))
+            extra[key] = {"error": repr(exc)}
     extra["peak_bf16_tflops"] = _peak_bf16_tflops()
     print(json.dumps({
         "metric": "resnet50_train_bf16_bs128_imgs_per_sec",

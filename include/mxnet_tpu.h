@@ -1,0 +1,118 @@
+/*
+ * mxnet_tpu.h — stable C ABI of the native host runtime.
+ *
+ * Reference parity: the reference exposed ~400 MX* entry points
+ * (include/mxnet/c_api.h) because EVERY op call crossed the C boundary.
+ * In the TPU-native design the op surface is JAX/XLA (no per-op C ABI by
+ * design — SURVEY §7 translation rules); the C ABI that remains is the
+ * host runtime the reference also kept native: the dependency engine
+ * (src/engine), pooled host allocator (src/storage), RecordIO
+ * (src/recordio), libjpeg image path (src/io/image_io.cc), and the
+ * threaded training data loader (src/io/iter_image_recordio_2.cc).
+ *
+ * ABI rules (mirrors the reference's c_api contract):
+ *  - every handle is an opaque void*; create/destroy pairs own it;
+ *  - functions returning int: 0 = success, -1 = failure with the message
+ *    readable via MXTGetLastError() (thread-local, like MXGetLastError);
+ *  - buffers returned through void** out are malloc'd and must be
+ *    released with MXTBufFree.
+ *
+ * The implementation lives in src/native/*.cc and is built on demand into
+ * libmxnet_tpu_native.so (mxnet_tpu/native/__init__.py loads it via
+ * ctypes; any C/C++/FFI client can link the same library against this
+ * header).
+ */
+#ifndef MXNET_TPU_H_
+#define MXNET_TPU_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- error handling --------------------------------------------------- */
+/* Last error message for the calling thread (empty string if none). */
+const char* MXTGetLastError(void);
+
+/* Free a buffer returned through a void** out parameter. */
+void MXTBufFree(void* ptr);
+
+/* ---- dependency engine (src/native/engine.cc) ------------------------- */
+/* Threaded dependency engine: vars carry RAW/WAR/WAW ordering, ops are
+ * C callbacks pushed with their const/mutable var sets (the reference
+ * Engine::PushAsync contract, include/mxnet/engine.h:118). */
+void*   MXTEngineCreate(int num_workers);
+int64_t MXTEngineNewVar(void* engine);
+/* fn returns 0 on success; nonzero marks every downstream op depending on
+ * its mutable vars as failed (error propagation). */
+int     MXTEnginePushAsync(void* engine, int (*fn)(void*), void* arg,
+                           const int64_t* const_vars, int n_const,
+                           const int64_t* mutable_vars, int n_mutable,
+                           int priority);
+int     MXTEngineWaitForVar(void* engine, int64_t var_id);
+void    MXTEngineWaitAll(void* engine);
+int64_t MXTEnginePending(void* engine);
+void    MXTEngineDestroy(void* engine);
+
+/* ---- pooled host allocator (src/native/storage.cc) -------------------- */
+/* Size-bucketed caching allocator (the reference GPUPooledStorageManager
+ * scheme applied to host staging buffers). */
+void* MXTPoolCreate(uint64_t max_cached_bytes, uint64_t alignment);
+void* MXTPoolAlloc(void* pool, uint64_t size);
+void  MXTPoolFree(void* pool, void* ptr, uint64_t size);
+/* out5: {alloc_calls, cache_hits, cached_bytes, live_bytes, peak_bytes} */
+void  MXTPoolStats(void* pool, uint64_t* out5);
+void  MXTPoolRelease(void* pool);   /* drop cached (free) buffers */
+void  MXTPoolDestroy(void* pool);
+
+/* ---- RecordIO (src/native/recordio.cc) -------------------------------- */
+/* Wire format: the reference's kMagic-framed records (recordio.h). */
+void*   MXTRecordWriterCreate(const char* path);
+int     MXTRecordWriterWrite(void* writer, const uint8_t* data,
+                             uint64_t len);
+int64_t MXTRecordWriterTell(void* writer);
+int     MXTRecordWriterClose(void* writer);
+
+void*   MXTRecordReaderCreate(const char* path);
+/* Returns payload length (pointer valid until the next call), 0 at EOF,
+ * -1 on corrupt framing. */
+int64_t MXTRecordReaderNext(void* reader, const uint8_t** out);
+int     MXTRecordReaderSeek(void* reader, int64_t offset);
+int64_t MXTRecordReaderTell(void* reader);
+/* Random-access read of the record at byte offset into dst (cap bytes);
+ * returns payload length or -1. */
+int64_t MXTRecordReaderReadAt(void* reader, int64_t offset, uint8_t* dst,
+                              uint64_t cap);
+int     MXTRecordReaderClose(void* reader);
+
+/* ---- JPEG / image (src/native/image.cc) ------------------------------- */
+/* Decode JPEG to packed RGB u8 HWC; *out is malloc'd (MXTBufFree). */
+int  MXTDecodeJPEG(const uint8_t* buf, uint64_t len, void** out,
+                   int* height, int* width, int* channels);
+int  MXTEncodeJPEG(const uint8_t* img, int height, int width, int channels,
+                   int quality, void** out, uint64_t* out_len);
+void MXTImageResizeBilinear(const uint8_t* src, int src_h, int src_w,
+                            int channels, uint8_t* dst, int dst_h,
+                            int dst_w);
+
+/* ---- threaded ImageRecord loader (src/native/dataloader.cc) ----------- */
+/* Multi-worker decode+augment pipeline feeding float batches (the
+ * reference ImageRecordIter, src/io/iter_image_recordio_2.cc).
+ * flags bit 0: random mirror.  mean3/scale: per-channel normalize. */
+void* MXTLoaderCreate(const char* rec_path, const char* idx_path_unused,
+                      int batch, int channels, int height, int width,
+                      int label_width, int num_workers, uint64_t seed,
+                      int shuffle, int flags, const float* mean3,
+                      float scale);
+/* Fills out_data (batch*C*H*W floats) + out_label (batch*label_width);
+ * returns actual batch rows, 0 at epoch end, -1 on error. */
+int  MXTLoaderNext(void* loader, float* out_data, float* out_label);
+void MXTLoaderReset(void* loader);
+void MXTLoaderDestroy(void* loader);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* MXNET_TPU_H_ */

@@ -80,6 +80,7 @@ from . import library  # noqa: E402  (extension .so loading)
 from . import image  # noqa: E402
 from . import elastic  # noqa: E402  (failure detection + auto-resume)
 from . import config  # noqa: E402  (env-var registry, reference env_var.md)
+from . import subgraph  # noqa: E402  (SubgraphProperty partitioner hooks)
 
 if base.get_env("MXNET_PROFILER_AUTOSTART", bool, False):
     profiler.set_state("run")  # reference env_var.md MXNET_PROFILER_AUTOSTART

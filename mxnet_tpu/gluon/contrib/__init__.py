@@ -1,4 +1,5 @@
-"""Gluon contrib (reference python/mxnet/gluon/contrib/)."""
-from . import estimator
+"""gluon.contrib (reference python/mxnet/gluon/contrib/__init__.py —
+estimator + data in MXNet 2.0)."""
+from . import data, estimator
 
-__all__ = ["estimator"]
+__all__ = ["estimator", "data"]

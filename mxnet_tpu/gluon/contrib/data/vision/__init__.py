@@ -1,0 +1,12 @@
+"""gluon.contrib.data.vision — bbox-aware transforms + ImageDataLoader
+(reference python/mxnet/gluon/contrib/data/vision/)."""
+from .dataloader import (ImageBboxDataLoader, ImageDataLoader,
+                         create_bbox_augment, create_image_augment)
+from .transforms import (ImageBboxCrop, ImageBboxRandomCropWithConstraints,
+                         ImageBboxRandomExpand,
+                         ImageBboxRandomFlipLeftRight, ImageBboxResize)
+
+__all__ = ["ImageBboxRandomFlipLeftRight", "ImageBboxCrop",
+           "ImageBboxRandomCropWithConstraints", "ImageBboxRandomExpand",
+           "ImageBboxResize", "ImageDataLoader", "ImageBboxDataLoader",
+           "create_image_augment", "create_bbox_augment"]

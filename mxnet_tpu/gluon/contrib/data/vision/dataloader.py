@@ -1,0 +1,135 @@
+"""Convenience image loaders with built-in augmentation.
+
+Reference: python/mxnet/gluon/contrib/data/vision/dataloader.py
+(create_image_augment:44, ImageDataLoader:140, ImageBboxDataLoader:364,
+BboxLabelTransform:474) — one-call loaders composing the augmenter list
+with a gluon DataLoader over .rec files / image lists.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..... import image as _image
+from ..... import image_detection as _det
+from ..... import ndarray as nd
+from .....base import MXNetError
+from ....data import DataLoader
+from ....data.dataset import Dataset
+from ....data.vision.datasets import ImageRecordDataset
+
+__all__ = ["create_image_augment", "create_bbox_augment",
+           "ImageDataLoader", "ImageBboxDataLoader"]
+
+
+def create_image_augment(data_shape, resize=0, rand_crop=False,
+                         rand_resize=False, rand_mirror=False, mean=None,
+                         std=None, brightness=0, contrast=0, saturation=0,
+                         hue=0, pca_noise=0, rand_gray=0, inter_method=2):
+    """The reference's classification augment factory
+    (dataloader.py:44) — delegates to image.CreateAugmenter."""
+    return _image.CreateAugmenter(
+        data_shape, resize=resize, rand_crop=rand_crop,
+        rand_resize=rand_resize, rand_mirror=rand_mirror, mean=mean,
+        std=std, brightness=brightness, contrast=contrast,
+        saturation=saturation, hue=hue, pca_noise=pca_noise,
+        rand_gray=rand_gray, inter_method=inter_method)
+
+
+def create_bbox_augment(data_shape, rand_crop=0, rand_pad=0, rand_gray=0,
+                        rand_mirror=False, mean=None, std=None,
+                        brightness=0, contrast=0, saturation=0, hue=0,
+                        pca_noise=0, inter_method=2, **kwargs):
+    """Detection augment factory (dataloader.py:247) — delegates to
+    image_detection.CreateDetAugmenter."""
+    return _det.CreateDetAugmenter(
+        data_shape, rand_crop=rand_crop, rand_pad=rand_pad,
+        rand_mirror=rand_mirror, mean=mean, std=std,
+        brightness=brightness, contrast=contrast, saturation=saturation,
+        hue=hue, pca_noise=pca_noise, inter_method=inter_method, **kwargs)
+
+
+class _ListDataset(Dataset):
+    """imglist entries: [label(s), path] resolved under path_root."""
+
+    def __init__(self, imglist, path_root):
+        import os
+
+        self._items = [(e[0], os.path.join(path_root, e[-1]))
+                       for e in imglist]
+
+    def __len__(self):
+        return len(self._items)
+
+    def __getitem__(self, idx):
+        label, path = self._items[idx]
+        return _image.imread(path), _np.asarray(label, _np.float32)
+
+
+class ImageDataLoader:
+    """One-call augmented classification loader (dataloader.py:140):
+    batches of (data NCHW float, label)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=".", imglist=None,
+                 shuffle=False, num_workers=0, last_batch="keep",
+                 aug_list=None, **kwargs):
+        if aug_list is None:
+            aug_list = create_image_augment(data_shape, **kwargs)
+        self._augs = aug_list
+
+        if path_imgrec is not None:
+            dataset = ImageRecordDataset(path_imgrec)
+        elif imglist is not None:
+            dataset = _ListDataset(imglist, path_root)
+        elif path_imglist is not None:
+            entries = []
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 3:
+                        continue
+                    entries.append([float(parts[1]), parts[-1]])
+            dataset = _ListDataset(entries, path_root)
+        else:
+            raise MXNetError("need path_imgrec, path_imglist, or imglist")
+
+        transformed = dataset.transform(self._transform)
+        self._loader = DataLoader(transformed, batch_size=batch_size,
+                                  shuffle=shuffle, num_workers=num_workers,
+                                  last_batch=last_batch)
+
+    def _transform(self, item):
+        img, label = item if isinstance(item, tuple) else (item[0], item[1])
+        for aug in self._augs:
+            img = aug(img)
+        chw = nd.transpose(img.astype("float32"), axes=(2, 0, 1))
+        return chw, label
+
+    def __iter__(self):
+        return iter(self._loader)
+
+    def __len__(self):
+        return len(self._loader)
+
+
+class ImageBboxDataLoader:
+    """One-call augmented detection loader (dataloader.py:364): batches of
+    (data NCHW float, padded bbox label (B, N, 5))."""
+
+    def __init__(self, batch_size, data_shape, images=None, labels=None,
+                 shuffle=False, aug_list=None, coord_normalized=True,
+                 **kwargs):
+        if images is None or labels is None:
+            raise MXNetError(
+                "this build takes in-memory images=/labels= (list of HWC "
+                "arrays + (N,5) [cls,x1,y1,x2,y2] labels); .rec-backed "
+                "detection records ride io.ImageRecordIter")
+        if aug_list is None:
+            aug_list = create_bbox_augment(data_shape, **kwargs)
+        self._it = _det.ImageDetIter(
+            batch_size=batch_size, data_shape=data_shape, images=images,
+            labels=labels, aug_list=aug_list, shuffle=shuffle)
+
+    def __iter__(self):
+        self._it.reset()
+        return iter(self._it)

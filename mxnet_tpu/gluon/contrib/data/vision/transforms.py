@@ -1,0 +1,209 @@
+"""Bbox-aware image transform Blocks.
+
+Reference: python/mxnet/gluon/contrib/data/vision/transforms/bbox/bbox.py
+(ImageBboxRandomFlipLeftRight:34, ImageBboxCrop:90,
+ImageBboxRandomCropWithConstraints:146, ImageBboxRandomExpand:216,
+ImageBboxResize:297).
+
+Contract kept verbatim: each Block takes (img HWC, bbox (N, 4+)) and
+returns the transformed pair; bbox columns 0-3 are corner-format absolute
+pixel coords (xmin, ymin, xmax, ymax); extra columns ride along untouched.
+Implementations are fresh numpy/NDArray math on that contract.
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as _np
+
+from ..... import image as _image
+from ..... import ndarray as nd
+from .....base import MXNetError
+from ....block import Block
+
+__all__ = ["ImageBboxRandomFlipLeftRight", "ImageBboxCrop",
+           "ImageBboxRandomCropWithConstraints", "ImageBboxRandomExpand",
+           "ImageBboxResize"]
+
+
+def _bbox_np(bbox):
+    arr = bbox.asnumpy() if isinstance(bbox, nd.NDArray) else \
+        _np.asarray(bbox)
+    if arr.ndim != 2 or arr.shape[1] < 4:
+        raise MXNetError("bbox must be (N, 4+), got %r" % (arr.shape,))
+    return arr.astype(_np.float32).copy()
+
+
+def _crop_bbox(boxes, x0, y0, w, h, allow_outside_center):
+    """Clip boxes to a crop window, translate to window coords, drop empty
+    (and center-outside, unless allowed) boxes."""
+    out = boxes.copy()
+    if not allow_outside_center:
+        cx = (boxes[:, 0] + boxes[:, 2]) / 2
+        cy = (boxes[:, 1] + boxes[:, 3]) / 2
+        keep_center = ((cx >= x0) & (cx < x0 + w) &
+                       (cy >= y0) & (cy < y0 + h))
+    else:
+        keep_center = _np.ones(len(boxes), bool)
+    out[:, 0] = _np.clip(out[:, 0], x0, x0 + w) - x0
+    out[:, 1] = _np.clip(out[:, 1], y0, y0 + h) - y0
+    out[:, 2] = _np.clip(out[:, 2], x0, x0 + w) - x0
+    out[:, 3] = _np.clip(out[:, 3], y0, y0 + h) - y0
+    keep = keep_center & (out[:, 2] > out[:, 0]) & (out[:, 3] > out[:, 1])
+    return out[keep]
+
+
+class ImageBboxRandomFlipLeftRight(Block):
+    """Flip img+bboxes horizontally with probability p [bbox.py:34]."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, img, bbox):
+        boxes = _bbox_np(bbox)
+        if self.p > 0 and (self.p >= 1 or _pyrandom.random() < self.p):
+            img = img[:, ::-1, :]
+            w = img.shape[1]
+            xmin = w - boxes[:, 2].copy()
+            xmax = w - boxes[:, 0].copy()
+            boxes[:, 0], boxes[:, 2] = xmin, xmax
+        return img, nd.array(boxes)
+
+
+class ImageBboxCrop(Block):
+    """Fixed crop (x, y, w, h); boxes translated/clipped, empty and
+    (optionally) center-outside boxes dropped [bbox.py:90]."""
+
+    def __init__(self, crop, allow_outside_center=False):
+        super().__init__()
+        if len(crop) != 4:
+            raise MXNetError("crop must be (x, y, w, h)")
+        self.x0, self.y0, self.w, self.h = crop
+        self.allow_outside_center = allow_outside_center
+
+    def forward(self, img, bbox):
+        boxes = _bbox_np(bbox)
+        if self.x0 + self.w >= img.shape[1] or \
+                self.y0 + self.h >= img.shape[0]:
+            return img, nd.array(boxes)
+        out = img[self.y0:self.y0 + self.h, self.x0:self.x0 + self.w, :]
+        boxes = _crop_bbox(boxes, self.x0, self.y0, self.w, self.h,
+                           self.allow_outside_center)
+        return out, nd.array(boxes)
+
+
+class ImageBboxRandomCropWithConstraints(Block):
+    """IoU-constrained random crop (SSD-style) [bbox.py:146]: sample crops
+    until one keeps min IoU with some box; fall back to identity."""
+
+    def __init__(self, min_scale=0.3, max_scale=1.0, max_aspect_ratio=2.0,
+                 constraints=None, max_trial=50, p=0.5):
+        super().__init__()
+        self.min_scale = min_scale
+        self.max_scale = max_scale
+        self.max_aspect_ratio = max_aspect_ratio
+        self.constraints = constraints or ((0.1, None), (0.3, None),
+                                           (0.5, None), (0.7, None),
+                                           (0.9, None), (None, 1))
+        self.max_trial = max_trial
+        self.p = p
+
+    @staticmethod
+    def _iou(boxes, crop):
+        x0, y0, x1, y1 = crop
+        ix0 = _np.maximum(boxes[:, 0], x0)
+        iy0 = _np.maximum(boxes[:, 1], y0)
+        ix1 = _np.minimum(boxes[:, 2], x1)
+        iy1 = _np.minimum(boxes[:, 3], y1)
+        iw = _np.maximum(ix1 - ix0, 0)
+        ih = _np.maximum(iy1 - iy0, 0)
+        inter = iw * ih
+        a = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        b = (x1 - x0) * (y1 - y0)
+        return inter / _np.maximum(a + b - inter, 1e-12)
+
+    def forward(self, img, bbox):
+        boxes = _bbox_np(bbox)
+        if _pyrandom.random() > self.p or not len(boxes):
+            return img, nd.array(boxes)
+        H, W = img.shape[:2]
+        for min_iou, max_iou in self.constraints:
+            lo = -_np.inf if min_iou is None else min_iou
+            hi = _np.inf if max_iou is None else max_iou
+            for _ in range(self.max_trial):
+                scale = _pyrandom.uniform(self.min_scale, self.max_scale)
+                ar = _pyrandom.uniform(
+                    max(1 / self.max_aspect_ratio, scale * scale),
+                    min(self.max_aspect_ratio, 1 / (scale * scale)))
+                cw = int(W * scale * _np.sqrt(ar))
+                ch = int(H * scale / _np.sqrt(ar))
+                if cw <= 0 or ch <= 0 or cw > W or ch > H:
+                    continue
+                cx = _pyrandom.randint(0, W - cw)
+                cy = _pyrandom.randint(0, H - ch)
+                iou = self._iou(boxes, (cx, cy, cx + cw, cy + ch))
+                if lo <= iou.max() <= hi:
+                    new_boxes = _crop_bbox(boxes, cx, cy, cw, ch, False)
+                    if len(new_boxes):
+                        out = img[cy:cy + ch, cx:cx + cw, :]
+                        return out, nd.array(new_boxes)
+        return img, nd.array(boxes)
+
+
+class ImageBboxRandomExpand(Block):
+    """Pad the image outward with fill, shifting boxes [bbox.py:216]."""
+
+    def __init__(self, max_ratio=4.0, fill=0, keep_ratio=True, p=0.5):
+        super().__init__()
+        self.max_ratio = max_ratio
+        self.fill = fill
+        self.keep_ratio = keep_ratio
+        self.p = p
+
+    def forward(self, img, bbox):
+        boxes = _bbox_np(bbox)
+        if self.max_ratio <= 1 or _pyrandom.random() > self.p:
+            return img, nd.array(boxes)
+        H, W, C = img.shape
+        rx = _pyrandom.uniform(1, self.max_ratio)
+        ry = rx if self.keep_ratio else _pyrandom.uniform(1, self.max_ratio)
+        nw, nh = int(W * rx), int(H * ry)
+        ox = _pyrandom.randint(0, nw - W)
+        oy = _pyrandom.randint(0, nh - H)
+        canvas = _np.full((nh, nw, C), self.fill,
+                          dtype=img.asnumpy().dtype
+                          if isinstance(img, nd.NDArray) else img.dtype)
+        canvas[oy:oy + H, ox:ox + W, :] = img.asnumpy() \
+            if isinstance(img, nd.NDArray) else img
+        boxes[:, 0] += ox
+        boxes[:, 2] += ox
+        boxes[:, 1] += oy
+        boxes[:, 3] += oy
+        return nd.array(canvas), nd.array(boxes)
+
+
+class ImageBboxResize(Block):
+    """Resize the image to (w, h), scaling boxes [bbox.py:297]."""
+
+    def __init__(self, size, keep_ratio=False, interp=2):
+        super().__init__()
+        self.size = size if isinstance(size, (tuple, list)) else (size, size)
+        self.keep_ratio = keep_ratio
+        self.interp = interp
+
+    def forward(self, img, bbox):
+        boxes = _bbox_np(bbox)
+        H, W = img.shape[:2]
+        tw, th = self.size
+        if self.keep_ratio:
+            scale = min(tw / W, th / H)
+            tw, th = max(1, int(W * scale)), max(1, int(H * scale))
+        out = _image.imresize(img if isinstance(img, nd.NDArray)
+                              else nd.array(img), tw, th, self.interp)
+        sx, sy = tw / W, th / H
+        boxes[:, 0] *= sx
+        boxes[:, 2] *= sx
+        boxes[:, 1] *= sy
+        boxes[:, 3] *= sy
+        return out, nd.array(boxes)

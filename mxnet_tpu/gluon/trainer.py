@@ -57,6 +57,12 @@ class Trainer:
         if zero and (mesh is None or "dp" not in getattr(mesh, "shape", {})):
             raise MXNetError("Trainer(zero=True) needs mesh= (a "
                              "jax.sharding.Mesh with a 'dp' axis)")
+        if zero and update_on_kvstore:
+            raise MXNetError(
+                "Trainer(zero=True) is incompatible with "
+                "update_on_kvstore=True: the kvstore update path would "
+                "create optimizer state fully replicated, silently voiding "
+                "the ZeRO-1 sharding")
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}

@@ -1,0 +1,325 @@
+"""Contrib op long tail: AdamW, multi-LAMB/LANS, count_sketch, fft,
+index ops, SyncBatchNorm.
+
+Reference: src/operator/contrib/adamw.cc (_adamw_update:79,
+_mp_adamw_update:34, _multi_adamw_update:143), multi_lamb.cc
+(_multi_lamb_update:174), multi_lans.cc (_multi_lans_update:190),
+count_sketch.cc, fft.cc, index_copy.cc, index_add.cc,
+sync_batch_norm.cc (_contrib_SyncBatchNorm:105).
+
+Notable semantics kept from the reference:
+- adamw takes ``rescale_grad`` as a TENSOR input; when it is non-finite the
+  entire update is skipped (adamw.cc:56 — this is the AMP grad-scaler
+  contract: overflowed steps become no-ops).
+- multi_lamb/multi_lans use interleaved (weight, grad, mean, var[, w32])
+  groups with per-tensor learning_rates/wds and per-tensor step_count for
+  bias correction.
+- fft returns the reference's interleaved real/imag layout (..., 2n), not
+  complex dtype (fft-inl.h output convention).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import alias, register
+
+
+# ---------------------------------------------------------------------------
+# AdamW (decoupled weight decay) — adamw.cc
+# ---------------------------------------------------------------------------
+def _adamw_math(w32, grad, mean, var, rescale, lr, eta, beta1, beta2,
+                epsilon, wd, clip_gradient):
+    scale = rescale.reshape(())
+    ok = jnp.isfinite(scale)
+    g = grad.astype(jnp.float32) * jnp.where(ok, scale, 0.0)
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1.0 - beta1) * g
+    new_var = beta2 * var + (1.0 - beta2) * g * g
+    step = lr * new_mean / (jnp.sqrt(new_var) + epsilon) + wd * w32
+    new_w = w32 - eta * step
+    # non-finite scale: whole update is a no-op (adamw.cc:56)
+    return (jnp.where(ok, new_w, w32), jnp.where(ok, new_mean, mean),
+            jnp.where(ok, new_var, var))
+
+
+@register("adamw_update", differentiable=False, mutates=(2, 3))
+def adamw_update(weight, grad, mean, var, rescale_grad, lr, eta=1.0,
+                 beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                 clip_gradient=-1.0):
+    """W -= eta*(lr*m/(sqrt(v)+eps) + wd*W)  [adamw.cc:79 _adamw_update]."""
+    new_w, new_mean, new_var = _adamw_math(
+        weight, grad, mean, var, rescale_grad, lr, eta, beta1, beta2,
+        epsilon, wd, clip_gradient)
+    return new_w, new_mean, new_var
+
+
+@register("mp_adamw_update", differentiable=False, mutates=(2, 3, 5))
+def mp_adamw_update(weight, grad, mean, var, rescale_grad, weight32, lr,
+                    eta=1.0, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                    clip_gradient=-1.0):
+    """fp16 weights + f32 master [adamw.cc:34 _mp_adamw_update]."""
+    new_w32, new_mean, new_var = _adamw_math(
+        weight32, grad, mean, var, rescale_grad, lr, eta, beta1, beta2,
+        epsilon, wd, clip_gradient)
+    return new_w32.astype(weight.dtype), new_mean, new_var, new_w32
+
+
+alias("_adamw_update", "adamw_update")
+alias("_mp_adamw_update", "mp_adamw_update")
+
+
+# ---------------------------------------------------------------------------
+# multi-tensor LAMB / LANS — multi_lamb.cc / multi_lans.cc
+# ---------------------------------------------------------------------------
+def _norm(x):
+    return jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
+
+
+def _trust(lr, w_norm, d_norm, lower_bound, upper_bound):
+    r1 = w_norm
+    if lower_bound is not None and lower_bound >= 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound is not None and upper_bound >= 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    ratio = jnp.where((r1 > 0) & (d_norm > 0), r1 / d_norm, 1.0)
+    return lr * ratio
+
+
+def _multi_lamb_fn(*arrays, learning_rates=None, wds=None, step_count=None,
+                   beta1=0.9, beta2=0.999, epsilon=1e-6, rescale_grad=1.0,
+                   lower_bound=-1.0, upper_bound=-1.0, clip_gradient=-1.0,
+                   bias_correction=True, num_tensors=None):
+    """Fused multi-tensor LAMB [multi_lamb.cc:174]: interleaved
+    (weight, grad, mean, var) groups, per-tensor lr/wd/step."""
+    n = len(arrays) // 4
+    outs, states = [], []
+    for i in range(n):
+        w, g, m, v = arrays[i * 4:(i + 1) * 4]
+        wf = w.astype(jnp.float32)
+        gf = g.astype(jnp.float32) * rescale_grad
+        if clip_gradient is not None and clip_gradient >= 0:
+            gf = jnp.clip(gf, -clip_gradient, clip_gradient)
+        nm = beta1 * m + (1.0 - beta1) * gf
+        nv = beta2 * v + (1.0 - beta2) * gf * gf
+        t = step_count[i] if step_count else 1
+        if bias_correction:
+            mh = nm / (1.0 - beta1 ** t)
+            vh = nv / (1.0 - beta2 ** t)
+        else:
+            mh, vh = nm, nv
+        d = mh / (jnp.sqrt(vh) + epsilon) + wds[i] * wf
+        lr = _trust(learning_rates[i], _norm(wf), _norm(d), lower_bound,
+                    upper_bound)
+        outs.append((wf - lr * d).astype(w.dtype))
+        states.extend([nm, nv])
+    return tuple(outs) + tuple(states)
+
+
+def _multi_lans_fn(*arrays, learning_rates=None, wds=None, step_count=None,
+                   beta1=0.9, beta2=0.999, epsilon=1e-6, rescale_grad=1.0,
+                   lower_bound=-1.0, upper_bound=-1.0, clip_gradient=-1.0,
+                   bias_correction=True, num_tensors=None):
+    """Fused multi-tensor LANS [multi_lans.cc:190; Zheng et al. 2020]:
+    LAMB plus a normalized-gradient term — each tensor's grad is first
+    scaled by 1/||g||, and the update blends the adam direction (weight
+    beta1) with the raw normalized gradient direction (weight 1-beta1),
+    each with its own trust ratio."""
+    n = len(arrays) // 4
+    outs, states = [], []
+    for i in range(n):
+        w, g, m, v = arrays[i * 4:(i + 1) * 4]
+        wf = w.astype(jnp.float32)
+        gf = g.astype(jnp.float32) * rescale_grad
+        gf = gf / jnp.maximum(_norm(gf), 1e-12)
+        if clip_gradient is not None and clip_gradient >= 0:
+            gf = jnp.clip(gf, -clip_gradient, clip_gradient)
+        nm = beta1 * m + (1.0 - beta1) * gf
+        nv = beta2 * v + (1.0 - beta2) * gf * gf
+        t = step_count[i] if step_count else 1
+        if bias_correction:
+            mh = nm / (1.0 - beta1 ** t)
+            vh = nv / (1.0 - beta2 ** t)
+        else:
+            mh, vh = nm, nv
+        w_norm = _norm(wf)
+        denom = jnp.sqrt(vh) + epsilon
+        d_adam = mh / denom + wds[i] * wf
+        d_grad = gf / denom + wds[i] * wf
+        lr_adam = _trust(learning_rates[i], w_norm, _norm(d_adam),
+                         lower_bound, upper_bound)
+        lr_grad = _trust(learning_rates[i], w_norm, _norm(d_grad),
+                         lower_bound, upper_bound)
+        new_w = wf - beta1 * lr_adam * d_adam \
+            - (1.0 - beta1) * lr_grad * d_grad
+        outs.append(new_w.astype(w.dtype))
+        states.extend([nm, nv])
+    return tuple(outs) + tuple(states)
+
+
+def _multi4_meta(stride=4):
+    def num_outputs(attrs):
+        return int(attrs["num_tensors"])
+
+    def mutates(attrs):
+        n = int(attrs["num_tensors"])
+        pos = []
+        for i in range(n):
+            pos.extend([i * stride + 2, i * stride + 3])
+        return pos
+
+    return num_outputs, mutates
+
+
+_no, _mut = _multi4_meta()
+_multi_lamb_fn.__name__ = "multi_lamb_update"
+_multi_lans_fn.__name__ = "multi_lans_update"
+register("multi_lamb_update", num_outputs=_no, differentiable=False,
+         mutates=_mut)(_multi_lamb_fn)
+register("multi_lans_update", num_outputs=_no, differentiable=False,
+         mutates=_mut)(_multi_lans_fn)
+alias("_multi_lamb_update", "multi_lamb_update")
+alias("_multi_lans_update", "multi_lans_update")
+
+
+# ---------------------------------------------------------------------------
+# count_sketch / fft — contrib/count_sketch.cc, fft.cc
+# ---------------------------------------------------------------------------
+@register("count_sketch")
+def count_sketch(data, h, s, out_dim=None, processing_batch_size=32):
+    """Count sketch projection [count_sketch.cc:36]: out[b, h[i]] +=
+    s[i] * data[b, i] — the FFT-friendly low-dim sketch from Compact
+    Bilinear Pooling."""
+    hh = h.reshape(-1).astype(jnp.int32)
+    ss = s.reshape(-1).astype(data.dtype)
+    B = data.shape[0]
+    out = jnp.zeros((B, int(out_dim)), data.dtype)
+    return out.at[:, hh].add(data * ss[None, :])
+
+
+@register("fft")
+def fft(data, compute_size=128):
+    """FFT over the last axis, interleaved real/imag output (..., 2n)
+    [fft-inl.h output layout]."""
+    f = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    return jnp.stack([f.real, f.imag], axis=-1).reshape(
+        data.shape[:-1] + (2 * data.shape[-1],)).astype(jnp.float32)
+
+
+@register("ifft")
+def ifft(data, compute_size=128):
+    """Inverse of the interleaved-layout fft [fft-inl.h]; input (..., 2n)
+    -> real (..., n)."""
+    n = data.shape[-1] // 2
+    c = data.reshape(data.shape[:-1] + (n, 2))
+    z = c[..., 0] + 1j * c[..., 1]
+    return jnp.fft.ifft(z, axis=-1).real.astype(jnp.float32) * n
+
+
+# ---------------------------------------------------------------------------
+# index ops — contrib/index_copy.cc, index_add.cc
+# ---------------------------------------------------------------------------
+@register("index_copy")
+def index_copy(old_tensor, index_vector, new_tensor):
+    """old[index[i]] = new[i]  [index_copy.cc:30]."""
+    return old_tensor.at[index_vector.astype(jnp.int32)].set(new_tensor)
+
+
+@register("index_add")
+def index_add(data, indices, updates):
+    """data[indices[i]] += updates[i] (duplicate indices accumulate)
+    [index_add.cc:30]."""
+    return data.at[indices.astype(jnp.int32)].add(updates)
+
+
+# ---------------------------------------------------------------------------
+# SyncBatchNorm — contrib/sync_batch_norm.cc
+# ---------------------------------------------------------------------------
+@register("sync_batch_norm", num_outputs=3)
+def sync_batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                    momentum=0.9, fix_gamma=True, use_global_stats=False,
+                    axis_name=None, ndev=1, key=None):
+    """Cross-device BatchNorm [_contrib_SyncBatchNorm, sync_batch_norm.cc:
+    105].  The reference synchronized per-GPU partial sums through a
+    host-side shared buffer + barrier (sync_batch_norm-inl.h:87); on TPU
+    the same reduction is ``lax.pmean`` over the mesh axis named
+    ``axis_name`` when tracing under shard_map/pjit — XLA lowers it to an
+    ICI all-reduce.  Outside an SPMD trace (axis_name=None) the global
+    batch already lives in one program, so plain batch statistics ARE the
+    synchronized statistics."""
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    if use_global_stats:
+        mean, var = moving_mean, moving_var
+        new_mm, new_mv = moving_mean, moving_var
+    else:
+        axes = (0,) + tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=axes)
+        sq = jnp.mean(jnp.square(x), axis=axes)
+        if axis_name:
+            mean = jax.lax.pmean(mean, axis_name)
+            sq = jax.lax.pmean(sq, axis_name)
+        var = sq - jnp.square(mean)
+        new_mm = momentum * moving_mean + (1.0 - momentum) * mean
+        new_mv = momentum * moving_var + (1.0 - momentum) * var
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    out = (x - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + eps)
+    return out * gamma.reshape(shape) + beta.reshape(shape), new_mm, new_mv
+
+
+alias("_contrib_SyncBatchNorm", "sync_batch_norm")
+alias("SyncBatchNorm", "sync_batch_norm")
+
+
+# ---------------------------------------------------------------------------
+# Hawkes process log-likelihood — contrib/hawkes_ll.cc
+# ---------------------------------------------------------------------------
+@register("hawkes_ll", num_outputs=2)
+def hawkes_ll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Joint log likelihood of K univariate Hawkes processes
+    [_contrib_hawkesll, hawkes_ll.cc:32; event recursion and remaining-
+    compensator terms follow hawkesll_forward / _forward_compensator,
+    hawkes_ll-inl.h:113,163].
+
+    lda (N,K) background intensity, alpha/beta (K,), state (N,K) carried
+    exp-decay memory, lags/marks (N,T) left-aligned ragged sequences,
+    valid_length/max_time (N,).  Returns (ll (N,), new_state (N,K)).
+    The sequence loop is one lax.scan; the whole batch vmaps over N —
+    differentiable w.r.t. lda/alpha/beta/state via jax autodiff (the
+    reference needed a hand-written backward kernel)."""
+    N, T = lags.shape
+    K = lda.shape[1]
+    marks_i = marks.astype(jnp.int32)
+    f32 = jnp.float32
+
+    def row(mu_r, s0, lag_r, mark_r, vl, mt):
+        def step(carry, inp):
+            s, last, t, ll = carry
+            lag, ci, j = inp
+            t2 = t + lag
+            d = t2 - last[ci]
+            ed = jnp.exp(-beta[ci] * d)
+            lam = mu_r[ci] + alpha[ci] * beta[ci] * s[ci] * ed
+            comp = mu_r[ci] * d + alpha[ci] * s[ci] * (1.0 - ed)
+            valid = j < vl
+            ll2 = ll + jnp.where(valid, jnp.log(lam) - comp, 0.0)
+            oh = jax.nn.one_hot(ci, K, dtype=s.dtype)
+            new_s = jnp.where(valid, s * (1 - oh) + oh * (1.0 + s[ci] * ed),
+                              s)
+            new_last = jnp.where(valid, last * (1 - oh) + oh * t2, last)
+            return (new_s, new_last, jnp.where(valid, t2, t), ll2), None
+
+        init = (s0.astype(f32), jnp.zeros(K, f32), f32(0), f32(0))
+        (s, last, _t, ll), _ = jax.lax.scan(
+            step, init, (lag_r.astype(f32), mark_r, jnp.arange(T)))
+        d = mt - last
+        ed = jnp.exp(-beta * d)
+        rem = mu_r * d + alpha * s * (1.0 - ed)
+        return ll - rem.sum(), s * ed
+
+    return jax.vmap(row)(lda.astype(f32), state, lags, marks_i,
+                         valid_length, max_time.astype(f32))
+
+
+alias("_contrib_hawkesll", "hawkes_ll")

@@ -107,6 +107,77 @@ def Embedding(data, weight, input_dim=None, output_dim=None,
     return core.embedding.fn(data, weight)
 
 
+def _rnn_num_outputs(attrs):
+    if not attrs.get("state_outputs", False):
+        return 1
+    return 3 if attrs.get("mode", "lstm") == "lstm" else 2
+
+
+def _rnn_fn(data, parameters, state, state_cell=None, state_size=None,
+            num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+            state_outputs=False, lstm_state_clip_min=None,
+            lstm_state_clip_max=None, **_ignored):
+    """1.x fused RNN op [rnn.cc:295 RNN]: all layers' weights+biases ride in
+    ONE flat parameter vector (weights for every (layer, direction) first,
+    then all biases — rnn-inl.h GetRnnParamSize layout), data is TNC.
+
+    The recurrence itself is the gluon fused path (gluon/rnn/rnn_layer.py
+    _rnn_forward — lax.scan with the input GEMM batched over time); this
+    wrapper only unpacks the packed vector.  Gate order matches _cell_step
+    (lstm: i,f,g,o).
+    """
+    from ..gluon.rnn.rnn_layer import _GATES, _rnn_forward
+
+    T, B, I = data.shape
+    H = int(state_size)
+    G = _GATES[mode]
+    ndir = 2 if bidirectional else 1
+    dt = data.dtype
+
+    shapes = []  # (layer, dir) -> (wi_shape, wh_shape)
+    for layer in range(int(num_layers)):
+        in_sz = I if layer == 0 else H * ndir
+        for _d in range(ndir):
+            shapes.append(((G * H, in_sz), (G * H, H)))
+    flat = parameters.reshape(-1)
+    off = 0
+    wis, whs = [], []
+    for wi_s, wh_s in shapes:
+        n = wi_s[0] * wi_s[1]
+        wis.append(flat[off:off + n].reshape(wi_s)); off += n
+        n = wh_s[0] * wh_s[1]
+        whs.append(flat[off:off + n].reshape(wh_s)); off += n
+    bis, bhs = [], []
+    for _ in shapes:
+        bis.append(flat[off:off + G * H]); off += G * H
+        bhs.append(flat[off:off + G * H]); off += G * H
+
+    weights = []
+    for wi, wh, bi, bh in zip(wis, whs, bis, bhs):
+        weights.extend([wi.astype(dt), wh.astype(dt), bi.astype(dt),
+                        bh.astype(dt)])
+    c0 = state_cell if state_cell is not None else jnp.zeros_like(state)
+    key = None
+    if p and float(p) > 0 and thread_state.is_training:
+        from .. import random as _random
+
+        key = _random.take_key()  # inter-layer dropout, training only
+    out, hT, cT = _rnn_forward(data, state, c0, mode, int(num_layers),
+                               bool(bidirectional), float(p), key,
+                               *weights)
+    if mode == "lstm" and lstm_state_clip_min is not None:
+        cT = jnp.clip(cT, lstm_state_clip_min, lstm_state_clip_max)
+    if not state_outputs:
+        return out
+    if mode == "lstm":
+        return out, hT, cT
+    return out, hT
+
+
+_rnn_fn.__name__ = "RNN"
+register("RNN", num_outputs=_rnn_num_outputs)(_rnn_fn)
+
+
 @register("ROIPooling")
 def ROIPooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
     """Max-pool ROI quantized to the feature grid [roi_pooling.cc:224].

@@ -1,0 +1,197 @@
+"""Random sampling ops — the reference's sample_*/random_* op families.
+
+Reference: src/operator/random/sample_op.cc (scalar-parameter random_*
+family), multisample_op.cc (tensor-parameter sample_* family — each element
+of the parameter tensors parameterizes its own distribution, drawing
+``shape`` extra trailing dims), shuffle_op.cc.
+
+TPU-native rendering: every draw pulls a fresh key from the framework RNG
+stream (mxnet_tpu/random.py take_key — counter-folded so eager call order
+reproduces under seed) and lowers to jax.random.* — stateless threefry on
+device, so samples are reproducible per (seed, call-index) which is a
+stronger contract than the reference's resource-pool RNG.
+
+All sampling ops are non-differentiable (reference: MakeZeroGradNodes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _key():
+    from .. import random as _random
+
+    return _random.take_key()
+
+
+def _mshape(param, shape):
+    """MultiSample shape rule (multisample_op.cc MultiSampleOpShape):
+    output = param.shape + shape."""
+    if shape is None:
+        return param.shape
+    extra = (shape,) if isinstance(shape, int) else tuple(shape)
+    return param.shape + extra
+
+
+def _bcast(param, shape):
+    """Broadcast a param tensor against trailing sample dims."""
+    out = _mshape(param, shape)
+    return jnp.broadcast_to(
+        param.reshape(param.shape + (1,) * (len(out) - param.ndim)), out), out
+
+
+@register("sample_uniform", differentiable=False)
+def sample_uniform(low, high, shape=None, dtype="float32"):
+    """Per-element uniform draws [multisample_op.cc uniform_desc]."""
+    lo, out = _bcast(low, shape)
+    hi, _ = _bcast(high, shape)
+    u = jax.random.uniform(_key(), out, jnp.dtype(dtype))
+    return lo + u * (hi - lo)
+
+
+@register("sample_normal", differentiable=False)
+def sample_normal(mu, sigma, shape=None, dtype="float32"):
+    m, out = _bcast(mu, shape)
+    s, _ = _bcast(sigma, shape)
+    return m + s * jax.random.normal(_key(), out, jnp.dtype(dtype))
+
+
+@register("sample_gamma", differentiable=False)
+def sample_gamma(alpha, beta, shape=None, dtype="float32"):
+    """Gamma(shape=alpha, scale=beta) — the reference's (alpha, beta)
+    parameterization is shape/scale."""
+    a, out = _bcast(alpha, shape)
+    b, _ = _bcast(beta, shape)
+    return jax.random.gamma(_key(), a.astype(jnp.dtype(dtype)),
+                            dtype=jnp.dtype(dtype)) * b
+
+
+@register("sample_exponential", differentiable=False)
+def sample_exponential(lam, shape=None, dtype="float32"):
+    l, out = _bcast(lam, shape)
+    return jax.random.exponential(_key(), out, jnp.dtype(dtype)) / l
+
+
+@register("sample_poisson", differentiable=False)
+def sample_poisson(lam, shape=None, dtype="float32"):
+    l, out = _bcast(lam, shape)
+    return jax.random.poisson(_key(), l, out).astype(jnp.dtype(dtype))
+
+
+@register("sample_negative_binomial", differentiable=False)
+def sample_negative_binomial(k, p, shape=None, dtype="float32"):
+    """NB(k failures, success prob p) via the Gamma-Poisson mixture
+    (sampler.h NegativeBinomialSampler uses the same construction)."""
+    kk, out = _bcast(k, shape)
+    pp, _ = _bcast(p, shape)
+    kf = jnp.asarray(kk, jnp.float32)
+    rate = jax.random.gamma(_key(), kf) * (1.0 - pp) / pp
+    return jax.random.poisson(_key(), rate, out).astype(jnp.dtype(dtype))
+
+
+@register("sample_generalized_negative_binomial", differentiable=False)
+def sample_generalized_negative_binomial(mu, alpha, shape=None,
+                                         dtype="float32"):
+    """GNB(mu, alpha): Poisson with Gamma(1/alpha, mu*alpha) rate
+    [sampler.h GeneralizedNegativeBinomialSampler]."""
+    m, out = _bcast(mu, shape)
+    a, _ = _bcast(alpha, shape)
+    kf = 1.0 / jnp.maximum(a, 1e-12)
+    rate = jax.random.gamma(_key(), kf.astype(jnp.float32)) * m * a
+    return jax.random.poisson(_key(), rate, out).astype(jnp.dtype(dtype))
+
+
+@register("sample_multinomial", differentiable=False)
+def sample_multinomial(data, shape=None, get_prob=False, dtype="int32"):
+    """Categorical draws from (batch, k) probabilities
+    [sample_multinomial_op.cc]: output (batch,) + shape indices."""
+    n = 1
+    if shape:
+        for s in ((shape,) if isinstance(shape, int) else shape):
+            n *= s
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    flat = jax.random.categorical(_key(), logits, axis=-1,
+                                  shape=(n,) + data.shape[:-1])
+    axes = tuple(range(1, flat.ndim)) + (0,)
+    out_shape = data.shape[:-1] + (
+        () if not shape else ((shape,) if isinstance(shape, int)
+                              else tuple(shape)))
+    out = jnp.transpose(flat, axes).reshape(out_shape).astype(
+        jnp.dtype(dtype))
+    if get_prob:
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1),
+            out.reshape(data.shape[:-1] + (-1,)).astype(jnp.int32),
+            axis=-1).reshape(out.shape)
+        return out, logp
+    return out
+
+
+# ---- scalar-parameter family (sample_op.cc random_* aliases) --------------
+@register("random_uniform", differentiable=False)
+def random_uniform(low=0.0, high=1.0, shape=(1,), dtype="float32"):
+    return jax.random.uniform(_key(), tuple(shape), jnp.dtype(dtype),
+                              low, high)
+
+
+@register("random_normal", differentiable=False)
+def random_normal(loc=0.0, scale=1.0, shape=(1,), dtype="float32"):
+    return loc + scale * jax.random.normal(_key(), tuple(shape),
+                                           jnp.dtype(dtype))
+
+
+@register("random_gamma", differentiable=False)
+def random_gamma(alpha=1.0, beta=1.0, shape=(1,), dtype="float32"):
+    return jax.random.gamma(_key(), alpha, tuple(shape),
+                            jnp.dtype(dtype)) * beta
+
+
+@register("random_exponential", differentiable=False)
+def random_exponential(lam=1.0, shape=(1,), dtype="float32"):
+    return jax.random.exponential(_key(), tuple(shape),
+                                  jnp.dtype(dtype)) / lam
+
+
+@register("random_poisson", differentiable=False)
+def random_poisson(lam=1.0, shape=(1,), dtype="float32"):
+    return jax.random.poisson(_key(), lam, tuple(shape)).astype(
+        jnp.dtype(dtype))
+
+
+@register("random_negative_binomial", differentiable=False)
+def random_negative_binomial(k=1, p=1.0, shape=(1,), dtype="float32"):
+    rate = jax.random.gamma(_key(), float(k), tuple(shape)) * (1.0 - p) / p
+    return jax.random.poisson(_key(), rate).astype(jnp.dtype(dtype))
+
+
+@register("random_generalized_negative_binomial", differentiable=False)
+def random_generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(1,),
+                                         dtype="float32"):
+    rate = jax.random.gamma(_key(), 1.0 / max(alpha, 1e-12),
+                            tuple(shape)) * mu * alpha
+    return jax.random.poisson(_key(), rate).astype(jnp.dtype(dtype))
+
+
+@register("random_randint", differentiable=False)
+def random_randint(low=0, high=1, shape=(1,), dtype="int32"):
+    return jax.random.randint(_key(), tuple(shape), low, high,
+                              jnp.dtype(dtype))
+
+
+@register("random_uniform_like", differentiable=False)
+def random_uniform_like(data, low=0.0, high=1.0):
+    return jax.random.uniform(_key(), data.shape, data.dtype, low, high)
+
+
+@register("random_normal_like", differentiable=False)
+def random_normal_like(data, loc=0.0, scale=1.0):
+    return loc + scale * jax.random.normal(_key(), data.shape, data.dtype)
+
+
+@register("shuffle", differentiable=False)
+def shuffle(data):
+    """Random permutation along axis 0 [shuffle_op.cc:128 _shuffle]."""
+    return jax.random.permutation(_key(), data, axis=0)

@@ -158,7 +158,9 @@ def invoke(op, inputs, attrs):
         for i, o in enumerate(outs):
             if _is_float(o._data):
                 o._entry = (node, i)
-        one = op.num_outputs == 1 and len(outs) == 1
+        n_rec = op.num_outputs(attrs) if callable(op.num_outputs) \
+            else op.num_outputs
+        one = n_rec == 1 and len(outs) == 1
         return _deliver(outs[0] if one else tuple(outs), out_arg)
 
     out = fn(*datas)

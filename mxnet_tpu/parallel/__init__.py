@@ -28,7 +28,8 @@ from ..ndarray.ndarray import NDArray
 from .optim import make_optimizer
 from .ring import ring_attention, ulysses_attention
 
-__all__ = ["make_mesh", "FusedTrainer", "PipelineTrainer", "make_train_step",
+__all__ = ["make_mesh", "make_hybrid_mesh", "FusedTrainer",
+           "PipelineTrainer", "make_train_step",
            "ring_attention", "ulysses_attention", "P", "Mesh",
            "NamedSharding", "shard_params", "param_pspec", "SUPPORTS_ZERO"]
 
@@ -63,6 +64,51 @@ def make_mesh(axes=None, devices=None):
                          % (dict(zip(names, sizes)), total, n))
     dev_array = _np.asarray(devices[:total]).reshape(sizes)
     return Mesh(dev_array, tuple(names))
+
+
+def make_hybrid_mesh(dcn_axes, ici_axes):
+    """Multi-slice mesh: outer axes ride the slow DCN (inter-slice network),
+    inner axes the fast ICI — the TPU rendering of the reference's
+    two-tier ps-lite/NCCL hierarchy (docs/.../distributed_training.md:
+    rack-local allreduce then cross-rack push/pull).
+
+    dcn_axes / ici_axes: dict name->size, e.g.
+    ``make_hybrid_mesh({'dp_dcn': 2}, {'dp': 2, 'tp': 2})`` on 8 devices.
+    Slice boundaries come from ``device.slice_index`` when the runtime
+    exposes it (multi-slice TPU); otherwise devices are grouped by
+    process (multi-host) or split contiguously (single host / CPU mesh) —
+    contiguous blocks keep intra-axis collectives on neighboring devices,
+    which is what mesh_utils.create_hybrid_device_mesh optimizes for.
+
+    Shardings over the combined mesh then place DCN-crossing collectives
+    on the outer axes only: e.g. grads psum over ('dp', 'dp_dcn') run as a
+    fast ICI reduce-scatter + a single small DCN allreduce.
+    """
+    devices = jax.devices()
+    n_dcn = 1
+    for s in dcn_axes.values():
+        n_dcn *= s
+    n_ici = 1
+    for s in ici_axes.values():
+        n_ici *= s
+    if n_dcn * n_ici > len(devices):
+        raise MXNetError("hybrid mesh needs %d devices, have %d"
+                         % (n_dcn * n_ici, len(devices)))
+    devices = devices[:n_dcn * n_ici]
+    key = (lambda d: getattr(d, "slice_index", None)) \
+        if getattr(devices[0], "slice_index", None) is not None \
+        else (lambda d: d.process_index)
+    groups = {}
+    for d in devices:
+        groups.setdefault(key(d), []).append(d)
+    if len(groups) == n_dcn and all(
+            len(g) == n_ici for g in groups.values()):
+        ordered = [d for k in sorted(groups) for d in groups[k]]
+    else:  # single host / CPU mesh: contiguous split
+        ordered = list(devices)
+    shape = tuple(dcn_axes.values()) + tuple(ici_axes.values())
+    names = tuple(dcn_axes.keys()) + tuple(ici_axes.keys())
+    return Mesh(_np.asarray(ordered).reshape(shape), names)
 
 
 def param_pspec(param, mesh):

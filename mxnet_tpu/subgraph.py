@@ -1,0 +1,179 @@
+"""Pluggable graph partitioning — the reference's SubgraphProperty /
+CustomPartitioner surface.
+
+Reference: src/operator/subgraph/subgraph_property.h (SubgraphProperty
+registry keyed by backend name, SelectSubgraphNode pattern matching),
+include/mxnet/lib_api.h:827 (external-library CustomPartitioner), invoked
+from python Symbol.optimize_for (python/mxnet/symbol/symbol.py:1477).
+
+TPU-native redesign: the compiler (XLA) already does fusion/placement, so a
+partitioner here is NOT a performance tool — it is the *extension hook* the
+reference exposes: a backend registers op-chain patterns and a fuse rule;
+``Symbol.optimize_for(backend)`` rewrites matching chains in the serialized
+op tree (symbol/__init__.py json_repr) into a single ``_subgraph`` node.
+The fused node either calls the backend's fuse fn or replays the recorded
+chain — XLA compiles the replayed chain as one fused kernel anyway, so
+correctness never depends on the backend doing anything clever.
+
+Usage::
+
+    prop = SubgraphProperty("mybackend")
+    prop.add_pattern(["dense", "relu"], name="dense_relu")
+    register_backend(prop)
+    optimized = sym.optimize_for("mybackend")
+"""
+from __future__ import annotations
+
+import ast
+import functools
+
+from .base import MXNetError
+
+_BACKENDS = {}
+
+
+class SubgraphProperty:
+    """A named backend holding op-chain patterns and optional fuse fns."""
+
+    def __init__(self, name):
+        self.name = name
+        self.patterns = []  # list of (op_chain, fused_name, fuse_fn|None)
+
+    def add_pattern(self, op_chain, name=None, fuse_fn=None):
+        """op_chain: outermost-first op names, e.g. ['relu', 'dense'] means
+        relu(dense(x, ...)).  fuse_fn(*leaf_arrays, attrs_list=...) -> array;
+        None replays the original ops (XLA fuses them into one kernel)."""
+        if not op_chain:
+            raise MXNetError("empty pattern")
+        fused = name or "_fused_" + "_".join(op_chain)
+        self.patterns.append((list(op_chain), fused, fuse_fn))
+        return self
+
+
+def register_backend(prop):
+    """Register a SubgraphProperty under its backend name (reference
+    MXNET_REGISTER_SUBGRAPH_BACKEND)."""
+    if not isinstance(prop, SubgraphProperty):
+        raise MXNetError("register_backend expects a SubgraphProperty")
+    _BACKENDS[prop.name.lower()] = prop
+    return prop
+
+
+def get_backend(name):
+    return _BACKENDS.get(str(name).lower())
+
+
+def list_backends():
+    return sorted(_BACKENDS)
+
+
+def _match_chain(node, chain):
+    """Match an outermost-first op-name chain down the FIRST input edge.
+    Returns node list [outermost .. innermost] or None."""
+    nodes, cur = [], node
+    for opname in chain:
+        if not isinstance(cur, dict) or cur.get("op") != opname:
+            return None
+        nodes.append(cur)
+        kids = cur.get("inputs", [])
+        cur = kids[0] if kids else None
+    return nodes
+
+
+def partition_json(tree, prop):
+    """Rewrite matching chains into _subgraph nodes (the SubgraphProperty
+    graph pass, subgraph_property.h:211).  Returns (new_tree, n_matches).
+
+    The fused node's ``inputs`` hold, in order: for every chain node from
+    outermost to innermost, that node's non-chain inputs (all inputs for
+    the innermost, inputs[1:] for the rest); ``chain`` records each node's
+    op, attrs, and how many of those inputs it owns (arity)."""
+    if not isinstance(tree, dict):
+        return tree, 0
+    for chain_ops, fused_name, _fn in prop.patterns:
+        nodes = _match_chain(tree, chain_ops)
+        if nodes:
+            child_json, chain_meta = [], []
+            inner = nodes[-1]
+            for nd_ in nodes:
+                own = nd_.get("inputs", []) if nd_ is inner \
+                    else nd_.get("inputs", [])[1:]
+                chain_meta.append({"op": nd_["op"],
+                                   "attrs": nd_.get("attrs", {}),
+                                   "arity": len(own)})
+                child_json.extend(own)
+            total = 1
+            new_inputs = []
+            for k in child_json:
+                nk, c = partition_json(k, prop)
+                new_inputs.append(nk)
+                total += c
+            return ({"op": "_subgraph", "backend": prop.name,
+                     "fused": fused_name, "chain": chain_meta,
+                     "inputs": new_inputs}, total)
+    total = 0
+    kids = tree.get("inputs")
+    if kids:
+        new_kids = []
+        for k in kids:
+            nk, c = partition_json(k, prop)
+            new_kids.append(nk)
+            total += c
+        tree = dict(tree, inputs=new_kids)
+    return tree, total
+
+
+def _parse_attrs(a):
+    out = {}
+    for k, v in (a or {}).items():
+        try:
+            out[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            out[k] = v
+    return out
+
+
+def rebuild_subgraph_node(node, rebuild):
+    """Turn a _subgraph json node back into an executable Symbol (hooked
+    from symbol._rebuild)."""
+    from .ops.registry import get_op
+    from .symbol import Symbol
+
+    prop = get_backend(node.get("backend"))
+    children = [rebuild(c) for c in node.get("inputs", [])]
+    chain = node.get("chain", [])
+    fuse_fn = None
+    if prop is not None:
+        for _ops, fused_name, fn in prop.patterns:
+            if fused_name == node.get("fused"):
+                fuse_fn = fn
+
+    def run_chain(vals):
+        # slice each chain node's own leaf values (outermost..innermost)
+        slices, off = [], 0
+        for meta in chain:
+            slices.append(vals[off:off + meta["arity"]])
+            off += meta["arity"]
+        acc = None
+        for meta, own in zip(reversed(chain), reversed(slices)):
+            args = own if acc is None else [acc] + list(own)
+            op = get_op(meta["op"])
+            attrs = _parse_attrs(meta["attrs"])
+            f = op.fn if not attrs else functools.partial(op.fn, **attrs)
+            acc = f(*args)
+        return acc
+
+    if fuse_fn is not None:
+        def fn(env):
+            vals = [c._fn(env) for c in children]
+            return fuse_fn(*vals, attrs_list=[_parse_attrs(m["attrs"])
+                                              for m in chain])
+    else:
+        def fn(env):
+            return run_chain([c._fn(env) for c in children])
+
+    inputs = []
+    for c in children:
+        inputs.extend(c._inputs)
+    return Symbol(fn, inputs, name=node.get("fused", "_subgraph"),
+                  json_repr=node)

@@ -69,8 +69,20 @@ class Symbol:
             return value
         if isinstance(value, NDArray):
             data = value._data
-            return Symbol(lambda env: data, [], name="const")
-        return Symbol(lambda env: value, [], name="const")
+            return Symbol(lambda env: data, [], name="const",
+                          json_repr={"op": "const",
+                                     "value": data.tolist(),
+                                     "dtype": str(data.dtype)})
+        if hasattr(value, "dtype") and hasattr(value, "tolist"):
+            # jnp/np array constant (e.g. from load_json): keep the json
+            # serializable — a raw array object would break re-save
+            data = value
+            return Symbol(lambda env: data, [], name="const",
+                          json_repr={"op": "const",
+                                     "value": data.tolist(),
+                                     "dtype": str(data.dtype)})
+        return Symbol(lambda env: value, [], name="const",
+                      json_repr={"op": "const", "value": value})
 
     @staticmethod
     def _apply(opname, *args, **attrs):
@@ -192,19 +204,30 @@ class Symbol:
 
     def optimize_for(self, backend=None, args=None, aux=None, ctx=None,
                      **kwargs):
-        """Graph-partition backends collapse into XLA (reference
-        symbol.py:1477 ran the registered SubgraphProperty).  Unknown
+        """Run a registered SubgraphProperty pass (reference symbol.py:1477;
+        see mxnet_tpu/subgraph.py for the backend registry).  The built-in
+        backend names are no-ops (XLA already fuses); a registered custom
+        backend rewrites matching op chains into _subgraph nodes; unknown
         backend strings fail loudly — the reference errored for
         unregistered backends too; silently succeeding would fake
         MKLDNN/TensorRT support."""
-        if isinstance(backend, str) and backend.lower() not in \
-                self._KNOWN_BACKENDS:
-            from ..base import MXNetError
+        from .. import subgraph as _subgraph
 
-            raise MXNetError(
-                "unknown partitioning backend %r: the TPU build has one "
-                "compiler backend (XLA); MKLDNN/TensorRT-style plugin "
-                "partitioners do not exist here" % (backend,))
+        if isinstance(backend, str):
+            prop = _subgraph.get_backend(backend)
+            if prop is not None:
+                new_json, n = _subgraph.partition_json(self._json, prop)
+                if n == 0:
+                    return self
+                return _rebuild(new_json)
+            if backend.lower() not in self._KNOWN_BACKENDS:
+                from ..base import MXNetError
+
+                raise MXNetError(
+                    "unknown partitioning backend %r: the TPU build has "
+                    "one compiler backend (XLA); register a "
+                    "SubgraphProperty (mxnet_tpu.subgraph) for custom "
+                    "partitioning" % (backend,))
         return self
 
     def __repr__(self):
@@ -251,12 +274,45 @@ def Group(symbols):
     return Symbol(fn, inputs, name="group")
 
 
+def _rebuild(node):
+    """Reconstruct a Symbol from its serialized op tree (the counterpart of
+    Symbol._apply's json_repr; reference symbol.load ran the C++ json graph
+    loader, python/mxnet/symbol/symbol.py:2917)."""
+    import ast
+
+    op = node.get("op")
+    if op == "null":
+        return Symbol.var(node.get("name", "data"),
+                          shape=node.get("shape"))
+    if op == "_subgraph":
+        from ..subgraph import rebuild_subgraph_node
+
+        return rebuild_subgraph_node(node, _rebuild)
+    if op == "const":
+        if "value" not in node:
+            raise MXNetError(
+                "symbol json predates const serialization; re-export")
+        value = node["value"]
+        if isinstance(value, list):
+            import jax.numpy as jnp
+
+            value = jnp.asarray(value, dtype=node.get("dtype", "float32"))
+        return Symbol._lift(value)
+    attrs = {}
+    for k, v in node.get("attrs", {}).items():
+        try:
+            attrs[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            attrs[k] = v  # non-literal attr: keep the string form
+    children = [_rebuild(c) for c in node.get("inputs", [])]
+    return Symbol._apply(op, *children, **attrs)
+
+
 def load_json(json_str):
     data = _json.loads(json_str)
     if "mxnet_tpu_symbol" not in data:
         raise MXNetError("not a mxnet_tpu symbol json")
-    raise MXNetError("symbol json stores structure only; rebuild via the "
-                     "original construction code (see SymbolBlock.imports)")
+    return _rebuild(data["mxnet_tpu_symbol"])
 
 
 def load(fname):

@@ -1,0 +1,236 @@
+"""Contrib tail ops: adamw, multi-lamb/lans, count_sketch, fft, index ops,
+SyncBatchNorm (reference tests: test_contrib_optimizer.py, test_operator.py
+fft/count_sketch sections, test_gluon.py SyncBatchNorm)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _arr(a, dtype=np.float32):
+    return nd.array(np.asarray(a, dtype=dtype))
+
+
+def _rs(seed=0):
+    return np.random.RandomState(seed)
+
+
+class TestAdamW:
+    def test_adamw_update_decoupled_wd(self):
+        rs = _rs(0)
+        w = rs.randn(6).astype(np.float32)
+        g = rs.randn(6).astype(np.float32)
+        m, v = _arr(np.zeros(6)), _arr(np.zeros(6))
+        out = nd.adamw_update(_arr(w), _arr(g), m, v, _arr([1.0]), lr=0.01,
+                              eta=1.0, wd=0.1)
+        m_ref = 0.1 * g
+        v_ref = 0.001 * g * g
+        ref = w - (0.01 * m_ref / (np.sqrt(v_ref) + 1e-8) + 0.1 * w)
+        np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5)
+
+    def test_adamw_skips_on_nonfinite_scale(self):
+        w = np.ones(4, np.float32)
+        m, v = _arr(np.zeros(4)), _arr(np.zeros(4))
+        out = nd.adamw_update(_arr(w), _arr(np.ones(4)), m, v,
+                              _arr([np.inf]), lr=0.1)
+        np.testing.assert_allclose(out.asnumpy(), w)  # update skipped
+        np.testing.assert_allclose(m.asnumpy(), np.zeros(4))
+
+    def test_mp_adamw_update(self):
+        w32 = np.linspace(-1, 1, 6).astype(np.float32)
+        w16 = _arr(w32).astype("bfloat16")
+        m, v = _arr(np.zeros(6)), _arr(np.zeros(6))
+        master = _arr(w32)
+        out = nd.mp_adamw_update(w16, _arr(np.full(6, 1.0)).astype(
+            "bfloat16"), m, v, _arr([1.0]), master, lr=0.01)
+        assert str(out.dtype) == "bfloat16"
+        assert not np.allclose(master.asnumpy(), w32)
+
+
+class TestMultiLambLans:
+    def _groups(self, n=2, d=6):
+        rs = _rs(1)
+        flat, raw = [], []
+        for _ in range(n):
+            w = rs.randn(d).astype(np.float32)
+            g = rs.randn(d).astype(np.float32)
+            m = np.zeros(d, np.float32)
+            v = np.zeros(d, np.float32)
+            raw.append((w, g, m, v))
+            flat += [_arr(w), _arr(g), _arr(m), _arr(v)]
+        return raw, flat
+
+    def test_multi_lamb_matches_single_lamb_math(self):
+        raw, flat = self._groups()
+        outs = nd.multi_lamb_update(*flat, learning_rates=[0.01, 0.02],
+                                    wds=[0.0, 0.1], step_count=[1, 1],
+                                    num_tensors=2)
+        for i, (w, g, m0, v0) in enumerate(raw):
+            m = 0.1 * g
+            v = 0.001 * g * g
+            mh = m / (1 - 0.9)
+            vh = v / (1 - 0.999)
+            d = mh / (np.sqrt(vh) + 1e-6) + [0.0, 0.1][i] * w
+            lr = [0.01, 0.02][i] * np.linalg.norm(w) / np.linalg.norm(d)
+            np.testing.assert_allclose(outs[i].asnumpy(), w - lr * d,
+                                       rtol=1e-4)
+
+    def test_multi_lans_runs_and_updates_state(self):
+        raw, flat = self._groups()
+        mean_handles = [flat[2], flat[6]]
+        outs = nd.multi_lans_update(*flat, learning_rates=[0.01, 0.01],
+                                    wds=[0.0, 0.0], step_count=[1, 1],
+                                    num_tensors=2)
+        for i, (w, g, _m, _v) in enumerate(raw):
+            assert not np.allclose(outs[i].asnumpy(), w)
+        for h in mean_handles:
+            assert not np.allclose(h.asnumpy(), 0)  # state written back
+
+
+class TestSketchFFT:
+    def test_count_sketch_known_result(self):
+        data = _arr([[1.0, 2.0, 3.0]])
+        h = _arr([0, 1, 0], dtype=np.int32)
+        s = _arr([1.0, -1.0, 1.0])
+        out = nd.count_sketch(data, h, s, out_dim=2).asnumpy()
+        np.testing.assert_allclose(out, [[4.0, -2.0]])
+
+    def test_fft_ifft_roundtrip(self):
+        rs = _rs(2)
+        x = rs.randn(2, 8).astype(np.float32)
+        f = nd.fft(x if isinstance(x, np.ndarray) is False else _arr(x))
+        assert f.shape == (2, 16)
+        ref = np.fft.fft(x, axis=-1)
+        got = f.asnumpy().reshape(2, 8, 2)
+        np.testing.assert_allclose(got[..., 0], ref.real, rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(got[..., 1], ref.imag, rtol=1e-4,
+                                   atol=1e-4)
+        back = nd.ifft(f)  # reference convention: scaled by n
+        np.testing.assert_allclose(back.asnumpy(), x * 8, rtol=1e-4,
+                                   atol=1e-4)
+
+
+class TestIndexOps:
+    def test_index_copy(self):
+        old = _arr(np.zeros((4, 2)))
+        new = _arr([[1.0, 1], [2, 2]])
+        idx = _arr([1, 3], dtype=np.int32)
+        out = nd.index_copy(old, idx, new).asnumpy()
+        np.testing.assert_allclose(out, [[0, 0], [1, 1], [0, 0], [2, 2]])
+
+    def test_index_add_accumulates_duplicates(self):
+        base = _arr(np.zeros((3, 2)))
+        upd = _arr([[1.0, 1], [2, 2], [3, 3]])
+        idx = _arr([0, 0, 2], dtype=np.int32)
+        out = nd.index_add(base, idx, upd).asnumpy()
+        np.testing.assert_allclose(out, [[3, 3], [0, 0], [3, 3]])
+
+
+class TestSyncBatchNorm:
+    def test_matches_batch_stats_single_program(self):
+        rs = _rs(3)
+        x = rs.randn(4, 3, 2, 2).astype(np.float32)
+        gamma = np.ones(3, np.float32)
+        beta = np.zeros(3, np.float32)
+        mm = np.zeros(3, np.float32)
+        mv = np.ones(3, np.float32)
+        out, new_mm, new_mv = nd.sync_batch_norm(
+            _arr(x), _arr(gamma), _arr(beta), _arr(mm), _arr(mv),
+            eps=1e-5, fix_gamma=False)
+        mean = x.mean(axis=(0, 2, 3))
+        var = x.var(axis=(0, 2, 3))
+        ref = (x - mean[None, :, None, None]) / np.sqrt(
+            var[None, :, None, None] + 1e-5)
+        np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(new_mv.asnumpy(),
+                                   0.9 * 1.0 + 0.1 * var, rtol=1e-4)
+
+    def test_pmean_sync_across_mesh_axis(self):
+        """SPMD path: per-shard stats pmean'd over 'dp' == global stats."""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from mxnet_tpu import parallel
+        from mxnet_tpu.ops.contrib_tail import sync_batch_norm as sbn_op
+
+        rs = _rs(4)
+        x = rs.randn(8, 3, 2, 2).astype(np.float32)
+        g = np.ones(3, np.float32)
+        b = np.zeros(3, np.float32)
+        mm = np.zeros(3, np.float32)
+        mv = np.ones(3, np.float32)
+        mesh = parallel.make_mesh({"dp": 8})
+
+        def f(xs, gs, bs, mms, mvs):
+            out, _, _ = sbn_op.fn(xs, gs, bs, mms, mvs, eps=1e-5,
+                                  fix_gamma=False, axis_name="dp")
+            return out
+
+        got = shard_map(
+            f, mesh=mesh,
+            in_specs=(P("dp"), P(), P(), P(), P()),
+            out_specs=P("dp"))(
+                jnp.asarray(x), jnp.asarray(g), jnp.asarray(b),
+                jnp.asarray(mm), jnp.asarray(mv))
+        mean = x.mean(axis=(0, 2, 3))
+        var = x.var(axis=(0, 2, 3))
+        ref = (x - mean[None, :, None, None]) / np.sqrt(
+            var[None, :, None, None] + 1e-5)
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4,
+                                   atol=1e-4)
+
+
+class TestHawkes:
+    def test_hawkes_ll_matches_manual_computation(self):
+        """One process (K=1), two events: hand-computed recursion from
+        hawkesll_forward (hawkes_ll-inl.h:113)."""
+        mu, a, b = 1.5, 0.2, 1.0
+        lags = np.array([[2.0, 3.0]], np.float32)
+        marks = np.zeros((1, 2), np.int32)
+        state0 = np.zeros((1, 1), np.float32)
+        vl = np.array([2.0], np.float32)
+        mt = np.array([10.0], np.float32)
+
+        # manual: event 1 at t=2 (last=0, s=0)
+        ll = 0.0; s = 0.0; last = 0.0; t = 2.0
+        d = t - last; ed = np.exp(-b * d)
+        ll += np.log(mu + a * b * s * ed) - (mu * d + a * s * (1 - ed))
+        s = 1 + s * ed; last = t
+        # event 2 at t=5
+        t = 5.0; d = t - last; ed = np.exp(-b * d)
+        ll += np.log(mu + a * b * s * ed) - (mu * d + a * s * (1 - ed))
+        s = 1 + s * ed; last = t
+        # remaining compensator to max_time
+        d = 10.0 - last; ed = np.exp(-b * d)
+        ll -= mu * d + a * s * (1 - ed)
+        s_final = s * ed
+
+        out_ll, out_state = nd.hawkes_ll(
+            _arr([[mu]]), _arr([a]), _arr([b]), _arr(state0), _arr(lags),
+            nd.array(marks), _arr(vl), _arr(mt))
+        np.testing.assert_allclose(out_ll.asnumpy(), [ll], rtol=1e-5)
+        np.testing.assert_allclose(out_state.asnumpy(), [[s_final]],
+                                   rtol=1e-5)
+
+    def test_hawkes_ll_ragged_batch(self):
+        """valid_length masks trailing junk; K=2 marks route to their own
+        state slots."""
+        N, T, K = 3, 4, 2
+        rs = _rs(5)
+        lags = np.abs(rs.rand(N, T)).astype(np.float32)
+        marks = rs.randint(0, K, (N, T)).astype(np.int32)
+        vl = np.array([1.0, 3.0, 0.0], np.float32)
+        mt = np.full(N, 50.0, np.float32)
+        lda = np.full((N, K), 1.0, np.float32)
+        out_ll, out_state = nd.hawkes_ll(
+            _arr(lda), _arr([0.2, 0.3]), _arr([1.0, 2.0]),
+            _arr(np.zeros((N, K))), _arr(lags), nd.array(marks),
+            _arr(vl), _arr(mt))
+        assert out_ll.shape == (N,) and out_state.shape == (N, K)
+        # row with vl=0 sees only the compensator: ll = -sum_k mu*T
+        np.testing.assert_allclose(out_ll.asnumpy()[2], -2 * 50.0,
+                                   rtol=1e-5)

@@ -157,3 +157,116 @@ def test_batchify():
     padded = Pad(axis=0, val=-1)([np.ones((2,)), np.ones((4,))])
     assert padded.shape == (2, 4)
     assert padded.asnumpy()[0, 3] == -1
+
+
+# ---------------------------------------------------------------------------
+# gluon.contrib.data.vision bbox transforms (reference
+# gluon/contrib/data/vision/transforms/bbox/bbox.py)
+# ---------------------------------------------------------------------------
+def _bbox_img():
+    rs = np.random.RandomState(0)
+    img = nd.array(rs.randint(0, 255, (40, 60, 3)), dtype="uint8")
+    boxes = nd.array(np.array([[10.0, 5, 30, 25, 1],
+                               [40, 20, 55, 35, 2]], np.float32))
+    return img, boxes
+
+
+def test_bbox_flip_left_right():
+    from mxnet_tpu.gluon.contrib.data.vision import \
+        ImageBboxRandomFlipLeftRight
+
+    img, boxes = _bbox_img()
+    out, nb = ImageBboxRandomFlipLeftRight(p=1.0)(img, boxes)
+    assert out.shape == img.shape
+    b = nb.asnumpy()
+    # first box x-range (10, 30) -> (60-30, 60-10)
+    np.testing.assert_allclose(b[0, :4], [30, 5, 50, 25])
+    np.testing.assert_allclose(b[0, 4], 1)  # extra column intact
+    # double flip restores
+    out2, nb2 = ImageBboxRandomFlipLeftRight(p=1.0)(out, nb)
+    np.testing.assert_allclose(nb2.asnumpy(), boxes.asnumpy())
+
+
+def test_bbox_crop_drops_outside_boxes():
+    from mxnet_tpu.gluon.contrib.data.vision import ImageBboxCrop
+
+    img, boxes = _bbox_img()
+    out, nb = ImageBboxCrop((5, 0, 30, 30))(img, boxes)
+    assert out.shape == (30, 30, 3)
+    b = nb.asnumpy()
+    assert b.shape[0] == 1  # second box center (47.5, 27.5) outside
+    np.testing.assert_allclose(b[0, :4], [5, 5, 25, 25])
+
+
+def test_bbox_random_expand_shifts_boxes():
+    from mxnet_tpu.gluon.contrib.data.vision import ImageBboxRandomExpand
+
+    np.random.seed(0)
+    img, boxes = _bbox_img()
+    out, nb = ImageBboxRandomExpand(max_ratio=2.0, fill=7, p=1.0)(img, boxes)
+    assert out.shape[0] >= 40 and out.shape[1] >= 60
+    b, b0 = nb.asnumpy(), boxes.asnumpy()
+    w0 = b0[:, 2] - b0[:, 0]
+    np.testing.assert_allclose(b[:, 2] - b[:, 0], w0)  # sizes preserved
+
+
+def test_bbox_resize_scales_boxes():
+    from mxnet_tpu.gluon.contrib.data.vision import ImageBboxResize
+
+    img, boxes = _bbox_img()
+    out, nb = ImageBboxResize((30, 20))(img, boxes)
+    assert out.shape == (20, 30, 3)
+    b = nb.asnumpy()
+    np.testing.assert_allclose(b[0, :4], [5, 2.5, 15, 12.5])
+
+
+def test_bbox_random_crop_with_constraints_keeps_valid_boxes():
+    from mxnet_tpu.gluon.contrib.data.vision import \
+        ImageBboxRandomCropWithConstraints
+
+    import random as pyrandom
+
+    pyrandom.seed(3)
+    img, boxes = _bbox_img()
+    t = ImageBboxRandomCropWithConstraints(p=1.0, max_trial=20)
+    out, nb = t(img, boxes)
+    b = nb.asnumpy()
+    assert b.shape[0] >= 1
+    assert (b[:, 2] > b[:, 0]).all() and (b[:, 3] > b[:, 1]).all()
+    assert b[:, 2].max() <= out.shape[1] and b[:, 3].max() <= out.shape[0]
+
+
+def test_contrib_image_dataloader_imglist(tmp_path):
+    from mxnet_tpu.gluon.contrib.data.vision import ImageDataLoader
+
+    rs = np.random.RandomState(0)
+    paths = []
+    for i in range(6):
+        p = str(tmp_path / ("im%d.npy" % i))
+        np.save(p, rs.randint(0, 255, (32, 40, 3)).astype(np.uint8))
+        paths.append(p)
+    imglist = [[float(i % 3), p] for i, p in enumerate(paths)]
+    loader = ImageDataLoader(batch_size=2, data_shape=(3, 24, 24),
+                             imglist=imglist, path_root="",
+                             rand_mirror=True, rand_crop=True)
+    batches = list(loader)
+    assert len(batches) == 3
+    data, label = batches[0]
+    assert data.shape == (2, 3, 24, 24)
+    assert label.shape[0] == 2
+
+
+def test_contrib_bbox_dataloader():
+    from mxnet_tpu.gluon.contrib.data.vision import ImageBboxDataLoader
+
+    rs = np.random.RandomState(1)
+    images = [rs.randint(0, 255, (40, 40, 3)).astype(np.uint8)
+              for _ in range(4)]
+    labels = [np.array([[0, 0.1, 0.1, 0.6, 0.6]], np.float32)
+              for _ in range(4)]
+    loader = ImageBboxDataLoader(batch_size=2, data_shape=(3, 32, 32),
+                                 images=images, labels=labels,
+                                 rand_mirror=True)
+    batches = list(iter(loader))
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (2, 3, 32, 32)

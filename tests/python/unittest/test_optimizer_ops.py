@@ -382,3 +382,112 @@ class TestLegacyOps:
         # bins: h {0,1}x{2,3}, w {0}x{1} -> maxima 4,5 / 12,13
         np.testing.assert_allclose(out.asnumpy()[0, 0],
                                    [[4.0, 5.0], [12.0, 13.0]])
+
+
+class TestLegacyRNN:
+    def _packed_params(self, rs, mode, layers, ndir, I, H):
+        from mxnet_tpu.gluon.rnn.rnn_layer import _GATES
+
+        G = _GATES[mode]
+        ws, bs = [], []
+        for layer in range(layers):
+            in_sz = I if layer == 0 else H * ndir
+            for _ in range(ndir):
+                ws.append(rs.randn(G * H, in_sz).astype(np.float32) * 0.2)
+                ws.append(rs.randn(G * H, H).astype(np.float32) * 0.2)
+        for layer in range(layers):
+            for _ in range(ndir):
+                bs.append(rs.randn(G * H).astype(np.float32) * 0.1)
+                bs.append(rs.randn(G * H).astype(np.float32) * 0.1)
+        return ws, bs, np.concatenate([w.ravel() for w in ws]
+                                      + [b.ravel() for b in bs])
+
+    def test_rnn_lstm_matches_manual_scan(self):
+        """Packed-parameter RNN op == direct _rnn_forward on the unpacked
+        weights (same kernel, so this pins the packing layout)."""
+        from mxnet_tpu.gluon.rnn.rnn_layer import _rnn_forward
+        import jax.numpy as jnp
+
+        rs = _rs(20)
+        T, B, I, H = 3, 2, 4, 5
+        ws, bs, packed = self._packed_params(rs, "lstm", 1, 1, I, H)
+        x = rs.randn(T, B, I).astype(np.float32)
+        h0 = rs.randn(1, B, H).astype(np.float32)
+        c0 = rs.randn(1, B, H).astype(np.float32)
+        out, hT, cT = nd.RNN(_arr(x), _arr(packed), _arr(h0), _arr(c0),
+                             state_size=H, num_layers=1, mode="lstm",
+                             state_outputs=True)
+        flat = []
+        for i in range(0, len(ws), 2):
+            flat.extend([jnp.asarray(ws[i]), jnp.asarray(ws[i + 1]),
+                         jnp.asarray(bs[i]), jnp.asarray(bs[i + 1])])
+        ref_out, ref_h, ref_c = _rnn_forward(
+            jnp.asarray(x), jnp.asarray(h0), jnp.asarray(c0), "lstm", 1,
+            False, 0.0, None, *flat)
+        np.testing.assert_allclose(out.asnumpy(), np.asarray(ref_out),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(hT.asnumpy(), np.asarray(ref_h),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(cT.asnumpy(), np.asarray(ref_c),
+                                   rtol=1e-5)
+
+    def test_rnn_bidirectional_gru_shapes(self):
+        rs = _rs(21)
+        T, B, I, H = 4, 3, 5, 6
+        _, _, packed = self._packed_params(rs, "gru", 2, 2, I, H)
+        x = rs.randn(T, B, I).astype(np.float32)
+        h0 = np.zeros((4, B, H), np.float32)  # layers*ndir
+        out, hT = nd.RNN(_arr(x), _arr(packed), _arr(h0), None,
+                         state_size=H, num_layers=2, mode="gru",
+                         bidirectional=True, state_outputs=True)
+        assert out.shape == (T, B, 2 * H)
+        assert hT.shape == (4, B, H)
+
+    def test_rnn_single_output_mode(self):
+        rs = _rs(22)
+        _, _, packed = self._packed_params(rs, "rnn_tanh", 1, 1, 3, 4)
+        x = rs.randn(2, 2, 3).astype(np.float32)
+        h0 = np.zeros((1, 2, 4), np.float32)
+        out = nd.RNN(_arr(x), _arr(packed), _arr(h0), None, state_size=4,
+                     num_layers=1, mode="rnn_tanh")
+        assert out.shape == (2, 2, 4)
+
+
+def test_rnn_single_output_under_record():
+    """Callable num_outputs must resolve on the autograd path too: one
+    output stays a bare NDArray inside record()."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon.rnn.rnn_layer import _GATES
+
+    rs = _rs(23)
+    H, I = 4, 3
+    G = _GATES["lstm"]
+    packed = np.concatenate([
+        rs.randn(G * H * I).astype(np.float32),
+        rs.randn(G * H * H).astype(np.float32),
+        rs.randn(2 * G * H).astype(np.float32)]) * 0.1
+    x = _arr(rs.randn(2, 2, I))
+    x.attach_grad()
+    h0 = _arr(np.zeros((1, 2, H)))
+    with autograd.record():
+        out = nd.RNN(x, _arr(packed), h0, None, state_size=H,
+                     num_layers=1, mode="lstm")
+        assert hasattr(out, "sum"), "must be a bare NDArray, not a tuple"
+        loss = out.sum()
+    loss.backward()
+    assert x.grad.shape == x.shape
+
+
+def test_trainer_zero_rejects_update_on_kvstore():
+    import pytest as _pytest
+
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    mesh = parallel.make_mesh({"dp": 8})
+    with _pytest.raises(MXNetError):
+        gluon.Trainer(net.collect_params(), "adam", zero=True, mesh=mesh,
+                      update_on_kvstore=True)

@@ -1,0 +1,118 @@
+"""sample_*/random_* op family tests (reference test_random.py model:
+moment checks against analytic mean/variance, reproducibility under seed).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+N = 4000
+
+
+def setup_function(_):
+    mx.random.seed(0)
+
+
+def _arr(a):
+    return nd.array(np.asarray(a, np.float32))
+
+
+def test_sample_uniform_shape_and_range():
+    low = _arr([[0.0, 5.0]])
+    high = _arr([[1.0, 6.0]])
+    out = nd.sample_uniform(low, high, shape=(N,))
+    assert out.shape == (1, 2, N)
+    o = out.asnumpy()
+    assert o[0, 0].min() >= 0.0 and o[0, 0].max() <= 1.0
+    assert o[0, 1].min() >= 5.0 and o[0, 1].max() <= 6.0
+    np.testing.assert_allclose(o.mean(axis=-1)[0], [0.5, 5.5], atol=0.05)
+
+
+def test_sample_normal_moments():
+    mu = _arr([0.0, 10.0])
+    sigma = _arr([1.0, 2.0])
+    o = nd.sample_normal(mu, sigma, shape=(N,)).asnumpy()
+    np.testing.assert_allclose(o.mean(axis=-1), [0.0, 10.0], atol=0.15)
+    np.testing.assert_allclose(o.std(axis=-1), [1.0, 2.0], atol=0.15)
+
+
+def test_sample_gamma_moments():
+    alpha, beta = _arr([2.0]), _arr([3.0])
+    o = nd.sample_gamma(alpha, beta, shape=(N,)).asnumpy()
+    np.testing.assert_allclose(o.mean(), 6.0, rtol=0.1)  # E = alpha*beta
+    np.testing.assert_allclose(o.var(), 18.0, rtol=0.25)  # V = alpha*beta^2
+
+
+def test_sample_exponential_poisson():
+    lam = _arr([2.0])
+    e = nd.sample_exponential(lam, shape=(N,)).asnumpy()
+    np.testing.assert_allclose(e.mean(), 0.5, rtol=0.1)
+    p = nd.sample_poisson(lam, shape=(N,)).asnumpy()
+    np.testing.assert_allclose(p.mean(), 2.0, rtol=0.1)
+    assert np.all(p == np.round(p))
+
+
+def test_sample_negative_binomial_mean():
+    k, p = _arr([4.0]), _arr([0.5])
+    o = nd.sample_negative_binomial(k, p, shape=(N,)).asnumpy()
+    # E = k(1-p)/p = 4
+    np.testing.assert_allclose(o.mean(), 4.0, rtol=0.15)
+
+
+def test_sample_gnb_mean():
+    mu, alpha = _arr([3.0]), _arr([0.2])
+    o = nd.sample_generalized_negative_binomial(
+        mu, alpha, shape=(N,)).asnumpy()
+    np.testing.assert_allclose(o.mean(), 3.0, rtol=0.15)
+    # V = mu + alpha*mu^2 = 3 + 1.8
+    np.testing.assert_allclose(o.var(), 4.8, rtol=0.3)
+
+
+def test_sample_multinomial_distribution():
+    probs = _arr([[0.2, 0.8], [0.9, 0.1]])
+    o = nd.sample_multinomial(probs, shape=(N,)).asnumpy()
+    assert o.shape == (2, N)
+    np.testing.assert_allclose((o[0] == 1).mean(), 0.8, atol=0.05)
+    np.testing.assert_allclose((o[1] == 0).mean(), 0.9, atol=0.05)
+
+
+def test_sample_multinomial_get_prob():
+    probs = _arr([[0.25, 0.75]])
+    out, logp = nd.sample_multinomial(probs, shape=(8,), get_prob=True)
+    o, lp = out.asnumpy(), logp.asnumpy()
+    assert o.shape == lp.shape == (1, 8)
+    expect = np.where(o == 1, np.log(0.75), np.log(0.25))
+    np.testing.assert_allclose(lp, expect, rtol=1e-4)
+
+
+def test_random_scalar_family():
+    u = nd.random_uniform(2.0, 4.0, shape=(N,)).asnumpy()
+    assert 2.0 <= u.min() and u.max() <= 4.0
+    n = nd.random_normal(1.0, 0.5, shape=(N,)).asnumpy()
+    np.testing.assert_allclose(n.mean(), 1.0, atol=0.1)
+    r = nd.random_randint(3, 9, shape=(N,)).asnumpy()
+    assert r.min() >= 3 and r.max() < 9 and r.dtype == np.int32
+    g = nd.random_gamma(2.0, 2.0, shape=(N,)).asnumpy()
+    np.testing.assert_allclose(g.mean(), 4.0, rtol=0.1)
+
+
+def test_like_variants_and_shuffle():
+    x = nd.zeros((5, 3))
+    u = nd.random_uniform_like(x)
+    assert u.shape == (5, 3) and float(u.asnumpy().max()) <= 1.0
+    nl = nd.random_normal_like(x, loc=2.0)
+    assert nl.shape == (5, 3)
+    base = nd.array(np.arange(10, dtype=np.float32))
+    s = nd.shuffle(base).asnumpy()
+    assert sorted(s.tolist()) == list(range(10))
+
+
+def test_seed_reproducibility():
+    mx.random.seed(123)
+    a = nd.random_normal(shape=(16,)).asnumpy()
+    mx.random.seed(123)
+    b = nd.random_normal(shape=(16,)).asnumpy()
+    np.testing.assert_allclose(a, b)
+    c = nd.random_normal(shape=(16,)).asnumpy()
+    assert not np.allclose(b, c)

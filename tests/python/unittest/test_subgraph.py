@@ -1,0 +1,96 @@
+"""SubgraphProperty partitioner tests (reference
+tests/python/unittest/test_subgraph_op.py model: register a backend,
+partition, outputs must match the unpartitioned graph)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, subgraph, sym
+from mxnet_tpu.base import MXNetError
+
+
+def _ev(s, **kw):
+    out = s.eval(**kw)
+    if isinstance(out, (list, tuple)):
+        out = out[0]
+    return out.asnumpy()
+
+
+@pytest.fixture()
+def backend():
+    prop = subgraph.SubgraphProperty("testbe")
+    prop.add_pattern(["relu", "fully_connected"], name="fc_relu")
+    subgraph.register_backend(prop)
+    yield prop
+    subgraph._BACKENDS.pop("testbe", None)
+
+
+def _net():
+    x = sym.Symbol.var("x")
+    w = sym.Symbol.var("w")
+    return x.fully_connected(w, num_hidden=4, no_bias=True).relu()
+
+
+def test_partition_rewrites_and_matches(backend):
+    s = _net()
+    s2 = s.optimize_for("testbe")
+    assert "_subgraph" in s2.tojson()
+    rs = np.random.RandomState(0)
+    xv = nd.array(rs.randn(2, 3).astype(np.float32))
+    wv = nd.array(rs.randn(4, 3).astype(np.float32))
+    np.testing.assert_allclose(_ev(s2, x=xv, w=wv), _ev(s, x=xv, w=wv),
+                               rtol=1e-5)
+
+
+def test_partitioned_json_roundtrip(backend):
+    s2 = _net().optimize_for("testbe")
+    s3 = sym.load_json(s2.tojson())
+    rs = np.random.RandomState(1)
+    xv = nd.array(rs.randn(3, 5).astype(np.float32))
+    wv = nd.array(rs.randn(4, 5).astype(np.float32))
+    np.testing.assert_allclose(_ev(s3, x=xv, w=wv), _ev(s2, x=xv, w=wv),
+                               rtol=1e-5)
+
+
+def test_custom_fuse_fn_is_used():
+    calls = []
+
+    def fuse(x, w, attrs_list=None):
+        calls.append(attrs_list)
+        import jax.numpy as jnp
+
+        return jnp.maximum(x @ w.T, 0.0)
+
+    prop = subgraph.SubgraphProperty("fusebe")
+    prop.add_pattern(["relu", "fully_connected"], name="fc_relu",
+                     fuse_fn=fuse)
+    subgraph.register_backend(prop)
+    try:
+        s2 = _net().optimize_for("fusebe")
+        rs = np.random.RandomState(2)
+        xv = nd.array(rs.randn(2, 3).astype(np.float32))
+        wv = nd.array(rs.randn(4, 3).astype(np.float32))
+        got = _ev(s2, x=xv, w=wv)
+        assert calls, "fuse_fn never invoked"
+        ref = np.maximum(xv.asnumpy() @ wv.asnumpy().T, 0)
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+    finally:
+        subgraph._BACKENDS.pop("fusebe", None)
+
+
+def test_no_match_returns_self(backend):
+    x = sym.Symbol.var("x")
+    s = x.tanh()
+    assert s.optimize_for("testbe") is s
+
+
+def test_unknown_backend_still_errors():
+    x = sym.Symbol.var("x")
+    with pytest.raises(MXNetError):
+        x.tanh().optimize_for("tensorrt7")
+
+
+def test_builtin_backends_noop():
+    x = sym.Symbol.var("x")
+    s = x.tanh()
+    assert s.optimize_for("xla") is s

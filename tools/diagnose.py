@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Environment doctor (reference tools/diagnose.py — prints platform, deps,
+env vars, and connectivity so bug reports carry reproducible context).
+
+TPU additions over the reference: PJRT backend/device table, a timed MXU
+matmul smoke (catches a dead tunnel — under axon a hung relay makes every
+dispatch block forever, so the smoke runs with a watchdog), native host
+runtime availability, and the framework env-var registry with effective
+values.
+
+Usage::
+
+    python tools/diagnose.py [--no-device-check]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import platform
+import sys
+import threading
+import time
+
+# runnable from a checkout: python tools/diagnose.py
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def section(title):
+    print("\n----------%s----------" % title)
+
+
+def python_info():
+    section("Python Info")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+    print("Arch         :", platform.architecture())
+
+
+def platform_info():
+    section("Platform Info")
+    print("Platform     :", platform.platform())
+    print("system       :", platform.system())
+    print("node         :", platform.node())
+    print("release      :", platform.release())
+    print("version      :", platform.version())
+
+
+def deps_info():
+    section("Dependencies")
+    for mod in ("numpy", "jax", "jaxlib", "flax", "optax"):
+        try:
+            m = __import__(mod)
+            print("%-12s : %s" % (mod, getattr(m, "__version__", "?")))
+        except ImportError:
+            print("%-12s : NOT FOUND" % mod)
+
+
+def framework_info(device_check=True):
+    section("MXNet-TPU Info")
+    t0 = time.time()
+    import mxnet_tpu as mx
+
+    print("import time  : %.3fs" % (time.time() - t0))
+    print("location     :", os.path.dirname(mx.__file__))
+    from mxnet_tpu import runtime
+
+    feats = [f for f in runtime.feature_list() if f.enabled]
+    print("features     :", ", ".join(f.name for f in feats))
+    from mxnet_tpu import native
+
+    print("native rt    :", "available" if native.available()
+          else "unavailable (pure-python fallbacks active)")
+    from mxnet_tpu.ops.registry import list_ops
+
+    print("ops          : %d registered" % len(list_ops()))
+
+    if not device_check:
+        return
+    section("Device Info")
+    import jax
+
+    print("backend      :", jax.default_backend())
+    for d in jax.devices():
+        print("device       : id=%d kind=%s process=%d"
+              % (d.id, d.device_kind, d.process_index))
+
+    # watchdog: a dead axon relay blocks forever, so do the smoke in a
+    # daemon thread and report a hang instead of hanging the doctor
+    result = {}
+
+    def smoke():
+        import jax.numpy as jnp
+
+        x = jnp.ones((256, 256))
+        t = time.time()
+        float((x @ x).sum())  # device round-trip hard-syncs
+        result["first"] = time.time() - t
+        t = time.time()
+        float((x @ x).sum())
+        result["steady"] = time.time() - t
+
+    th = threading.Thread(target=smoke, daemon=True)
+    th.start()
+    th.join(timeout=120)
+    if "steady" in result:
+        print("matmul smoke : first=%.2fs steady=%.4fs OK"
+              % (result["first"], result["steady"]))
+    else:
+        print("matmul smoke : HUNG (>120s) — device tunnel down? "
+              "retry with JAX_PLATFORMS=cpu")
+
+
+def env_info():
+    section("Environment")
+    from mxnet_tpu import config
+
+    for name, val in sorted(config.current().items()):
+        mark = "*" if name in os.environ else " "
+        print("%s %-38s = %r" % (mark, name, val))
+    print("(* = set in this environment)")
+    for var in ("JAX_PLATFORMS", "XLA_FLAGS", "PYTHONPATH", "http_proxy",
+                "https_proxy"):
+        if os.environ.get(var):
+            print("  %s=%s" % (var, os.environ[var]))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--no-device-check", action="store_true",
+                    help="skip the on-device matmul smoke")
+    args = ap.parse_args()
+    python_info()
+    platform_info()
+    deps_info()
+    framework_info(device_check=not args.no_device_check)
+    env_info()
+    print()
+
+
+if __name__ == "__main__":
+    main()
