@@ -81,6 +81,13 @@ from . import image  # noqa: E402
 from . import elastic  # noqa: E402  (failure detection + auto-resume)
 from . import config  # noqa: E402  (env-var registry, reference env_var.md)
 from . import subgraph  # noqa: E402  (SubgraphProperty partitioner hooks)
+from . import callback  # noqa: E402  (Speedometer/checkpoint callbacks)
+from . import dlpack  # noqa: E402  (DLPack interop)
+from . import error  # noqa: E402  (structured error classes)
+from . import visualization  # noqa: E402  (print_summary/plot_network)
+from .optimizer import lr_scheduler  # noqa: E402  (mx.lr_scheduler)
+from .dlpack import (from_dlpack, to_dlpack_for_read,  # noqa: E402
+                     to_dlpack_for_write)
 
 if base.get_env("MXNET_PROFILER_AUTOSTART", bool, False):
     profiler.set_state("run")  # reference env_var.md MXNET_PROFILER_AUTOSTART

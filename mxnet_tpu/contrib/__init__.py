@@ -1,4 +1,4 @@
-"""Contrib (reference python/mxnet/contrib/ — amp, onnx, tensorboard...)."""
-from . import amp, onnx, quantization
+"""mx.contrib (reference python/mxnet/contrib/__init__.py)."""
+from . import amp, io, onnx, quantization, tensorboard, text
 
-__all__ = ["amp", "quantization", "onnx"]
+__all__ = ["amp", "quantization", "onnx", "io", "text", "tensorboard"]

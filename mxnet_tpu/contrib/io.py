@@ -1,0 +1,61 @@
+"""contrib.io — DataIter adapters (reference python/mxnet/contrib/io.py:24
+DataLoaderIter: wrap a Gluon DataLoader in the legacy DataIter interface so
+Module-era training loops consume DataLoader pipelines)."""
+from __future__ import annotations
+
+from ..io import DataBatch, DataDesc, DataIter
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Iterate a gluon DataLoader as a legacy DataIter."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label",
+                 dtype="float32"):
+        sampler = getattr(loader, "_batch_sampler", None)
+        batch_size = getattr(loader, "_batch_size",
+                             getattr(sampler, "_batch_size", 0))
+        super().__init__(batch_size=batch_size)
+        self._loader = loader
+        self._iter = iter(loader)
+        self._data_name = data_name
+        self._label_name = label_name
+        self._dtype = dtype
+        self._current = None
+
+    @property
+    def provide_data(self):
+        batch = self._peek()
+        if batch is None:
+            return []
+        return [DataDesc(self._data_name, batch[0].shape)]
+
+    @property
+    def provide_label(self):
+        batch = self._peek()
+        if batch is None or len(batch) < 2:
+            return []
+        return [DataDesc(self._label_name, batch[1].shape)]
+
+    def _peek(self):
+        if self._current is None:
+            try:
+                self._current = next(self._iter)
+            except StopIteration:
+                return None
+        return self._current
+
+    def reset(self):
+        self._iter = iter(self._loader)
+        self._current = None
+
+    def next(self):
+        batch = self._peek()
+        if batch is None:
+            raise StopIteration
+        self._current = None
+        data, label = batch[0], (batch[1] if len(batch) > 1 else None)
+        return DataBatch(data=[data],
+                         label=[label] if label is not None else [],
+                         pad=0)

@@ -1,0 +1,48 @@
+"""contrib.tensorboard — metric → TensorBoard bridge (reference
+python/mxnet/contrib/tensorboard.py:34 LogMetricsCallback).
+
+Gated on a SummaryWriter implementation being importable (tensorboardX /
+torch.utils.tensorboard); this image ships torch (cpu), so the torch
+writer is the default.  Without one, construction raises with guidance —
+matching the reference's hard dependency on the ``tensorboard`` package.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["LogMetricsCallback"]
+
+
+def _find_writer(logging_dir):
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+
+        return SummaryWriter(logging_dir)
+    except Exception:
+        pass
+    try:
+        from tensorboardX import SummaryWriter
+
+        return SummaryWriter(logging_dir)
+    except Exception:
+        pass
+    raise MXNetError(
+        "contrib.tensorboard needs a SummaryWriter (torch or tensorboardX)")
+
+
+class LogMetricsCallback:
+    """Batch-end callback logging eval metrics as TB scalars."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.summary_writer = _find_writer(logging_dir)
+        self._step = 0
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self._step)
+        self._step += 1

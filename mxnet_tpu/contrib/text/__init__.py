@@ -1,0 +1,4 @@
+"""contrib.text — vocabulary + token embeddings (reference
+python/mxnet/contrib/text/)."""
+from . import embedding, utils, vocab  # noqa: F401
+from .vocab import Vocabulary  # noqa: F401

@@ -1,0 +1,197 @@
+"""Token embeddings (reference python/mxnet/contrib/text/embedding.py:133
+_TokenEmbedding family — vocab-indexed embedding matrices loadable from
+text files and composable with gluon).
+
+Zero-egress build: GloVe/FastText read the standard file formats from a
+LOCAL path (``pretrained_file_path``) instead of downloading; the registry
++ create() surface matches the reference so code using
+``text.embedding.create('glove', ...)`` ports directly.
+"""
+from __future__ import annotations
+
+import io
+import os
+
+import numpy as _np
+
+from ... import ndarray as nd
+from ...base import MXNetError
+from .vocab import Vocabulary
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "TokenEmbedding", "GloVe", "FastText", "CustomEmbedding",
+           "CompositeEmbedding"]
+
+_REGISTRY = {}
+
+
+def register(cls):
+    """Register an embedding class under its lowercase name (reference
+    embedding.py register)."""
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(embedding_name, **kwargs):
+    try:
+        cls = _REGISTRY[embedding_name.lower()]
+    except KeyError:
+        raise MXNetError("unknown embedding %r; registered: %s"
+                         % (embedding_name, sorted(_REGISTRY))) from None
+    return cls(**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained file names (reference embedding.py:90).  Names are
+    advisory here: files must be provided locally (no egress)."""
+    table = {"glove": ["glove.6B.50d.txt", "glove.6B.100d.txt",
+                       "glove.6B.200d.txt", "glove.6B.300d.txt",
+                       "glove.840B.300d.txt"],
+             "fasttext": ["wiki.simple.vec", "wiki.en.vec"]}
+    if embedding_name is not None:
+        return table.get(embedding_name.lower(), [])
+    return table
+
+
+class TokenEmbedding(Vocabulary):
+    """Embedding matrix keyed by a vocabulary (reference
+    _TokenEmbedding:133)."""
+
+    def __init__(self, unknown_token="<unk>", **kwargs):
+        super().__init__(unknown_token=unknown_token, **kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    def _load_embedding(self, path, elem_delim=" ", init_unknown_vec=None,
+                        encoding="utf-8"):
+        if not os.path.isfile(path):
+            raise MXNetError("embedding file %r not found (zero-egress "
+                             "build: provide the file locally)" % (path,))
+        tokens, vecs = [], []
+        with io.open(path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if line_num == 0 and len(parts) == 2 and \
+                        parts[0].isdigit() and parts[1].isdigit():
+                    continue  # fasttext header "count dim"
+                if len(parts) < 2:
+                    continue
+                token, elems = parts[0], parts[1:]
+                if self._vec_len == 0:
+                    self._vec_len = len(elems)
+                elif len(elems) != self._vec_len:
+                    continue  # malformed line (reference warns + skips)
+                if token in self._token_to_idx:
+                    continue
+                tokens.append(token)
+                vecs.append(_np.asarray(elems, dtype=_np.float32))
+        base = len(self._idx_to_token)
+        for t in tokens:
+            self._token_to_idx[t] = len(self._idx_to_token)
+            self._idx_to_token.append(t)
+        mat = _np.zeros((len(self._idx_to_token), self._vec_len),
+                        _np.float32)
+        if vecs:
+            mat[base:] = _np.stack(vecs)
+        if init_unknown_vec is not None and self._unknown_token is not None:
+            mat[self._token_to_idx[self._unknown_token]] = \
+                init_unknown_vec(self._vec_len)
+        self._idx_to_vec = nd.array(mat)
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        if lower_case_backup:
+            toks = [t if t in self._token_to_idx else t.lower()
+                    for t in toks]
+        idx = self.to_indices(toks)
+        vecs = self._idx_to_vec[nd.array(_np.asarray(idx, _np.int32),
+                                         dtype="int32")]
+        return vecs[0] if single else vecs
+
+    def update_token_vectors(self, tokens, new_vectors):
+        toks = [tokens] if isinstance(tokens, str) else tokens
+        idx = []
+        for t in toks:
+            if t not in self._token_to_idx:
+                raise MXNetError("token %r not in the embedding" % (t,))
+            idx.append(self._token_to_idx[t])
+        mat = _np.array(self._idx_to_vec.asnumpy())  # writable copy
+        mat[_np.asarray(idx)] = new_vectors.asnumpy() \
+            if isinstance(new_vectors, nd.NDArray) else new_vectors
+        self._idx_to_vec = nd.array(mat)
+
+
+@register
+class GloVe(TokenEmbedding):
+    """GloVe text format: ``token v1 .. vD`` per line (reference
+    embedding.py:481)."""
+
+    def __init__(self, pretrained_file_path, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path,
+                             init_unknown_vec=_np.zeros)
+        if vocabulary is not None:
+            _restrict(self, vocabulary)
+
+
+@register
+class FastText(TokenEmbedding):
+    """FastText .vec format (header line ``count dim``; reference
+    embedding.py:553)."""
+
+    def __init__(self, pretrained_file_path, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path,
+                             init_unknown_vec=_np.zeros)
+        if vocabulary is not None:
+            _restrict(self, vocabulary)
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Any ``token<delim>v1<delim>..`` file (reference embedding.py:635)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf-8", vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim=elem_delim,
+                             init_unknown_vec=_np.zeros, encoding=encoding)
+        if vocabulary is not None:
+            _restrict(self, vocabulary)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary (reference
+    embedding.py:703)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        super().__init__(unknown_token=vocabulary.unknown_token)
+        embs = token_embeddings if isinstance(token_embeddings, list) \
+            else [token_embeddings]
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        parts = []
+        for emb in embs:
+            parts.append(emb.get_vecs_by_tokens(
+                self._idx_to_token).asnumpy())
+        mat = _np.concatenate(parts, axis=1)
+        self._vec_len = mat.shape[1]
+        self._idx_to_vec = nd.array(mat)
+
+
+def _restrict(emb, vocabulary):
+    """Rebuild the matrix over an external vocabulary's tokens (the
+    reference's vocabulary= constructor path, embedding.py:349)."""
+    vecs = emb.get_vecs_by_tokens(vocabulary.idx_to_token).asnumpy()
+    emb._token_to_idx = dict(vocabulary.token_to_idx)
+    emb._idx_to_token = list(vocabulary.idx_to_token)
+    emb._idx_to_vec = nd.array(vecs)

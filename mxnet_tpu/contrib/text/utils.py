@@ -1,0 +1,22 @@
+"""Text utilities (reference python/mxnet/contrib/text/utils.py:26)."""
+from __future__ import annotations
+
+import collections
+import re
+
+__all__ = ["count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Token frequency counter from delimited text (reference
+    utils.py:26)."""
+    source_str = re.sub(r"(%s|%s)+" % (re.escape(token_delim),
+                                       re.escape(seq_delim)),
+                        " ", source_str)
+    if to_lower:
+        source_str = source_str.lower()
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    counter.update(t for t in source_str.split(" ") if t)
+    return counter

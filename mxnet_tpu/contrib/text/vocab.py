@@ -1,0 +1,107 @@
+"""Text vocabulary (reference python/mxnet/contrib/text/vocab.py:28
+Vocabulary — counter-based token indexing with unknown/reserved tokens)."""
+from __future__ import annotations
+
+import collections
+
+from ...base import MXNetError
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    """Token ↔ index mapping built from a frequency counter.
+
+    Index 0 is the unknown token (when set); reserved tokens follow; the
+    remaining slots are counter keys sorted by (-frequency, token) —
+    the reference's ordering contract (vocab.py:107).
+    """
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise MXNetError("min_freq must be >= 1")
+        if reserved_tokens is not None:
+            if len(set(reserved_tokens)) != len(reserved_tokens) or \
+                    (unknown_token is not None
+                     and unknown_token in reserved_tokens):
+                raise MXNetError("reserved_tokens must be unique and must "
+                                 "not contain the unknown token")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = (list(reserved_tokens)
+                                 if reserved_tokens else None)
+        self._idx_to_token = []
+        if unknown_token is not None:
+            self._idx_to_token.append(unknown_token)
+        if reserved_tokens:
+            self._idx_to_token.extend(reserved_tokens)
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter(counter, most_freq_count, min_freq)
+
+    def _index_counter(self, counter, most_freq_count, min_freq):
+        pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+        kept = 0
+        for token, freq in pairs:
+            if freq < min_freq:
+                break
+            if most_freq_count is not None and kept >= most_freq_count:
+                break
+            if token in self._token_to_idx:
+                continue
+            self._token_to_idx[token] = len(self._idx_to_token)
+            self._idx_to_token.append(token)
+            kept += 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) -> index(es); unknown tokens map to the unk index (or
+        raise when the vocab has no unknown token)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = []
+        for t in toks:
+            if t in self._token_to_idx:
+                out.append(self._token_to_idx[t])
+            elif self._unknown_token is not None:
+                out.append(self._token_to_idx[self._unknown_token])
+            else:
+                raise MXNetError("token %r not in vocabulary (no unknown "
+                                 "token configured)" % (t,))
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        out = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise MXNetError("index %d out of vocabulary range" % i)
+            out.append(self._idx_to_token[i])
+        return out[0] if single else out
+
+
+def count_tokens(tokens, counter=None):
+    """Accumulate token frequencies (reference utils.py
+    count_tokens_from_str without the string splitting)."""
+    counter = counter if counter is not None else collections.Counter()
+    counter.update(tokens)
+    return counter

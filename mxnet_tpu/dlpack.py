@@ -1,0 +1,45 @@
+"""DLPack interop (reference python/mxnet/dlpack.py — ndarray_to_dlpack_*
+/ ndarray_from_dlpack, the zero-copy tensor exchange used by
+``mx.nd.to_dlpack_for_read`` and torch/cupy bridges).
+
+TPU-native path: jax.Array implements the DLPack protocol natively
+(``__dlpack__``), so the capsule flows straight through — CPU buffers
+exchange zero-copy with torch/numpy; device buffers follow jax's dlpack
+rules."""
+from __future__ import annotations
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["to_dlpack_for_read", "to_dlpack_for_write", "from_dlpack"]
+
+
+def to_dlpack_for_read(data):
+    """NDArray -> DLPack exporter (read view) [reference dlpack.py:57].
+
+    Returns the underlying jax.Array, which implements ``__dlpack__`` /
+    ``__dlpack_device__`` — the modern DLPack exchange object accepted by
+    ``torch.from_dlpack`` / ``np.from_dlpack`` (the capsule-only protocol
+    the reference used is deprecated across the ecosystem)."""
+    if not isinstance(data, NDArray):
+        raise MXNetError("to_dlpack_for_read expects an NDArray")
+    return data._data
+
+
+def to_dlpack_for_write(data):
+    """Functional arrays have no writable aliasing; the capsule is the
+    same read view (documented divergence: XLA buffers are immutable —
+    reference semantics relied on in-place engine writes)."""
+    return to_dlpack_for_read(data)
+
+
+def from_dlpack(dlpack):
+    """DLPack exporter (``__dlpack__`` object) -> NDArray
+    [reference dlpack.py:92]."""
+    import jax.numpy as jnp
+
+    if not hasattr(dlpack, "__dlpack__"):
+        raise MXNetError(
+            "from_dlpack expects an object implementing __dlpack__ (raw "
+            "capsules are no longer exchanged; pass the tensor itself)")
+    return NDArray(jnp.from_dlpack(dlpack))

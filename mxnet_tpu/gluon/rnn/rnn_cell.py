@@ -285,3 +285,66 @@ class BidirectionalCell(RecurrentCell):
 
     def forward(self, inputs, states):
         raise MXNetError("BidirectionalCell must be used with unroll()")
+
+
+class VariationalDropoutCell(_ModifierCell):
+    """Variational (locked) dropout (reference rnn_cell.py:1090, Gal &
+    Ghahramani 2016): ONE dropout mask per sequence, reused at every time
+    step, separately for inputs/states/outputs.  Masks are drawn lazily on
+    the first step after ``reset()``."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self._di = drop_inputs
+        self._ds = drop_states
+        self._do = drop_outputs
+        self.reset()
+
+    def reset(self):
+        self._mask_i = self._mask_s = self._mask_o = None
+        if hasattr(self.base_cell, "reset"):
+            self.base_cell.reset()
+
+    @staticmethod
+    def _mask(p, arr):
+        from ... import random as mxrandom
+
+        keep = 1.0 - p
+        return mxrandom.bernoulli(keep, shape=arr.shape) / keep
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, **kwargs):
+        """Fresh masks per sequence (reference rnn_cell.py:1141 — its
+        unroll also resets before the time loop)."""
+        self.reset()
+        return super().unroll(length, inputs, begin_state=begin_state,
+                              layout=layout, merge_outputs=merge_outputs,
+                              **kwargs)
+
+    def forward(self, inputs, states):
+        from ... import autograd
+
+        if autograd.is_training():
+            if self._di > 0:
+                if self._mask_i is None or \
+                        self._mask_i.shape != inputs.shape:
+                    self._mask_i = self._mask(self._di, inputs)
+                inputs = inputs * self._mask_i
+            if self._ds > 0 and states:
+                if self._mask_s is None or \
+                        self._mask_s.shape != states[0].shape:
+                    self._mask_s = self._mask(self._ds, states[0])
+                states = [states[0] * self._mask_s] + list(states[1:])
+        out, next_states = self.base_cell(inputs, states)
+        if autograd.is_training() and self._do > 0:
+            if self._mask_o is None or self._mask_o.shape != out.shape:
+                self._mask_o = self._mask(self._do, out)
+            out = out * self._mask_o
+        return out, next_states
+
+    def __repr__(self):
+        return "VariationalDropoutCell(%r)" % (self.base_cell,)
+
+
+__all__.append("VariationalDropoutCell")

@@ -63,6 +63,8 @@ UpSampling = _nn.upsampling
 BlockGrad = stop_gradient = _core.stop_gradient
 
 
+from . import image  # noqa: E402,F401  (mx.nd.image op namespace)
+
 # Activation / LeakyReLU / Dropout resolve from the registry (ops/legacy.py)
 # — one act_type dispatcher for nd AND sym, stochastic rrelu in training,
 # implicit-RNG train-gated dropout.

@@ -23,6 +23,7 @@ from ..util import (is_np_array, is_np_shape, reset_np, set_np, use_np,
 from ..context import cpu, current_context, gpu, num_gpus, num_tpus, tpu
 from .. import random  # noqa: F401
 from ..base import get_env  # noqa: F401
+from ..ndarray import image  # noqa: F401  (npx.image op namespace)
 
 fully_connected = FullyConnected
 convolution = Convolution

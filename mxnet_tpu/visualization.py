@@ -1,0 +1,100 @@
+"""Network visualization (reference python/mxnet/visualization.py —
+print_summary:37, plot_network:214).
+
+print_summary walks the serialized symbol op tree (symbol/__init__.py
+json_repr — the same graph plot_network draws) and prints the reference's
+layer table: name, output shape, params, connections.  plot_network emits
+graphviz when the ``graphviz`` package is importable and raises with
+guidance otherwise (same hard dependency as the reference).
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _walk(node, out, parent=None):
+    if not isinstance(node, dict):
+        return
+    name = node.get("op", "?")
+    if name == "null":
+        name = "var:" + str(node.get("name"))
+    if name == "const":
+        name = "const"
+    ident = id(node)
+    out.append((ident, name, node, id(parent) if parent is not None
+                else None))
+    for child in node.get("inputs", []) or []:
+        _walk(child, out, node)
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Print the layer table of a Symbol [visualization.py:37]."""
+    from .symbol import Symbol
+
+    if not isinstance(symbol, Symbol):
+        raise MXNetError("print_summary expects a Symbol")
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    positions = [int(line_length * p) for p in positions]
+    headers = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    out_shapes = None
+    if shape is not None:
+        res = symbol.infer_shape(**shape)
+        if res and res[1]:
+            out_shapes = res[1]
+
+    nodes = []
+    _walk(symbol._json, nodes)
+    nodes.reverse()  # inputs first, output last
+    by_id = {ident: nm for ident, nm, _n, _p in nodes}
+
+    def row(fields):
+        line = ""
+        for i, f in enumerate(fields):
+            line = (line[:positions[i] - len(str(f)) - 1]
+                    if len(line) > positions[i] - len(str(f)) - 1 else line)
+            line += str(f)
+            line = line.ljust(positions[i])
+        print(line.rstrip())
+
+    print("=" * line_length)
+    row(headers)
+    print("=" * line_length)
+    for i, (ident, name, node, parent) in enumerate(nodes):
+        oshape = ""
+        if out_shapes is not None and i == len(nodes) - 1:
+            oshape = out_shapes[0]
+        prev = by_id.get(parent, "") if parent else ""
+        row([name, oshape, "", prev])
+    print("=" * line_length)
+    print("Nodes: %d" % len(nodes))
+    print("=" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz digraph of the symbol graph [visualization.py:214]."""
+    from .symbol import Symbol
+
+    if not isinstance(symbol, Symbol):
+        raise MXNetError("plot_network expects a Symbol")
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise MXNetError("plot_network requires the graphviz python "
+                         "package (zero-egress build: not installed; use "
+                         "print_summary for a text view)") from None
+    node_attrs = node_attrs or {}
+    dot = Digraph(name=title, format=save_format)
+    nodes = []
+    _walk(symbol._json, nodes)
+    for ident, name, node, parent in nodes:
+        if hide_weights and name.startswith("var:") and \
+                any(k in name for k in ("weight", "bias", "gamma", "beta")):
+            continue
+        dot.node(str(ident), name, **node_attrs)
+        if parent is not None:
+            dot.edge(str(ident), str(parent))
+    return dot

@@ -1,0 +1,120 @@
+"""contrib.text + contrib.io tests (reference
+tests/python/unittest/test_contrib_text.py model)."""
+import collections
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib import text
+
+
+def _counter():
+    return collections.Counter(
+        ["the", "the", "the", "quick", "quick", "fox"])
+
+
+class TestVocabulary:
+    def test_ordering_and_unknown(self):
+        v = text.Vocabulary(_counter())
+        assert v.idx_to_token[0] == "<unk>"
+        # freq desc, ties lexicographic
+        assert v.idx_to_token[1:] == ["the", "quick", "fox"]
+        assert v.to_indices("the") == 1
+        assert v.to_indices(["fox", "missing"]) == [3, 0]
+        assert v.to_tokens([1, 2]) == ["the", "quick"]
+
+    def test_min_freq_and_cap(self):
+        v = text.Vocabulary(_counter(), min_freq=2)
+        assert "fox" not in v.token_to_idx
+        v2 = text.Vocabulary(_counter(), most_freq_count=1)
+        assert len(v2) == 2  # unk + "the"
+
+    def test_reserved_tokens(self):
+        v = text.Vocabulary(_counter(), reserved_tokens=["<pad>", "<bos>"])
+        assert v.idx_to_token[:3] == ["<unk>", "<pad>", "<bos>"]
+        with pytest.raises(MXNetError):
+            text.Vocabulary(_counter(), reserved_tokens=["<unk>"])
+
+    def test_count_tokens_from_str(self):
+        c = text.utils.count_tokens_from_str("a b\nb c", to_lower=False)
+        assert c == collections.Counter({"b": 2, "a": 1, "c": 1})
+
+
+class TestEmbedding:
+    def _write_glove(self, tmp_path):
+        f = tmp_path / "emb.txt"
+        f.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+        return str(f)
+
+    def test_custom_embedding_loads(self, tmp_path):
+        emb = text.embedding.CustomEmbedding(self._write_glove(tmp_path))
+        assert emb.vec_len == 3
+        np.testing.assert_allclose(
+            emb.get_vecs_by_tokens("world").asnumpy(), [4, 5, 6])
+        # unknown -> zero vector
+        np.testing.assert_allclose(
+            emb.get_vecs_by_tokens("nope").asnumpy(), [0, 0, 0])
+
+    def test_create_registry_and_vocab_restrict(self, tmp_path):
+        v = text.Vocabulary(collections.Counter(["world", "world", "zzz"]))
+        emb = text.embedding.create(
+            "glove", pretrained_file_path=self._write_glove(tmp_path),
+            vocabulary=v)
+        assert emb.idx_to_token == v.idx_to_token
+        np.testing.assert_allclose(
+            emb.idx_to_vec.asnumpy()[v.to_indices("world")], [4, 5, 6])
+        # zzz not in the file -> zeros
+        np.testing.assert_allclose(
+            emb.idx_to_vec.asnumpy()[v.to_indices("zzz")], [0, 0, 0])
+
+    def test_fasttext_header_skipped(self, tmp_path):
+        f = tmp_path / "w.vec"
+        f.write_text("2 3\nfoo 1 1 1\nbar 2 2 2\n")
+        emb = text.embedding.FastText(str(f))
+        assert emb.vec_len == 3
+        np.testing.assert_allclose(
+            emb.get_vecs_by_tokens("bar").asnumpy(), [2, 2, 2])
+
+    def test_update_token_vectors_and_composite(self, tmp_path):
+        emb = text.embedding.CustomEmbedding(self._write_glove(tmp_path))
+        emb.update_token_vectors("hello", nd.array(np.array([[9.0, 9, 9]],
+                                                            np.float32)))
+        np.testing.assert_allclose(
+            emb.get_vecs_by_tokens("hello").asnumpy(), [9, 9, 9])
+        v = text.Vocabulary(collections.Counter(["hello"]))
+        comp = text.embedding.CompositeEmbedding(v, [emb, emb])
+        assert comp.vec_len == 6
+
+    def test_embedding_feeds_gluon_embedding_layer(self, tmp_path):
+        from mxnet_tpu.gluon import nn
+
+        emb = text.embedding.CustomEmbedding(self._write_glove(tmp_path))
+        layer = nn.Embedding(len(emb), emb.vec_len)
+        layer.initialize()
+        layer.weight.set_data(emb.idx_to_vec)
+        out = layer(nd.array(np.array([emb.to_indices("world")],
+                                      np.int32), dtype="int32"))
+        np.testing.assert_allclose(out.asnumpy()[0], [4, 5, 6])
+
+
+def test_contrib_io_dataloader_iter():
+    from mxnet_tpu.contrib.io import DataLoaderIter
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    y = np.arange(6, dtype=np.float32)
+    loader = DataLoader(ArrayDataset(x, y), batch_size=2)
+    it = DataLoaderIter(loader)
+    assert it.provide_data[0].shape == (2, 2)
+    batches = []
+    try:
+        while True:
+            batches.append(it.next())
+    except StopIteration:
+        pass
+    assert len(batches) == 3
+    it.reset()
+    assert it.next().data[0].shape == (2, 2)

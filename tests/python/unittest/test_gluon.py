@@ -258,3 +258,31 @@ def test_trainer_zero_state_sharding():
             if shard == a.size // 8:
                 found_sharded = True
     assert found_sharded, "no optimizer-state leaf was sharded over dp"
+
+
+def test_variational_dropout_cell_locked_mask():
+    """Same dropout mask at every timestep (reference rnn_cell.py:1090);
+    fresh mask after reset()."""
+    from mxnet_tpu.gluon import rnn
+
+    mx.random.seed(0)
+    cell = rnn.VariationalDropoutCell(rnn.RNNCell(8, input_size=8),
+                                      drop_inputs=0.5)
+    cell.initialize()
+    x = nd.ones((2, 8))
+    states = cell.begin_state(batch_size=2)
+    with autograd.record():
+        cell(x, states)
+        m1 = cell._mask_i.asnumpy()
+        cell(x, states)
+        m2 = cell._mask_i.asnumpy()
+    np.testing.assert_allclose(m1, m2)  # locked across steps
+    cell.reset()
+    with autograd.record():
+        cell(x, states)
+    m3 = cell._mask_i.asnumpy()
+    assert not np.allclose(m1, m3)  # new sequence, new mask
+    # inference: no dropout at all
+    cell.reset()
+    out, _ = cell(x, states)
+    assert cell._mask_i is None

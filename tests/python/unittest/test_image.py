@@ -156,3 +156,61 @@ def test_sequential_and_random_order_aug():
 
 def test_scale_down():
     assert mximg.scale_down((30, 40), (50, 60)) == (30, 36)
+
+
+# ---------------------------------------------------------------------------
+# nd.image op namespace (reference src/operator/image/, ndarray/image.py)
+# ---------------------------------------------------------------------------
+def test_nd_image_to_tensor_normalize():
+    src = mx.nd.array(np.full((4, 6, 3), 255, np.uint8), dtype="uint8")
+    t = nd.image.to_tensor(src)
+    assert t.shape == (3, 4, 6)
+    np.testing.assert_allclose(t.asnumpy(), np.ones((3, 4, 6)), rtol=1e-6)
+    n = nd.image.normalize(t, mean=(0.5, 0.5, 0.5), std=(0.25, 0.5, 1.0))
+    got = n.asnumpy()
+    np.testing.assert_allclose(got[0], np.full((4, 6), 2.0), rtol=1e-5)
+    np.testing.assert_allclose(got[1], np.full((4, 6), 1.0), rtol=1e-5)
+    # batched
+    tb = nd.image.to_tensor(mx.nd.array(
+        np.zeros((2, 4, 6, 3), np.uint8), dtype="uint8"))
+    assert tb.shape == (2, 3, 4, 6)
+
+
+def test_nd_image_geometry_ops():
+    rs = np.random.RandomState(0)
+    src = mx.nd.array(rs.randint(0, 255, (10, 12, 3)), dtype="uint8")
+    c = nd.image.crop(src, x=2, y=1, width=5, height=4)
+    assert c.shape == (4, 5, 3)
+    np.testing.assert_allclose(c.asnumpy(), src.asnumpy()[1:5, 2:7])
+    r = nd.image.resize(src, size=(6, 5))
+    assert r.shape == (5, 6, 3)
+    f = nd.image.flip_left_right(src)
+    np.testing.assert_allclose(f.asnumpy(), src.asnumpy()[:, ::-1])
+    rc = nd.image.random_crop(src, width=4, height=3)
+    assert rc.shape == (3, 4, 3)
+    rrc = nd.image.random_resized_crop(src, size=(8, 8))
+    assert rrc.shape == (8, 8, 3)
+
+
+def test_nd_image_jitter_family():
+    mx.random.seed(0)
+    rs = np.random.RandomState(1)
+    src = mx.nd.array(rs.randint(10, 245, (8, 8, 3)).astype(np.float32))
+    b = nd.image.random_brightness(src, 1.5, 1.5)  # fixed factor 1.5
+    np.testing.assert_allclose(b.asnumpy(), src.asnumpy() * 1.5, rtol=1e-5)
+    s = nd.image.random_saturation(src, 0.0, 0.0)  # full desaturate
+    g = s.asnumpy()
+    np.testing.assert_allclose(g[..., 0], g[..., 1], rtol=1e-4)
+    j = nd.image.random_color_jitter(src, brightness=0.2, contrast=0.2,
+                                     saturation=0.2, hue=0.2)
+    assert j.shape == src.shape
+    la = nd.image.adjust_lighting(src, alpha=(0.0, 0.0, 0.0))
+    np.testing.assert_allclose(la.asnumpy(), src.asnumpy(), rtol=1e-5)
+    rl = nd.image.random_lighting(src, alpha_std=0.05)
+    assert rl.shape == src.shape
+
+
+def test_npx_image_namespace():
+    from mxnet_tpu import numpy_extension as npx
+
+    assert npx.image.to_tensor is nd.image.to_tensor

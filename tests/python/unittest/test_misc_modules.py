@@ -1,0 +1,79 @@
+"""callback / dlpack / visualization / error / lr_scheduler top-level
+modules (reference python/mxnet/{callback,dlpack,visualization,error}.py)."""
+import logging
+from collections import namedtuple
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+
+BatchEndParam = namedtuple("BatchEndParam",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def test_speedometer_logs(caplog):
+    from mxnet_tpu import metric
+
+    m = metric.Accuracy()
+    m.update(nd.array(np.array([0, 1], np.float32)),
+             nd.array(np.array([[0.9, 0.1], [0.1, 0.9]], np.float32)))
+    cb = mx.callback.Speedometer(batch_size=4, frequent=2)
+    with caplog.at_level(logging.INFO):
+        for i in range(5):
+            cb(BatchEndParam(epoch=0, nbatch=i, eval_metric=m,
+                             locals=None))
+    assert any("samples/sec" in r.message for r in caplog.records)
+
+
+def test_do_checkpoint_saves(tmp_path):
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    cb = mx.callback.do_checkpoint(str(tmp_path / "model"), period=1)
+    cb(0, net)
+    assert (tmp_path / "model-0001.params").exists()
+
+
+def test_dlpack_roundtrip_numpy_torch():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    cap = mx.to_dlpack_for_read(x)
+    back = mx.from_dlpack(cap)
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy())
+    # torch interop (cpu build is baked in)
+    torch = pytest.importorskip("torch")
+    t = torch.utils.dlpack.from_dlpack(mx.to_dlpack_for_read(x))
+    np.testing.assert_allclose(t.numpy(), x.asnumpy())
+    y = mx.from_dlpack(torch.ones(2, 2))
+    np.testing.assert_allclose(y.asnumpy(), np.ones((2, 2)))
+
+
+def test_print_summary(capsys):
+    from mxnet_tpu import sym
+
+    x = sym.Symbol.var("x")
+    s = x.fully_connected(sym.Symbol.var("w"), num_hidden=4,
+                          no_bias=True).relu()
+    mx.visualization.print_summary(s, shape={"x": (2, 3), "w": (4, 3)})
+    out = capsys.readouterr().out
+    assert "fully_connected" in out and "relu" in out and "var:x" in out
+
+
+def test_error_classes_dual_catch():
+    with pytest.raises(MXNetError):
+        raise mx.error.ValueError("bad")
+    with pytest.raises(ValueError):
+        raise mx.error.ValueError("bad")
+    err = mx.error.NotImplementedForSymbol(test_error_classes_dual_catch,
+                                           "nd.foo")
+    assert "nd.foo" in str(err)
+
+
+def test_lr_scheduler_top_level_alias():
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5,
+                                            base_lr=1.0)
+    assert sched(0) == 1.0
+    assert sched(4) < 1.0

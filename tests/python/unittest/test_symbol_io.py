@@ -174,3 +174,44 @@ def test_loaded_symbol_resaves(tmp_path):
     s3 = sym.load_json(s2.tojson())  # re-serialize the LOADED symbol
     v = nd.zeros((2,))
     np.testing.assert_allclose(_ev(s3, x=v), [1, 2])
+
+
+# ---------------------------------------------------------------------------
+# tools/im2rec.py (reference tools/im2rec.py CLI)
+# ---------------------------------------------------------------------------
+def test_im2rec_list_and_encode(tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
+                                    "..", "tools"))
+    import im2rec
+
+    rs = np.random.RandomState(0)
+    for cls in ("cats", "dogs"):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            np.save(str(d / ("x%d.npy" % i)),
+                    rs.randint(0, 255, (24, 30, 3)).astype(np.uint8))
+    prefix = str(tmp_path / "data")
+    im2rec.main([prefix, str(tmp_path / "imgs"), "--list", "--recursive"])
+    lst = prefix + ".lst"
+    assert os.path.exists(lst)
+    rows = list(im2rec.read_list(lst))
+    assert len(rows) == 6
+    labels = {r[2][0] for r in rows}
+    assert labels == {0.0, 1.0}
+
+    im2rec.main([prefix, str(tmp_path / "imgs"), "--resize", "16"])
+    assert os.path.exists(prefix + ".rec")
+    assert os.path.exists(prefix + ".idx")
+    # read a record back: jpeg payload decodes to a 3-channel image
+    r = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    raw = r.read_idx(rows[0][0])
+    header, payload = recordio.unpack(raw)
+    assert header.id == rows[0][0]
+    from mxnet_tpu import image as mximage
+
+    img = mximage.imdecode(payload)
+    assert img.shape[2] == 3 and min(img.shape[:2]) == 16
+    r.close()

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""im2rec — pack an image folder into .lst / .rec files.
+
+Reference: tools/im2rec.py (list_image:38, make_list:93, image_encode:150,
+multiprocess read/write workers:212-264).  Same CLI contract: two modes —
+``--list`` scans a folder into a train/val .lst split; without ``--list``
+it encodes every .lst in the prefix into an indexed .rec.
+
+TPU-native rendering: encoding uses the native libjpeg path
+(src/native/image.cc MXTEncodeJPEG) when available, PIL otherwise, and the
+RecordIO writer is the same wire format the native training loader
+(src/native/dataloader.cc) consumes.  Parallelism is a thread pool —
+decode/encode release the GIL inside libjpeg.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EXTS = [".jpeg", ".jpg", ".png", ".npy"]
+
+
+def list_image(root, recursive, exts):
+    """Yield (index, relpath, label) with one label per subdirectory
+    (reference im2rec.py:38)."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+            dirs.sort()
+            for fname in sorted(files):
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and suffix in exts:
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and suffix in exts:
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    """Tab-separated: index\tlabel...\trelpath (reference im2rec.py:75)."""
+    with open(path_out, "w") as fout:
+        for item in image_list:
+            line = "%d\t" % item[0]
+            for j in item[2:]:
+                line += "%f\t" % j
+            line += "%s\n" % item[1]
+            fout.write(line)
+
+
+def make_list(args):
+    """Scan + shuffle + split into train/val/test .lst (reference
+    im2rec.py:93)."""
+    exts = [e.lower() if e.startswith(".") else "." + e.lower()
+            for e in args.exts]
+    image_list = list(list_image(args.root, args.recursive, exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    n = len(image_list)
+    n_test = int(n * args.test_ratio)
+    n_train = int(n * args.train_ratio)
+    names = []
+    if args.test_ratio > 0:
+        names.append(("_test.lst", image_list[:n_test]))
+    if args.train_ratio + args.test_ratio < 1.0:
+        names.append(("_val.lst", image_list[n_test + n_train:]))
+    names.append(("_train.lst" if args.train_ratio < 1.0 else ".lst",
+                  image_list[n_test:n_test + n_train]))
+    for suffix, chunk in names:
+        chunk = [(i,) + item[1:] for i, item in enumerate(chunk)]
+        write_list(args.prefix + suffix, chunk)
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line in fin:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield (int(parts[0]), parts[-1],
+                   [float(x) for x in parts[1:-1]])
+
+
+def _encode_jpeg(img_arr, quality):
+    from mxnet_tpu import native
+
+    if native.available():
+        return native.encode_jpeg(img_arr, quality)
+    import io as _io
+
+    from PIL import Image
+
+    buf = _io.BytesIO()
+    Image.fromarray(img_arr).save(buf, format="JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def image_encode(args, item, root):
+    """Load one image, optionally resize/center-square, JPEG-encode, and
+    frame it with the IRHeader (reference im2rec.py:150)."""
+    import numpy as np
+
+    from mxnet_tpu import image as mximage
+    from mxnet_tpu import recordio
+
+    fullpath = os.path.join(root, item[1])
+    img = mximage.imread(fullpath)
+    if args.center_crop:
+        h, w = img.shape[:2]
+        s = min(h, w)
+        img = img[(h - s) // 2:(h - s) // 2 + s,
+                  (w - s) // 2:(w - s) // 2 + s]
+    if args.resize:
+        img = mximage.resize_short(img, args.resize)
+    arr = np.ascontiguousarray(img.asnumpy().astype(np.uint8))
+    payload = _encode_jpeg(arr, args.quality)
+    label = item[2][0] if len(item[2]) == 1 else np.asarray(
+        item[2], np.float32)
+    header = recordio.IRHeader(0, label, item[0], 0)
+    return recordio.pack(header, payload)
+
+
+def encode_rec(args, lst_path):
+    """One .lst -> .rec + .idx using the native RecordIO writer."""
+    from mxnet_tpu import recordio
+
+    base = lst_path[:-4]
+    writer = recordio.MXIndexedRecordIO(base + ".idx", base + ".rec", "w")
+    items = list(read_list(lst_path))
+    root = args.root
+    with ThreadPoolExecutor(max_workers=args.num_thread) as pool:
+        packed = pool.map(lambda it: (it[0], image_encode(args, it, root)),
+                          items)
+        for idx, blob in packed:
+            writer.write_idx(idx, blob)
+    writer.close()
+    print("wrote %s.rec (%d records)" % (base, len(items)))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="Create an image list or .rec database "
+                    "(reference tools/im2rec.py CLI)")
+    p.add_argument("prefix", help="prefix of .lst/.rec files")
+    p.add_argument("root", help="image root folder")
+    p.add_argument("--list", action="store_true",
+                   help="create an image list instead of a database")
+    p.add_argument("--exts", nargs="+", default=EXTS)
+    p.add_argument("--recursive", action="store_true")
+    p.add_argument("--shuffle", dest="shuffle", action="store_true",
+                   default=True)
+    p.add_argument("--no-shuffle", dest="shuffle", action="store_false",
+                   help="keep the sorted scan order in the .lst")
+    p.add_argument("--train-ratio", type=float, default=1.0)
+    p.add_argument("--test-ratio", type=float, default=0.0)
+    p.add_argument("--resize", type=int, default=0)
+    p.add_argument("--center-crop", action="store_true")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--num-thread", type=int, default=1)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.list:
+        make_list(args)
+        return
+    working_dir = os.path.dirname(os.path.abspath(args.prefix)) or "."
+    prefix_name = os.path.basename(args.prefix)
+    for fname in sorted(os.listdir(working_dir)):
+        if fname.startswith(prefix_name) and fname.endswith(".lst"):
+            encode_rec(args, os.path.join(working_dir, fname))
+
+
+if __name__ == "__main__":
+    main()
