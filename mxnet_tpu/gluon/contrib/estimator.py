@@ -17,7 +17,8 @@ from ..trainer import Trainer
 
 __all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
            "BatchBegin", "BatchEnd", "LoggingHandler", "CheckpointHandler",
-           "EarlyStoppingHandler", "ValidationHandler", "StoppingHandler"]
+           "EarlyStoppingHandler", "ValidationHandler", "StoppingHandler",
+           "MetricHandler", "GradientUpdateHandler"]
 
 
 class TrainBegin:
@@ -202,6 +203,40 @@ class ValidationHandler(BatchEnd, EpochEnd):
             self.eval_fn(self.val_data)
 
 
+class MetricHandler(EpochBegin, BatchEnd):
+    """Reset train metrics at epoch begin, update them at batch end
+    (reference event_handler.py:122)."""
+
+    def __init__(self, metrics, priority=-1000):
+        self.metrics = metrics if isinstance(metrics, list) else [metrics]
+        self.priority = priority
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred, label = kwargs.get("pred"), kwargs.get("label")
+        if pred is None or label is None:
+            return
+        for m in self.metrics:
+            m.update([label], [pred])
+
+
+class GradientUpdateHandler(BatchEnd):
+    """Run the optimizer step at batch end (reference
+    event_handler.py:722); priority -2000 orders it before every other
+    batch_end handler."""
+
+    def __init__(self, priority=-2000):
+        self.priority = priority
+
+    def batch_end(self, estimator, *args, **kwargs):
+        batch = kwargs.get("batch")
+        size = batch[0].shape[0] if batch is not None else 1
+        estimator.trainer.step(size)
+
+
 class Estimator:
     """Reference estimator/estimator.py Estimator."""
 
@@ -230,38 +265,47 @@ class Estimator:
 
     def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
             batches=None):
+        """Handler-driven loop (reference estimator.py:fit): the optimizer
+        step and metric updates are themselves handlers
+        (GradientUpdateHandler / MetricHandler, event_handler.py:722,122),
+        so callers can replace the update cadence (e.g. gradient
+        accumulation) without forking the loop."""
         if epochs is None and batches is None:
             epochs = 1
         handlers = list(event_handlers or [])
-        stopper = StoppingHandler(epochs, batches)
-        handlers.append(stopper)
+        handlers.append(StoppingHandler(epochs, batches))
         if not any(isinstance(h, LoggingHandler) for h in handlers):
             handlers.append(LoggingHandler())
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(MetricHandler(self.train_metrics))
+        if not any(isinstance(h, GradientUpdateHandler) for h in handlers):
+            handlers.append(GradientUpdateHandler())
 
-        def fire(event):
+        # reference event_handler ordering: stable sort by priority (more
+        # negative runs earlier; GradientUpdateHandler -2000, MetricHandler
+        # -1000), so user handlers observe post-update state by default
+        handlers.sort(key=lambda h: getattr(h, "priority", 0))
+
+        def fire(event, **kwargs):
             for h in handlers:
                 fn = getattr(h, event, None)
                 if fn:
-                    fn(self)
+                    fn(self, **kwargs)
                 if getattr(h, "stop_training", False):
                     self.stop_training = True
 
         fire("train_begin")
         while not self.stop_training:
-            for m in self.train_metrics:
-                m.reset()
             fire("epoch_begin")
             for batch in train_data:
                 data, label = batch[0], batch[1]
-                fire("batch_begin")
+                fire("batch_begin", batch=batch)
                 with autograd.record():
                     pred = self.net(data)
                     loss_val = self.loss(pred, label)
                 loss_val.backward()
-                self.trainer.step(data.shape[0])
-                for m in self.train_metrics:
-                    m.update([label], [pred])
-                fire("batch_end")
+                fire("batch_end", batch=batch, pred=pred, label=label,
+                     loss=loss_val)
                 if self.stop_training:
                     break
             fire("epoch_end")

@@ -190,3 +190,176 @@ class RandomColorJitter(Block):
         for i in order:
             x = self._ts[i](x)
         return x
+
+
+class RandomCrop(Block):
+    """Random-position crop to ``size``, optionally zero/edge-padding
+    first (reference transforms/image.py:322)."""
+
+    def __init__(self, size, pad=None, pad_value=0):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size,
+                                                                   size)
+        self._pad = pad
+        self._pad_value = pad_value
+
+    def forward(self, x):
+        from ....base import MXNetError
+
+        arr = x.asnumpy()
+        if self._pad:
+            p = self._pad
+            arr = _np.pad(arr, ((p, p), (p, p), (0, 0)),
+                          constant_values=self._pad_value)
+        h, w = arr.shape[:2]
+        tw, th = self._size
+        if tw > w or th > h:
+            raise MXNetError(
+                "RandomCrop size (%d, %d) exceeds the %s image (%d, %d); "
+                "pad= more or resize first" %
+                (tw, th, "padded" if self._pad else "input", w, h))
+        x0 = _np.random.randint(0, w - tw + 1)
+        y0 = _np.random.randint(0, h - th + 1)
+        return nd.array(arr[y0:y0 + th, x0:x0 + tw])
+
+
+class RandomHue(Block):
+    """YIQ-rotation hue jitter (reference transforms/image.py:599)."""
+
+    def __init__(self, hue):
+        super().__init__()
+        self._hue = hue
+
+    def forward(self, x):
+        from ....image import HueJitterAug
+
+        return HueJitterAug(self._hue)(x)
+
+
+class RandomGray(Block):
+    """Random 3-channel grayscale conversion (reference
+    transforms/image.py:687)."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        from ....image import RandomGrayAug
+
+        return RandomGrayAug(self._p)(x)
+
+
+class Rotate(Block):
+    """Rotate by a fixed angle (degrees, counter-clockwise; reference
+    transforms/image.py:144 — bilinear sampling over the rotated grid,
+    zeros outside)."""
+
+    def __init__(self, rotation_degrees, zoom_in=False, zoom_out=False):
+        super().__init__()
+        self._deg = rotation_degrees
+        self._zoom_in = zoom_in
+        self._zoom_out = zoom_out
+
+    def forward(self, x):
+        return _rotate(x, self._deg, self._zoom_in, self._zoom_out)
+
+
+class RandomRotation(Block):
+    """Rotate by a uniform random angle in ``angle_limits``
+    (reference transforms/image.py:174)."""
+
+    def __init__(self, angle_limits, zoom_in=False, zoom_out=False,
+                 rotate_with_proba=1.0):
+        super().__init__()
+        self._limits = angle_limits
+        self._zoom_in = zoom_in
+        self._zoom_out = zoom_out
+        self._proba = rotate_with_proba
+
+    def forward(self, x):
+        if _np.random.rand() > self._proba:
+            return x
+        deg = _np.random.uniform(*self._limits)
+        return _rotate(x, deg, self._zoom_in, self._zoom_out)
+
+
+def _rotate(x, deg, zoom_in=False, zoom_out=False):
+    """Bilinear rotation of an HWC image around its center."""
+    import math
+
+    arr = x.asnumpy().astype(_np.float32)
+    h, w = arr.shape[:2]
+    theta = math.radians(deg)
+    c, s = math.cos(theta), math.sin(theta)
+    scale = 1.0
+    if zoom_out:  # fit the whole rotated image
+        scale = abs(c) + abs(s)
+    elif zoom_in:  # largest axis-aligned box inside the rotation
+        scale = 1.0 / (abs(c) + abs(s))
+    yy, xx = _np.meshgrid(_np.arange(h, dtype=_np.float32),
+                          _np.arange(w, dtype=_np.float32), indexing="ij")
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    xr = (xx - cx) * scale
+    yr = (yy - cy) * scale
+    # inverse mapping for a counter-clockwise screen rotation (y points
+    # down, so the math-CW matrix gives visual CCW)
+    sx = c * xr - s * yr + cx
+    sy = s * xr + c * yr + cy
+    x0 = _np.floor(sx).astype(_np.int32)
+    y0 = _np.floor(sy).astype(_np.int32)
+    fx = sx - x0
+    fy = sy - y0
+
+    def sample(yi, xi):
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yi = _np.clip(yi, 0, h - 1)
+        xi = _np.clip(xi, 0, w - 1)
+        return arr[yi, xi] * valid[..., None]
+
+    out = (sample(y0, x0) * ((1 - fx) * (1 - fy))[..., None]
+           + sample(y0, x0 + 1) * (fx * (1 - fy))[..., None]
+           + sample(y0 + 1, x0) * ((1 - fx) * fy)[..., None]
+           + sample(y0 + 1, x0 + 1) * (fx * fy)[..., None])
+    return nd.array(out.astype(_np.float32))
+
+
+class CropResize(HybridBlock):
+    """Fixed crop then resize (reference transforms/image.py:259)."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=None):
+        super().__init__()
+        self._x, self._y = x, y
+        self._w, self._h = width, height
+        self._size = size
+        self._interp = interpolation
+
+    def forward(self, data):
+        out = data[self._y:self._y + self._h, self._x:self._x + self._w]
+        if self._size is not None:
+            from ....image import imresize
+
+            size = self._size if isinstance(self._size, (tuple, list)) \
+                else (self._size, self._size)
+            out = imresize(out, size[0], size[1],
+                           self._interp if self._interp is not None else 1)
+        return out
+
+
+class RandomApply(Block):
+    """Apply a transform with probability p (reference
+    transforms/__init__.py:138)."""
+
+    def __init__(self, transforms, p=0.5):
+        super().__init__()
+        self.transforms = transforms
+        self.p = p
+
+    def forward(self, x):
+        if _np.random.rand() < self.p:
+            return self.transforms(x)
+        return x
+
+
+__all__ += ["RandomCrop", "RandomHue", "RandomGray", "Rotate",
+            "RandomRotation", "CropResize", "RandomApply"]

@@ -414,3 +414,107 @@ def np(numpy_feval, name="custom", allow_extra_outputs=False):
 
     feval.__name__ = getattr(numpy_feval, "__name__", "feval")
     return CustomMetric(feval, name, allow_extra_outputs)
+
+
+@register
+class Fbeta(F1):
+    """F-beta score (reference metric.py:815): weighted harmonic mean of
+    precision/recall; beta > 1 favors recall."""
+
+    def __init__(self, name="fbeta", beta=1, average="macro", **kwargs):
+        super().__init__(name=name, average=average, **kwargs)
+        self.beta = beta
+
+    def get(self):
+        p, r = self.stats.precision, self.stats.recall
+        b2 = self.beta * self.beta
+        denom = b2 * p + r
+        return (self.name,
+                (1 + b2) * p * r / denom if denom > 0 else 0.0)
+
+
+@register
+class MeanPairwiseDistance(EvalMetric):
+    """Mean p-norm distance between pred and label vectors (reference
+    metric.py:1197)."""
+
+    def __init__(self, name="mpd", p=2, **kwargs):
+        super().__init__(name, **kwargs)
+        self.p = p
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            l_ = _to_np(label).reshape(_to_np(label).shape[0], -1)
+            p_ = _to_np(pred).reshape(_to_np(pred).shape[0], -1)
+            d = (_np.abs(p_ - l_) ** self.p).sum(axis=1) ** (1.0 / self.p)
+            self.sum_metric += float(d.sum())
+            self.num_inst += d.shape[0]
+
+
+@register
+class MeanCosineSimilarity(EvalMetric):
+    """Mean cosine similarity along the last axis (reference
+    metric.py:1263)."""
+
+    def __init__(self, name="cos_sim", eps=1e-12, **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            l_, p_ = _to_np(label), _to_np(pred)
+            num = (l_ * p_).sum(axis=-1)
+            den = _np.sqrt((l_ * l_).sum(axis=-1)) * \
+                _np.sqrt((p_ * p_).sum(axis=-1))
+            sim = num / _np.maximum(den, self.eps)
+            self.sum_metric += float(sim.sum())
+            self.num_inst += sim.size
+
+
+@register
+class PCC(EvalMetric):
+    """Multiclass Pearson correlation coefficient over the confusion
+    matrix (reference metric.py:1586 — the k-category generalization of
+    MCC, Gorodkin 2004)."""
+
+    def __init__(self, name="pcc", **kwargs):
+        super().__init__(name, **kwargs)
+        self._conf = None
+
+    def reset(self):
+        self._conf = None
+        super().reset()
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            l_ = _to_np(label).astype(_np.int64).flatten()
+            p_ = _to_np(pred)
+            p_ = p_.argmax(axis=-1) if p_.ndim > 1 else (p_ > 0.5)
+            p_ = p_.astype(_np.int64).flatten()
+            k = int(max(l_.max(), p_.max())) + 1
+            if self._conf is None:
+                self._conf = _np.zeros((k, k), _np.float64)
+            elif self._conf.shape[0] < k:
+                grown = _np.zeros((k, k), _np.float64)
+                grown[:self._conf.shape[0], :self._conf.shape[1]] = \
+                    self._conf
+                self._conf = grown
+            for li, pi in zip(l_, p_):
+                self._conf[pi, li] += 1
+            self.num_inst += l_.shape[0]
+
+    def get(self):
+        if self._conf is None:
+            return (self.name, 0.0)
+        c = self._conf
+        n = c.sum()
+        t = c.sum(axis=1)  # predicted-class totals
+        s = c.sum(axis=0)  # true-class totals
+        cov_xy = c.trace() * n - (t * s).sum()
+        cov_xx = n * n - (t * t).sum()
+        cov_yy = n * n - (s * s).sum()
+        denom = _np.sqrt(cov_xx * cov_yy)
+        return (self.name, float(cov_xy / denom) if denom > 0 else 0.0)

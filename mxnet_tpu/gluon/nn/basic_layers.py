@@ -437,3 +437,31 @@ class HybridConcatenate(Concatenate, HybridBlock):
     def __init__(self, axis=-1):
         HybridBlock.__init__(self)
         self._axis = axis
+
+
+class Swish(HybridBlock):
+    """x * sigmoid(beta * x) (reference nn/activations.py Swish;
+    Ramachandran et al. 2017)."""
+
+    def __init__(self, beta=1.0):
+        super().__init__()
+        self._beta = beta
+
+    def forward(self, x):
+        from ...ops import nn as _opsnn
+
+        return x * _opsnn.sigmoid(self._beta * x)
+
+
+class BatchNormReLU(BatchNorm):
+    """Fused BatchNorm + ReLU (reference nn/basic_layers.py BatchNormReLU
+    — a cuDNN fusion; under XLA the relu fuses into the BN kernel
+    automatically, so this is the same one compiled kernel)."""
+
+    def forward(self, x):
+        from ...ops import nn as _opsnn
+
+        return _opsnn.relu(super().forward(x))
+
+
+__all__ += ["Swish", "BatchNormReLU"]

@@ -272,3 +272,161 @@ class ReflectionPad2D(HybridBlock):
     def forward(self, x):
         p = self._padding
         return x.pad(((0, 0), (0, 0), (p, p), (p, p)), mode="reflect")
+
+
+class _PixelShuffle(HybridBlock):
+    """Base pixel-shuffle: regroup channel blocks into spatial blocks
+    (reference conv_layers.py PixelShuffle1D/2D/3D; Shi et al. 2016).
+    Channel layout matches the reference: (N, f1*..*fk*C, D1..Dk) ->
+    (N, C, f1*D1, .., fk*Dk)."""
+
+    def __init__(self, factor, ndim):
+        super().__init__()
+        self._f = (factor,) * ndim if isinstance(factor, int) \
+            else tuple(factor)
+        if len(self._f) != ndim:
+            raise MXNetError("factor must have %d elements" % ndim)
+        self._ndim = ndim
+
+    def forward(self, x):
+        f = self._f
+        k = self._ndim
+        N, C_in = x.shape[0], x.shape[1]
+        spatial = x.shape[2:]
+        prod_f = 1
+        for fi in f:
+            prod_f *= fi
+        C = C_in // prod_f
+        # C-major channel split like the reference's reshape(0, -4, -1,
+        # f1*..*fk, 0, 0): channel index = c*prod(f) + (f1-major tap).
+        # Built from the registered reshape/transpose ops so autograd
+        # records the layout chain.
+        xr = x.reshape((N, C) + f + tuple(spatial))
+        perm = [0, 1]  # N, C
+        for i in range(k):
+            perm += [2 + k + i, 2 + i]  # Di, fi
+        from ...ndarray import transpose as _transpose
+
+        xt = _transpose(xr, axes=tuple(perm))
+        out_spatial = tuple(spatial[i] * f[i] for i in range(k))
+        return xt.reshape((N, C) + out_spatial)
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(N, f*C, W) -> (N, C, f*W) [reference conv_layers.py]."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 1)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(N, f1*f2*C, H, W) -> (N, C, f1*H, f2*W)."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 2)
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(N, f1*f2*f3*C, D, H, W) -> (N, C, f1*D, f2*H, f3*W)."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 3)
+
+
+class DeformableConvolution(_Resolving):
+    """Deformable conv v1/v2 (reference contrib deformable_convolution.cc /
+    modulated_deformable_convolution.cc; Dai et al. 2017, Zhu et al. 2019).
+
+    Two branches like the reference block: a regular conv producing the
+    per-tap (dy, dx) offsets (and modulation mask for v2), and the
+    deformable sampling conv itself.  The TPU rendering gathers each
+    kernel tap with bilinear interpolation (one fused gather/einsum chain
+    — no im2col buffer) and contracts taps x channels on the MXU.
+    """
+
+    def __init__(self, channels, kernel_size=(3, 3), strides=(1, 1),
+                 padding=(1, 1), in_channels=0, num_deformable_group=1,
+                 use_bias=True, modulated=False, weight_initializer=None,
+                 prefix=None):
+        super().__init__()
+        from ... import initializer as init
+        from ..parameter import Parameter
+
+        self._kernel = (kernel_size,) * 2 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._strides = (strides,) * 2 if isinstance(strides, int) \
+            else tuple(strides)
+        self._padding = (padding,) * 2 if isinstance(padding, int) \
+            else tuple(padding)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._dg = num_deformable_group
+        self._modulated = modulated
+        kh, kw = self._kernel
+        n_off = self._dg * kh * kw * (3 if modulated else 2)
+        self.offset_weight = Parameter(
+            "offset_weight", shape=(n_off, in_channels, kh, kw),
+            init=init.Zero(), allow_deferred_init=True)
+        self.offset_bias = Parameter("offset_bias", shape=(n_off,),
+                                     init=init.Zero())
+        self.weight = Parameter(
+            "weight", shape=(channels, in_channels, kh, kw),
+            init=weight_initializer or init.Xavier(),
+            allow_deferred_init=True)
+        self.bias = Parameter("bias", shape=(channels,),
+                              init=init.Zero()) if use_bias else None
+
+    def infer_shape(self, x, *args):
+        in_c = x.shape[1]
+        kh, kw = self._kernel
+        self.weight.shape = (self._channels, in_c, kh, kw)
+        self.offset_weight.shape = (self.offset_weight.shape[0], in_c,
+                                    kh, kw)
+
+    def forward(self, x):
+        from ...ops.registry import apply_op
+
+        self._resolve(x)
+
+        def full(data, w_off, b_off, w, bias):
+            """Pure fn (offset conv + deformable sampling) run through the
+            one-off invoke path so autograd records it like any op."""
+            import jax
+            import jax.numpy as jnp
+
+            from ...ops.contrib_tail import deformable_convolution as dc
+
+            sh, sw = self._strides
+            ph, pw = self._padding
+            kh, kw = self._kernel
+            off = jax.lax.conv_general_dilated(
+                data, w_off, (sh, sw), [(ph, ph), (pw, pw)]) + \
+                b_off[None, :, None, None]
+            mask = None
+            if self._modulated:
+                n2 = self._dg * kh * kw * 2
+                off, mask = off[:, :n2], jax.nn.sigmoid(off[:, n2:])
+            return dc.fn(data, off, w, bias, kernel=self._kernel,
+                         stride=self._strides, pad=self._padding,
+                         num_deformable_group=self._dg, mask=mask)
+
+        bias = self.bias.data() if self.bias is not None else None
+        args = [x, self.offset_weight.data(), self.offset_bias.data(),
+                self.weight.data()]
+        if bias is not None:
+            return apply_op(full, *args, bias)
+        return apply_op(lambda d, wo, bo, w: full(d, wo, bo, w, None),
+                        *args)
+
+
+class ModulatedDeformableConvolution(DeformableConvolution):
+    """DCNv2: deformable conv with per-tap modulation mask (reference
+    modulated_deformable_convolution.cc)."""
+
+    def __init__(self, *args, **kwargs):
+        kwargs["modulated"] = True
+        super().__init__(*args, **kwargs)
+
+
+__all__ += ["PixelShuffle1D", "PixelShuffle2D", "PixelShuffle3D",
+            "DeformableConvolution", "ModulatedDeformableConvolution"]

@@ -323,3 +323,140 @@ def hawkes_ll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
 
 
 alias("_contrib_hawkesll", "hawkes_ll")
+
+
+# ---------------------------------------------------------------------------
+# deformable convolution — contrib/deformable_convolution.cc,
+# modulated_deformable_convolution.cc (DCN v1/v2)
+# ---------------------------------------------------------------------------
+@register("deformable_convolution")
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), pad=(1, 1), num_deformable_group=1,
+                           mask=None):
+    """DCN sampling conv [contrib/deformable_convolution.cc:90]: offset
+    (N, dg*K*2, OH, OW) shifts each kernel tap's sampling point; bilinear
+    gather + tap/channel contraction on the MXU (no im2col buffer).
+    ``mask`` (N, dg*K, OH, OW), already sigmoided, enables DCNv2
+    modulation [modulated_deformable_convolution.cc]."""
+    N, C, H, W = data.shape
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = pad
+    dg = num_deformable_group
+    K = kh * kw
+    OH, OW = offset.shape[2], offset.shape[3]
+    offs = offset.reshape(N, dg, K, 2, OH, OW)
+
+    oy = jnp.arange(OH) * sh - ph
+    ox = jnp.arange(OW) * sw - pw
+    ky, kx = jnp.meshgrid(jnp.arange(kh), jnp.arange(kw), indexing="ij")
+    ky, kx = ky.reshape(-1), kx.reshape(-1)
+    base_y = oy[None, :, None] + ky[:, None, None]
+    base_x = ox[None, None, :] + kx[:, None, None]
+    sy = base_y[None, None] + offs[:, :, :, 0]
+    sx = base_x[None, None] + offs[:, :, :, 1]
+
+    y0 = jnp.floor(sy)
+    x0 = jnp.floor(sx)
+    fy, fx = sy - y0, sx - x0
+    dpg = C // dg
+    xg2 = data.reshape(N, dg, dpg, H * W)
+
+    def gather(yi, xi):
+        valid = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        flat = (yc * W + xc).reshape(N, dg, K, 1, OH * OW)
+        took = jnp.take_along_axis(xg2[:, :, None], flat, axis=-1)
+        took = took.reshape(N, dg, K, dpg, OH, OW)
+        return took * valid[:, :, :, None].astype(data.dtype)
+
+    val = (gather(y0, x0) * ((1 - fy) * (1 - fx))[:, :, :, None]
+           + gather(y0, x0 + 1) * ((1 - fy) * fx)[:, :, :, None]
+           + gather(y0 + 1, x0) * (fy * (1 - fx))[:, :, :, None]
+           + gather(y0 + 1, x0 + 1) * (fy * fx)[:, :, :, None])
+    if mask is not None:
+        val = val * mask.reshape(N, dg, K, 1, OH, OW)
+    wk = weight.reshape(weight.shape[0], dg, dpg, K)
+    out = jnp.einsum("ngkcij,ogck->noij", val, wk)
+    if bias is not None:
+        out = out + bias[None, :, None, None]
+    return out
+
+
+alias("_contrib_DeformableConvolution", "deformable_convolution")
+
+
+# ---------------------------------------------------------------------------
+# interleaved attention matmuls — contrib/transformer.cc:651-826
+# (the reference's fastest 1.x BERT path; kept so those scripts run
+# verbatim.  On TPU each op is one einsum XLA maps straight onto the MXU —
+# the flash path in ops/pallas_attention.py remains the preferred API.)
+# ---------------------------------------------------------------------------
+@register("interleaved_matmul_selfatt_qk")
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads=1):
+    """(T, B, H*3*D) interleaved qkv -> (B*H, T, T) scaled scores
+    [transformer.cc:651; scale 1/sqrt(D) applied like :201]."""
+    T, B, E3 = queries_keys_values.shape
+    D = E3 // (heads * 3)
+    x = queries_keys_values.reshape(T, B, heads, 3, D)
+    q, k = x[..., 0, :], x[..., 1, :]
+    scores = jnp.einsum("tbhd,sbhd->bhts", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(D))
+    return scores.reshape(B * heads, T, T).astype(
+        queries_keys_values.dtype)
+
+
+@register("interleaved_matmul_selfatt_valatt")
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention,
+                                      heads=1):
+    """attention (B*H, T, T) @ values -> (T, B, H*D)
+    [transformer.cc:693]."""
+    T, B, E3 = queries_keys_values.shape
+    D = E3 // (heads * 3)
+    v = queries_keys_values.reshape(T, B, heads, 3, D)[..., 2, :]
+    att = attention.reshape(B, heads, T, T)
+    out = jnp.einsum("bhts,sbhd->tbhd", att, v)
+    return out.reshape(T, B, heads * D)
+
+
+@register("interleaved_matmul_encdec_qk")
+def interleaved_matmul_encdec_qk(queries, keys_values, heads=1):
+    """queries (Tq, B, H*D) x interleaved kv (Tk, B, H*2*D) ->
+    (B*H, Tq, Tk) scaled scores [transformer.cc:740]."""
+    Tq, B, E = queries.shape
+    D = E // heads
+    Tk = keys_values.shape[0]
+    q = queries.reshape(Tq, B, heads, D)
+    k = keys_values.reshape(Tk, B, heads, 2, D)[..., 0, :]
+    scores = jnp.einsum("tbhd,sbhd->bhts", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(D))
+    return scores.reshape(B * heads, Tq, Tk).astype(queries.dtype)
+
+
+@register("interleaved_matmul_encdec_valatt")
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads=1):
+    """attention (B*H, Tq, Tk) @ interleaved values -> (Tq, B, H*D)
+    [transformer.cc:786]."""
+    Tk, B, E2 = keys_values.shape
+    D = E2 // (heads * 2)
+    v = keys_values.reshape(Tk, B, heads, 2, D)[..., 1, :]
+    Tq = attention.shape[1]
+    att = attention.reshape(B, heads, Tq, Tk)
+    out = jnp.einsum("bhts,sbhd->tbhd", att, v)
+    return out.reshape(Tq, B, heads * D)
+
+
+@register("div_sqrt_dim")
+def div_sqrt_dim(data):
+    """data / sqrt(data.shape[-1]) [transformer.cc:838]."""
+    return data / jnp.sqrt(jnp.float32(data.shape[-1])).astype(data.dtype)
+
+
+for _n in ("interleaved_matmul_selfatt_qk",
+           "interleaved_matmul_selfatt_valatt",
+           "interleaved_matmul_encdec_qk",
+           "interleaved_matmul_encdec_valatt", "div_sqrt_dim"):
+    alias("_contrib_" + _n, _n)

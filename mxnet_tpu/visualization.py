@@ -14,19 +14,29 @@ from .base import MXNetError
 __all__ = ["print_summary", "plot_network"]
 
 
-def _walk(node, out, parent=None):
-    if not isinstance(node, dict):
+def _walk(root, out):
+    """Iterative DFS with a visited set: shared subgraphs (residual /
+    weight-sharing diamonds) appear once, and deep chains cannot blow the
+    recursion limit."""
+    if not isinstance(root, dict):
         return
-    name = node.get("op", "?")
-    if name == "null":
-        name = "var:" + str(node.get("name"))
-    if name == "const":
-        name = "const"
-    ident = id(node)
-    out.append((ident, name, node, id(parent) if parent is not None
-                else None))
-    for child in node.get("inputs", []) or []:
-        _walk(child, out, node)
+    seen = set()
+    stack = [(root, None)]
+    while stack:
+        node, parent = stack.pop()
+        if not isinstance(node, dict):
+            continue
+        ident = id(node)
+        if ident in seen:
+            continue
+        seen.add(ident)
+        name = node.get("op", "?")
+        if name == "null":
+            name = "var:" + str(node.get("name"))
+        out.append((ident, name, node,
+                    id(parent) if parent is not None else None))
+        for child in reversed(node.get("inputs", []) or []):
+            stack.append((child, node))
 
 
 def print_summary(symbol, shape=None, line_length=120, positions=None):

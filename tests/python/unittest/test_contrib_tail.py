@@ -234,3 +234,50 @@ class TestHawkes:
         # row with vl=0 sees only the compensator: ll = -sum_k mu*T
         np.testing.assert_allclose(out_ll.asnumpy()[2], -2 * 50.0,
                                    rtol=1e-5)
+
+
+class TestInterleavedAttention:
+    def test_selfatt_qk_valatt_match_dense_attention(self):
+        """The 1.x interleaved kernel chain == plain softmax attention."""
+        import jax
+
+        rs = _rs(6)
+        T, B, H, D = 5, 2, 2, 4
+        qkv = rs.randn(T, B, H * 3 * D).astype(np.float32)
+        scores = nd.interleaved_matmul_selfatt_qk(_arr(qkv), heads=H)
+        assert scores.shape == (B * H, T, T)
+        att = nd.softmax(scores, axis=-1)
+        out = nd.interleaved_matmul_selfatt_valatt(_arr(qkv), att, heads=H)
+        assert out.shape == (T, B, H * D)
+
+        # dense reference
+        x = qkv.reshape(T, B, H, 3, D)
+        q, k, v = x[..., 0, :], x[..., 1, :], x[..., 2, :]
+        s = np.einsum("tbhd,sbhd->bhts", q, k) / np.sqrt(D)
+        e = np.exp(s - s.max(-1, keepdims=True))
+        a = e / e.sum(-1, keepdims=True)
+        ref = np.einsum("bhts,sbhd->tbhd", a, v).reshape(T, B, H * D)
+        np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_encdec_chain_shapes_and_scale(self):
+        rs = _rs(7)
+        Tq, Tk, B, H, D = 3, 6, 2, 2, 4
+        q = rs.randn(Tq, B, H * D).astype(np.float32)
+        kv = rs.randn(Tk, B, H * 2 * D).astype(np.float32)
+        scores = nd.interleaved_matmul_encdec_qk(_arr(q), _arr(kv), heads=H)
+        assert scores.shape == (B * H, Tq, Tk)
+        out = nd.interleaved_matmul_encdec_valatt(
+            _arr(kv), nd.softmax(scores, axis=-1), heads=H)
+        assert out.shape == (Tq, B, H * D)
+        # scale: constant q/k -> scores = D * c^2 / sqrt(D)
+        qc = np.ones((1, 1, H * D), np.float32)
+        kvc = np.ones((1, 1, H * 2 * D), np.float32)
+        sc = nd.interleaved_matmul_encdec_qk(_arr(qc), _arr(kvc), heads=H)
+        np.testing.assert_allclose(sc.asnumpy().ravel(),
+                                   np.full(H, D / np.sqrt(D)), rtol=1e-5)
+
+    def test_div_sqrt_dim(self):
+        x = _arr(np.full((2, 9), 3.0))
+        np.testing.assert_allclose(nd.div_sqrt_dim(x).asnumpy(),
+                                   np.full((2, 9), 1.0), rtol=1e-6)

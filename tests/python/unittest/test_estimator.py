@@ -1,0 +1,105 @@
+"""Estimator + event handler tests (reference
+tests/python/unittest/test_gluon_estimator.py,
+test_gluon_event_handler.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib import estimator as est
+
+
+def _data(n=32):
+    rs = np.random.RandomState(0)
+    x = rs.randn(n, 4).astype(np.float32)
+    w = np.array([[1.0, -1, 0.5, 2]], np.float32)
+    y = (x @ w.T > 0).astype(np.float32).ravel()
+    ds = gluon.data.ArrayDataset(x, y)
+    return gluon.data.DataLoader(ds, batch_size=8)
+
+
+def _net():
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    return net
+
+
+def _estimator(net=None):
+    net = net or _net()
+    return est.Estimator(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        trainer=gluon.Trainer(net.collect_params(), "adam",
+                              {"learning_rate": 0.05}))
+
+
+def test_fit_trains_and_updates_metrics():
+    e = _estimator()
+    w0 = e.net.weight.data().asnumpy().copy()
+    e.fit(_data(), epochs=3)
+    assert not np.allclose(e.net.weight.data().asnumpy(), w0), \
+        "GradientUpdateHandler must step the trainer"
+    name, acc = e.train_metrics[0].get()
+    assert acc > 0.5
+
+
+def test_metric_handler_resets_each_epoch():
+    e = _estimator()
+    e.fit(_data(), epochs=3)
+    # MetricHandler resets at every epoch begin, so after 3 epochs the
+    # metric holds exactly ONE epoch of samples, not three
+    assert e.train_metrics[0].num_inst == 32
+
+
+def test_custom_gradient_update_handler_replaces_default():
+    calls = []
+
+    class EverySecond(est.GradientUpdateHandler):
+        def batch_end(self, estimator, *args, **kwargs):
+            calls.append(1)
+            if len(calls) % 2 == 0:
+                super().batch_end(estimator, *args, **kwargs)
+
+    e = _estimator()
+    e.fit(_data(), epochs=1, event_handlers=[EverySecond()])
+    assert len(calls) == 4  # 32/8 batches
+
+
+def test_stopping_handler_batch_budget():
+    e = _estimator()
+    counted = []
+
+    class Count(est.BatchEnd):
+        def batch_end(self, estimator, *args, **kwargs):
+            counted.append(1)
+
+    e.fit(_data(), batches=3, event_handlers=[Count()])
+    assert len(counted) == 3
+
+
+def test_checkpoint_and_early_stopping(tmp_path):
+    e = _estimator()
+    handlers = [
+        est.CheckpointHandler(str(tmp_path), model_prefix="m"),
+        est.EarlyStoppingHandler(monitor=e.train_metrics[0],
+                                 patience=1, mode="max"),
+    ]
+    e.fit(_data(), epochs=4, event_handlers=handlers)
+    assert any(f.startswith("m") for f in os.listdir(str(tmp_path)))
+
+
+def test_validation_handler_runs_eval():
+    e = _estimator()
+    evals = []
+
+    class SpyVal(est.ValidationHandler):
+        def __init__(self, data):
+            super().__init__(data, None)
+
+        def epoch_end(self, estimator, *args, **kwargs):
+            evals.append(estimator.evaluate(self.val_data))
+
+    e.fit(_data(), epochs=2, event_handlers=[SpyVal(_data(16))])
+    assert len(evals) == 2 and "accuracy" in list(evals[0])[0]

@@ -286,3 +286,97 @@ def test_variational_dropout_cell_locked_mask():
     cell.reset()
     out, _ = cell(x, states)
     assert cell._mask_i is None
+
+
+def test_pixel_shuffle_layers():
+    from mxnet_tpu.gluon import nn as gnn
+
+    # 2D: block content lands as f1 x f2 pixel blocks
+    x = nd.array(np.arange(1 * 4 * 2 * 2, dtype=np.float32)
+                 .reshape(1, 4, 2, 2))
+    out = gnn.PixelShuffle2D(2)(x)
+    assert out.shape == (1, 1, 4, 4)
+    ref = np.arange(16, dtype=np.float32).reshape(2, 2, 2, 2)  # f1 f2 H W
+    expect = ref.transpose(2, 0, 3, 1).reshape(4, 4)
+    np.testing.assert_allclose(out.asnumpy()[0, 0], expect)
+    # 1D / 3D shapes
+    assert gnn.PixelShuffle1D(3)(nd.ones((2, 6, 5))).shape == (2, 2, 15)
+    assert gnn.PixelShuffle3D((1, 2, 2))(
+        nd.ones((1, 8, 2, 3, 3))).shape == (1, 2, 2, 6, 6)
+
+
+def test_swish_and_batchnorm_relu():
+    from mxnet_tpu.gluon import nn as gnn
+
+    x = nd.array(np.array([-2.0, 0.0, 2.0], np.float32))
+    s = gnn.Swish()(x).asnumpy()
+    ref = np.array([-2, 0, 2]) / (1 + np.exp(np.array([2.0, 0, -2])))
+    np.testing.assert_allclose(s, ref, rtol=1e-5)
+    bn = gnn.BatchNormReLU(in_channels=3)
+    bn.initialize()
+    out = bn(nd.array(np.random.RandomState(0).randn(2, 3, 4, 4)
+                      .astype(np.float32)))
+    assert float(out.asnumpy().min()) >= 0.0
+
+
+def test_deformable_convolution_zero_offsets_match_conv():
+    """With zero offsets (the zero-init offset branch) DCN == regular
+    conv — the reference's sanity contract."""
+    from mxnet_tpu.gluon import nn as gnn
+
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.randn(2, 4, 7, 7).astype(np.float32))
+    dcn = gnn.DeformableConvolution(6, kernel_size=(3, 3), padding=(1, 1),
+                                    in_channels=4, use_bias=True)
+    dcn.initialize()
+    conv = gnn.Conv2D(6, 3, padding=1, in_channels=4)
+    conv.initialize()
+    conv.weight.set_data(dcn.weight.data())
+    conv.bias.set_data(dcn.bias.data())
+    np.testing.assert_allclose(dcn(x).asnumpy(), conv(x).asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_modulated_deformable_convolution_runs():
+    from mxnet_tpu.gluon import nn as gnn
+
+    rs = np.random.RandomState(1)
+    x = nd.array(rs.randn(1, 4, 6, 6).astype(np.float32))
+    dcn = gnn.ModulatedDeformableConvolution(
+        3, kernel_size=(3, 3), padding=(1, 1), in_channels=4,
+        num_deformable_group=2)
+    dcn.initialize()
+    out = dcn(x)
+    assert out.shape == (1, 3, 6, 6)
+    # grads flow through the sampling path
+    xg = nd.array(rs.randn(1, 4, 6, 6).astype(np.float32))
+    xg.attach_grad()
+    with autograd.record():
+        L = dcn(xg).sum()
+    L.backward()
+    assert float(np.abs(xg.grad.asnumpy()).sum()) > 0
+
+
+def test_pixel_shuffle_c_major_multichannel():
+    """C-major layout: channel c*prod(f)+tap feeds output channel c
+    (reference reshape(0, -4, -1, f1*f2, 0, 0))."""
+    from mxnet_tpu.gluon import nn as gnn
+
+    # 2 output channels, factor (2,2): 8 input channels
+    x = np.zeros((1, 8, 1, 1), np.float32)
+    x[0, :, 0, 0] = np.arange(8)
+    out = gnn.PixelShuffle2D(2)(nd.array(x)).asnumpy()
+    assert out.shape == (1, 2, 2, 2)
+    # output channel 0 gets input channels 0..3, channel 1 gets 4..7
+    np.testing.assert_allclose(out[0, 0].ravel(), [0, 1, 2, 3])
+    np.testing.assert_allclose(out[0, 1].ravel(), [4, 5, 6, 7])
+
+
+def test_deformable_conv_deferred_in_channels():
+    from mxnet_tpu.gluon import nn as gnn
+
+    dcn = gnn.DeformableConvolution(5, kernel_size=(3, 3), padding=(1, 1))
+    dcn.initialize()
+    out = dcn(nd.ones((1, 4, 6, 6)))
+    assert out.shape == (1, 5, 6, 6)
+    assert dcn.weight.shape == (5, 4, 3, 3)

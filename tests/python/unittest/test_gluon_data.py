@@ -270,3 +270,54 @@ def test_contrib_bbox_dataloader():
     batches = list(iter(loader))
     assert len(batches) == 2
     assert batches[0].data[0].shape == (2, 3, 32, 32)
+
+
+def test_transforms_rotate_family():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    rs = np.random.RandomState(0)
+    img = nd.array(rs.randint(0, 255, (12, 12, 3)).astype(np.float32))
+    # 360-degree rotation reproduces the image (interior pixels)
+    out = T.Rotate(360.0)(img)
+    np.testing.assert_allclose(out.asnumpy()[2:-2, 2:-2],
+                               img.asnumpy()[2:-2, 2:-2], atol=1e-3)
+    # 90-degree rotation of a delta moves it predictably
+    delta = np.zeros((7, 7, 1), np.float32)
+    delta[1, 3] = 1.0
+    r = T.Rotate(90.0)(nd.array(delta)).asnumpy()
+    assert r[3, 1].sum() > 0.9  # (row 1, center col) -> (center row, col 1)
+    np.random.seed(0)
+    rr = T.RandomRotation((-30, 30))(img)
+    assert rr.shape == img.shape
+
+
+def test_transforms_crop_family():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    rs = np.random.RandomState(1)
+    img = nd.array(rs.randint(0, 255, (20, 24, 3)).astype(np.uint8),
+                   dtype="uint8")
+    np.random.seed(0)
+    rc = T.RandomCrop(8)(img)
+    assert rc.shape == (8, 8, 3)
+    rcp = T.RandomCrop(8, pad=4)(img)
+    assert rcp.shape == (8, 8, 3)
+    cr = T.CropResize(2, 3, 10, 8, size=(5, 5))(img)
+    assert cr.shape == (5, 5, 3)
+    np.testing.assert_allclose(
+        T.CropResize(2, 3, 10, 8)(img).asnumpy(),
+        img.asnumpy()[3:11, 2:12])
+
+
+def test_transforms_hue_gray_apply():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    rs = np.random.RandomState(2)
+    img = nd.array(rs.randint(0, 255, (8, 8, 3)).astype(np.float32))
+    np.random.seed(0)
+    h = T.RandomHue(0.5)(img)
+    assert h.shape == img.shape
+    g = T.RandomGray(1.0)(img).asnumpy()
+    np.testing.assert_allclose(g[..., 0], g[..., 1], rtol=1e-4)
+    ra = T.RandomApply(T.RandomGray(1.0), p=0.0)
+    np.testing.assert_allclose(ra(img).asnumpy(), img.asnumpy())

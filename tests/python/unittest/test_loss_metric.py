@@ -138,3 +138,53 @@ def test_composite_and_create():
     custom = gmetric.np(lambda l, p: float((l == p.argmax(-1)).mean()))
     custom.update([nd.array([0])], [nd.array([[0.9, 0.1]])])
     assert custom.get()[1] == 1.0
+
+
+def test_fbeta_metric():
+    from mxnet_tpu.gluon import metric as gm
+
+    m = gm.Fbeta(beta=2)
+    label = nd.array(np.array([1, 1, 0, 0], np.float32))
+    pred = nd.array(np.array([[0.2, 0.8], [0.8, 0.2], [0.9, 0.1],
+                              [0.4, 0.6]], np.float32))
+    m.update([label], [pred])
+    # tp=1 fp=1 fn=1 -> p=0.5 r=0.5 -> fbeta = 0.5 for any beta
+    assert abs(m.get()[1] - 0.5) < 1e-6
+
+
+def test_mean_pairwise_distance_and_cosine():
+    from mxnet_tpu.gluon import metric as gm
+
+    m = gm.MeanPairwiseDistance()
+    label = nd.array(np.array([[0.0, 0], [0, 0]], np.float32))
+    pred = nd.array(np.array([[3.0, 4], [0, 0]], np.float32))
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 2.5) < 1e-6  # (5 + 0) / 2
+
+    c = gm.MeanCosineSimilarity()
+    a = nd.array(np.array([[1.0, 0], [0, 1]], np.float32))
+    b = nd.array(np.array([[1.0, 0], [1, 0]], np.float32))
+    c.update([a], [b])
+    assert abs(c.get()[1] - 0.5) < 1e-6  # (1 + 0) / 2
+
+
+def test_pcc_metric_matches_mcc_binary():
+    from mxnet_tpu.gluon import metric as gm
+
+    rs = np.random.RandomState(0)
+    label = rs.randint(0, 2, 50).astype(np.float32)
+    scores = rs.rand(50, 2).astype(np.float32)
+    pcc = gm.PCC()
+    mcc = gm.MCC()
+    pcc.update([nd.array(label)], [nd.array(scores)])
+    mcc.update([nd.array(label)], [nd.array(scores)])
+    assert abs(pcc.get()[1] - mcc.get()[1]) < 1e-6
+
+
+def test_metric_create_by_name_new_entries():
+    from mxnet_tpu.gluon import metric as gm
+
+    for name in ("fbeta", "pcc", "meanpairwisedistance",
+                 "meancosinesimilarity"):
+        m = gm.create(name)
+        assert isinstance(m, gm.EvalMetric)
