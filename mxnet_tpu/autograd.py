@@ -80,17 +80,29 @@ def predict_mode():
 
 
 class TapeNode:
-    """One recorded op: holds the vjp closure (residuals live on device)."""
+    """One recorded op: holds the vjp closure (residuals live on device).
 
-    __slots__ = ("vjp_fn", "inputs", "n_outputs", "out_avals", "seq", "name")
+    For ``create_graph`` (higher-order) backward the node also keeps the
+    forward pure fn + its full positional args, so the backward pass can be
+    re-expressed as fresh RECORDED ops (jax.vjp re-run inside the tape)
+    instead of replaying the stored closure, whose output would be off-tape
+    (reference: the C++ graph executor re-enters RecordOp for the grad
+    graph, imperative.cc:466)."""
+
+    __slots__ = ("vjp_fn", "inputs", "n_outputs", "out_avals", "seq",
+                 "name", "fwd_fn", "all_datas", "positions")
     _counter = [0]
 
-    def __init__(self, vjp_fn, inputs, n_outputs, out_avals=None, name=""):
+    def __init__(self, vjp_fn, inputs, n_outputs, out_avals=None, name="",
+                 fwd_fn=None, all_datas=None, positions=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs          # list of NDArray (kept alive for graph walk)
         self.n_outputs = n_outputs
         self.out_avals = out_avals    # [(shape, dtype)] for zero-cotangent fill
         self.name = name
+        self.fwd_fn = fwd_fn          # pure tuple-valued fn(*all_datas)
+        self.all_datas = all_datas    # raw positional args at record time
+        self.positions = positions    # indices of NDArray args in all_datas
         TapeNode._counter[0] += 1
         self.seq = TapeNode._counter[0]
 
@@ -206,7 +218,26 @@ def _backward_impl(heads, head_grads, retain_graph, create_graph,
                     else _onp.zeros(shape, _jax.dtypes.float0))
                 for ct, (shape, dtype) in zip(outs_ct, node.out_avals)
             ]
-        in_grads = node.vjp_fn(tuple(outs_ct))
+        if create_graph:
+            if node.fwd_fn is None:
+                raise MXNetError(
+                    "create_graph=True reached a '%s' node recorded "
+                    "without a re-traceable forward (hybridized CachedOp, "
+                    "autograd.Function, or CustomOp) — higher-order "
+                    "gradients flow only through registry ops; run the "
+                    "block un-hybridized for the double-backward pass"
+                    % (node.name or "?",))
+            # reference imperative.cc:466 Backward(): the grad sweep runs
+            # with is_recording = create_graph, independent of the caller's
+            # scope, so the produced grads always land on the tape
+            prev = thread_state.is_recording
+            thread_state.is_recording = True
+            try:
+                in_grads = _recorded_vjp(node, outs_ct)
+            finally:
+                thread_state.is_recording = prev
+        else:
+            in_grads = node.vjp_fn(tuple(outs_ct))
         for inp, ig in zip(node.inputs, in_grads):
             if ig is None:
                 continue
@@ -224,7 +255,8 @@ def _backward_impl(heads, head_grads, retain_graph, create_graph,
     if accumulate:
         _write_grads(var_grads, order, heads)
         return None
-    return {k: NDArray(v) for k, v in var_grads.items()}
+    return {k: (v if isinstance(v, NDArray) else NDArray(v))
+            for k, v in var_grads.items()}
 
 
 def _write_grads(var_grads, order, heads):
@@ -242,6 +274,8 @@ def _write_grads(var_grads, order, heads):
         g = var_grads.get(aid)
         if g is None or arr._grad is None:
             continue
+        if hasattr(g, "_data"):  # NDArray grad from a create_graph pass
+            g = g._data
         if arr._grad_req == "add":
             arr._grad._data = arr._grad._data + g
         else:
@@ -250,6 +284,43 @@ def _write_grads(var_grads, order, heads):
 
 def _accum(existing, new):
     return new if existing is None else existing + new
+
+
+def _recorded_vjp(node, outs_ct):
+    """Re-run the node's backward as RECORDED ops: jax.vjp of the stored
+    forward fn over (float cotangents + original tensor inputs), invoked
+    through apply_op so the produced gradients carry tape entries —
+    grad-of-grad then differentiates straight through them."""
+    import jax
+
+    from .ndarray.ndarray import NDArray
+    from .ops.registry import apply_op
+
+    float_idx = [i for i, ct in enumerate(outs_ct)
+                 if hasattr(ct, "dtype") and ct.dtype.name != "float0"]
+    const_cts = {i: ct for i, ct in enumerate(outs_ct)
+                 if i not in float_idx}
+    ct_args = [outs_ct[i] if isinstance(outs_ct[i], NDArray)
+               else NDArray(outs_ct[i]) for i in float_idx]
+    in_args = node.inputs  # NDArray handles recorded at forward time
+    n_ct = len(ct_args)
+
+    def bwd(*flat, _node=node, _float_idx=tuple(float_idx),
+            _const=const_cts, _n_ct=n_ct):
+        cts, tensors = flat[:_n_ct], flat[_n_ct:]
+        datas = list(_node.all_datas)
+        for pos, v in zip(_node.positions, tensors):
+            datas[pos] = v
+        _, vjp = jax.vjp(_node.fwd_fn, *datas)
+        full_ct = list(_const.get(i) for i in range(_node.n_outputs))
+        for i, c in zip(_float_idx, cts):
+            full_ct[i] = c
+        gs = vjp(tuple(full_ct))
+        return tuple(gs[p] for p in _node.positions)
+
+    out = apply_op(bwd, *ct_args, *in_args)
+    outs = out if isinstance(out, tuple) else (out,)
+    return list(outs)
 
 
 def get_symbol(x):

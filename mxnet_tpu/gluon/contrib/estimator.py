@@ -272,6 +272,7 @@ class Estimator:
         accumulation) without forking the loop."""
         if epochs is None and batches is None:
             epochs = 1
+        self.stop_training = False  # a second fit() must train again
         handlers = list(event_handlers or [])
         handlers.append(StoppingHandler(epochs, batches))
         if not any(isinstance(h, LoggingHandler) for h in handlers):

@@ -335,14 +335,24 @@ class CropResize(HybridBlock):
         self._interp = interpolation
 
     def forward(self, data):
-        out = data[self._y:self._y + self._h, self._x:self._x + self._w]
+        if data.ndim == 4:  # NHWC batch: crop the spatial axes
+            out = data[:, self._y:self._y + self._h,
+                       self._x:self._x + self._w]
+        else:
+            out = data[self._y:self._y + self._h,
+                       self._x:self._x + self._w]
         if self._size is not None:
             from ....image import imresize
 
             size = self._size if isinstance(self._size, (tuple, list)) \
                 else (self._size, self._size)
-            out = imresize(out, size[0], size[1],
-                           self._interp if self._interp is not None else 1)
+            interp = self._interp if self._interp is not None else 1
+            if out.ndim == 4:
+                out = nd.stack(*[imresize(out[i], size[0], size[1],
+                                          interp)
+                                 for i in range(out.shape[0])], axis=0)
+            else:
+                out = imresize(out, size[0], size[1], interp)
         return out
 
 

@@ -153,7 +153,8 @@ def invoke(op, inputs, attrs):
         node = TapeNode(
             vjp_wrapper, nd_inputs, len(out_datas),
             out_avals=[(o.shape, o.dtype) for o in out_datas],
-            name=op.name)
+            name=op.name, fwd_fn=tuple_fn, all_datas=list(datas),
+            positions=positions)
         outs = [NDArray(o) for o in out_datas]
         for i, o in enumerate(outs):
             if _is_float(o._data):

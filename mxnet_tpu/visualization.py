@@ -14,10 +14,12 @@ from .base import MXNetError
 __all__ = ["print_summary", "plot_network"]
 
 
-def _walk(root, out):
+def _walk(root, out, edges=None):
     """Iterative DFS with a visited set: shared subgraphs (residual /
-    weight-sharing diamonds) appear once, and deep chains cannot blow the
-    recursion limit."""
+    weight-sharing diamonds) list each NODE once but keep EVERY edge, and
+    deep chains cannot blow the recursion limit.  ``out`` receives
+    (ident, name, node, first_parent); ``edges`` (optional list) receives
+    every (child_ident, parent_ident) pair."""
     if not isinstance(root, dict):
         return
     seen = set()
@@ -27,6 +29,8 @@ def _walk(root, out):
         if not isinstance(node, dict):
             continue
         ident = id(node)
+        if edges is not None and parent is not None:
+            edges.append((ident, id(parent)))
         if ident in seen:
             continue
         seen.add(ident)
@@ -98,13 +102,16 @@ def plot_network(symbol, title="plot", save_format="pdf", shape=None,
                          "print_summary for a text view)") from None
     node_attrs = node_attrs or {}
     dot = Digraph(name=title, format=save_format)
-    nodes = []
-    _walk(symbol._json, nodes)
-    for ident, name, node, parent in nodes:
+    nodes, edges = [], []
+    _walk(symbol._json, nodes, edges)
+    hidden = set()
+    for ident, name, node, _parent in nodes:
         if hide_weights and name.startswith("var:") and \
                 any(k in name for k in ("weight", "bias", "gamma", "beta")):
+            hidden.add(ident)
             continue
         dot.node(str(ident), name, **node_attrs)
-        if parent is not None:
-            dot.edge(str(ident), str(parent))
+    for child, parent in edges:  # every consumer edge, diamonds included
+        if child not in hidden:
+            dot.edge(str(child), str(parent))
     return dot

@@ -121,3 +121,157 @@ def test_mark_variables():
         y = 5 * x
     y.backward()
     assert x.grad.asnumpy()[0] == 5.0
+
+
+def test_higher_order_grad_scalar():
+    """d2/dx2 tanh via autograd.grad twice (reference
+    test_higher_order_grad.py model)."""
+    x = nd.array(np.array([0.3, -0.7], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.tanh(x)
+        g1 = autograd.grad(y, [x], create_graph=True)[0]
+        gsum = g1.sum()
+    gsum.backward()
+    t = np.tanh(np.array([0.3, -0.7]))
+    expect = -2 * t * (1 - t * t)  # d/dx (1 - tanh^2)
+    np.testing.assert_allclose(x.grad.asnumpy(), expect, rtol=1e-4)
+
+
+def test_grad_with_multiple_outputs_and_inputs():
+    a = nd.array(np.array([2.0], np.float32))
+    b = nd.array(np.array([3.0], np.float32))
+    a.attach_grad(); b.attach_grad()
+    with autograd.record():
+        u = a * b
+        v = a + b
+        L = (u * v).sum()  # L = ab(a+b) = a^2 b + a b^2
+    L.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [2 * 2 * 3 + 9],
+                               rtol=1e-5)  # 2ab + b^2
+    np.testing.assert_allclose(b.grad.asnumpy(), [4 + 2 * 2 * 3],
+                               rtol=1e-5)  # a^2 + 2ab
+
+
+def test_grad_req_null_param_untouched():
+    x = nd.array(np.ones(3, np.float32))
+    y = nd.array(np.ones(3, np.float32))
+    x.attach_grad(grad_req="null")
+    y.attach_grad()
+    with autograd.record():
+        L = (x * y).sum()
+    L.backward()
+    np.testing.assert_allclose(y.grad.asnumpy(), np.ones(3))
+    assert x.grad is None or float(np.abs(x.grad.asnumpy()).sum()) == 0
+
+
+def test_is_recording_and_pause_nesting():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        with autograd.pause():
+            assert not autograd.is_recording()
+            with autograd.record():
+                assert autograd.is_recording()
+            assert not autograd.is_recording()
+        assert autograd.is_recording()
+
+
+def test_backward_through_concat_split():
+    a = nd.array(np.ones((2, 2), np.float32))
+    b = nd.array(np.full((2, 2), 2.0, np.float32))
+    a.attach_grad(); b.attach_grad()
+    with autograd.record():
+        c = nd.concat(a, b, dim=1)
+        parts = nd.split(c, num_outputs=2, axis=1)
+        L = (parts[0] * 3 + parts[1] * 5).sum()
+    L.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), np.full((2, 2), 3.0))
+    np.testing.assert_allclose(b.grad.asnumpy(), np.full((2, 2), 5.0))
+
+
+def test_backward_nonscalar_head_requires_head_grads():
+    x = nd.array(np.arange(4, dtype=np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    head = nd.array(np.array([1.0, 0, 2, 0], np.float32))
+    y.backward(head)
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * np.arange(4) *
+                               head.asnumpy())
+
+
+def test_third_order_grad_and_chain():
+    """d3/dx3 of x^4 = 24x, computed via three nested grad passes."""
+    x = nd.array(np.array([1.5], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 4).sum()
+        g1 = autograd.grad(y, [x], create_graph=True)[0]       # 4x^3
+        g2 = autograd.grad(g1.sum(), [x], create_graph=True)[0]  # 12x^2
+        g3sum = g2.sum()
+    g3sum.backward()                                            # 24x
+    np.testing.assert_allclose(x.grad.asnumpy(), [24 * 1.5], rtol=1e-4)
+
+
+def test_hessian_vector_product_through_net():
+    """HVP of a tiny MLP loss — create_graph through matmul + nonlinearity."""
+    rs = np.random.RandomState(0)
+    w = nd.array(rs.randn(3, 3).astype(np.float32) * 0.5)
+    x = nd.array(rs.randn(2, 3).astype(np.float32))
+    v = nd.array(rs.randn(3, 3).astype(np.float32))
+    w.attach_grad()
+    with autograd.record():
+        loss = (nd.tanh(nd.dot(x, w)) ** 2).sum()
+        g = autograd.grad(loss, [w], create_graph=True)[0]
+        gv = (g * v).sum()
+    gv.backward()
+    hvp = x.grad if False else w.grad
+    # numeric HVP: (g(w+eps*v) - g(w-eps*v)) / 2eps
+    eps = 1e-3
+
+    def g_at(wv):
+        wn = nd.array(wv)
+        wn.attach_grad()
+        with autograd.record():
+            L = (nd.tanh(nd.dot(x, wn)) ** 2).sum()
+        L.backward()
+        return wn.grad.asnumpy()
+
+    num = (g_at(w.asnumpy() + eps * v.asnumpy())
+           - g_at(w.asnumpy() - eps * v.asnumpy())) / (2 * eps)
+    np.testing.assert_allclose(hvp.asnumpy(), num, rtol=5e-2, atol=5e-3)
+
+
+def test_create_graph_outside_record_scope():
+    """Reference contract: the grad sweep records when create_graph=True
+    even if the caller left the record scope."""
+    x = nd.array(np.array([0.5], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.tanh(x)
+    g1 = autograd.grad(y, [x], create_graph=True)[0]  # outside record()
+    with autograd.record():
+        s = g1.sum()
+    # g1 carries tape entries, so a fresh backward through it reaches x
+    grads = autograd.grad(s, [x])
+    t = np.tanh(0.5)
+    np.testing.assert_allclose(grads[0].asnumpy(), [-2 * t * (1 - t * t)],
+                               rtol=1e-4)
+
+
+def test_create_graph_rejects_hybrid_nodes():
+    import pytest
+
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.base import MXNetError
+
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    net.hybridize()
+    x = nd.ones((1, 2))
+    x.attach_grad()
+    with autograd.record():
+        y = net(x).sum()
+        with pytest.raises(MXNetError):
+            autograd.grad(y, [x], create_graph=True)

@@ -103,3 +103,12 @@ def test_validation_handler_runs_eval():
 
     e.fit(_data(), epochs=2, event_handlers=[SpyVal(_data(16))])
     assert len(evals) == 2 and "accuracy" in list(evals[0])[0]
+
+
+def test_fit_twice_trains_again():
+    e = _estimator()
+    e.fit(_data(), epochs=1)
+    w1 = e.net.weight.data().asnumpy().copy()
+    e.fit(_data(), epochs=2)
+    assert not np.allclose(e.net.weight.data().asnumpy(), w1), \
+        "second fit() must actually train"
