@@ -8,7 +8,7 @@ from . import _proto
 
 # ONNX enums
 _FLOAT = 1
-_ATTR_FLOAT, _ATTR_INT, _ATTR_INTS = 1, 2, 7
+_ATTR_FLOAT, _ATTR_INT, _ATTR_STRING, _ATTR_INTS = 1, 2, 3, 7
 
 # opset 11: the last opset where Dropout.ratio is an attribute (it became
 # an input at 12); everything else emitted here is 11-compatible
@@ -41,6 +41,11 @@ def _attr_float(name, value):
             .varint(20, _ATTR_FLOAT))
 
 
+def _attr_string(name, value):
+    return (_proto.Writer().string(1, name).string(4, value)
+            .varint(20, _ATTR_STRING))
+
+
 def _node(op_type, inputs, outputs, name, attrs=()):
     w = _proto.Writer()
     for i in inputs:
@@ -54,11 +59,12 @@ def _node(op_type, inputs, outputs, name, attrs=()):
     return w
 
 
-def _value_info(name, shape):
+def _value_info(name, shape, elem_type=None):
     dims = _proto.Writer()
     for d in shape:
         dims.message(1, _proto.Writer().varint(1, d))
-    ttype = (_proto.Writer().varint(1, _FLOAT).message(2, dims))
+    ttype = (_proto.Writer().varint(1, elem_type if elem_type is not None
+                                    else _FLOAT).message(2, dims))
     typ = _proto.Writer().message(1, ttype)
     return _proto.Writer().string(1, name).message(2, typ)
 
@@ -67,6 +73,8 @@ class _Exporter:
     def __init__(self):
         self.nodes = []
         self.inits = []
+        self.min_opset = _OPSET          # raised by opset-gated ops
+        self.input_elem_type = None      # int64 when data feeds Gather
         self.counter = 0
 
     def uniq(self, base):
@@ -186,11 +194,93 @@ class _Exporter:
             self.nodes.append(_node("GlobalAveragePool", [cur], [out],
                                     self.uniq("GlobalAveragePool")))
             return out
+        if kind == "GlobalMaxPool2D":
+            out = self.uniq("gmp")
+            self.nodes.append(_node("GlobalMaxPool", [cur], [out],
+                                    self.uniq("GlobalMaxPool")))
+            return out
+        if kind == "LeakyReLU":
+            out = self.uniq("lrelu")
+            self.nodes.append(_node(
+                "LeakyRelu", [cur], [out], self.uniq("LeakyRelu"),
+                [_attr_float("alpha", getattr(layer, "_alpha",
+                                              getattr(layer, "_slope",
+                                                      0.01)))]))
+            return out
+        if kind == "ELU":
+            out = self.uniq("elu")
+            self.nodes.append(_node(
+                "Elu", [cur], [out], self.uniq("Elu"),
+                [_attr_float("alpha", getattr(layer, "_alpha", 1.0))]))
+            return out
+        if kind == "LayerNorm":
+            self.min_opset = max(self.min_opset, 17)  # LN is opset-17
+            inputs = [cur,
+                      self.add_init("gamma", layer.gamma.data().asnumpy()),
+                      self.add_init("beta", layer.beta.data().asnumpy())]
+            out = self.uniq("ln")
+            self.nodes.append(_node(
+                "LayerNormalization", inputs, [out],
+                self.uniq("LayerNormalization"),
+                [_attr_float("epsilon", layer._eps),
+                 _attr_int("axis", getattr(layer, "_axis", -1))]))
+            return out
+        if kind == "Embedding":
+            w_name = self.add_init("weight", layer.weight.data().asnumpy())
+            out = self.uniq("emb")
+            if cur == "data":
+                self.input_elem_type = 7  # INT64: Gather indices input
+            self.nodes.append(_node("Gather", [w_name, cur], [out],
+                                    self.uniq("Gather")))
+            return out
+        if kind == "PixelShuffle2D":
+            f = layer._f
+            if f[0] != f[1]:
+                raise MXNetError("onnx DepthToSpace needs square factors")
+            out = self.uniq("d2s")
+            # C-major layout == ONNX CRD mode
+            self.nodes.append(_node(
+                "DepthToSpace", [cur], [out], self.uniq("DepthToSpace"),
+                [_attr_int("blocksize", f[0]),
+                 _attr_string("mode", "CRD")]))
+            return out
+        if kind == "Conv2DTranspose":
+            w_name = self.add_init("weight", layer.weight.data().asnumpy())
+            inputs = [cur, w_name]
+            if layer.bias is not None:
+                inputs.append(self.add_init("bias",
+                                            layer.bias.data().asnumpy()))
+            out = self.uniq("convT")
+            k = layer._kernel
+            self.nodes.append(_node(
+                "ConvTranspose", inputs, [out], self.uniq("ConvTranspose"),
+                [_attr_ints("kernel_shape", k),
+                 _attr_ints("strides", layer._strides),
+                 _attr_ints("pads", tuple(layer._padding) * 2),
+                 _attr_ints("dilations", layer._dilation),
+                 _attr_ints("output_padding", layer._output_padding),
+                 _attr_int("group", layer._groups)]))
+            cur = out
+            if layer._activation:
+                cur = self._activation(layer._activation, cur)
+            return cur
         raise MXNetError("onnx export: unsupported layer %s" % kind)
 
     def _activation(self, act, cur):
         table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
-                 "softrelu": "Softplus"}
+                 "softrelu": "Softplus", "gelu": "Gelu", "elu": "Elu",
+                 "selu": "Selu"}
+        if act == "gelu":
+            self.min_opset = max(self.min_opset, 20)  # Gelu is opset-20
+        if act == "silu":
+            # silu = x * sigmoid(x): emit the two-node expansion
+            s = self.uniq("sig")
+            self.nodes.append(_node("Sigmoid", [cur], [s],
+                                    self.uniq("Sigmoid")))
+            out = self.uniq("mul")
+            self.nodes.append(_node("Mul", [cur, s], [out],
+                                    self.uniq("Mul")))
+            return out
         if act not in table:
             raise MXNetError("onnx export: unsupported activation %s" % act)
         out = self.uniq(act)
@@ -211,11 +301,12 @@ def export_model(net, input_shape, onnx_file_path="model.onnx",
     graph.string(2, model_name)
     for t in ex.inits:
         graph.message(5, t)
-    graph.message(11, _value_info("data", input_shape))
+    graph.message(11, _value_info("data", input_shape,
+                                  elem_type=ex.input_elem_type))
     # output shape is graph-dependent; emit rank-only (dim_value 0 allowed)
     graph.message(12, _value_info(out_name, ()))
 
-    opset = _proto.Writer().string(1, "").varint(2, _OPSET)
+    opset = _proto.Writer().string(1, "").varint(2, ex.min_opset)
     model = (_proto.Writer().varint(1, 8)          # ir_version
              .string(2, "mxnet_tpu")               # producer_name
              .message(7, graph).message(8, opset))

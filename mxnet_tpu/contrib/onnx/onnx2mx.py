@@ -52,7 +52,7 @@ def _parse_node(buf):
 
 
 _ACT = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
-        "Softplus": "softrelu"}
+        "Softplus": "softrelu", "Gelu": "gelu", "Selu": "selu"}
 
 
 def _sym_pads(attrs, op):
@@ -153,6 +153,52 @@ def import_model(onnx_file_path, ctx=None):
                         padding=tuple(pads[:2]), **kwargs))
         elif op == "GlobalAveragePool":
             net.add(nn.GlobalAvgPool2D())
+        elif op == "GlobalMaxPool":
+            net.add(nn.GlobalMaxPool2D())
+        elif op == "LeakyRelu":
+            net.add(nn.LeakyReLU(attrs.get("alpha", 0.01)))
+        elif op == "Elu":
+            net.add(nn.ELU(attrs.get("alpha", 1.0)))
+        elif op == "LayerNormalization":
+            gamma, beta = inits[ins[1]], inits[ins[2]]
+            layer = nn.LayerNorm(axis=int(attrs.get("axis", -1)),
+                                 epsilon=attrs.get("epsilon", 1e-5),
+                                 in_channels=gamma.shape[0])
+            net.add(layer)
+            pending_weights.append((layer, {"gamma": gamma, "beta": beta}))
+        elif op == "Gather" and ins[0] in inits:
+            if int(attrs.get("axis", 0)) != 0:
+                raise MXNetError("onnx import: Gather axis=%r over an "
+                                 "initializer is not an Embedding lookup"
+                                 % (attrs.get("axis"),))
+            w = inits[ins[0]]
+            layer = nn.Embedding(w.shape[0], w.shape[1])
+            net.add(layer)
+            pending_weights.append((layer, {"weight": w}))
+        elif op == "DepthToSpace":
+            if attrs.get("mode", "DCR") != "CRD":
+                raise MXNetError("onnx import: DepthToSpace DCR mode not "
+                                 "supported (export uses CRD)")
+            net.add(nn.PixelShuffle2D(int(attrs["blocksize"])))
+        elif op == "ConvTranspose":
+            if "output_shape" in attrs:
+                raise MXNetError("onnx import: ConvTranspose output_shape "
+                                 "is not supported; re-export with "
+                                 "explicit pads/output_padding")
+            w = inits[ins[1]]
+            bias = inits[ins[2]] if len(ins) > 2 else None
+            pads = _sym_pads(attrs, op)
+            layer = nn.Conv2DTranspose(
+                w.shape[1] * int(attrs.get("group", 1)),
+                kernel_size=tuple(attrs["kernel_shape"]),
+                strides=tuple(attrs.get("strides", (1, 1))),
+                padding=tuple(pads[:2]),
+                dilation=tuple(attrs.get("dilations", (1, 1))),
+                output_padding=tuple(attrs.get("output_padding", (0, 0))),
+                groups=int(attrs.get("group", 1)),
+                in_channels=w.shape[0], use_bias=bias is not None)
+            net.add(layer)
+            pending_weights.append((layer, {"weight": w, "bias": bias}))
         else:
             raise MXNetError("onnx import: unsupported op %s" % op)
 

@@ -226,7 +226,7 @@ def test_hessian_vector_product_through_net():
         g = autograd.grad(loss, [w], create_graph=True)[0]
         gv = (g * v).sum()
     gv.backward()
-    hvp = x.grad if False else w.grad
+    hvp = w.grad
     # numeric HVP: (g(w+eps*v) - g(w-eps*v)) / 2eps
     eps = 1e-3
 

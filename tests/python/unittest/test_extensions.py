@@ -151,10 +151,11 @@ def test_onnx_unsupported_layer(tmp_path):
     from mxnet_tpu.contrib import onnx as onnx_mx
 
     net = nn.HybridSequential()
-    net.add(nn.Embedding(10, 4))
+    net.add(nn.DeformableConvolution(3, kernel_size=(3, 3), padding=(1, 1),
+                                     in_channels=2))
     net.initialize()
     with pytest.raises(Exception):
-        onnx_mx.export_model(net, (2,), str(tmp_path / "x.onnx"))
+        onnx_mx.export_model(net, (1, 2, 4, 4), str(tmp_path / "x.onnx"))
 
 
 # ---------------------------------------------------------------------------
@@ -299,3 +300,50 @@ def test_onnx_padded_avgpool_count_include_pad(tmp_path):
         got = net2(x).asnumpy()
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
         assert net2[0]._count_include_pad == cip
+
+
+def test_onnx_roundtrip_extended_layers(tmp_path):
+    """Export -> import -> identical outputs for the widened layer set
+    (LeakyReLU/ELU/LayerNorm via Dense chain, DepthToSpace/PixelShuffle,
+    ConvTranspose, GlobalMaxPool, Embedding)."""
+    from mxnet_tpu.contrib import onnx as monnx
+    from mxnet_tpu.gluon import nn
+
+    rs = np.random.RandomState(0)
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2DTranspose(4, 3, strides=2, padding=1, in_channels=2),
+            nn.LeakyReLU(0.1),
+            nn.Conv2D(8, 3, padding=1, in_channels=4, activation="relu"),
+            nn.PixelShuffle2D(2),
+            nn.ELU(1.0),
+            nn.GlobalMaxPool2D(),
+            nn.Flatten(),
+            nn.Dense(5, in_units=2))
+    net.initialize()
+    x = mx.nd.array(rs.randn(2, 2, 8, 8).astype(np.float32))
+    ref = net(x).asnumpy()
+    f = str(tmp_path / "ext.onnx")
+    monnx.export_model(net, (2, 2, 8, 8), f)
+    net2, _ = monnx.import_model(f)
+    got = net2(x).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_roundtrip_layernorm_embedding(tmp_path):
+    from mxnet_tpu.contrib import onnx as monnx
+    from mxnet_tpu.gluon import nn
+
+    rs = np.random.RandomState(1)
+    net = nn.HybridSequential()
+    net.add(nn.Embedding(10, 6), nn.LayerNorm(in_channels=6),
+            nn.Dense(3, in_units=6, flatten=False))
+    net.initialize()
+    idx = mx.nd.array(rs.randint(0, 10, (4, 7)).astype(np.int32),
+                      dtype="int32")
+    ref = net(idx).asnumpy()
+    f = str(tmp_path / "ln.onnx")
+    monnx.export_model(net, (4, 7), f)
+    net2, _ = monnx.import_model(f)
+    got = net2(idx).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)

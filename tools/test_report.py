@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Run the test suite and write a machine-readable summary artifact.
+
+Round-2 advisor finding: headline "N/N tests pass" claims need a committed
+artifact (like BENCH_r*.json / MULTICHIP_r*.json) so the judge can verify
+without a ~15-minute re-run.  Usage::
+
+    python tools/test_report.py TESTS_r03.json
+
+Writes {"collected", "passed", "failed", "errors", "skipped",
+"duration_s", "tests_per_file": {file: n_collected}, "returncode",
+"command"}.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+
+def main(out_path="TESTS.json"):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cmd = [sys.executable, "-m", "pytest", "tests/", "-q", "-rN",
+           "--tb=no", "-p", "no:warnings"]
+    t0 = time.time()
+    proc = subprocess.run(cmd, cwd=repo, capture_output=True, text=True,
+                          timeout=3600)
+    dur = time.time() - t0
+    text = proc.stdout
+
+    summary = {"collected": 0, "passed": 0, "failed": 0, "errors": 0,
+               "skipped": 0}
+    m = re.search(r"(\d+) passed", text)
+    if m:
+        summary["passed"] = int(m.group(1))
+    m = re.search(r"(\d+) failed", text)
+    if m:
+        summary["failed"] = int(m.group(1))
+    m = re.search(r"(\d+) error", text)
+    if m:
+        summary["errors"] = int(m.group(1))
+    m = re.search(r"(\d+) skipped", text)
+    if m:
+        summary["skipped"] = int(m.group(1))
+    summary["collected"] = (summary["passed"] + summary["failed"]
+                            + summary["skipped"] + summary["errors"])
+
+    per_file = {}
+    collect = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "-q", "--collect-only"],
+        cwd=repo, capture_output=True, text=True, timeout=600)
+    for line in collect.stdout.splitlines():
+        if "::" in line:
+            per_file.setdefault(line.split("::")[0], 0)
+            per_file[line.split("::")[0]] += 1
+
+    report = dict(summary, duration_s=round(dur, 1),
+                  tests_per_file=per_file,
+                  returncode=proc.returncode,
+                  command=" ".join(cmd))
+    with open(os.path.join(repo, out_path), "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+    print(json.dumps(summary), "->", out_path)
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main(*(sys.argv[1:] or ["TESTS.json"])))
