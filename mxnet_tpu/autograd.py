@@ -222,10 +222,9 @@ def _backward_impl(heads, head_grads, retain_graph, create_graph,
             if node.fwd_fn is None:
                 raise MXNetError(
                     "create_graph=True reached a '%s' node recorded "
-                    "without a re-traceable forward (hybridized CachedOp, "
-                    "autograd.Function, or CustomOp) — higher-order "
-                    "gradients flow only through registry ops; run the "
-                    "block un-hybridized for the double-backward pass"
+                    "without a re-traceable forward (autograd.Function or "
+                    "CustomOp callbacks) — higher-order gradients flow "
+                    "through registry ops and hybridized blocks only"
                     % (node.name or "?",))
             # reference imperative.cc:466 Backward(): the grad sweep runs
             # with is_recording = create_graph, independent of the caller's

@@ -10,9 +10,9 @@ from . import _proto
 _FLOAT = 1
 _ATTR_FLOAT, _ATTR_INT, _ATTR_STRING, _ATTR_INTS = 1, 2, 3, 7
 
-# opset 11: the last opset where Dropout.ratio is an attribute (it became
-# an input at 12); everything else emitted here is 11-compatible
-_OPSET = 11
+# opset 13 baseline: Dropout takes ratio as an INPUT (the attribute form
+# died at 12); LayerNormalization raises to 17 and Gelu to 20 on demand
+_OPSET = 13
 
 
 def _tensor(name, arr):
@@ -162,9 +162,10 @@ class _Exporter:
             return out
         if kind == "Dropout":
             out = self.uniq("drop")
-            self.nodes.append(_node("Dropout", [cur], [out],
-                                    self.uniq("Dropout"),
-                                    [_attr_float("ratio", layer._rate)]))
+            ratio = self.add_init("ratio",
+                                  _np.asarray(layer._rate, _np.float32))
+            self.nodes.append(_node("Dropout", [cur, ratio], [out],
+                                    self.uniq("Dropout")))
             return out
         if kind in ("MaxPool2D", "AvgPool2D"):
             if layer._layout != "NCHW":
@@ -195,6 +196,8 @@ class _Exporter:
                                     self.uniq("GlobalAveragePool")))
             return out
         if kind == "GlobalMaxPool2D":
+            if getattr(layer, "_layout", "NCHW") != "NCHW":
+                raise MXNetError("onnx export supports NCHW pooling only")
             out = self.uniq("gmp")
             self.nodes.append(_node("GlobalMaxPool", [cur], [out],
                                     self.uniq("GlobalMaxPool")))
@@ -245,6 +248,8 @@ class _Exporter:
                  _attr_string("mode", "CRD")]))
             return out
         if kind == "Conv2DTranspose":
+            if getattr(layer, "_layout", "NCHW") != "NCHW":
+                raise MXNetError("onnx export supports NCHW convs only")
             w_name = self.add_init("weight", layer.weight.data().asnumpy())
             inputs = [cur, w_name]
             if layer.bias is not None:
@@ -268,10 +273,16 @@ class _Exporter:
 
     def _activation(self, act, cur):
         table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
-                 "softrelu": "Softplus", "gelu": "Gelu", "elu": "Elu",
-                 "selu": "Selu"}
+                 "softrelu": "Softplus", "elu": "Elu", "selu": "Selu"}
         if act == "gelu":
             self.min_opset = max(self.min_opset, 20)  # Gelu is opset-20
+            out = self.uniq("gelu")
+            # the framework computes the tanh approximation
+            # (jax.nn.gelu(approximate=True)) — declare it
+            self.nodes.append(_node(
+                "Gelu", [cur], [out], self.uniq("Gelu"),
+                [_attr_string("approximate", "tanh")]))
+            return out
         if act == "silu":
             # silu = x * sigmoid(x): emit the two-node expansion
             s = self.uniq("sig")

@@ -137,7 +137,11 @@ def import_model(onnx_file_path, ctx=None):
         elif op in _ACT:
             net.add(nn.Activation(_ACT[op]))
         elif op == "Dropout":
-            net.add(nn.Dropout(attrs.get("ratio", 0.5)))
+            if len(ins) > 1 and ins[1] in inits:  # opset>=12: ratio input
+                ratio = float(_np.asarray(inits[ins[1]]).reshape(()))
+            else:
+                ratio = attrs.get("ratio", 0.5)
+            net.add(nn.Dropout(ratio))
         elif op in ("MaxPool", "AveragePool"):
             cls = nn.MaxPool2D if op == "MaxPool" else nn.AvgPool2D
             pads = _sym_pads(attrs, op)

@@ -411,10 +411,21 @@ class HybridBlock(Block):
                 pgrads, *igrads = _vjp(tuple(out_cts))
                 return list(pgrads) + list(igrads)
 
+            n_p = len(param_datas)
+
+            def fwd_flat(*flat, _jfn=centry.jfn, _rng=rng, _n_p=n_p):
+                outs, _states = _jfn(list(flat[:_n_p]), _rng, *flat[_n_p:])
+                return tuple(outs)
+
+            all_datas = list(param_datas) + list(input_datas)
             node = autograd.TapeNode(
                 vjp_wrapper, node_inputs, len(out_datas),
                 out_avals=[(o.shape, o.dtype) for o in out_datas],
-                name=type(self).__name__)
+                name=type(self).__name__,
+                # create_graph support: the traced program re-enters the
+                # tape through this flat pure fn (autograd._recorded_vjp)
+                fwd_fn=fwd_flat, all_datas=all_datas,
+                positions=list(range(len(all_datas))))
             outs = [NDArray(o) for o in out_datas]
             for i, o in enumerate(outs):
                 import jax.numpy as jnp

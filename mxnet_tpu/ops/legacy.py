@@ -34,7 +34,13 @@ for _camel, _snake in [
         ("SequenceReverse", "sequence_reverse"),
         ("FullyConnected", "fully_connected"),
         ("Convolution", "convolution"), ("Deconvolution", "deconvolution"),
-        ("Pooling", "pooling"), ("slice_channel", "split")]:
+        ("Pooling", "pooling"), ("slice_channel", "split"),
+        # elemwise_* kept as registry names (tensor/elemwise_binary_op
+        # registrations) — same fused kernels as the broadcast forms here
+        ("elemwise_add", "add"), ("elemwise_sub", "subtract"),
+        ("elemwise_mul", "multiply"), ("elemwise_div", "divide"),
+        ("broadcast_add", "add"), ("broadcast_sub", "subtract"),
+        ("broadcast_mul", "multiply"), ("broadcast_div", "divide")]:
     alias(_camel, _snake)
 
 
