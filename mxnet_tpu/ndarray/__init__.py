@@ -26,11 +26,8 @@ _namespace = globals()
 for _name in list_ops():
     _namespace.setdefault(_name, get_op(_name))
 
-# broadcast_* and elemwise_* legacy aliases (reference op names)
-broadcast_add = elemwise_add = _core.add
-broadcast_sub = elemwise_sub = _core.subtract
-broadcast_mul = elemwise_mul = _core.multiply
-broadcast_div = elemwise_div = _core.divide
+# broadcast_add/sub/mul/div and elemwise_* come from the registry alias
+# table (ops/legacy.py) via the re-export loop above — ONE source of truth
 broadcast_power = _core.power
 broadcast_maximum = _core.maximum
 broadcast_minimum = _core.minimum

@@ -516,8 +516,11 @@ def multi_head_attention(q, k, v, num_heads=1, mask=None, scale=None,
     (mxnet_tpu/ops/pallas_attention.py) takes over for long sequences.
 
     impl: 'auto' | 'dense' | 'flash' (blockwise scan) | 'pallas'.
-    attn_dropout (+ dropout_key) drops attention probabilities — only the
-    dense path materializes them, so flash/pallas reject it explicitly.
+    attn_dropout (+ dropout_key) drops attention probabilities; dense and
+    the blockwise flash path both support it (flash applies a per-block
+    threefry mask online, never materializing (T, T)), so auto-dispatch
+    routes long-sequence dropout training to 'flash' and the dropout-free
+    case to the raw Pallas kernel.  Only impl='pallas' rejects dropout.
     """
     from ..base import MXNetError
     from . import pallas_attention as pa
@@ -534,22 +537,29 @@ def multi_head_attention(q, k, v, num_heads=1, mask=None, scale=None,
                          "with mxnet_tpu.random.take_key())")
     has_dropout = attn_dropout > 0.0
     if impl == "auto":
-        impl = ("pallas" if pa.use_flash(Tq, Tk, D, mask is not None)
-                and not has_dropout else "dense")
+        if pa.use_flash(Tq, Tk, D, mask is not None):
+            # probability dropout rides the blockwise online-softmax path
+            # (per-block threefry mask, no (T,T) materialization); the
+            # raw Pallas kernel handles the dropout-free case
+            impl = "flash" if has_dropout else "pallas"
+        else:
+            impl = "dense"
     if impl in ("pallas", "flash"):
         if mask is not None:
             raise MXNetError(
                 "impl=%r does not support an arbitrary mask (only causal=); "
                 "use impl='dense' or drop the mask" % impl)
-        if has_dropout:
+        if has_dropout and impl == "pallas":
             raise MXNetError(
-                "impl=%r does not support attention-probability dropout; "
-                "use impl='dense' or attn_dropout=0" % impl)
+                "impl='pallas' does not support attention-probability "
+                "dropout; use impl='flash' (blockwise) or attn_dropout=0")
         if impl == "pallas":
             out = pa.flash_attention(qh, kh, vh, causal, scale)
         else:
             out = pa.blockwise_attention(qh, kh, vh, causal=causal,
-                                         sm_scale=scale)
+                                         sm_scale=scale,
+                                         dropout_p=attn_dropout,
+                                         dropout_key=dropout_key)
         return out.transpose(0, 2, 1, 3).reshape(B, Tq, HD)
     scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
                         preferred_element_type=jnp.float32) * scale

@@ -29,11 +29,22 @@ _NEG_INF = -1e30
 # ---------------------------------------------------------------------------
 # blockwise (pure JAX) — the reference semantics + the backward path
 # ---------------------------------------------------------------------------
-def blockwise_attention(q, k, v, causal=False, sm_scale=None, block_k=256):
+def blockwise_attention(q, k, v, causal=False, sm_scale=None, block_k=256,
+                        dropout_p=0.0, dropout_key=None):
     """Memory-efficient attention via lax.scan over K/V blocks.
 
     q, k, v: (B, H, T, D).  Differentiable; O(T·D + T·block_k) live memory.
-    """
+
+    ``dropout_p`` drops attention PROBABILITIES (the BERT recipe) without
+    ever materializing the (T, T) matrix: the softmax denominator
+    accumulates the undropped mass while the numerator applies a
+    per-block threefry mask — exactly dropout(softmax(s)) @ v, computed
+    online.  Deterministic per ``dropout_key``, so the vjp recomputation
+    sees the same mask."""
+    if dropout_p > 0.0 and dropout_key is None:
+        raise ValueError(
+            "blockwise_attention: dropout_p > 0 requires dropout_key "
+            "(e.g. jax.random.PRNGKey / mxnet_tpu.random.take_key())")
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     scale = sm_scale if sm_scale is not None else 1.0 / (D ** 0.5)
@@ -64,8 +75,15 @@ def blockwise_attention(q, k, v, causal=False, sm_scale=None, block_k=256):
         p = jnp.exp(s - m_new[..., None])
         corr = jnp.exp(m - m_new)
         l = l * corr + p.sum(-1)
+        if dropout_p > 0.0:
+            keep = 1.0 - dropout_p
+            mask_bits = jax.random.bernoulli(
+                jax.random.fold_in(dropout_key, j), keep, p.shape)
+            p_num = p * mask_bits.astype(p.dtype) / keep
+        else:
+            p_num = p
         acc = acc * corr[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, vblk.astype(jnp.float32),
+            "bhqk,bhkd->bhqd", p_num, vblk.astype(jnp.float32),
             preferred_element_type=jnp.float32)
         return (m_new, l, acc), None
 

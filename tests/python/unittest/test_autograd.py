@@ -295,3 +295,25 @@ def test_create_graph_through_hybridized_block():
                      - (grad_at(xm) ** 2).sum()) / (2 * eps)
     np.testing.assert_allclose(x.grad.asnumpy(), num, rtol=5e-2,
                                atol=1e-4)
+
+
+def test_create_graph_rejects_custom_function_nodes():
+    """autograd.Function callbacks have no re-traceable forward; the
+    create_graph sweep must fail loudly, not corrupt the Hessian."""
+    import pytest
+
+    from mxnet_tpu.base import MXNetError
+
+    class Square(autograd.Function):
+        def forward(self, x):
+            return x * x
+
+        def backward(self, dy):
+            return 2 * dy
+
+    x = nd.array(np.array([2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = Square()(x).sum()
+        with pytest.raises(MXNetError):
+            autograd.grad(y, [x], create_graph=True)
