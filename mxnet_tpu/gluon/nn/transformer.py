@@ -73,11 +73,12 @@ class MultiHeadAttention(HybridBlock):
         q = self.query_proj(query)
         k = self.key_proj(key)
         v = self.value_proj(value)
-        attn_kwargs = {}
         if self._dropout > 0.0 and autograd.is_training():
+            # auto-dispatch handles dropout now: long sequences ride the
+            # blockwise flash path (per-block mask, no (T,T) buffer)
             attn_kwargs = dict(attn_dropout=self._dropout,
                                dropout_key=mxrandom.take_key(),
-                               impl="dense")
+                               impl=self._impl)
         else:
             attn_kwargs = dict(impl=self._impl)
         out = nd.multi_head_attention(

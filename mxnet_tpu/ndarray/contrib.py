@@ -405,3 +405,34 @@ def boolean_mask(data, index, axis=0):
 
     fn.__name__ = "boolean_mask"
     return apply_op(fn, data)
+
+
+# ops that the reference registers under _contrib_ but this registry holds
+# under plain names (the _contrib_-prefixed aliases also resolve)
+_CONTRIB_PLAIN = frozenset([
+    "quantize", "quantize_v2", "dequantize", "requantize",
+    "quantized_conv", "quantized_fully_connected",
+    "roi_align", "box_iou", "box_nms", "box_encode", "box_decode",
+    "bipartite_matching", "multibox_prior", "multibox_detection",
+    "count_sketch", "fft", "ifft", "index_copy", "index_add",
+    "sync_batch_norm", "adaptive_avg_pooling", "bilinear_resize",
+    "multi_sum_sq", "multi_lars", "multi_all_finite", "all_finite",
+    "multi_lamb_update", "multi_lans_update", "adamw_update",
+    "mp_adamw_update", "deformable_convolution", "boolean_mask",
+])
+
+
+def __getattr__(name):
+    """Resolve ``mx.nd.contrib.<op>`` from the registry — ONLY names the
+    reference's contrib surface carries: ``_contrib_``-prefixed
+    registrations (hawkesll, interleaved matmuls, div_sqrt_dim,
+    SyncBatchNorm...) and the curated plain-name set above.  A stray
+    non-contrib name (``mx.nd.contrib.add``) raises, so typos in ported
+    1.x code fail loudly instead of aliasing the whole op namespace."""
+    from ..ops.registry import _OP_REGISTRY
+
+    if "_contrib_" + name in _OP_REGISTRY:
+        return _OP_REGISTRY["_contrib_" + name]
+    if name in _CONTRIB_PLAIN and name in _OP_REGISTRY:
+        return _OP_REGISTRY[name]
+    raise AttributeError("mx.nd.contrib has no attribute %r" % (name,))

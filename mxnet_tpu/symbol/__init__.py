@@ -338,6 +338,20 @@ def ones(shape, dtype="float32", **kwargs):
     return Symbol(lambda env: data, [], name="ones")
 
 
+def _make_sym_op(opname, display_name=None):
+    """Deferred-apply wrapper shared by mx.sym.<op> and mx.sym.contrib.<op>
+    (one body, so the 'data' kwarg convention cannot diverge)."""
+
+    def op_fn(*args, **attrs):
+        data_args = [a for a in args if isinstance(a, (Symbol, NDArray))]
+        if "data" in attrs:
+            data_args = [attrs.pop("data")] + data_args
+        return Symbol._apply(opname, *data_args, **attrs)
+
+    op_fn.__name__ = display_name or opname
+    return op_fn
+
+
 class _SymModule(types.ModuleType):
     """Expose every registered op as mx.sym.<op>(*symbols, **attrs)."""
 
@@ -345,17 +359,34 @@ class _SymModule(types.ModuleType):
         if name.startswith("__"):
             raise AttributeError(name)
         if name in list_ops():
-            def op_fn(*args, **attrs):
-                data_args = [a for a in args if isinstance(a, (Symbol,
-                                                               NDArray))]
-                if "data" in attrs:
-                    data_args = [attrs.pop("data")] + data_args
-                return Symbol._apply(name, *data_args, **attrs)
-
-            op_fn.__name__ = name
+            op_fn = _make_sym_op(name)
             setattr(self, name, op_fn)
             return op_fn
+        if name == "contrib":
+            contrib = _SymContrib()
+            setattr(self, "contrib", contrib)
+            return contrib
         raise AttributeError("mx.sym has no attribute %r" % name)
+
+
+class _SymContrib:
+    """mx.sym.contrib.<op> — same surface rule as mx.nd.contrib
+    (ndarray/contrib.py): _contrib_-prefixed registrations plus the
+    curated plain-name contrib set."""
+
+    def __getattr__(self, name):
+        from ..ndarray.contrib import _CONTRIB_PLAIN
+        from ..ops.registry import _OP_REGISTRY
+
+        if "_contrib_" + name in _OP_REGISTRY:
+            op_fn = _make_sym_op("_contrib_" + name, display_name=name)
+        elif name in _CONTRIB_PLAIN and name in _OP_REGISTRY:
+            op_fn = _make_sym_op(name)
+        else:
+            raise AttributeError(
+                "mx.sym.contrib has no attribute %r" % (name,))
+        setattr(self, name, op_fn)
+        return op_fn
 
 
 sys.modules[__name__].__class__ = _SymModule

@@ -1,0 +1,108 @@
+"""Initializer + Context coverage (reference tests/python/unittest/
+test_init.py and the ctx handling in test_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import initializer as init
+from mxnet_tpu import nd
+
+
+def _initialized(cls_or_obj, shape=(64, 64), **kwargs):
+    net_init = cls_or_obj if not isinstance(cls_or_obj, type) \
+        else cls_or_obj(**kwargs)
+    arr = nd.zeros(shape)
+    net_init("weight", arr)
+    return arr.asnumpy()
+
+
+class TestInitializers:
+    def test_constant_zero_one(self):
+        np.testing.assert_allclose(_initialized(init.Zero), 0.0)
+        np.testing.assert_allclose(_initialized(init.One), 1.0)
+        np.testing.assert_allclose(_initialized(init.Constant(3.5)), 3.5)
+
+    def test_uniform_range_and_normal_sigma(self):
+        mx.random.seed(0)
+        u = _initialized(init.Uniform(0.2))
+        assert -0.2 <= u.min() and u.max() <= 0.2
+        assert u.std() > 0.05
+        n = _initialized(init.Normal(0.3), shape=(128, 128))
+        assert abs(n.std() - 0.3) < 0.02
+
+    def test_xavier_magnitude(self):
+        mx.random.seed(1)
+        x = _initialized(init.Xavier(factor_type="avg", magnitude=3),
+                         shape=(100, 100))
+        # uniform bound sqrt(3 * 2 / (100+100)) ~ 0.173
+        assert x.max() <= 0.18 and x.min() >= -0.18
+        assert x.std() > 0.05
+
+    def test_orthogonal_is_orthogonal(self):
+        mx.random.seed(2)
+        w = _initialized(init.Orthogonal(scale=1.0), shape=(32, 32))
+        np.testing.assert_allclose(w @ w.T, np.eye(32), atol=1e-4)
+        # the reference default scale is 1.414: rows orthogonal, norm^2=2
+        w2 = _initialized(init.Orthogonal(), shape=(16, 16))
+        np.testing.assert_allclose(w2 @ w2.T, 2.0 * np.eye(16), atol=1e-3)
+
+    def test_bilinear_upsampling_kernel(self):
+        w = _initialized(init.Bilinear(), shape=(1, 1, 4, 4))
+        k = w[0, 0]
+        np.testing.assert_allclose(k, k[::-1, ::-1], rtol=1e-6)  # symmetric
+        assert k.max() == k[1:3, 1:3].max()  # peak at center
+
+    def test_lstmbias_forget_gate(self):
+        b = _initialized(init.LSTMBias(forget_bias=1.0), shape=(16,))
+        H = 4
+        np.testing.assert_allclose(b[H:2 * H], 1.0)  # forget slice
+        np.testing.assert_allclose(b[:H], 0.0)
+
+    def test_create_registry_and_mixed(self):
+        i = init.create("xavier")
+        assert isinstance(i, init.Xavier)
+        mixed = init.Mixed([".*bias.*", ".*"], [init.One(), init.Zero()])
+        a = nd.zeros((4,))
+        mixed("encoder_bias_0", a)
+        np.testing.assert_allclose(a.asnumpy(), 1.0)
+        b = nd.zeros((4,))
+        mixed("weight_0", b)
+        np.testing.assert_allclose(b.asnumpy(), 0.0)
+
+    def test_initializer_through_gluon(self):
+        from mxnet_tpu.gluon import nn
+
+        net = nn.Dense(5, in_units=5, weight_initializer=init.Constant(0.5))
+        net.initialize()
+        np.testing.assert_allclose(net.weight.data().asnumpy(), 0.5)
+        # reference precedence: the per-param initializer wins over the
+        # default passed to initialize(), even on force_reinit
+        net.initialize(init=init.Zero(), force_reinit=True)
+        np.testing.assert_allclose(net.weight.data().asnumpy(), 0.5)
+        # a param with no own init follows the default
+        net2 = nn.Dense(3, in_units=3)
+        net2.initialize(init=init.Constant(2.0))
+        np.testing.assert_allclose(net2.weight.data().asnumpy(), 2.0)
+
+
+class TestContext:
+    def test_cpu_tpu_handles(self):
+        c = mx.cpu()
+        assert c.device_type in ("cpu",)
+        assert mx.context.current_context() is not None
+        assert mx.num_gpus() == 0
+
+    def test_context_equality_and_repr(self):
+        assert mx.cpu(0) == mx.cpu(0)
+        assert "cpu" in repr(mx.cpu(0))
+
+    def test_array_creation_with_ctx(self):
+        a = nd.ones((2, 2), ctx=mx.cpu())
+        assert a.shape == (2, 2)
+        assert a.context.device_type == "cpu"
+
+    def test_with_context_scope(self):
+        with mx.Context(mx.cpu(0)) if not callable(mx.Context) or \
+                isinstance(mx.Context, type) else mx.cpu(0):
+            x = nd.zeros((1,))
+        assert x.shape == (1,)

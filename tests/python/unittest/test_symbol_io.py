@@ -215,3 +215,30 @@ def test_im2rec_list_and_encode(tmp_path):
     img = mximage.imdecode(payload)
     assert img.shape[2] == 3 and min(img.shape[:2]) == 16
     r.close()
+
+
+def test_contrib_namespaces_resolve_registry_ops():
+    """mx.nd.contrib.* and mx.sym.contrib.* resolve plain and _contrib_-
+    prefixed registry names (reference generated namespaces)."""
+    from mxnet_tpu import nd as ndm
+
+    out = ndm.contrib.div_sqrt_dim(ndm.ones((2, 4)))
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 4), 0.5))
+    assert ndm.contrib.hawkesll.name == "hawkes_ll"
+    with pytest.raises(AttributeError):
+        ndm.contrib.no_such_op_xyz
+
+    x = sym.Symbol.var("x")
+    s = sym.contrib.div_sqrt_dim(x)
+    got = _ev(s, x=nd.ones((3, 16)))
+    np.testing.assert_allclose(got, np.full((3, 16), 0.25))
+
+
+def test_contrib_namespace_rejects_non_contrib_ops():
+    from mxnet_tpu import nd as ndm
+
+    with pytest.raises(AttributeError):
+        ndm.contrib.add  # plain arithmetic must NOT alias into contrib
+    with pytest.raises(AttributeError):
+        sym.contrib.Convolution
+    assert sym.contrib is sym.contrib  # cached instance
