@@ -77,6 +77,70 @@ class KVStore(KVStoreBase):
             for dst in (o if isinstance(o, (list, tuple)) else [o]):
                 src.copyto(dst)
 
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows named by ``row_ids`` (reference
+        kvstore.py:385 row_sparse_pull — the sparse-embedding workflow:
+        servers hold the full table, workers fetch the rows this batch
+        touches).  Each ``out`` receives a RowSparseNDArray whose stored
+        rows are ``unique(row_ids)``.
+
+        ``row_ids`` is one array-like (shared by every out) or a list of
+        array-likes matching the flattened outs one-to-one (the reference
+        out/row_ids pairing contract); a length mismatch raises instead of
+        silently truncating."""
+        import jax.numpy as jnp
+
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull requires row_ids")
+        keys, outs = _pair(key, out)
+        flat_dsts = []
+        for o in outs:
+            flat_dsts.extend([(o_, oi) for oi, o_ in enumerate(
+                o if isinstance(o, (list, tuple)) else [o])])
+        dst_keys = []
+        for k, o in zip(keys, outs):
+            n = len(o) if isinstance(o, (list, tuple)) else 1
+            dst_keys.extend([k] * n)
+
+        def as_ids(v):
+            arr = v._data if hasattr(v, "_data") else jnp.asarray(v)
+            return arr.reshape(-1).astype(jnp.int32)
+
+        if isinstance(row_ids, (list, tuple)) and row_ids and \
+                not isinstance(row_ids[0], (int, float)):
+            if len(row_ids) != len(flat_dsts):
+                raise MXNetError(
+                    "row_sparse_pull: %d row_ids arrays for %d outs"
+                    % (len(row_ids), len(flat_dsts)))
+            ids_per_dst = [as_ids(r) for r in row_ids]
+        else:
+            ids_per_dst = [as_ids(row_ids)] * len(flat_dsts)
+
+        for (dst, _oi), k, idx in zip(flat_dsts, dst_keys, ids_per_dst):
+            src = self._store[self._key(k)]
+            n_rows = src.shape[0]
+            import numpy as _np
+
+            host_idx = _np.asarray(idx)
+            if host_idx.size and (host_idx.min() < 0
+                                  or host_idx.max() >= n_rows):
+                raise MXNetError(
+                    "row_sparse_pull: row id out of range [0, %d): %r"
+                    % (n_rows, int(host_idx.min() if host_idx.min() < 0
+                                   else host_idx.max())))
+            uniq = jnp.unique(idx)
+            rsp = RowSparseNDArray(src._data[uniq], uniq, src.shape)
+            if isinstance(dst, RowSparseNDArray):
+                dst._data = rsp._data
+                dst.indices_ = rsp.indices_
+                dst._shape = rsp._shape
+            else:
+                # densify through tostype so copyto's shape/dtype
+                # validation applies (no hand-rolled scatter)
+                rsp.tostype("default").copyto(dst)
+
     def pushpull(self, key, value, out=None, priority=0):
         keys, values = _pair(key, value)
         for k, v in zip(keys, values):

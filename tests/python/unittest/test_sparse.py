@@ -267,3 +267,72 @@ def test_row_sparse_from_dense_device_path():
     rs_arr = row_sparse_from_dense(nd.array(dense))
     assert rs_arr.indices.asnumpy().tolist() == [1, 4]
     assert np.allclose(rs_arr.tostype("default").asnumpy(), dense)
+
+
+def test_kvstore_row_sparse_pull():
+    """Reference kvstore.row_sparse_pull contract: only the requested rows
+    come back, as a RowSparseNDArray keyed by unique(row_ids)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import kv
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray, \
+        row_sparse_from_dense
+
+    store = kv.create("local")
+    table = np.arange(20, dtype=np.float32).reshape(5, 4)
+    store.init("emb", nd.array(table))
+
+    out = row_sparse_from_dense(nd.zeros((5, 4)))
+    store.row_sparse_pull("emb", out=out,
+                          row_ids=nd.array(np.array([3, 1, 3], np.int32),
+                                           dtype="int32"))
+    np.testing.assert_allclose(np.asarray(out.indices_), [1, 3])
+    np.testing.assert_allclose(np.asarray(out._data), table[[1, 3]])
+
+    # dense out: zeros outside the pulled rows
+    dense = nd.zeros((5, 4))
+    store.row_sparse_pull("emb", out=dense,
+                          row_ids=nd.array(np.array([0], np.int32),
+                                           dtype="int32"))
+    got = dense.asnumpy()
+    np.testing.assert_allclose(got[0], table[0])
+    np.testing.assert_allclose(got[1:], 0.0)
+
+    import pytest as _pytest
+
+    from mxnet_tpu.base import MXNetError
+    with _pytest.raises(MXNetError):
+        store.row_sparse_pull("emb", out=dense)
+
+
+def test_kvstore_row_sparse_pull_validation():
+    from mxnet_tpu import kv
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.ndarray.sparse import row_sparse_from_dense
+
+    store = kv.create("local")
+    store.init("t", nd.array(np.arange(12, dtype=np.float32).reshape(3, 4)))
+    out = row_sparse_from_dense(nd.zeros((3, 4)))
+    import pytest as _pytest
+
+    with _pytest.raises(MXNetError):  # out-of-range id
+        store.row_sparse_pull("t", out=out,
+                              row_ids=nd.array(np.array([9], np.int32),
+                                               dtype="int32"))
+    with _pytest.raises(MXNetError):  # mismatched per-out ids list
+        store.row_sparse_pull(
+            "t", out=[out, out, out],
+            row_ids=[nd.array(np.array([0], np.int32), dtype="int32")])
+    # per-out pairing: two outs, two id sets
+    o1 = row_sparse_from_dense(nd.zeros((3, 4)))
+    o2 = row_sparse_from_dense(nd.zeros((3, 4)))
+    store.row_sparse_pull(
+        "t", out=[o1, o2],
+        row_ids=[nd.array(np.array([0], np.int32), dtype="int32"),
+                 nd.array(np.array([2], np.int32), dtype="int32")])
+    np.testing.assert_allclose(np.asarray(o1.indices_), [0])
+    np.testing.assert_allclose(np.asarray(o2.indices_), [2])
+    # shape-mismatched dense out fails loudly through copyto
+    with _pytest.raises(Exception):
+        store.row_sparse_pull("t", out=nd.zeros((2, 4)),
+                              row_ids=nd.array(np.array([0], np.int32),
+                                               dtype="int32"))
