@@ -95,21 +95,20 @@ class KVStore(KVStoreBase):
         if row_ids is None:
             raise MXNetError("row_sparse_pull requires row_ids")
         keys, outs = _pair(key, out)
-        flat_dsts = []
-        for o in outs:
-            flat_dsts.extend([(o_, oi) for oi, o_ in enumerate(
-                o if isinstance(o, (list, tuple)) else [o])])
-        dst_keys = []
+        flat_dsts, dst_keys = [], []
         for k, o in zip(keys, outs):
-            n = len(o) if isinstance(o, (list, tuple)) else 1
-            dst_keys.extend([k] * n)
+            group = o if isinstance(o, (list, tuple)) else [o]
+            flat_dsts.extend(group)
+            dst_keys.extend([k] * len(group))
 
         def as_ids(v):
             arr = v._data if hasattr(v, "_data") else jnp.asarray(v)
             return arr.reshape(-1).astype(jnp.int32)
 
+        import numbers
+
         if isinstance(row_ids, (list, tuple)) and row_ids and \
-                not isinstance(row_ids[0], (int, float)):
+                not isinstance(row_ids[0], numbers.Number):
             if len(row_ids) != len(flat_dsts):
                 raise MXNetError(
                     "row_sparse_pull: %d row_ids arrays for %d outs"
@@ -118,7 +117,7 @@ class KVStore(KVStoreBase):
         else:
             ids_per_dst = [as_ids(row_ids)] * len(flat_dsts)
 
-        for (dst, _oi), k, idx in zip(flat_dsts, dst_keys, ids_per_dst):
+        for dst, k, idx in zip(flat_dsts, dst_keys, ids_per_dst):
             src = self._store[self._key(k)]
             n_rows = src.shape[0]
             import numpy as _np
@@ -133,6 +132,13 @@ class KVStore(KVStoreBase):
             uniq = jnp.unique(idx)
             rsp = RowSparseNDArray(src._data[uniq], uniq, src.shape)
             if isinstance(dst, RowSparseNDArray):
+                if tuple(dst.shape) != tuple(src.shape) or \
+                        dst._data.dtype != src._data.dtype:
+                    raise MXNetError(
+                        "row_sparse_pull: out shape/dtype %s/%s does not "
+                        "match stored %s/%s" %
+                        (dst.shape, dst._data.dtype, src.shape,
+                         src._data.dtype))
                 dst._data = rsp._data
                 dst.indices_ = rsp.indices_
                 dst._shape = rsp._shape

@@ -189,6 +189,11 @@ class FusedTrainer:
         self._zero = bool(zero) and mesh.shape["dp"] > 1 if zero else False
         optimizer_params = dict(optimizer_params or {})
         self._lr = optimizer_params.pop("learning_rate", 0.01)
+        # reference Trainer honors optimizer_params['lr_scheduler']; here
+        # the schedule is evaluated host-side each step and fed into the
+        # compiled program as a scalar argument (no recompiles, any
+        # python scheduler works)
+        self._lr_scheduler = optimizer_params.pop("lr_scheduler", None)
         self._opt_init, self._opt_update = make_optimizer(
             optimizer, learning_rate=self._lr, **optimizer_params)
         # a user loss_fn receives ALL model outputs and ALL labels:
@@ -279,7 +284,6 @@ class FusedTrainer:
         loss_fn = self._loss_fn
         trainable = self._trainable
         opt_update = self._opt_update
-        lr = self._lr
         accum = self._grad_accum
         compute_dtype = self._dtype
         from ..contrib.amp import FP32_PARAM_SUFFIXES as _fp32_sufs
@@ -318,7 +322,7 @@ class FusedTrainer:
                 loss = loss_fn(outs[0], ys[0])
             return jnp.mean(loss), new_states
 
-        def step(params, opt_state, step_i, rng, xs, ys):
+        def step(params, opt_state, step_i, lr_t, rng, xs, ys):
             train_p = {n: v for n, v in params.items() if n in trainable}
             frozen = {n: v for n, v in params.items() if n not in trainable}
             vg = jax.value_and_grad(loss_of, has_aux=True)
@@ -373,7 +377,7 @@ class FusedTrainer:
                     lambda g: g / accum, grads)
 
             new_train, new_opt = opt_update(step_i, train_p, grads,
-                                            opt_state, lr)
+                                            opt_state, lr_t)
             new_params = dict(frozen)
             new_params.update(new_train)
             new_params.update(new_states)  # running stats etc.
@@ -394,7 +398,7 @@ class FusedTrainer:
             with self._mesh:
                 self._step_fn = jax.jit(
                     step,
-                    in_shardings=(param_sh, state_sh, None, None,
+                    in_shardings=(param_sh, state_sh, None, None, None,
                                   NamedSharding(self._mesh, batch_spec),
                                   NamedSharding(self._mesh, batch_spec)),
                     out_shardings=(param_sh, out_state_sh, None),
@@ -419,9 +423,11 @@ class FusedTrainer:
         if self._step_fn is None:
             self._setup(*xs)
         rng = mxrandom.take_key()
+        lr_t = (self._lr_scheduler(self._step_count)
+                if self._lr_scheduler is not None else self._lr)
         self._params, self._opt_state, loss = self._step_fn(
             self._params, self._opt_state, jnp.uint32(self._step_count),
-            rng, xs, ys)
+            jnp.float32(lr_t), rng, xs, ys)
         self._step_count += 1
         return NDArray(loss)
 

@@ -567,3 +567,68 @@ def test_grad_accum_with_zero_and_tp():
     acc_losses, acc_w = run(accum=4, zero=True)
     np.testing.assert_allclose(acc_losses, base_losses, rtol=1e-4)
     np.testing.assert_allclose(acc_w, base_w, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_trainer_lr_scheduler():
+    """optimizer_params['lr_scheduler'] drives the compiled step without
+    recompiles (reference Trainer contract): a zero-LR schedule freezes
+    the weights, a two-phase FactorScheduler matches two fixed-LR runs."""
+    import numpy as np
+
+    from mxnet_tpu.gluon import nn
+
+    def build():
+        import mxnet_tpu as mx
+
+        mx.random.seed(17)
+        net = nn.Dense(4, in_units=4)
+        net.initialize()
+        return net
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 4).astype(np.float32)
+    y = rs.randint(0, 4, 8).astype(np.int32)
+
+    class ZeroLR:
+        def __call__(self, step):
+            return 0.0
+
+    net = build()
+    w0 = net.weight.data().asnumpy().copy()
+    tr = parallel.FusedTrainer(
+        net, loss="softmax_ce", optimizer="sgd",
+        optimizer_params={"learning_rate": 0.5, "momentum": 0.0,
+                          "lr_scheduler": ZeroLR()})
+    for _ in range(2):
+        tr.step(x, y)
+    tr.sync_block()
+    np.testing.assert_allclose(net.weight.data().asnumpy(), w0, rtol=1e-6)
+
+    # two-phase schedule: 2 steps at 0.2, 2 at 0.1 — must match two
+    # fixed-LR trainers run back to back on the same weights
+    class TwoPhase:
+        def __call__(self, step):
+            return 0.2 if step < 2 else 0.1
+
+    net_s = build()
+    tr_s = parallel.FusedTrainer(
+        net_s, loss="softmax_ce", optimizer="sgd",
+        optimizer_params={"learning_rate": 0.2, "momentum": 0.0,
+                          "lr_scheduler": TwoPhase()})
+    for _ in range(4):
+        tr_s.step(x, y)
+    tr_s.sync_block()
+
+    net_m = build()
+    tr_m1 = parallel.FusedTrainer(
+        net_m, loss="softmax_ce", optimizer="sgd",
+        optimizer_params={"learning_rate": 0.2, "momentum": 0.0})
+    tr_m1.step(x, y); tr_m1.step(x, y)
+    tr_m1.sync_block()
+    tr_m2 = parallel.FusedTrainer(
+        net_m, loss="softmax_ce", optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.0})
+    tr_m2.step(x, y); tr_m2.step(x, y)
+    tr_m2.sync_block()
+    np.testing.assert_allclose(net_s.weight.data().asnumpy(),
+                               net_m.weight.data().asnumpy(), rtol=1e-5)
