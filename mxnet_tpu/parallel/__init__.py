@@ -194,6 +194,11 @@ class FusedTrainer:
         # compiled program as a scalar argument (no recompiles, any
         # python scheduler works)
         self._lr_scheduler = optimizer_params.pop("lr_scheduler", None)
+        if self._lr_scheduler is not None and hasattr(
+                self._lr_scheduler, "base_lr"):
+            # reference Optimizer contract (optimizer.py:65): an explicit
+            # learning_rate re-bases the schedule
+            self._lr_scheduler.base_lr = self._lr
         self._opt_init, self._opt_update = make_optimizer(
             optimizer, learning_rate=self._lr, **optimizer_params)
         # a user loss_fn receives ALL model outputs and ALL labels:
@@ -423,7 +428,9 @@ class FusedTrainer:
         if self._step_fn is None:
             self._setup(*xs)
         rng = mxrandom.take_key()
-        lr_t = (self._lr_scheduler(self._step_count)
+        # reference num_update starts at 1 (_update_count increments
+        # before _get_lr, optimizer.py:100) — keep the same phase
+        lr_t = (self._lr_scheduler(self._step_count + 1)
                 if self._lr_scheduler is not None else self._lr)
         self._params, self._opt_state, loss = self._step_fn(
             self._params, self._opt_state, jnp.uint32(self._step_count),

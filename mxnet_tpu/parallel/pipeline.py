@@ -117,6 +117,14 @@ class PipelineTrainer:
                 "a working fill/drain schedule" % (self._M, self._S))
         optimizer_params = dict(optimizer_params or {})
         self._lr = optimizer_params.pop("learning_rate", 0.01)
+        # same contract as FusedTrainer: schedule evaluated host-side,
+        # fed into the compiled step as a scalar argument
+        self._lr_scheduler = optimizer_params.pop("lr_scheduler", None)
+        if self._lr_scheduler is not None and hasattr(
+                self._lr_scheduler, "base_lr"):
+            # reference Optimizer contract (optimizer.py:65): an explicit
+            # learning_rate re-bases the schedule
+            self._lr_scheduler.base_lr = self._lr
         self._opt_init, self._opt_update = make_optimizer(
             optimizer, learning_rate=self._lr, **optimizer_params)
         self._user_loss = loss_fn is not None
@@ -274,7 +282,6 @@ class PipelineTrainer:
         S, M, dp = self._S, self._M, self._dp
         mb_loc, Amax = self._mb_loc, self._Amax
         opt_update = self._opt_update
-        lr = self._lr
         branches = self._branches()
         has_dp = "dp" in mesh.axis_names and dp > 1
         batch_axes = ("dp",) if has_dp else ()
@@ -318,11 +325,11 @@ class PipelineTrainer:
         smapped = shard_map_compat(pipe_loss, mesh=mesh,
                                    in_specs=in_specs, out_specs=P())
 
-        def train_step(stacked, opt_state, step_i, rng, xm, ym):
+        def train_step(stacked, opt_state, step_i, lr_t, rng, xm, ym):
             loss, g = jax.value_and_grad(
                 lambda w: smapped(w, rng, xm, ym))(stacked)
             new_p, new_opt = opt_update(step_i, {"stacked": stacked},
-                                        {"stacked": g}, opt_state, lr)
+                                        {"stacked": g}, opt_state, lr_t)
             return new_p["stacked"], new_opt, loss
 
         psh = NamedSharding(mesh, self._pspec)
@@ -332,7 +339,7 @@ class PipelineTrainer:
         with mesh:
             self._step_fn = jax.jit(
                 train_step,
-                in_shardings=(psh, opt_sh, None, None, bsh, bsh),
+                in_shardings=(psh, opt_sh, None, None, None, bsh, bsh),
                 out_shardings=(psh, opt_sh, None),
                 donate_argnums=(0, 1))
 
@@ -348,9 +355,13 @@ class PipelineTrainer:
         xm = x.reshape((M, x.shape[0] // M) + x.shape[1:])
         ym = y.reshape((M, y.shape[0] // M) + y.shape[1:])
         rng = mxrandom.take_key()
+        # reference num_update starts at 1 (_update_count increments
+        # before _get_lr, optimizer.py:100) — keep the same phase
+        lr_t = (self._lr_scheduler(self._step_count + 1)
+                if self._lr_scheduler is not None else self._lr)
         self._stacked, self._opt_state, loss = self._step_fn(
             self._stacked, self._opt_state, jnp.uint32(self._step_count),
-            rng, xm, ym)
+            jnp.float32(lr_t), rng, xm, ym)
         self._step_count += 1
         return NDArray(loss)
 

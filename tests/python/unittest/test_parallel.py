@@ -573,8 +573,6 @@ def test_fused_trainer_lr_scheduler():
     """optimizer_params['lr_scheduler'] drives the compiled step without
     recompiles (reference Trainer contract): a zero-LR schedule freezes
     the weights, a two-phase FactorScheduler matches two fixed-LR runs."""
-    import numpy as np
-
     from mxnet_tpu.gluon import nn
 
     def build():
@@ -590,7 +588,7 @@ def test_fused_trainer_lr_scheduler():
     y = rs.randint(0, 4, 8).astype(np.int32)
 
     class ZeroLR:
-        def __call__(self, step):
+        def __call__(self, num_update):
             return 0.0
 
     net = build()
@@ -607,8 +605,9 @@ def test_fused_trainer_lr_scheduler():
     # two-phase schedule: 2 steps at 0.2, 2 at 0.1 — must match two
     # fixed-LR trainers run back to back on the same weights
     class TwoPhase:
-        def __call__(self, step):
-            return 0.2 if step < 2 else 0.1
+        def __call__(self, num_update):
+            # num_update starts at 1 (reference phase)
+            return 0.2 if num_update <= 2 else 0.1
 
     net_s = build()
     tr_s = parallel.FusedTrainer(
@@ -632,3 +631,40 @@ def test_fused_trainer_lr_scheduler():
     tr_m2.sync_block()
     np.testing.assert_allclose(net_s.weight.data().asnumpy(),
                                net_m.weight.data().asnumpy(), rtol=1e-5)
+
+
+def test_pipeline_trainer_lr_scheduler():
+    """PipelineTrainer honors lr_scheduler like FusedTrainer: zero LR
+    freezes the stage weights."""
+    from mxnet_tpu.gluon import nn
+
+    mesh = _mesh_or_skip({"pp": 2, "dp": 4})
+    mx.random.seed(19)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=8, activation="relu"),
+            nn.Dense(4, in_units=8))
+    net.initialize()
+
+    class ZeroLR:
+        def __call__(self, num_update):
+            return 0.0
+
+    tr = parallel.PipelineTrainer(
+        net, mesh=mesh, num_microbatches=4, loss="softmax_ce",
+        optimizer="sgd",
+        optimizer_params={"learning_rate": 0.5, "momentum": 0.0,
+                          "lr_scheduler": ZeroLR()})
+    rs = np.random.RandomState(0)
+    x = rs.randn(16, 8).astype(np.float32)
+    y = rs.randint(0, 4, 16).astype(np.int32)
+    tr.step(x, y)
+    tr.step(x, y)
+    tr.sync_block()
+    w0 = net[0].weight.data().asnumpy()
+    mx.random.seed(19)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(8, in_units=8, activation="relu"),
+             nn.Dense(4, in_units=8))
+    net2.initialize()
+    np.testing.assert_allclose(w0, net2[0].weight.data().asnumpy(),
+                               rtol=1e-6)
