@@ -339,8 +339,13 @@ class HybridBlock(Block):
 
     def optimize_for(self, x, *args, backend=None, **kwargs):
         """Reference HybridBlock.optimize_for (block.py:1218 backend
-        partitioning).  XLA is the single backend; this hybridizes + warms
-        the compile cache."""
+        partitioning).  XLA is the single compiler backend; registered
+        SubgraphProperty backends (mxnet_tpu.subgraph) are accepted as
+        valid names (their rewrites apply on the Symbol path), and unknown
+        backend strings fail loudly like Symbol.optimize_for."""
+        from .. import subgraph as _subgraph
+
+        _subgraph.validate_backend(backend)
         self.hybridize(True, backend=backend, **kwargs)
         return self(x, *args)
 

@@ -188,17 +188,7 @@ class FusedTrainer:
             raise MXNetError("zero=True requires a mesh with a dp axis")
         self._zero = bool(zero) and mesh.shape["dp"] > 1 if zero else False
         optimizer_params = dict(optimizer_params or {})
-        self._lr = optimizer_params.pop("learning_rate", 0.01)
-        # reference Trainer honors optimizer_params['lr_scheduler']; here
-        # the schedule is evaluated host-side each step and fed into the
-        # compiled program as a scalar argument (no recompiles, any
-        # python scheduler works)
-        self._lr_scheduler = optimizer_params.pop("lr_scheduler", None)
-        if self._lr_scheduler is not None and hasattr(
-                self._lr_scheduler, "base_lr"):
-            # reference Optimizer contract (optimizer.py:65): an explicit
-            # learning_rate re-bases the schedule
-            self._lr_scheduler.base_lr = self._lr
+        self._lr, self._lr_scheduler = _pop_lr_schedule(optimizer_params)
         self._opt_init, self._opt_update = make_optimizer(
             optimizer, learning_rate=self._lr, **optimizer_params)
         # a user loss_fn receives ALL model outputs and ALL labels:
@@ -486,6 +476,20 @@ class FusedTrainer:
     @property
     def params(self):
         return self._params
+
+
+def _pop_lr_schedule(optimizer_params):
+    """Shared Fused/Pipeline trainer LR plumbing.  Reference Optimizer
+    contract (optimizer.py:65): an EXPLICIT learning_rate re-bases the
+    schedule; a defaulted one must not clobber the scheduler's own
+    base_lr.  The schedule itself is evaluated host-side each step and
+    fed into the compiled program as a scalar argument (no recompiles)."""
+    explicit = "learning_rate" in optimizer_params
+    lr = optimizer_params.pop("learning_rate", 0.01)
+    scheduler = optimizer_params.pop("lr_scheduler", None)
+    if scheduler is not None and explicit and hasattr(scheduler, "base_lr"):
+        scheduler.base_lr = lr
+    return lr, scheduler
 
 
 def _make_loss(loss):

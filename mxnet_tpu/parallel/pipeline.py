@@ -115,16 +115,10 @@ class PipelineTrainer:
             raise MXNetError(
                 "num_microbatches (%d) must be >= pipeline stages (%d) for "
                 "a working fill/drain schedule" % (self._M, self._S))
+        from . import _pop_lr_schedule  # shared Fused/Pipeline contract
+
         optimizer_params = dict(optimizer_params or {})
-        self._lr = optimizer_params.pop("learning_rate", 0.01)
-        # same contract as FusedTrainer: schedule evaluated host-side,
-        # fed into the compiled step as a scalar argument
-        self._lr_scheduler = optimizer_params.pop("lr_scheduler", None)
-        if self._lr_scheduler is not None and hasattr(
-                self._lr_scheduler, "base_lr"):
-            # reference Optimizer contract (optimizer.py:65): an explicit
-            # learning_rate re-bases the schedule
-            self._lr_scheduler.base_lr = self._lr
+        self._lr, self._lr_scheduler = _pop_lr_schedule(optimizer_params)
         self._opt_init, self._opt_update = make_optimizer(
             optimizer, learning_rate=self._lr, **optimizer_params)
         self._user_loss = loss_fn is not None

@@ -63,6 +63,27 @@ def get_backend(name):
     return _BACKENDS.get(str(name).lower())
 
 
+# compiler backends that are always valid no-op names (XLA is the one
+# real compiler; reference accepted its builtin names the same way)
+BUILTIN_BACKENDS = frozenset(["", "xla", "tpu", "default"])
+
+
+def validate_backend(name):
+    """Raise for a backend string that is neither builtin nor a registered
+    SubgraphProperty — shared by Symbol.optimize_for and
+    HybridBlock.optimize_for so the rule cannot drift."""
+    if name is None:
+        return None
+    if get_backend(name) is not None:
+        return get_backend(name)
+    if str(name).lower() in BUILTIN_BACKENDS:
+        return None
+    raise MXNetError(
+        "unknown partitioning backend %r: the TPU build has one compiler "
+        "backend (XLA); register a SubgraphProperty "
+        "(mxnet_tpu.subgraph) for custom partitioning" % (name,))
+
+
 def list_backends():
     return sorted(_BACKENDS)
 

@@ -8,9 +8,9 @@ variable inputs.  Composing symbols composes closures; `bind` closes over
 arrays; `infer_shape` is jax.eval_shape over the closure (replacing the
 nnvm InferShape pass); executing a bound symbol jit-compiles the whole
 graph — exactly the CachedOp/"one fused XLA computation" north star, shared
-with HybridBlock.  optimize_for() is a no-op shim: graph partitioning/fusion
-backends (MKLDNN/TensorRT subgraph properties in the reference) collapse
-into XLA.
+with HybridBlock.  optimize_for() runs registered SubgraphProperty
+partitioner passes (mxnet_tpu/subgraph.py); the builtin backend names are
+no-ops because XLA already fuses.
 """
 from __future__ import annotations
 
@@ -200,8 +200,6 @@ class Symbol:
         with open(fname, "w") as f:
             f.write(self.tojson())
 
-    _KNOWN_BACKENDS = {None, "", "xla", "tpu", "default"}
-
     def optimize_for(self, backend=None, args=None, aux=None, ctx=None,
                      **kwargs):
         """Run a registered SubgraphProperty pass (reference symbol.py:1477;
@@ -213,21 +211,12 @@ class Symbol:
         MKLDNN/TensorRT support."""
         from .. import subgraph as _subgraph
 
-        if isinstance(backend, str):
-            prop = _subgraph.get_backend(backend)
-            if prop is not None:
-                new_json, n = _subgraph.partition_json(self._json, prop)
-                if n == 0:
-                    return self
-                return _rebuild(new_json)
-            if backend.lower() not in self._KNOWN_BACKENDS:
-                from ..base import MXNetError
-
-                raise MXNetError(
-                    "unknown partitioning backend %r: the TPU build has "
-                    "one compiler backend (XLA); register a "
-                    "SubgraphProperty (mxnet_tpu.subgraph) for custom "
-                    "partitioning" % (backend,))
+        prop = _subgraph.validate_backend(backend)
+        if prop is not None:
+            new_json, n = _subgraph.partition_json(self._json, prop)
+            if n == 0:
+                return self
+            return _rebuild(new_json)
         return self
 
     def __repr__(self):

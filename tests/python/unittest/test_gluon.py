@@ -380,3 +380,22 @@ def test_deformable_conv_deferred_in_channels():
     out = dcn(nd.ones((1, 4, 6, 6)))
     assert out.shape == (1, 5, 6, 6)
     assert dcn.weight.shape == (5, 4, 3, 3)
+
+
+def test_hybridblock_optimize_for_validates_backend():
+    from mxnet_tpu import subgraph
+    from mxnet_tpu.base import MXNetError
+
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    x = nd.ones((1, 2))
+    out = net.optimize_for(x, backend="xla")  # builtin: warms the cache
+    assert out.shape == (1, 2)
+    with pytest.raises(MXNetError):
+        net.optimize_for(x, backend="tensorrt")
+    prop = subgraph.SubgraphProperty("blockbe")
+    subgraph.register_backend(prop)
+    try:
+        assert net.optimize_for(x, backend="blockbe").shape == (1, 2)
+    finally:
+        subgraph._BACKENDS.pop("blockbe", None)
