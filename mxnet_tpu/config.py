@@ -53,6 +53,10 @@ ENV_VARS = {
         bool, False,
         "Log when a sparse op densifies (the storage-fallback path, "
         "ndarray/sparse.py)."),
+    "MXNET_TEST_LARGE": (
+        bool, False,
+        "Run the gated large-tensor nightly checks (2^31-element shapes; "
+        "tests/python/unittest/test_large_array.py)."),
 }
 
 

@@ -491,3 +491,22 @@ def test_trainer_zero_rejects_update_on_kvstore():
     with _pytest.raises(MXNetError):
         gluon.Trainer(net.collect_params(), "adam", zero=True, mesh=mesh,
                       update_on_kvstore=True)
+
+
+def test_im2col_gradient_is_col2im():
+    """The unfold/fold pair are adjoints: grad of sum(w * im2col(x)) ==
+    col2im(w) — pins both the autograd wiring and the layout."""
+    from mxnet_tpu import autograd
+
+    rs = _rs(30)
+    x = _arr(rs.randn(1, 2, 5, 5))
+    w = rs.randn(1, 2 * 9, 25).astype(np.float32)
+    x.attach_grad()
+    with autograd.record():
+        cols = nd.im2col(x, kernel=(3, 3), stride=(1, 1), pad=(1, 1))
+        loss = (cols * _arr(w)).sum()
+    loss.backward()
+    ref = nd.col2im(_arr(w), input_size=(2, 5, 5), kernel=(3, 3),
+                    stride=(1, 1), pad=(1, 1))
+    np.testing.assert_allclose(x.grad.asnumpy(), ref.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
