@@ -188,3 +188,64 @@ def test_metric_create_by_name_new_entries():
                  "meancosinesimilarity"):
         m = gm.create(name)
         assert isinstance(m, gm.EvalMetric)
+
+
+def test_squared_hinge_logistic_poisson_losses():
+    from mxnet_tpu.gluon import loss as gloss
+
+    pred = nd.array(np.array([0.5, -1.5, 2.0], np.float32))
+    lbl = nd.array(np.array([1.0, -1.0, -1.0], np.float32))
+    sh = gloss.SquaredHingeLoss()(pred, lbl).asnumpy()
+    ref = np.maximum(0, 1 - np.array([0.5, -1.5, 2.0]) *
+                     np.array([1, -1, -1])) ** 2
+    np.testing.assert_allclose(sh, ref, rtol=1e-5)
+
+    lg = gloss.LogisticLoss(label_format="signed")(pred, lbl).asnumpy()
+    ref_lg = np.log1p(np.exp(-np.array([0.5, -1.5, 2.0]) *
+                             np.array([1, -1, -1])))
+    np.testing.assert_allclose(lg, ref_lg, rtol=1e-5)
+
+    lam = nd.array(np.array([1.0, 2.0], np.float32))
+    tgt = nd.array(np.array([2.0, 1.0], np.float32))
+    pn = gloss.PoissonNLLLoss(from_logits=False)(lam, tgt).asnumpy()
+    ref_pn = np.mean(np.array([1.0, 2.0]) -
+                     np.array([2.0, 1.0]) * np.log(np.array([1.0, 2.0])
+                                                   + 1e-8))
+    np.testing.assert_allclose(pn, ref_pn, rtol=1e-4)
+
+
+def test_loss_sample_weight_and_weight():
+    from mxnet_tpu.gluon import loss as gloss
+
+    pred = nd.array(np.array([[1.0], [3.0]], np.float32))
+    lbl = nd.array(np.array([[0.0], [0.0]], np.float32))
+    base = gloss.L2Loss()(pred, lbl).asnumpy()           # [0.5, 4.5]
+    np.testing.assert_allclose(base, [0.5, 4.5], rtol=1e-6)
+    # constructor weight rescales globally
+    np.testing.assert_allclose(
+        gloss.L2Loss(weight=2.0)(pred, lbl).asnumpy(), [1.0, 9.0],
+        rtol=1e-6)
+    # sample_weight masks per example
+    sw = nd.array(np.array([[1.0], [0.0]], np.float32))
+    np.testing.assert_allclose(
+        gloss.L2Loss()(pred, lbl, sw).asnumpy(), [0.5, 0.0], rtol=1e-6)
+
+
+def test_cosine_embedding_and_sdml_run_and_train():
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import loss as gloss
+
+    rs = np.random.RandomState(0)
+    a = nd.array(rs.randn(4, 8).astype(np.float32))
+    b = nd.array(rs.randn(4, 8).astype(np.float32))
+    lbl = nd.array(np.array([1, -1, 1, -1], np.float32))
+    ce = gloss.CosineEmbeddingLoss()(a, b, lbl)
+    assert ce.shape[0] == 4 and np.isfinite(ce.asnumpy()).all()
+
+    x1 = nd.array(rs.randn(6, 8).astype(np.float32))
+    x2 = nd.array(rs.randn(6, 8).astype(np.float32))
+    x1.attach_grad()
+    with autograd.record():
+        L = gloss.SDMLLoss()(x1, x2).sum()
+    L.backward()
+    assert float(np.abs(x1.grad.asnumpy()).sum()) > 0
