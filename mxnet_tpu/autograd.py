@@ -27,6 +27,15 @@ __all__ = [
 ]
 
 
+def _is_float0(x):
+    """True for jax's symbolic-zero cotangents.  NB: np.dtype(float0).name
+    is 'void', so name-string checks misclassify them (ADVICE r3)."""
+    import jax
+
+    dt = getattr(x, "dtype", None)
+    return dt is not None and dt == jax.dtypes.float0
+
+
 def is_recording():
     return thread_state.is_recording
 
@@ -240,7 +249,7 @@ def _backward_impl(heads, head_grads, retain_graph, create_graph,
         for inp, ig in zip(node.inputs, in_grads):
             if ig is None:
                 continue
-            if hasattr(ig, "dtype") and ig.dtype.name == "float0":
+            if _is_float0(ig):
                 continue
             e = getattr(inp, "_entry", None)
             if e is not None:
@@ -296,7 +305,7 @@ def _recorded_vjp(node, outs_ct):
     from .ops.registry import apply_op
 
     float_idx = [i for i, ct in enumerate(outs_ct)
-                 if hasattr(ct, "dtype") and ct.dtype.name != "float0"]
+                 if hasattr(ct, "dtype") and not _is_float0(ct)]
     const_cts = {i: ct for i, ct in enumerate(outs_ct)
                  if i not in float_idx}
     ct_args = [outs_ct[i] if isinstance(outs_ct[i], NDArray)

@@ -221,7 +221,20 @@ class Trainer:
 
                 if not isinstance(grad, RowSparseNDArray):
                     grad = row_sparse_from_dense(grad)
-            if self._zero and getattr(grad, "stype", "default") == "default":
+            if self._zero:
+                if getattr(grad, "stype", "default") != "default":
+                    # ADVICE r3: the sparse branch would mix dp-sharded
+                    # optimizer state with single-device weight/grad and
+                    # crash deep inside jax on device mismatch; fail with
+                    # the actual contract instead
+                    from ..base import MXNetError
+
+                    raise MXNetError(
+                        "Trainer(zero=True) does not support row_sparse "
+                        "gradients (parameter %r): ZeRO shards optimizer "
+                        "state along the dp axis, which requires dense "
+                        "grads. Use grad_stype='default' or zero=False."
+                        % (param.name,))
                 self._zero_update(i, param, grad)
             else:
                 self._optimizer.update_multi_precision(

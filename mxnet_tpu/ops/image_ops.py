@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as _onp
 
 from .registry import alias, register
 
@@ -160,7 +161,11 @@ def _blend(a, b, alpha):
     return a * alpha + b * (1.0 - alpha)
 
 
-_GRAY = jnp.asarray([0.299, 0.587, 0.114])
+# Plain numpy on purpose: a module-level jnp constant would trigger PJRT
+# backend initialization during `import mxnet_tpu` (fail-slow when the TPU
+# tunnel is unreachable).  jnp ops accept numpy operands and the constant is
+# folded into the compiled program either way.
+_GRAY = _onp.asarray([0.299, 0.587, 0.114], dtype=_onp.float32)
 
 
 @register("image_random_brightness", differentiable=False)

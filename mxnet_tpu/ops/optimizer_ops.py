@@ -131,7 +131,9 @@ def rmsprop_update(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
     """Hinton's RMSProp [optimizer_op.cc:755]."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient) + wd * weight
     new_n = gamma1 * n + (1.0 - gamma1) * g * g
-    new_w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    # eps OUTSIDE the sqrt: RMSPropUpdateKernel divides by sqrt(n)+eps
+    # (optimizer_op-inl.h:2025); only the centered variant keeps it inside
+    new_w = weight - lr * g / (jnp.sqrt(new_n) + epsilon)
     if clip_weights is not None and clip_weights >= 0:
         new_w = jnp.clip(new_w, -clip_weights, clip_weights)
     return new_w, new_n

@@ -135,10 +135,24 @@ def invoke(op, inputs, attrs):
         and any(_on_tape(x) for x in inputs if isinstance(x, NDArray))
     )
     if recordable:
+        from .. import random as _random
         from ..autograd import TapeNode
 
-        def tuple_fn(*args):
-            out = fn(*args)
+        # Pin the op's stochastic identity at record time (ADVICE r3): the
+        # create_graph backward re-executes this fn to rebuild the vjp, and
+        # it must see the SAME RNG keys and the SAME train-mode flag the
+        # real forward saw, or Dropout/rrelu silently use a fresh mask.
+        keylog = _random.KeyLog()
+        train_at_record = thread_state.is_training
+
+        def tuple_fn(*args, _log=keylog, _train=train_at_record):
+            prev_train = thread_state.is_training
+            thread_state.is_training = _train
+            try:
+                with _random.logged_keys(_log):
+                    out = fn(*args)
+            finally:
+                thread_state.is_training = prev_train
             return out if isinstance(out, tuple) else (out,)
 
         out_datas, vjp_fn = jax.vjp(tuple_fn, *datas)

@@ -642,7 +642,8 @@ class RMSProp(Optimizer):
         else:
             (n,) = state
             n._data = self.rho * n._data + (1 - self.rho) * jnp.square(g)
-            w = w - lr * g / jnp.sqrt(n._data + self.epsilon)
+            # sqrt(n)+eps like RMSPropUpdateKernel (optimizer_op-inl.h:2025)
+            w = w - lr * g / (jnp.sqrt(n._data) + self.epsilon)
         if self.clip_weights:
             w = jnp.clip(w, -self.clip_weights, self.clip_weights)
         weight._data = w.astype(weight._data.dtype)

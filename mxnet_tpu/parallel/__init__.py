@@ -380,6 +380,7 @@ class FusedTrainer:
 
         if self._mesh is not None:
             batch_spec = P(self._batch_axes if self._batch_axes else None)
+            self._batch_sharding = NamedSharding(self._mesh, batch_spec)
             param_sh = {n: NamedSharding(self._mesh, self._param_specs[n])
                         for n in self._params}
             state_sh = None
@@ -417,6 +418,11 @@ class FusedTrainer:
             else (as_jax(y),)
         if self._step_fn is None:
             self._setup(*xs)
+        if self._mesh is not None:
+            # committed single-device arrays (NDArray _data) would clash
+            # with the jitted in_shardings; reshard onto the batch axes
+            xs = tuple(jax.device_put(v, self._batch_sharding) for v in xs)
+            ys = tuple(jax.device_put(v, self._batch_sharding) for v in ys)
         rng = mxrandom.take_key()
         # reference num_update starts at 1 (_update_count increments
         # before _get_lr, optimizer.py:100) — keep the same phase

@@ -11,16 +11,23 @@ call — randomness stays inside the fused XLA program instead of a host RNG.
 """
 from __future__ import annotations
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
 
-from .base import _as_np_dtype
+from .base import MXNetError, _as_np_dtype
 
 __all__ = ["seed", "take_key", "uniform", "normal", "randn", "randint",
            "gamma", "exponential", "poisson", "multinomial", "bernoulli",
-           "shuffle", "trace_rng"]
+           "shuffle", "trace_rng", "KeyLog", "logged_keys", "laplace",
+           "pareto", "weibull", "rayleigh", "gumbel", "logistic", "choice",
+           "categorical"]
 
-_state = {"key": jax.random.PRNGKey(0)}
+# Key is created lazily: jax.random.PRNGKey executes a device computation,
+# and module scope here runs during `import mxnet_tpu` — a backend touch at
+# import time means a wedged TPU tunnel hangs the import (VERDICT r3).
+_state = {"key": None, "seed": 0}
 _trace_stack = []
 
 
@@ -30,6 +37,40 @@ class _TraceRNG:
     def __init__(self, base_key):
         self.base_key = base_key
         self.counter = 0
+
+
+class KeyLog:
+    """Per-recorded-op key journal (ADVICE r3: create_graph replay).
+
+    The first execution of a recorded op's forward (inside invoke's
+    jax.vjp) RECORDS every key it draws; any re-execution of the same
+    forward — the create_graph backward rebuilds the vjp by replaying the
+    stored fn — gets the SAME keys back in draw order, so stochastic ops
+    (Dropout, rrelu) use the mask the real forward used.  This is the eager
+    counterpart of gluon/block.py pinning ``_rng`` for hybridized blocks.
+    """
+
+    __slots__ = ("keys", "finalized", "pos")
+
+    def __init__(self):
+        self.keys = []
+        self.finalized = False
+        self.pos = 0
+
+
+_keylog_stack = []
+
+
+@contextlib.contextmanager
+def logged_keys(log):
+    """Route take_key() through ``log``: record on first entry, replay after."""
+    _keylog_stack.append(log)
+    log.pos = 0
+    try:
+        yield
+    finally:
+        _keylog_stack.pop()
+        log.finalized = True
 
 
 class trace_rng:
@@ -48,14 +89,38 @@ class trace_rng:
 
 def seed(seed_state, ctx="all"):
     """Set the global seed (reference python/mxnet/random.py)."""
+    _state["seed"] = int(seed_state)
     _state["key"] = jax.random.PRNGKey(int(seed_state))
 
 
 def take_key():
     if _trace_stack:
+        # hybridize trace: keys are traced values derived from the program's
+        # base-key argument; replay identity is the compiled program's job
         rng = _trace_stack[-1]
         rng.counter += 1
         return jax.random.fold_in(rng.base_key, rng.counter)
+    if _keylog_stack:
+        log = _keylog_stack[-1]
+        if log.finalized:  # replay: hand back the recorded stream
+            if log.pos >= len(log.keys):
+                raise MXNetError(
+                    "RNG replay mismatch: recorded op drew %d key(s) at "
+                    "record time but its replayed forward asked for more "
+                    "— the op's control flow must not depend on state that "
+                    "changed since recording" % len(log.keys))
+            key = log.keys[log.pos]
+            log.pos += 1
+            return key
+        key = _fresh_key()
+        log.keys.append(key)
+        return key
+    return _fresh_key()
+
+
+def _fresh_key():
+    if _state["key"] is None:
+        _state["key"] = jax.random.PRNGKey(_state["seed"])
     _state["key"], sub = jax.random.split(_state["key"])
     return sub
 
@@ -156,3 +221,68 @@ def shuffle(data, **kw):
 
     x = data._data if isinstance(data, NDArray) else data
     return _wrap(jax.random.permutation(take_key(), x, axis=0))
+
+
+# ---- distribution tail (reference np_random ops: _npi_laplace/_npi_pareto/
+# _npi_weibull/_npi_rayleigh/_npi_gumbel/_npi_logistic/_npi_choice) --------
+def laplace(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None,
+            out=None):
+    data = jax.random.laplace(take_key(), _shape(shape),
+                              dtype=_as_np_dtype(dtype)) * scale + loc
+    return _wrap(data, ctx, out)
+
+
+def pareto(a=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    """Lomax-style pareto (np.random.pareto: (1-U)^{-1/a} - 1)."""
+    u = jax.random.uniform(take_key(), _shape(shape),
+                           dtype=_as_np_dtype(dtype))
+    return _wrap(jnp.expm1(-jnp.log1p(-u) / a), ctx, out)
+
+
+def weibull(a=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    u = jax.random.uniform(take_key(), _shape(shape),
+                           dtype=_as_np_dtype(dtype))
+    return _wrap(jnp.power(-jnp.log1p(-u), 1.0 / a), ctx, out)
+
+
+def rayleigh(scale=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    u = jax.random.uniform(take_key(), _shape(shape),
+                           dtype=_as_np_dtype(dtype))
+    return _wrap(scale * jnp.sqrt(-2.0 * jnp.log1p(-u)), ctx, out)
+
+
+def gumbel(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None,
+           out=None):
+    data = jax.random.gumbel(take_key(), _shape(shape),
+                             dtype=_as_np_dtype(dtype)) * scale + loc
+    return _wrap(data, ctx, out)
+
+
+def logistic(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None,
+             out=None):
+    data = jax.random.logistic(take_key(), _shape(shape),
+                               dtype=_as_np_dtype(dtype)) * scale + loc
+    return _wrap(data, ctx, out)
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None, out=None):
+    """np.random.choice (reference _npi_choice)."""
+    from .ndarray.ndarray import NDArray
+
+    arr = a._data if isinstance(a, NDArray) else a
+    if isinstance(arr, int):
+        arr = jnp.arange(arr)
+    pv = p._data if isinstance(p, NDArray) else p
+    data = jax.random.choice(take_key(), arr, _shape(size),
+                             replace=replace, p=pv)
+    return _wrap(data, ctx, out)
+
+
+def categorical(logits, shape=None, ctx=None, out=None):
+    """npx.random.categorical (reference _npx__random_categorical)."""
+    from .ndarray.ndarray import NDArray
+
+    lg = logits._data if isinstance(logits, NDArray) else logits
+    out_shape = None if shape is None else _shape(shape)
+    data = jax.random.categorical(take_key(), lg, axis=-1, shape=out_shape)
+    return _wrap(data, ctx, out)

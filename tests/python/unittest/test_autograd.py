@@ -317,3 +317,60 @@ def test_create_graph_rejects_custom_function_nodes():
         y = Square()(x).sum()
         with pytest.raises(MXNetError):
             autograd.grad(y, [x], create_graph=True)
+
+
+def test_create_graph_replays_recorded_dropout_mask():
+    """ADVICE r3: the create_graph backward re-executes a recorded op's
+    forward to rebuild its vjp; stochastic ops must replay the SAME RNG
+    keys (and the same train-mode flag), or the recomputed backward uses a
+    different dropout mask than the actual forward.  With x=1 and
+    y = Dropout(x), dy/dx elementwise equals y itself — any fresh mask
+    breaks the equality with probability ~1 at this size."""
+    mx.random.seed(7)
+    x = nd.array(np.ones((64, 64), np.float32))
+    x.attach_grad()
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+        ysum = y.sum()
+        g1 = autograd.grad(ysum, [x], create_graph=True)[0]
+    np.testing.assert_allclose(g1.asnumpy(), y.asnumpy(), rtol=1e-6)
+
+
+def test_create_graph_dropout_second_order_consistent():
+    """grad-of-grad through Dropout: d/dx (g1*x).sum() = g1 must reuse the
+    recorded mask again on the second differentiation."""
+    mx.random.seed(11)
+    x = nd.array(np.ones((32, 32), np.float32))
+    x.attach_grad()
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+        g1 = autograd.grad(y.sum(), [x], create_graph=True)[0]
+        L = (g1 * x).sum()
+    L.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), y.asnumpy(), rtol=1e-6)
+
+
+def test_float0_cotangent_mixed_output_create_graph():
+    """ADVICE r3: a recorded op with a non-float output gets a float0
+    zero-fill cotangent in the backward sweep; np.dtype(float0).name is
+    'void', so a name-string check misclassifies it as a real cotangent and
+    crashes jax.vjp inside the create_graph replay.  Record a mixed
+    (float, int) output op and take grad-of-grad through the float leg."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.registry import apply_op
+
+    def square_and_argmax(x):
+        return x * x, jnp.argmax(x, axis=-1)
+
+    x = nd.array(np.array([[3.0, 1.0, 2.0], [5.0, 4.0, 6.0]], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        sq, idx = apply_op(square_and_argmax, x)
+        L = sq.sum()
+        g1 = autograd.grad(L, [x], create_graph=True)[0]  # 2x
+        L2 = (g1 * x).sum()  # 2x^2 -> d/dx = 4x
+    L2.backward()
+    assert idx.asnumpy().dtype.kind in "iu"
+    np.testing.assert_allclose(g1.asnumpy(), 2 * x.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(), 4 * x.asnumpy(), rtol=1e-6)

@@ -89,7 +89,9 @@ class TestUpdaters:
         out = nd.rmsprop_update(_arr(w), _arr(g), n, lr=0.01, gamma1=0.9,
                                 epsilon=1e-8)
         n_ref = 0.1 * g * g
-        ref = w - 0.01 * g / np.sqrt(n_ref + 1e-8)
+        # eps outside the sqrt, matching RMSPropUpdateKernel
+        # (reference optimizer_op-inl.h:2025): sqrt(n) + eps
+        ref = w - 0.01 * g / (np.sqrt(n_ref) + 1e-8)
         np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5)
 
     def test_ftrl_update(self):
