@@ -29,7 +29,15 @@ __all__ = ["foreach", "while_loop", "cond", "isfinite", "isnan", "isinf",
            "arange_like", "index_copy", "index_array", "getnnz",
            "boolean_mask", "box_iou", "box_nms", "box_encode", "box_decode",
            "bipartite_matching", "ROIAlign", "MultiBoxPrior",
-           "MultiBoxDetection"]
+           "MultiBoxDetection", "dgl_csr_neighbor_uniform_sample",
+           "dgl_csr_neighbor_non_uniform_sample", "dgl_subgraph",
+           "dgl_graph_compact", "dgl_adjacency", "edge_id"]
+
+# DGL graph-sampling family (reference src/operator/contrib/dgl_graph.cc —
+# host CSR kernels there too; see ndarray/dgl.py for the TPU rationale)
+from .dgl import (dgl_adjacency, dgl_csr_neighbor_non_uniform_sample,  # noqa: E402
+                  dgl_csr_neighbor_uniform_sample, dgl_graph_compact,
+                  dgl_subgraph, edge_id)
 
 # detection family (reference src/operator/contrib/bounding_box.cc,
 # roi_align.cc, multibox_*.cc — surfaced as mx.nd.contrib.* there too)

@@ -60,6 +60,13 @@ class NDArray:
         self._entry = None
         self._marked = False
 
+    @classmethod
+    def _from_np(cls, arr, ctx=None):
+        """Wrap a host numpy array (device transfer deferred to jnp)."""
+        import jax.numpy as jnp
+
+        return cls(jnp.asarray(arr), ctx=ctx)
+
     # ---- basic properties -------------------------------------------------
     @property
     def shape(self):

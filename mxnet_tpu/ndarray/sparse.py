@@ -149,6 +149,38 @@ class CSRNDArray(BaseSparseNDArray):
         return NDArray(dense.at[rows, self.indices_.astype(_jnp().int32)]
                        .add(self._data))
 
+    def check_format(self, full_check=True):
+        """Validate csr invariants (reference NDArray::SyncCheckFormat /
+        python sparse.py check_format): monotone indptr starting at 0 and
+        closing at nnz, in-range column indices."""
+        indptr = _np.asarray(self.indptr_)
+        indices = _np.asarray(self.indices_)
+        if indptr.ndim != 1 or len(indptr) != self._shape[0] + 1:
+            raise MXNetError("csr indptr length %d != rows+1 (%d)"
+                             % (len(indptr), self._shape[0] + 1))
+        if int(indptr[0]) != 0 or _np.any(_np.diff(indptr) < 0):
+            raise MXNetError("csr indptr must be non-decreasing from 0")
+        if int(indptr[-1]) != len(indices):
+            raise MXNetError("csr indptr[-1] (%d) != nnz (%d)"
+                             % (int(indptr[-1]), len(indices)))
+        if full_check and len(indices) and (
+                int(indices.min()) < 0
+                or int(indices.max()) >= self._shape[1]):
+            raise MXNetError("csr column index out of range")
+
+    def asscipy(self):
+        import scipy.sparse as _sp
+
+        return _sp.csr_matrix(
+            (_np.asarray(self._data), _np.asarray(self.indices_),
+             _np.asarray(self.indptr_)), shape=self._shape)
+
+    def astype(self, dtype):
+        jnp = _jnp()
+        return CSRNDArray(self._data.astype(_as_np_dtype(dtype)),
+                          jnp.asarray(self.indices_),
+                          jnp.asarray(self.indptr_), self._shape)
+
     def __repr__(self):
         return "<CSRNDArray %s>" % (self._shape,)
 

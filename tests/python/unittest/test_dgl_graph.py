@@ -1,0 +1,220 @@
+"""DGL graph-sampling op family tests.
+
+Ported contracts from the reference tests/python/unittest/test_dgl_graph.py
+(uniform/non-uniform neighbor sampling invariants, subgraph structure
+checks, compact round-trip, adjacency, edge_id ground truth).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+sp = pytest.importorskip("scipy.sparse")
+
+
+def _full_graph():
+    # 5-vertex complete graph without self loops, edge ids 1..20
+    data = np.arange(1, 21, dtype=np.int64)
+    indices = np.array([1, 2, 3, 4, 0, 2, 3, 4, 0, 1, 3, 4,
+                        0, 1, 2, 4, 0, 1, 2, 3], dtype=np.int64)
+    indptr = np.array([0, 4, 8, 12, 16, 20], dtype=np.int64)
+    return nd.sparse.csr_matrix((data, indices, indptr), shape=(5, 5))
+
+
+def check_uniform(out, num_hops, max_num_vertices):
+    sample_id, sub_csr, layer = out
+    assert len(sample_id.asnumpy()) == max_num_vertices + 1
+    num_vertices = int(sample_id.asnumpy()[-1])
+    sub_csr.check_format(full_check=True)
+    indptr = sub_csr.indptr.asnumpy()
+    assert np.all(indptr[num_vertices:] == indptr[num_vertices])
+    for d in layer.asnumpy()[:num_vertices]:
+        assert d <= num_hops
+
+
+def check_non_uniform(out, num_hops, max_num_vertices):
+    sample_id, sub_csr, prob, layer = out
+    assert len(sample_id.asnumpy()) == max_num_vertices + 1
+    num_vertices = int(sample_id.asnumpy()[-1])
+    sub_csr.check_format(full_check=True)
+    indptr = sub_csr.indptr.asnumpy()
+    assert np.all(indptr[num_vertices:] == indptr[num_vertices])
+    assert len(prob.asnumpy()) == max_num_vertices
+    for d in layer.asnumpy()[:num_vertices]:
+        assert d <= num_hops
+
+
+def check_compact(csr, id_arr, num_nodes):
+    compact = nd.contrib.dgl_graph_compact(
+        csr, id_arr, graph_sizes=num_nodes, return_mapping=False)
+    assert compact.shape[0] == num_nodes
+    assert compact.shape[1] == num_nodes
+    assert np.array_equal(compact.indptr.asnumpy(),
+                          csr.indptr.asnumpy()[:num_nodes + 1])
+    sub_indices = compact.indices.asnumpy()
+    indices = csr.indices.asnumpy()
+    ids = id_arr.asnumpy()
+    for i in range(len(sub_indices)):
+        assert ids[sub_indices[i]] == indices[i]
+
+
+def test_uniform_sample():
+    mx.random.seed(42)
+    a = _full_graph()
+    cases = [([0, 1, 2, 3, 4], 1, 2, 5), ([0], 1, 1, 4), ([0], 2, 1, 3),
+             ([0, 2, 4], 1, 2, 5), ([0, 4], 1, 2, 5), ([0, 4], 2, 2, 5)]
+    for seeds, hops, nbr, maxv in cases:
+        seed = nd.array(np.array(seeds, dtype=np.int64))
+        out = nd.contrib.dgl_csr_neighbor_uniform_sample(
+            a, seed, num_args=2, num_hops=hops, num_neighbor=nbr,
+            max_num_vertices=maxv)
+        assert len(out) == 3
+        check_uniform(out, num_hops=hops, max_num_vertices=maxv)
+        num_nodes = int(out[0].asnumpy()[-1])
+        assert 0 < num_nodes < len(out[0].asnumpy())
+        check_compact(out[1], out[0], num_nodes)
+
+
+def test_uniform_sample_reproducible():
+    a = _full_graph()
+    seed = nd.array(np.array([0, 2], dtype=np.int64))
+
+    def draw():
+        mx.random.seed(7)
+        out = nd.contrib.dgl_csr_neighbor_uniform_sample(
+            a, seed, num_args=2, num_hops=1, num_neighbor=2,
+            max_num_vertices=5)
+        return out[0].asnumpy(), out[1].indices.asnumpy()
+
+    ids1, cols1 = draw()
+    ids2, cols2 = draw()
+    assert np.array_equal(ids1, ids2)
+    assert np.array_equal(cols1, cols2)
+
+
+def test_non_uniform_sample():
+    mx.random.seed(42)
+    a = _full_graph()
+    prob = nd.array(np.array([0.9, 0.8, 0.2, 0.4, 0.1], dtype=np.float32))
+    cases = [([0, 1, 2, 3, 4], 1, 2, 5), ([0], 1, 1, 4), ([0], 2, 1, 4),
+             ([0, 2, 4], 1, 2, 5), ([0, 4], 2, 2, 5)]
+    for seeds, hops, nbr, maxv in cases:
+        seed = nd.array(np.array(seeds, dtype=np.int64))
+        out = nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+            a, prob, seed, num_args=3, num_hops=hops, num_neighbor=nbr,
+            max_num_vertices=maxv)
+        assert len(out) == 4
+        check_non_uniform(out, num_hops=hops, max_num_vertices=maxv)
+
+
+def _generate_graph(n):
+    rs = np.random.RandomState(3)
+    dense = (rs.rand(n, n) < 0.2).astype(np.float32)
+    coo = sp.coo_matrix(dense)
+    coo.data = np.arange(len(coo.row), dtype=np.float32)
+    csr = coo.tocsr()
+    g = nd.sparse.csr_matrix(
+        (csr.data.astype(np.int64), csr.indices.astype(np.int64),
+         csr.indptr.astype(np.int64)), shape=(n, n))
+    return csr, g
+
+
+def test_subgraph():
+    sp_g, g = _generate_graph(100)
+    rs = np.random.RandomState(5)
+    vertices = np.unique(rs.randint(0, 100, size=20))
+    subgs = nd.contrib.dgl_subgraph(
+        g, nd.array(vertices.astype(np.int64)), return_mapping=True)
+    subgs[0].check_format()
+    subgs[1].check_format()
+    assert np.array_equal(subgs[0].indptr.asnumpy(),
+                          subgs[1].indptr.asnumpy())
+    assert np.array_equal(subgs[0].indices.asnumpy(),
+                          subgs[1].indices.asnumpy())
+    sp_subg = subgs[1].asscipy()
+    indptr = subgs[0].indptr.asnumpy()
+    indices = subgs[0].indices.asnumpy()
+    for subv1 in range(len(indptr) - 1):
+        v1 = vertices[subv1]
+        for subv2 in indices[indptr[subv1]:indptr[subv1 + 1]]:
+            v2 = vertices[subv2]
+            assert sp_g[v1, v2] == sp_subg[subv1, subv2]
+
+
+def test_adjacency():
+    _sp_g, g = _generate_graph(100)
+    adj = nd.contrib.dgl_adjacency(g)
+    assert adj.data.asnumpy().dtype == np.float32
+    assert adj.shape == g.shape
+    assert np.array_equal(adj.indptr.asnumpy(), g.indptr.asnumpy())
+    assert np.array_equal(adj.indices.asnumpy(), g.indices.asnumpy())
+    assert np.all(adj.data.asnumpy() == 1.0)
+
+
+def test_edge_id():
+    shape = (8, 9)
+    rs = np.random.RandomState(11)
+    dense = rs.rand(*shape) * (rs.rand(*shape) < 0.4)
+    csr = sp.csr_matrix(dense.astype(np.float32))
+    g = nd.sparse.csr_matrix((csr.data, csr.indices.astype(np.int64),
+                              csr.indptr.astype(np.int64)), shape=shape)
+    ground_truth = np.full(shape, -1.0, dtype=np.float32)
+    for i in range(shape[0]):
+        for j in range(csr.indptr[i], csr.indptr[i + 1]):
+            ground_truth[i, csr.indices[j]] = csr.data[j]
+    np_u = rs.randint(0, shape[0], size=5)
+    np_v = rs.randint(0, shape[1], size=5)
+    out = nd.contrib.edge_id(g, nd.array(np_u.astype(np.int64)),
+                             nd.array(np_v.astype(np.int64)))
+    np.testing.assert_allclose(out.asnumpy(), ground_truth[np_u, np_v],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_edge_id_preserves_int64_dtype():
+    # int64 edge ids above 2**24 would corrupt through a float32 output
+    big = np.int64(2 ** 24 + 1)
+    data = np.array([big, 7], dtype=np.int64)
+    indices = np.array([1, 0], dtype=np.int64)
+    indptr = np.array([0, 1, 2], dtype=np.int64)
+    g = nd.sparse.csr_matrix((data, indices, indptr), shape=(2, 2))
+    out = nd.contrib.edge_id(g, nd.array(np.array([0, 0], dtype=np.int64)),
+                             nd.array(np.array([1, 0], dtype=np.int64)))
+    assert out.asnumpy().dtype.kind == "i"
+    assert int(out.asnumpy()[0]) == int(big)
+    assert int(out.asnumpy()[1]) == -1
+
+
+def test_sampled_subcsr_keeps_parent_width():
+    # parent graph (5, 7): sampled sub-csr columns stay in the parent's
+    # column space (CSRNeighborUniformSampleShape keeps shape[1])
+    data = np.arange(1, 5, dtype=np.int64)
+    indices = np.array([1, 2, 0, 3], dtype=np.int64)
+    indptr = np.array([0, 2, 3, 4, 4, 4], dtype=np.int64)
+    g = nd.sparse.csr_matrix((data, indices, indptr), shape=(5, 7))
+    mx.random.seed(0)
+    out = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, nd.array(np.array([0], dtype=np.int64)), num_args=2, num_hops=1,
+        num_neighbor=2, max_num_vertices=4)
+    assert out[1].shape[1] == 7
+
+
+def test_non_uniform_sample_clamps_to_positive_weights():
+    # row 0 has 4 neighbors (more than requested, so the weighted draw
+    # runs) but only 2 carry probability mass; asking for 3 must not crash
+    # — the draw clamps to the feasible candidates.  NB a row SHORTER than
+    # num_neighbor is copied wholesale, zero-prob entries included
+    # (GetNonUniformSample's ver_len <= max_num_neighbor early-out).
+    data = np.array([1, 2, 3, 4], dtype=np.int64)
+    indices = np.array([1, 2, 3, 4], dtype=np.int64)
+    indptr = np.array([0, 4, 4, 4, 4, 4], dtype=np.int64)
+    g = nd.sparse.csr_matrix((data, indices, indptr), shape=(5, 5))
+    prob = nd.array(np.array([0.0, 0.5, 0.0, 0.5, 0.0], dtype=np.float32))
+    mx.random.seed(0)
+    out = nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        g, prob, nd.array(np.array([0], dtype=np.int64)), num_args=3,
+        num_hops=1, num_neighbor=3, max_num_vertices=5)
+    check_non_uniform(out, num_hops=1, max_num_vertices=5)
+    sub_csr = out[1]
+    cols = sub_csr.indices.asnumpy()
+    assert set(cols.tolist()) == {1, 3}
