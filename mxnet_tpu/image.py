@@ -78,6 +78,19 @@ def imresize(src, w, h, interp=1):
     return nd.array(out).astype(src.dtype)
 
 
+def copyMakeBorder(src, top, bot, left, right, border_type=0, value=0.0):
+    """Pad an HWC image (reference _cvcopyMakeBorder, src/io OpenCV
+    bridge): border_type 0 = constant fill, 1 = replicate edge."""
+    arr = src.asnumpy() if hasattr(src, "asnumpy") else _np.asarray(src)
+    pads = ((top, bot), (left, right)) + ((0, 0),) * (arr.ndim - 2)
+    if border_type == 1:
+        out = _np.pad(arr, pads, mode="edge")
+    else:
+        out = _np.pad(arr, pads, mode="constant",
+                      constant_values=_np.asarray(value, arr.dtype))
+    return nd.array(out, dtype=str(arr.dtype))
+
+
 def resize_short(src, size, interp=2):
     h, w = src.shape[:2]
     if h > w:

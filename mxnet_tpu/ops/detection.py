@@ -346,3 +346,174 @@ def multibox_detection(cls_prob, loc_pred, anchors, clip=True,
     return box_nms.fn(det, overlap_thresh=nms_threshold, valid_thresh=0.0,
                       topk=nms_topk, coord_start=2, score_index=1,
                       id_index=0, force_suppress=force_suppress)
+
+
+@register("multibox_target", differentiable=False, num_outputs=3)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training-target assignment (multibox_target.cc:72
+    MultiBoxTargetForward): greedy bipartite gt<->anchor matching, then
+    IoU-threshold matching, optional hard-negative mining ranked by
+    background confidence, and variance-scaled offset encoding.
+
+    Host numpy kernel ON PURPOSE: the matching loop is sequential
+    argmax-with-removal over (anchors x gts) — the reference runs it on
+    CPU even in GPU builds (multibox_target.cu just copies); it prepares
+    targets, it is not in the compiled training step.
+
+    anchor (1, N, 4) corner format, label (B, M, 5+) rows
+    [cls, x1, y1, x2, y2, ...] padded with -1, cls_pred (B, CLS, N) ->
+    (loc_target (B, N*4), loc_mask (B, N*4), cls_target (B, N))."""
+    import numpy as onp
+
+    anc = onp.asarray(anchor).reshape(-1, 4)
+    lab = onp.asarray(label)
+    cp = onp.asarray(cls_pred)
+    B, M, W = lab.shape
+    N = anc.shape[0]
+    loc_t = onp.zeros((B, N, 4), onp.float32)
+    loc_m = onp.zeros((B, N, 4), onp.float32)
+    cls_t = onp.full((B, N), float(ignore_label), onp.float32)
+
+    aw = onp.maximum(anc[:, 2] - anc[:, 0], 1e-12)
+    ah = onp.maximum(anc[:, 3] - anc[:, 1], 1e-12)
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+
+    def iou(a, b):
+        ix = onp.maximum(0, onp.minimum(a[:, None, 2], b[None, :, 2])
+                         - onp.maximum(a[:, None, 0], b[None, :, 0]))
+        iy = onp.maximum(0, onp.minimum(a[:, None, 3], b[None, :, 3])
+                         - onp.maximum(a[:, None, 1], b[None, :, 1]))
+        inter = ix * iy
+        ua = ((a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1]))[:, None] \
+            + ((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]))[None, :] - inter
+        return inter / onp.maximum(ua, 1e-12)
+
+    for nb in range(B):
+        valid = 0
+        while valid < M and lab[nb, valid, 0] != -1.0:
+            valid += 1
+        if valid == 0:
+            continue
+        gt = lab[nb, :valid]
+        overlaps = iou(anc, gt[:, 1:5])              # (N, valid)
+        anchor_flags = onp.full(N, -1, onp.int8)     # -1 ignore, 1 pos, 0 neg
+        matches = onp.full(N, -1, onp.int64)
+        match_iou = onp.full(N, -1.0, onp.float32)
+        # 1. greedy bipartite: every gt gets its best still-free anchor
+        gt_free = onp.ones(valid, bool)
+        work = overlaps.copy()
+        while gt_free.any():
+            j, k = onp.unravel_index(onp.argmax(
+                onp.where(gt_free[None, :], work, -1.0)), work.shape)
+            if work[j, k] <= 1e-6:
+                break
+            matches[j] = k
+            match_iou[j] = work[j, k]
+            anchor_flags[j] = 1
+            gt_free[k] = False
+            work[j, :] = -1.0
+        # 2. threshold matching for the rest
+        if overlap_threshold > 0:
+            free = anchor_flags != 1
+            best_gt = onp.argmax(overlaps, axis=1)
+            best_iou = overlaps[onp.arange(N), best_gt]
+            take = free & (best_iou > overlap_threshold)
+            matches[take] = best_gt[take]
+            match_iou[free] = best_iou[free]
+            anchor_flags[take] = 1
+        num_pos = int((anchor_flags == 1).sum())
+        # 3. negatives
+        if negative_mining_ratio > 0:
+            num_neg = min(int(num_pos * negative_mining_ratio),
+                          N - num_pos)
+            num_neg = max(num_neg, int(minimum_negative_samples))
+            cand = onp.where((anchor_flags != 1)
+                             & (match_iou < negative_mining_thresh))[0]
+            if num_neg > 0 and len(cand):
+                logits = cp[nb]                       # (CLS, N)
+                mx_ = logits[:, cand].max(axis=0)
+                prob_bg = onp.exp(logits[0, cand] - mx_) / onp.exp(
+                    logits[:, cand] - mx_).sum(axis=0)
+                # hardest negatives = lowest background confidence
+                # (reference sorts SortElemDescend(-prob) — prob ascending)
+                order = onp.argsort(prob_bg, kind="stable")
+                anchor_flags[cand[order[:num_neg]]] = 0
+        else:
+            anchor_flags[anchor_flags != 1] = 0
+        # 4. targets
+        pos = onp.where(anchor_flags == 1)[0]
+        neg = onp.where(anchor_flags == 0)[0]
+        cls_t[nb, neg] = 0.0
+        if len(pos):
+            g = gt[matches[pos]]
+            cls_t[nb, pos] = g[:, 0] + 1.0
+            gw = onp.maximum(g[:, 3] - g[:, 1], 1e-12)
+            gh = onp.maximum(g[:, 4] - g[:, 2], 1e-12)
+            gcx = (g[:, 1] + g[:, 3]) / 2
+            gcy = (g[:, 2] + g[:, 4]) / 2
+            v = variances
+            loc_t[nb, pos, 0] = ((gcx - acx[pos]) / aw[pos]) / v[0]
+            loc_t[nb, pos, 1] = ((gcy - acy[pos]) / ah[pos]) / v[1]
+            loc_t[nb, pos, 2] = onp.log(gw / aw[pos]) / v[2]
+            loc_t[nb, pos, 3] = onp.log(gh / ah[pos]) / v[3]
+            loc_m[nb, pos] = 1.0
+    return (jnp.asarray(loc_t.reshape(B, -1)),
+            jnp.asarray(loc_m.reshape(B, -1)), jnp.asarray(cls_t))
+
+
+@register("rroi_align", differentiable=False)
+def rroi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+               sampling_ratio=-1):
+    """Rotated ROI align (contrib/rroi_align.cc — CPU-only in the
+    reference too): rois rows [batch_idx, cx, cy, w, h, angle_deg];
+    bilinear sampling on a rotated grid, average-pooled."""
+    import numpy as onp
+
+    x = onp.asarray(data)
+    r = onp.asarray(rois)
+    B, C, H, W = x.shape
+    ph, pw = (pooled_size, pooled_size) if isinstance(pooled_size, int) \
+        else tuple(pooled_size)
+    R = r.shape[0]
+    out = onp.zeros((R, C, ph, pw), onp.float32)
+    for i in range(R):
+        b = int(r[i, 0])
+        cx, cy, w, h = (r[i, 1] * spatial_scale, r[i, 2] * spatial_scale,
+                        max(r[i, 3] * spatial_scale, 1.0),
+                        max(r[i, 4] * spatial_scale, 1.0))
+        theta = onp.deg2rad(r[i, 5])
+        cosT, sinT = onp.cos(theta), onp.sin(theta)
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+        for py in range(ph):
+            for px in range(pw):
+                acc = onp.zeros(C, onp.float32)
+                for iy in range(sr):
+                    for ix in range(sr):
+                        # unit coords in the roi frame, centered
+                        ux = (px + (ix + 0.5) / sr) / pw - 0.5
+                        uy = (py + (iy + 0.5) / sr) / ph - 0.5
+                        sx = cx + ux * w * cosT - uy * h * sinT
+                        sy = cy + ux * w * sinT + uy * h * cosT
+                        if sx < -1.0 or sx > W or sy < -1.0 or sy > H:
+                            continue
+                        # clamp BEFORE taking the fractions (reference
+                        # rroi_align.cc:89-114 sets x=0 when x<=0, so a
+                        # border sample reads the pure edge pixel)
+                        sxc = min(max(sx, 0.0), W - 1)
+                        syc = min(max(sy, 0.0), H - 1)
+                        x0c = int(onp.floor(sxc))
+                        y0c = int(onp.floor(syc))
+                        x1c = min(x0c + 1, W - 1)
+                        y1c = min(y0c + 1, H - 1)
+                        fx = sxc - x0c; fy = syc - y0c
+                        val = ((1 - fx) * (1 - fy) * x[b, :, y0c, x0c]
+                               + fx * (1 - fy) * x[b, :, y0c, x1c]
+                               + (1 - fx) * fy * x[b, :, y1c, x0c]
+                               + fx * fy * x[b, :, y1c, x1c])
+                        acc += val
+                out[i, :, py, px] = acc / (sr * sr)
+    return jnp.asarray(out)

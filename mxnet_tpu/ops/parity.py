@@ -1,0 +1,888 @@
+"""Reference op-name parity layer: the registry tail to the full
+``NNVM_REGISTER_OP`` universe.
+
+Three kinds of entries (OPS_PARITY.md is generated from the same tables by
+tools/ops_parity.py):
+
+1. **Aliases** — reference names that are pure renames of ops this registry
+   already holds (legacy CamelCase elemwise family, ``_linalg_*`` /
+   ``_sample_*`` underscore prefixes, ``broadcast_*`` comparison spellings,
+   ``max_axis``-style 0.x names).  Reference: the ``.add_alias`` chains in
+   elemwise_binary_broadcast_op_basic.cc, elemwise_unary_op_basic.cc and
+   the 586-op registry at large.
+2. **Scalar-operand family** — ``_plus_scalar``/``_rdiv_scalar``/… from
+   elemwise_binary_scalar_op_basic.cc.  One generic jnp expression each:
+   XLA constant-folds the scalar, so there is no reason for the reference's
+   specialized kernels — but the NAMES must resolve for 1.x code.
+3. **Real tail ops** — init ops (init_op.cc), the random-pdf family
+   (random/pdf_op.cc), functional slice/scatter assignment
+   (matrix_op.cc _slice_assign:700, indexing_op.cc scatter_set_nd),
+   split_v2 (matrix_op.cc), make_loss (make_loss.cc), STE rounding
+   (contrib/stes_op.cc), quadratic (contrib/quadratic_op.cc),
+   gradient multiplier (contrib/gradient_multiplier_op.cc), group/sparse
+   adagrad (contrib/optimizer_op.cc), multi-tensor adamw/lamb/lans mp
+   variants (contrib/adamw.cc, multi_lamb.cc, multi_lans.cc), the
+   quantized-op tail (quantization/), unique zipfian sampling
+   (random/unique_sample_op.cc), and allclose (contrib/allclose_op.cc).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError, _as_np_dtype
+from . import contrib_tail, core, nn, optimizer_ops  # noqa: F401 (dep order)
+from .registry import alias, get_op, register
+
+__all__ = []
+
+
+# ---------------------------------------------------------------------------
+# 1. pure aliases
+# ---------------------------------------------------------------------------
+# reference name -> existing registry name
+ALIASES = {
+    # legacy CamelCase binary broadcast ops (elemwise_binary_broadcast_op
+    # _basic.cc .add_alias("_Plus") etc.)
+    "_Plus": "broadcast_add", "_plus": "broadcast_add",
+    "_add": "broadcast_add", "_grad_add": "broadcast_add",
+    "_Minus": "broadcast_sub", "_minus": "broadcast_sub",
+    "_sub": "broadcast_sub",
+    "_Mul": "broadcast_mul", "_mul": "broadcast_mul",
+    "_Div": "broadcast_div", "_div": "broadcast_div",
+    "_Mod": "mod", "_mod": "mod",
+    "_Power": "power", "_power": "power",
+    "_Maximum": "maximum", "_maximum": "maximum",
+    "_Minimum": "minimum", "_minimum": "minimum",
+    "_Hypot": "hypot", "_hypot": "hypot",
+    # comparisons (CamelCase + lowercase + broadcast_ spellings)
+    "_Equal": "equal", "_equal": "equal", "broadcast_equal": "equal",
+    "_Not_Equal": "not_equal", "_not_equal": "not_equal",
+    "broadcast_not_equal": "not_equal",
+    "_Greater": "greater", "_greater": "greater",
+    "broadcast_greater": "greater",
+    "_Greater_Equal": "greater_equal", "_greater_equal": "greater_equal",
+    "broadcast_greater_equal": "greater_equal",
+    "_Lesser": "lesser", "_lesser": "lesser",
+    "broadcast_lesser": "lesser",
+    "_Lesser_Equal": "lesser_equal", "_lesser_equal": "lesser_equal",
+    "broadcast_lesser_equal": "lesser_equal",
+    "_Logical_And": "logical_and", "_logical_and": "logical_and",
+    "broadcast_logical_and": "logical_and",
+    "_Logical_Or": "logical_or", "_logical_or": "logical_or",
+    "broadcast_logical_or": "logical_or",
+    "_Logical_Xor": "logical_xor", "_logical_xor": "logical_xor",
+    "broadcast_logical_xor": "logical_xor",
+    "broadcast_maximum": "maximum", "broadcast_minimum": "minimum",
+    "broadcast_hypot": "hypot", "broadcast_power": "power",
+    "broadcast_mod": "mod",
+    "broadcast_plus": "broadcast_add", "broadcast_minus": "broadcast_sub",
+    # 0.x axis-suffixed reductions (broadcast_reduce_op registrations)
+    "max_axis": "max", "min_axis": "min", "sum_axis": "sum",
+    # misc renames
+    "ElementWiseSum": "add_n", "BlockGrad": "stop_gradient",
+    "make_loss_legacy": "identity",
+    "SoftmaxActivation": "softmax",
+    "_copy": "identity", "_copyto": "identity",
+    "choose_element_0index": "pick", "crop": "slice",
+    "normal": "random_normal", "uniform": "random_uniform",
+    "_histogram": "histogram", "_shuffle": "shuffle",
+    "_unravel_index": "unravel_index",
+    "_ravel_multi_index": "ravel_multi_index",
+    "_rnn_param_concat": "concat",
+    "_npi_rnn_param_concat": "concat",
+    "batch_flatten": "flatten",
+    "_contrib_AdaptiveAvgPooling2D": "adaptive_avg_pooling",
+    "_contrib_BilinearResize2D": "bilinear_resize",
+    "_contrib_box_non_maximum_suppression": "box_nms",
+    "_contrib_ctc_loss": "ctc_loss",
+    "_contrib_CTCLoss": "CTCLoss",
+    "_random_uniform": "random_uniform",
+    "_random_normal": "random_normal",
+    "_random_exponential": "random_exponential",
+    "_random_gamma": "random_gamma",
+    "_random_poisson": "random_poisson",
+    "_random_negative_binomial": "random_negative_binomial",
+    "_random_generalized_negative_binomial":
+        "random_generalized_negative_binomial",
+    "_random_randint": "random_randint",
+    "_random_uniform_like": "random_uniform_like",
+    "_random_normal_like": "random_normal_like",
+    "_sample_uniform": "sample_uniform",
+    "_sample_normal": "sample_normal",
+    "_sample_gamma": "sample_gamma",
+    "_sample_exponential": "sample_exponential",
+    "_sample_poisson": "sample_poisson",
+    "_sample_negative_binomial": "sample_negative_binomial",
+    "_sample_generalized_negative_binomial":
+        "sample_generalized_negative_binomial",
+    "_sample_multinomial": "sample_multinomial",
+}
+
+# _linalg_* underscore aliases (la_op.cc registers the underscored names;
+# this registry standardized on the python-surface linalg_* spelling)
+_LINALG = ["det", "extractdiag", "extracttrian", "gelqf", "gemm", "gemm2",
+           "inverse", "makediag", "maketrian", "potrf", "potri", "slogdet",
+           "sumlogdiag", "syevd", "syrk", "trmm", "trsm"]
+
+
+def _install_aliases():
+    for la in _LINALG:
+        ALIASES["_linalg_" + la] = "linalg_" + la
+    for ref, ours in ALIASES.items():
+        try:
+            get_op(ref)
+        except MXNetError:
+            alias(ref, ours)
+
+
+# ---------------------------------------------------------------------------
+# 2. scalar-operand family (elemwise_binary_scalar_op_basic.cc etc.)
+# ---------------------------------------------------------------------------
+_SCALAR_FAMILY = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: jnp.mod(x, s),
+    "_rmod_scalar": lambda x, s: jnp.mod(jnp.full_like(x, s), x),
+    "_power_scalar": lambda x, s: jnp.power(x, s),
+    "_rpower_scalar": lambda x, s: jnp.power(jnp.full_like(x, s), x),
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_hypot_scalar": lambda x, s: jnp.hypot(x, jnp.full_like(x, s)),
+    "_equal_scalar": lambda x, s: (x == s).astype(x.dtype),
+    "_not_equal_scalar": lambda x, s: (x != s).astype(x.dtype),
+    "_greater_scalar": lambda x, s: (x > s).astype(x.dtype),
+    "_greater_equal_scalar": lambda x, s: (x >= s).astype(x.dtype),
+    "_lesser_scalar": lambda x, s: (x < s).astype(x.dtype),
+    "_lesser_equal_scalar": lambda x, s: (x <= s).astype(x.dtype),
+    "_logical_and_scalar": lambda x, s: jnp.logical_and(
+        x != 0, bool(s)).astype(x.dtype),
+    "_logical_or_scalar": lambda x, s: jnp.logical_or(
+        x != 0, bool(s)).astype(x.dtype),
+    "_logical_xor_scalar": lambda x, s: jnp.logical_xor(
+        x != 0, bool(s)).astype(x.dtype),
+}
+
+_SCALAR_CAMEL = {
+    "_PlusScalar": "_plus_scalar", "_MinusScalar": "_minus_scalar",
+    "_RMinusScalar": "_rminus_scalar", "_MulScalar": "_mul_scalar",
+    "_DivScalar": "_div_scalar", "_RDivScalar": "_rdiv_scalar",
+    "_ModScalar": "_mod_scalar", "_RModScalar": "_rmod_scalar",
+    "_PowerScalar": "_power_scalar", "_RPowerScalar": "_rpower_scalar",
+    "_MaximumScalar": "_maximum_scalar", "_MinimumScalar": "_minimum_scalar",
+    "_HypotScalar": "_hypot_scalar", "_EqualScalar": "_equal_scalar",
+    "_NotEqualScalar": "_not_equal_scalar",
+    "_GreaterScalar": "_greater_scalar",
+    "_GreaterEqualScalar": "_greater_equal_scalar",
+    "_LesserScalar": "_lesser_scalar",
+    "_LesserEqualScalar": "_lesser_equal_scalar",
+    "_LogicalAndScalar": "_logical_and_scalar",
+    "_LogicalOrScalar": "_logical_or_scalar",
+    "_LogicalXorScalar": "_logical_xor_scalar",
+}
+
+
+def _install_scalar_family():
+    non_diff = {"_equal_scalar", "_not_equal_scalar", "_greater_scalar",
+                "_greater_equal_scalar", "_lesser_scalar",
+                "_lesser_equal_scalar", "_logical_and_scalar",
+                "_logical_or_scalar", "_logical_xor_scalar"}
+    for name, expr in _SCALAR_FAMILY.items():
+        def fn(data, scalar=1.0, is_int=None, _e=expr, **_ignored):
+            return _e(data, scalar)
+
+        fn.__name__ = name
+        register(name, differentiable=name not in non_diff)(fn)
+    for camel, lower in _SCALAR_CAMEL.items():
+        alias(camel, lower)
+
+
+# ---------------------------------------------------------------------------
+# 3. init ops (init_op.cc) — registry-level, shape comes as an attr
+# ---------------------------------------------------------------------------
+def _install_init_ops():
+    def _shape(s):
+        return (s,) if isinstance(s, int) else tuple(s)
+
+    @register("_zeros", differentiable=False)
+    def _zeros(shape=(1,), dtype="float32", ctx=None, **_kw):
+        return jnp.zeros(_shape(shape), _as_np_dtype(dtype))
+
+    @register("_ones", differentiable=False)
+    def _ones(shape=(1,), dtype="float32", ctx=None, **_kw):
+        return jnp.ones(_shape(shape), _as_np_dtype(dtype))
+
+    @register("_full", differentiable=False)
+    def _full(shape=(1,), value=0.0, dtype="float32", ctx=None, **_kw):
+        return jnp.full(_shape(shape), value, _as_np_dtype(dtype))
+
+    @register("_zeros_without_dtype", differentiable=False)
+    def _zeros_without_dtype(shape=(1,), ctx=None, **_kw):
+        return jnp.zeros(_shape(shape), jnp.float32)
+
+    @register("_arange", differentiable=False)
+    def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32",
+                ctx=None, **_kw):
+        out = jnp.arange(start, stop, step, _as_np_dtype(dtype))
+        return jnp.repeat(out, repeat) if repeat > 1 else out
+
+    @register("_linspace", differentiable=False)
+    def _linspace(start=0.0, stop=1.0, num=50, endpoint=True,
+                  dtype="float32", ctx=None, **_kw):
+        return jnp.linspace(start, stop, int(num), endpoint=endpoint,
+                            dtype=_as_np_dtype(dtype))
+
+    @register("_eye", differentiable=False)
+    def _eye(N=1, M=0, k=0, dtype="float32", ctx=None, **_kw):
+        return jnp.eye(int(N), int(M) if M else None, int(k),
+                       dtype=_as_np_dtype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# 4. random-pdf family (random/pdf_op.cc)
+# ---------------------------------------------------------------------------
+def _param_view(sample, parm):
+    """Broadcast per-distribution params against sample's trailing dims
+    (pdf_op.cc: index = start / sample_size)."""
+    extra = sample.ndim - parm.ndim
+    return parm.reshape(parm.shape + (1,) * extra)
+
+
+def _pdf(name, lpdf_fn, n_parms=2, event_dim=0):
+    def fn(sample, *parms, is_log=False):
+        views = [_param_view(sample if event_dim == 0 else
+                             sample[..., 0], p) for p in parms]
+        lp = lpdf_fn(sample, *views)
+        return lp if is_log else jnp.exp(lp)
+
+    fn.__name__ = name
+    register(name)(fn)
+
+
+def _install_pdf_family():
+    _pdf("_random_pdf_uniform",
+         lambda x, lo, hi: -jnp.log(hi - lo) * jnp.ones_like(x))
+    _pdf("_random_pdf_normal",
+         lambda x, mu, sig: -0.5 * jnp.square((x - mu) / sig)
+         - jnp.log(sig * jnp.sqrt(2 * jnp.pi)))
+    # rate parameterization: a*log(b) + (a-1)log x - b x - lgamma(a)
+    # (pdf_op.h:121 PDF_Gamma)
+    _pdf("_random_pdf_gamma",
+         lambda x, a, b: a * jnp.log(b) + (a - 1) * jnp.log(x) - b * x
+         - lax.lgamma(a))
+    _pdf("_random_pdf_exponential",
+         lambda x, lam: jnp.log(lam) - lam * x, n_parms=1)
+    _pdf("_random_pdf_poisson",
+         lambda x, lam: x * jnp.log(lam) - lam - lax.lgamma(x + 1.0),
+         n_parms=1)
+    # p is the FAILURE probability (pdf_op.h:246 comment)
+    _pdf("_random_pdf_negative_binomial",
+         lambda x, l, p: lax.lgamma(x + l) - lax.lgamma(x + 1.0)
+         - lax.lgamma(l) + l * jnp.log(p) + x * jnp.log(1 - p))
+
+    def _gnb(x, mu, alpha):
+        l = 1.0 / alpha
+        p = 1.0 / (mu * alpha + 1.0)
+        return (lax.lgamma(x + l) - lax.lgamma(x + 1.0) - lax.lgamma(l)
+                + l * jnp.log(p) + x * jnp.log(1 - p))
+
+    _pdf("_random_pdf_generalized_negative_binomial", _gnb)
+
+    @register("_random_pdf_dirichlet")
+    def _random_pdf_dirichlet(sample, alpha, is_log=False):
+        """pdf_op.h:325 PDF_Dirichlet — sample carries a trailing event
+        dim of size k; alpha is params_shape + (k,), broadcast across any
+        extra sample dims between them."""
+        extra = sample.ndim - alpha.ndim
+        a = alpha.reshape(alpha.shape[:-1] + (1,) * extra
+                          + alpha.shape[-1:])
+        lp = jnp.sum((a - 1.0) * jnp.log(sample), axis=-1) \
+            + lax.lgamma(jnp.sum(a, axis=-1)) \
+            - jnp.sum(lax.lgamma(a), axis=-1)
+        return lp if is_log else jnp.exp(lp)
+
+
+# ---------------------------------------------------------------------------
+# 5. functional slice/scatter assignment (matrix_op.cc, indexing_op.cc)
+# ---------------------------------------------------------------------------
+def _slices(shape, begin, end, step=None):
+    step = step or [None] * len(begin)
+    out = []
+    for i, (b, e) in enumerate(zip(begin, end)):
+        s = step[i] if i < len(step) and step[i] not in (None, 0) else 1
+        out.append(slice(b, e, s))
+    while len(out) < len(shape):
+        out.append(slice(None))
+    return tuple(out)
+
+
+def _install_assign_family():
+    @register("_slice_assign")
+    def _slice_assign(lhs, rhs, begin=(), end=(), step=None):
+        """out = lhs with lhs[begin:end:step] = rhs (matrix_op.cc
+        _slice_assign — functional: returns a new array, the NDArray
+        ``out=`` contract handles in-place semantics)."""
+        return lhs.at[_slices(lhs.shape, begin, end, step)].set(
+            rhs.astype(lhs.dtype))
+
+    @register("_slice_assign_scalar")
+    def _slice_assign_scalar(data, scalar=0.0, begin=(), end=(), step=None):
+        return data.at[_slices(data.shape, begin, end, step)].set(scalar)
+
+    alias("_crop_assign", "_slice_assign")
+    alias("_crop_assign_scalar", "_slice_assign_scalar")
+
+    @register("_scatter_set_nd")
+    def _scatter_set_nd(lhs, rhs, indices, shape=None):
+        """lhs with lhs[indices] = rhs (indexing_op.cc _scatter_set_nd:
+        the functional form of scatter_nd writing into an existing
+        array).  ``indices`` is (M, N) selecting N cells across M axes."""
+        idx = tuple(indices.astype(jnp.int32))
+        return lhs.at[idx].set(rhs.astype(lhs.dtype))
+
+    @register("split_v2", num_outputs=lambda attrs: max(
+        1, int(attrs.get("_num_outputs", attrs.get("sections", 1)))
+        if not attrs.get("indices") else len(attrs["indices"]) + 1))
+    def split_v2(data, indices=(), axis=0, squeeze_axis=False, sections=0,
+                 **_kw):
+        """numpy-style split (matrix_op.cc _split_v2): ``sections`` equal
+        parts or explicit boundary ``indices``."""
+        if sections:
+            parts = jnp.split(data, int(sections), axis=axis)
+        else:
+            parts = jnp.split(data, list(indices), axis=axis)
+        if squeeze_axis:
+            parts = [jnp.squeeze(p, axis=axis) for p in parts]
+        return tuple(parts)
+
+    alias("_split_v2", "split_v2")
+
+    @register("broadcast_axis")
+    def broadcast_axis(data, axis=(), size=(), **_kw):
+        """Broadcast size-1 axes to given sizes (broadcast_reduce_op.cc)."""
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        sizes = (size,) if isinstance(size, int) else tuple(size)
+        target = list(data.shape)
+        for a, s in zip(axes, sizes):
+            target[a] = s
+        return jnp.broadcast_to(data, tuple(target))
+
+    alias("broadcast_axes", "broadcast_axis")
+
+
+# ---------------------------------------------------------------------------
+# 6. misc tail
+# ---------------------------------------------------------------------------
+def _install_misc():
+    @register("make_loss")
+    def make_loss(data):
+        """Forward identity; gradient = ones (make_loss.cc / MakeLoss
+        FGradient MakeZeroGrad... the 2.0 op returns ones_like as the
+        head-grad seed so a non-scalar 'loss' output backprops as-if
+        summed)."""
+        @jax.custom_vjp
+        def _ml(x):
+            return x
+
+        def fwd(x):
+            return x, None
+
+        def bwd(_res, g):
+            return (jnp.ones_like(g),)
+
+        _ml.defvjp(fwd, bwd)
+        return _ml(data)
+
+    @register("_identity_with_attr_like_rhs")
+    def _identity_with_attr_like_rhs(lhs, rhs):
+        """Identity on lhs; rhs only donates shape/stype attrs
+        (elemwise_unary_op_basic.cc — internal sparse-grad plumbing)."""
+        return lhs
+
+    @register("_square_sum", differentiable=False)
+    def _square_sum(data, axis=None, keepdims=False):
+        """sum(x^2) fused (square_sum.cc — row_sparse-aware there; the
+        dense rendering is the same contraction XLA fuses anyway)."""
+        return jnp.sum(jnp.square(data), axis=axis, keepdims=keepdims)
+
+    @register("_contrib_quadratic")
+    def _contrib_quadratic(data, a=0.0, b=0.0, c=0.0):
+        """a*x^2 + b*x + c (contrib/quadratic_op.cc — the tutorial op)."""
+        return a * jnp.square(data) + b * data + c
+
+    alias("quadratic", "_contrib_quadratic")
+
+    @register("_contrib_gradientmultiplier")
+    def _contrib_gradientmultiplier(data, scalar=1.0):
+        """Identity forward, grad scaled by ``scalar`` (contrib/
+        gradient_multiplier_op.cc — gradient-reversal trick when
+        scalar<0)."""
+        @jax.custom_vjp
+        def _gm(x):
+            return x
+
+        def fwd(x):
+            return x, None
+
+        def bwd(_res, g):
+            return (g * scalar,)
+
+        _gm.defvjp(fwd, bwd)
+        return _gm(data)
+
+    @register("_contrib_round_ste")
+    def _contrib_round_ste(data):
+        """round with straight-through gradient (contrib/stes_op.cc)."""
+        return data + lax.stop_gradient(jnp.round(data) - data)
+
+    @register("_contrib_sign_ste")
+    def _contrib_sign_ste(data):
+        return data + lax.stop_gradient(jnp.sign(data) - data)
+
+    @register("_contrib_dynamic_reshape", differentiable=False)
+    def _contrib_dynamic_reshape(data, shape_like):
+        """Reshape with a TENSOR shape argument (contrib/
+        dynamic_shape_ops.cc) — eager-only on XLA: the shape must be
+        concrete by execution time, exactly the reference's dynamic-shape
+        dispatch falling off the static path."""
+        import numpy as _onp
+
+        target = [int(v) for v in _onp.asarray(shape_like)]
+        return jnp.reshape(data, target)
+
+    @register("_contrib_allclose", differentiable=False)
+    def _contrib_allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+        """1 if all |a-b| <= atol + rtol*|b| (contrib/allclose_op.cc)."""
+        return jnp.all(jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                   equal_nan=equal_nan)).astype(jnp.float32)
+
+    alias("allclose", "_contrib_allclose")
+
+    @register("_npx_constraint_check", differentiable=False)
+    def _npx_constraint_check(data, msg="constraint violated"):
+        """np_constraint_check.cc: returns True and errors eagerly when the
+        boolean tensor has any False (XLA has no device-side assert; the
+        eager check IS the reference CPU behavior)."""
+        import numpy as _onp
+
+        ok = bool(_onp.asarray(jnp.all(data)))
+        if not ok:
+            raise MXNetError(str(msg))
+        return jnp.asarray(True)
+
+    alias("constraint_check", "_npx_constraint_check")
+
+    @register("index_update")
+    def index_update(data, indices, updates):
+        """Functional x[idx] = updates (npx.index_update,
+        np_index_update.cc)."""
+        idx = tuple(indices.astype(jnp.int32).T) \
+            if indices.ndim > 1 else (indices.astype(jnp.int32),)
+        return data.at[idx].set(updates.astype(data.dtype))
+
+    @register("categorical", differentiable=False)
+    def categorical(logits, shape=None):
+        """Sample class ids from (batched) logits — npx.random.categorical
+        (np_random ops)."""
+        from .. import random as _random
+
+        out_shape = None if shape is None else (
+            (shape,) if isinstance(shape, int) else tuple(shape))
+        return jax.random.categorical(_random.take_key(), logits, axis=-1,
+                                      shape=out_shape)
+
+    @register("_sample_unique_zipfian", differentiable=False,
+              num_outputs=2)
+    def _sample_unique_zipfian(range_max=1, shape=(1,)):
+        """Unique zipfian draws + expected-count outputs
+        (random/unique_sample_op.cc — the sampled-softmax helper).
+        Deduplication is per row; counts follow the log-uniform class
+        distribution the reference uses."""
+        import numpy as _onp
+
+        from .. import random as _random
+
+        shp = (shape,) if isinstance(shape, int) else tuple(shape)
+        n_rows = 1 if len(shp) == 1 else int(shp[0])
+        n = int(shp[-1])
+        key = _onp.asarray(_random.take_key())
+        rs = _onp.random.default_rng(int(key[0]) << 32 | int(key[-1]))
+        rows, counts = [], []
+        log_range = _onp.log(range_max + 1.0)
+        for _r in range(n_rows):
+            seen, out = {}, []
+            num_tries = 0
+            while len(out) < n:
+                num_tries += 1
+                v = int(_onp.exp(rs.random() * log_range)) - 1
+                v = min(max(v, 0), range_max - 1)
+                if v not in seen:
+                    seen[v] = True
+                    out.append(v)
+            rows.append(out)
+            # expected count per sampled class given num_tries draws
+            p = [-_onp.expm1(num_tries * _onp.log1p(
+                -_onp.log1p(1.0 / (c + 1.0)) / log_range)) for c in out]
+            counts.append(p)
+        samples = _onp.asarray(rows, dtype=_onp.int64).reshape(shp)
+        cnt = _onp.asarray(counts, dtype=_onp.float32).reshape(shp)
+        return jnp.asarray(samples), jnp.asarray(cnt)
+
+
+# ---------------------------------------------------------------------------
+# 7. optimizer tail (contrib/optimizer_op.cc, adamw.cc, multi_lamb.cc)
+# ---------------------------------------------------------------------------
+def _install_optimizer_tail():
+    @register("group_adagrad_update", differentiable=False, mutates=(2,))
+    def group_adagrad_update(weight, grad, history, lr, rescale_grad=1.0,
+                             clip_gradient=-1.0, epsilon=1e-5):
+        """Group AdaGrad (contrib/optimizer_op.cc GroupAdagradUpdate):
+        history accumulates the MEAN square over the trailing dims per
+        row."""
+        g = grad * rescale_grad
+        if clip_gradient is not None and clip_gradient >= 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        axes = tuple(range(1, g.ndim))
+        new_h = history + (jnp.mean(jnp.square(g), axis=axes, keepdims=True)
+                           if axes else jnp.square(g))
+        new_w = weight - lr * g / (jnp.sqrt(new_h) + epsilon)
+        return new_w, new_h
+
+    alias("_contrib_group_adagrad_update", "group_adagrad_update")
+
+    @register("_sparse_adagrad_update", differentiable=False, mutates=(2,))
+    def _sparse_adagrad_update(weight, grad, history, lr, epsilon=1e-7,
+                               wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+        """AdaGrad with the reference's sparse-update semantics rendered
+        dense: rows with all-zero grad keep weight AND history untouched
+        (optimizer_op.cc AdagradUpdateEx row_sparse path)."""
+        g = grad * rescale_grad
+        if clip_gradient is not None and clip_gradient >= 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        live = jnp.any(g != 0, axis=tuple(range(1, g.ndim)), keepdims=True) \
+            if g.ndim > 1 else (g != 0)
+        new_h = jnp.where(live, history + jnp.square(g), history)
+        step = lr * g / (jnp.sqrt(new_h) + epsilon)
+        new_w = jnp.where(live, weight * (1.0 - lr * wd) - step, weight)
+        return new_w, new_h
+
+    from .contrib_tail import _multi_lamb_fn, _multi_lans_fn
+
+    def _mp_multi(base_fn, stride=5):
+        """mp variants interleave (w, g, mean, var, weight32); math runs on
+        weight32, output weight re-cast (multi_lamb.cc MP path)."""
+        def fn(*arrays, **attrs):
+            n = len(arrays) // stride
+            slim, w32s, orig = [], [], []
+            for i in range(n):
+                w, g, m, v, w32 = arrays[i * stride:(i + 1) * stride]
+                slim.extend([w32, g, m, v])
+                w32s.append(w32)
+                orig.append(w)
+            attrs.pop("num_tensors", None)
+            outs = base_fn(*slim, num_tensors=n, **attrs)
+            new_w32 = outs[:n]
+            states = outs[n:]
+            final = [nw.astype(orig[i].dtype) for i, nw in
+                     enumerate(new_w32)]
+            return tuple(final) + tuple(states) + tuple(new_w32)
+
+        return fn
+
+    def _mp_meta(stride=5):
+        def num_outputs(attrs):
+            return int(attrs["num_tensors"])
+
+        def mutates(attrs):
+            n = int(attrs["num_tensors"])
+            pos = []
+            for i in range(n):
+                pos.extend([i * stride + 2, i * stride + 3])
+            for i in range(n):
+                pos.append(i * stride + 4)
+            return pos
+
+        return num_outputs, mutates
+
+    _no, _mut = _mp_meta()
+    f = _mp_multi(_multi_lamb_fn)
+    f.__name__ = "_multi_mp_lamb_update"
+    register("_multi_mp_lamb_update", differentiable=False,
+             num_outputs=_no, mutates=_mut)(f)
+    f2 = _mp_multi(_multi_lans_fn)
+    f2.__name__ = "_multi_mp_lans_update"
+    register("_multi_mp_lans_update", differentiable=False,
+             num_outputs=_no, mutates=_mut)(f2)
+
+    from .contrib_tail import _adamw_math
+
+    def _multi_adamw(*arrays, lrs=None, wds=None, etas=None, beta1=0.9,
+                     beta2=0.999, epsilon=1e-8, clip_gradient=-1.0,
+                     step_count=None, num_tensors=None, mp=False):
+        """Fused multi-tensor AdamW (adamw.cc _multi_adamw_update:143):
+        trailing input is the shared rescale_grad TENSOR — non-finite
+        scale skips the whole update."""
+        stride = 5 if mp else 4
+        rescale = arrays[-1]
+        arrays = arrays[:-1]
+        n = len(arrays) // stride
+        outs, states, w32outs = [], [], []
+        for i in range(n):
+            grp = arrays[i * stride:(i + 1) * stride]
+            if mp:
+                w, g, m, v, w32 = grp
+            else:
+                w, g, m, v = grp
+                w32 = w.astype(jnp.float32)
+            nw32, nm, nv = _adamw_math(
+                w32, g.astype(jnp.float32), m, v, rescale, lrs[i], etas[i],
+                beta1, beta2, epsilon, wds[i], clip_gradient)
+            outs.append(nw32.astype(w.dtype))
+            states.extend([nm, nv])
+            if mp:
+                w32outs.append(nw32)
+        return tuple(outs) + tuple(states) + tuple(w32outs)
+
+    def _adamw_meta(stride):
+        def num_outputs(attrs):
+            return int(attrs["num_tensors"])
+
+        def mutates(attrs):
+            n = int(attrs["num_tensors"])
+            pos = []
+            for i in range(n):
+                pos.extend([i * stride + 2, i * stride + 3])
+            if stride == 5:
+                for i in range(n):
+                    pos.append(i * stride + 4)
+            return pos
+
+        return num_outputs, mutates
+
+    _no4, _mut4 = _adamw_meta(4)
+    g4 = lambda *a, **kw: _multi_adamw(*a, mp=False, **kw)  # noqa: E731
+    g4.__name__ = "_multi_adamw_update"
+    register("_multi_adamw_update", differentiable=False,
+             num_outputs=_no4, mutates=_mut4)(g4)
+    _no5, _mut5 = _adamw_meta(5)
+    g5 = lambda *a, **kw: _multi_adamw(*a, mp=True, **kw)  # noqa: E731
+    g5.__name__ = "_multi_mp_adamw_update"
+    register("_multi_mp_adamw_update", differentiable=False,
+             num_outputs=_no5, mutates=_mut5)(g5)
+
+
+# ---------------------------------------------------------------------------
+# 8. quantized-op tail (quantization/*.cc)
+# ---------------------------------------------------------------------------
+def _install_quantized_tail():
+    def _rng_of(q, mn, mx):
+        return mn, mx
+
+    @register("quantized_pooling", differentiable=False, num_outputs=3)
+    def quantized_pooling(data, min_range, max_range, kernel=(2, 2),
+                          stride=None, pad=(0, 0), pool_type="max",
+                          **kw):
+        """int8 pooling straight on quantized values (quantized_pooling.cc
+        — order-preserving, range passes through)."""
+        from .nn import pooling
+
+        out = pooling.fn(data.astype(jnp.float32), kernel=kernel,
+                         stride=stride, pad=pad, pool_type=pool_type, **kw)
+        out = jnp.clip(jnp.round(out), -127, 127).astype(data.dtype)
+        return out, min_range, max_range
+
+    @register("quantized_act", differentiable=False, num_outputs=3)
+    def quantized_act(data, min_range, max_range, act_type="relu"):
+        """int8 relu (quantized_activation.cc — relu only there too)."""
+        if act_type != "relu":
+            raise MXNetError("quantized_act supports relu only (reference "
+                             "quantized_activation.cc)")
+        out = jnp.maximum(data, 0)
+        return out, jnp.maximum(jnp.asarray(min_range, jnp.float32), 0.0), \
+            max_range
+
+    @register("quantized_flatten", differentiable=False, num_outputs=3)
+    def quantized_flatten(data, min_range, max_range):
+        return data.reshape(data.shape[0], -1), min_range, max_range
+
+    @register("quantized_concat", differentiable=False, num_outputs=3)
+    def quantized_concat(*args, num_args=None, dim=1):
+        """Concat int8 inputs after rescaling to the widest range
+        (quantized_concat.cc)."""
+        n = len(args) // 3
+        datas, mins, maxs = args[:n], args[n:2 * n], args[2 * n:]
+        out_min = jnp.minimum(*mins) if n > 1 else mins[0]
+        out_max = jnp.maximum(*maxs) if n > 1 else maxs[0]
+        out_amax = jnp.maximum(jnp.abs(out_min), jnp.abs(out_max))
+        parts = []
+        for d, mn, mx in zip(datas, mins, maxs):
+            amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+            parts.append(jnp.clip(jnp.round(
+                d.astype(jnp.float32) * (amax / out_amax)), -127, 127))
+        return (jnp.concatenate(parts, axis=dim).astype(datas[0].dtype),
+                out_min, out_max)
+
+    @register("quantized_elemwise_add", differentiable=False, num_outputs=3)
+    def quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min,
+                               rhs_max):
+        """int8 add via f32 accumulate + requantize to the summed range
+        (quantized_elemwise_add.cc)."""
+        ls = jnp.maximum(jnp.abs(lhs_min), jnp.abs(lhs_max)) / 127.0
+        rs = jnp.maximum(jnp.abs(rhs_min), jnp.abs(rhs_max)) / 127.0
+        f = lhs.astype(jnp.float32) * ls + rhs.astype(jnp.float32) * rs
+        out_amax = jnp.maximum(jnp.abs(lhs_min) + jnp.abs(rhs_min),
+                               jnp.abs(lhs_max) + jnp.abs(rhs_max))
+        q = jnp.clip(jnp.round(f * (127.0 / out_amax)), -127, 127)
+        return q.astype(lhs.dtype), -out_amax, out_amax
+
+    @register("quantized_elemwise_mul", differentiable=False, num_outputs=3)
+    def quantized_elemwise_mul(lhs, rhs, lhs_min, lhs_max, rhs_min,
+                               rhs_max):
+        ls = jnp.maximum(jnp.abs(lhs_min), jnp.abs(lhs_max)) / 127.0
+        rs = jnp.maximum(jnp.abs(rhs_min), jnp.abs(rhs_max)) / 127.0
+        f = (lhs.astype(jnp.float32) * ls) * (rhs.astype(jnp.float32) * rs)
+        out_amax = (jnp.maximum(jnp.abs(lhs_min), jnp.abs(lhs_max))
+                    * jnp.maximum(jnp.abs(rhs_min), jnp.abs(rhs_max)))
+        out_amax = jnp.maximum(out_amax, 1e-12)
+        q = jnp.clip(jnp.round(f * (127.0 / out_amax)), -127, 127)
+        return q.astype(lhs.dtype), -out_amax, out_amax
+
+    @register("quantized_embedding", differentiable=False, num_outputs=3)
+    def quantized_embedding(data, weight_q, w_min, w_max, input_dim=None,
+                            output_dim=None):
+        """int8 embedding gather (quantized_indexing_op.cc)."""
+        return weight_q[data.astype(jnp.int32)], w_min, w_max
+
+    @register("quantized_batch_norm", differentiable=False, num_outputs=3)
+    def quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                             d_min, d_max, eps=1e-3, **_kw):
+        """int8 BN folded to a per-channel affine then requantized
+        (quantized_batch_norm.cc — inference only)."""
+        scale_in = jnp.maximum(jnp.abs(d_min), jnp.abs(d_max)) / 127.0
+        x = data.astype(jnp.float32) * scale_in
+        inv = gamma / jnp.sqrt(moving_var + eps)
+        shape = (1, -1) + (1,) * (data.ndim - 2)
+        y = (x - moving_mean.reshape(shape)) * inv.reshape(shape) \
+            + beta.reshape(shape)
+        amax = jnp.maximum(jnp.max(jnp.abs(y)), 1e-12)
+        q = jnp.clip(jnp.round(y * (127.0 / amax)), -127, 127)
+        return q.astype(data.dtype), -amax, amax
+
+    for ref, ours in {
+            "_contrib_quantize": "quantize",
+            "_contrib_quantize_v2": "quantize_v2",
+            "_contrib_dequantize": "dequantize",
+            "_contrib_requantize": "requantize",
+            "_contrib_quantized_conv": "quantized_conv",
+            "_contrib_quantized_fully_connected":
+                "quantized_fully_connected",
+            "_contrib_quantized_pooling": "quantized_pooling",
+            "_contrib_quantized_act": "quantized_act",
+            "_contrib_quantized_flatten": "quantized_flatten",
+            "_contrib_quantized_concat": "quantized_concat",
+            "_contrib_quantized_elemwise_add": "quantized_elemwise_add",
+            "_contrib_quantized_elemwise_mul": "quantized_elemwise_mul",
+            "_contrib_quantized_embedding": "quantized_embedding",
+            "_contrib_quantized_batch_norm": "quantized_batch_norm",
+    }.items():
+        try:
+            get_op(ref)
+        except MXNetError:
+            alias(ref, ours)
+
+    @register("_contrib_calibrate_entropy", differentiable=False,
+              num_outputs=2)
+    def _contrib_calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
+        """KL-divergence threshold search over a histogram
+        (quantization/calibrate.cc) — delegates to the python calibrator
+        which implements the same search."""
+        import numpy as _onp
+
+        from ..contrib.quantization import calib_entropy_threshold
+
+        h = _onp.asarray(hist)
+        e = _onp.asarray(hist_edges)
+        thr = calib_entropy_threshold(h, e, int(num_quantized_bins))
+        return (jnp.asarray(-thr, jnp.float32),
+                jnp.asarray(thr, jnp.float32))
+
+
+def _install_misc_tail():
+    bn = get_op("BatchNorm")
+
+    @register("_contrib_BatchNormWithReLU",
+              num_outputs=lambda attrs: 1 if not attrs.get(
+                  "output_mean_var") else 3)
+    def _contrib_BatchNormWithReLU(data, gamma, beta, moving_mean,
+                                   moving_var, **attrs):
+        """BN + fused ReLU (contrib/batch_norm_relu.cc).  XLA fuses the
+        max into the normalization epilogue on its own; the op exists for
+        name parity with imported 1.x graphs."""
+        out = bn.fn(data, gamma, beta, moving_mean, moving_var, **attrs)
+        if isinstance(out, tuple):
+            return (jnp.maximum(out[0], 0),) + out[1:]
+        return jnp.maximum(out, 0)
+
+    @register("_npi_boolean_mask_assign_scalar")
+    def _npi_boolean_mask_assign_scalar(data, mask, value=0.0):
+        """data[mask] = scalar, functional (np_boolean_mask_assign.cc)."""
+        m = mask.astype(bool)
+        m = m.reshape(m.shape + (1,) * (data.ndim - m.ndim))
+        return jnp.where(m, jnp.asarray(value, data.dtype), data)
+
+    @register("_npi_boolean_mask_assign_tensor")
+    def _npi_boolean_mask_assign_tensor(data, mask, value):
+        """data[mask] = tensor broadcast against the masked region.  The
+        general gather-shaped rhs needs a concrete mask (eager), matching
+        the reference's dynamic-shape dispatch; the broadcastable case
+        stays traceable."""
+        m = mask.astype(bool)
+        m = m.reshape(m.shape + (1,) * (data.ndim - m.ndim))
+        try:
+            return jnp.where(m, value.astype(data.dtype), data)
+        except (TypeError, ValueError):
+            import numpy as _onp
+
+            host = _onp.asarray(data).copy()
+            host[_onp.asarray(m).reshape(mask.shape)] = _onp.asarray(value)
+            return jnp.asarray(host)
+
+    @register("cast_storage", differentiable=False)
+    def cast_storage(data, stype="default"):
+        """Dense-side cast_storage (cast_storage.cc): on the registry path
+        (dense jax arrays) every stype is stored dense, so this is the
+        identity; real sparse handles convert via
+        ndarray.sparse.cast_storage / .tostype (FComputeEx equivalent)."""
+        return data
+
+    @register("_sparse_retain", differentiable=False)
+    def _sparse_retain(data, indices):
+        """Dense rendering of sparse_retain (sparse_retain.cc): keep the
+        given rows, zero the rest.  RowSparseNDArray handles route through
+        ndarray.sparse (RowSparseNDArray.retain) instead."""
+        keep = jnp.zeros((data.shape[0],), bool).at[
+            indices.astype(jnp.int32)].set(True)
+        return jnp.where(keep.reshape((-1,) + (1,) * (data.ndim - 1)),
+                         data, jnp.zeros_like(data))
+
+    for like in ["exponential", "gamma", "poisson", "negative_binomial",
+                 "generalized_negative_binomial"]:
+        alias("_random_%s_like" % like, "random_%s_like" % like)
+    alias("_contrib_MultiBoxTarget", "multibox_target")
+    alias("_contrib_RROIAlign", "rroi_align")
+
+
+_install_aliases()
+_install_scalar_family()
+_install_init_ops()
+_install_pdf_family()
+_install_assign_family()
+_install_misc()
+_install_optimizer_tail()
+_install_quantized_tail()
+_install_misc_tail()

@@ -195,3 +195,30 @@ def random_normal_like(data, loc=0.0, scale=1.0):
 def shuffle(data):
     """Random permutation along axis 0 [shuffle_op.cc:128 _shuffle]."""
     return jax.random.permutation(_key(), data, axis=0)
+
+
+@register("random_exponential_like", differentiable=False)
+def random_exponential_like(data, lam=1.0):
+    return jax.random.exponential(_key(), data.shape, data.dtype) / lam
+
+
+@register("random_gamma_like", differentiable=False)
+def random_gamma_like(data, alpha=1.0, beta=1.0):
+    return jax.random.gamma(_key(), alpha, data.shape, data.dtype) * beta
+
+
+@register("random_poisson_like", differentiable=False)
+def random_poisson_like(data, lam=1.0):
+    return jax.random.poisson(_key(), lam, data.shape).astype(data.dtype)
+
+
+@register("random_negative_binomial_like", differentiable=False)
+def random_negative_binomial_like(data, k=1, p=1.0):
+    lam = jax.random.gamma(_key(), float(k), data.shape) * (1 - p) / p
+    return jax.random.poisson(_key(), lam, data.shape).astype(data.dtype)
+
+
+@register("random_generalized_negative_binomial_like", differentiable=False)
+def random_generalized_negative_binomial_like(data, mu=1.0, alpha=1.0):
+    lam = jax.random.gamma(_key(), 1.0 / alpha, data.shape) * alpha * mu
+    return jax.random.poisson(_key(), lam, data.shape).astype(data.dtype)

@@ -1,0 +1,395 @@
+"""Tests for the reference-name parity tail (mxnet_tpu/ops/parity.py).
+
+Oracles: scipy.stats for the pdf family (random/pdf_op.cc), numpy
+reference math for scalar/assign families, structural invariants for
+multibox_target (multibox_target.cc) and the quantized tail.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.ops.registry import get_op
+
+st = pytest.importorskip("scipy.stats")
+
+
+def _a(x, dt=np.float32):
+    return nd.array(np.asarray(x, dt))
+
+
+class TestPdfFamily:
+    def test_uniform(self):
+        out = get_op("_random_pdf_uniform")(
+            _a([[1.0, 2.0, 3.0, 4.0]]), _a([0.0]), _a([10.0])).asnumpy()
+        np.testing.assert_allclose(out, [[0.1] * 4], rtol=1e-6)
+
+    def test_normal_and_log(self):
+        s = _a([[0.5, -1.5]])
+        mu, sig = _a([0.5]), _a([2.0])
+        pdf = get_op("_random_pdf_normal")(s, mu, sig).asnumpy()
+        np.testing.assert_allclose(
+            pdf, st.norm.pdf([[0.5, -1.5]], loc=0.5, scale=2.0), rtol=1e-5)
+        lpdf = get_op("_random_pdf_normal")(s, mu, sig,
+                                            is_log=True).asnumpy()
+        np.testing.assert_allclose(lpdf, np.log(pdf), rtol=1e-5)
+
+    def test_gamma_rate_parameterization(self):
+        # reference PDF_Gamma: a*log(b) + (a-1)log x - b*x - lgamma(a)
+        # i.e. beta is a RATE (pdf_op.h:121)
+        out = get_op("_random_pdf_gamma")(
+            _a([[0.5, 1.5]]), _a([2.0]), _a([3.0])).asnumpy()
+        np.testing.assert_allclose(
+            out, st.gamma.pdf([[0.5, 1.5]], a=2.0, scale=1 / 3.0),
+            rtol=1e-5)
+
+    def test_exponential_poisson(self):
+        out = get_op("_random_pdf_exponential")(
+            _a([[0.5, 2.0]]), _a([1.5])).asnumpy()
+        np.testing.assert_allclose(out, st.expon.pdf([[0.5, 2.0]],
+                                                     scale=1 / 1.5),
+                                   rtol=1e-5)
+        outp = get_op("_random_pdf_poisson")(
+            _a([[0.0, 2.0, 5.0]]), _a([3.0])).asnumpy()
+        np.testing.assert_allclose(outp, st.poisson.pmf([[0, 2, 5]], 3.0),
+                                   rtol=1e-5)
+
+    def test_negative_binomial_failure_prob(self):
+        # reference p is the FAILURE probability (pdf_op.h:246)
+        k, p = 4.0, 0.3
+        xs = np.array([[0.0, 2.0, 7.0]])
+        out = get_op("_random_pdf_negative_binomial")(
+            _a(xs), _a([k]), _a([p])).asnumpy()
+        want = st.nbinom.pmf(xs, k, p)
+        np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    def test_generalized_negative_binomial(self):
+        mu, alpha = 2.5, 0.5
+        xs = np.array([[0.0, 1.0, 4.0]])
+        out = get_op("_random_pdf_generalized_negative_binomial")(
+            _a(xs), _a([mu]), _a([alpha])).asnumpy()
+        l = 1.0 / alpha
+        p = 1.0 / (mu * alpha + 1.0)
+        np.testing.assert_allclose(out, st.nbinom.pmf(xs, l, p), rtol=1e-5)
+
+    def test_dirichlet(self):
+        out = get_op("_random_pdf_dirichlet")(
+            _a([[0.2, 0.3, 0.5]]), _a([[2.0, 3.0, 4.0]])).asnumpy()
+        np.testing.assert_allclose(
+            out, st.dirichlet.pdf([0.2, 0.3, 0.5], [2, 3, 4]), rtol=1e-5)
+
+    def test_pdf_gradient_flows(self):
+        s = _a([[0.5, 1.5]])
+        mu = _a([0.1])
+        sig = _a([1.2])
+        mu.attach_grad(), sig.attach_grad()
+        with autograd.record():
+            L = nd.sum(get_op("_random_pdf_normal")(s, mu, sig, is_log=True))
+        L.backward()
+        # d/dmu sum(lpdf) = sum((x-mu)/sig^2)
+        want = np.sum((np.array([0.5, 1.5]) - 0.1) / 1.2 ** 2)
+        np.testing.assert_allclose(mu.grad.asnumpy(), [want], rtol=1e-4)
+
+
+class TestScalarFamily:
+    def test_arith(self):
+        x = _a([1.0, 2.0, 3.0])
+        np.testing.assert_allclose(
+            get_op("_rminus_scalar")(x, scalar=10.0).asnumpy(),
+            [9.0, 8.0, 7.0])
+        np.testing.assert_allclose(
+            get_op("_rdiv_scalar")(x, scalar=6.0).asnumpy(),
+            [6.0, 3.0, 2.0])
+        np.testing.assert_allclose(
+            get_op("_rpower_scalar")(x, scalar=2.0).asnumpy(),
+            [2.0, 4.0, 8.0])
+
+    def test_camelcase_aliases_resolve(self):
+        x = _a([1.0, -2.0])
+        np.testing.assert_allclose(
+            get_op("_PlusScalar")(x, scalar=1.0).asnumpy(), [2.0, -1.0])
+        np.testing.assert_allclose(
+            get_op("_GreaterScalar")(x, scalar=0.0).asnumpy(), [1.0, 0.0])
+
+    def test_legacy_binary_aliases(self):
+        x, y = _a([1.0, 2.0]), _a([3.0, 5.0])
+        np.testing.assert_allclose(get_op("_Mul")(x, y).asnumpy(),
+                                   [3.0, 10.0])
+        np.testing.assert_allclose(
+            get_op("broadcast_plus")(x, y).asnumpy(), [4.0, 7.0])
+        np.testing.assert_allclose(get_op("max_axis")(
+            _a([[1.0, 9.0], [3.0, 4.0]]), axis=1).asnumpy(), [9.0, 4.0])
+
+
+class TestAssignFamily:
+    def test_slice_assign(self):
+        lhs = _a(np.zeros((3, 4)))
+        rhs = _a(np.ones((2, 2)))
+        out = get_op("_slice_assign")(lhs, rhs, begin=(1, 1),
+                                      end=(3, 3)).asnumpy()
+        want = np.zeros((3, 4), np.float32)
+        want[1:3, 1:3] = 1
+        np.testing.assert_allclose(out, want)
+        # _crop_assign is the 0.x alias
+        out2 = get_op("_crop_assign")(lhs, rhs, begin=(1, 1),
+                                      end=(3, 3)).asnumpy()
+        np.testing.assert_allclose(out2, want)
+
+    def test_scatter_set_nd(self):
+        lhs = _a(np.zeros((2, 3)))
+        idx = _a([[0, 1], [2, 0]], np.int32)
+        rhs = _a([5.0, 7.0])
+        out = get_op("_scatter_set_nd")(lhs, rhs, idx).asnumpy()
+        assert out[0, 2] == 5.0 and out[1, 0] == 7.0
+
+    def test_split_v2(self):
+        x = _a(np.arange(10).reshape(5, 2))
+        parts = get_op("split_v2")(x, indices=(2, 3), axis=0)
+        assert [p.shape for p in parts] == [(2, 2), (1, 2), (2, 2)]
+        parts = get_op("split_v2")(x, sections=5, axis=0,
+                                   squeeze_axis=True)
+        assert parts[0].shape == (2,)
+
+    def test_broadcast_axis(self):
+        x = _a(np.arange(3).reshape(1, 3, 1))
+        out = get_op("broadcast_axis")(x, axis=(0, 2), size=(2, 4))
+        assert out.shape == (2, 3, 4)
+        out2 = get_op("broadcast_axes")(x, axis=0, size=4)
+        assert out2.shape == (4, 3, 1)
+
+    def test_boolean_mask_assign(self):
+        x = _a([[1.0, 2.0], [3.0, 4.0]])
+        m = _a([[1, 0], [0, 1]])
+        out = get_op("_npi_boolean_mask_assign_scalar")(
+            x, m, value=9.0).asnumpy()
+        np.testing.assert_allclose(out, [[9.0, 2.0], [3.0, 9.0]])
+
+
+class TestMiscTail:
+    def test_make_loss_grad_is_ones(self):
+        x = _a([1.0, 2.0])
+        x.attach_grad()
+        with autograd.record():
+            y = get_op("make_loss")(x * 3.0)
+            L = y.sum()
+        L.backward()
+        # make_loss seeds ones through itself: dL/dx = 3 * 1
+        np.testing.assert_allclose(x.grad.asnumpy(), [3.0, 3.0])
+
+    def test_gradient_multiplier(self):
+        x = _a([1.0, 2.0])
+        x.attach_grad()
+        with autograd.record():
+            L = get_op("_contrib_gradientmultiplier")(x, scalar=-0.5).sum()
+        L.backward()
+        np.testing.assert_allclose(x.grad.asnumpy(), [-0.5, -0.5])
+
+    def test_round_ste(self):
+        x = _a([0.4, 1.6])
+        x.attach_grad()
+        with autograd.record():
+            y = get_op("_contrib_round_ste")(x)
+            L = (y * y).sum()
+        L.backward()
+        np.testing.assert_allclose(y.asnumpy(), [0.0, 2.0])
+        # straight-through: dL/dx = 2*round(x)
+        np.testing.assert_allclose(x.grad.asnumpy(), [0.0, 4.0])
+
+    def test_quadratic_and_allclose(self):
+        x = _a([1.0, 2.0])
+        out = get_op("quadratic")(x, a=1.0, b=2.0, c=3.0).asnumpy()
+        np.testing.assert_allclose(out, [6.0, 11.0])
+        ok = get_op("allclose")(x, x).asnumpy()
+        assert ok == 1.0
+
+    def test_constraint_check(self):
+        from mxnet_tpu.base import MXNetError
+
+        assert bool(get_op("constraint_check")(
+            _a([1, 1], np.int32)).asnumpy())
+        with pytest.raises(MXNetError):
+            get_op("constraint_check")(_a([1, 0], np.int32), msg="bad")
+
+    def test_init_ops(self):
+        assert get_op("_zeros")(shape=(2, 3)).shape == (2, 3)
+        out = get_op("_arange")(start=0, stop=3, repeat=2).asnumpy()
+        np.testing.assert_allclose(out, [0, 0, 1, 1, 2, 2])
+        assert get_op("_eye")(N=3).asnumpy()[1, 1] == 1.0
+
+    def test_identity_like_rhs_and_square_sum(self):
+        x = _a([[1.0, 2.0], [3.0, 4.0]])
+        np.testing.assert_allclose(
+            get_op("_identity_with_attr_like_rhs")(x, x).asnumpy(),
+            x.asnumpy())
+        np.testing.assert_allclose(
+            get_op("_square_sum")(x, axis=1).asnumpy(), [5.0, 25.0])
+
+    def test_sparse_retain_dense(self):
+        x = _a(np.arange(12).reshape(4, 3))
+        out = get_op("_sparse_retain")(x, _a([0, 2], np.int32)).asnumpy()
+        assert out[0].sum() > 0 and out[2].sum() > 0
+        assert out[1].sum() == 0 and out[3].sum() == 0
+
+    def test_unique_zipfian(self):
+        mx.random.seed(3)
+        samples, counts = get_op("_sample_unique_zipfian")(
+            range_max=1000, shape=(2, 16))
+        s = samples.asnumpy()
+        assert s.shape == (2, 16)
+        for row in s:
+            assert len(set(row.tolist())) == 16
+            assert row.min() >= 0 and row.max() < 1000
+        assert (counts.asnumpy() > 0).all()
+
+
+class TestOptimizerTail:
+    def test_group_adagrad(self):
+        w = _a(np.ones((3, 2)))
+        g = _a(np.full((3, 2), 0.5))
+        h = _a(np.zeros((3, 1)))
+        out = get_op("group_adagrad_update")(w, g, h, lr=0.1).asnumpy()
+        # h row = mean(g^2) = 0.25 -> step = 0.1*0.5/sqrt(0.25)
+        np.testing.assert_allclose(out, 1.0 - 0.1 * 0.5 / 0.5, rtol=1e-4)
+
+    def test_sparse_adagrad_skips_zero_rows(self):
+        w = _a(np.ones((3, 2)))
+        g = _a(np.array([[0.5, 0.5], [0.0, 0.0], [1.0, 1.0]]))
+        h = _a(np.zeros((3, 2)))
+        out = get_op("_sparse_adagrad_update")(w, g, h, lr=0.1).asnumpy()
+        assert (out[1] == 1.0).all()            # untouched row
+        assert (out[0] != 1.0).all() and (out[2] != 1.0).all()
+        assert (h.asnumpy()[1] == 0.0).all()    # history untouched too
+
+    def test_multi_mp_lamb_shapes(self):
+        n = 2
+        arrays = []
+        rs = np.random.RandomState(0)
+        origs = []
+        for _ in range(n):
+            w16 = rs.rand(4, 3).astype(np.float16)
+            g = rs.rand(4, 3).astype(np.float16)
+            m = np.zeros((4, 3), np.float32)
+            v = np.zeros((4, 3), np.float32)
+            w32 = w16.astype(np.float32)
+            origs.append(w16)
+            arrays += [_a(w16, np.float16), _a(g, np.float16),
+                       _a(m), _a(v), _a(w32)]
+        outs = get_op("_multi_mp_lamb_update")(
+            *arrays, learning_rates=(0.01, 0.01), wds=(0.0, 0.0),
+            step_count=(1, 1), num_tensors=n)
+        assert len(outs) == n
+        for i, o in enumerate(outs):
+            assert o.asnumpy().dtype == np.float16
+            assert not np.allclose(o.asnumpy(), origs[i])
+        # states mutated in place: mean/var and weight32
+        assert not np.allclose(arrays[2].asnumpy(), 0.0)
+        assert not np.allclose(arrays[4].asnumpy(),
+                               origs[0].astype(np.float32))
+
+    def test_multi_adamw_rescale_tensor_gate(self):
+        w = _a(np.ones((2, 2)))
+        g = _a(np.full((2, 2), 0.1))
+        m = _a(np.zeros((2, 2)))
+        v = _a(np.zeros((2, 2)))
+        nanscale = _a([np.nan])
+        out = get_op("_multi_adamw_update")(
+            w, g, m, v, nanscale, lrs=(0.01,), wds=(0.0,), etas=(1.0,),
+            num_tensors=1)
+        np.testing.assert_allclose(out.asnumpy(), 1.0)  # update skipped
+
+
+class TestQuantizedTail:
+    def test_quantized_pooling_and_flatten(self):
+        q = _a(np.arange(-8, 8).reshape(1, 1, 4, 4), np.int8)
+        mn, mx_ = _a(-1.0), _a(1.0)
+        out, omn, omx = get_op("quantized_pooling")(
+            q, mn, mx_, kernel=(2, 2), stride=(2, 2))
+        assert out.asnumpy().dtype == np.int8
+        assert out.shape == (1, 1, 2, 2)
+        np.testing.assert_allclose(out.asnumpy().ravel(), [-3, -1, 5, 7])
+        f, _, _ = get_op("quantized_flatten")(q, mn, mx_)
+        assert f.shape == (1, 16)
+
+    def test_quantized_elemwise_add_range(self):
+        l = _a([100, -100], np.int8)
+        r = _a([100, -100], np.int8)
+        out, omn, omx = get_op("quantized_elemwise_add")(
+            l, r, _a(-1.0), _a(1.0), _a(-1.0), _a(1.0))
+        assert float(omx.asnumpy()) == pytest.approx(2.0)
+        np.testing.assert_allclose(out.asnumpy(), [100, -100])
+
+    def test_quantized_embedding(self):
+        wq = _a(np.arange(12).reshape(4, 3), np.int8)
+        out, _, _ = get_op("quantized_embedding")(
+            _a([1, 3], np.int32), wq, _a(-1.0), _a(1.0))
+        np.testing.assert_allclose(out.asnumpy(), [[3, 4, 5], [9, 10, 11]])
+
+
+class TestDetectionTail:
+    def test_multibox_target_basic(self):
+        # one anchor right on the gt, one far away
+        anchors = _a([[[0.1, 0.1, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9]]])
+        labels = _a([[[0.0, 0.1, 0.1, 0.4, 0.4]]])   # cls 0 at anchor 0
+        cls_preds = _a(np.zeros((1, 2, 2)))
+        loc_t, loc_m, cls_t = get_op("multibox_target")(
+            anchors, labels, cls_preds)
+        ct = cls_t.asnumpy()
+        assert ct.shape == (1, 2)
+        assert ct[0, 0] == 1.0            # cls 0 -> target 1 (0=background)
+        assert ct[0, 1] == 0.0            # far anchor -> background
+        lm = loc_m.asnumpy().reshape(1, 2, 4)
+        assert (lm[0, 0] == 1.0).all() and (lm[0, 1] == 0.0).all()
+        lt = loc_t.asnumpy().reshape(1, 2, 4)
+        np.testing.assert_allclose(lt[0, 0], 0.0, atol=1e-5)  # exact match
+
+    def test_multibox_target_hard_negative_mining(self):
+        anchors = _a([[[0.1, 0.1, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9],
+                       [0.0, 0.6, 0.3, 0.9], [0.6, 0.0, 0.9, 0.3]]])
+        labels = _a([[[1.0, 0.1, 0.1, 0.4, 0.4]]])
+        # anchor 2 has the LOWEST background confidence -> hardest negative
+        logits = np.zeros((1, 3, 4), np.float32)
+        logits[0, 0] = [5.0, 5.0, -5.0, 5.0]
+        loc_t, loc_m, cls_t = get_op("multibox_target")(
+            anchors, labels, _a(logits), negative_mining_ratio=1.0)
+        ct = cls_t.asnumpy()[0]
+        assert ct[0] == 2.0               # cls 1 -> target 2
+        assert ct[2] == 0.0               # mined negative
+        assert ct[1] == -1.0 and ct[3] == -1.0   # ignored
+
+    def test_rroi_align_axis_aligned_matches_crop(self):
+        x = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+        rois = _a([[0.0, 2.5, 2.5, 2.0, 2.0, 0.0]])  # axis-aligned 2x2
+        out = get_op("rroi_align")(_a(x), rois, pooled_size=(2, 2),
+                                   spatial_scale=1.0, sampling_ratio=1)
+        o = out.asnumpy()[0, 0]
+        assert o.shape == (2, 2)
+        assert o[1, 1] > o[0, 0]          # preserves spatial order
+
+
+class TestRandomTail:
+    def test_distribution_shapes_and_stats(self):
+        mx.random.seed(0)
+        for name, kw, check in [
+                ("laplace", {"loc": 0.0, "scale": 1.0},
+                 lambda v: abs(np.median(v)) < 0.2),
+                ("pareto", {"a": 3.0}, lambda v: (v >= 0).all()),
+                ("weibull", {"a": 2.0}, lambda v: (v >= 0).all()),
+                ("rayleigh", {"scale": 1.0}, lambda v: (v >= 0).all()),
+                ("gumbel", {"loc": 0.0, "scale": 1.0},
+                 lambda v: np.isfinite(v).all()),
+                ("logistic", {"loc": 0.0, "scale": 1.0},
+                 lambda v: abs(np.median(v)) < 0.25)]:
+            out = getattr(mx.random, name)(shape=(4000,), **kw).asnumpy()
+            assert out.shape == (4000,), name
+            assert check(out), name
+
+    def test_choice_and_categorical(self):
+        mx.random.seed(1)
+        out = mx.random.choice(5, size=(100,)).asnumpy()
+        assert out.min() >= 0 and out.max() < 5
+        p = np.array([0.0, 0.0, 1.0, 0.0, 0.0], np.float32)
+        out = mx.random.choice(5, size=(20,), p=_a(p)).asnumpy()
+        assert (out == 2).all()
+        logits = _a(np.log(np.array([[1e-9, 1.0]], np.float32)))
+        cat = mx.random.categorical(logits, shape=(50,)).asnumpy()
+        assert (cat == 1).all()

@@ -1,0 +1,140 @@
+"""Mechanically extract the reference's registered operator names.
+
+Produces the ground-truth op-name universe for OPS_PARITY.md:
+
+1. Direct ``NNVM_REGISTER_OP(concrete_name)`` registrations in ``src/**.cc``
+   (unique names; the raw grep count ~586 includes the same op registered in
+   several .cc files for different attrs).
+2. ``.add_alias("name")`` aliases.
+3. Token-pasting macro families (the only six paste patterns in the tree,
+   verified by grepping ``NNVM_REGISTER_OP([^)]*##``):
+   - ``_sample_##distr``      (multisample_op.cc MXNET_OPERATOR_REGISTER_SAMPLING)
+   - ``_random_pdf_##distr`` + ``_backward_pdf_##distr`` (pdf_op.cc)
+   - ``_npi_##name`` / ``_npi_##name##_scalar`` (np_elemwise_broadcast*_op.cc logic macros)
+   - ``_npi_atleast_##N##d`` (np_matrix_op.cc)
+
+Usage: python tools/extract_ref_ops.py /root/reference > /tmp/ref_ops.json
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+
+def _read_all_cc(root):
+    for dirpath, _dirs, files in os.walk(os.path.join(root, "src")):
+        for f in files:
+            if f.endswith((".cc", ".h")):
+                path = os.path.join(dirpath, f)
+                try:
+                    with open(path, errors="replace") as fh:
+                        yield path, fh.read()
+                except OSError:
+                    continue
+
+
+DIRECT = re.compile(r"NNVM_REGISTER_OP\(([A-Za-z0-9_]+)\)")
+ALIAS = re.compile(r'\.add_alias\("([^"]+)"\)')
+
+# Wrapper macros whose FIRST argument is the concrete registered op name
+# (their bodies do NNVM_REGISTER_OP(name), which the DIRECT regex only sees
+# as the literal placeholder 'name').  #define lines are skipped below.
+WRAPPER = re.compile(
+    r"^\s*(MXNET_OPERATOR_REGISTER_[A-Z_0-9]+|"
+    r"MXNET_MKL_OPERATOR_REGISTER_[A-Z_0-9]+)\s*\(\s*([A-Za-z0-9_]+)",
+    re.M)
+# wrapper families whose name is NOT the plain first argument — handled by
+# PASTE_MACROS instead
+PASTE_FAMILY = (
+    "MXNET_OPERATOR_REGISTER_SAMPLING",
+    "MXNET_OPERATOR_REGISTER_PDF",
+    "MXNET_OPERATOR_REGISTER_NP_BINARY_LOGIC",
+    "MXNET_OPERATOR_REGISTER_NP_BINARY_SCALAR_LOGIC",
+)
+
+# macro invocation -> final registered names (token-paste expansion)
+PASTE_MACROS = {
+    # MXNET_OPERATOR_REGISTER_SAMPLING{1,2}(distr, ...) -> _sample_<distr>
+    # (+ alias sample_<distr> emitted by the macro body)
+    re.compile(r"MXNET_OPERATOR_REGISTER_SAMPLING[12]?\(\s*([A-Za-z0-9_]+)"):
+        lambda m: [("_sample_" + m, None), ("sample_" + m, "_sample_" + m)],
+    # MXNET_OPERATOR_REGISTER_PDF{1,2}(distr, ...) -> _random_pdf_<distr>
+    # + _backward_pdf_<distr>
+    re.compile(r"MXNET_OPERATOR_REGISTER_PDF[12]\(\s*([A-Za-z0-9_]+)"):
+        lambda m: [("_random_pdf_" + m, None), ("_backward_pdf_" + m, None)],
+    # MXNET_OPERATOR_REGISTER_NP_BINARY_LOGIC(name) -> _npi_<name>
+    re.compile(
+        r"MXNET_OPERATOR_REGISTER_NP_BINARY_LOGIC\(\s*([A-Za-z0-9_]+)\)"):
+        lambda m: [("_npi_" + m, None)],
+    re.compile(
+        r"MXNET_OPERATOR_REGISTER_NP_BINARY_SCALAR_LOGIC\(\s*([A-Za-z0-9_]+)\)"):
+        lambda m: [("_npi_" + m + "_scalar", None)],
+    # NNVM_REGISTER_ATLEAST_ND(N) -> _npi_atleast_<N>d
+    re.compile(r"NNVM_REGISTER_ATLEAST_ND\(\s*([0-9]+)\s*\)"):
+        lambda m: [("_npi_atleast_" + m + "d", None)],
+}
+
+
+def extract(root):
+    ops = {}      # name -> {kind: direct|paste, files: [..]}
+    aliases = {}  # alias -> canonical (None if unknown from context)
+    for path, text in _read_all_cc(root):
+        rel = os.path.relpath(path, root)
+        for name in DIRECT.findall(text):
+            if name == "name":  # macro placeholder in #define bodies
+                continue
+            ops.setdefault(name, {"kind": "direct", "files": []})
+            if rel not in ops[name]["files"]:
+                ops[name]["files"].append(rel)
+        nodefine = "\n".join(ln for ln in text.splitlines()
+                             if not ln.lstrip().startswith("#define"))
+        for macro, name in WRAPPER.findall(nodefine):
+            if any(macro.startswith(p) for p in PASTE_FAMILY):
+                continue
+            if name in ("name", "distr", "N"):
+                continue
+            ops.setdefault(name, {"kind": "wrapper", "files": []})
+            if rel not in ops[name]["files"]:
+                ops[name]["files"].append(rel)
+        # .add_alias: attribute to the nearest preceding registration
+        for mreg in re.finditer(
+                r"NNVM_REGISTER_OP\(([A-Za-z0-9_]+)\)((?:\s*\.[^;]*?)*?);",
+                text, re.S):
+            canonical = mreg.group(1)
+            if canonical == "name":
+                continue
+            for al in ALIAS.findall(mreg.group(0)):
+                aliases[al] = canonical
+        for al in ALIAS.findall(text):
+            aliases.setdefault(al, None)
+        for pat, expand in PASTE_MACROS.items():
+            for m in pat.findall(text):
+                if m in ("distr", "name", "N"):
+                    continue
+                for new_name, alias_of in expand(m):
+                    if alias_of is None:
+                        ops.setdefault(new_name,
+                                       {"kind": "paste", "files": []})
+                        if rel not in ops[new_name]["files"]:
+                            ops[new_name]["files"].append(rel)
+                    else:
+                        aliases.setdefault(new_name, alias_of)
+    # aliases that shadow a real registration are registrations
+    aliases = {a: c for a, c in aliases.items() if a not in ops}
+    return ops, aliases
+
+
+def main():
+    root = sys.argv[1] if len(sys.argv) > 1 else "/root/reference"
+    ops, aliases = extract(root)
+    print(json.dumps({
+        "ops": {k: v for k, v in sorted(ops.items())},
+        "aliases": {k: v for k, v in sorted(aliases.items())},
+        "n_ops": len(ops), "n_aliases": len(aliases),
+    }, indent=1))
+
+
+if __name__ == "__main__":
+    main()
