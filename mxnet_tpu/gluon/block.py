@@ -686,6 +686,20 @@ class SymbolBlock(HybridBlock):
 
         with open(symbol_file) as f:
             manifest = json.load(f)
+        if "nodes" in manifest and "heads" in manifest:
+            # the INCUMBENT's model-symbol.json (nnvm graph json written by
+            # the reference HybridBlock.export, gluon/block.py:1300) —
+            # rebuild over this registry's ops (reference names supplied
+            # by ops/parity.py) and bind the reference .params binary
+            if isinstance(input_names, str):
+                input_names = [input_names]
+            from ..symbol import load_reference_json
+
+            sym_ = load_reference_json(manifest)
+            blk = _ReferenceGraphBlock(sym_, list(input_names))
+            if param_file:
+                blk._load_reference_params(param_file, ctx=ctx)
+            return blk
         if manifest.get("format") == "mxnet_tpu-hybrid-2" and \
                 "program" in manifest:
             from jax import export as jax_export
@@ -709,3 +723,64 @@ class SymbolBlock(HybridBlock):
         if param_file:
             block.load_parameters(param_file, ctx=ctx, allow_missing=False)
         return block
+
+
+class _ReferenceGraphBlock(HybridBlock):
+    """Runnable block over an imported REFERENCE nnvm graph.
+
+    Graph inputs that are not data inputs become Parameters (the
+    reference's arg/aux split: gluon/block.py:1500 SymbolBlock sets
+    non-input null nodes as parameters).  The whole graph evaluates as one
+    recorded op, so autograd/hybridize work like any other block.
+    """
+
+    def __init__(self, sym_, input_names):
+        super().__init__()
+        from .parameter import Parameter
+
+        self._sym = sym_
+        self._input_names = list(input_names)
+        free = [n for n in sym_.list_inputs()
+                if n not in self._input_names]
+        self._graph_param_names = free
+        for n in free:
+            self._reg_params[n] = Parameter(n, shape=None,
+                                            dtype="float32", init="zeros")
+
+    def _load_reference_params(self, param_file, ctx=None):
+        from .. import ndarray as _nd
+        from ..ndarray.ndarray import NDArray
+
+        loaded = _nd.load(param_file)
+        if not isinstance(loaded, dict):
+            raise MXNetError("reference param file carries no keys; "
+                             "cannot match graph inputs")
+        values = {}
+        for k, v in loaded.items():
+            name = k.split(":", 1)[1] if ":" in k else k
+            values[name] = v
+        missing = [n for n in self._graph_param_names if n not in values]
+        if missing:
+            raise MXNetError("reference params missing graph inputs: %s"
+                             % missing)
+        for n in self._graph_param_names:
+            p = self._reg_params[n]
+            v = values[n]
+            data = v if isinstance(v, NDArray) else NDArray(v)
+            p.dtype = data.dtype
+            p.set_data(data)  # attaches the grad buffer per grad_req
+
+    def forward(self, *args):
+        from ..ops.registry import apply_op
+
+        pvals = [self._reg_params[n].data()
+                 for n in self._graph_param_names]
+        names = self._input_names + self._graph_param_names
+
+        def ref_graph(*datas, _sym=self._sym, _names=names):
+            env = dict(zip(_names, datas))
+            out = _sym._fn(env)
+            return out
+
+        ref_graph.__name__ = "reference_graph"
+        return apply_op(ref_graph, *args, *pvals)

@@ -154,11 +154,17 @@ def moveaxis(a, source, destination):
 # ---- serialization (reference MXNDArraySave/Load, ndarray/utils.py) -------
 
 
-def save(fname, data):
+def save(fname, data, format="npz"):
     """Save NDArray / list / dict of NDArray (reference ndarray/utils.py:149).
 
-    Format: numpy .npz under the hood (TPU-native: the reference's custom
-    binary chunk format served its C++ loader; npz keeps numpy interop)."""
+    Default format: numpy .npz (TPU-native: the reference's custom binary
+    chunk format served its C++ loader; npz keeps numpy interop).
+    ``format="reference"`` writes the incumbent's binary NDArray-list
+    format instead, loadable by the reference's mx.nd.load."""
+    if format == "reference":
+        from .. import legacy_io
+
+        return legacy_io.save(fname, data)
     if isinstance(data, NDArray):
         payload = {"__mx_single__": data.asnumpy()}
     elif isinstance(data, dict):
@@ -173,6 +179,15 @@ def save(fname, data):
 
 
 def load(fname):
+    # reference-format interop: the incumbent's .params files open with
+    # kMXAPINDArrayListMagic — route them through the binary codec
+    # (mxnet_tpu/legacy_io.py; reference src/ndarray/ndarray.cc:1930)
+    with open(fname, "rb") as f:
+        head = f.read(8)
+    from .. import legacy_io
+
+    if legacy_io.is_reference_format(head):
+        return legacy_io.load(fname)
     with _np.load(fname, allow_pickle=False) as npz:
         keys = list(npz.keys())
         if keys == ["__mx_single__"]:

@@ -31,7 +31,21 @@ class Symbol:
     """Deferred computation over named inputs."""
 
     def __init__(self, fn, inputs, name="node", json_repr=None):
-        self._fn = fn                  # env(dict name->jax) -> jax value
+        # Memoize per evaluation: without this, a DAG with shared nodes
+        # (every residual block) re-evaluates the shared prefix once per
+        # consumer path — exponential blow-up on an imported ResNet graph.
+        # The env dict itself is the per-eval cache (fresh per eval/trace);
+        # symbols stay alive through the closures, so id(self) is stable.
+        memo_key = ("__sym_memo__", id(self))
+
+        def memo_fn(env, _fn=fn, _key=memo_key):
+            hit = env.get(_key)
+            if hit is None:
+                hit = _fn(env)
+                env[_key] = hit
+            return hit
+
+        self._fn = memo_fn             # env(dict name->jax) -> jax value
         self._inputs = list(inputs)    # ordered free-variable names
         self._name = name
         self._json = json_repr or {"op": name, "inputs": list(inputs)}
@@ -299,14 +313,87 @@ def _rebuild(node):
 
 def load_json(json_str):
     data = _json.loads(json_str)
-    if "mxnet_tpu_symbol" not in data:
-        raise MXNetError("not a mxnet_tpu symbol json")
-    return _rebuild(data["mxnet_tpu_symbol"])
+    if "mxnet_tpu_symbol" in data:
+        return _rebuild(data["mxnet_tpu_symbol"])
+    if "nodes" in data and "heads" in data:
+        return load_reference_json(data)
+    raise MXNetError("not a mxnet_tpu or reference symbol json")
 
 
 def load(fname):
     with open(fname) as f:
         return load_json(f.read())
+
+
+def _parse_ref_attr(v):
+    """Reference graph attrs are ALL strings ('(2, 2)', 'True', '1e-05',
+    'None') — nnvm stores dict<str,str> (nnvm/node.h attrs)."""
+    import ast
+
+    if not isinstance(v, str):
+        return v
+    s = v.strip()
+    low = s.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    if low in ("none", "null"):
+        return None
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def load_reference_json(data, input_names=None):
+    """Build a Symbol from the incumbent's nnvm graph json
+    (model-symbol.json written by the reference HybridBlock.export,
+    gluon/block.py:1300; format produced by nnvm::Graph SaveJSON:
+    nodes[{op,name,attrs,inputs[[nid,out,ver]]}] + arg_nodes + heads).
+
+    Every node's op must resolve in this registry — the parity layer
+    (ops/parity.py) carries the reference names, so imported 1.x graphs
+    execute on the XLA path directly.  Returns a Symbol (grouped when the
+    graph has several heads)."""
+    nodes = data["nodes"]
+    syms = []          # per-node Symbol (possibly tuple-valued)
+
+    def node_output(nid, out_idx):
+        s = syms[nid]
+        if out_idx == 0:
+            return s
+        base = s
+
+        def pick(env, _b=base, _i=out_idx):
+            out = _b._fn(env)
+            return out[_i]
+
+        return Symbol(pick, base._inputs,
+                      name="%s_output%d" % (base._name, out_idx))
+
+    for node in nodes:
+        op = node["op"]
+        name = node.get("name", "node%d" % len(syms))
+        if op == "null":
+            attrs = {k: _parse_ref_attr(v)
+                     for k, v in node.get("attrs", {}).items()}
+            syms.append(Symbol.var(name, shape=attrs.get("__shape__")))
+            continue
+        attrs = {k: _parse_ref_attr(v)
+                 for k, v in node.get("attrs", {}).items()}
+        # nnvm-internal attrs that are not op arguments (num_args is the
+        # variadic arity — implicit in the inputs list; num_outputs stays,
+        # it is a real parameter of SliceChannel/split)
+        for internal in ("__shape__", "__dtype__", "__storage_type__",
+                         "__profiler_scope__", "__ctx_group__",
+                         "__mirror_stage__", "num_args"):
+            attrs.pop(internal, None)
+        children = [node_output(nid, out_idx)
+                    for nid, out_idx, *_ in node["inputs"]]
+        syms.append(Symbol._apply(op, *children, **attrs))
+
+    heads = [node_output(nid, out_idx)
+             for nid, out_idx, *_ in data["heads"]]
+    return heads[0] if len(heads) == 1 else Group(heads)
 
 
 def zeros(shape, dtype="float32", **kwargs):

@@ -242,3 +242,20 @@ def test_contrib_namespace_rejects_non_contrib_ops():
     with pytest.raises(AttributeError):
         sym.contrib.Convolution
     assert sym.contrib is sym.contrib  # cached instance
+
+
+def test_symbol_dag_eval_is_memoized():
+    """A diamond DAG must evaluate shared nodes once per eval — without
+    per-env memoization, 25 stacked diamonds = 2^25 evaluations (hangs)."""
+    import time
+
+    from mxnet_tpu import nd, sym
+
+    x = sym.var("x")
+    node = x
+    for _ in range(25):
+        node = node + node
+    t0 = time.time()
+    out = node.eval(x=nd.array(np.array([1.0], np.float32)))[0]
+    assert time.time() - t0 < 30.0
+    np.testing.assert_allclose(out.asnumpy(), [2.0 ** 25])

@@ -1,0 +1,109 @@
+"""Generate the vendored reference-format test fixture.
+
+This writer is INTENTIONALLY independent of mxnet_tpu/legacy_io.py: it
+transcribes the byte layout straight from the reference C++ —
+src/ndarray/ndarray.cc:1697 (NDArray::Save, V2 records), :1930
+(kMXAPINDArrayListMagic list header), include/mxnet/tuple.h:731
+(Tuple::Save: int32 ndim + int64 dims), include/mxnet/base.h:145
+(Context::Save: int32 dev_type + int32 dev_id) — so the interop test
+crosses two implementations of the spec, not one implementation talking
+to itself.  The symbol json mirrors the nnvm SaveJSON schema of a
+reference `HybridBlock.export` of a small MLP (Dense-relu-Dense), the
+same graph the reference tutorial exports.
+
+Usage: python tools/make_reference_fixture.py tests/data
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import sys
+
+import numpy as np
+
+
+def write_tensor(out, arr):
+    arr = np.ascontiguousarray(arr)
+    out.append(struct.pack("<I", 0xF993FAC9))      # NDARRAY_V2_MAGIC
+    out.append(struct.pack("<i", 0))               # kDefaultStorage
+    out.append(struct.pack("<i", arr.ndim))        # TShape: int32 ndim
+    out.append(struct.pack("<%dq" % arr.ndim, *arr.shape))  # int64 dims
+    out.append(struct.pack("<ii", 1, 0))           # Context cpu(0)
+    flag = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+            "int32": 4, "int8": 5, "int64": 6}[str(arr.dtype)]
+    out.append(struct.pack("<i", flag))
+    out.append(arr.tobytes())
+
+
+def write_params(path, named):
+    out = [struct.pack("<QQ", 0x112, 0),           # list magic + reserved
+           struct.pack("<Q", len(named))]
+    for _k, v in named:
+        write_tensor(out, v)
+    out.append(struct.pack("<Q", len(named)))
+    for k, _v in named:
+        kb = k.encode()
+        out.append(struct.pack("<Q", len(kb)))
+        out.append(kb)
+    with open(path, "wb") as f:
+        f.write(b"".join(out))
+
+
+def mlp_symbol_json():
+    """nnvm graph json of Dense(16, relu) -> Dense(4), as the reference
+    exports it (node layout observed from nnvm::Graph SaveJSON)."""
+    nodes = [
+        {"op": "null", "name": "data", "inputs": []},
+        {"op": "null", "name": "mlp0_weight",
+         "attrs": {"__shape__": "(16, 8)"}, "inputs": []},
+        {"op": "null", "name": "mlp0_bias",
+         "attrs": {"__shape__": "(16,)"}, "inputs": []},
+        {"op": "FullyConnected", "name": "mlp0_fwd",
+         "attrs": {"flatten": "True", "no_bias": "False",
+                   "num_hidden": "16"},
+         "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+        {"op": "Activation", "name": "mlp0_relu_fwd",
+         "attrs": {"act_type": "relu"}, "inputs": [[3, 0, 0]]},
+        {"op": "null", "name": "mlp1_weight",
+         "attrs": {"__shape__": "(4, 16)"}, "inputs": []},
+        {"op": "null", "name": "mlp1_bias",
+         "attrs": {"__shape__": "(4,)"}, "inputs": []},
+        {"op": "FullyConnected", "name": "mlp1_fwd",
+         "attrs": {"flatten": "True", "no_bias": "False",
+                   "num_hidden": "4"},
+         "inputs": [[4, 0, 0], [5, 0, 0], [6, 0, 0]]},
+    ]
+    return {
+        "nodes": nodes,
+        "arg_nodes": [0, 1, 2, 5, 6],
+        "node_row_ptr": list(range(len(nodes) + 1)),
+        "heads": [[7, 0, 0]],
+        "attrs": {"mxnet_version": ["int", 10700]},
+    }
+
+
+def main():
+    outdir = sys.argv[1] if len(sys.argv) > 1 else "tests/data"
+    os.makedirs(outdir, exist_ok=True)
+    rs = np.random.RandomState(1234)
+    params = [
+        ("arg:mlp0_weight", rs.randn(16, 8).astype(np.float32) * 0.1),
+        ("arg:mlp0_bias", rs.randn(16).astype(np.float32) * 0.1),
+        ("arg:mlp1_weight", rs.randn(4, 16).astype(np.float32) * 0.1),
+        ("arg:mlp1_bias", rs.randn(4).astype(np.float32) * 0.1),
+    ]
+    write_params(os.path.join(outdir, "ref_mlp-0000.params"), params)
+    with open(os.path.join(outdir, "ref_mlp-symbol.json"), "w") as f:
+        json.dump(mlp_symbol_json(), f, indent=2)
+    # mixed-dtype list fixture without keys + an int64 tensor
+    write_params(os.path.join(outdir, "ref_tensors.params"), [
+        ("x", np.arange(6, dtype=np.float32).reshape(2, 3)),
+        ("y", np.array([1, 2, 3], dtype=np.int64)),
+        ("z", rs.rand(3, 1, 2).astype(np.float64)),
+    ])
+    print("fixtures written to", outdir)
+
+
+if __name__ == "__main__":
+    main()
