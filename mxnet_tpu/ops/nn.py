@@ -537,24 +537,19 @@ def multi_head_attention(q, k, v, num_heads=1, mask=None, scale=None,
                          "with mxnet_tpu.random.take_key())")
     has_dropout = attn_dropout > 0.0
     if impl == "auto":
-        if pa.use_flash(Tq, Tk, D, mask is not None):
-            # probability dropout rides the blockwise online-softmax path
-            # (per-block threefry mask, no (T,T) materialization); the
-            # raw Pallas kernel handles the dropout-free case
-            impl = "flash" if has_dropout else "pallas"
-        else:
-            impl = "dense"
+        # the Pallas kernel now covers dropout too (in-kernel per-tile
+        # PRNG mask, fwd + both bwd kernels regenerate it)
+        impl = "pallas" if pa.use_flash(Tq, Tk, D, mask is not None) \
+            else "dense"
     if impl in ("pallas", "flash"):
         if mask is not None:
             raise MXNetError(
                 "impl=%r does not support an arbitrary mask (only causal=); "
                 "use impl='dense' or drop the mask" % impl)
-        if has_dropout and impl == "pallas":
-            raise MXNetError(
-                "impl='pallas' does not support attention-probability "
-                "dropout; use impl='flash' (blockwise) or attn_dropout=0")
         if impl == "pallas":
-            out = pa.flash_attention(qh, kh, vh, causal, scale)
+            out = pa.flash_attention(qh, kh, vh, causal, scale,
+                                     dropout_p=attn_dropout,
+                                     dropout_key=dropout_key)
         else:
             out = pa.blockwise_attention(qh, kh, vh, causal=causal,
                                          sm_scale=scale,

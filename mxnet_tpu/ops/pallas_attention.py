@@ -97,10 +97,34 @@ def blockwise_attention(q, k, v, causal=False, sm_scale=None, block_k=256,
 
 
 # ---------------------------------------------------------------------------
-# Pallas kernel
+# Pallas kernels (forward + flash backward; reference fwd-only equivalent:
+# src/operator/contrib/transformer.cc:650-826)
 # ---------------------------------------------------------------------------
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
-                  block_k, seq_k):
+def _tile_keep_mask(seed, bh, qi, j, shape, dropout_p, interpret):
+    """Deterministic per-tile keep mask.
+
+    Seeding by (seed, bh, qi, j) makes the SAME mask reproducible from the
+    forward kernel, the dq kernel (fixed qi, looping j) and the dkv kernel
+    (fixed j, looping qi) without storing any bits.  On TPU hardware the
+    bits come from the core PRNG (pltpu.prng_*); interpret mode has no
+    lowering for those, so it derives a threefry mask instead — each
+    backend is self-consistent across its fwd/bwd passes, which is the
+    only requirement (masks need not match across backends)."""
+    if interpret:
+        key = jax.random.fold_in(jax.random.fold_in(jax.random.fold_in(
+            jax.random.PRNGKey(seed), bh), qi), j)
+        return jax.random.bernoulli(key, 1.0 - dropout_p, shape)
+    from jax.experimental.pallas import tpu as pltpu
+
+    pltpu.prng_seed(seed, bh, qi, j)
+    bits = pltpu.prng_random_bits(shape)
+    thresh = jnp.uint32(int((1.0 - dropout_p) * float(2 ** 32 - 1)))
+    return bits.astype(jnp.uint32) < thresh
+
+
+def _flash_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
+                  causal, block_q, block_k, seq_k, dropout_p, interpret):
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale          # (block_q, D)
     D = q.shape[-1]
@@ -127,7 +151,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
         m_new = jnp.maximum(m, s.max(-1))
         p = jnp.exp(s - m_new[:, None])
         corr = jnp.exp(m - m_new)
+        # denominator accumulates UNdropped mass (the BERT recipe:
+        # dropout(softmax(s)) @ v — normalization sees the full softmax)
         l_new = l * corr + p.sum(-1)
+        if dropout_p > 0.0:
+            keep = _tile_keep_mask(seed_ref[0], bh, qi, j, p.shape,
+                                   dropout_p, interpret)
+            p = p * keep.astype(p.dtype) / (1.0 - dropout_p)
         acc_new = acc * corr[:, None] + jax.lax.dot_general(
             p, vblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -138,14 +168,126 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
     a0 = jnp.zeros((block_q, D), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, a0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
-def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, *, scale, causal, block_q, block_k,
+                   seq_k, dropout_p, interpret):
+    """dq for one (bh, q-block): ds = p∘(msc∘(dO·Vᵀ) − Δ); dq = scale·ds·K."""
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    qs = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    D = qs.shape[-1]
+    nk = pl.cdiv(seq_k, block_k)
+    if causal:
+        nk = jnp.minimum(nk, pl.cdiv((qi + 1) * block_q, block_k))
+
+    def body(j, dq):
+        kblk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(qs, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        k_idx = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_idx < seq_k
+        if causal:
+            q_idx = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = valid & (k_idx <= q_idx)
+        s = jnp.where(valid, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])                  # rows sum to 1
+        dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            keep = _tile_keep_mask(seed_ref[0], bh, qi, j, p.shape,
+                                   dropout_p, interpret)
+            dp = dp * keep.astype(dp.dtype) / (1.0 - dropout_p)
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(
+            ds, kblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((block_q, D),
+                                                  jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, *, scale, causal, block_q,
+                    block_k, seq_q, seq_k, dropout_p, interpret):
+    """dk/dv for one (bh, k-block), looping q blocks.
+
+    dv = (p∘msc)ᵀ·dO;  dk = scale·dsᵀ·Q  with the SAME per-tile dropout
+    mask as the forward (regenerated, not stored)."""
+    bh = pl.program_id(0)
+    j = pl.program_id(1)
+    kblk = k_ref[0].astype(jnp.float32)               # (block_k, D)
+    vblk = v_ref[0].astype(jnp.float32)
+    D = kblk.shape[-1]
+    nq = pl.cdiv(seq_q, block_q)
+
+    def body(qi, carry):
+        dk, dv = carry
+        qs = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(
+            jnp.float32) * scale
+        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q)]
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q)]
+        s = jax.lax.dot_general(qs, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        k_idx = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        q_idx = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        valid = (k_idx < seq_k) & (q_idx < seq_q)
+        if causal:
+            valid = valid & (k_idx <= q_idx)
+        s = jnp.where(valid, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(valid, p, 0.0)                  # padded q rows -> 0
+        if dropout_p > 0.0:
+            keep = _tile_keep_mask(seed_ref[0], bh, qi, j, p.shape,
+                                   dropout_p, interpret).astype(p.dtype) \
+                / (1.0 - dropout_p)
+        else:
+            keep = None
+        pm = p * keep if keep is not None else p
+        dv = dv + jax.lax.dot_general(
+            pm, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if keep is not None:
+            dp = dp * keep
+        ds = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(
+            ds, qs, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, D), jnp.float32)
+    dv0 = jnp.zeros((block_k, D), jnp.float32)
+    # causal: q blocks strictly left of this k block see only masked score
+    lo = (j * block_k) // block_q if causal else 0
+    dk, dv = jax.lax.fori_loop(lo, nq, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)               # already scale·dsᵀ·Qs
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _smem_spec():
+    """BlockSpec for the scalar dropout seed (SMEM on TPU)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _pad_pack(q, k, v, block_q, block_k):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
-    scale = sm_scale if sm_scale is not None else 1.0 / (D ** 0.5)
-    block_q = min(block_q, Tq)
-    block_k = min(block_k, Tk)
     nq = -(-Tq // block_q)
     nk = -(-Tk // block_k)
     pad_q = nq * block_q - Tq
@@ -157,62 +299,185 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         # the array edge, which would misalign rows against the k_idx mask
         k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
-    Tk_pad = Tk + pad_k
     qf = q.reshape(B * H, nq * block_q, D)
-    kf = k.reshape(B * H, Tk_pad, D)
-    vf = v.reshape(B * H, Tk_pad, D)
+    kf = k.reshape(B * H, Tk + pad_k, D)
+    vf = v.reshape(B * H, Tk + pad_k, D)
+    return qf, kf, vf, nq, nk, pad_q, pad_k
+
+
+def _flash_forward(q, k, v, seed, causal, sm_scale, block_q, block_k,
+                   interpret, dropout_p, want_lse=False):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    scale = sm_scale if sm_scale is not None else 1.0 / (D ** 0.5)
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    qf, kf, vf, nq, nk, pad_q, _pad_k = _pad_pack(q, k, v, block_q, block_k)
+    Tk_pad = kf.shape[1]
 
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, seq_k=Tk)
-    out = pl.pallas_call(
+        block_k=block_k, seq_k=Tk, dropout_p=dropout_p,
+        interpret=interpret)
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, nq),
         in_specs=[
+            _smem_spec(),
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, Tk_pad, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, Tk_pad, D), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, nq * block_q, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, nq * block_q, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, nq * block_q), jnp.float32),
+        ],
         interpret=interpret,
-    )(qf, kf, vf)
-    out = out.reshape(B, H, nq * block_q, D)
-    return out[:, :, :Tq] if pad_q else out
+    )(seed, qf, kf, vf)
+    outr = out.reshape(B, H, nq * block_q, D)
+    if pad_q:
+        outr = outr[:, :, :Tq]
+    if want_lse:
+        return outr, lse
+    return outr
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_backward(q, k, v, seed, out, lse, do, causal, scale, block_q,
+                    block_k, interpret, dropout_p):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    qf, kf, vf, nq, nk, pad_q, pad_k = _pad_pack(q, k, v, block_q, block_k)
+    Tq_pad, Tk_pad = qf.shape[1], kf.shape[1]
+    dof = jnp.pad(do, ((0, 0), (0, 0), (0, pad_q), (0, 0))) if pad_q else do
+    dof = dof.reshape(B * H, Tq_pad, D)
+    # Δ = rowsum(dO ∘ O) — one cheap fused XLA reduction, fed to both
+    # kernels (padded rows contribute zeros via the padded dO)
+    outf = (jnp.pad(out, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+            if pad_q else out).reshape(B * H, Tq_pad, D)
+    delta = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
+                    axis=-1)                           # (B*H, Tq_pad)
+
+    smem_spec = _smem_spec()
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_k=Tk, dropout_p=dropout_p,
+        interpret=interpret)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B * H, nq),
+        in_specs=[
+            smem_spec,
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Tk_pad, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Tk_pad, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq_pad, D), q.dtype),
+        interpret=interpret,
+    )(seed, qf, kf, vf, dof, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_q=Tq, seq_k=Tk, dropout_p=dropout_p,
+        interpret=interpret)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B * H, nk),
+        in_specs=[
+            smem_spec,
+            pl.BlockSpec((1, Tq_pad, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, Tq_pad, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Tq_pad), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, Tq_pad), lambda b, j: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tk_pad, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Tk_pad, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(seed, qf, kf, vf, dof, lse, delta)
+
+    dq = dq.reshape(B, H, Tq_pad, D)[:, :, :Tq]
+    dk = dk.reshape(B, H, Tk_pad, D)[:, :, :Tk]
+    dv = dv.reshape(B, H, Tk_pad, D)[:, :, :Tk]
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_core(q, k, v, seed, causal, sm_scale, block_q, block_k,
+                interpret, dropout_p):
+    return _flash_forward(q, k, v, seed, causal, sm_scale, block_q,
+                          block_k, interpret, dropout_p)
+
+
+def _flash_core_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k,
+                    interpret, dropout_p):
+    out, lse = _flash_forward(q, k, v, seed, causal, sm_scale, block_q,
+                              block_k, interpret, dropout_p, want_lse=True)
+    return out, (q, k, v, seed, out, lse)
+
+
+def _flash_core_bwd(causal, sm_scale, block_q, block_k, interpret,
+                    dropout_p, res, do):
+    import numpy as _onp
+
+    q, k, v, seed, out, lse = res
+    D = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / (D ** 0.5)
+    dq, dk, dv = _flash_backward(q, k, v, seed, out, lse, do, causal,
+                                 scale, block_q, block_k, interpret,
+                                 dropout_p)
+    dseed = _onp.zeros((1,), jax.dtypes.float0)   # int seed: zero cotangent
+    return dq, dk, dv, dseed
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
 def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=512,
-                    block_k=512, interpret=None):
+                    block_k=512, interpret=None, dropout_p=0.0,
+                    dropout_key=None):
     """Flash attention, (B, H, T, D) layout.
 
-    Forward runs the Pallas kernel (interpret mode off-TPU); backward
-    recomputes through ``blockwise_attention`` so residual memory stays
-    O(T·D) — the flash-attention trade (extra FLOPs for HBM locality) that
-    the MXU absorbs.
-    """
+    Forward AND backward run Pallas kernels (interpret mode off-TPU): the
+    backward recomputes per-block probabilities from the saved logsumexp —
+    residual memory stays O(T·D), and dq/dk/dv are back-to-back MXU
+    matmuls (the fused equivalent the reference lacks; its
+    interleaved_matmul kernels are fwd-only, transformer.cc:650-826).
+    Attention-probability dropout runs IN-kernel from the TPU PRNG: the
+    per-tile mask is regenerated — never stored — in fwd, dq and dkv
+    passes, seeded by (key, bh, q-block, k-block)."""
     interpret = _default_interpret() if interpret is None else interpret
-    return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
-                          interpret)
-
-
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    interpret = _default_interpret() if interpret is None else interpret
-    out = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
-                         interpret)
-    return out, (q, k, v)
-
-
-def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(
-            q_, k_, v_, causal=causal, sm_scale=sm_scale, block_k=block_k),
-        q, k, v)
-    return vjp(do)
-
-
-flash_attention.defvjp(_flash_fwd, _flash_bwd)
+    if dropout_p > 0.0:
+        if dropout_key is None:
+            raise ValueError("flash_attention: dropout_p > 0 requires "
+                             "dropout_key")
+        # fold ALL key words into the seed: threefry key_data for
+        # PRNGKey(s), s < 2^32 is [0, s] — taking only word 0 would give
+        # every such key the same mask
+        kd = jax.random.key_data(dropout_key).reshape(-1)
+        folded = jnp.bitwise_xor(kd[0] * jnp.uint32(2654435761),
+                                 kd[-1]) if kd.shape[0] > 1 else kd[0]
+        seed = folded.astype(jnp.int32).reshape(1)
+    else:
+        seed = jnp.zeros((1,), jnp.int32)
+    return _flash_core(q, k, v, seed, causal, sm_scale, block_q, block_k,
+                       interpret, float(dropout_p))
 
 
 def _default_interpret():

@@ -260,8 +260,8 @@ def test_blockwise_attention_dropout_semantics():
 
 
 def test_mha_auto_uses_flash_with_dropout_long_seq():
-    """T=512 + attn dropout must route to the blockwise path, not dense
-    (the BERT pretrain configuration)."""
+    """T=512 + attn dropout must route to the Pallas kernel (in-kernel
+    per-tile dropout, r4), not dense (the BERT pretrain configuration)."""
     from mxnet_tpu import nd
     from mxnet_tpu import random as mxrandom
 
@@ -272,12 +272,12 @@ def test_mha_auto_uses_flash_with_dropout_long_seq():
     out = nd.multi_head_attention(q, q, q, num_heads=H, attn_dropout=0.1,
                                   dropout_key=key)
     assert out.shape == (B, T, H * D)
-    # pin the ROUTING: auto == explicit flash bit-for-bit (same key and
-    # per-block masks); the dense path draws one full-matrix mask and
+    # pin the ROUTING: auto == explicit pallas bit-for-bit (same key and
+    # per-tile masks); the dense path draws one full-matrix mask and
     # would differ
     out_flash = nd.multi_head_attention(q, q, q, num_heads=H,
                                         attn_dropout=0.1, dropout_key=key,
-                                        impl="flash")
+                                        impl="pallas")
     np.testing.assert_allclose(out.asnumpy(), out_flash.asnumpy())
     out_dense = nd.multi_head_attention(q, q, q, num_heads=H,
                                         attn_dropout=0.1, dropout_key=key,
