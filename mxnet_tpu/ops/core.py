@@ -603,19 +603,37 @@ def slice_axis(x, axis=0, begin=0, end=None):
 
 @register("slice_like")
 def slice_like(x, shape_like, axes=None):
+    # bound by the second input's SHAPE (slice_like.cc semantics), never
+    # its values — shape_like[ax] would read the array's data
+    from ..base import MXNetError
+
+    if axes is None and x.ndim != shape_like.ndim:
+        # reference slice_like.cc CHECK_EQs the ranks when no axes are
+        # given; failing loudly beats silently slicing a prefix
+        raise MXNetError(
+            "slice_like without axes needs equal ranks, got %d vs %d; "
+            "pass axes= to slice a subset" % (x.ndim, shape_like.ndim))
     slices = [slice(None)] * x.ndim
     axes_ = axes if axes is not None else range(x.ndim)
     for ax in axes_:
-        slices[ax] = slice(0, shape_like[ax])
+        slices[ax] = slice(0, shape_like.shape[ax])
     return x[tuple(slices)]
 
 
 @register("pad")
 def pad(x, pad_width=None, mode="constant", constant_value=0):
+    # the legacy Pad op (pad.cc) passes a FLAT 2*ndim tuple
+    # (before_0, after_0, before_1, after_1, ...); accept that layout on
+    # top of everything jnp.pad takes (scalar, (n,), ((b,a),...))
+    pw = pad_width
+    if isinstance(pw, (tuple, list)) and pw \
+            and not isinstance(pw[0], (tuple, list)) \
+            and len(pw) == 2 * x.ndim:
+        pw = tuple((int(pw[2 * i]), int(pw[2 * i + 1]))
+                   for i in range(x.ndim))
     if mode == "constant":
-        return jnp.pad(x, pad_width, mode=mode,
-                       constant_values=constant_value)
-    return jnp.pad(x, pad_width, mode=mode)
+        return jnp.pad(x, pw, mode=mode, constant_values=constant_value)
+    return jnp.pad(x, pw, mode=mode)
 
 
 @register("where")

@@ -516,11 +516,11 @@ def multi_head_attention(q, k, v, num_heads=1, mask=None, scale=None,
     (mxnet_tpu/ops/pallas_attention.py) takes over for long sequences.
 
     impl: 'auto' | 'dense' | 'flash' (blockwise scan) | 'pallas'.
-    attn_dropout (+ dropout_key) drops attention probabilities; dense and
-    the blockwise flash path both support it (flash applies a per-block
-    threefry mask online, never materializing (T, T)), so auto-dispatch
-    routes long-sequence dropout training to 'flash' and the dropout-free
-    case to the raw Pallas kernel.  Only impl='pallas' rejects dropout.
+    attn_dropout (+ dropout_key) drops attention probabilities; every
+    impl supports it — the Pallas kernel applies a per-tile PRNG mask
+    inside fwd AND both backward kernels (regenerated, never stored), so
+    auto-dispatch sends all long-sequence cases, dropout included, to
+    'pallas'; 'flash' (blockwise) remains the pure-JAX fallback.
     """
     from ..base import MXNetError
     from . import pallas_attention as pa

@@ -9,10 +9,14 @@ running (m, l, acc) state, so memory is O(T·D) and the MXU sees back-to-back
 (block_q × D) @ (D × block_k) matmuls.
 
 Three tiers:
-- ``flash_attention``     — Pallas kernel (TPU; ``interpret=True`` elsewhere
-                            so the same kernel is testable on CPU).
-- ``blockwise_attention`` — pure-JAX lax.scan online softmax; differentiable;
-                            the custom-vjp backward recomputes through this.
+- ``flash_attention``     — Pallas kernels fwd AND bwd (TPU;
+                            ``interpret=True`` elsewhere so the same
+                            kernels are testable on CPU): the backward
+                            recomputes per-block probabilities from the
+                            saved logsumexp in dedicated dq and dk/dv
+                            kernels, with in-kernel probability dropout.
+- ``blockwise_attention`` — pure-JAX lax.scan online softmax;
+                            differentiable end-to-end; the fallback path.
 - dense                   — plain einsum chain (ops/nn.py), best for short T.
 """
 from __future__ import annotations

@@ -32,6 +32,12 @@ default_rtols = {_np.dtype(_np.float16): 1e-2, _np.dtype(_np.float32): 1e-4,
                  _np.dtype(_np.float64): 1e-6}
 default_atols = {_np.dtype(_np.float16): 1e-3, _np.dtype(_np.float32): 1e-5,
                  _np.dtype(_np.float64): 1e-8}
+# integer/bool results must be exact (reference test_utils per-dtype
+# tolerance tables treat non-floats as rtol=atol=0)
+for _idt in (_np.int8, _np.uint8, _np.int16, _np.int32, _np.int64,
+             _np.bool_):
+    default_rtols[_np.dtype(_idt)] = 0.0
+    default_atols[_np.dtype(_idt)] = 0.0
 
 
 def effective_dtype(arr):
@@ -155,14 +161,21 @@ def check_consistency(fn, inputs, ctx_list=None, dtypes=("float32",),
         for dtype in dtypes:
             args = [nd.array(x, ctx=ctx).astype(dtype) for x in inputs]
             out = fn(*args)
-            out_np = out.asnumpy().astype(_np.float64)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            out_np = [o.asnumpy().astype(_np.float64) for o in outs]
             if ref is None:
                 ref = out_np
+                continue
+            if dtype == "bfloat16":
+                # 8 mantissa bits: eps ~7.8e-3, and additive cancellation
+                # near zero makes abs error the binding constraint
+                r = rtol if rtol is not None else 4e-2
+                a = atol if atol is not None else 2e-2
             else:
-                tol_dt = _np.dtype(_np.float16) if dtype in ("float16",
-                                                             "bfloat16") \
+                tol_dt = _np.dtype(_np.float16) if dtype == "float16" \
                     else _np.dtype(dtype)
-                assert_almost_equal(out_np, ref,
-                                    rtol=rtol or default_rtols[tol_dt],
-                                    atol=atol or default_atols[tol_dt])
+                r = rtol if rtol is not None else default_rtols[tol_dt]
+                a = atol if atol is not None else default_atols[tol_dt]
+            for got, want in zip(out_np, ref):
+                assert_almost_equal(got, want, rtol=r, atol=a)
     return ref

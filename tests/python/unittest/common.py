@@ -1,0 +1,39 @@
+"""Shared test fixtures (reference tests/python/unittest/common.py).
+
+``with_seed`` is the reference's reproducible-randomness decorator
+(common.py:164): every decorated test draws a fresh seed (or honors
+MXNET_TEST_SEED), seeds both numpy and the framework RNG, and on failure
+prints the seed so the exact tensor draw can be replayed with
+``MXNET_TEST_SEED=<n> pytest <test>``.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import random as _pyrandom
+
+import numpy as np
+
+
+def with_seed(seed=None):
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            env = os.environ.get("MXNET_TEST_SEED")
+            this = int(env) if env else (
+                seed if seed is not None
+                else _pyrandom.SystemRandom().randint(0, 2 ** 31 - 1))
+            np.random.seed(this)
+            import mxnet_tpu as mx
+
+            mx.random.seed(this)
+            try:
+                return fn(*args, **kwargs)
+            except Exception:
+                print("*** test failed with MXNET_TEST_SEED=%d — rerun "
+                      "with that env var to reproduce the draw ***" % this)
+                raise
+
+        return wrapper
+
+    return deco
