@@ -1,0 +1,125 @@
+"""Analyze a jax.profiler trace directory into the MFU work list.
+
+Pairs with benchmark/profile_tpu.py: once the trace is captured on the
+real chip, this turns the xplane protobuf into the bench-driving facts —
+top self-time ops, device vs host split, and the per-category breakdown
+that tells you WHERE the non-matmul time goes (VERDICT r3 "explain every
+>5% time bucket").
+
+Usage:
+    python benchmark/profile_tpu.py resnet_bf16 /tmp/trace
+    python benchmark/analyze_trace.py /tmp/trace
+
+No TPU needed for the analysis itself; the parsing runs on the host via
+tensorboard_plugin_profile's converters.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+
+def find_xplanes(logdir):
+    return sorted(glob.glob(os.path.join(
+        logdir, "**", "*.xplane.pb"), recursive=True))
+
+
+def direct_op_table(xplane, top=30):
+    """Parse the XSpace proto directly (tensorflow.tsl xplane_pb2) and sum
+    self-duration per event name on each plane — independent of the
+    plugin's converter pywrap, so it works on any host install."""
+    from collections import defaultdict
+
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    space = xplane_pb2.XSpace()
+    with open(xplane, "rb") as f:
+        space.ParseFromString(f.read())
+    report = {}
+    for plane in space.planes:
+        meta = {m.id: m.name for m in plane.event_metadata.values()} if \
+            isinstance(plane.event_metadata, dict) else \
+            {k: v.name for k, v in plane.event_metadata.items()}
+        per_op = defaultdict(int)
+        total = 0
+        for line in plane.lines:
+            for ev in line.events:
+                name = meta.get(ev.metadata_id, str(ev.metadata_id))
+                per_op[name] += ev.duration_ps
+                total += ev.duration_ps
+        if not per_op:
+            continue
+        rows = sorted(per_op.items(), key=lambda kv: -kv[1])[:top]
+        report[plane.name] = {
+            "total_ms": round(total / 1e9, 3),
+            "top_ops": [{"op": n, "ms": round(d / 1e9, 3),
+                         "pct": round(100.0 * d / max(total, 1), 1)}
+                        for n, d in rows],
+        }
+    return report
+
+
+def tool_data(xplane, tool):
+    from tensorboard_plugin_profile.convert import raw_to_tool_data as r2t
+
+    data, _ctype = r2t.xspace_to_tool_data([xplane], tool, {})
+    return data
+
+
+def op_table(xplane, top=25):
+    """framework_op_stats -> [(op, total_self_us, fraction)]."""
+    import csv
+    import io
+
+    data = tool_data(xplane, "framework_op_stats^")
+    if isinstance(data, bytes):
+        data = data.decode()
+    # the tool emits either json or csv depending on plugin version
+    try:
+        parsed = json.loads(data)
+        rows = parsed if isinstance(parsed, list) else \
+            parsed.get("data", [])
+        out = []
+        for r in rows[:top]:
+            out.append(r)
+        return out
+    except (ValueError, TypeError):
+        rd = csv.DictReader(io.StringIO(data))
+        return list(rd)[:top]
+
+
+def overview(xplane):
+    data = tool_data(xplane, "overview_page^")
+    if isinstance(data, bytes):
+        data = data.decode()
+    return json.loads(data)
+
+
+def main():
+    logdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/mxtpu_trace"
+    xplanes = find_xplanes(logdir)
+    if not xplanes:
+        raise SystemExit("no *.xplane.pb under %s — capture with "
+                         "benchmark/profile_tpu.py first" % logdir)
+    xp = xplanes[-1]
+    print("# analyzing", xp)
+    # primary: direct proto parse (always works on this host)
+    report = direct_op_table(xp)
+    for plane, body in report.items():
+        print("\n## plane %s — total %.1f ms" % (plane, body["total_ms"]))
+        for row in body["top_ops"]:
+            print("  %6.1f ms  %4.1f%%  %s"
+                  % (row["ms"], row["pct"], row["op"][:100]))
+    # secondary: plugin tools when the pywrap converter exists
+    try:
+        ov = overview(xp)
+        print("\n## overview_page")
+        print(json.dumps(ov, indent=1)[:4000])
+    except Exception as exc:  # noqa: BLE001 - tool coverage varies
+        print("\n(overview_page tool unavailable: %s)" % exc)
+
+
+if __name__ == "__main__":
+    main()

@@ -12,6 +12,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <unistd.h>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -179,6 +180,186 @@ int test_recordio_roundtrip() {
   return 0;
 }
 
+// ---- engine: sticky error propagation (threaded_engine.h:64 ExceptionRef
+// semantics: a failed op poisons its var; the error resurfaces at
+// WaitForVar like the reference rethrows at the next sync point) ---------
+int fail42_fn(void*) { return 42; }
+int ok_fn(void*) { return 0; }
+
+int test_engine_error_stickiness() {
+  void* eng = MXTEngineCreate(2);
+  int64_t var = MXTEngineNewVar(eng);
+  CHECK(MXTEnginePushAsync(eng, fail42_fn, nullptr, nullptr, 0, &var, 1,
+                           0) == 0);
+  CHECK(MXTEngineWaitForVar(eng, var) == 42);   // error surfaces
+  // a later successful write does NOT clear the sticky error
+  CHECK(MXTEnginePushAsync(eng, ok_fn, nullptr, nullptr, 0, &var, 1,
+                           0) == 0);
+  CHECK(MXTEngineWaitForVar(eng, var) == 42);
+  // dependent ops on the poisoned var still run (reference semantics:
+  // the chain keeps executing; the error is reported at sync points)
+  std::vector<int> log;
+  std::mutex mu;
+  AppendArg d{&log, &mu, 7, 0};
+  CHECK(MXTEnginePushAsync(eng, append_fn, &d, &var, 1, nullptr, 0, 0)
+        == 0);
+  MXTEngineWaitAll(eng);
+  CHECK(log.size() == 1 && log[0] == 7);
+  // unknown var id fails cleanly
+  CHECK(MXTEngineWaitForVar(eng, 999999) == -1);
+  MXTEngineDestroy(eng);
+  return 0;
+}
+
+// ---- engine: concurrent pushers hammering shared vars -------------------
+struct CounterArg {
+  int* counter;  // UNSYNCHRONIZED on purpose: engine WAW ordering is the
+                 // only thing keeping increments race-free
+};
+
+int incr_fn(void* p) {
+  auto* a = static_cast<CounterArg*>(p);
+  int v = *a->counter;
+  std::this_thread::sleep_for(std::chrono::microseconds(10));
+  *a->counter = v + 1;
+  return 0;
+}
+
+int test_engine_concurrent_push_stress() {
+  void* eng = MXTEngineCreate(4);
+  const int kThreads = 4, kOpsPerThread = 100;
+  int64_t var = MXTEngineNewVar(eng);
+  int counter = 0;
+  CounterArg arg{&counter};
+  std::vector<std::thread> pushers;
+  for (int t = 0; t < kThreads; ++t) {
+    pushers.emplace_back([&] {
+      for (int i = 0; i < kOpsPerThread; ++i)
+        MXTEnginePushAsync(eng, incr_fn, &arg, nullptr, 0, &var, 1, 0);
+    });
+  }
+  for (auto& t : pushers) t.join();
+  CHECK(MXTEngineWaitForVar(eng, var) == 0);
+  // all writes serialized: the unsynchronized counter is exact
+  CHECK(counter == kThreads * kOpsPerThread);
+  CHECK(MXTEnginePending(eng) == 0);
+  MXTEngineDestroy(eng);
+  return 0;
+}
+
+// ---- engine: destruction drains a loaded queue (shutdown-under-load;
+// reference engine_shutdown_test.cc) --------------------------------------
+std::atomic<int> g_slow_ran{0};
+
+int slow_fn(void*) {
+  std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  ++g_slow_ran;
+  return 0;
+}
+
+int test_engine_shutdown_under_load() {
+  void* eng = MXTEngineCreate(2);
+  int64_t var = MXTEngineNewVar(eng);
+  g_slow_ran = 0;
+  for (int i = 0; i < 20; ++i)
+    CHECK(MXTEnginePushAsync(eng, slow_fn, nullptr, nullptr, 0, &var, 1,
+                             0) == 0);
+  // destroy WITHOUT waiting: the destructor must drain the dependency
+  // chains (each grant wakes the next) and join workers, not hang or
+  // abandon queued ops
+  MXTEngineDestroy(eng);
+  CHECK(g_slow_ran.load() == 20);
+  return 0;
+}
+
+// ---- storage: allocator churn from many threads -------------------------
+int test_pool_concurrent_churn() {
+  void* pool = MXTPoolCreate(8u << 20, 64);
+  std::atomic<bool> failed{false};
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 4; ++t) {
+    ts.emplace_back([&, t] {
+      uint64_t sizes[4] = {256, 1000, 4096, 70000};
+      for (int i = 0; i < 500; ++i) {
+        uint64_t sz = sizes[(i + t) % 4];
+        void* p = MXTPoolAlloc(pool, sz);
+        if (!p || (reinterpret_cast<uintptr_t>(p) % 64) != 0) {
+          failed = true;
+          return;
+        }
+        // touch first/last byte: catches recycled-undersized blocks
+        static_cast<uint8_t*>(p)[0] = 0x5A;
+        static_cast<uint8_t*>(p)[sz - 1] = 0xA5;
+        MXTPoolFree(pool, p, sz);
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+  CHECK(!failed.load());
+  uint64_t s[5];
+  MXTPoolStats(pool, s);
+  CHECK(s[0] == 0);            // nothing left in use
+  CHECK(s[3] + s[4] == 4 * 500);  // every alloc was a hit or a miss
+  CHECK(s[3] > 0);             // churn produced cache hits
+  MXTPoolRelease(pool);
+  MXTPoolStats(pool, s);
+  CHECK(s[1] == 0);
+  MXTPoolDestroy(pool);
+  return 0;
+}
+
+// ---- recordio: truncated / corrupted stream recovery --------------------
+int test_recordio_truncated_recovery() {
+  const char* path = "build/mxt_cpptest_trunc.rec";
+  std::remove(path);
+  void* w = MXTRecordWriterCreate(path);
+  CHECK(w != nullptr);
+  std::string big(1000, 'x'), small("tail");
+  CHECK(MXTRecordWriterWrite(w, reinterpret_cast<const uint8_t*>(
+                                 big.data()), big.size()) == 0);
+  CHECK(MXTRecordWriterWrite(w, reinterpret_cast<const uint8_t*>(
+                                 small.data()), small.size()) == 0);
+  CHECK(MXTRecordWriterClose(w) == 0);
+
+  // truncate inside record 2's payload
+  {
+    FILE* f = std::fopen(path, "rb");
+    std::fseek(f, 0, SEEK_END);
+    long full = std::ftell(f);
+    std::fclose(f);
+    CHECK(truncate(path, full - 6) == 0);
+  }
+  void* r = MXTRecordReaderCreate(path);
+  CHECK(r != nullptr);
+  const uint8_t* buf = nullptr;
+  CHECK(MXTRecordReaderNext(r, &buf) == 1000);  // record 1 intact
+  int64_t rc2 = MXTRecordReaderNext(r, &buf);
+  CHECK(rc2 <= 0);                              // truncation: no garbage
+  CHECK(MXTRecordReaderClose(r) == 0);
+
+  // corrupt record 2's magic: the reader must stop, not misparse
+  void* w2 = MXTRecordWriterCreate(path);
+  CHECK(MXTRecordWriterWrite(w2, reinterpret_cast<const uint8_t*>(
+                                 big.data()), big.size()) == 0);
+  CHECK(MXTRecordWriterWrite(w2, reinterpret_cast<const uint8_t*>(
+                                 small.data()), small.size()) == 0);
+  CHECK(MXTRecordWriterClose(w2) == 0);
+  {
+    FILE* f = std::fopen(path, "rb+");
+    // record 1: magic(4) + len(4) + 1000 payload -> record 2 magic at 1008
+    std::fseek(f, 1008, SEEK_SET);
+    uint8_t junk = 0xEE;
+    std::fwrite(&junk, 1, 1, f);
+    std::fclose(f);
+  }
+  void* r2 = MXTRecordReaderCreate(path);
+  CHECK(MXTRecordReaderNext(r2, &buf) == 1000);
+  CHECK(MXTRecordReaderNext(r2, &buf) <= 0);    // bad magic detected
+  CHECK(MXTRecordReaderClose(r2) == 0);
+  std::remove(path);
+  return 0;
+}
+
 }  // namespace
 
 int main() {
@@ -187,6 +368,11 @@ int main() {
   rc |= test_engine_parallel_reads_exclusive_write();
   rc |= test_pool_reuse_and_stats();
   rc |= test_recordio_roundtrip();
+  rc |= test_engine_error_stickiness();
+  rc |= test_engine_concurrent_push_stress();
+  rc |= test_engine_shutdown_under_load();
+  rc |= test_pool_concurrent_churn();
+  rc |= test_recordio_truncated_recovery();
   if (rc == 0) std::printf("ALL C++ NATIVE TESTS PASSED\n");
   return rc;
 }
