@@ -27,9 +27,15 @@ def find_xplanes(logdir):
 
 
 def direct_op_table(xplane, top=30):
-    """Parse the XSpace proto directly (tensorflow.tsl xplane_pb2) and sum
-    self-duration per event name on each plane — independent of the
-    plugin's converter pywrap, so it works on any host install."""
+    """Parse the XSpace proto directly (tensorflow.tsl xplane_pb2) into
+    per-(plane, line) duration tables — independent of the plugin's
+    converter pywrap, so it works on any host install.
+
+    Events are aggregated PER LINE (a line is one track, e.g. 'XLA Ops'
+    vs 'XLA Modules' on a device plane): summing across lines would count
+    each op once in its own event and again inside its enclosing module,
+    inflating totals ~2x.  Events on one line don't nest in xplane traces,
+    so within-line sums are honest self-time."""
     from collections import defaultdict
 
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
@@ -42,22 +48,23 @@ def direct_op_table(xplane, top=30):
         meta = {m.id: m.name for m in plane.event_metadata.values()} if \
             isinstance(plane.event_metadata, dict) else \
             {k: v.name for k, v in plane.event_metadata.items()}
-        per_op = defaultdict(int)
-        total = 0
         for line in plane.lines:
+            per_op = defaultdict(int)
+            total = 0
             for ev in line.events:
                 name = meta.get(ev.metadata_id, str(ev.metadata_id))
                 per_op[name] += ev.duration_ps
                 total += ev.duration_ps
-        if not per_op:
-            continue
-        rows = sorted(per_op.items(), key=lambda kv: -kv[1])[:top]
-        report[plane.name] = {
-            "total_ms": round(total / 1e9, 3),
-            "top_ops": [{"op": n, "ms": round(d / 1e9, 3),
-                         "pct": round(100.0 * d / max(total, 1), 1)}
-                        for n, d in rows],
-        }
+            if not per_op:
+                continue
+            rows = sorted(per_op.items(), key=lambda kv: -kv[1])[:top]
+            key = "%s :: %s" % (plane.name, line.name or line.id)
+            report[key] = {
+                "total_ms": round(total / 1e9, 3),
+                "top_ops": [{"op": n, "ms": round(d / 1e9, 3),
+                             "pct": round(100.0 * d / max(total, 1), 1)}
+                            for n, d in rows],
+            }
     return report
 
 

@@ -351,7 +351,7 @@ def _flash_forward(q, k, v, seed, causal, sm_scale, block_q, block_k,
 
 
 def _flash_backward(q, k, v, seed, out, lse, do, causal, scale, block_q,
-                    block_k, interpret, dropout_p):
+                    block_k, interpret, dropout_p, dlse=None):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     block_q = min(block_q, Tq)
@@ -366,6 +366,14 @@ def _flash_backward(q, k, v, seed, out, lse, do, causal, scale, block_q,
             if pad_q else out).reshape(B * H, Tq_pad, D)
     delta = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
                     axis=-1)                           # (B*H, Tq_pad)
+    if dlse is not None:
+        # lse cotangent folds into the delta term: the softmax backward is
+        # ds = p·(dp − Δ) and ∂lse/∂s = p, so ds = p·(dp − (Δ − dlse)) —
+        # the kernels need no change to support flash_attention_lse
+        dlf = jnp.pad(dlse.reshape(B * H, Tq),
+                      ((0, 0), (0, pad_q))) if pad_q \
+            else dlse.reshape(B * H, Tq)
+        delta = delta - dlf.astype(jnp.float32)
 
     smem_spec = _smem_spec()
     dq_kernel = functools.partial(
@@ -451,6 +459,56 @@ def _flash_core_bwd(causal, sm_scale, block_q, block_k, interpret,
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def _flash_lse_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                    interpret):
+    interpret = _default_interpret() if interpret is None else interpret
+    seed = jnp.zeros((1,), jnp.int32)
+    out, lse = _flash_forward(q, k, v, seed, causal, sm_scale, block_q,
+                              block_k, interpret, 0.0, want_lse=True)
+    B, H, Tq, _D = q.shape
+    return out, lse.reshape(B, H, -1)[:, :, :Tq]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_lse(q, k, v, causal=False, sm_scale=None, block_q=512,
+                        block_k=512, interpret=None):
+    """Flash attention returning (out, logsumexp) — the building block for
+    ring/context-parallel composition (parallel/ring.py): partial results
+    from different K/V shards merge exactly via their lse.  The lse
+    cotangent is honored (it folds into the backward's delta term)."""
+    return _flash_lse_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                           interpret)
+
+
+def _flash_lse_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    outs = _flash_lse_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                           interpret)
+    return outs, (q, k, v) + outs
+
+
+def _flash_lse_bwd(causal, sm_scale, block_q, block_k, interpret, res,
+                   cts):
+    q, k, v, out, lse = res
+    do, dlse = cts
+    interpret = _default_interpret() if interpret is None else interpret
+    D = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / (D ** 0.5)
+    B, H, Tq, _ = q.shape
+    bq = min(block_q, Tq)
+    nq = -(-Tq // bq)
+    lse_flat = jnp.pad(lse, ((0, 0), (0, 0), (0, nq * bq - Tq))) \
+        .reshape(B * H, nq * bq) if nq * bq != Tq \
+        else lse.reshape(B * H, Tq)
+    seed = jnp.zeros((1,), jnp.int32)
+    dq, dk, dv = _flash_backward(q, k, v, seed, out, lse_flat, do, causal,
+                                 scale, block_q, block_k, interpret, 0.0,
+                                 dlse=dlse)
+    return dq, dk, dv
+
+
+flash_attention_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=512,

@@ -49,8 +49,15 @@ def local_attention_block(q, k, v, bias=None, scale=None):
     return o, m[..., 0], l[..., 0]
 
 
-def _ring_attn_sharded(q, k, v, axis_name, causal, scale):
-    """Per-shard body (runs under shard_map).  q,k,v: local (B,H,T_loc,D)."""
+def _ring_attn_sharded(q, k, v, axis_name, causal, scale, impl="dense",
+                       block=512):
+    """Per-shard body (runs under shard_map).  q,k,v: local (B,H,T_loc,D).
+
+    impl='dense' materializes each visiting (T_loc, T_loc) score block;
+    impl='flash' runs the Pallas flash kernel per hop and merges the
+    normalized partials via their logsumexp (exact: softmax is associative
+    under lse reweighting) — O(T_loc·D) memory per hop, MXU matmuls
+    throughout, the ring-of-flash-blocks design for long context."""
     axis_size = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
     B, H, T, D = q.shape
@@ -64,6 +71,51 @@ def _ring_attn_sharded(q, k, v, axis_name, causal, scale):
         k_pos = kv_rank * T + jnp.arange(T)
         mask = q_pos[:, None] >= k_pos[None, :]
         return jnp.where(mask, 0.0, -1e30)[None, None]
+
+    if impl == "flash":
+        from ..ops.pallas_attention import flash_attention_lse
+
+        bq = min(block, T)
+
+        def flash_hop(k_cur, v_cur, kv_rank):
+            def hop(causal_flag):
+                o, l = flash_attention_lse(q, k_cur, v_cur, causal_flag,
+                                           scale_, bq, bq)
+                return o.astype(jnp.float32), l
+
+            if not causal:
+                return hop(False)
+
+            def skip(_):
+                return (jnp.zeros((B, H, T, D), jnp.float32),
+                        jnp.full((B, H, T), -jnp.inf, jnp.float32))
+
+            # diagonal hop: in-block causal; earlier ranks: fully visible;
+            # later ranks: fully masked
+            idx = jnp.where(kv_rank == rank, 0,
+                            jnp.where(kv_rank < rank, 1, 2))
+            return lax.switch(idx, [lambda _: hop(True),
+                                    lambda _: hop(False), skip], None)
+
+        def step_flash(carry, i):
+            o_acc, lse_acc, k_cur, v_cur = carry
+            kv_rank = (rank - i) % axis_size
+            o_blk, lse_blk = flash_hop(k_cur, v_cur, kv_rank)
+            lse_new = jnp.logaddexp(lse_acc, lse_blk)
+            w_a = jnp.exp(lse_acc - lse_new)
+            w_b = jnp.exp(lse_blk - lse_new)
+            o_acc = o_acc * w_a[..., None] + o_blk * w_b[..., None]
+            perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+            k_nxt = lax.ppermute(k_cur, axis_name, perm)
+            v_nxt = lax.ppermute(v_cur, axis_name, perm)
+            return (o_acc, lse_new, k_nxt, v_nxt), None
+
+        zero_q = (q * 0).astype(jnp.float32)
+        o0 = zero_q
+        lse0 = zero_q[..., 0] - jnp.inf
+        (o, _lse, _, _), _ = lax.scan(step_flash, (o0, lse0, k, v),
+                                      jnp.arange(axis_size))
+        return o.astype(q.dtype)
 
     def step(carry, i):
         o_acc, m_acc, l_acc, k_cur, v_cur = carry
@@ -97,18 +149,36 @@ def _ring_attn_sharded(q, k, v, axis_name, causal, scale):
 
 
 def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
-                   scale=None):
+                   scale=None, impl="dense", block=512):
     """Context-parallel attention.  q,k,v: (B, H, T, D) with T sharded over
     ``axis_name`` when called under pjit/shard_map; standalone call shards
-    internally over ``mesh``."""
+    internally over ``mesh``.  impl='flash' runs the Pallas flash kernel
+    per ring hop (see _ring_attn_sharded).
+
+    NB impl='flash' inside a CALLER-managed shard_map: pallas_call outputs
+    carry no varying-axes annotation, so the enclosing shard_map must be
+    created with ``check_vma=False`` (``check_rep=False`` on older jax) —
+    the mesh= path below does this automatically."""
     body = functools.partial(_ring_attn_sharded, axis_name=axis_name,
-                             causal=causal, scale=scale)
+                             causal=causal, scale=scale, impl=impl,
+                             block=block)
     if mesh is None:
         # assume we're already inside a shard_map context
         return body(q, k, v)
     spec = P(None, None, axis_name, None)
-    return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)(q, k, v)
+    kw = {}
+    if impl == "flash":
+        # pallas_call's out_shape carries no vma annotation; relax the
+        # shard_map varying-axes check for the kernel path
+        kw = {"check_vma": False}
+    try:
+        sm = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, **kw)
+    except TypeError:  # older jax: check_rep instead of check_vma
+        kw = {"check_rep": False} if kw else {}
+        sm = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, **kw)
+    return sm(q, k, v)
 
 
 def _ulysses_sharded(q, k, v, axis_name, causal, scale):
