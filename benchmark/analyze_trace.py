@@ -58,7 +58,11 @@ def direct_op_table(xplane, top=30):
             if not per_op:
                 continue
             rows = sorted(per_op.items(), key=lambda kv: -kv[1])[:top]
-            key = "%s :: %s" % (plane.name, line.name or line.id)
+            # line.id disambiguates identically-named lines (host thread
+            # pools routinely repeat names; a name-only key would drop
+            # every earlier line's durations)
+            key = "%s :: %s#%d" % (plane.name, line.name or "line",
+                                   line.id)
             report[key] = {
                 "total_ms": round(total / 1e9, 3),
                 "top_ops": [{"op": n, "ms": round(d / 1e9, 3),

@@ -162,22 +162,23 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
     body = functools.partial(_ring_attn_sharded, axis_name=axis_name,
                              causal=causal, scale=scale, impl=impl,
                              block=block)
+    if impl not in ("dense", "flash"):
+        raise ValueError("ring_attention impl must be 'dense' or 'flash', "
+                         "got %r" % (impl,))
     if mesh is None:
         # assume we're already inside a shard_map context
         return body(q, k, v)
     spec = P(None, None, axis_name, None)
-    kw = {}
     if impl == "flash":
-        # pallas_call's out_shape carries no vma annotation; relax the
-        # shard_map varying-axes check for the kernel path
-        kw = {"check_vma": False}
-    try:
+        # pallas_call's out_shape carries no vma annotation; use the
+        # version-portable relaxed shard_map (shared shim, _smap.py)
+        from ._smap import shard_map_compat
+
+        sm = shard_map_compat(body, mesh=mesh,
+                              in_specs=(spec, spec, spec), out_specs=spec)
+    else:
         sm = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, **kw)
-    except TypeError:  # older jax: check_rep instead of check_vma
-        kw = {"check_rep": False} if kw else {}
-        sm = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, **kw)
+                       out_specs=spec)
     return sm(q, k, v)
 
 

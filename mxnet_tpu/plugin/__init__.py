@@ -1,0 +1,14 @@
+"""Optional plugin bridges (reference plugin/{torch,warpctc,opencv,sframe}).
+
+The reference compiled these in as optional C++ op plugins.  Here:
+- ``plugin.torch``: a live PyTorch bridge (plugin/torch/torch_module.cc
+  equivalent) — wrap torch modules/functions as framework ops with a
+  differentiable host boundary.
+- warpctc's role is served by the built-in CTCLoss (ops/nn.py ctc_loss —
+  XLA-lowered, no plugin needed).
+- opencv's role is served by the native libjpeg pipeline + mx.image
+  (src/native/image.cc, image.py imdecode/imresize/copyMakeBorder).
+"""
+from . import torch  # noqa: F401
+
+__all__ = ["torch"]

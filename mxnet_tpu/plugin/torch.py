@@ -1,0 +1,133 @@
+"""PyTorch bridge (reference plugin/torch — TorchModule/TorchCriterion).
+
+The reference's torch plugin let users drop torch modules and criteria
+into MXNet graphs (plugin/torch/torch_module-inl.h, torch_criterion).
+TPU rendering: the bridge is a HOST boundary — torch (CPU) runs eagerly
+on numpy views of the arrays and the backward rides the autograd tape as
+a custom Function node, exactly how the reference pushed torch calls
+through its engine as opaque ops.  The compiled/hybridized path stays
+pure XLA; the bridge is for eager composition, preprocessing, and
+porting torch model pieces while migrating.
+
+    import torch as _t
+    from mxnet_tpu.plugin.torch import TorchBlock
+    blk = TorchBlock(_t.nn.Linear(4, 3))
+    y = blk(nd.array(x))            # differentiable through the bridge
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..autograd import Function
+from ..base import MXNetError
+from ..gluon.block import Block
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["TorchFunction", "TorchBlock", "torch_criterion"]
+
+
+def _torch():
+    try:
+        import torch
+    except ImportError as exc:  # pragma: no cover - torch is baked in
+        raise MXNetError("plugin.torch needs pytorch installed") from exc
+    return torch
+
+
+class TorchFunction(Function):
+    """Differentiable bridge around a torch callable.
+
+    Forward converts NDArray inputs to requires-grad torch tensors and
+    runs the callable; backward replays torch.autograd over the saved
+    graph.  Works under autograd.record like any framework op (the tape
+    node is the same custom-Function node the reference used for its
+    plugin ops; create_graph through it is rejected, as for every
+    non-retraceable Function)."""
+
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+        self._t_in = None
+        self._t_out = None
+
+    def forward(self, *inputs):
+        torch = _torch()
+        self._t_in = [torch.tensor(_np.asarray(x.asnumpy()),
+                                   requires_grad=True) for x in inputs]
+        out = self._fn(*self._t_in)
+        single = not isinstance(out, (tuple, list))
+        outs = [out] if single else list(out)
+        self._t_out = outs
+        nd_outs = [NDArray._from_np(o.detach().cpu().numpy())
+                   for o in outs]
+        return nd_outs[0] if single else tuple(nd_outs)
+
+    def backward(self, *output_grads):
+        torch = _torch()
+        grads = [torch.tensor(_np.asarray(g.asnumpy()))
+                 if g is not None else None for g in output_grads]
+        torch.autograd.backward(self._t_out, grads)
+        out = []
+        for t in self._t_in:
+            out.append(NDArray._from_np(
+                t.grad.cpu().numpy() if t.grad is not None
+                else _np.zeros(tuple(t.shape), _np.float32)))
+        return out[0] if len(out) == 1 else tuple(out)
+
+
+class TorchBlock(Block):
+    """Wrap a ``torch.nn.Module`` as a Gluon block (reference
+    TorchModuleOp).  The torch module owns its parameters; they train
+    THROUGH the bridge when the surrounding graph backprops into them —
+    call ``step_torch(lr)`` for a simple SGD update of the torch side, or
+    use a torch optimizer directly on ``module.parameters()``."""
+
+    def __init__(self, module):
+        super().__init__()
+        self.module = module
+
+    def forward(self, *args):
+        fn = TorchFunction(self.module)
+        out = fn(*args)
+        self._last_fn = fn
+        return out
+
+    def torch_parameters(self):
+        """Torch-side parameters as {name: NDArray} snapshots (the
+        reference exposed plugin params through the same arg-dict
+        surface)."""
+        return {n: NDArray._from_np(p.detach().cpu().numpy())
+                for n, p in self.module.named_parameters()}
+
+    def load_torch_parameters(self, named):
+        torch = _torch()
+        with torch.no_grad():
+            for n, p in self.module.named_parameters():
+                if n in named:
+                    v = named[n]
+                    arr = v.asnumpy() if isinstance(v, NDArray) else \
+                        _np.asarray(v)
+                    p.copy_(torch.tensor(arr))
+
+    def step_torch(self, lr):
+        """Apply accumulated torch grads (populated by backward through
+        the bridge) as one SGD step, then clear them."""
+        torch = _torch()
+        with torch.no_grad():
+            for p in self.module.parameters():
+                if p.grad is not None:
+                    p.add_(p.grad, alpha=-float(lr))
+                    p.grad = None
+
+
+def torch_criterion(criterion):
+    """Wrap a torch loss (reference TorchCriterion): returns
+    fn(pred_ndarray, label_ndarray) -> scalar NDArray, differentiable
+    w.r.t. pred."""
+
+    def loss_fn(pred, label):
+        fn = TorchFunction(
+            lambda p, l: criterion(p, l.detach()))
+        return fn(pred, label)
+
+    return loss_fn
