@@ -147,8 +147,11 @@ add(["_Div", "floor_divide", "remainder", "fmod", "_Mod"],
 add(["_Power", "float_power"], pos, rnd(0, 2), rtol=2e-2, atol=2e-2)
 add(["_Hypot", "arctan2", "copysign", "logaddexp"], rnd(), rnd())
 add(["_Equal", "_Not_Equal", "_Greater", "_Greater_Equal", "_Lesser",
-     "_Lesser_Equal", "_Logical_And", "_Logical_Or", "_Logical_Xor",
-     "isclose"], rnd(), rnd())
+     "_Lesser_Equal", "_Logical_And", "_Logical_Or", "_Logical_Xor"],
+    rnd(), rnd())
+# isclose's atol/rtol threshold is a discontinuity: integer-valued draws
+# keep every pair decisively close (equal) or far (>=1 apart) in all dtypes
+add("isclose", dint, dint)
 add(["bitwise_and", "bitwise_or", "bitwise_xor", "left_shift",
      "right_shift", "gcd", "lcm"], ints(1, 8), ints(1, 4), dtypes=I)
 add("ldexp", rnd(), ints(0, 3), int_args=(1,))
@@ -367,7 +370,9 @@ add("one_hot", ints(0, 5), attrs={"depth": 6}, shapes=[(4,), (2, 3)],
     dtypes=I)
 add("pick", rnd(), lambda s: ints(0, 4)((s[0],)), attrs={"axis": -1},
     shapes=[(4, 5), (3, 5)], int_args=(1,))
-add("searchsorted", lambda s: np.sort(_r(s[-1:])), rnd(),
+# bin edges/queries integer-valued: a query exactly between two edges
+# cannot flip sides under a low-precision cast
+add("searchsorted", lambda s: np.sort(dint(s[-1:]) * 4), dint,
     shapes=[(8,), (5,)])
 add("digitize", rnd(), lambda s: np.sort(_r((4,))), kind="run")
 add("bincount", ints(0, 6), shapes=[(10,), (20,)], dtypes=I)
