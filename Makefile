@@ -32,7 +32,7 @@ test: native
 
 # suite summary artifact (TESTS_r{N}.json) — round-2 advisor contract
 test-report:
-	python tools/test_report.py TESTS_r03.json
+	python tools/test_report.py TESTS_r04.json
 
 # LoC diagnostic — the EXACT command the round metrics use (round-2
 # advisor asked for reproducibility; excludes tests, includes native src)

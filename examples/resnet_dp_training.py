@@ -16,6 +16,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))  # runnable without installing the package
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    # honor the documented CPU invocation even on hosts where a TPU PJRT
+    # plugin is preloaded via sitecustomize (env vars alone don't stop
+    # its backend init; see _virtual_devices.py)
+    from _virtual_devices import force_virtual_cpu
+
+    force_virtual_cpu(8)
 
 import argparse
 import time

@@ -102,3 +102,19 @@ def test_model_zoo_pretrained_via_reference_fixture(tmp_path, monkeypatch):
     assert len(plain) == 4 and "mlp0_weight" in plain
     assert model_store is not None  # surface exists; full zoo weights are
     # gated on egress — the reference-format path above is what they ride
+
+
+def test_load_reference_sparse_csr():
+    """CSR record: aux dtypes/shapes + payloads parse into a CSRNDArray
+    with the right structure and values."""
+    out = nd.load(os.path.join(DATA, "ref_sparse.params"))
+    csr = out["csr"]
+    assert csr.stype == "csr"
+    assert csr.shape == (3, 3)
+    np.testing.assert_allclose(csr.data.asnumpy(), [1.5, 2.5, 3.5])
+    np.testing.assert_allclose(csr.indices.asnumpy(), [1, 0, 2])
+    np.testing.assert_allclose(csr.indptr.asnumpy(), [0, 1, 1, 3])
+    dense = csr.tostype("default").asnumpy()
+    np.testing.assert_allclose(
+        dense, [[0, 1.5, 0], [0, 0, 0], [2.5, 0, 3.5]])
+    np.testing.assert_allclose(out["dense"].asnumpy(), np.eye(2))

@@ -36,11 +36,41 @@ def write_tensor(out, arr):
     out.append(arr.tobytes())
 
 
+def write_csr_tensor(out, shape, data, indices, indptr):
+    """kCSRStorage record (ndarray.cc:1697 sparse branch): storage shape
+    (nnz), shape, context, dtype, aux dtypes+shapes, data, aux data."""
+    data = np.ascontiguousarray(data)
+    indices = np.ascontiguousarray(indices, dtype=np.int64)
+    indptr = np.ascontiguousarray(indptr, dtype=np.int64)
+    out.append(struct.pack("<I", 0xF993FAC9))      # V2
+    out.append(struct.pack("<i", 2))               # kCSRStorage
+    out.append(struct.pack("<i", 1))               # storage shape: (nnz,)
+    out.append(struct.pack("<q", data.shape[0]))
+    out.append(struct.pack("<i", len(shape)))
+    out.append(struct.pack("<%dq" % len(shape), *shape))
+    out.append(struct.pack("<ii", 1, 0))           # cpu(0)
+    flag = {"float32": 0, "float64": 1, "int64": 6}[str(data.dtype)]
+    out.append(struct.pack("<i", flag))
+    # aux 0 = indptr (int64, rows+1), aux 1 = indices (int64, nnz)
+    out.append(struct.pack("<i", 6))
+    out.append(struct.pack("<i", 1))
+    out.append(struct.pack("<q", indptr.shape[0]))
+    out.append(struct.pack("<i", 6))
+    out.append(struct.pack("<i", 1))
+    out.append(struct.pack("<q", indices.shape[0]))
+    out.append(data.tobytes())
+    out.append(indptr.tobytes())
+    out.append(indices.tobytes())
+
+
 def write_params(path, named):
     out = [struct.pack("<QQ", 0x112, 0),           # list magic + reserved
            struct.pack("<Q", len(named))]
     for _k, v in named:
-        write_tensor(out, v)
+        if isinstance(v, tuple):                   # (shape, data, idx, ptr)
+            write_csr_tensor(out, *v)
+        else:
+            write_tensor(out, v)
     out.append(struct.pack("<Q", len(named)))
     for k, _v in named:
         kb = k.encode()
@@ -101,6 +131,14 @@ def main():
         ("x", np.arange(6, dtype=np.float32).reshape(2, 3)),
         ("y", np.array([1, 2, 3], dtype=np.int64)),
         ("z", rs.rand(3, 1, 2).astype(np.float64)),
+    ])
+    # sparse csr record (reference sparse-aware save, ndarray.cc:1697):
+    # [[0, 1.5, 0], [0, 0, 0], [2.5, 0, 3.5]]
+    write_params(os.path.join(outdir, "ref_sparse.params"), [
+        ("csr", ((3, 3), np.array([1.5, 2.5, 3.5], np.float32),
+                 np.array([1, 0, 2], np.int64),
+                 np.array([0, 1, 1, 3], np.int64))),
+        ("dense", np.eye(2, dtype=np.float32)),
     ])
     print("fixtures written to", outdir)
 
