@@ -293,6 +293,9 @@ def _bench_resnet_infer(dtype="bfloat16", batch=32, iters=30):
     mx.random.seed(0)
     net = vision.resnet50_v1()
     net.initialize()
+    # one tiny forward resolves deferred-shape params before export_pure
+    from mxnet_tpu import nd as _nd
+    net(_nd.zeros((1, 3, 224, 224)))
     apply_fn, params = net.export_pure(training=False)
     if dtype != "float32":
         dt = jnp.dtype(dtype)

@@ -524,6 +524,12 @@ class HybridBlock(Block):
             return [o._data if isinstance(o, NDArray) else o
                     for o in flat_out], new_states
 
+        missing = [n for n, p in zip(names, params_list) if p._data is None]
+        if missing:
+            raise ValueError(
+                "export_pure: parameters %s are deferred-initialized (shape "
+                "unknown until the first forward). Run the block once on a "
+                "representative input before export_pure()." % missing[:5])
         return apply_fn, {n: p._data._data for n, p in zip(names,
                                                            params_list)}
 

@@ -172,7 +172,11 @@ def _flash_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
     a0 = jnp.zeros((block_q, D), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, a0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
+    # lse rides a (8, block_q) tile — Mosaic requires the last two block
+    # dims be (8k, 128k)-aligned, so a flat (1, block_q) row is illegal on
+    # real TPU; sublane-broadcast and let the caller slice row 0
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    lse_ref[0, 0] = jax.lax.broadcast_in_dim(lse, (8, block_q), (1,))
 
 
 def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -183,8 +187,8 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     qi = pl.program_id(1)
     qs = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0, 0, 0, :]           # row 0 of the (8, block_q) tile
+    delta = delta_ref[0, 0, 0, :]
     D = qs.shape[-1]
     nk = pl.cdiv(seq_k, block_k)
     if causal:
@@ -239,8 +243,8 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         qs = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(
             jnp.float32) * scale
         do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qi * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(qi * block_q, block_q)]
+        lse = lse_ref[0, qi, 0, :]      # (nq, 8, block_q) layout, row 0
+        delta = delta_ref[0, qi, 0, :]
         s = jax.lax.dot_general(qs, kblk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         k_idx = j * block_k + jax.lax.broadcasted_iota(
@@ -334,14 +338,15 @@ def _flash_forward(q, k, v, seed, causal, sm_scale, block_q, block_k,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, 1, 8, block_q), lambda b, i: (b, i, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, nq * block_q, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, nq * block_q), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, nq, 8, block_q), jnp.float32),
         ],
         interpret=interpret,
     )(seed, qf, kf, vf)
+    lse = lse[:, :, 0, :].reshape(B * H, nq * block_q)
     outr = out.reshape(B, H, nq * block_q, D)
     if pad_q:
         outr = outr[:, :, :Tq]
@@ -375,6 +380,15 @@ def _flash_backward(q, k, v, seed, out, lse, do, causal, scale, block_q,
             else dlse.reshape(B * H, Tq)
         delta = delta - dlf.astype(jnp.float32)
 
+    # widen lse/delta rows to the (nq, 8, block_q) tile layout the kernels
+    # read (see _flash_kernel's lse note)
+    def _widen(x):
+        x = x.reshape(B * H, nq, 1, block_q)
+        return jnp.broadcast_to(x, (B * H, nq, 8, block_q))
+
+    lse4 = _widen(lse)
+    delta4 = _widen(delta)
+
     smem_spec = _smem_spec()
     dq_kernel = functools.partial(
         _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
@@ -389,13 +403,13 @@ def _flash_backward(q, k, v, seed, out, lse, do, causal, scale, block_q,
             pl.BlockSpec((1, Tk_pad, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, Tk_pad, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, 1, 8, block_q), lambda b, i: (b, i, 0, 0)),
+            pl.BlockSpec((1, 1, 8, block_q), lambda b, i: (b, i, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Tq_pad, D), q.dtype),
         interpret=interpret,
-    )(seed, qf, kf, vf, dof, lse, delta)
+    )(seed, qf, kf, vf, dof, lse4, delta4)
 
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
@@ -410,8 +424,8 @@ def _flash_backward(q, k, v, seed, out, lse, do, causal, scale, block_q,
             pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, Tq_pad, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, Tq_pad), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, Tq_pad), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, nq, 8, block_q), lambda b, j: (b, 0, 0, 0)),
+            pl.BlockSpec((1, nq, 8, block_q), lambda b, j: (b, 0, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
@@ -422,7 +436,7 @@ def _flash_backward(q, k, v, seed, out, lse, do, causal, scale, block_q,
             jax.ShapeDtypeStruct((B * H, Tk_pad, D), v.dtype),
         ],
         interpret=interpret,
-    )(seed, qf, kf, vf, dof, lse, delta)
+    )(seed, qf, kf, vf, dof, lse4, delta4)
 
     dq = dq.reshape(B, H, Tq_pad, D)[:, :, :Tq]
     dk = dk.reshape(B, H, Tk_pad, D)[:, :, :Tk]

@@ -321,3 +321,137 @@ def test_transforms_hue_gray_apply():
     np.testing.assert_allclose(g[..., 0], g[..., 1], rtol=1e-4)
     ra = T.RandomApply(T.RandomGray(1.0), p=0.0)
     np.testing.assert_allclose(ra(img).asnumpy(), img.asnumpy())
+
+
+# ---------------------------------------------------------------------------
+# process-worker path (reference _MultiWorkerIter, dataloader.py:513)
+# ---------------------------------------------------------------------------
+
+class _SquareDataset(gdata.Dataset):
+    """Module-level so 'spawn' contexts could pickle it too."""
+
+    def __init__(self, n):
+        self._n = n
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, idx):
+        return (np.full((3,), idx, np.float32),
+                np.float32(idx * idx))
+
+
+def test_multiworker_process_ordering():
+    ds = _SquareDataset(37)
+    loader = gdata.DataLoader(ds, batch_size=5, num_workers=3,
+                              last_batch="keep")
+    got_x, got_y = [], []
+    for bx, by in loader:
+        got_x.append(bx.asnumpy())
+        got_y.append(by.asnumpy())
+    x = np.concatenate(got_x)
+    y = np.concatenate(got_y)
+    assert x.shape == (37, 3)
+    np.testing.assert_allclose(x[:, 0], np.arange(37))
+    np.testing.assert_allclose(y, np.arange(37) ** 2)
+
+
+def test_multiworker_process_reentrant_and_shuffle():
+    ds = _SquareDataset(24)
+    loader = gdata.DataLoader(ds, batch_size=4, num_workers=2, shuffle=True)
+    for _ in range(2):  # iterating twice spawns fresh workers each time
+        seen = np.concatenate([b[0].asnumpy()[:, 0] for b in loader])
+        assert sorted(seen.tolist()) == list(range(24))
+
+
+class _FailingDataset(gdata.Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, idx):
+        if idx == 5:
+            raise ValueError("boom at 5")
+        return np.zeros(2, np.float32)
+
+
+def test_multiworker_process_error_propagates():
+    loader = gdata.DataLoader(_FailingDataset(), batch_size=4,
+                              num_workers=2)
+    with pytest.raises(RuntimeError, match="boom at 5"):
+        list(loader)
+
+
+def test_multiworker_shm_segments_cleaned_up():
+    import glob
+    before = set(glob.glob("/dev/shm/psm_*"))
+    ds = _SquareDataset(20)
+    loader = gdata.DataLoader(ds, batch_size=4, num_workers=2)
+    list(loader)
+    import gc, time
+    leaked = set()
+    for _ in range(10):  # retry: concurrent processes may hold transients
+        gc.collect()
+        leaked = set(glob.glob("/dev/shm/psm_*")) - before
+        if not leaked:
+            break
+        time.sleep(0.3)
+    assert not leaked, f"leaked shm segments: {leaked}"
+
+
+class _GilBoundDataset(gdata.Dataset):
+    """Pure-python per-sample work: the workload class that cannot scale
+    on the thread pool (holds the GIL) and must on processes."""
+
+    def __init__(self, n, iters=20000):
+        self._n, self._iters = n, iters
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, idx):
+        acc = 0
+        for i in range(self._iters):  # pure-python loop, GIL-bound
+            acc = (acc + i * idx) % 1000003
+        return np.full((4,), acc, np.float32)
+
+
+@pytest.mark.skipif(len(os.sched_getaffinity(0)) < 4,
+                    reason="needs >=4 cores to demonstrate scaling")
+def test_multiworker_process_scaling():
+    """VERDICT r4 item 2 done-bar: >=2.5x at num_workers=4 vs 1 on a
+    pure-python transform."""
+    import time
+    ds = _GilBoundDataset(64)
+
+    def run(workers):
+        loader = gdata.DataLoader(ds, batch_size=8, num_workers=workers)
+        t0 = time.perf_counter()
+        n = sum(b.shape[0] for b in loader)
+        assert n == 64
+        return time.perf_counter() - t0
+
+    run(1)  # warmup fork machinery
+    t1 = min(run(1) for _ in range(3))  # best-of-3: tolerate CI noise
+    t4 = min(run(4) for _ in range(3))
+    assert t1 / t4 >= 2.5, f"scaling {t1 / t4:.2f}x < 2.5x (t1={t1:.2f}s t4={t4:.2f}s)"
+
+
+def test_thread_pool_option_still_works():
+    ds = _SquareDataset(16)
+    loader = gdata.DataLoader(ds, batch_size=4, num_workers=2,
+                              thread_pool=True)
+    x = np.concatenate([b[0].asnumpy()[:, 0] for b in loader])
+    assert sorted(x.tolist()) == list(range(16))
+
+
+def test_multiworker_unpicklable_falls_back_to_threads():
+    """Closures/open handles can't cross forkserver pickling; the loader
+    must degrade to thread workers (the pre-process-worker behavior)."""
+    import warnings
+    ds = gdata.SimpleDataset(list(range(12))).transform(lambda x: x * 2.0)
+    loader = gdata.DataLoader(ds, batch_size=4, num_workers=2)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = np.concatenate([b.asnumpy() for b in loader])
+    assert sorted(out.tolist()) == [2.0 * i for i in range(12)]
+    assert any("not picklable" in str(x.message) for x in w)
