@@ -1,16 +1,29 @@
 """``mx.contrib.onnx`` — ONNX export/import.
 
 Reference capability: python/mxnet/contrib/onnx (~8k LoC of op-by-op
-mx2onnx/onnx2mx converters).
+mx2onnx/onnx2mx converters over MXNet op names).
 
-TPU-native build: layer-structured Gluon nets (Sequential trees of the
-standard layers) export to real ONNX ModelProto files written with the
-bundled wire-format codec (_proto.py — no onnx package in this
-environment), and such files import back into runnable Gluon nets with
-weights.  ``export_model``/``import_model`` keep the reference entry-point
-names.
+TPU-native build (no ``onnx`` package in the image; ModelProto rides the
+bundled wire-format codec _proto.py):
+
+* export: ``export_model`` traces ANY Gluon net through
+  ``export_pure`` into a jaxpr and converts primitive-by-primitive
+  (jaxpr2onnx.py) — residual DAGs, branches, attention all export; the
+  layer-structural path (mx2onnx.py) covers lax.scan RNNs with real
+  ONNX LSTM/GRU/RNN nodes and ConvTranspose.
+* import: ``import_model`` returns an ``OnnxGraphBlock`` interpreting
+  the node DAG through the framework's recorded ops — hybridizable,
+  differentiable, opset-portable (attr-vs-input forms normalized).
+
+``export_model``/``import_model``/``get_model_metadata`` keep the
+reference entry-point names.
 """
 from .mx2onnx import export_model  # noqa: F401
-from .onnx2mx import import_model  # noqa: F401
+from .onnx2mx import (  # noqa: F401
+    get_model_metadata,
+    import_model,
+    import_to_layers,
+)
 
-__all__ = ["export_model", "import_model"]
+__all__ = ["export_model", "import_model", "import_to_layers",
+           "get_model_metadata"]

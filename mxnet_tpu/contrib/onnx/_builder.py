@@ -1,0 +1,264 @@
+"""Shared ONNX graph construction/parsing helpers.
+
+Both exporters (the layer-structural one in mx2onnx.py and the
+jaxpr-graph one in jaxpr2onnx.py) and the importer (onnx2mx.py) build on
+these.  Encodes/decodes the ModelProto subset on the raw protobuf wire
+format via _proto.py (no ``onnx`` package in the image).
+
+Reference surface: /root/reference/python/mxnet/contrib/onnx/ (mx2onnx
+_export_helper + onnx2mx _import_helper); redesigned here around a typed
+TensorProto codec so initializers round-trip in every dtype the
+framework produces (f32/f16/bf16/ints/bool) instead of float32-only.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as _np
+
+from ...base import MXNetError
+from . import _proto
+
+# ONNX TensorProto.DataType enum
+FLOAT = 1
+UINT8 = 2
+INT8 = 3
+UINT16 = 4
+INT16 = 5
+INT32 = 6
+INT64 = 7
+STRING = 8
+BOOL = 9
+FLOAT16 = 10
+DOUBLE = 11
+UINT32 = 12
+UINT64 = 13
+BFLOAT16 = 16
+
+_NP2ONNX = {
+    "float32": FLOAT, "uint8": UINT8, "int8": INT8, "uint16": UINT16,
+    "int16": INT16, "int32": INT32, "int64": INT64, "bool": BOOL,
+    "float16": FLOAT16, "float64": DOUBLE, "uint32": UINT32,
+    "uint64": UINT64, "bfloat16": BFLOAT16,
+}
+_ONNX2NP = {v: k for k, v in _NP2ONNX.items()}
+
+# AttributeProto.AttributeType
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR = 1, 2, 3, 4
+ATTR_FLOATS, ATTR_INTS, ATTR_STRINGS = 6, 7, 8
+
+
+def onnx_dtype(np_dtype):
+    key = str(_np.dtype(np_dtype)) if str(np_dtype) != "bfloat16" else \
+        "bfloat16"
+    # jax bfloat16 reports as 'bfloat16' via ml_dtypes
+    key = str(np_dtype) if "bfloat16" in str(np_dtype) else key
+    code = _NP2ONNX.get(key)
+    if code is None:
+        raise MXNetError("onnx: unsupported dtype %s" % (np_dtype,))
+    return code
+
+
+def np_dtype(onnx_code):
+    name = _ONNX2NP.get(int(onnx_code))
+    if name is None:
+        raise MXNetError("onnx: unsupported TensorProto dtype %d"
+                         % onnx_code)
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return _np.dtype(ml_dtypes.bfloat16)
+    return _np.dtype(name)
+
+
+def tensor(name, arr):
+    """Encode one TensorProto (any supported dtype, raw_data layout)."""
+    arr = _np.ascontiguousarray(arr)
+    code = onnx_dtype(arr.dtype)
+    w = _proto.Writer()
+    for d in arr.shape:
+        w.varint(1, d)            # dims
+    w.varint(2, code)             # data_type
+    w.string(8, name)             # name
+    w.string(9, arr.tobytes())    # raw_data
+    return w
+
+
+def parse_tensor(buf):
+    """Decode one TensorProto -> (name, np.ndarray).  Handles raw_data of
+    every supported dtype plus the typed repeated fields (float_data=4,
+    int32_data=5, int64_data=7, double_data=10) other exporters emit."""
+    f = _proto.parse(buf)
+    dims = _proto.get_packed_ints(f, 1)
+    code = _proto.get_int(f, 2, FLOAT)
+    name = _proto.get_str(f, 8)
+    dt = np_dtype(code)
+    raw = f.get(9)
+    if raw:
+        arr = _np.frombuffer(raw[0][1], dtype=dt).copy()
+    elif code in (FLOAT, FLOAT16, BFLOAT16):
+        arr = _np.asarray(_proto.get_packed_floats(f, 4),
+                          _np.float32).astype(dt)
+    elif code == DOUBLE:
+        vals = []
+        for wtype, v in f.get(10, []):
+            if wtype == 1:
+                vals.append(v)
+            else:
+                vals.extend(struct.unpack("<%dd" % (len(v) // 8), v))
+        arr = _np.asarray(vals, _np.float64)
+    elif code == INT64:
+        arr = _np.asarray(_proto.get_packed_ints(f, 7), _np.int64)
+    else:  # int32_data carries every narrow int/bool dtype
+        arr = _np.asarray(_proto.get_packed_ints(f, 5),
+                          _np.int64).astype(dt)
+    return name, arr.reshape(dims)
+
+
+# ---- attributes ------------------------------------------------------------
+
+def attr_int(name, value):
+    return (_proto.Writer().string(1, name).varint(3, int(value))
+            .varint(20, ATTR_INT))
+
+
+def attr_ints(name, values):
+    return (_proto.Writer().string(1, name).ints_packed(8, values)
+            .varint(20, ATTR_INTS))
+
+
+def attr_float(name, value):
+    return (_proto.Writer().string(1, name).float32(2, float(value))
+            .varint(20, ATTR_FLOAT))
+
+
+def attr_floats(name, values):
+    return (_proto.Writer().string(1, name).floats_packed(7, values)
+            .varint(20, ATTR_FLOATS))
+
+
+def attr_string(name, value):
+    return (_proto.Writer().string(1, name).string(4, value)
+            .varint(20, ATTR_STRING))
+
+
+def attr_strings(name, values):
+    w = _proto.Writer().string(1, name)
+    for v in values:
+        w.string(9, v)
+    return w.varint(20, ATTR_STRINGS)
+
+
+def attr_tensor(name, arr):
+    return (_proto.Writer().string(1, name).message(5, tensor("", arr))
+            .varint(20, ATTR_TENSOR))
+
+
+def _auto_attr(name, value):
+    if isinstance(value, bool):
+        return attr_int(name, int(value))
+    if isinstance(value, int):
+        return attr_int(name, value)
+    if isinstance(value, float):
+        return attr_float(name, value)
+    if isinstance(value, str):
+        return attr_string(name, value)
+    if isinstance(value, _np.ndarray):
+        return attr_tensor(name, value)
+    if isinstance(value, (list, tuple)):
+        if all(isinstance(v, (int, _np.integer)) for v in value):
+            return attr_ints(name, value)
+        return attr_floats(name, value)
+    raise MXNetError("onnx: cannot encode attribute %s=%r" % (name, value))
+
+
+def node(op_type, inputs, outputs, name, attrs=None):
+    """Encode one NodeProto.  ``attrs`` is a {name: python value} dict
+    (auto-typed) or an iterable of pre-encoded attribute Writers."""
+    w = _proto.Writer()
+    for i in inputs:
+        w.string(1, i)
+    for o in outputs:
+        w.string(2, o)
+    w.string(3, name)
+    w.string(4, op_type)
+    if isinstance(attrs, dict):
+        attrs = [_auto_attr(k, v) for k, v in attrs.items()]
+    for a in (attrs or ()):
+        w.message(5, a)
+    return w
+
+
+def value_info(name, shape, elem_type=FLOAT):
+    dims = _proto.Writer()
+    for d in shape:
+        if isinstance(d, str):            # symbolic dim (dim_param)
+            dims.message(1, _proto.Writer().string(2, d))
+        else:
+            dims.message(1, _proto.Writer().varint(1, int(d)))
+    ttype = _proto.Writer().varint(1, elem_type).message(2, dims)
+    typ = _proto.Writer().message(1, ttype)
+    return _proto.Writer().string(1, name).message(2, typ)
+
+
+class GraphBuilder:
+    """Accumulates nodes/initializers/IO and assembles a ModelProto."""
+
+    def __init__(self, opset=13):
+        self.nodes = []
+        self.inits = []
+        self.inputs = []   # (name, shape, elem_type)
+        self.outputs = []  # (name, shape, elem_type)
+        self.opset = opset
+        self._counter = 0
+        self._init_names = set()
+
+    def uniq(self, base="t"):
+        self._counter += 1
+        return "%s_%d" % (base, self._counter)
+
+    def require_opset(self, version):
+        self.opset = max(self.opset, version)
+
+    def add_initializer(self, arr, name=None):
+        name = name if name is not None else self.uniq("const")
+        if name in self._init_names:
+            return name
+        self._init_names.add(name)
+        self.inits.append(tensor(name, _np.asarray(arr)))
+        return name
+
+    def const_i64(self, values, name_hint="shape"):
+        return self.add_initializer(
+            _np.asarray(values, _np.int64), self.uniq(name_hint))
+
+    def add_node(self, op_type, inputs, attrs=None, n_out=1, outputs=None):
+        outs = outputs or [self.uniq(op_type.lower())
+                           for _ in range(n_out)]
+        self.nodes.append(node(op_type, inputs, outs,
+                               self.uniq(op_type), attrs))
+        return outs[0] if n_out == 1 and outputs is None else outs
+
+    def graph(self, name):
+        g = _proto.Writer()
+        for n in self.nodes:
+            g.message(1, n)
+        g.string(2, name)
+        for t in self.inits:
+            g.message(5, t)
+        for nm, shape, et in self.inputs:
+            g.message(11, value_info(nm, shape, et))
+        for nm, shape, et in self.outputs:
+            g.message(12, value_info(nm, shape, et))
+        return g
+
+    def model(self, name="mxnet_tpu_model", producer="mxnet_tpu"):
+        opset = _proto.Writer().string(1, "").varint(2, self.opset)
+        return (_proto.Writer().varint(1, 8)     # ir_version
+                .string(2, producer)
+                .message(7, self.graph(name)).message(8, opset))
+
+    def save(self, path, name="mxnet_tpu_model"):
+        with open(path, "wb") as f:
+            f.write(self.model(name).bytes())
+        return path

@@ -1,0 +1,704 @@
+"""Graph ONNX exporter: jaxpr primitives -> ONNX nodes.
+
+The reference exporter walks an nnvm symbol graph op-by-op
+(/root/reference/python/mxnet/contrib/onnx/mx2onnx/_op_translations.py,
+~100 converters over MXNet op names).  The TPU-native equivalent works
+one level lower: any model — arbitrary DAG, residual adds, branches,
+attention — is traced through ``HybridBlock.export_pure`` into a jaxpr,
+and each *jax primitive* is translated to ONNX.  One converter table
+covers every model expressible in the framework instead of one per
+front-end op, and fidelity is exact because the jaxpr IS the computation
+XLA runs.
+
+Inference-mode export (training=False), static shapes from the example
+input; higher-order primitives (pjit/custom_jvp/remat) are inlined.
+``lax.scan`` (fused RNN layers) has no faithful feed-forward expansion —
+those models export through the layer-structural path in mx2onnx.py,
+which emits real ONNX LSTM/GRU/RNN nodes.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+from . import _builder as _b
+
+_EMIT = {}
+
+
+def _emits(*names):
+    def deco(fn):
+        for n in names:
+            _EMIT[n] = fn
+        return fn
+    return deco
+
+
+class _Ctx:
+    """Conversion context: name environment over one jaxpr."""
+
+    def __init__(self, builder):
+        self.b = builder
+        self.env = {}
+
+    def name_of(self, atom):
+        import jax.extend.core
+
+        if isinstance(atom, jax.extend.core.Literal):
+            val = _np.asarray(atom.val, dtype=atom.aval.dtype)
+            return self.b.add_initializer(val)
+        return self.env[atom]
+
+    def set(self, var, name):
+        self.env[var] = name
+
+    def avalshape(self, atom):
+        return tuple(atom.aval.shape)
+
+    def dtype(self, atom):
+        return atom.aval.dtype
+
+
+def _ident(ctx, eqn, ins):
+    return ins[0]
+
+
+# ---- elementwise ----------------------------------------------------------
+
+_DIRECT = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "pow": "Pow",
+    "neg": "Neg", "abs": "Abs", "exp": "Exp", "log": "Log",
+    "tanh": "Tanh", "logistic": "Sigmoid", "sqrt": "Sqrt",
+    "sign": "Sign", "floor": "Floor", "ceil": "Ceil", "round": "Round",
+    "erf": "Erf", "is_finite": None,  # handled below
+    "sin": "Sin", "cos": "Cos", "tan": "Tan", "asin": "Asin",
+    "acos": "Acos", "atan": "Atan", "sinh": "Sinh", "cosh": "Cosh",
+    "asinh": "Asinh", "acosh": "Acosh", "atanh": "Atanh",
+    "and": "And", "or": "Or", "xor": "Xor", "not": "Not",
+    "eq": "Equal", "lt": "Less", "le": "LessOrEqual",
+    "gt": "Greater", "ge": "GreaterOrEqual",
+}
+
+
+def _emit_direct(ctx, eqn, ins):
+    return ctx.b.add_node(_DIRECT[eqn.primitive.name], ins)
+
+
+for _n, _o in _DIRECT.items():
+    if _o is not None:
+        _EMIT[_n] = _emit_direct
+
+
+@_emits("ne")
+def _ne(ctx, eqn, ins):
+    return ctx.b.add_node("Not", [ctx.b.add_node("Equal", ins)])
+
+
+@_emits("is_finite")
+def _isfinite(ctx, eqn, ins):
+    # IsInf | IsNaN, inverted
+    isinf = ctx.b.add_node("IsInf", ins)
+    isnan = ctx.b.add_node("IsNaN", ins)
+    return ctx.b.add_node("Not", [ctx.b.add_node("Or", [isinf, isnan])])
+
+
+@_emits("rsqrt")
+def _rsqrt(ctx, eqn, ins):
+    return ctx.b.add_node("Reciprocal", [ctx.b.add_node("Sqrt", ins)])
+
+
+@_emits("cbrt")
+def _cbrt(ctx, eqn, ins):
+    third = ctx.b.add_initializer(
+        _np.asarray(1.0 / 3.0, ctx.dtype(eqn.invars[0])))
+    return ctx.b.add_node("Pow", [ins[0], third])
+
+
+@_emits("log1p")
+def _log1p(ctx, eqn, ins):
+    one = ctx.b.add_initializer(_np.asarray(1.0, ctx.dtype(eqn.invars[0])))
+    return ctx.b.add_node("Log", [ctx.b.add_node("Add", [ins[0], one])])
+
+
+@_emits("expm1")
+def _expm1(ctx, eqn, ins):
+    one = ctx.b.add_initializer(_np.asarray(1.0, ctx.dtype(eqn.invars[0])))
+    return ctx.b.add_node("Sub", [ctx.b.add_node("Exp", ins), one])
+
+
+@_emits("integer_pow")
+def _integer_pow(ctx, eqn, ins):
+    y = ctx.b.add_initializer(
+        _np.asarray(eqn.params["y"], ctx.dtype(eqn.invars[0])))
+    return ctx.b.add_node("Pow", [ins[0], y])
+
+
+@_emits("rem")
+def _rem(ctx, eqn, ins):
+    return ctx.b.add_node("Mod", ins, {"fmod": 1})
+
+
+@_emits("clamp")
+def _clamp(ctx, eqn, ins):
+    # lax.clamp(min, x, max) -> Clip(x, min, max); Clip requires scalars
+    lo, x, hi = ins
+    if ctx.avalshape(eqn.invars[0]) != () or \
+            ctx.avalshape(eqn.invars[2]) != ():
+        lo_b = ctx.b.add_node("Max", [lo, x])
+        return ctx.b.add_node("Min", [hi, lo_b])
+    return ctx.b.add_node("Clip", [x, lo, hi])
+
+
+@_emits("select_n")
+def _select_n(ctx, eqn, ins):
+    if len(ins) != 3:
+        raise MXNetError("onnx export: select_n with %d cases" % (
+            len(ins) - 1))
+    # select_n(pred, on_false, on_true): Where picks X when cond is true
+    return ctx.b.add_node("Where", [ins[0], ins[2], ins[1]])
+
+
+@_emits("convert_element_type")
+def _convert(ctx, eqn, ins):
+    to = _b.onnx_dtype(eqn.params["new_dtype"])
+    return ctx.b.add_node("Cast", ins, {"to": to})
+
+
+@_emits("stop_gradient", "copy")
+def _copy(ctx, eqn, ins):
+    return ctx.b.add_node("Identity", ins)
+
+
+@_emits("device_put")
+def _device_put(ctx, eqn, ins):
+    return list(ins)
+
+
+@_emits("square")
+def _square(ctx, eqn, ins):
+    return ctx.b.add_node("Mul", [ins[0], ins[0]])
+
+
+# ---- shape ops ------------------------------------------------------------
+
+@_emits("reshape")
+def _reshape(ctx, eqn, ins):
+    src = ins[0]
+    if eqn.params.get("dimensions") is not None:
+        src = ctx.b.add_node(
+            "Transpose", [src],
+            {"perm": list(eqn.params["dimensions"])})
+    shape = ctx.b.const_i64(eqn.params["new_sizes"])
+    return ctx.b.add_node("Reshape", [src, shape])
+
+
+@_emits("transpose")
+def _transpose(ctx, eqn, ins):
+    return ctx.b.add_node("Transpose", ins,
+                          {"perm": list(eqn.params["permutation"])})
+
+
+@_emits("squeeze")
+def _squeeze(ctx, eqn, ins):
+    axes = ctx.b.const_i64(list(eqn.params["dimensions"]), "axes")
+    return ctx.b.add_node("Squeeze", [ins[0], axes])
+
+
+@_emits("expand_dims")
+def _expand_dims(ctx, eqn, ins):
+    axes = ctx.b.const_i64(list(eqn.params["dimensions"]), "axes")
+    return ctx.b.add_node("Unsqueeze", [ins[0], axes])
+
+
+@_emits("broadcast_in_dim")
+def _broadcast_in_dim(ctx, eqn, ins):
+    target = tuple(eqn.params["shape"])
+    bdims = tuple(eqn.params["broadcast_dimensions"])
+    in_shape = ctx.avalshape(eqn.invars[0])
+    if in_shape == target:
+        return ins[0]
+    interim = [1] * len(target)
+    for src_axis, dst_axis in enumerate(bdims):
+        interim[dst_axis] = in_shape[src_axis]
+    cur = ins[0]
+    if tuple(interim) != in_shape:
+        cur = ctx.b.add_node(
+            "Reshape", [cur, ctx.b.const_i64(interim)])
+    if tuple(interim) != target:
+        cur = ctx.b.add_node(
+            "Expand", [cur, ctx.b.const_i64(target)])
+    return cur
+
+
+@_emits("concatenate")
+def _concat(ctx, eqn, ins):
+    return ctx.b.add_node("Concat", ins,
+                          {"axis": int(eqn.params["dimension"])})
+
+
+@_emits("slice")
+def _slice(ctx, eqn, ins):
+    starts = list(eqn.params["start_indices"])
+    ends = list(eqn.params["limit_indices"])
+    strides = eqn.params.get("strides")
+    strides = list(strides) if strides is not None else [1] * len(starts)
+    axes = list(range(len(starts)))
+    return ctx.b.add_node("Slice", [
+        ins[0], ctx.b.const_i64(starts, "starts"),
+        ctx.b.const_i64(ends, "ends"), ctx.b.const_i64(axes, "axes"),
+        ctx.b.const_i64(strides, "steps")])
+
+
+@_emits("rev")
+def _rev(ctx, eqn, ins):
+    axes = list(eqn.params["dimensions"])
+    n = len(axes)
+    int64_min = -(1 << 63)
+    return ctx.b.add_node("Slice", [
+        ins[0], ctx.b.const_i64([-1] * n, "starts"),
+        ctx.b.const_i64([int64_min + 1] * n, "ends"),
+        ctx.b.const_i64(axes, "axes"),
+        ctx.b.const_i64([-1] * n, "steps")])
+
+
+@_emits("pad")
+def _pad(ctx, eqn, ins):
+    cfg = list(eqn.params["padding_config"])
+    if any(i != 0 for _lo, _hi, i in cfg):
+        raise MXNetError("onnx export: interior padding not representable")
+    rank = len(cfg)
+    pos_begin = [max(lo, 0) for lo, _hi, _i in cfg]
+    pos_end = [max(hi, 0) for _lo, hi, _i in cfg]
+    cur = ins[0]
+    if any(pos_begin) or any(pos_end):
+        pads = ctx.b.const_i64(pos_begin + pos_end, "pads")
+        cur = ctx.b.add_node("Pad", [cur, pads, ins[1]],
+                             {"mode": "constant"})
+    neg_begin = [max(-lo, 0) for lo, _hi, _i in cfg]
+    neg_end = [max(-hi, 0) for _lo, hi, _i in cfg]
+    if any(neg_begin) or any(neg_end):
+        shape_after = [
+            s + max(lo, 0) + max(hi, 0)
+            for s, (lo, hi, _i) in zip(ctx.avalshape(eqn.invars[0]), cfg)]
+        starts = neg_begin
+        ends = [s - e for s, e in zip(shape_after, neg_end)]
+        cur = ctx.b.add_node("Slice", [
+            cur, ctx.b.const_i64(starts, "starts"),
+            ctx.b.const_i64(ends, "ends"),
+            ctx.b.const_i64(list(range(rank)), "axes"),
+            ctx.b.const_i64([1] * rank, "steps")])
+    return cur
+
+
+@_emits("iota")
+def _iota(ctx, eqn, ins):
+    shape = tuple(eqn.params["shape"])
+    dim = int(eqn.params["dimension"])
+    dtype = eqn.params["dtype"]
+    if int(_np.prod(shape)) > 10_000_000:
+        raise MXNetError("onnx export: iota of %s too large to embed"
+                         % (shape,))
+    rng = _np.arange(shape[dim])
+    view = [1] * len(shape)
+    view[dim] = shape[dim]
+    arr = _np.broadcast_to(rng.reshape(view), shape).astype(dtype)
+    return ctx.b.add_initializer(arr, ctx.b.uniq("iota"))
+
+
+# ---- contractions ---------------------------------------------------------
+
+_LETTERS = "abcdefghijklmnopqrstuvwxyz"
+
+
+@_emits("dot_general")
+def _dot_general(ctx, eqn, ins):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs_rank = len(ctx.avalshape(eqn.invars[0]))
+    rhs_rank = len(ctx.avalshape(eqn.invars[1]))
+    next_letter = iter(_LETTERS)
+    lhs_sub = [None] * lhs_rank
+    rhs_sub = [None] * rhs_rank
+    for li, ri in zip(lb, rb):
+        c = next(next_letter)
+        lhs_sub[li] = c
+        rhs_sub[ri] = c
+    for li, ri in zip(lc, rc):
+        c = next(next_letter)
+        lhs_sub[li] = c
+        rhs_sub[ri] = c
+    for i in range(lhs_rank):
+        if lhs_sub[i] is None:
+            lhs_sub[i] = next(next_letter)
+    for i in range(rhs_rank):
+        if rhs_sub[i] is None:
+            rhs_sub[i] = next(next_letter)
+    out_sub = ([lhs_sub[i] for i in lb]
+               + [lhs_sub[i] for i in range(lhs_rank)
+                  if i not in lb and i not in lc]
+               + [rhs_sub[i] for i in range(rhs_rank)
+                  if i not in rb and i not in rc])
+    eq = "%s,%s->%s" % ("".join(lhs_sub), "".join(rhs_sub),
+                        "".join(out_sub))
+    lhs, rhs = ins
+    in_dt = ctx.dtype(eqn.invars[0])
+    out = ctx.b.add_node("Einsum", [lhs, rhs], {"equation": eq})
+    out_dt = eqn.outvars[0].aval.dtype
+    if out_dt != in_dt:
+        out = ctx.b.add_node("Cast", [out],
+                             {"to": _b.onnx_dtype(out_dt)})
+    return out
+
+
+@_emits("conv_general_dilated")
+def _conv(ctx, eqn, ins):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    lhs_spec, rhs_spec, out_spec = dn.lhs_spec, dn.rhs_spec, dn.out_spec
+    nspatial = len(lhs_spec) - 2
+    if p["batch_group_count"] != 1:
+        raise MXNetError("onnx export: batch_group_count != 1")
+    if any(d != 1 for d in p["lhs_dilation"]):
+        raise MXNetError(
+            "onnx export: transposed convolution (lhs_dilation) must go "
+            "through the layer exporter (method='layers')")
+    canon_lhs = tuple(range(nspatial + 2))        # NCHW...
+    canon_rhs = tuple(range(nspatial + 2))        # OIHW...
+    lhs, rhs = ins
+    if tuple(lhs_spec) != canon_lhs:
+        # lhs_spec[i] gives which logical role sits at... jax stores spec as
+        # (batch_dim, feature_dim, spatial...) position indices
+        perm = list(lhs_spec)
+        lhs = ctx.b.add_node("Transpose", [lhs], {"perm": perm})
+    if tuple(rhs_spec) != canon_rhs:
+        perm = list(rhs_spec)
+        rhs = ctx.b.add_node("Transpose", [rhs], {"perm": perm})
+    pads_lo = [lo for lo, _hi in p["padding"]]
+    pads_hi = [hi for _lo, hi in p["padding"]]
+    out = ctx.b.add_node("Conv", [lhs, rhs], {
+        "strides": list(p["window_strides"]),
+        "pads": pads_lo + pads_hi,
+        "dilations": list(p["rhs_dilation"]),
+        "group": int(p["feature_group_count"])})
+    if tuple(out_spec) != canon_lhs:
+        inv = [0] * len(out_spec)
+        for i, d in enumerate(out_spec):
+            inv[d] = i
+        out = ctx.b.add_node("Transpose", [out], {"perm": inv})
+    out_dt = eqn.outvars[0].aval.dtype
+    if out_dt != ctx.dtype(eqn.invars[0]):
+        out = ctx.b.add_node("Cast", [out],
+                             {"to": _b.onnx_dtype(out_dt)})
+    return out
+
+
+# ---- reductions -----------------------------------------------------------
+
+@_emits("reduce_sum")
+def _reduce_sum(ctx, eqn, ins):
+    axes = ctx.b.const_i64(list(eqn.params["axes"]), "axes")
+    return ctx.b.add_node("ReduceSum", [ins[0], axes], {"keepdims": 0})
+
+
+def _reduce_attr(onnx_op):
+    def emit(ctx, eqn, ins):
+        return ctx.b.add_node(onnx_op, ins, {
+            "axes": list(eqn.params["axes"]), "keepdims": 0})
+    return emit
+
+
+_EMIT["reduce_max"] = _reduce_attr("ReduceMax")
+_EMIT["reduce_min"] = _reduce_attr("ReduceMin")
+_EMIT["reduce_prod"] = _reduce_attr("ReduceProd")
+
+
+@_emits("reduce_and", "reduce_or")
+def _reduce_bool(ctx, eqn, ins):
+    op = "ReduceMin" if eqn.primitive.name == "reduce_and" else "ReduceMax"
+    as_int = ctx.b.add_node("Cast", ins, {"to": _b.INT32})
+    red = ctx.b.add_node(op, [as_int], {
+        "axes": list(eqn.params["axes"]), "keepdims": 0})
+    return ctx.b.add_node("Cast", [red], {"to": _b.BOOL})
+
+
+@_emits("argmax", "argmin")
+def _argminmax(ctx, eqn, ins):
+    op = "ArgMax" if eqn.primitive.name == "argmax" else "ArgMin"
+    axes = list(eqn.params["axes"])
+    if len(axes) != 1:
+        raise MXNetError("onnx export: multi-axis %s" % op)
+    out = ctx.b.add_node(op, ins, {"axis": axes[0], "keepdims": 0})
+    want = eqn.outvars[0].aval.dtype
+    if _np.dtype(want) != _np.int64:
+        out = ctx.b.add_node("Cast", [out], {"to": _b.onnx_dtype(want)})
+    return out
+
+
+@_emits("cumsum")
+def _cumsum(ctx, eqn, ins):
+    axis = ctx.b.add_initializer(
+        _np.asarray(eqn.params["axis"], _np.int64))
+    return ctx.b.add_node("CumSum", [ins[0], axis], {
+        "reverse": 1 if eqn.params.get("reverse") else 0})
+
+
+@_emits("cumlogsumexp", "cumprod", "cummax", "cummin")
+def _cum_unsupported(ctx, eqn, ins):
+    raise MXNetError("onnx export: %s has no ONNX equivalent"
+                     % eqn.primitive.name)
+
+
+# ---- windows (pooling) ----------------------------------------------------
+
+def _window_common(ctx, eqn):
+    p = eqn.params
+    window = list(p["window_dimensions"])
+    strides = list(p["window_strides"])
+    padding = list(p["padding"])
+    base_dil = list(p.get("base_dilation") or [1] * len(window))
+    win_dil = list(p.get("window_dilation") or [1] * len(window))
+    if any(d != 1 for d in base_dil):
+        raise MXNetError("onnx export: reduce_window base_dilation")
+    if window[0] != 1 or window[1] != 1:
+        raise MXNetError("onnx export: reduce_window over non-spatial dims")
+    if any(padding[i] != (0, 0) for i in (0, 1)):
+        raise MXNetError("onnx export: reduce_window pads batch/channel")
+    k = window[2:]
+    s = strides[2:]
+    lo = [p_[0] for p_ in padding[2:]]
+    hi = [p_[1] for p_ in padding[2:]]
+    d = win_dil[2:]
+    return k, s, lo + hi, d
+
+
+@_emits("reduce_window_max")
+def _maxpool(ctx, eqn, ins):
+    k, s, pads, d = _window_common(ctx, eqn)
+    attrs = {"kernel_shape": k, "strides": s, "pads": pads}
+    if any(x != 1 for x in d):
+        attrs["dilations"] = d
+    return ctx.b.add_node("MaxPool", ins, attrs)
+
+
+@_emits("reduce_window_sum")
+def _sumpool(ctx, eqn, ins):
+    k, s, pads, d = _window_common(ctx, eqn)
+    if any(x != 1 for x in d):
+        raise MXNetError("onnx export: dilated sum-pooling")
+    avg = ctx.b.add_node("AveragePool", ins, {
+        "kernel_shape": k, "strides": s, "pads": pads,
+        "count_include_pad": 1})
+    n = ctx.b.add_initializer(
+        _np.asarray(float(_np.prod(k)), ctx.dtype(eqn.invars[0])))
+    return ctx.b.add_node("Mul", [avg, n])
+
+
+@_emits("reduce_window_min")
+def _minpool(ctx, eqn, ins):
+    neg = ctx.b.add_node("Neg", ins)
+    k, s, pads, d = _window_common(ctx, eqn)
+    attrs = {"kernel_shape": k, "strides": s, "pads": pads}
+    if any(x != 1 for x in d):
+        attrs["dilations"] = d
+    mp = ctx.b.add_node("MaxPool", [neg], attrs)
+    return ctx.b.add_node("Neg", [mp])
+
+
+# ---- gather/scatter/dynamic -----------------------------------------------
+
+@_emits("gather")
+def _gather(ctx, eqn, ins):
+    import jax
+
+    dnums = eqn.params["dimension_numbers"]
+    operand_shape = ctx.avalshape(eqn.invars[0])
+    idx_shape = ctx.avalshape(eqn.invars[1])
+    slice_sizes = tuple(eqn.params["slice_sizes"])
+    rank = len(operand_shape)
+    # pattern: jnp.take(x, idx, axis=k) — one indexed axis, full slices on
+    # the rest, index vector has a trailing singleton coordinate dim
+    if (len(dnums.start_index_map) == 1
+            and dnums.collapsed_slice_dims == dnums.start_index_map
+            and not getattr(dnums, "operand_batching_dims", ())
+            and idx_shape and idx_shape[-1] == 1):
+        axis = dnums.start_index_map[0]
+        idx_batch = len(idx_shape) - 1
+        full = all(slice_sizes[i] == operand_shape[i]
+                   for i in range(rank) if i != axis)
+        # ONNX Gather output = operand[:axis] + idx + operand[axis+1:]
+        # — the remaining operand dims must land exactly there
+        want_offsets = tuple(range(axis)) + tuple(
+            range(axis + idx_batch, idx_batch + rank - 1))
+        if (full and slice_sizes[axis] == 1
+                and tuple(dnums.offset_dims) == want_offsets):
+            idx = ctx.b.add_node("Squeeze", [
+                ins[1], ctx.b.const_i64([len(idx_shape) - 1], "axes")])
+            return ctx.b.add_node("Gather", [ins[0], idx], {"axis": axis})
+    # NOTE: no take_along_axis->GatherElements pattern: lax.gather
+    # dimension-number soups (e.g. deformable conv's bilinear sampling)
+    # can look deceptively similar and mis-translate silently — fail
+    # loudly instead.
+    raise MXNetError("onnx export: general gather %r not representable"
+                     % (dnums,))
+
+
+@_emits("dynamic_slice")
+def _dynamic_slice(ctx, eqn, ins):
+    sizes = list(eqn.params["slice_sizes"])
+    in_shape = ctx.avalshape(eqn.invars[0])
+    rank = len(sizes)
+    starts_1d = []
+    for s in ins[1:]:
+        c = ctx.b.add_node("Cast", [s], {"to": _b.INT64})
+        starts_1d.append(ctx.b.add_node(
+            "Unsqueeze", [c, ctx.b.const_i64([0], "axes")]))
+    starts = ctx.b.add_node("Concat", starts_1d, {"axis": 0}) \
+        if len(starts_1d) > 1 else starts_1d[0]
+    # lax semantics clamp starts into [0, dim - size]; reproduce so
+    # edge-reaching dynamic indices keep the static output shape
+    starts = ctx.b.add_node("Max", [starts,
+                                    ctx.b.const_i64([0] * rank, "zero")])
+    starts = ctx.b.add_node("Min", [starts, ctx.b.const_i64(
+        [d - s for d, s in zip(in_shape, sizes)], "maxstart")])
+    ends = ctx.b.add_node(
+        "Add", [starts, ctx.b.const_i64(sizes, "sizes")])
+    return ctx.b.add_node("Slice", [
+        ins[0], starts, ends, ctx.b.const_i64(list(range(rank)), "axes")])
+
+
+@_emits("sort")
+def _sort(ctx, eqn, ins):
+    p = eqn.params
+    if p.get("num_keys", 1) != 1 or len(ins) != 1:
+        raise MXNetError("onnx export: multi-operand sort")
+    dim = int(p["dimension"])
+    n = ctx.avalshape(eqn.invars[0])[dim]
+    k = ctx.b.const_i64([n], "k")
+    vals, _idx = ctx.b.add_node(
+        "TopK", [ins[0], k],
+        {"axis": dim, "largest": 0, "sorted": 1}, n_out=2)
+    return vals
+
+
+@_emits("top_k")
+def _top_k(ctx, eqn, ins):
+    k = ctx.b.const_i64([int(eqn.params["k"])], "k")
+    vals, idx = ctx.b.add_node(
+        "TopK", [ins[0], k], {"axis": -1, "largest": 1, "sorted": 1},
+        n_out=2)
+    want = eqn.outvars[1].aval.dtype
+    if _np.dtype(want) != _np.int64:
+        idx = ctx.b.add_node("Cast", [idx], {"to": _b.onnx_dtype(want)})
+    return [vals, idx]
+
+
+# ---- higher-order: inline -------------------------------------------------
+
+def _inline(ctx, eqn, ins, closed):
+    inner = closed.jaxpr
+    sub = _Ctx(ctx.b)
+    for cv, cval in zip(inner.constvars, closed.consts):
+        sub.set(cv, ctx.b.add_initializer(_np.asarray(cval)))
+    for v, nm in zip(inner.invars, ins):
+        sub.set(v, nm)
+    outs = _convert_eqns(sub, inner)
+    return outs
+
+
+@_emits("pjit", "jit", "closed_call", "remat", "checkpoint",
+        "custom_vjp_call", "custom_jvp_call")
+def _call_like(ctx, eqn, ins):
+    p = eqn.params
+    closed = p.get("jaxpr") or p.get("call_jaxpr") or p.get("fun_jaxpr")
+    if closed is None:
+        raise MXNetError("onnx export: opaque call %s"
+                         % eqn.primitive.name)
+    if hasattr(closed, "jaxpr"):
+        return _inline(ctx, eqn, ins, closed)
+    # plain Jaxpr (no consts)
+    import jax.extend.core
+
+    return _inline(ctx, eqn, ins,
+                   jax.extend.core.ClosedJaxpr(closed, ()))
+
+
+def _convert_eqns(ctx, jaxpr):
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        fn = _EMIT.get(name)
+        if fn is None:
+            raise MXNetError(
+                "onnx export: unsupported jax primitive '%s' (params %s)"
+                % (name, sorted(eqn.params)))
+        ins = [ctx.name_of(v) for v in eqn.invars]
+        out = fn(ctx, eqn, ins)
+        outs = out if isinstance(out, list) else [out]
+        if len(outs) < len(eqn.outvars):
+            raise MXNetError("onnx export: %s emitted %d outputs, needs %d"
+                             % (name, len(outs), len(eqn.outvars)))
+        for var, nm in zip(eqn.outvars, outs):
+            if type(var).__name__ != "DropVar":
+                ctx.set(var, nm)
+    return [ctx.name_of(v) for v in jaxpr.outvars]
+
+
+# ---- entry ----------------------------------------------------------------
+
+def export_graph(net, example_inputs, onnx_file_path,
+                 model_name="mxnet_tpu_model", float32=True):
+    """Trace ``net`` (inference mode) on ``example_inputs`` (list of
+    jnp/np arrays) and write an ONNX ModelProto of the whole DAG."""
+    import jax
+    import jax.numpy as jnp
+
+    apply_fn, params = net.export_pure(training=False)
+    if float32:
+        params = {n: (v.astype(jnp.float32)
+                      if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                  for n, v in params.items()}
+
+    def fwd(params_dict, *xs):
+        outs, _states = apply_fn(params_dict, None, *xs)
+        return tuple(outs)
+
+    closed = jax.make_jaxpr(fwd)(params, *example_inputs)
+
+    b = _b.GraphBuilder(opset=13)
+    ctx = _Ctx(b)
+    jaxpr = closed.jaxpr
+    for cv, cval in zip(jaxpr.constvars, closed.consts):
+        ctx.set(cv, b.add_initializer(_np.asarray(cval)))
+
+    # invars: params (flattened dict in sorted key order per jax pytree)
+    import jax.tree_util as jtu
+
+    flat_params, _tree = jtu.tree_flatten(params)
+    n_params = len(flat_params)
+    param_leaf_names = [k for k, _v in
+                        sorted(params.items(), key=lambda kv: kv[0])]
+    # jax flattens dicts in sorted-key order; sanity-check the count
+    if len(param_leaf_names) != n_params:
+        raise MXNetError("onnx export: param flatten mismatch")
+    for var, pname, arr in zip(jaxpr.invars[:n_params], param_leaf_names,
+                               [params[k] for k in param_leaf_names]):
+        safe = pname.replace("/", ".")
+        ctx.set(var, b.add_initializer(_np.asarray(arr), safe))
+    input_vars = jaxpr.invars[n_params:]
+    for i, (var, x) in enumerate(zip(input_vars, example_inputs)):
+        nm = "data" if i == 0 else "data%d" % i
+        b.inputs.append((nm, tuple(_np.shape(x)),
+                         _b.onnx_dtype(_np.asarray(x).dtype)))
+        ctx.set(var, nm)
+
+    out_names = _convert_eqns(ctx, jaxpr)
+    # graph outputs must be node outputs, not initializers/inputs: wrap
+    final = []
+    init_names = {n for n in out_names if n in b._init_names}
+    for i, nm in enumerate(out_names):
+        if nm in init_names or any(nm == inp[0] for inp in b.inputs):
+            nm = b.add_node("Identity", [nm])
+        var = jaxpr.outvars[i]
+        b.outputs.append((nm, tuple(var.aval.shape),
+                          _b.onnx_dtype(var.aval.dtype)))
+        final.append(nm)
+    return b.save(onnx_file_path, model_name)

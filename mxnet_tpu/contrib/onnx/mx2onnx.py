@@ -4,6 +4,7 @@ from __future__ import annotations
 import numpy as _np
 
 from ...base import MXNetError
+from . import _builder as _b
 from . import _proto
 
 # ONNX enums
@@ -16,14 +17,10 @@ _OPSET = 13
 
 
 def _tensor(name, arr):
-    arr = _np.ascontiguousarray(arr, dtype=_np.float32)
-    w = _proto.Writer()
-    for d in arr.shape:
-        w.varint(1, d)            # dims
-    w.varint(2, _FLOAT)           # data_type
-    w.string(8, name)             # name
-    w.string(9, arr.tobytes())    # raw_data
-    return w
+    arr = _np.asarray(arr)
+    if arr.dtype.kind == "f":     # weights ride f32; ints keep their type
+        arr = arr.astype(_np.float32)
+    return _b.tensor(name, arr)
 
 
 def _attr_int(name, value):
@@ -247,6 +244,8 @@ class _Exporter:
                 [_attr_int("blocksize", f[0]),
                  _attr_string("mode", "CRD")]))
             return out
+        if kind in ("LSTM", "GRU", "RNN") and hasattr(layer, "_mode"):
+            return self._rnn(layer, cur)
         if kind == "Conv2DTranspose":
             if getattr(layer, "_layout", "NCHW") != "NCHW":
                 raise MXNetError("onnx export supports NCHW convs only")
@@ -270,6 +269,76 @@ class _Exporter:
                 cur = self._activation(layer._activation, cur)
             return cur
         raise MXNetError("onnx export: unsupported layer %s" % kind)
+
+    def _rnn(self, layer, cur):
+        """gluon.rnn fused layers -> ONNX LSTM/GRU/RNN nodes (one per
+        stacked layer).  Gate blocks are reordered from the framework's
+        packed order (lstm i,f,g,o / gru r,z,n — rnn_layer.py) to the
+        ONNX spec order (lstm i,o,f,c / gru z,r,h); gluon GRU semantics
+        equal linear_before_reset=1, declared on the node."""
+        mode = layer._mode
+        onnx_op = {"lstm": "LSTM", "gru": "GRU",
+                   "rnn_tanh": "RNN", "rnn_relu": "RNN"}[mode]
+        order = {"lstm": [0, 3, 1, 2],   # i f g o -> i o f c
+                 "gru": [1, 0, 2],       # r z n   -> z r h
+                 "rnn_tanh": [0], "rnn_relu": [0]}[mode]
+        G = len(order)
+        H = layer._hidden_size
+        ndir = layer._dir
+        if layer._layout == "NTC":
+            cur = self._transpose(cur, (1, 0, 2))
+        for li in range(layer._num_layers):
+            Ws, Rs, Bs = [], [], []
+            for d in range(ndir):
+                sfx = "l%d%s" % (li, "_r" if d else "")
+                w = getattr(layer, sfx + "_i2h_weight").data().asnumpy()
+                r = getattr(layer, sfx + "_h2h_weight").data().asnumpy()
+                bi = getattr(layer, sfx + "_i2h_bias").data().asnumpy()
+                bh = getattr(layer, sfx + "_h2h_bias").data().asnumpy()
+
+                def ro(mat):
+                    blocks = _np.split(mat, G, axis=0)
+                    return _np.concatenate([blocks[i] for i in order],
+                                           axis=0)
+
+                Ws.append(ro(w))
+                Rs.append(ro(r))
+                Bs.append(_np.concatenate([
+                    ro(bi.reshape(-1, 1)).reshape(-1),
+                    ro(bh.reshape(-1, 1)).reshape(-1)]))
+            w_name = self.add_init("W", _np.stack(Ws))
+            r_name = self.add_init("R", _np.stack(Rs))
+            b_name = self.add_init("B", _np.stack(Bs))
+            y = self.uniq("rnn_y")
+            attrs = [_attr_int("hidden_size", H),
+                     _attr_string("direction", "bidirectional"
+                                  if ndir == 2 else "forward")]
+            if mode == "gru":
+                attrs.append(_attr_int("linear_before_reset", 1))
+            if mode == "rnn_relu":
+                attrs.append(_b.attr_strings("activations",
+                                             ["Relu"] * ndir))
+            self.nodes.append(_node(
+                onnx_op, [cur, w_name, r_name, b_name], [y],
+                self.uniq(onnx_op), attrs))
+            # Y: (T, ndir, B, H) -> (T, B, ndir*H)
+            cur = self._transpose(y, (0, 2, 1, 3))
+            shaped = self.uniq("rnn_flat")
+            shape_init = self.add_init(
+                "shape", _np.asarray([0, 0, ndir * H], _np.int64))
+            self.nodes.append(_node("Reshape", [cur, shape_init],
+                                    [shaped], self.uniq("Reshape")))
+            cur = shaped
+        if layer._layout == "NTC":
+            cur = self._transpose(cur, (1, 0, 2))
+        return cur
+
+    def _transpose(self, cur, perm):
+        out = self.uniq("tr")
+        self.nodes.append(_node("Transpose", [cur], [out],
+                                self.uniq("Transpose"),
+                                [_attr_ints("perm", perm)]))
+        return out
 
     def _activation(self, act, cur):
         table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
@@ -299,20 +368,97 @@ class _Exporter:
         return out
 
 
-def export_model(net, input_shape, onnx_file_path="model.onnx",
-                 model_name="mxnet_tpu_model"):
-    """Export a layer-structured Gluon net to an ONNX file (reference
-    contrib/onnx export_model).  ``input_shape`` includes the batch dim."""
-    ex = _Exporter()
-    out_name = ex.emit(net, "data")
+def _normalize_inputs(input_shape):
+    """Accept one shape tuple, a list of shapes, (shape, dtype) pairs, or
+    arrays; return a list of numpy example arrays."""
+    from ...ndarray import NDArray
 
+    def one(x):
+        if isinstance(x, NDArray):
+            return x.asnumpy()
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return _np.asarray(x)
+        if (isinstance(x, tuple) and len(x) == 2
+                and isinstance(x[0], (tuple, list))):
+            return _np.zeros(tuple(x[0]), _np.dtype(x[1]))
+        return _np.zeros(tuple(x), _np.float32)
+
+    if isinstance(input_shape, NDArray) or (
+            hasattr(input_shape, "shape")
+            and hasattr(input_shape, "dtype")):
+        return [one(input_shape)]
+    if isinstance(input_shape, (list, tuple)):
+        if all(isinstance(d, (int, _np.integer)) for d in input_shape):
+            return [one(tuple(input_shape))]       # one bare shape
+        if (len(input_shape) == 2
+                and isinstance(input_shape[0], (list, tuple))
+                and isinstance(input_shape[1], str)):
+            return [one(input_shape)]              # one (shape, dtype)
+        return [one(s) for s in input_shape]       # several inputs
+    raise MXNetError("onnx export: cannot interpret inputs %r"
+                     % (input_shape,))
+
+
+def export_model(net, input_shape, onnx_file_path="model.onnx",
+                 model_name="mxnet_tpu_model", method="auto"):
+    """Export a Gluon net to an ONNX file (reference contrib/onnx
+    export_model, mx2onnx/export_model.py).
+
+    method:
+      * "graph" — trace export_pure into a jaxpr and convert primitive-
+        by-primitive (jaxpr2onnx.py).  Handles ANY DAG: residual nets,
+        branches, attention.  Inference-mode semantics.
+      * "layers" — walk HybridSequential children emitting one ONNX node
+        per layer (incl. LSTM/GRU/RNN nodes for gluon.rnn layers, and
+        ConvTranspose).
+      * "auto" (default) — graph first, falling back to layers for
+        models the jaxpr path cannot represent (lax.scan RNNs,
+        transposed conv).
+    ``input_shape`` includes the batch dim; pass ``(shape, "int32")``
+    tuples or example arrays for non-f32 inputs, or a list for
+    multi-input models."""
+    if method not in ("auto", "graph", "layers"):
+        raise MXNetError("onnx export: unknown method %r" % (method,))
+    graph_err = None
+    if method in ("auto", "graph"):
+        from ... import nd as nd_mod
+        from .jaxpr2onnx import export_graph
+
+        examples = _normalize_inputs(input_shape)
+        try:
+            if any(p._data is None for p in net.collect_params().values()):
+                # resolve deferred shapes with one eager probe pass
+                net(*[nd_mod.array(x) for x in examples])
+            return export_graph(net, examples, onnx_file_path, model_name)
+        except MXNetError as exc:
+            if method == "graph":
+                raise
+            graph_err = exc
+    return _export_layers(net, input_shape, onnx_file_path, model_name,
+                          graph_err)
+
+
+def _export_layers(net, input_shape, onnx_file_path, model_name,
+                   graph_err=None):
+    """Layer-structural exporter (HybridSequential chains)."""
+    ex = _Exporter()
+    try:
+        out_name = ex.emit(net, "data")
+    except MXNetError as exc:
+        if graph_err is not None:
+            raise MXNetError(
+                "onnx export failed on both paths: graph: %s | layers: %s"
+                % (graph_err, exc))
+        raise
+
+    shape = tuple(_normalize_inputs(input_shape)[0].shape)
     graph = _proto.Writer()
     for n in ex.nodes:
         graph.message(1, n)
     graph.string(2, model_name)
     for t in ex.inits:
         graph.message(5, t)
-    graph.message(11, _value_info("data", input_shape,
+    graph.message(11, _value_info("data", shape,
                                   elem_type=ex.input_elem_type))
     # output shape is graph-dependent; emit rank-only (dim_value 0 allowed)
     graph.message(12, _value_info(out_name, ()))

@@ -299,7 +299,13 @@ def test_onnx_padded_avgpool_count_include_pad(tmp_path):
         net2, _ = onnx_mx.import_model(path)
         got = net2(x).asnumpy()
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
-        assert net2[0]._count_include_pad == cip
+        # the attr itself must survive the layer-structural path
+        path_l = str(tmp_path / ("avg_l_%s.onnx" % cip))
+        onnx_mx.export_model(net, (1, 2, 6, 6), path_l, method="layers")
+        net3, _ = onnx_mx.import_to_layers(path_l)
+        got3 = net3(x).asnumpy()
+        np.testing.assert_allclose(got3, want, rtol=1e-5, atol=1e-6)
+        assert net3[0]._count_include_pad == cip
 
 
 def test_onnx_roundtrip_extended_layers(tmp_path):

@@ -1,0 +1,315 @@
+"""ONNX converter breadth (VERDICT r4 item 3).
+
+Reference test model: tests/python-pytest/onnx/test_onnxruntime.py +
+test_operators.py — the reference round-trips its model zoo through
+onnx with onnxruntime as oracle.  No onnx/onnxruntime in this image, so
+the oracle is the *independent-path* round trip: the graph exporter
+converts jaxpr primitives (jaxpr2onnx.py) while the importer interprets
+ONNX node semantics (onnx2mx.py graph interpreter); numerical agreement
+with the original net checks both translations against each other.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.contrib import onnx as onnx_mx
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def setup_function(_f):
+    mx.random.seed(0)
+
+
+def _roundtrip(net, xs, tmp_path, tol=1e-4, method="auto"):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    want = net(*xs)
+    want = [w.asnumpy() for w in (want if isinstance(want, tuple)
+                                  else [want])]
+    path = str(tmp_path / "model.onnx")
+    onnx_mx.export_model(net, [x for x in xs], path, method=method)
+    assert os.path.getsize(path) > 100
+    net2, _params = onnx_mx.import_model(path)
+    got = net2(*xs)
+    got = [g.asnumpy() for g in (got if isinstance(got, tuple)
+                                 else [got])]
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=tol, atol=tol)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# model zoo sweep (reference onnx CI: the full vision zoo round-trips)
+# ---------------------------------------------------------------------------
+
+_ZOO = [
+    ("resnet18_v1", 64),
+    ("resnet18_v2", 64),
+    ("squeezenet1_0", 64),
+    ("mobilenet1_0", 64),
+    ("mobilenet_v2_1_0", 64),
+    ("densenet121", 64),
+    ("inception_v3", 299),  # fixed 8x8 final pool needs the full size
+    ("alexnet", 224),
+    ("vgg11", 224),
+]
+
+
+@pytest.mark.parametrize("name,size", _ZOO,
+                         ids=[n for n, _s in _ZOO])
+def test_zoo_roundtrip(name, size, tmp_path):
+    net = getattr(vision, name)()
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).rand(
+        1, 3, size, size).astype(np.float32))
+    _roundtrip(net, x, tmp_path, tol=5e-3 if name == "vgg11" else 1e-3)
+
+
+def test_bert_encoder_roundtrip(tmp_path):
+    from mxnet_tpu.gluon.model_zoo import bert as bert_zoo
+
+    model = bert_zoo.BERTModel(vocab_size=200, units=32, hidden_size=64,
+                               num_layers=2, num_heads=4, dropout=0.0)
+    model.initialize()
+    rs = np.random.RandomState(0)
+    toks = nd.array(rs.randint(0, 200, (2, 12)).astype(np.int32))
+    segs = nd.array(np.zeros((2, 12), np.int32))
+    _roundtrip(model, [toks, segs], tmp_path, tol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# RNN export: real ONNX LSTM/GRU/RNN nodes via the layer-structural path
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("ctor,kwargs", [
+    (gluon.rnn.LSTM, {}),
+    (gluon.rnn.GRU, {}),
+    (gluon.rnn.RNN, {}),
+    (gluon.rnn.LSTM, {"bidirectional": True}),
+    (gluon.rnn.LSTM, {"num_layers": 2}),
+], ids=["lstm", "gru", "rnn", "bilstm", "lstm2"])
+def test_rnn_roundtrip(ctor, kwargs, tmp_path):
+    net = nn.HybridSequential()
+    net.add(ctor(8, input_size=5, **kwargs))
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).randn(6, 2, 5)
+                 .astype(np.float32))  # TNC
+    _roundtrip(net, x, tmp_path, tol=1e-5)
+
+
+def test_rnn_onnx_nodes_emitted(tmp_path):
+    """The exported file must contain a real LSTM node (not a scan)."""
+    from mxnet_tpu.contrib.onnx.onnx2mx import parse_model
+
+    net = nn.HybridSequential()
+    net.add(gluon.rnn.LSTM(4, input_size=3))
+    net.initialize()
+    x = nd.array(np.zeros((5, 2, 3), np.float32))
+    net(x)
+    path = str(tmp_path / "lstm.onnx")
+    onnx_mx.export_model(net, x, path)
+    ops = [n["op_type"] for n in parse_model(path)["nodes"]]
+    assert "LSTM" in ops
+
+
+# ---------------------------------------------------------------------------
+# converter details
+# ---------------------------------------------------------------------------
+
+def test_conv_transpose_roundtrip(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Conv2DTranspose(4, kernel_size=3, strides=2, padding=1,
+                               in_channels=3))
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).randn(1, 3, 6, 6)
+                 .astype(np.float32))
+    _roundtrip(net, x, tmp_path, tol=1e-5)
+
+
+def test_multi_output_graph(tmp_path):
+    class TwoHead(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Dense(4)
+            self.fc2 = nn.Dense(2)
+
+        def forward(self, x):
+            return self.fc1(x), self.fc2(x)
+
+    net = TwoHead()
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).randn(3, 6).astype(np.float32))
+    _roundtrip(net, x, tmp_path, tol=1e-5)
+
+
+def test_imported_graph_is_trainable(tmp_path):
+    """Imported blocks carry real Parameters and ride the vjp tape."""
+    from mxnet_tpu import autograd
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(1))
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).randn(4, 3).astype(np.float32))
+    path = str(tmp_path / "t.onnx")
+    onnx_mx.export_model(net, x, path)
+    net2, _ = onnx_mx.import_model(path)
+    params = list(net2.collect_params().values())
+    assert params, "no parameters registered on imported graph"
+    with autograd.record():
+        loss = (net2(x) ** 2).sum()
+    loss.backward()
+    grads = [p.grad().asnumpy() for p in params]
+    assert any(np.abs(g).sum() > 0 for g in grads)
+
+
+def test_get_model_metadata(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4))
+    net.initialize()
+    x = nd.array(np.zeros((2, 3), np.float32))
+    net(x)
+    path = str(tmp_path / "m.onnx")
+    onnx_mx.export_model(net, (2, 3), path)
+    meta = onnx_mx.get_model_metadata(path)
+    names = [n for n, _s in meta["input_tensor_data"]]
+    assert names == ["data"]
+    assert meta["input_tensor_data"][0][1] == (2, 3)
+
+
+def test_layer_importer_still_works(tmp_path):
+    """Feed-forward chains can still import layer-structured."""
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1), nn.Activation("relu"),
+            nn.GlobalAvgPool2D(), nn.Flatten(), nn.Dense(2))
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).randn(1, 3, 6, 6)
+                 .astype(np.float32))
+    want = net(x).asnumpy()
+    path = str(tmp_path / "chain.onnx")
+    onnx_mx.export_model(net, x, path, method="layers")
+    net2, _ = onnx_mx.import_to_layers(path)
+    got = net2(x).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# opset handling: attr-vs-input forms (reference onnx2mx supports opsets
+# 7..13; the graph importer normalizes both encodings)
+# ---------------------------------------------------------------------------
+
+def _tiny_model_bytes(opset, nodes, inits, in_shape, out_name,
+                      elem=None):
+    from mxnet_tpu.contrib.onnx import _builder as b
+
+    g = b.GraphBuilder(opset=opset)
+    g.nodes = nodes
+    for name, arr in inits.items():
+        g.add_initializer(arr, name)
+    g.inputs.append(("data", in_shape, elem or b.FLOAT))
+    g.outputs.append((out_name, (), b.FLOAT))
+    return g
+
+
+def test_opset_legacy_forms(tmp_path):
+    """Squeeze axes / Slice bounds / Dropout ratio as ATTRIBUTES (the
+    pre-opset-10/13 encodings external exporters still produce)."""
+    from mxnet_tpu.contrib.onnx import _builder as b
+
+    nodes = [
+        b.node("Dropout", ["data"], ["d"], "drop", {"ratio": 0.5}),
+        b.node("Slice", ["d"], ["s"], "slice",
+               {"starts": [0], "ends": [2], "axes": [1]}),
+        b.node("Unsqueeze", ["s"], ["u"], "unsq", {"axes": [0]}),
+        b.node("Squeeze", ["u"], ["out"], "sq", {"axes": [0]}),
+    ]
+    g = _tiny_model_bytes(9, nodes, {}, (2, 4), "out")
+    path = str(tmp_path / "legacy.onnx")
+    g.save(path)
+    net, _ = onnx_mx.import_model(path)
+    x = nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))
+    got = net(x).asnumpy()
+    np.testing.assert_allclose(got, x.asnumpy()[:, :2])
+
+
+def test_opset13_input_forms(tmp_path):
+    """Same ops with opset-13 input-tensor encodings."""
+    from mxnet_tpu.contrib.onnx import _builder as b
+
+    inits = {
+        "ratio": np.asarray(0.5, np.float32),
+        "starts": np.asarray([0], np.int64),
+        "ends": np.asarray([2], np.int64),
+        "axes1": np.asarray([1], np.int64),
+        "axes0": np.asarray([0], np.int64),
+    }
+    nodes = [
+        b.node("Dropout", ["data", "ratio"], ["d"], "drop"),
+        b.node("Slice", ["d", "starts", "ends", "axes1"], ["s"], "slice"),
+        b.node("Unsqueeze", ["s", "axes0"], ["u"], "unsq"),
+        b.node("Squeeze", ["u", "axes0"], ["out"], "sq"),
+    ]
+    g = _tiny_model_bytes(13, nodes, inits, (2, 4), "out")
+    path = str(tmp_path / "o13.onnx")
+    g.save(path)
+    net, _ = onnx_mx.import_model(path)
+    x = nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))
+    got = net(x).asnumpy()
+    np.testing.assert_allclose(got, x.asnumpy()[:, :2])
+
+
+def test_reduce_forms(tmp_path):
+    """ReduceSum axes-as-input (13) and ReduceMean axes-as-attr."""
+    from mxnet_tpu.contrib.onnx import _builder as b
+
+    inits = {"axes": np.asarray([1], np.int64)}
+    nodes = [
+        b.node("ReduceSum", ["data", "axes"], ["r1"], "rs",
+               {"keepdims": 0}),
+        b.node("ReduceMean", ["data"], ["r2"], "rm",
+               {"axes": [1], "keepdims": 0}),
+        b.node("Add", ["r1", "r2"], ["out"], "add"),
+    ]
+    g = _tiny_model_bytes(13, nodes, inits, (2, 4), "out")
+    path = str(tmp_path / "red.onnx")
+    g.save(path)
+    net, _ = onnx_mx.import_model(path)
+    x = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+    got = net(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(got, x.sum(1) + x.mean(1), rtol=1e-6)
+
+
+def test_gemm_padded_pool_forms(tmp_path):
+    """Gemm alpha/beta/transA + asymmetric MaxPool pads import."""
+    from mxnet_tpu.contrib.onnx import _builder as b
+
+    rs = np.random.RandomState(0)
+    w = rs.randn(5, 4).astype(np.float32)
+    c = rs.randn(4).astype(np.float32)
+    inits = {"w": w, "c": c}
+    nodes = [b.node("Gemm", ["data", "w", "c"], ["out"], "gemm",
+                    {"alpha": 2.0, "beta": 0.5})]
+    g = _tiny_model_bytes(13, nodes, inits, (3, 5), "out")
+    path = str(tmp_path / "gemm.onnx")
+    g.save(path)
+    net, _ = onnx_mx.import_model(path)
+    x = rs.randn(3, 5).astype(np.float32)
+    got = net(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(got, 2.0 * (x @ w) + 0.5 * c, rtol=1e-5)
+
+    xi = rs.rand(1, 2, 5, 5).astype(np.float32)
+    nodes = [b.node("MaxPool", ["data"], ["out"], "mp",
+                    {"kernel_shape": [2, 2], "strides": [2, 2],
+                     "pads": [0, 0, 1, 1]})]
+    g = _tiny_model_bytes(13, nodes, {}, (1, 2, 5, 5), "out")
+    path2 = str(tmp_path / "pool.onnx")
+    g.save(path2)
+    net2, _ = onnx_mx.import_model(path2)
+    got2 = net2(nd.array(xi)).asnumpy()
+    padded = np.pad(xi, ((0, 0), (0, 0), (0, 1), (0, 1)),
+                    constant_values=-np.inf)
+    want2 = padded.reshape(1, 2, 3, 2, 3, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(got2, want2)
