@@ -49,6 +49,11 @@ ENV_VARS = {
     "MXNET_PROFILER_AUTOSTART": (
         bool, False,
         "Start the profiler at import (reference env_var.md)."),
+    "MXNET_NP_FALLBACK_LOG_VERBOSE": (
+        bool, True,
+        "Warn (once per name) when mx.np resolves a function via host "
+        "numpy instead of jax.numpy — host fallbacks run off-device and "
+        "outside autograd (numpy/__init__.py)."),
     "MXNET_STORAGE_FALLBACK_LOG_VERBOSE": (
         bool, False,
         "Log when a sparse op densifies (the storage-fallback path, "

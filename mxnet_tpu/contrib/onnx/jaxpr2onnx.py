@@ -110,9 +110,11 @@ def _rsqrt(ctx, eqn, ins):
 
 @_emits("cbrt")
 def _cbrt(ctx, eqn, ins):
+    # sign(x) * |x|^(1/3): plain Pow NaNs on negative bases
     third = ctx.b.add_initializer(
         _np.asarray(1.0 / 3.0, ctx.dtype(eqn.invars[0])))
-    return ctx.b.add_node("Pow", [ins[0], third])
+    mag = ctx.b.add_node("Pow", [ctx.b.add_node("Abs", ins), third])
+    return ctx.b.add_node("Mul", [ctx.b.add_node("Sign", ins), mag])
 
 
 @_emits("log1p")

@@ -7,13 +7,16 @@ from ...base import MXNetError
 from . import _builder as _b
 from . import _proto
 
-# ONNX enums
-_FLOAT = 1
-_ATTR_FLOAT, _ATTR_INT, _ATTR_STRING, _ATTR_INTS = 1, 2, 3, 7
-
 # opset 13 baseline: Dropout takes ratio as an INPUT (the attribute form
 # died at 12); LayerNormalization raises to 17 and Gelu to 20 on demand
 _OPSET = 13
+
+# wire-format encoding lives in _builder.py (shared with jaxpr2onnx)
+_attr_int = _b.attr_int
+_attr_ints = _b.attr_ints
+_attr_float = _b.attr_float
+_attr_string = _b.attr_string
+_node = _b.node
 
 
 def _tensor(name, arr):
@@ -23,47 +26,9 @@ def _tensor(name, arr):
     return _b.tensor(name, arr)
 
 
-def _attr_int(name, value):
-    return (_proto.Writer().string(1, name).varint(3, int(value))
-            .varint(20, _ATTR_INT))
-
-
-def _attr_ints(name, values):
-    return (_proto.Writer().string(1, name).ints_packed(8, values)
-            .varint(20, _ATTR_INTS))
-
-
-def _attr_float(name, value):
-    return (_proto.Writer().string(1, name).float32(2, float(value))
-            .varint(20, _ATTR_FLOAT))
-
-
-def _attr_string(name, value):
-    return (_proto.Writer().string(1, name).string(4, value)
-            .varint(20, _ATTR_STRING))
-
-
-def _node(op_type, inputs, outputs, name, attrs=()):
-    w = _proto.Writer()
-    for i in inputs:
-        w.string(1, i)
-    for o in outputs:
-        w.string(2, o)
-    w.string(3, name)
-    w.string(4, op_type)
-    for a in attrs:
-        w.message(5, a)
-    return w
-
-
 def _value_info(name, shape, elem_type=None):
-    dims = _proto.Writer()
-    for d in shape:
-        dims.message(1, _proto.Writer().varint(1, d))
-    ttype = (_proto.Writer().varint(1, elem_type if elem_type is not None
-                                    else _FLOAT).message(2, dims))
-    typ = _proto.Writer().message(1, ttype)
-    return _proto.Writer().string(1, name).message(2, typ)
+    return _b.value_info(name, shape,
+                         _b.FLOAT if elem_type is None else elem_type)
 
 
 class _Exporter:

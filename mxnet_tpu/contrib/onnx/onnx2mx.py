@@ -44,7 +44,8 @@ def _parse_attrs(node_fields):
         name = _proto.get_str(f, 1)
         atype = _proto.get_int(f, 20)
         if atype == _b.ATTR_FLOAT:
-            attrs[name] = _proto.get_packed_floats(f, 2)[0]
+            vals = _proto.get_packed_floats(f, 2)
+            attrs[name] = vals[0] if vals else 0.0  # proto3 omits zeros
         elif atype == _b.ATTR_INT:
             attrs[name] = _proto.get_int(f, 3)
         elif atype == _b.ATTR_STRING:
@@ -288,8 +289,8 @@ _BINARY_NP = {
 def _binary(self, node, vals):
     a, b = vals
     if _is_host(a) and _is_host(b):   # host constant fold
-        return getattr(_np, _BINARY_NP[node["op_type"]].replace(
-            "logical_", "logical_"))(_np.asarray(a), _np.asarray(b))
+        return getattr(_np, _BINARY_NP[node["op_type"]])(
+            _np.asarray(a), _np.asarray(b))
     fn = getattr(_mnp(), _BINARY_NP[node["op_type"]])
     return fn(_as_dev(a), _as_dev(b))
 
@@ -506,7 +507,9 @@ def _transpose(self, node, vals):
 @_h("Flatten")
 def _flatten(self, node, vals):
     x = _as_dev(vals[0])
-    axis = int(node["attrs"].get("axis", 1)) % (len(x.shape) + 1)
+    rank = len(x.shape)
+    axis = int(node["attrs"].get("axis", 1))
+    axis = axis if axis >= 0 else axis + rank   # ONNX: -1 == rank-1
     shape = x.shape
     lead = int(_np.prod(shape[:axis])) if axis > 0 else 1
     return _mnp().reshape(x, (lead, -1))
@@ -1035,16 +1038,19 @@ def _rnn_common(self, node, vals, mode):
             "onnx import: GRU linear_before_reset=0 has no fused "
             "equivalent (framework GRU applies the reset gate after the "
             "recurrent GEMM); re-export with linear_before_reset=1")
+    ndir_acts = 2 if direction == "bidirectional" else 1
     if "activations" in a:
         defaults = {"lstm": ["Sigmoid", "Tanh", "Tanh"],
                     "gru": ["Sigmoid", "Tanh"],
                     "rnn_tanh": ["Tanh"]}[mode]
-        per_dir = a["activations"][:len(defaults)]
-        if [s if isinstance(s, str) else s for s in per_dir] != defaults:
-            if mode == "rnn_tanh" and per_dir == ["Relu"]:
+        acts = list(a["activations"])
+        want = defaults * ndir_acts
+        if acts != want[:len(acts)] or len(acts) > len(want):
+            if mode == "rnn_tanh" and acts == ["Relu"] * ndir_acts:
                 mode = "rnn_relu"
             else:
-                raise MXNetError("onnx import: custom RNN activations")
+                raise MXNetError("onnx import: custom RNN activations %s"
+                                 % (acts,))
     if a.get("clip"):
         raise MXNetError("onnx import: RNN cell clip")
 

@@ -1,13 +1,15 @@
 """Large-tensor sanity (reference tests/nightly/test_large_array.py).
 
 The reference's nightly suite allocates >2^32-element tensors to pin its
-int64 shape/indexing paths.  This build indexes with jax's default 32-bit
-ints (x64 mode is not enabled), so what these checks pin is the
-INT32_MAX BOUNDARY: 2^31-element arrays whose last flat offset equals
-INT32_MAX, plus Python-side int64 shape arithmetic.  Scaled shapes run in
-every suite; the 2^31-element tier runs under MXNET_TEST_LARGE=1
-(8 GB-per-buffer nightly contract).  Truly over-int32 offsets (>2^31
-elements) would need x64 mode + 16 GB buffers and are out of scope here.
+int64 shape/indexing paths (CMakeLists USE_INT64_TENSOR_SIZE), with
+per-section checks over creation / manipulation / reduction / indexing /
+nn / random ops.  This build indexes with jax's default 32-bit ints (x64
+mode off), so what these checks pin is the INT32_MAX BOUNDARY: arrays
+whose last flat offset equals INT32_MAX, plus Python-side int64 shape
+arithmetic.  Structure mirrors the reference sections; every check runs
+at a scaled shape in each suite, and the 2^31-element tier (8 GB per
+buffer) runs under MXNET_TEST_LARGE=1 — the nightly contract.  Truly
+over-int32 offsets would need x64 mode + 16 GB buffers; out of scope.
 """
 import numpy as np
 import pytest
@@ -27,39 +29,241 @@ def _shape():
     return LARGE_SHAPE if LARGE else SMALL_SHAPE
 
 
-def test_creation_and_reduction_python_int64_sizes():
-    x = nd.ones(_shape())
-    assert x.size == _shape()[0] * _shape()[1]
-    s = float(x.sum().asnumpy())
-    assert s == float(x.size)
-
-
-def test_indexing_at_int32_max_offset():
-    shape = _shape()
-    # broadcast-free construction: one (N, 1) column expanded lazily
-    col = nd.array(np.arange(shape[0], dtype=np.float32).reshape(-1, 1))
-    x = nd.broadcast_to(col, shape)
-    # the corner read walks to the last flat offset (== INT32_MAX in the
-    # gated tier)
-    assert float(x[shape[0] - 1, shape[1] - 1].asnumpy()) == shape[0] - 1
-    assert int(np.argmax(
-        nd.max(x, axis=1).asnumpy())) == shape[0] - 1
-
-
-def test_take_with_large_row_indices():
-    """Rows taken from the FULL-width matrix so the gated tier's last-row
-    gather reads up to the INT32_MAX flat offset.  Index arrays are
-    jax-default 32-bit (int64 inputs downcast — x64 mode is off)."""
+def _rows():
+    """A (N, W) matrix whose value at [i, j] is i, built broadcast-lazily
+    (no host materialization of the full matrix)."""
     shape = _shape()
     col = nd.array(np.arange(shape[0], dtype=np.float32).reshape(-1, 1))
-    x = nd.broadcast_to(col, shape)
-    idx = nd.array(np.array([0, shape[0] // 2, shape[0] - 1], np.int64),
-                   dtype="int64")
-    got = nd.take(x, idx)
-    np.testing.assert_allclose(
-        np.asarray(got[:, shape[1] - 1].asnumpy()),
-        [0, shape[0] // 2, shape[0] - 1])
+    return nd.broadcast_to(col, shape), shape
 
+
+def setup_function(_f):
+    mx.random.seed(0)
+
+
+# ---------------------------------------------------------------------------
+# creation (reference: test_ones/zeros/full/arange/linspace/eye...)
+# ---------------------------------------------------------------------------
+
+class TestCreation:
+    def test_ones_size_and_sum(self):
+        x = nd.ones(_shape())
+        assert x.size == _shape()[0] * _shape()[1]
+        assert float(x.sum().asnumpy()) == float(x.size)
+
+    def test_zeros_full(self):
+        z = nd.zeros(_shape())
+        assert float(z.max().asnumpy()) == 0.0
+        f = nd.full(_shape(), 3.0)
+        assert float(f.min().asnumpy()) == 3.0
+
+    def test_arange_boundary_value(self):
+        n = _shape()[0]
+        r = nd.arange(n)
+        assert float(r[n - 1].asnumpy()) == n - 1
+
+    def test_python_int64_size_arithmetic(self):
+        # shape products stay exact far past int32 on the host side
+        shape = (1 << 20, 1 << 20)       # 2^40 elements, never allocated
+        assert shape[0] * shape[1] == 1 << 40
+        x = nd.ones((2, 2))
+        assert isinstance(x.size, int)
+
+
+# ---------------------------------------------------------------------------
+# manipulation (reference: test_reshape/transpose/expand_dims/split...)
+# ---------------------------------------------------------------------------
+
+class TestManipulation:
+    def test_reshape_flat_roundtrip(self):
+        x, shape = _rows()
+        flat = x.reshape((shape[0] * shape[1],))
+        assert flat.shape == (shape[0] * shape[1],)
+        back = flat.reshape(shape)
+        assert float(back[shape[0] - 1, 0].asnumpy()) == shape[0] - 1
+
+    def test_transpose_corner(self):
+        x, shape = _rows()
+        t = nd.transpose(x)
+        assert t.shape == (shape[1], shape[0])
+        assert float(t[shape[1] - 1, shape[0] - 1].asnumpy()) == \
+            shape[0] - 1
+
+    def test_expand_squeeze(self):
+        x, shape = _rows()
+        e = nd.expand_dims(x, axis=0)
+        assert e.shape == (1,) + shape
+        s = nd.squeeze(e, axis=0)
+        assert s.shape == shape
+
+    def test_split_concat_width(self):
+        x, shape = _rows()
+        halves = nd.split(x, num_outputs=2, axis=1)
+        assert halves[0].shape == (shape[0], shape[1] // 2)
+        back = nd.concat(halves[0], halves[1], dim=1)
+        assert back.shape == shape
+
+    def test_slice_corner_window(self):
+        x, shape = _rows()
+        w = x[shape[0] - 2:, shape[1] - 2:]
+        np.testing.assert_allclose(
+            w.asnumpy(),
+            [[shape[0] - 2] * 2, [shape[0] - 1] * 2])
+
+    def test_flip_last_becomes_first(self):
+        x, shape = _rows()
+        f = nd.flip(x, axis=0)
+        assert float(f[0, 0].asnumpy()) == shape[0] - 1
+
+    def test_tile_small_to_large(self):
+        shape = _shape()
+        base = nd.array(np.arange(shape[1], dtype=np.float32)
+                        .reshape(1, -1))
+        t = nd.tile(base, reps=(shape[0], 1))
+        assert t.shape == shape
+        assert float(t[shape[0] - 1, shape[1] - 1].asnumpy()) == \
+            shape[1] - 1
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference: test_sum/mean/argmax over LARGE_X)
+# ---------------------------------------------------------------------------
+
+class TestReduction:
+    def test_sum_exceeds_int32(self):
+        # elementwise sum whose VALUE crosses int32: 2M (or 2^31) * 1200
+        x = nd.ones(_shape()) * 1200.0
+        total = float(x.sum().asnumpy())
+        assert total == 1200.0 * _shape()[0] * _shape()[1]
+        assert total > (1 << 31)
+
+    def test_axis_reductions(self):
+        x, shape = _rows()
+        m = nd.max(x, axis=1)
+        assert m.shape == (shape[0],)
+        assert float(m[shape[0] - 1].asnumpy()) == shape[0] - 1
+        mn = nd.min(x, axis=0)
+        assert float(mn[0].asnumpy()) == 0.0
+
+    def test_argmax_at_last_row(self):
+        x, shape = _rows()
+        am = nd.argmax(nd.max(x, axis=1), axis=0)
+        assert int(am.asnumpy()) == shape[0] - 1
+
+    def test_mean_of_rows(self):
+        x, shape = _rows()
+        mean = float(nd.mean(x).asnumpy())
+        np.testing.assert_allclose(mean, (shape[0] - 1) / 2.0, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# indexing / gather (reference: test_take/slice_assign/one_hot...)
+# ---------------------------------------------------------------------------
+
+class TestIndexing:
+    def test_indexing_at_int32_max_offset(self):
+        x, shape = _rows()
+        # corner read walks to the last flat offset (== INT32_MAX gated)
+        assert float(x[shape[0] - 1, shape[1] - 1].asnumpy()) == \
+            shape[0] - 1
+
+    def test_take_with_large_row_indices(self):
+        """Rows taken from the FULL-width matrix so the gated tier's
+        last-row gather reads up to the INT32_MAX flat offset.  Index
+        arrays are jax-default 32-bit (int64 inputs downcast)."""
+        x, shape = _rows()
+        idx = nd.array(
+            np.array([0, shape[0] // 2, shape[0] - 1], np.int64),
+            dtype="int64")
+        got = nd.take(x, idx)
+        np.testing.assert_allclose(
+            np.asarray(got[:, shape[1] - 1].asnumpy()),
+            [0, shape[0] // 2, shape[0] - 1])
+
+    def test_gather_nd_corner(self):
+        x, shape = _rows()
+        indices = nd.array(np.array(
+            [[0, shape[0] - 1], [0, shape[1] - 1]], np.int64),
+            dtype="int64")
+        got = nd.gather_nd(x, indices)
+        np.testing.assert_allclose(got.asnumpy(), [0, shape[0] - 1])
+
+    def test_slice_assign_last_row(self):
+        x, shape = _rows()
+        y = nd._slice_assign_scalar(
+            x, -7.0, begin=(shape[0] - 1, 0), end=(shape[0], shape[1]))
+        assert float(y[shape[0] - 1, shape[1] - 1].asnumpy()) == -7.0
+        assert float(y[shape[0] - 2, 0].asnumpy()) == shape[0] - 2
+
+    def test_one_hot_tall(self):
+        n = _shape()[0]
+        idx = nd.array(np.array([0, n - 1], np.int64), dtype="int64")
+        oh = nd.one_hot(idx, depth=16)
+        np.testing.assert_allclose(oh.asnumpy()[:, 0], [1, 0])
+
+    def test_where_threshold(self):
+        x, shape = _rows()
+        w = nd.where(x >= shape[0] - 1, nd.ones_like(x),
+                     nd.zeros_like(x))
+        assert float(w.sum().asnumpy()) == shape[1]
+
+
+# ---------------------------------------------------------------------------
+# nn ops at tall shapes (reference: test_fully_connected/softmax/pooling)
+# ---------------------------------------------------------------------------
+
+class TestNN:
+    def test_fully_connected_tall_batch(self):
+        shape = _shape()
+        x = nd.ones((shape[0], 64))
+        w = nd.ones((8, 64))
+        out = nd.FullyConnected(x, w, None, num_hidden=8, no_bias=True)
+        assert out.shape == (shape[0], 8)
+        assert float(out[shape[0] - 1, 7].asnumpy()) == 64.0
+
+    def test_softmax_wide_axis(self):
+        x, shape = _rows()
+        s = nd.softmax(x, axis=1)          # uniform along rows
+        np.testing.assert_allclose(
+            float(s[shape[0] - 1, 0].asnumpy()), 1.0 / shape[1],
+            rtol=1e-4)
+
+    def test_dot_tall_skinny(self):
+        shape = _shape()
+        a = nd.ones((shape[0], 32))
+        b = nd.ones((32, 16))
+        out = nd.dot(a, b)
+        assert out.shape == (shape[0], 16)
+        assert float(out[shape[0] - 1, 0].asnumpy()) == 32.0
+
+    def test_topk_last_rows(self):
+        x, shape = _rows()
+        col = nd.max(x, axis=1)
+        top = nd.topk(col, k=2, ret_typ="indices")
+        got = sorted(int(v) for v in top.asnumpy())
+        assert got == [shape[0] - 2, shape[0] - 1]
+
+
+# ---------------------------------------------------------------------------
+# random at large shapes (reference: test_random nightly section)
+# ---------------------------------------------------------------------------
+
+class TestRandom:
+    def test_uniform_full_shape(self):
+        x = mx.random.uniform(shape=_shape())
+        assert x.shape == _shape()
+        v = float(nd.mean(x).asnumpy())
+        assert 0.45 < v < 0.55
+
+    def test_normal_std(self):
+        x = mx.random.normal(shape=_shape())
+        v = float(nd.mean(x * x).asnumpy())
+        assert 0.9 < v < 1.1
+
+
+# ---------------------------------------------------------------------------
+# gated nightly tier: the true INT32_MAX boundary (8 GB buffers)
+# ---------------------------------------------------------------------------
 
 @pytest.mark.skipif(not LARGE, reason="nightly-only: needs 8GB+ arrays "
                     "(set MXNET_TEST_LARGE=1)")
