@@ -49,6 +49,15 @@ ENV_VARS = {
     "MXNET_PROFILER_AUTOSTART": (
         bool, False,
         "Start the profiler at import (reference env_var.md)."),
+    "MXNET_EAGER_VJP_CACHE": (
+        bool, True,
+        "Reuse jitted forward+vjp pairs for repeated eager recorded-op "
+        "signatures (ops/registry.py); 0 retraces jax.vjp every call."),
+    "MXNET_EAGER_VJP_CACHE_MAX_ELEMS": (
+        int, 1 << 16,
+        "Input-size ceiling (total elements) for the eager vjp cache; "
+        "above it the cached recompute-backward would cost more device "
+        "time than the retrace it saves."),
     "MXNET_NP_FALLBACK_LOG_VERBOSE": (
         bool, True,
         "Warn (once per name) when mx.np resolves a function via host "

@@ -110,6 +110,70 @@ def _is_float(x):
         x.dtype, jnp.complexfloating)
 
 
+# ---- eager vjp signature cache -------------------------------------------
+# The reference built a whole FFI layer for ~2x python->kernel overhead
+# (SURVEY §2.1 "New FFI"); here the recorded eager path costs a jax.vjp
+# RETRACE per call — ~50x the unrecorded path on small tensors
+# (benchmark/opperf --dispatch).  Repeated (op, attrs, avals, train, amp)
+# signatures therefore reuse a jitted forward + jitted vjp rebuilt from
+# the same pure fn.  Excluded: ops that draw RNG keys during trace (the
+# mask would be baked in), array-valued attrs, and inputs above
+# MXNET_EAGER_VJP_CACHE_MAX_ELEMS (the cached backward recomputes the
+# forward, which only pays off while python dispatch dominates device
+# time).  Disable wholesale with MXNET_EAGER_VJP_CACHE=0.
+_VJP_CACHE = {}
+_VJP_CACHE_CAP = 4096
+
+
+def _vjp_cache_key(op, attrs, datas, train):
+    from ..base import get_env
+
+    # ONLY registry-registered ops are cacheable: their fn is a stable
+    # module-level pure function fully described by (name, attrs).
+    # apply_op one-offs (mx.np adapter, autograd._recorded_vjp closures)
+    # close over per-call state — two closures with identical name+avals
+    # would collide and replay the wrong captured data.
+    if _OP_REGISTRY.get(op.name) is not op:
+        return None
+    if not get_env("MXNET_EAGER_VJP_CACHE", bool, True):
+        return None
+    limit = get_env("MXNET_EAGER_VJP_CACHE_MAX_ELEMS", int, 1 << 16)
+    total = 0
+    sig = []
+    for d in datas:
+        if hasattr(d, "shape") and hasattr(d, "dtype"):
+            total += d.size
+            sig.append((tuple(d.shape), str(d.dtype)))
+        else:
+            sig.append(("py", repr(d)))
+    if total > limit:
+        return None
+    if attrs and any(hasattr(v, "shape") and hasattr(v, "dtype")
+                     for v in attrs.values()):
+        # array-valued attrs are baked into the partial closure; NDArray
+        # hashes by id so hash() would NOT catch them, and a cached
+        # backward would replay a stale buffer after in-place updates
+        return None
+    try:
+        attrs_key = tuple(sorted(attrs.items())) if attrs else ()
+        hash(attrs_key)
+    except TypeError:
+        return None       # unhashable attrs
+    from ..contrib import amp as _amp
+
+    return (op.name, attrs_key, bool(train), _amp.is_active(),
+            _amp.target_dtype(), tuple(sig))
+
+
+def vjp_cache_info():
+    """(entries,) introspection for tests/benchmarks."""
+    return {"entries": len(_VJP_CACHE)}
+
+
+def vjp_cache_clear():
+    _VJP_CACHE.clear()
+
+
 def invoke(op, inputs, attrs):
     """Imperative invoke: run ``op`` on NDArray inputs, record if needed.
 
@@ -120,6 +184,7 @@ def invoke(op, inputs, attrs):
 
     out_arg = attrs.pop("out", None) if attrs else None
     datas = [x._data if isinstance(x, NDArray) else x for x in inputs]
+    raw_attrs = attrs
     if attrs:
         # array-valued attrs (e.g. length masks) ride along as constants
         attrs = {k: (v._data if isinstance(v, NDArray) else v)
@@ -155,14 +220,45 @@ def invoke(op, inputs, attrs):
                 thread_state.is_training = prev_train
             return out if isinstance(out, tuple) else (out,)
 
-        out_datas, vjp_fn = jax.vjp(tuple_fn, *datas)
         nd_inputs = [x for x in inputs if isinstance(x, NDArray)]
-        # vjp_fn covers every positional arg; non-NDArray args get dropped.
+        # the vjp covers every positional arg; non-NDArray args get dropped
         positions = [i for i, x in enumerate(inputs) if isinstance(x, NDArray)]
 
-        def vjp_wrapper(out_cts, _vjp=vjp_fn, _pos=positions, _n=len(datas)):
-            all_grads = _vjp(tuple(out_cts))
-            return [all_grads[i] for i in _pos]
+        cache_key = _vjp_cache_key(op, raw_attrs, datas, train_at_record)
+        if cache_key is not None:
+            # the cached backward bakes gradient positions: a raw-array
+            # vs NDArray input mix with identical avals must not collide
+            cache_key = cache_key + (tuple(positions),)
+        bwd_jit = _VJP_CACHE.get(cache_key) if cache_key is not None \
+            else None
+        if bwd_jit is not None:
+            # hit: forward runs EAGERLY (identical math, and eager jnp
+            # dispatch beats a jit call for trivial ops); the backward
+            # reuses the jitted vjp-rebuild
+            out = fn(*datas)
+            out_datas = out if isinstance(out, tuple) else (out,)
+
+            def vjp_wrapper(out_cts, _bwd=bwd_jit, _p=tuple(datas)):
+                return list(_bwd(_p, tuple(out_cts)))
+        else:
+            out_datas, vjp_fn = jax.vjp(tuple_fn, *datas)
+
+            def vjp_wrapper(out_cts, _vjp=vjp_fn, _pos=positions):
+                all_grads = _vjp(tuple(out_cts))
+                return [all_grads[i] for i in _pos]
+
+            if cache_key is not None and not keylog.keys:
+                # deterministic signature: cache a backward that rebuilds
+                # the vjp inside jit (recompute-based — cheap at cached
+                # sizes), returning grads at tape positions
+                def _bwd_fn(primals, cts, _fn=tuple_fn,
+                            _pos=tuple(positions)):
+                    grads = jax.vjp(_fn, *primals)[1](tuple(cts))
+                    return tuple(grads[i] for i in _pos)
+
+                if len(_VJP_CACHE) >= _VJP_CACHE_CAP:
+                    _VJP_CACHE.clear()
+                _VJP_CACHE[cache_key] = jax.jit(_bwd_fn)
 
         node = TapeNode(
             vjp_wrapper, nd_inputs, len(out_datas),

@@ -374,3 +374,120 @@ def test_float0_cotangent_mixed_output_create_graph():
     assert idx.asnumpy().dtype.kind in "iu"
     np.testing.assert_allclose(g1.asnumpy(), 2 * x.asnumpy(), rtol=1e-6)
     np.testing.assert_allclose(x.grad.asnumpy(), 4 * x.asnumpy(), rtol=1e-6)
+
+
+# ---- eager vjp signature cache (VERDICT r4 item 4) ------------------------
+
+class TestEagerVjpCache:
+    def test_cache_populates_and_matches_uncached(self, monkeypatch):
+        from mxnet_tpu.ops import registry
+
+        registry.vjp_cache_clear()
+        x = mx.nd.array(np.random.RandomState(0)
+                        .rand(4, 4).astype(np.float32))
+        y = mx.nd.array(np.random.RandomState(1)
+                        .rand(4, 4).astype(np.float32))
+        x.attach_grad()
+
+        def grad_once():
+            with autograd.record():
+                L = mx.nd.sum(mx.nd.dot(x, y) * 2.0)
+            L.backward()
+            return x.grad.asnumpy().copy()
+
+        g_first = grad_once()          # populates
+        assert registry.vjp_cache_info()["entries"] >= 1
+        g_cached = grad_once()         # hits
+        np.testing.assert_allclose(g_first, g_cached, rtol=1e-6)
+        monkeypatch.setenv("MXNET_EAGER_VJP_CACHE", "0")
+        g_uncached = grad_once()
+        np.testing.assert_allclose(g_cached, g_uncached, rtol=1e-6)
+
+    def test_rng_ops_not_cached_and_stay_random(self):
+        from mxnet_tpu.ops import registry
+
+        registry.vjp_cache_clear()
+        x = mx.nd.array(np.random.RandomState(0)
+                        .rand(64).astype(np.float32))
+        x.attach_grad()
+        outs = []
+        for _ in range(2):
+            with autograd.record():
+                o = mx.nd.dropout(x, p=0.5)
+            outs.append(o.asnumpy())
+        assert not np.allclose(outs[0], outs[1]), \
+            "dropout mask must differ across eager calls"
+        for key in registry._VJP_CACHE:
+            assert "dropout" not in key[0]
+
+    def test_large_inputs_skip_cache(self):
+        from mxnet_tpu.ops import registry
+
+        registry.vjp_cache_clear()
+        big = mx.nd.array(np.random.RandomState(0)
+                          .rand(512, 512).astype(np.float32))
+        big.attach_grad()
+        with autograd.record():
+            L = mx.nd.sum(mx.nd.tanh(big))
+        L.backward()
+        for key in registry._VJP_CACHE:
+            assert key[0] != "tanh" or key[-1][0][0] != (512, 512)
+
+    def test_create_graph_still_works_through_cache(self):
+        from mxnet_tpu.ops import registry
+
+        registry.vjp_cache_clear()
+        x = mx.nd.array(np.array([0.3, 0.7], np.float32))
+        x.attach_grad()
+        # warm the cache with the same signature first
+        with autograd.record():
+            L = mx.nd.sum(mx.nd.tanh(x))
+        L.backward()
+        with autograd.record():
+            y = mx.nd.tanh(x)
+            g1 = autograd.grad(mx.nd.sum(y), [x], create_graph=True)[0]
+            L2 = mx.nd.sum(g1 * g1)
+        L2.backward()
+        t = np.tanh(x.asnumpy())
+        sech2 = 1 - t ** 2
+        want = 2 * sech2 * (-2 * t * sech2)
+        np.testing.assert_allclose(x.grad.asnumpy(), want, rtol=1e-4)
+
+    def test_cache_beats_retrace(self, monkeypatch):
+        """SELF-RELATIVE dispatch gate (box-speed independent): recorded
+        eager dispatch with the cache must beat the per-call jax.vjp
+        retrace by >=2x.  Absolute-time budgets live in opperf
+        --dispatch where a human reads them."""
+        import time
+
+        import jax
+
+        from mxnet_tpu.ops import registry
+
+        x = mx.nd.array(np.random.RandomState(0)
+                        .rand(4, 4).astype(np.float32))
+        y = mx.nd.array(np.random.RandomState(1)
+                        .rand(4, 4).astype(np.float32))
+        x.attach_grad()
+
+        def timeit(f, n=150):
+            for _ in range(25):
+                r = f()
+            jax.block_until_ready(r._data)
+            t0 = time.perf_counter()
+            for _ in range(n):
+                r = f()
+            jax.block_until_ready(r._data)
+            return (time.perf_counter() - t0) / n
+
+        def rec():
+            with autograd.record():
+                return mx.nd.dot(x, y)
+
+        registry.vjp_cache_clear()
+        cached = timeit(rec)
+        monkeypatch.setenv("MXNET_EAGER_VJP_CACHE", "0")
+        uncached = timeit(rec)
+        assert cached * 2.0 < uncached, \
+            "cached %.1fus not ahead of retrace %.1fus" \
+            % (cached * 1e6, uncached * 1e6)
