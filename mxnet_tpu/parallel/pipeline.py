@@ -97,13 +97,29 @@ class PipelineTrainer:
     ill-defined anyway).
     """
 
-    def __init__(self, block, loss=None, optimizer="sgd",
-                 optimizer_params=None, mesh=None, loss_fn=None,
-                 num_microbatches=4, dtype=None):
-        from . import _make_loss  # shared loss factory
+    def __new__(cls, *args, schedule="gpipe", **kwargs):
+        if schedule not in ("gpipe", "1f1b"):
+            raise MXNetError("unknown pipeline schedule %r "
+                             "(gpipe | 1f1b)" % (schedule,))
+        if cls is PipelineTrainer and schedule == "1f1b":
+            from .pipeline_1f1b import OneFOneBTrainer
+
+            return super().__new__(OneFOneBTrainer)
+        return super().__new__(cls)
+
+    def _init_common(self, block, loss, optimizer, optimizer_params,
+                     mesh, loss_fn, num_microbatches, dtype, engine):
+        """Validation/wiring shared by the GPipe and 1F1B trainers."""
+        from . import _make_loss, _pop_lr_schedule
 
         if mesh is None or "pp" not in mesh.axis_names:
-            raise MXNetError("PipelineTrainer needs a mesh with a 'pp' axis")
+            raise MXNetError("PipelineTrainer needs a mesh with a "
+                             "'pp' axis")
+        if engine == "1f1b":
+            extra = [a for a in mesh.axis_names if a not in ("pp", "dp")]
+            if extra:
+                raise MXNetError("1f1b pipeline supports pp(+dp) meshes "
+                                 "only (got extra axes %s)" % extra)
         self._mesh = mesh
         self._S = int(mesh.shape["pp"])
         self._dp = int(mesh.shape["dp"]) if "dp" in mesh.axis_names else 1
@@ -115,8 +131,6 @@ class PipelineTrainer:
             raise MXNetError(
                 "num_microbatches (%d) must be >= pipeline stages (%d) for "
                 "a working fill/drain schedule" % (self._M, self._S))
-        from . import _pop_lr_schedule  # shared Fused/Pipeline contract
-
         optimizer_params = dict(optimizer_params or {})
         self._lr, self._lr_scheduler = _pop_lr_schedule(optimizer_params)
         self._opt_init, self._opt_update = make_optimizer(
@@ -124,10 +138,16 @@ class PipelineTrainer:
         self._user_loss = loss_fn is not None
         self._loss_fn = loss_fn or _make_loss(loss)
         if dtype not in (None, "float32", "fp32"):
-            raise MXNetError("PipelineTrainer v1 computes in f32 (got "
-                             "dtype=%r)" % (dtype,))
-        self._step_fn = None
+            raise MXNetError("%s pipeline computes in f32 (got dtype=%r)"
+                             % (engine, dtype))
         self._step_count = 0
+
+    def __init__(self, block, loss=None, optimizer="sgd",
+                 optimizer_params=None, mesh=None, loss_fn=None,
+                 num_microbatches=4, dtype=None, *, schedule="gpipe"):
+        self._init_common(block, loss, optimizer, optimizer_params, mesh,
+                          loss_fn, num_microbatches, dtype, "gpipe")
+        self._step_fn = None
         self._stacked = None
         self._opt_state = None
 

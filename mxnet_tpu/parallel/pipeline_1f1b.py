@@ -1,0 +1,421 @@
+"""1F1B pipeline parallelism with per-stage programs (VERDICT r4 item 5).
+
+The GPipe trainer (pipeline.py) is SPMD: one program, every device
+compiles ALL stage bodies behind ``lax.switch`` and stage weights ride a
+zero-padded ``(S, Lmax)`` stack.  That is compact for small S but scales
+badly: program size grows with total stage code, HBM with Lmax, and the
+scan-transposed backward stores all M microbatch activations (GPipe's
+known memory profile).
+
+This module is the MPMD rendering — the design real pod pipelines use,
+and the TPU-native equivalent of the reference's planned pipeline work
+(nearest ancestor: subgraph control flow, control_flow.cc:1096):
+
+- Each stage is its OWN jitted program, traced once, placed on its own
+  ``pp``-row submesh and GSPMD-sharded over ``dp`` within it.  No
+  lax.switch, no padding: every stage keeps its natural parameter pytree
+  and activation shapes.
+- The host issues programs in 1F1B order (schedule built by
+  ``build_1f1b_schedule`` — unit-testable); PJRT async dispatch overlaps
+  stages, and jax.Array data dependencies enforce cross-stage ordering.
+  Stage boundaries are explicit ``device_put`` transfers onto the next
+  stage's submesh (ICI).
+- Stage backwards are REMATERIALIZED: ``bwd_s`` recomputes the stage
+  forward inside ``jax.vjp`` (the standard pipeline tradeoff — holding
+  residuals per in-flight microbatch would defeat 1F1B's memory bound).
+  In-flight forward inputs per stage are bounded by ``min(M, S - s)``
+  instead of GPipe's M.
+- The last stage fuses F and B of each microbatch into one program
+  (loss + grads), which is exactly the 1F1B steady state.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .optim import make_optimizer
+
+__all__ = ["build_1f1b_schedule", "schedule_stats", "OneFOneBTrainer"]
+
+
+# ---------------------------------------------------------------------------
+# schedule (pure, unit-testable)
+# ---------------------------------------------------------------------------
+
+def _per_stage_order(S, M, s, schedule="1f1b"):
+    """Op order for stage s: list of ("F"|"B", microbatch)."""
+    if schedule == "gpipe":
+        return ([("F", m) for m in range(M)]
+                + [("B", m) for m in range(M)])
+    warmup = min(M, S - 1 - s)
+    ops = [("F", m) for m in range(warmup)]
+    b = 0
+    for f in range(warmup, M):
+        ops.append(("F", f))
+        ops.append(("B", b))
+        b += 1
+    while b < M:
+        ops.append(("B", b))
+        b += 1
+    return ops
+
+
+def build_1f1b_schedule(S, M, schedule="1f1b"):
+    """Global issue order: list of (stage, kind, microbatch) respecting
+    cross-stage data dependencies while each stage follows its 1F1B (or
+    GPipe) local order.  Dependencies: F(s,m) needs F(s-1,m); B(s,m)
+    needs B(s+1,m); B/F of the last stage are fused in execution but
+    scheduled as F then B back-to-back."""
+    queues = [list(_per_stage_order(S, M, s, schedule)) for s in range(S)]
+    heads = [0] * S
+    done = set()
+    order = []
+
+    def ready(s, op):
+        kind, m = op
+        if kind == "F":
+            return s == 0 or ("F", s - 1, m) in done
+        return (s == S - 1 and ("F", s, m) in done) or \
+            (s < S - 1 and ("B", s + 1, m) in done and
+             ("F", s, m) in done)
+
+    total = sum(len(q) for q in queues)
+    while len(order) < total:
+        progressed = False
+        for s in range(S):
+            while heads[s] < len(queues[s]) and \
+                    ready(s, queues[s][heads[s]]):
+                kind, m = queues[s][heads[s]]
+                order.append((s, kind, m))
+                done.add((kind, s, m))
+                heads[s] += 1
+                progressed = True
+        if not progressed:
+            raise MXNetError("pipeline schedule deadlock (S=%d M=%d)"
+                             % (S, M))
+    return order
+
+
+def schedule_stats(S, M, schedule="1f1b", f_ticks=1, b_ticks=2):
+    """Tick-simulate the schedule (each stage = one executor; F/B cost
+    f_ticks/b_ticks; ops start when deps + executor free).  Returns
+    {"makespan", "bubble_fraction", "peak_inflight"} where peak_inflight
+    is the max number of forwards a stage holds without their backward —
+    the activation-memory bound (1F1B: <= min(M, S - s); GPipe: M)."""
+    finish = {}
+    free_at = [0] * S
+    inflight = [0] * S
+    peak = [0] * S
+    for s, kind, m in build_1f1b_schedule(S, M, schedule):
+        cost = f_ticks if kind == "F" else b_ticks
+        if kind == "F":
+            dep = finish.get(("F", s - 1, m), 0) if s > 0 else 0
+        elif s == S - 1:
+            dep = finish.get(("F", s, m), 0)
+        else:
+            dep = max(finish.get(("B", s + 1, m), 0),
+                      finish.get(("F", s, m), 0))
+        start = max(free_at[s], dep)
+        finish[(kind, s, m)] = start + cost
+        free_at[s] = start + cost
+        if kind == "F":
+            inflight[s] += 1
+            peak[s] = max(peak[s], inflight[s])
+        else:
+            inflight[s] -= 1
+    makespan = max(finish.values())
+    busy = M * (f_ticks + b_ticks)     # per stage
+    return {
+        "makespan": makespan,
+        "bubble_fraction": 1.0 - busy / makespan,
+        "peak_inflight": peak,
+    }
+
+
+# ---------------------------------------------------------------------------
+# trainer
+# ---------------------------------------------------------------------------
+
+def _pipeline_trainer_cls():
+    from .pipeline import PipelineTrainer
+
+    return PipelineTrainer
+
+
+class OneFOneBTrainer(_pipeline_trainer_cls()):
+    """MPMD 1F1B pipeline trainer (constructed via
+    ``PipelineTrainer(..., schedule='1f1b')``)."""
+
+    def __init__(self, block, loss=None, optimizer="sgd",
+                 optimizer_params=None, mesh=None, loss_fn=None,
+                 num_microbatches=4, dtype=None, *, schedule="1f1b"):
+        self._init_common(block, loss, optimizer, optimizer_params, mesh,
+                          loss_fn, num_microbatches, dtype, "1f1b")
+        self._built = False
+        self._pending_state = None
+        self.last_peak_inflight = None   # introspection for tests
+
+    # -- setup ---------------------------------------------------------------
+    def _stage_meshes(self):
+        axis = self._mesh.axis_names.index("pp")
+        devs = _np.moveaxis(_np.asarray(self._mesh.devices), axis, 0)
+        return [Mesh(_np.asarray(devs[s]).reshape(-1), ("dp",))
+                for s in range(self._S)]
+
+    def _setup(self, x, y):
+        from .. import autograd
+        from ..gluon.nn import HybridSequential
+        from .pipeline import _partition_stages
+
+        block = self._block
+        children = list(block)
+        if len(children) < self._S:
+            raise MXNetError("model has %d layers < %d pipeline stages"
+                             % (len(children), self._S))
+        if any(p._data is None for p in block.collect_params().values()):
+            with autograd.pause():
+                block(NDArray(x))
+
+        B = x.shape[0]
+        M, S, dp = self._M, self._S, self._dp
+        if B % M:
+            raise MXNetError("batch %d not divisible by "
+                             "num_microbatches %d" % (B, M))
+        mb = B // M
+        if mb % dp:
+            raise MXNetError("microbatch %d not divisible by dp=%d"
+                             % (mb, dp))
+
+        self._meshes = self._stage_meshes()
+        stage_children = _partition_stages(children, S)
+        self._applies, self._named, self._params = [], [], []
+        self._fwd, self._bwd, self._opt_apply = [], [], []
+        self._opt_states = []
+        rng0 = jax.random.PRNGKey(0)
+        abstract = jax.ShapeDtypeStruct((mb,) + x.shape[1:], x.dtype)
+        self._in_avals = []
+        loss_fn, user_loss = self._loss_fn, self._user_loss
+
+        for si, kids in enumerate(stage_children):
+            seq = HybridSequential()
+            seq.add(*kids)
+            apply_fn, params = seq.export_pure(training=True)
+            for n, v in params.items():
+                if v.dtype != jnp.float32:
+                    raise MXNetError("1f1b pipeline requires f32 params "
+                                     "(%s is %s)" % (n, v.dtype))
+            outs, states = jax.eval_shape(apply_fn, params, rng0, abstract)
+            if states:
+                raise MXNetError(
+                    "pipeline stage %d updates running statistics (%s) — "
+                    "BatchNorm-style layers are not supported" %
+                    (si, list(states)))
+            if len(outs) != 1:
+                raise MXNetError("pipeline stages must be single-output")
+            smesh = self._meshes[si]
+            repl = NamedSharding(smesh, P())
+            shard0 = NamedSharding(smesh, P("dp"))
+            self._in_avals.append(abstract)
+            self._applies.append(apply_fn)
+            self._named.append(seq.collect_params())
+            self._params.append({
+                n: jax.device_put(v, repl) for n, v in params.items()})
+            self._opt_states.append(jax.tree_util.tree_map(
+                lambda v: jax.device_put(v, repl),
+                self._opt_init(params)))
+
+            last = si == S - 1
+
+            def stage_out(p, xin, rng, m, _f=apply_fn, _s=si):
+                key = jax.random.fold_in(jax.random.fold_in(rng, _s), m)
+                outs2, _ = _f(p, key, xin)
+                return outs2[0]
+
+            if not last:
+                fwd = jax.jit(
+                    stage_out,
+                    in_shardings=(repl, shard0, None, None),
+                    out_shardings=shard0)
+
+                def bwd(p, xin, rng, m, ct, _so=stage_out):
+                    # remat: rebuild the stage vjp from the saved INPUT
+                    out, vjp = jax.vjp(
+                        lambda pp, xx: _so(pp, xx, rng, m), p, xin)
+                    pg, xg = vjp(ct.astype(out.dtype))
+                    return pg, xg
+
+                bwd = jax.jit(
+                    bwd,
+                    in_shardings=(repl, shard0, None, None, shard0),
+                    out_shardings=(repl, shard0))
+            else:
+                def last_fb(p, xin, ylab, rng, m, _so=stage_out):
+                    def lossf(pp, xx):
+                        out = _so(pp, xx, rng, m)
+                        if user_loss:
+                            return jnp.mean(loss_fn([out], ylab))
+                        return jnp.mean(loss_fn(out, ylab))
+
+                    loss_val, (pg, xg) = jax.value_and_grad(
+                        lossf, argnums=(0, 1))(p, xin)
+                    return loss_val, pg, xg
+
+                fwd = None
+                bwd = jax.jit(
+                    last_fb,
+                    in_shardings=(repl, shard0, shard0, None, None),
+                    out_shardings=(None, repl, shard0))
+
+            def opt_apply(step_i, p, g, st, lr, _upd=self._opt_update):
+                return _upd(step_i, p, g, st, lr)
+
+            self._opt_apply.append(jax.jit(
+                opt_apply,
+                in_shardings=(None, repl, repl, repl, None),
+                out_shardings=(repl, repl),
+                donate_argnums=(1, 3)))
+            self._fwd.append(fwd)
+            self._bwd.append(bwd)
+            abstract = jax.ShapeDtypeStruct(outs[0].shape, outs[0].dtype)
+
+        self._mb = mb
+        self._order = build_1f1b_schedule(S, M)
+        # per-boundary transfer shardings, fixed once shapes are known
+        def _bshard(mesh_s, aval):
+            return NamedSharding(mesh_s,
+                                 P("dp", *([None] * (aval.ndim - 1))))
+
+        self._xfer_in = [_bshard(self._meshes[s], self._in_avals[s])
+                         for s in range(S)]
+        # ct of stage s-1's OUTPUT: stage s's input spec on s-1's submesh
+        self._xfer_ct = [None] + [
+            NamedSharding(self._meshes[s - 1], self._xfer_in[s].spec)
+            for s in range(1, S)]
+        self._shard_x0 = self._xfer_in[0]
+        self._shard_y = NamedSharding(self._meshes[-1],
+                                      P("dp", *([None] * (y.ndim - 1))))
+        self._built = True
+        if self._pending_state is not None:
+            state, self._pending_state = self._pending_state, None
+            self._apply_state(state)
+
+    # -- public --------------------------------------------------------------
+    def step(self, x, y):
+        from .. import random as mxrandom
+
+        x = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        y = y._data if isinstance(y, NDArray) else jnp.asarray(y)
+        if not self._built:
+            self._setup(x, y)
+        S, M, mb = self._S, self._M, self._mb
+        if x.shape[0] != M * mb:
+            raise MXNetError(
+                "batch %d does not match the compiled pipeline step "
+                "(%d microbatches x %d); keep the batch size fixed or "
+                "drop the epoch tail" % (x.shape[0], M, mb))
+        rng = mxrandom.take_key()
+        xm = [jax.device_put(x[m * mb:(m + 1) * mb], self._shard_x0)
+              for m in range(M)]
+        ym = [jax.device_put(y[m * mb:(m + 1) * mb], self._shard_y)
+              for m in range(M)]
+
+        acts = [{} for _ in range(S)]     # (stage) -> {m: saved input}
+        cts = [{} for _ in range(S)]      # cotangents arriving at stage
+        gacc = [None] * S
+        losses = []
+        # executed-forwards minus executed-backwards per stage: the
+        # activation-memory bound 1F1B exists to cap (<= S - s)
+        outstanding = [0] * S
+        peak = [0] * S
+
+        def add_grads(s, pg):
+            gacc[s] = pg if gacc[s] is None else jax.tree_util.tree_map(
+                jnp.add, gacc[s], pg)
+
+        for s, kind, m in self._order:
+            if kind == "F" and s < S - 1:
+                xin = xm[m] if s == 0 else acts[s][m]
+                if s == 0:
+                    acts[s][m] = xin
+                out = self._fwd[s](self._params[s], xin, rng,
+                                   jnp.uint32(m))
+                acts[s + 1][m] = jax.device_put(out, self._xfer_in[s + 1])
+                outstanding[s] += 1
+                peak[s] = max(peak[s], outstanding[s])
+            elif kind == "F":            # last stage: fused into B
+                outstanding[s] += 1
+                peak[s] = max(peak[s], outstanding[s])
+            else:
+                if s == S - 1:
+                    loss, pg, xg = self._bwd[s](
+                        self._params[s], acts[s].pop(m), ym[m], rng,
+                        jnp.uint32(m))
+                    losses.append(loss)
+                else:
+                    pg, xg = self._bwd[s](
+                        self._params[s], acts[s].pop(m), rng,
+                        jnp.uint32(m), cts[s].pop(m))
+                add_grads(s, pg)
+                outstanding[s] -= 1
+                if s > 0:
+                    cts[s - 1][m] = jax.device_put(xg, self._xfer_ct[s])
+
+        self.last_peak_inflight = peak
+        lr_t = (self._lr_scheduler(self._step_count + 1)
+                if self._lr_scheduler is not None else self._lr)
+        scale = 1.0 / M
+        for s in range(S):
+            g = jax.tree_util.tree_map(lambda v: v * scale, gacc[s])
+            self._params[s], self._opt_states[s] = self._opt_apply[s](
+                jnp.uint32(self._step_count), self._params[s], g,
+                self._opt_states[s], jnp.float32(lr_t))
+        self._step_count += 1
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + jax.device_put(l, total.sharding)
+        return NDArray(total / M)
+
+    # -- checkpoint/resume (mxnet_tpu.elastic contract) ----------------------
+    def state_dict(self):
+        if not self._built:
+            return None
+        # COPIES, not aliases: the optimizer step donates the live param/
+        # state buffers, which would delete a snapshot taken by reference
+        copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)
+        return {
+            "params": [copy(dict(p)) for p in self._params],
+            "opt_states": [copy(s) for s in self._opt_states],
+            "step": jnp.uint32(self._step_count),
+        }
+
+    def load_state_dict(self, state):
+        if not self._built:
+            self._pending_state = state
+            return
+        self._apply_state(state)
+
+    def _apply_state(self, state):
+        for s in range(self._S):
+            repl = NamedSharding(self._meshes[s], P())
+            self._params[s] = {
+                n: jax.device_put(v, repl)
+                for n, v in state["params"][s].items()}
+            self._opt_states[s] = jax.tree_util.tree_map(
+                lambda v: jax.device_put(v, repl),
+                state["opt_states"][s])
+        self._step_count = int(state["step"])
+
+    def sync_block(self):
+        for s in range(self._S):
+            named = self._named[s]
+            for n, v in self._params[s].items():
+                named[n]._data._data = jnp.asarray(_np.asarray(v))
+
+    @property
+    def params(self):
+        return self._params

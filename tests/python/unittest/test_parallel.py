@@ -710,3 +710,126 @@ def test_ring_attention_flash_impl_matches_dense():
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-3, atol=2e-4,
                                        err_msg="d" + name)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B pipeline (VERDICT r4 item 5): per-stage programs, no lax.switch
+# ---------------------------------------------------------------------------
+
+def test_1f1b_schedule_validity_and_memory_bound():
+    """The built schedule respects data deps; peak in-flight activations
+    per stage are bounded by min(M, S-s) (1F1B) vs M (GPipe)."""
+    from mxnet_tpu.parallel.pipeline_1f1b import (
+        build_1f1b_schedule, schedule_stats)
+
+    S, M = 4, 16     # M = 4*S, the VERDICT config
+    order = build_1f1b_schedule(S, M)
+    assert len(order) == 2 * S * M
+    seen = set()
+    for s, kind, m in order:
+        if kind == "F":
+            assert s == 0 or ("F", s - 1, m) in seen
+        else:
+            assert ("F", s, m) in seen
+            assert s == S - 1 or ("B", s + 1, m) in seen
+        seen.add((kind, s, m))
+
+    st_1f1b = schedule_stats(S, M, "1f1b")
+    st_gpipe = schedule_stats(S, M, "gpipe")
+    for s in range(S):
+        assert st_1f1b["peak_inflight"][s] <= min(M, S - s), \
+            st_1f1b["peak_inflight"]
+        assert st_gpipe["peak_inflight"][s] == M
+    # bubble: both schedules idle (S-1) fill + (S-1) drain slots; at
+    # M=4S the fraction stays below the analytic (S-1)/(M+S-1) with
+    # F=1,B=2 tick costs
+    assert st_1f1b["bubble_fraction"] <= st_gpipe["bubble_fraction"] + 1e-9
+    assert st_1f1b["bubble_fraction"] < (S - 1) / (M + S - 1) + 1e-9, \
+        st_1f1b["bubble_fraction"]
+
+
+def test_1f1b_trainer_matches_fused_s4():
+    """S=4 1F1B run matches FusedTrainer loss trajectory (the VERDICT
+    done-bar) — per-stage programs, natural shapes, remat backward."""
+    mesh = _mesh_or_skip({"pp": 4})
+    np.random.seed(4)
+    X = np.random.rand(16, 12).astype(np.float32)
+    Y = np.random.randint(0, 8, 16).astype(np.int32)
+    net_p = _mlp_for_pipeline(21)
+    net_s = _mlp_for_pipeline(21)
+    pipe = parallel.PipelineTrainer(
+        net_p, loss="softmax_ce", optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        mesh=mesh, num_microbatches=8, schedule="1f1b")
+    ref = parallel.FusedTrainer(
+        net_s, loss="softmax_ce", optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    losses_p, losses_r = [], []
+    for _ in range(5):
+        losses_p.append(float(pipe.step(X, Y).asscalar()))
+        losses_r.append(float(ref.step(X, Y).asscalar()))
+    assert_almost_equal(np.array(losses_p), np.array(losses_r),
+                        rtol=1e-3, atol=1e-4)
+    assert losses_p[-1] < losses_p[0]
+    # runtime memory bound observed, not just scheduled
+    S, M = 4, 8
+    for s, peak in enumerate(pipe.last_peak_inflight):
+        assert peak <= min(M, S - s), pipe.last_peak_inflight
+
+
+def test_1f1b_dp_pp_and_sync_block():
+    """pp x dp 1F1B: batch sharded over dp; sync_block writes stage
+    params back."""
+    mesh = _mesh_or_skip({"pp": 2, "dp": 2})
+    np.random.seed(5)
+    X = np.random.rand(16, 12).astype(np.float32)
+    Y = np.random.randint(0, 8, 16).astype(np.int32)
+    net_p = _mlp_for_pipeline(23)
+    net_s = _mlp_for_pipeline(23)
+    pipe = parallel.PipelineTrainer(
+        net_p, loss="softmax_ce", optimizer="adam",
+        optimizer_params={"learning_rate": 1e-2},
+        mesh=mesh, num_microbatches=4, schedule="1f1b")
+    ref = parallel.FusedTrainer(
+        net_s, loss="softmax_ce", optimizer="adam",
+        optimizer_params={"learning_rate": 1e-2})
+    for _ in range(3):
+        lp = float(pipe.step(X, Y).asscalar())
+        lr_ = float(ref.step(X, Y).asscalar())
+        assert abs(lp - lr_) < 1e-3 * max(1.0, abs(lr_))
+    pipe.sync_block()
+    ref.sync_block()
+    # logits drift apart at fp-accumulation level after 3 adam steps;
+    # the LOSS the two models achieve must agree
+    def eager_loss(net):
+        out = net(nd.array(X)).asnumpy()
+        logp = out - np.log(np.exp(out - out.max(1, keepdims=True))
+                            .sum(1, keepdims=True)) - out.max(
+                                1, keepdims=True)
+        return -logp[np.arange(len(Y)), Y].mean()
+
+    assert abs(eager_loss(net_p) - eager_loss(net_s)) < 5e-3
+
+
+def test_1f1b_state_dict_roundtrip():
+    mesh = _mesh_or_skip({"pp": 2})
+    np.random.seed(6)
+    X = np.random.rand(8, 12).astype(np.float32)
+    Y = np.random.randint(0, 8, 8).astype(np.int32)
+    net_a = _mlp_for_pipeline(31)
+    net_b = _mlp_for_pipeline(31)
+    a = parallel.PipelineTrainer(
+        net_a, loss="softmax_ce", optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1},
+        mesh=mesh, num_microbatches=2, schedule="1f1b")
+    b = parallel.PipelineTrainer(
+        net_b, loss="softmax_ce", optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1},
+        mesh=mesh, num_microbatches=2, schedule="1f1b")
+    for _ in range(2):
+        a.step(X, Y)
+    state = a.state_dict()
+    b.load_state_dict(state)   # parked (pre-setup), applied at first step
+    la = float(a.step(X, Y).asscalar())
+    lb = float(b.step(X, Y).asscalar())
+    assert abs(la - lb) < 1e-5 * max(1.0, abs(la))
