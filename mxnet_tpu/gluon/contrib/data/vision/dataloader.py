@@ -43,7 +43,7 @@ def create_bbox_augment(data_shape, rand_crop=0, rand_pad=0, rand_gray=0,
     image_detection.CreateDetAugmenter."""
     return _det.CreateDetAugmenter(
         data_shape, rand_crop=rand_crop, rand_pad=rand_pad,
-        rand_mirror=rand_mirror, mean=mean, std=std,
+        rand_gray=rand_gray, rand_mirror=rand_mirror, mean=mean, std=std,
         brightness=brightness, contrast=contrast, saturation=saturation,
         hue=hue, pca_noise=pca_noise, inter_method=inter_method, **kwargs)
 

@@ -417,6 +417,159 @@ class CastAug(Augmenter):
         return src.astype(self.typ)
 
 
+def imrotate(src, rotation_degrees, zoom_in=False, zoom_out=False):
+    """Rotate image(s) by ``rotation_degrees`` (reference image.py:618
+    imrotate — CHW or NCHW float32; ``zoom_in`` crops so no padding
+    shows, ``zoom_out`` shrinks so the whole source stays visible).
+
+    TPU-native: the (N,6) affine theta is assembled on the host (it is
+    tiny) and the grid + bilinear sampling run through the registry's
+    GridGenerator/BilinearSampler ops (ops/image_ops.py), so the pixel
+    work happens on device and gradients flow to ``src``."""
+    import numbers
+
+    if zoom_in and zoom_out:
+        raise ValueError("`zoom_in` and `zoom_out` cannot be both True")
+    src = src if isinstance(src, nd.NDArray) else nd.array(src)
+    if str(src.dtype) != "float32":
+        raise TypeError("Only `float32` images are supported")
+    expanded = False
+    if src.ndim == 3:
+        expanded = True
+        if not isinstance(rotation_degrees, numbers.Number):
+            raise TypeError("single image needs a scalar angle")
+        src = nd.expand_dims(src, axis=0)
+    elif src.ndim != 4:
+        raise ValueError("Only 3D (CHW) and 4D (NCHW) are supported")
+    N, _C, H, W = src.shape
+    if isinstance(rotation_degrees, numbers.Number):
+        angles = _np.full(N, float(rotation_degrees), _np.float32)
+    else:
+        angles = _np.asarray(
+            rotation_degrees.asnumpy()
+            if isinstance(rotation_degrees, nd.NDArray)
+            else rotation_degrees, _np.float32).reshape(-1)
+        if len(angles) != N:
+            raise ValueError("need one angle per image")
+    rad = _np.pi * angles / 180.0
+
+    hs, ws = (H - 1) / 2.0, (W - 1) / 2.0
+    c = _np.cos(rad)
+    s = _np.sin(rad)
+    if zoom_in or zoom_out:
+        rho = _np.sqrt(H * H + W * W)
+        ang = _np.arctan2(H, W)
+        a = _np.abs(rad)
+        max_x = _np.maximum(_np.abs(rho * _np.cos(ang + a)),
+                            _np.abs(rho * _np.cos(ang - a)))
+        max_y = _np.maximum(_np.abs(rho * _np.sin(ang + a)),
+                            _np.abs(rho * _np.sin(ang - a)))
+        if zoom_out:
+            scale = _np.maximum(max_x / W, max_y / H)
+        else:
+            scale = _np.minimum(W / max_x, H / max_y)
+    else:
+        scale = _np.ones_like(rad)
+    # aspect-preserving rotation in normalized coords:
+    # x' = s*(c*x - (hs/ws)*sin*y), y' = s*((ws/hs)*sin*x + c*y)
+    zeros = _np.zeros_like(rad)
+    theta = _np.stack([
+        scale * c, -scale * s * (hs / ws), zeros,
+        scale * s * (ws / hs), scale * c, zeros], axis=1) \
+        .astype(_np.float32)
+    grid = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                            target_shape=(H, W))
+    out = nd.BilinearSampler(src, grid)
+    return out[0] if expanded else out
+
+
+def random_rotate(src, angle_limits, zoom_in=False, zoom_out=False):
+    """Rotate by a uniform random angle in ``angle_limits`` (reference
+    image.py:727)."""
+    lo, hi = angle_limits
+    if src.ndim == 3:
+        deg = float(_np.random.uniform(lo, hi))
+    else:
+        deg = _np.random.uniform(lo, hi, size=src.shape[0]) \
+            .astype(_np.float32)
+    return imrotate(src, deg if _np.isscalar(deg) else nd.array(deg),
+                    zoom_in=zoom_in, zoom_out=zoom_out)
+
+
+def rgb_to_hsv(arr):
+    """HWC float [0,1] RGB -> HSV (vectorized colorsys semantics)."""
+    r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+    mx_ = _np.max(arr, axis=-1)
+    mn = _np.min(arr, axis=-1)
+    diff = mx_ - mn
+    safe = _np.where(diff == 0, 1.0, diff)
+    h = _np.where(
+        mx_ == r, (g - b) / safe % 6.0,
+        _np.where(mx_ == g, (b - r) / safe + 2.0, (r - g) / safe + 4.0))
+    h = _np.where(diff == 0, 0.0, h) / 6.0
+    s = _np.where(mx_ == 0, 0.0, diff / _np.where(mx_ == 0, 1.0, mx_))
+    return _np.stack([h, s, mx_], axis=-1)
+
+
+def hsv_to_rgb(arr):
+    """HWC float HSV -> RGB (inverse of rgb_to_hsv)."""
+    h, s, v = arr[..., 0] * 6.0, arr[..., 1], arr[..., 2]
+    i = _np.floor(h)
+    f = h - i
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    i = i.astype(_np.int32) % 6
+    r = _np.choose(i, [v, q, p, p, t, v])
+    g = _np.choose(i, [t, v, v, q, p, p])
+    b = _np.choose(i, [p, p, t, v, v, q])
+    return _np.stack([r, g, b], axis=-1)
+
+
+class HSVJitterAug(Augmenter):
+    """Jitter hue/saturation/value in HSV space (the exact color-space
+    rendering; the reference's HueJitterAug approximates hue rotation
+    with an RGB matrix).  Oracle-tested against colorsys."""
+
+    def __init__(self, hue=0.0, saturation=0.0, value=0.0):
+        super().__init__(hue=hue, saturation=saturation, value=value)
+        self.hue = hue
+        self.saturation = saturation
+        self.value = value
+
+    def __call__(self, src):
+        arr = src.asnumpy().astype(_np.float32)
+        scale = 255.0 if arr.max() > 1.0 else 1.0
+        hsv = rgb_to_hsv(arr / scale)
+        dh = _np.random.uniform(-self.hue, self.hue)
+        ds = 1.0 + _np.random.uniform(-self.saturation, self.saturation)
+        dv = 1.0 + _np.random.uniform(-self.value, self.value)
+        hsv[..., 0] = (hsv[..., 0] + dh) % 1.0
+        hsv[..., 1] = _np.clip(hsv[..., 1] * ds, 0, 1)
+        hsv[..., 2] = _np.clip(hsv[..., 2] * dv, 0, 1)
+        return nd.array(hsv_to_rgb(hsv) * scale, dtype=src.dtype)
+
+
+class RandomRotateAug(Augmenter):
+    """Random rotation augmenter over ``imrotate`` (HWC uint8/float in,
+    same out; the angle draw matches reference random_rotate)."""
+
+    def __init__(self, angle_limits, zoom_in=False, zoom_out=False):
+        super().__init__(angle_limits=angle_limits, zoom_in=zoom_in,
+                         zoom_out=zoom_out)
+        self.angle_limits = angle_limits
+        self.zoom_in = zoom_in
+        self.zoom_out = zoom_out
+
+    def __call__(self, src):
+        arr = src.asnumpy().astype(_np.float32)
+        chw = nd.array(arr.transpose(2, 0, 1))
+        out = random_rotate(chw, self.angle_limits, zoom_in=self.zoom_in,
+                            zoom_out=self.zoom_out)
+        return nd.array(out.asnumpy().transpose(1, 2, 0),
+                        dtype=src.dtype)
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, hue=0, pca_noise=0,
@@ -486,9 +639,13 @@ class ImageIter:
 
 # detection augmenters + iterator (reference python/mxnet/image/detection.py)
 from .image_detection import (  # noqa: E402,F401
-    CreateDetAugmenter, DetAugmenter, DetBorrowAug, DetHorizontalFlipAug,
-    DetRandomCropAug, DetRandomPadAug, DetRandomSelectAug, ImageDetIter)
+    CreateDetAugmenter, CreateMultiRandCropAugmenter, DetAugmenter,
+    DetBorrowAug, DetHorizontalFlipAug, DetRandomCropAug, DetRandomPadAug,
+    DetRandomSelectAug, ImageDetIter)
 
-__all__ += ["CreateDetAugmenter", "DetAugmenter", "DetBorrowAug",
+__all__ += ["CreateDetAugmenter", "CreateMultiRandCropAugmenter",
+            "DetAugmenter", "DetBorrowAug",
             "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
-            "DetRandomSelectAug", "ImageDetIter"]
+            "DetRandomSelectAug", "ImageDetIter",
+            "imrotate", "random_rotate", "RandomRotateAug",
+            "HSVJitterAug", "rgb_to_hsv", "hsv_to_rgb"]

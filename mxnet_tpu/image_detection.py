@@ -76,9 +76,19 @@ class DetRandomCropAug(DetAugmenter):
     center falls outside are dropped (marked cls=-1, shape-stable)."""
 
     def __init__(self, min_object_covered=0.3, min_crop_scale=0.3,
-                 max_crop_scale=1.0, max_attempts=20):
+                 max_crop_scale=1.0, max_attempts=20,
+                 aspect_ratio_range=(0.75, 1.33), area_range=None,
+                 min_eject_coverage=0.3):
         self.min_object_covered = float(min_object_covered)
-        self.scale_range = (float(min_crop_scale), float(max_crop_scale))
+        if area_range is not None:
+            self.area_range = (float(area_range[0]), float(area_range[1]))
+        else:
+            # back-compat: scale range on the side length
+            self.area_range = (float(min_crop_scale) ** 2,
+                               float(max_crop_scale) ** 2)
+        self.aspect_ratio_range = (float(aspect_ratio_range[0]),
+                                   float(aspect_ratio_range[1]))
+        self.min_eject_coverage = float(min_eject_coverage)
         self.max_attempts = int(max_attempts)
 
     def __call__(self, src, label):
@@ -88,8 +98,10 @@ class DetRandomCropAug(DetAugmenter):
                         else label, copy=True)
         valid = lab[:, 0] >= 0
         for _ in range(self.max_attempts):
-            s = _np.random.uniform(*self.scale_range)
-            cw, ch = s, s
+            area = _np.random.uniform(*self.area_range)
+            ratio = _np.random.uniform(*self.aspect_ratio_range)
+            cw = min(_np.sqrt(area * ratio), 1.0)
+            ch = min(_np.sqrt(area / ratio), 1.0)
             cx = _np.random.uniform(0, 1 - cw)
             cy = _np.random.uniform(0, 1 - ch)
             # fraction of each box covered by the crop
@@ -115,7 +127,8 @@ class DetRandomCropAug(DetAugmenter):
                 centers_x = (nl[:, 1] + nl[:, 3]) / 2
                 centers_y = (nl[:, 2] + nl[:, 4]) / 2
                 keep = ((centers_x > 0) & (centers_x < 1) &
-                        (centers_y > 0) & (centers_y < 1) & valid)
+                        (centers_y > 0) & (centers_y < 1) & valid &
+                        (cover >= self.min_eject_coverage))
                 nl[:, 1:5] = _np.clip(nl[:, 1:5], 0.0, 1.0)
                 nl[~keep, 0] = -1  # invalid marker, shape-stable
                 return nd.array(out.copy(), dtype=src.dtype), nd.array(nl)
@@ -151,23 +164,106 @@ class DetRandomPadAug(DetAugmenter):
         return nd.array(canvas, dtype=src.dtype), nd.array(lab)
 
 
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0.0):
+    """Reference detection.py:418 CreateMultiRandCropAugmenter: each
+    scalar parameter may instead be a list; one DetRandomCropAug per
+    parameter tuple, wrapped so a random one fires per sample."""
+    def listify(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v]
+
+    mocs = listify(min_object_covered)
+
+    # aspect/area entries are pair-tuples; a list of pairs means
+    # per-crop settings
+    def pairs(v):
+        if isinstance(v, (list, tuple)) and v and \
+                isinstance(v[0], (list, tuple)):
+            return [tuple(p) for p in v]
+        return [tuple(v)]
+
+    ratios = pairs(aspect_ratio_range)
+    areas = pairs(area_range)
+    ejects = listify(min_eject_coverage)
+    n = max(len(mocs), len(ratios), len(areas), len(ejects))
+
+    def at(lst, i):
+        if len(lst) == 1:
+            return lst[0]
+        if len(lst) != n:
+            raise MXNetError(
+                "CreateMultiRandCropAugmenter: parameter lists must share "
+                "one length (got %d vs %d)" % (len(lst), n))
+        return lst[i]
+
+    crops = [DetRandomCropAug(
+        min_object_covered=at(mocs, i),
+        aspect_ratio_range=at(ratios, i), area_range=at(areas, i),
+        min_eject_coverage=at(ejects, i), max_attempts=max_attempts)
+        for i in range(n)]
+    return DetRandomSelectAug(crops, skip_prob=skip_prob)
+
+
 def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
-                       rand_mirror=False, mean=None, std=None,
-                       min_object_covered=0.3, max_pad_scale=2.0,
-                       **kwargs):
-    """Standard detection augmenter chain (reference detection.py
-    CreateDetAugmenter)."""
+                       rand_gray=0, rand_mirror=False, mean=None,
+                       std=None, brightness=0, contrast=0, saturation=0,
+                       pca_noise=0, hue=0, inter_method=2,
+                       min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, max_pad_scale=2.0,
+                       pad_val=(127, 127, 127)):
+    """Standard detection augmenter chain — full reference option set
+    (detection.py:483 CreateDetAugmenter): geometric crop/pad/mirror
+    plus the color augmenters borrowed through DetBorrowAug."""
+    from .image import (CastAug, ColorJitterAug, ColorNormalizeAug,
+                        ForceResizeAug, HueJitterAug, LightingAug,
+                        RandomGrayAug, ResizeAug)
+
     augs = []
+    if resize > 0:
+        augs.append(DetBorrowAug(ResizeAug(resize, inter_method)))
     if rand_crop > 0:
-        augs.append(DetRandomSelectAug(
-            [DetRandomCropAug(min_object_covered=min_object_covered)],
-            skip_prob=1.0 - rand_crop))
+        crop = CreateMultiRandCropAugmenter(
+            min_object_covered=min_object_covered,
+            aspect_ratio_range=aspect_ratio_range,
+            area_range=(min(area_range[0], 1.0), min(area_range[1], 1.0)),
+            min_eject_coverage=min_eject_coverage,
+            max_attempts=max_attempts, skip_prob=1.0 - rand_crop)
+        augs.append(crop)
     if rand_pad > 0:
         augs.append(DetRandomSelectAug(
-            [DetRandomPadAug(max_pad_scale=max_pad_scale)],
+            [DetRandomPadAug(max_pad_scale=max_pad_scale,
+                             pad_val=pad_val)],
             skip_prob=1.0 - rand_pad))
     if rand_mirror:
         augs.append(DetHorizontalFlipAug(0.5))
+    # force the output shape (the crop/pad change it)
+    augs.append(DetBorrowAug(
+        ForceResizeAug((data_shape[2], data_shape[1]), inter_method)))
+    augs.append(DetBorrowAug(CastAug()))
+    if brightness or contrast or saturation:
+        augs.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        augs.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        augs.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
+    if rand_gray > 0:
+        augs.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None and len(_np.atleast_1d(mean)):
+        augs.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
     return augs
 
 

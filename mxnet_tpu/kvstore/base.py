@@ -15,6 +15,13 @@ def register(klass):
     return klass
 
 
+def _pair(key, value):
+    """Normalize (key, value) to parallel lists (shared by every store)."""
+    if isinstance(key, (list, tuple)):
+        return list(key), list(value)
+    return [key], [value]
+
+
 class KVStoreBase:
     """Interface: broadcast / pushpull (+ optional optimizer offload)."""
 
@@ -31,6 +38,79 @@ class KVStoreBase:
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         raise NotImplementedError
+
+    def _key(self, key):
+        return str(key)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows named by ``row_ids`` (reference
+        kvstore.py:385 row_sparse_pull — the sparse-embedding workflow:
+        servers hold the full table, workers fetch the rows this batch
+        touches).  Each ``out`` receives a RowSparseNDArray whose stored
+        rows are ``unique(row_ids)``.
+
+        ``row_ids`` is one array-like (shared by every out) or a list of
+        array-likes matching the flattened outs one-to-one (the reference
+        out/row_ids pairing contract); a length mismatch raises instead of
+        silently truncating."""
+        import jax.numpy as jnp
+
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull requires row_ids")
+        keys, outs = _pair(key, out)
+        flat_dsts, dst_keys = [], []
+        for k, o in zip(keys, outs):
+            group = o if isinstance(o, (list, tuple)) else [o]
+            flat_dsts.extend(group)
+            dst_keys.extend([k] * len(group))
+
+        def as_ids(v):
+            arr = v._data if hasattr(v, "_data") else jnp.asarray(v)
+            return arr.reshape(-1).astype(jnp.int32)
+
+        import numbers
+
+        if isinstance(row_ids, (list, tuple)) and row_ids and \
+                not isinstance(row_ids[0], numbers.Number):
+            if len(row_ids) != len(flat_dsts):
+                raise MXNetError(
+                    "row_sparse_pull: %d row_ids arrays for %d outs"
+                    % (len(row_ids), len(flat_dsts)))
+            ids_per_dst = [as_ids(r) for r in row_ids]
+        else:
+            ids_per_dst = [as_ids(row_ids)] * len(flat_dsts)
+
+        for dst, k, idx in zip(flat_dsts, dst_keys, ids_per_dst):
+            src = self._store[self._key(k)]
+            n_rows = src.shape[0]
+            import numpy as _np
+
+            host_idx = _np.asarray(idx)
+            if host_idx.size and (host_idx.min() < 0
+                                  or host_idx.max() >= n_rows):
+                raise MXNetError(
+                    "row_sparse_pull: row id out of range [0, %d): %r"
+                    % (n_rows, int(host_idx.min() if host_idx.min() < 0
+                                   else host_idx.max())))
+            uniq = jnp.unique(idx)
+            rsp = RowSparseNDArray(src._data[uniq], uniq, src.shape)
+            if isinstance(dst, RowSparseNDArray):
+                if tuple(dst.shape) != tuple(src.shape) or \
+                        dst._data.dtype != src._data.dtype:
+                    raise MXNetError(
+                        "row_sparse_pull: out shape/dtype %s/%s does not "
+                        "match stored %s/%s" %
+                        (dst.shape, dst._data.dtype, src.shape,
+                         src._data.dtype))
+                dst._data = rsp._data
+                dst.indices_ = rsp.indices_
+                dst._shape = rsp._shape
+            else:
+                # densify through tostype so copyto's shape/dtype
+                # validation applies (no hand-rolled scatter)
+                rsp.tostype("default").copyto(dst)
 
     def set_optimizer(self, optimizer):
         raise NotImplementedError

@@ -8,6 +8,7 @@ from . import core, nn, quantization  # noqa: F401  (registration effects)
 from . import detection, linalg, np_tail  # noqa: F401  (registration)
 from . import optimizer_ops, tensor_tail, legacy  # noqa: F401  (registration)
 from . import random_ops, contrib_tail  # noqa: F401  (registration)
+from . import image_ops  # noqa: F401  (registration: _image_* + samplers)
 from . import parity  # noqa: F401  (reference-name parity tail; LAST —
 #                        aliases resolve against everything above)
 from .registry import Operator, apply_op, get_op, invoke, list_ops, register

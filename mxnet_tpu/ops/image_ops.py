@@ -278,3 +278,88 @@ for _ref, _ours in [
         ("_image_adjust_lighting", "image_adjust_lighting"),
         ("_image_random_lighting", "image_random_lighting")]:
     alias(_ref, _ours)
+
+
+# ---------------------------------------------------------------------------
+# spatial sampling family — BilinearSampler (bilinear_sampler.cc:150),
+# GridGenerator (grid_generator.cc:237), SpatialTransformer
+# (spatial_transformer.cc:217).  One differentiable jnp bilinear-sample
+# core serves all three (plus image.imrotate); XLA fuses the gathers.
+# ---------------------------------------------------------------------------
+
+def _bilinear_sample_core(data, grid):
+    """data (N,C,H,W), grid (N,2,Ho,Wo) with grid[:,0]=x, grid[:,1]=y in
+    [-1,1]; out-of-range samples read 0 (the reference's zero padding)."""
+    N, C, H, W = data.shape
+    x = (grid[:, 0] + 1.0) * (W - 1) / 2.0       # (N,Ho,Wo)
+    y = (grid[:, 1] + 1.0) * (H - 1) / 2.0
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    wx = x - x0
+    wy = y - y0
+
+    def take(yi, xi):
+        inb = ((xi >= 0) & (xi <= W - 1) & (yi >= 0)
+               & (yi <= H - 1))
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        flat = data.reshape(N, C, H * W)
+        idx = (yc * W + xc).reshape(N, 1, -1)
+        vals = jnp.take_along_axis(
+            flat, jnp.broadcast_to(idx, (N, C, idx.shape[-1])), axis=2)
+        vals = vals.reshape(N, C, *xi.shape[1:])
+        return vals * inb[:, None].astype(data.dtype)
+
+    out = (take(y0, x0) * ((1 - wy) * (1 - wx))[:, None]
+           + take(y0, x0 + 1) * ((1 - wy) * wx)[:, None]
+           + take(y0 + 1, x0) * (wy * (1 - wx))[:, None]
+           + take(y0 + 1, x0 + 1) * (wy * wx)[:, None])
+    return out.astype(data.dtype)
+
+
+@register("BilinearSampler")
+def bilinear_sampler(data, grid, cudnn_off=None):
+    """Reference bilinear_sampler.cc:150: sample ``data`` at ``grid``
+    (normalized [-1,1] x,y), zero outside."""
+    return _bilinear_sample_core(data, grid)
+
+
+@register("GridGenerator")
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """Reference grid_generator.cc:237.
+
+    affine: ``data`` (N,6) row-major 2x3 theta -> grid (N,2,Ho,Wo)
+    warp: ``data`` (N,2,H,W) pixel offsets -> normalized grid
+    """
+    if transform_type == "affine":
+        N = data.shape[0]
+        Ho, Wo = int(target_shape[0]), int(target_shape[1])
+        theta = data.reshape(N, 2, 3)
+        ys, xs = jnp.meshgrid(
+            jnp.linspace(-1.0, 1.0, Ho), jnp.linspace(-1.0, 1.0, Wo),
+            indexing="ij")
+        ones = jnp.ones_like(xs)
+        base = jnp.stack([xs, ys, ones], axis=0).reshape(3, -1)  # (3,HoWo)
+        out = jnp.einsum("nij,jk->nik", theta, base)             # (N,2,HoWo)
+        return out.reshape(N, 2, Ho, Wo)
+    if transform_type == "warp":
+        N, _two, H, W = data.shape
+        ys, xs = jnp.meshgrid(jnp.arange(H, dtype=data.dtype),
+                              jnp.arange(W, dtype=data.dtype),
+                              indexing="ij")
+        x_new = (data[:, 0] + xs) * (2.0 / max(W - 1, 1)) - 1.0
+        y_new = (data[:, 1] + ys) * (2.0 / max(H - 1, 1)) - 1.0
+        return jnp.stack([x_new, y_new], axis=1)
+    raise ValueError("GridGenerator transform_type %r" % (transform_type,))
+
+
+@register("SpatialTransformer")
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear",
+                        cudnn_off=None):
+    """Reference spatial_transformer.cc:217 (STN): affine theta from the
+    localization net + bilinear sampling in one op."""
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise ValueError("SpatialTransformer supports affine/bilinear")
+    grid = grid_generator.fn(loc, "affine", target_shape)
+    return _bilinear_sample_core(data, grid)

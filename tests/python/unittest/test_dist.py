@@ -11,6 +11,7 @@ import os
 import subprocess
 import sys
 
+import numpy as np
 import pytest
 
 _REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
@@ -38,3 +39,93 @@ def test_dist_sync_kvstore_4proc():
     # children share the stdout pipe, so lines can interleave without
     # newlines — count occurrences, not lines
     assert proc.stdout.count("dist_sync_kvstore OK") == 4, proc.stdout
+
+
+def _launch(script, n=2, extra=(), timeout=540, expect_rc=0):
+    """expect_rc: int for an exact match, or "fail" for any nonzero
+    (rank-death drills race on WHICH rank's exit the launcher reports
+    first — the injected code vs a peer's collective-abort error)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+             "-n", str(n), "--backend", "cpu", sys.executable,
+             os.path.join(_REPO, "tests", "nightly", script),
+             *extra],
+            env=env, capture_output=True, text=True, timeout=timeout)
+    except OSError as exc:  # pragma: no cover - sandboxed env
+        pytest.skip("cannot spawn subprocesses: %s" % exc)
+    ok = (proc.returncode != 0) if expect_rc == "fail" \
+        else (proc.returncode == expect_rc)
+    assert ok, (
+        "%s rc=%d (want %s)\n--- stdout ---\n%s\n--- stderr ---\n%s"
+        % (script, proc.returncode, expect_rc, proc.stdout[-3000:],
+           proc.stderr[-3000:]))
+    return proc
+
+
+def test_dist_gradient_compression_2proc():
+    """2-bit codes cross the wire with error feedback (VERDICT r4 #6)."""
+    proc = _launch("dist_grad_compression.py", n=2)
+    assert proc.stdout.count("dist_grad_compression OK") == 2, proc.stdout
+
+
+def test_dist_hybrid_mesh_fused_2proc_x4dev():
+    """2 proc x 4 virtual devices: FusedTrainer over a {dp_dcn, dp}
+    hybrid mesh — the DCN axis crosses the process boundary."""
+    proc = _launch("dist_hybrid_fused.py", n=2, timeout=600)
+    assert proc.stdout.count("dist_hybrid_fused OK") == 2, proc.stdout
+
+
+def test_dist_elastic_kill_and_resume():
+    """Kill rank 1 mid-training; a fresh launch resumes from the
+    CheckpointManager state and lands on the SAME final weights as an
+    uninterrupted run (elastic.py wired to multi-process)."""
+    import re
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        ck_a = os.path.join(td, "a")
+        ck_b = os.path.join(td, "b")
+        # phase 1: rank 1 dies at step 3 -> the launcher must FAIL the
+        # job (whichever rank's exit it polls first)
+        _launch("dist_elastic_resume.py", n=2,
+                extra=["--ckpt", ck_a, "--steps", "6", "--die-at", "3"],
+                expect_rc="fail")
+        # phase 2: resume from the step-3 checkpoint, finish 6 steps
+        proc_resumed = _launch(
+            "dist_elastic_resume.py", n=2,
+            extra=["--ckpt", ck_a, "--steps", "6"])
+        # the kill races rank0's step-3 save: the atomic CheckpointManager
+        # guarantees SOME complete checkpoint (>= step 1) survives
+        assert "resumed at step" in proc_resumed.stdout, \
+            proc_resumed.stdout
+        # reference: uninterrupted 6 steps in a clean dir
+        proc_ref = _launch(
+            "dist_elastic_resume.py", n=2,
+            extra=["--ckpt", ck_b, "--steps", "6"])
+
+        def finals(out):
+            return sorted(float(v) for v in
+                          re.findall(r"FINAL (-?[\d.]+)", out))
+
+        fr, ff = finals(proc_resumed.stdout), finals(proc_ref.stdout)
+        assert len(fr) == 2 and len(ff) == 2, (proc_resumed.stdout,
+                                               proc_ref.stdout)
+        assert np.allclose(fr, ff, rtol=1e-5, atol=1e-6), (fr, ff)
+
+
+def test_dist_row_sparse_and_compressed_training_2proc():
+    """row_sparse_pull across processes + training through a compressed
+    store keeps ranks in lockstep."""
+    proc = _launch("dist_row_sparse.py", n=2)
+    assert proc.stdout.count("dist_row_sparse OK") == 2, proc.stdout
+
+
+def test_dist_sync_kvstore_3proc():
+    """Odd worker count: bucketing/broadcast math must not assume
+    power-of-two ranks."""
+    proc = _launch("dist_sync_kvstore.py", n=3)
+    assert proc.stdout.count("dist_sync_kvstore OK") == 3, proc.stdout

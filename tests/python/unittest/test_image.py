@@ -214,3 +214,161 @@ def test_npx_image_namespace():
     from mxnet_tpu import numpy_extension as npx
 
     assert npx.image.to_tensor is nd.image.to_tensor
+
+
+# ---- imrotate / sampler family (VERDICT r4 item 9) ------------------------
+
+def test_imrotate_identity_and_quarter_turns():
+    rs = np.random.RandomState(0)
+    img = nd.array(rs.rand(3, 8, 8).astype(np.float32))  # CHW
+    out0 = mx.image.imrotate(img, 0)
+    np.testing.assert_allclose(out0.asnumpy(), img.asnumpy(), atol=1e-5)
+    # 90-degree rotation == numpy rot90 oracle per channel (grid sampling
+    # of the exact quarter turn is lossless for odd/even square sizes)
+    out90 = mx.image.imrotate(img, 90)
+    want = np.stack([np.rot90(c, 1) for c in img.asnumpy()])
+    np.testing.assert_allclose(out90.asnumpy(), want, atol=1e-4)
+    out180 = mx.image.imrotate(img, 180)
+    want180 = np.stack([np.rot90(c, 2) for c in img.asnumpy()])
+    np.testing.assert_allclose(out180.asnumpy(), want180, atol=1e-4)
+
+
+def test_imrotate_batched_and_validation():
+    rs = np.random.RandomState(1)
+    batch = nd.array(rs.rand(3, 2, 6, 6).astype(np.float32))  # NCHW
+    out = mx.image.imrotate(batch, nd.array(np.array([0., 90., 180.],
+                                                     np.float32)))
+    np.testing.assert_allclose(out[0].asnumpy(), batch[0].asnumpy(),
+                               atol=1e-5)
+    with pytest.raises(ValueError):
+        mx.image.imrotate(batch[0], 10, zoom_in=True, zoom_out=True)
+    with pytest.raises(TypeError):
+        mx.image.imrotate(nd.array(np.zeros((3, 4, 4), np.int32)), 10)
+    out_r = mx.image.random_rotate(batch, (-10, 10), zoom_in=True)
+    assert out_r.shape == batch.shape
+
+
+def test_zoom_out_contains_whole_image():
+    """zoom_out at 45deg: all four source corners stay inside (their
+    sampled intensity survives), and the mean intensity drops because of
+    the zero padding."""
+    img = nd.array(np.ones((1, 9, 9), np.float32))
+    out = mx.image.imrotate(img, 45, zoom_out=True)
+    # whole image visible => the center row keeps full intensity
+    assert float(out.asnumpy()[0, 4, 4]) > 0.99
+    assert out.asnumpy().mean() < 0.95  # padding entered the canvas
+
+
+def test_bilinear_sampler_matches_manual_shift():
+    """Oracle: a half-pixel x-shift grid equals the numpy average of
+    horizontal neighbors."""
+    rs = np.random.RandomState(2)
+    data = rs.rand(1, 1, 4, 6).astype(np.float32)
+    H, W = 4, 6
+    ys, xs = np.meshgrid(np.arange(H), np.arange(W), indexing="ij")
+    x_shift = xs + 0.5
+    gx = x_shift * 2.0 / (W - 1) - 1.0
+    gy = ys * 2.0 / (H - 1) - 1.0
+    grid = np.stack([gx, gy])[None].astype(np.float32)
+    out = nd.BilinearSampler(nd.array(data), nd.array(grid)).asnumpy()
+    want = np.zeros_like(data)
+    want[..., :-1] = (data[..., :-1] + data[..., 1:]) / 2
+    want[..., -1] = data[..., -1] / 2  # half out-of-bounds -> zero pad
+    np.testing.assert_allclose(out, want, atol=1e-5)
+
+
+def test_grid_generator_affine_matches_numpy():
+    theta = np.array([[0.5, 0.0, 0.1, 0.0, 0.5, -0.2]], np.float32)
+    grid = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                            target_shape=(3, 5)).asnumpy()
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 3), np.linspace(-1, 1, 5),
+                         indexing="ij")
+    want_x = 0.5 * xs + 0.0 * ys + 0.1
+    want_y = 0.0 * xs + 0.5 * ys - 0.2
+    np.testing.assert_allclose(grid[0, 0], want_x, atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1], want_y, atol=1e-6)
+
+
+def test_spatial_transformer_grads_flow():
+    from mxnet_tpu import autograd
+
+    rs = np.random.RandomState(3)
+    data = nd.array(rs.rand(1, 2, 5, 5).astype(np.float32))
+    theta = nd.array(np.array([[1, 0, 0, 0, 1, 0]], np.float32))
+    theta.attach_grad()
+    with autograd.record():
+        out = nd.SpatialTransformer(data, theta, target_shape=(5, 5))
+        L = nd.sum(out * out)
+    L.backward()
+    g = theta.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+# ---- HSV jitter oracle vs colorsys ----------------------------------------
+
+def test_rgb_hsv_roundtrip_matches_colorsys():
+    import colorsys
+
+    rs = np.random.RandomState(4)
+    arr = rs.rand(5, 7, 3).astype(np.float32)
+    hsv = mx.image.rgb_to_hsv(arr)
+    for i in range(5):
+        for j in range(0, 7, 3):
+            want = colorsys.rgb_to_hsv(*arr[i, j])
+            np.testing.assert_allclose(hsv[i, j], want, atol=1e-5)
+    back = mx.image.hsv_to_rgb(hsv)
+    np.testing.assert_allclose(back, arr, atol=1e-5)
+
+
+def test_hsv_jitter_aug_bounds():
+    np.random.seed(5)
+    img = nd.array((np.random.rand(6, 6, 3) * 255).astype(np.float32))
+    aug = mx.image.HSVJitterAug(hue=0.1, saturation=0.2, value=0.2)
+    out = aug(img).asnumpy()
+    assert out.shape == (6, 6, 3)
+    assert out.min() >= 0 and out.max() <= 255.0 + 1e-3
+    # zero-jitter must be the identity
+    aug0 = mx.image.HSVJitterAug(0, 0, 0)
+    out0 = aug0(img).asnumpy()
+    np.testing.assert_allclose(out0, img.asnumpy(), atol=1e-2)
+
+
+# ---- detection tail --------------------------------------------------------
+
+def test_create_multi_rand_crop_augmenter():
+    aug = mx.image.CreateMultiRandCropAugmenter(
+        min_object_covered=[0.1, 0.5],
+        aspect_ratio_range=[(0.75, 1.33), (0.9, 1.1)],
+        area_range=[(0.1, 1.0), (0.3, 1.0)],
+        min_eject_coverage=[0.3, 0.3])
+    assert len(aug.aug_list) == 2
+    np.random.seed(6)
+    img = nd.array(np.random.rand(32, 32, 3).astype(np.float32))
+    label = nd.array(np.array([[1, 0.2, 0.2, 0.8, 0.8]], np.float32))
+    out, lab = aug(img, label)
+    assert out.shape[2] == 3 and lab.shape == (1, 5)
+    with pytest.raises(mx.MXNetError):
+        mx.image.CreateMultiRandCropAugmenter(
+            min_object_covered=[0.1, 0.5, 0.9],
+            aspect_ratio_range=[(0.75, 1.33), (0.9, 1.1)])
+
+
+def test_create_det_augmenter_full_options():
+    np.random.seed(7)
+    augs = mx.image.CreateDetAugmenter(
+        (3, 24, 24), resize=28, rand_crop=0.5, rand_pad=0.5,
+        rand_gray=0.1, rand_mirror=True, mean=True, std=True,
+        brightness=0.1, contrast=0.1, saturation=0.1, hue=0.1,
+        pca_noise=0.05)
+    img = nd.array((np.random.rand(32, 40, 3) * 255).astype(np.float32))
+    label = nd.array(np.array([[0, 0.1, 0.1, 0.6, 0.7],
+                               [2, 0.3, 0.4, 0.9, 0.9]], np.float32))
+    for aug in augs:
+        img, label = aug(img, label)
+    assert img.shape == (24, 24, 3)       # forced to data_shape
+    lab = label.asnumpy()
+    assert lab.shape == (2, 5)
+    valid = lab[lab[:, 0] >= 0]
+    if len(valid):
+        assert valid[:, 1:].min() >= -1e-6
+        assert valid[:, 1:].max() <= 1 + 1e-6
