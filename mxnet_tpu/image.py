@@ -570,6 +570,33 @@ class RandomRotateAug(Augmenter):
                         dtype=src.dtype)
 
 
+def _color_aug_tail(brightness=0, contrast=0, saturation=0, hue=0,
+                    pca_noise=0, rand_gray=0, mean=None, std=None):
+    """The cast + color-jitter + lighting + gray + normalize tail shared
+    by CreateAugmenter and CreateDetAugmenter (constants live HERE
+    once: ImageNet PCA eigen-basis and mean/std)."""
+    tail = [CastAug()]
+    if brightness or contrast or saturation:
+        tail.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        tail.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        tail.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        tail.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None and len(_np.atleast_1d(mean)):
+        tail.append(ColorNormalizeAug(mean, std))
+    return tail
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, hue=0, pca_noise=0,
@@ -590,25 +617,8 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
         auglist.append(CenterCropAug(crop_size, inter_method))
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
-    auglist.append(CastAug())
-    if brightness or contrast or saturation:
-        auglist.append(ColorJitterAug(brightness, contrast, saturation))
-    if hue:
-        auglist.append(HueJitterAug(hue))
-    if pca_noise > 0:
-        eigval = _np.array([55.46, 4.794, 1.148])
-        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
-                            [-0.5808, -0.0045, -0.8140],
-                            [-0.5836, -0.6948, 0.4203]])
-        auglist.append(LightingAug(pca_noise, eigval, eigvec))
-    if rand_gray > 0:
-        auglist.append(RandomGrayAug(rand_gray))
-    if mean is True:
-        mean = _np.array([123.68, 116.28, 103.53])
-    if std is True:
-        std = _np.array([58.395, 57.12, 57.375])
-    if mean is not None and len(_np.atleast_1d(mean)):
-        auglist.append(ColorNormalizeAug(mean, std))
+    auglist.extend(_color_aug_tail(brightness, contrast, saturation, hue,
+                                   pca_noise, rand_gray, mean, std))
     return auglist
 
 

@@ -219,9 +219,7 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
     """Standard detection augmenter chain — full reference option set
     (detection.py:483 CreateDetAugmenter): geometric crop/pad/mirror
     plus the color augmenters borrowed through DetBorrowAug."""
-    from .image import (CastAug, ColorJitterAug, ColorNormalizeAug,
-                        ForceResizeAug, HueJitterAug, LightingAug,
-                        RandomGrayAug, ResizeAug)
+    from .image import ForceResizeAug, ResizeAug, _color_aug_tail
 
     augs = []
     if resize > 0:
@@ -230,7 +228,7 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
         crop = CreateMultiRandCropAugmenter(
             min_object_covered=min_object_covered,
             aspect_ratio_range=aspect_ratio_range,
-            area_range=(min(area_range[0], 1.0), min(area_range[1], 1.0)),
+            area_range=area_range,
             min_eject_coverage=min_eject_coverage,
             max_attempts=max_attempts, skip_prob=1.0 - rand_crop)
         augs.append(crop)
@@ -244,26 +242,9 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
     # force the output shape (the crop/pad change it)
     augs.append(DetBorrowAug(
         ForceResizeAug((data_shape[2], data_shape[1]), inter_method)))
-    augs.append(DetBorrowAug(CastAug()))
-    if brightness or contrast or saturation:
-        augs.append(DetBorrowAug(
-            ColorJitterAug(brightness, contrast, saturation)))
-    if hue:
-        augs.append(DetBorrowAug(HueJitterAug(hue)))
-    if pca_noise > 0:
-        eigval = _np.array([55.46, 4.794, 1.148])
-        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
-                            [-0.5808, -0.0045, -0.8140],
-                            [-0.5836, -0.6948, 0.4203]])
-        augs.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
-    if rand_gray > 0:
-        augs.append(DetBorrowAug(RandomGrayAug(rand_gray)))
-    if mean is True:
-        mean = _np.array([123.68, 116.28, 103.53])
-    if std is True:
-        std = _np.array([58.395, 57.12, 57.375])
-    if mean is not None and len(_np.atleast_1d(mean)):
-        augs.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    augs.extend(DetBorrowAug(a) for a in _color_aug_tail(
+        brightness, contrast, saturation, hue, pca_noise, rand_gray,
+        mean, std))
     return augs
 
 

@@ -27,6 +27,13 @@ from ..base import MXNetError, thread_state
 __all__ = ["Operator", "register", "get_op", "list_ops", "invoke", "apply_op"]
 
 _OP_REGISTRY = {}
+# bumped on every register()/alias(); cheap staleness token for caches
+# built over the registry (amp classification)
+_REG_VERSION = [0]
+
+
+def registration_version():
+    return _REG_VERSION[0]
 
 
 class Operator:
@@ -76,6 +83,7 @@ def register(name=None, num_outputs=1, differentiable=True, mutates=None):
             raise MXNetError("op '%s' registered twice" % opname)
         op = Operator(opname, fn, num_outputs, differentiable, mutates=mutates)
         _OP_REGISTRY[opname] = op
+        _REG_VERSION[0] += 1
         return op
 
     return deco
@@ -89,6 +97,7 @@ def alias(new_name, existing):
     if new_name in _OP_REGISTRY:
         raise MXNetError("op '%s' registered twice" % new_name)
     _OP_REGISTRY[new_name] = op
+    _REG_VERSION[0] += 1
     return op
 
 
@@ -328,7 +337,18 @@ def _amp_rewrite(op_name, fn):
         return fn
     import jax.numpy as jnp
 
-    if op_name in _amp.TARGET_DTYPE_OPS:
+    from ..contrib.amp import lists as _lists
+
+    table = _lists.classification()
+    cat = table.get(op_name)
+    if cat is None and op_name.startswith("np."):
+        cat = table.get(op_name[3:])   # np adapter inherits the base op
+    if cat is None:
+        if "." in op_name or op_name == "lambda":
+            return fn                  # anonymous apply_op fns
+        cat = _lists.category_of(op_name)  # warn-once path
+
+    if cat == "target_dtype":
         to = jnp.dtype(_amp.target_dtype())
 
         def low_fn(*args):
@@ -338,7 +358,7 @@ def _amp_rewrite(op_name, fn):
 
         low_fn.__name__ = getattr(fn, "__name__", op_name)
         return low_fn
-    if op_name in _amp.FP32_OPS:
+    if cat == "fp32":
         low = (jnp.bfloat16, jnp.float16)
 
         def high_fn(*args):
@@ -348,6 +368,21 @@ def _amp_rewrite(op_name, fn):
 
         high_fn.__name__ = getattr(fn, "__name__", op_name)
         return high_fn
+    if cat == "widest":
+        def widest_fn(*args):
+            fdts = [a.dtype for a in args
+                    if hasattr(a, "dtype")
+                    and jnp.issubdtype(a.dtype, jnp.floating)]
+            if len(set(map(str, fdts))) > 1:
+                to = jnp.result_type(*fdts)
+                args = [a.astype(to)
+                        if hasattr(a, "dtype")
+                        and jnp.issubdtype(a.dtype, jnp.floating) else a
+                        for a in args]
+            return fn(*args)
+
+        widest_fn.__name__ = getattr(fn, "__name__", op_name)
+        return widest_fn
     return fn
 
 
