@@ -1,0 +1,9 @@
+"""``mxnet.executor`` compat module (reference python/mxnet/executor.py).
+
+1.x migration scripts do ``from mxnet import executor`` /
+``mx.executor.Executor``; the implementation lives with the Symbol
+(symbol/__init__.py) since an executor is a bound symbol closure here.
+"""
+from .symbol import Executor  # noqa: F401
+
+__all__ = ["Executor"]

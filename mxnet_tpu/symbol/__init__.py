@@ -168,15 +168,30 @@ class Symbol:
         return [NDArray(out)]
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
-             **kwargs):
-        return Executor(self, ctx, args or kwargs)
+             aux_states=None, **kwargs):
+        """1.x executor protocol (reference executor.py:124 + symbol.py
+        bind): ``args`` is a dict or a list ordered like
+        ``list_inputs()``; ``args_grad`` receives gradients under
+        ``grad_req`` (write/add/null, str or per-arg dict)."""
+        args = args if args is not None else kwargs
+        return Executor(self, ctx, args, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=aux_states)
 
     def _simple_bind(self, ctx=None, grad_req="write", **shapes):
         import jax.numpy as jnp
 
         args = {name: NDArray(jnp.zeros(shape, jnp.float32))
                 for name, shape in shapes.items()}
-        return Executor(self, ctx, args)
+
+        def req(name):
+            return grad_req.get(name, "null") \
+                if isinstance(grad_req, dict) else grad_req
+
+        grads = {name: NDArray(jnp.zeros(shape, jnp.float32))
+                 for name, shape in shapes.items()
+                 if req(name) != "null"} or None
+        return Executor(self, ctx, args, args_grad=grads,
+                        grad_req=grad_req)
 
     simple_bind = _simple_bind
 
@@ -242,22 +257,146 @@ class Symbol:
 
 
 class Executor:
-    """Compat executor (reference python/mxnet/executor.py:124 — a thin
-    CachedOp wrapper in MXNet 2.0; here a jit-compiled closure)."""
+    """1.x compat executor (reference python/mxnet/executor.py:124 — a
+    thin CachedOp wrapper in 2.0; symbol.py bind/simple_bind protocol).
 
-    def __init__(self, sym_, ctx, args):
+    Carries the classic surface: ``arg_dict``/``grad_dict``/
+    ``arg_arrays``/``grad_arrays``/``outputs``, ``forward(is_train)``,
+    ``backward(out_grads)`` (jax.vjp of the symbol's pure eval, grads
+    written into ``args_grad`` under write/add), and
+    ``copy_params_from``.  Aux states: the deferred-closure Symbol holds
+    no mutable running statistics (BN-style state lives in Gluon
+    Parameters here), so ``aux_*`` surfaces exist and stay empty."""
+
+    def __init__(self, sym_, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None):
+        names = sym_.list_inputs()
+        if isinstance(args, (list, tuple)):
+            if len(args) != len(names):
+                raise MXNetError(
+                    "bind: %d arg arrays for %d symbol inputs %s"
+                    % (len(args), len(names), names))
+            args = dict(zip(names, args))
         self._sym = sym_
-        self._args = dict(args)
+        self._args = dict(args or {})
+        if isinstance(args_grad, (list, tuple)):
+            if len(args_grad) != len(names):
+                raise MXNetError(
+                    "bind: %d grad arrays for %d symbol inputs %s"
+                    % (len(args_grad), len(names), names))
+            args_grad = dict(zip(names, args_grad))
+        self._args_grad = dict(args_grad or {})
+        for name, g in self._args_grad.items():
+            ref = self._args.get(name)
+            if ref is not None and g is not None and \
+                    tuple(g.shape) != tuple(ref.shape):
+                raise MXNetError(
+                    "bind: args_grad[%s] shape %s != arg shape %s"
+                    % (name, tuple(g.shape), tuple(ref.shape)))
+        self._grad_req = grad_req
+        self.aux_arrays = list(aux_states or [])
         self.outputs = []
+        self._vjp = None
+        self._grad_names = []
 
+    # ---- classic accessors -------------------------------------------------
+    @property
+    def arg_dict(self):
+        return self._args
+
+    @property
+    def grad_dict(self):
+        return self._args_grad
+
+    @property
+    def aux_dict(self):
+        return {}
+
+    @property
+    def arg_arrays(self):
+        return [self._args[n] for n in self._sym.list_inputs()
+                if n in self._args]
+
+    @property
+    def grad_arrays(self):
+        return [self._args_grad.get(n)
+                for n in self._sym.list_inputs()]
+
+    def _req_for(self, name):
+        if isinstance(self._grad_req, dict):
+            return self._grad_req.get(name, "null")
+        return self._grad_req
+
+    def copy_params_from(self, arg_params, aux_params=None):
+        """Reference executor.py copy_params_from: load a param dict into
+        the bound arg arrays (shape-checked)."""
+        for name, src in (arg_params or {}).items():
+            if name not in self._args:
+                continue
+            dst = self._args[name]
+            if tuple(dst.shape) != tuple(src.shape):
+                raise MXNetError(
+                    "copy_params_from: %s shape %s != bound %s"
+                    % (name, tuple(src.shape), tuple(dst.shape)))
+            src.copyto(dst)
+
+    # ---- execution ---------------------------------------------------------
     def forward(self, is_train=False, **kwargs):
-        self._args.update(kwargs)
-        self.outputs = self._sym.eval(**self._args)
+        import jax
+        import jax.numpy as jnp
+
+        for name, arr in kwargs.items():
+            self._args[name] = arr if isinstance(arr, NDArray) \
+                else NDArray(jnp.asarray(arr))
+        names = self._sym.list_inputs()
+        missing = [n for n in names if n not in self._args]
+        if missing:
+            raise MXNetError("forward: unbound inputs %s" % missing)
+        grad_names = [n for n in names if self._req_for(n) != "null"
+                      and n in self._args_grad] if is_train else []
+        datas = {n: self._args[n]._data for n in names}
+
+        def fn(grad_vals):
+            env = dict(datas)
+            env.update(zip(grad_names, grad_vals))
+            out = self._sym._fn(env)
+            return out if isinstance(out, tuple) else (out,)
+
+        if is_train and grad_names:
+            outs, self._vjp = jax.vjp(
+                fn, [datas[n] for n in grad_names])
+            self._grad_names = grad_names
+        else:
+            outs = fn([])
+            self._vjp = None
+        self.outputs = [NDArray(o) for o in outs]
         return self.outputs
 
     def backward(self, out_grads=None):
-        raise MXNetError("Executor.backward: use autograd.record around "
-                         "eval, or Gluon")
+        import jax.numpy as jnp
+
+        if self._vjp is None:
+            raise MXNetError(
+                "backward: call forward(is_train=True) first (and bind "
+                "with args_grad / a non-null grad_req)")
+        if out_grads is None:
+            cts = tuple(jnp.ones_like(o._data) for o in self.outputs)
+        else:
+            if not isinstance(out_grads, (list, tuple)):
+                out_grads = [out_grads]
+            cts = tuple(g._data if isinstance(g, NDArray)
+                        else jnp.asarray(g) for g in out_grads)
+        (grads,) = self._vjp(cts)
+        for name, g in zip(self._grad_names, grads):
+            dst = self._args_grad[name]
+            if tuple(dst.shape) != tuple(g.shape):
+                raise MXNetError(
+                    "backward: grad for %s has shape %s, buffer is %s"
+                    % (name, tuple(g.shape), tuple(dst.shape)))
+            if self._req_for(name) == "add":
+                dst._data = dst._data + g
+            else:
+                dst._data = g.astype(dst._data.dtype)
 
 
 def var(name, **kwargs):
