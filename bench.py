@@ -346,7 +346,7 @@ def main():
         except Exception as exc:  # noqa: BLE001 - headline must stay parseable
             last_exc = exc
             _log("headline attempt %d FAILED: %r" % (attempt + 1, exc))
-            if _over_budget("headline retry"):
+            if attempt == 2 or _over_budget("headline retry"):
                 break
             time.sleep(30 * (attempt + 1))
             if _probe_backend(timeout_s=120) is not None:

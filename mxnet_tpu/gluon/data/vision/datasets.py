@@ -18,7 +18,7 @@ from .... import ndarray as nd
 from ..dataset import ArrayDataset, Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
-           "ImageRecordDataset", "ImageFolderDataset"]
+           "ImageRecordDataset", "ImageFolderDataset", "ImageListDataset"]
 
 
 class _DownloadedDataset(Dataset):
@@ -213,6 +213,57 @@ class ImageFolderDataset(Dataset):
         if self._transform is not None:
             return self._transform(img_nd, label)
         return img_nd, label
+
+    def __len__(self):
+        return len(self.items)
+
+
+class ImageListDataset(Dataset):
+    """Images named by an imglist (reference datasets.py:365): either a
+    .lst-style text file (``index\\tlabel...\\trelpath`` per line) or a
+    python list whose items are ``[label(s)..., relpath]``.  Labels load
+    as float arrays; multi-value labels keep their full vector."""
+
+    def __init__(self, root=".", imglist=None, flag=1):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self.items = []   # (path, label ndarray)
+        if isinstance(imglist, str):
+            fname = os.path.join(self._root, imglist)
+            with open(fname, "rt") as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 3:
+                        continue
+                    label = _np.asarray(parts[1:-1], _np.float32)
+                    self.items.append(
+                        (os.path.join(self._root, parts[-1]), label))
+        elif isinstance(imglist, (list, tuple)):
+            for img in imglist:
+                if not isinstance(img[-1], str):
+                    raise ValueError(
+                        "imglist entries end with the image path: %r"
+                        % (img,))
+                raw = img[:-1]
+                if len(raw) == 1 and not _np.isscalar(raw[0]):
+                    label = _np.asarray(raw[0], _np.float32)
+                else:
+                    label = _np.asarray(raw, _np.float32)
+                self.items.append(
+                    (os.path.join(self._root, img[-1]), label))
+        else:
+            raise ValueError("imglist must be a path or a list")
+
+    def __getitem__(self, idx):
+        path, label = self.items[idx]
+        if path.endswith(".npy"):
+            img = _np.load(path)
+            # preserve pre-processed dtypes (float .npy stays float)
+            return nd.array(img, dtype=str(img.dtype)), nd.array(label)
+        from ....image import imread
+
+        img = imread(path, flag=self._flag).asnumpy()
+        return nd.array(img, dtype="uint8"), nd.array(label)
 
     def __len__(self):
         return len(self.items)

@@ -101,6 +101,95 @@ def build_1f1b_schedule(S, M, schedule="1f1b"):
     return order
 
 
+def _interleaved_device_order(S, V, M, r):
+    """Device r's op order for the Megatron-style interleaved schedule
+    (Narayanan et al. 2021 §2.2): each device owns V model chunks
+    (chunk v of device r is global chunk v*S + r); forwards cycle
+    chunks every S microbatches, backwards cycle in reverse, warmup =
+    (S - r - 1)*2 + (V - 1)*S.  Requires M % S == 0."""
+    total = M * V
+
+    def f_cm(k):
+        return (k // S) % V, (k // (S * V)) * S + k % S
+
+    def b_cm(k):
+        return V - 1 - (k // S) % V, (k // (S * V)) * S + k % S
+
+    warm = min(total, (S - r - 1) * 2 + (V - 1) * S)
+    ops = [("F",) + f_cm(k) for k in range(warm)]
+    b = 0
+    for f in range(warm, total):
+        ops.append(("F",) + f_cm(f))
+        ops.append(("B",) + b_cm(b))
+        b += 1
+    while b < total:
+        ops.append(("B",) + b_cm(b))
+        b += 1
+    return ops
+
+
+def build_interleaved_schedule(S, V, M):
+    """Global issue order over C = S*V chunks: merge the per-device
+    interleaved orders respecting cross-chunk data deps.  Entries are
+    (global_chunk, kind, microbatch); global chunk of (device r,
+    local chunk v) is v*S + r."""
+    if M % S:
+        raise MXNetError("interleaved schedule needs num_microbatches "
+                         "%% pp == 0 (got M=%d, S=%d)" % (M, S))
+    C = S * V
+    queues = [_interleaved_device_order(S, V, M, r) for r in range(S)]
+    heads = [0] * S
+    done = set()
+    order = []
+    total = sum(len(q) for q in queues)
+    while len(order) < total:
+        progressed = False
+        for r in range(S):
+            while heads[r] < len(queues[r]):
+                kind, v, m = queues[r][heads[r]]
+                c = v * S + r
+                if kind == "F":
+                    ok = c == 0 or ("F", c - 1, m) in done
+                else:
+                    ok = ("F", c, m) in done and \
+                        (c == C - 1 or ("B", c + 1, m) in done)
+                if not ok:
+                    break
+                order.append((c, kind, m))
+                done.add((kind, c, m))
+                heads[r] += 1
+                progressed = True
+        if not progressed:
+            raise MXNetError("interleaved schedule deadlock "
+                             "(S=%d V=%d M=%d)" % (S, V, M))
+    return order
+
+
+def interleaved_stats(S, V, M, f_ticks=1.0, b_ticks=2.0):
+    """Tick-simulate the interleaved schedule: S device executors, chunk
+    costs scale 1/V.  Returns {"makespan", "bubble_fraction"} in
+    stage-time units — bubble shrinks ~1/V vs plain 1F1B."""
+    C = S * V
+    fc, bc = f_ticks / V, b_ticks / V
+    finish = {}
+    free = [0.0] * S
+    for c, kind, m in build_interleaved_schedule(S, V, M):
+        r = c % S
+        cost = fc if kind == "F" else bc
+        if kind == "F":
+            dep = finish.get(("F", c - 1, m), 0.0) if c else 0.0
+        else:
+            dep = max(finish.get(("F", c, m), 0.0),
+                      finish.get(("B", c + 1, m), 0.0))
+        start = max(free[r], dep)
+        finish[(kind, c, m)] = start + cost
+        free[r] = start + cost
+    makespan = max(finish.values())
+    busy = M * (f_ticks + b_ticks)
+    return {"makespan": makespan,
+            "bubble_fraction": 1.0 - busy / makespan}
+
+
 def schedule_stats(S, M, schedule="1f1b", f_ticks=1, b_ticks=2):
     """Tick-simulate the schedule (each stage = one executor; F/B cost
     f_ticks/b_ticks; ops start when deps + executor free).  Returns
@@ -153,9 +242,18 @@ class OneFOneBTrainer(_pipeline_trainer_cls()):
 
     def __init__(self, block, loss=None, optimizer="sgd",
                  optimizer_params=None, mesh=None, loss_fn=None,
-                 num_microbatches=4, dtype=None, *, schedule="1f1b"):
+                 num_microbatches=4, dtype=None, *, schedule="1f1b",
+                 num_virtual_stages=1):
         self._init_common(block, loss, optimizer, optimizer_params, mesh,
                           loss_fn, num_microbatches, dtype, "1f1b")
+        self._V = int(num_virtual_stages)
+        if self._V < 1:
+            raise MXNetError("num_virtual_stages must be >= 1")
+        self._C = self._S * self._V          # model chunks
+        if self._V > 1 and self._M % self._S:
+            raise MXNetError(
+                "interleaved schedule needs num_microbatches %% pp == 0 "
+                "(got M=%d, pp=%d)" % (self._M, self._S))
         self._built = False
         self._pending_state = None
         self.last_peak_inflight = None   # introspection for tests
@@ -174,9 +272,6 @@ class OneFOneBTrainer(_pipeline_trainer_cls()):
 
         block = self._block
         children = list(block)
-        if len(children) < self._S:
-            raise MXNetError("model has %d layers < %d pipeline stages"
-                             % (len(children), self._S))
         if any(p._data is None for p in block.collect_params().values()):
             with autograd.pause():
                 block(NDArray(x))
@@ -191,8 +286,13 @@ class OneFOneBTrainer(_pipeline_trainer_cls()):
             raise MXNetError("microbatch %d not divisible by dp=%d"
                              % (mb, dp))
 
+        C = self._C
+        if len(children) < C:
+            raise MXNetError(
+                "model has %d layers < %d chunks (pp=%d x "
+                "num_virtual_stages=%d)" % (len(children), C, S, self._V))
         self._meshes = self._stage_meshes()
-        stage_children = _partition_stages(children, S)
+        stage_children = _partition_stages(children, C)
         self._applies, self._named, self._params = [], [], []
         self._fwd, self._bwd, self._opt_apply = [], [], []
         self._opt_states = []
@@ -217,7 +317,7 @@ class OneFOneBTrainer(_pipeline_trainer_cls()):
                     (si, list(states)))
             if len(outs) != 1:
                 raise MXNetError("pipeline stages must be single-output")
-            smesh = self._meshes[si]
+            smesh = self._meshes[si % S]     # chunk c lives on device c%S
             repl = NamedSharding(smesh, P())
             shard0 = NamedSharding(smesh, P("dp"))
             self._in_avals.append(abstract)
@@ -229,7 +329,7 @@ class OneFOneBTrainer(_pipeline_trainer_cls()):
                 lambda v: jax.device_put(v, repl),
                 self._opt_init(params)))
 
-            last = si == S - 1
+            last = si == C - 1
 
             def stage_out(p, xin, rng, m, _f=apply_fn, _s=si):
                 key = jax.random.fold_in(jax.random.fold_in(rng, _s), m)
@@ -284,18 +384,20 @@ class OneFOneBTrainer(_pipeline_trainer_cls()):
             abstract = jax.ShapeDtypeStruct(outs[0].shape, outs[0].dtype)
 
         self._mb = mb
-        self._order = build_1f1b_schedule(S, M)
+        self._order = (build_1f1b_schedule(C, M) if self._V == 1
+                       else build_interleaved_schedule(S, self._V, M))
         # per-boundary transfer shardings, fixed once shapes are known
         def _bshard(mesh_s, aval):
             return NamedSharding(mesh_s,
                                  P("dp", *([None] * (aval.ndim - 1))))
 
-        self._xfer_in = [_bshard(self._meshes[s], self._in_avals[s])
-                         for s in range(S)]
-        # ct of stage s-1's OUTPUT: stage s's input spec on s-1's submesh
+        self._xfer_in = [_bshard(self._meshes[c % S], self._in_avals[c])
+                         for c in range(C)]
+        # ct of chunk c-1's OUTPUT: chunk c's input spec on c-1's submesh
         self._xfer_ct = [None] + [
-            NamedSharding(self._meshes[s - 1], self._xfer_in[s].spec)
-            for s in range(1, S)]
+            NamedSharding(self._meshes[(c - 1) % S],
+                          self._xfer_in[c].spec)
+            for c in range(1, C)]
         self._shard_x0 = self._xfer_in[0]
         self._shard_y = NamedSharding(self._meshes[-1],
                                       P("dp", *([None] * (y.ndim - 1))))
@@ -312,7 +414,7 @@ class OneFOneBTrainer(_pipeline_trainer_cls()):
         y = y._data if isinstance(y, NDArray) else jnp.asarray(y)
         if not self._built:
             self._setup(x, y)
-        S, M, mb = self._S, self._M, self._mb
+        S, M, mb, C = self._S, self._M, self._mb, self._C
         if x.shape[0] != M * mb:
             raise MXNetError(
                 "batch %d does not match the compiled pipeline step "
@@ -324,56 +426,56 @@ class OneFOneBTrainer(_pipeline_trainer_cls()):
         ym = [jax.device_put(y[m * mb:(m + 1) * mb], self._shard_y)
               for m in range(M)]
 
-        acts = [{} for _ in range(S)]     # (stage) -> {m: saved input}
-        cts = [{} for _ in range(S)]      # cotangents arriving at stage
-        gacc = [None] * S
+        acts = [{} for _ in range(C)]     # (chunk) -> {m: saved input}
+        cts = [{} for _ in range(C)]      # cotangents arriving at chunk
+        gacc = [None] * C
         losses = []
-        # executed-forwards minus executed-backwards per stage: the
-        # activation-memory bound 1F1B exists to cap (<= S - s)
-        outstanding = [0] * S
-        peak = [0] * S
+        # executed-forwards minus executed-backwards per chunk: the
+        # activation-memory bound 1F1B exists to cap
+        outstanding = [0] * C
+        peak = [0] * C
 
-        def add_grads(s, pg):
-            gacc[s] = pg if gacc[s] is None else jax.tree_util.tree_map(
-                jnp.add, gacc[s], pg)
+        def add_grads(c, pg):
+            gacc[c] = pg if gacc[c] is None else jax.tree_util.tree_map(
+                jnp.add, gacc[c], pg)
 
-        for s, kind, m in self._order:
-            if kind == "F" and s < S - 1:
-                xin = xm[m] if s == 0 else acts[s][m]
-                if s == 0:
-                    acts[s][m] = xin
-                out = self._fwd[s](self._params[s], xin, rng,
+        for c, kind, m in self._order:
+            if kind == "F" and c < C - 1:
+                xin = xm[m] if c == 0 else acts[c][m]
+                if c == 0:
+                    acts[c][m] = xin
+                out = self._fwd[c](self._params[c], xin, rng,
                                    jnp.uint32(m))
-                acts[s + 1][m] = jax.device_put(out, self._xfer_in[s + 1])
-                outstanding[s] += 1
-                peak[s] = max(peak[s], outstanding[s])
-            elif kind == "F":            # last stage: fused into B
-                outstanding[s] += 1
-                peak[s] = max(peak[s], outstanding[s])
+                acts[c + 1][m] = jax.device_put(out, self._xfer_in[c + 1])
+                outstanding[c] += 1
+                peak[c] = max(peak[c], outstanding[c])
+            elif kind == "F":            # last chunk: fused into B
+                outstanding[c] += 1
+                peak[c] = max(peak[c], outstanding[c])
             else:
-                if s == S - 1:
-                    loss, pg, xg = self._bwd[s](
-                        self._params[s], acts[s].pop(m), ym[m], rng,
+                if c == C - 1:
+                    loss, pg, xg = self._bwd[c](
+                        self._params[c], acts[c].pop(m), ym[m], rng,
                         jnp.uint32(m))
                     losses.append(loss)
                 else:
-                    pg, xg = self._bwd[s](
-                        self._params[s], acts[s].pop(m), rng,
-                        jnp.uint32(m), cts[s].pop(m))
-                add_grads(s, pg)
-                outstanding[s] -= 1
-                if s > 0:
-                    cts[s - 1][m] = jax.device_put(xg, self._xfer_ct[s])
+                    pg, xg = self._bwd[c](
+                        self._params[c], acts[c].pop(m), rng,
+                        jnp.uint32(m), cts[c].pop(m))
+                add_grads(c, pg)
+                outstanding[c] -= 1
+                if c > 0:
+                    cts[c - 1][m] = jax.device_put(xg, self._xfer_ct[c])
 
         self.last_peak_inflight = peak
         lr_t = (self._lr_scheduler(self._step_count + 1)
                 if self._lr_scheduler is not None else self._lr)
         scale = 1.0 / M
-        for s in range(S):
-            g = jax.tree_util.tree_map(lambda v: v * scale, gacc[s])
-            self._params[s], self._opt_states[s] = self._opt_apply[s](
-                jnp.uint32(self._step_count), self._params[s], g,
-                self._opt_states[s], jnp.float32(lr_t))
+        for c in range(C):
+            g = jax.tree_util.tree_map(lambda v: v * scale, gacc[c])
+            self._params[c], self._opt_states[c] = self._opt_apply[c](
+                jnp.uint32(self._step_count), self._params[c], g,
+                self._opt_states[c], jnp.float32(lr_t))
         self._step_count += 1
         total = losses[0]
         for l in losses[1:]:
@@ -400,8 +502,8 @@ class OneFOneBTrainer(_pipeline_trainer_cls()):
         self._apply_state(state)
 
     def _apply_state(self, state):
-        for s in range(self._S):
-            repl = NamedSharding(self._meshes[s], P())
+        for s in range(self._C):
+            repl = NamedSharding(self._meshes[s % self._S], P())
             self._params[s] = {
                 n: jax.device_put(v, repl)
                 for n, v in state["params"][s].items()}
@@ -411,7 +513,7 @@ class OneFOneBTrainer(_pipeline_trainer_cls()):
         self._step_count = int(state["step"])
 
     def sync_block(self):
-        for s in range(self._S):
+        for s in range(self._C):
             named = self._named[s]
             for n, v in self._params[s].items():
                 named[n]._data._data = jnp.asarray(_np.asarray(v))

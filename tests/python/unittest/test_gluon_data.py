@@ -455,3 +455,41 @@ def test_multiworker_unpicklable_falls_back_to_threads():
         out = np.concatenate([b.asnumpy() for b in loader])
     assert sorted(out.tolist()) == [2.0 * i for i in range(12)]
     assert any("not picklable" in str(x.message) for x in w)
+
+
+def test_image_list_dataset(tmp_path):
+    """ImageListDataset (reference datasets.py:365): .lst file and
+    python-list forms, scalar and vector labels."""
+    import numpy as np
+
+    from mxnet_tpu.gluon.data import vision
+
+    root = str(tmp_path)
+    imgs = []
+    for i in range(4):
+        arr = (np.random.RandomState(i).rand(6, 6, 3) * 255).astype(
+            np.uint8)
+        name = "img%d.npy" % i
+        np.save(os.path.join(root, name), arr)
+        imgs.append(name)
+
+    # .lst file form: index\tlabel\tpath (+ a 2-value label row)
+    with open(os.path.join(root, "data.lst"), "w") as f:
+        f.write("0\t1\t%s\n" % imgs[0])
+        f.write("1\t0\t%s\n" % imgs[1])
+        f.write("2\t0.5\t2.5\t%s\n" % imgs[2])
+    ds = vision.ImageListDataset(root=root, imglist="data.lst")
+    assert len(ds) == 3
+    img, label = ds[0]
+    assert img.shape == (6, 6, 3) and str(img.dtype) == "uint8"
+    assert float(label.asnumpy()[0]) == 1.0
+    assert list(ds[2][1].asnumpy()) == [0.5, 2.5]
+
+    # python-list form
+    ds2 = vision.ImageListDataset(
+        root=root, imglist=[[0, imgs[0]], [1, imgs[1]],
+                            [[2.0, 3.0], imgs[2]]])
+    assert len(ds2) == 3
+    assert list(ds2[2][1].asnumpy()) == [2.0, 3.0]
+    with pytest.raises(ValueError):
+        vision.ImageListDataset(root=root, imglist=[[0, 1]])
