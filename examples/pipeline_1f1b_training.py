@@ -1,0 +1,86 @@
+"""Pipeline-parallel training: GPipe vs 1F1B vs interleaved 1F1B.
+
+Three schedules over the same model and data, all matching the
+single-program FusedTrainer loss trajectory:
+
+- GPipe (`parallel/pipeline.py`): the whole fill/drain schedule is ONE
+  XLA program (scan ticks + ppermute boundaries).
+- 1F1B (`schedule="1f1b"`): MPMD — each stage is its own jitted
+  program on its own submesh; in-flight activations per stage are
+  bounded by min(M, S - s) instead of M.
+- Interleaved (`num_virtual_stages=V`): V model chunks per device,
+  Megatron-style order, pipeline bubble shrinks ~1/V.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/pipeline_1f1b_training.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    from _virtual_devices import force_virtual_cpu
+
+    force_virtual_cpu(8)
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel.pipeline_1f1b import (interleaved_stats,
+                                              schedule_stats)
+
+
+def build(seed):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    for _ in range(7):
+        net.add(nn.Dense(32, activation="relu"))
+    net.add(nn.Dense(8))
+    net.initialize()
+    return net
+
+
+def main():
+    rs = np.random.RandomState(0)
+    X = rs.rand(32, 16).astype(np.float32)
+    Y = rs.randint(0, 8, 32).astype(np.int32)
+    mesh = parallel.make_mesh({"pp": 4})
+    opt = {"learning_rate": 0.1, "momentum": 0.9}
+
+    trainers = {
+        "fused (reference)": parallel.FusedTrainer(
+            build(1), loss="softmax_ce", optimizer="sgd",
+            optimizer_params=dict(opt)),
+        "gpipe": parallel.PipelineTrainer(
+            build(1), loss="softmax_ce", optimizer="sgd",
+            optimizer_params=dict(opt), mesh=mesh, num_microbatches=8),
+        "1f1b": parallel.PipelineTrainer(
+            build(1), loss="softmax_ce", optimizer="sgd",
+            optimizer_params=dict(opt), mesh=mesh, num_microbatches=8,
+            schedule="1f1b"),
+        "interleaved V=2": parallel.PipelineTrainer(
+            build(1), loss="softmax_ce", optimizer="sgd",
+            optimizer_params=dict(opt), mesh=mesh, num_microbatches=8,
+            schedule="1f1b", num_virtual_stages=2),
+    }
+    for step in range(4):
+        row = "  ".join("%s %.5f" % (name, float(tr.step(X, Y).asscalar()))
+                        for name, tr in trainers.items())
+        print("step %d: %s" % (step, row))
+
+    s1 = schedule_stats(4, 8, "1f1b")
+    s2 = interleaved_stats(4, 2, 8)
+    print("bubble fraction: gpipe/1f1b %.3f -> interleaved V=2 %.3f"
+          % (s1["bubble_fraction"], s2["bubble_fraction"]))
+    print("1F1B peak in-flight per stage:",
+          trainers["1f1b"].last_peak_inflight, "(bound: S-s)")
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -231,10 +231,15 @@ class ImageListDataset(Dataset):
         if isinstance(imglist, str):
             fname = os.path.join(self._root, imglist)
             with open(fname, "rt") as fin:
-                for line in fin:
+                for lineno, line in enumerate(fin, 1):
+                    if not line.strip():
+                        continue
                     parts = line.strip().split("\t")
                     if len(parts) < 3:
-                        continue
+                        raise ValueError(
+                            "%s:%d: expected 'index\\tlabel...\\tpath' "
+                            "(tab-separated, >=3 fields), got %r"
+                            % (fname, lineno, line.strip()))
                     label = _np.asarray(parts[1:-1], _np.float32)
                     self.items.append(
                         (os.path.join(self._root, parts[-1]), label))

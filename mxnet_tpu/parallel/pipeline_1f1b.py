@@ -65,6 +65,40 @@ def _per_stage_order(S, M, s, schedule="1f1b"):
     return ops
 
 
+def _merge_queues(queues, to_chunk, n_chunks, what):
+    """Greedy merge of per-executor op queues into one dependency-valid
+    global order.  ``queues[r]`` holds (kind, *op) entries;
+    ``to_chunk(r, op)`` maps an entry to its GLOBAL chunk index along
+    the model; deps are F(c,m) <- F(c-1,m) and B(c,m) <- F(c,m) &
+    B(c+1,m).  Raises on deadlock (an invalid per-executor order)."""
+    n_exec = len(queues)
+    heads = [0] * n_exec
+    done = set()
+    order = []
+    total = sum(len(q) for q in queues)
+    while len(order) < total:
+        progressed = False
+        for r in range(n_exec):
+            while heads[r] < len(queues[r]):
+                entry = queues[r][heads[r]]
+                kind, m = entry[0], entry[-1]
+                c = to_chunk(r, entry)
+                if kind == "F":
+                    ok = c == 0 or ("F", c - 1, m) in done
+                else:
+                    ok = ("F", c, m) in done and \
+                        (c == n_chunks - 1 or ("B", c + 1, m) in done)
+                if not ok:
+                    break
+                order.append((c, kind, m))
+                done.add((kind, c, m))
+                heads[r] += 1
+                progressed = True
+        if not progressed:
+            raise MXNetError("pipeline schedule deadlock (%s)" % what)
+    return order
+
+
 def build_1f1b_schedule(S, M, schedule="1f1b"):
     """Global issue order: list of (stage, kind, microbatch) respecting
     cross-stage data dependencies while each stage follows its 1F1B (or
@@ -72,33 +106,8 @@ def build_1f1b_schedule(S, M, schedule="1f1b"):
     needs B(s+1,m); B/F of the last stage are fused in execution but
     scheduled as F then B back-to-back."""
     queues = [list(_per_stage_order(S, M, s, schedule)) for s in range(S)]
-    heads = [0] * S
-    done = set()
-    order = []
-
-    def ready(s, op):
-        kind, m = op
-        if kind == "F":
-            return s == 0 or ("F", s - 1, m) in done
-        return (s == S - 1 and ("F", s, m) in done) or \
-            (s < S - 1 and ("B", s + 1, m) in done and
-             ("F", s, m) in done)
-
-    total = sum(len(q) for q in queues)
-    while len(order) < total:
-        progressed = False
-        for s in range(S):
-            while heads[s] < len(queues[s]) and \
-                    ready(s, queues[s][heads[s]]):
-                kind, m = queues[s][heads[s]]
-                order.append((s, kind, m))
-                done.add((kind, s, m))
-                heads[s] += 1
-                progressed = True
-        if not progressed:
-            raise MXNetError("pipeline schedule deadlock (S=%d M=%d)"
-                             % (S, M))
-    return order
+    return _merge_queues(queues, lambda r, entry: r, S,
+                         "S=%d M=%d %s" % (S, M, schedule))
 
 
 def _interleaved_device_order(S, V, M, r):
@@ -138,56 +147,49 @@ def build_interleaved_schedule(S, V, M):
                          "%% pp == 0 (got M=%d, S=%d)" % (M, S))
     C = S * V
     queues = [_interleaved_device_order(S, V, M, r) for r in range(S)]
-    heads = [0] * S
-    done = set()
-    order = []
-    total = sum(len(q) for q in queues)
-    while len(order) < total:
-        progressed = False
-        for r in range(S):
-            while heads[r] < len(queues[r]):
-                kind, v, m = queues[r][heads[r]]
-                c = v * S + r
-                if kind == "F":
-                    ok = c == 0 or ("F", c - 1, m) in done
-                else:
-                    ok = ("F", c, m) in done and \
-                        (c == C - 1 or ("B", c + 1, m) in done)
-                if not ok:
-                    break
-                order.append((c, kind, m))
-                done.add((kind, c, m))
-                heads[r] += 1
-                progressed = True
-        if not progressed:
-            raise MXNetError("interleaved schedule deadlock "
-                             "(S=%d V=%d M=%d)" % (S, V, M))
-    return order
+    return _merge_queues(queues,
+                         lambda r, entry: entry[1] * S + r, C,
+                         "interleaved S=%d V=%d M=%d" % (S, V, M))
+
+
+def _simulate_ticks(order, n_exec, dev_of, f_cost, b_cost, busy):
+    """ASAP tick simulation of a dependency-valid (chunk, kind, m) order
+    over ``n_exec`` executors.  Returns makespan/bubble plus the peak
+    forwards-without-backward per chunk (the activation-memory bound)."""
+    finish = {}
+    free_at = {}
+    inflight = {}
+    peak = {}
+    for c, kind, m in order:
+        r = dev_of(c)
+        cost = f_cost if kind == "F" else b_cost
+        if kind == "F":
+            dep = finish.get(("F", c - 1, m), 0.0) if c else 0.0
+            inflight[c] = inflight.get(c, 0) + 1
+            peak[c] = max(peak.get(c, 0), inflight[c])
+        else:
+            dep = max(finish.get(("F", c, m), 0.0),
+                      finish.get(("B", c + 1, m), 0.0))
+            inflight[c] = inflight.get(c, 0) - 1
+        start = max(free_at.get(r, 0.0), dep)
+        finish[(kind, c, m)] = start + cost
+        free_at[r] = start + cost
+    makespan = max(finish.values())
+    n_chunks = max(peak) + 1 if peak else 0
+    return {
+        "makespan": makespan,
+        "bubble_fraction": 1.0 - busy / makespan,
+        "peak_inflight": [peak.get(c, 0) for c in range(n_chunks)],
+    }
 
 
 def interleaved_stats(S, V, M, f_ticks=1.0, b_ticks=2.0):
     """Tick-simulate the interleaved schedule: S device executors, chunk
-    costs scale 1/V.  Returns {"makespan", "bubble_fraction"} in
-    stage-time units — bubble shrinks ~1/V vs plain 1F1B."""
-    C = S * V
-    fc, bc = f_ticks / V, b_ticks / V
-    finish = {}
-    free = [0.0] * S
-    for c, kind, m in build_interleaved_schedule(S, V, M):
-        r = c % S
-        cost = fc if kind == "F" else bc
-        if kind == "F":
-            dep = finish.get(("F", c - 1, m), 0.0) if c else 0.0
-        else:
-            dep = max(finish.get(("F", c, m), 0.0),
-                      finish.get(("B", c + 1, m), 0.0))
-        start = max(free[r], dep)
-        finish[(kind, c, m)] = start + cost
-        free[r] = start + cost
-    makespan = max(finish.values())
-    busy = M * (f_ticks + b_ticks)
-    return {"makespan": makespan,
-            "bubble_fraction": 1.0 - busy / makespan}
+    costs scale 1/V.  Returns makespan/bubble in stage-time units —
+    bubble shrinks ~1/V vs plain 1F1B."""
+    return _simulate_ticks(
+        build_interleaved_schedule(S, V, M), S, lambda c: c % S,
+        f_ticks / V, b_ticks / V, M * (f_ticks + b_ticks))
 
 
 def schedule_stats(S, M, schedule="1f1b", f_ticks=1, b_ticks=2):
@@ -196,34 +198,9 @@ def schedule_stats(S, M, schedule="1f1b", f_ticks=1, b_ticks=2):
     {"makespan", "bubble_fraction", "peak_inflight"} where peak_inflight
     is the max number of forwards a stage holds without their backward —
     the activation-memory bound (1F1B: <= min(M, S - s); GPipe: M)."""
-    finish = {}
-    free_at = [0] * S
-    inflight = [0] * S
-    peak = [0] * S
-    for s, kind, m in build_1f1b_schedule(S, M, schedule):
-        cost = f_ticks if kind == "F" else b_ticks
-        if kind == "F":
-            dep = finish.get(("F", s - 1, m), 0) if s > 0 else 0
-        elif s == S - 1:
-            dep = finish.get(("F", s, m), 0)
-        else:
-            dep = max(finish.get(("B", s + 1, m), 0),
-                      finish.get(("F", s, m), 0))
-        start = max(free_at[s], dep)
-        finish[(kind, s, m)] = start + cost
-        free_at[s] = start + cost
-        if kind == "F":
-            inflight[s] += 1
-            peak[s] = max(peak[s], inflight[s])
-        else:
-            inflight[s] -= 1
-    makespan = max(finish.values())
-    busy = M * (f_ticks + b_ticks)     # per stage
-    return {
-        "makespan": makespan,
-        "bubble_fraction": 1.0 - busy / makespan,
-        "peak_inflight": peak,
-    }
+    return _simulate_ticks(
+        build_1f1b_schedule(S, M, schedule), S, lambda c: c,
+        float(f_ticks), float(b_ticks), M * (f_ticks + b_ticks))
 
 
 # ---------------------------------------------------------------------------
