@@ -246,6 +246,33 @@ def row_stack(*args, **kwargs):
     return module.vstack(*args, **kwargs)
 
 
+def copyto(dst, src, casting="same_kind", where=True):
+    """numpy.copyto on NDArrays: write ``src`` into ``dst`` in place
+    (device-side; jnp has no copyto — the host fallback could never
+    mutate a device array).  ``casting`` is enforced with numpy's own
+    rule table; ``src`` broadcasts to ``dst`` like numpy."""
+    if not isinstance(dst, NDArray):
+        raise MXNetError("mx.np.copyto: dst must be an NDArray")
+    module = sys.modules[__name__]
+    src_dtype = getattr(src, "dtype", None)
+    if src_dtype is None:
+        src_dtype = _onp.asarray(src).dtype
+    if not _onp.can_cast(src_dtype, _as_np_dtype(dst.dtype), casting):
+        raise MXNetError(
+            "mx.np.copyto: cannot cast %s to %s under rule %r"
+            % (src_dtype, dst.dtype, casting))
+    src_nd = src if isinstance(src, NDArray) else \
+        module.array(src, dtype=dst.dtype)
+    if tuple(src_nd.shape) != tuple(dst.shape):
+        src_nd = module.broadcast_to(src_nd, tuple(dst.shape))
+    if where is True:
+        src_nd.copyto(dst)
+        return
+    merged = module.where(where, src_nd, dst)
+    (merged if isinstance(merged, NDArray)
+     else module.array(merged)).copyto(dst)
+
+
 # creation / conversion with mxnet semantics ---------------------------------
 
 def array(obj, dtype=None, ctx=None, device=None):

@@ -132,6 +132,9 @@ def _is_float(x):
 # time).  Disable wholesale with MXNET_EAGER_VJP_CACHE=0.
 _VJP_CACHE = {}
 _VJP_CACHE_CAP = 4096
+# ops whose fn concretizes array values (static axes etc.) — their vjp
+# cannot be rebuilt under jit; discovered at first failing backward
+_VJP_UNJITTABLE = set()
 
 
 def _vjp_cache_key(op, attrs, datas, train):
@@ -144,6 +147,8 @@ def _vjp_cache_key(op, attrs, datas, train):
     # would collide and replay the wrong captured data.
     if _OP_REGISTRY.get(op.name) is not op:
         return None
+    if op.name in _VJP_UNJITTABLE:
+        return None
     if not get_env("MXNET_EAGER_VJP_CACHE", bool, True):
         return None
     limit = get_env("MXNET_EAGER_VJP_CACHE_MAX_ELEMS", int, 1 << 16)
@@ -153,8 +158,13 @@ def _vjp_cache_key(op, attrs, datas, train):
         if hasattr(d, "shape") and hasattr(d, "dtype"):
             total += d.size
             sig.append((tuple(d.shape), str(d.dtype)))
-        else:
+        elif isinstance(d, (int, float, bool, str, bytes, type(None))):
+            # immutable scalars only: they get BAKED into the cached
+            # backward's closure, so a mutable arg (list) could be
+            # mutated after caching while its repr-key still matched
             sig.append(("py", repr(d)))
+        else:
+            return None
     if total > limit:
         return None
     if attrs and any(hasattr(v, "shape") and hasattr(v, "dtype")
@@ -240,6 +250,8 @@ def invoke(op, inputs, attrs):
             cache_key = cache_key + (tuple(positions),)
         bwd_jit = _VJP_CACHE.get(cache_key) if cache_key is not None \
             else None
+        arr_idx = tuple(i for i, d in enumerate(datas)
+                        if hasattr(d, "shape") and hasattr(d, "dtype"))
         if bwd_jit is not None:
             # hit: forward runs EAGERLY (identical math, and eager jnp
             # dispatch beats a jit call for trivial ops); the backward
@@ -247,8 +259,22 @@ def invoke(op, inputs, attrs):
             out = fn(*datas)
             out_datas = out if isinstance(out, tuple) else (out,)
 
-            def vjp_wrapper(out_cts, _bwd=bwd_jit, _p=tuple(datas)):
-                return list(_bwd(_p, tuple(out_cts)))
+            def vjp_wrapper(out_cts, _bwd=bwd_jit, _p=tuple(datas),
+                            _ai=arr_idx, _key=cache_key, _tf=tuple_fn,
+                            _pos=positions):
+                try:
+                    return list(_bwd(tuple(_p[i] for i in _ai),
+                                     tuple(out_cts)))
+                except Exception:  # noqa: BLE001 - fn not jit-traceable
+                    # an op that concretizes a primal (static axis from
+                    # an array value) cannot ride the jitted backward:
+                    # drop ALL of its entries (none can ever hit again
+                    # once blacklisted) and recompute eagerly
+                    _VJP_UNJITTABLE.add(_key[0])
+                    for k in [k for k in _VJP_CACHE if k[0] == _key[0]]:
+                        _VJP_CACHE.pop(k, None)
+                    grads = jax.vjp(_tf, *_p)[1](tuple(out_cts))
+                    return [grads[i] for i in _pos]
         else:
             out_datas, vjp_fn = jax.vjp(tuple_fn, *datas)
 
@@ -259,10 +285,20 @@ def invoke(op, inputs, attrs):
             if cache_key is not None and not keylog.keys:
                 # deterministic signature: cache a backward that rebuilds
                 # the vjp inside jit (recompute-based — cheap at cached
-                # sizes), returning grads at tape positions
-                def _bwd_fn(primals, cts, _fn=tuple_fn,
-                            _pos=tuple(positions)):
-                    grads = jax.vjp(_fn, *primals)[1](tuple(cts))
+                # sizes), returning grads at tape positions.  Only ARRAY
+                # args are traced; python scalars are baked as closure
+                # constants (they are part of the cache key, and some
+                # fns use them statically — a tracer would break them)
+                const = {i: d for i, d in enumerate(datas)
+                         if i not in arr_idx}
+
+                def _bwd_fn(arr_primals, cts, _fn=tuple_fn,
+                            _pos=tuple(positions), _ai=arr_idx,
+                            _const=const, _n=len(datas)):
+                    it = iter(arr_primals)
+                    full = [_const[i] if i in _const else next(it)
+                            for i in range(_n)]
+                    grads = jax.vjp(_fn, *full)[1](tuple(cts))
                     return tuple(grads[i] for i in _pos)
 
                 if len(_VJP_CACHE) >= _VJP_CACHE_CAP:

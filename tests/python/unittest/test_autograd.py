@@ -491,3 +491,48 @@ class TestEagerVjpCache:
         assert cached * 2.0 < uncached, \
             "cached %.1fus not ahead of retrace %.1fus" \
             % (cached * 1e6, uncached * 1e6)
+
+    def test_unjittable_op_falls_back_and_blacklists(self):
+        """An op whose fn concretizes an array value (static axis) cannot
+        ride the jitted cached backward: the first failing backward must
+        fall back to the eager vjp (correct grads) and blacklist the op."""
+        import jax.numpy as jnp
+
+        from mxnet_tpu.ops import registry
+
+        name = "_test_concretizing_op"
+        registry._OP_REGISTRY.pop(name, None)
+        registry._VJP_UNJITTABLE.discard(name)
+
+        @registry.register(name)
+        def _concretizing(x, axes):
+            # int(axes[0]) concretizes: fine eagerly, breaks under jit
+            return jnp.swapaxes(x, int(axes[0]), int(axes[1])) * 2.0
+
+        try:
+            registry.vjp_cache_clear()
+            x = mx.nd.array(np.random.RandomState(0)
+                            .rand(3, 4).astype(np.float32))
+            axes = mx.nd.array(np.array([0, 1], np.int32))
+            x.attach_grad()
+
+            op = registry.get_op(name)
+
+            def grad_once():
+                with autograd.record():
+                    L = mx.nd.sum(op(x, axes))
+                L.backward()
+                return x.grad.asnumpy().copy()
+
+            g1 = grad_once()          # populates the cache (eager vjp ok)
+            g2 = grad_once()          # cache hit -> jit trace fails ->
+                                      # eager fallback + blacklist
+            np.testing.assert_allclose(g1, 2 * np.ones((3, 4)), rtol=1e-6)
+            np.testing.assert_allclose(g2, g1, rtol=1e-6)
+            assert name in registry._VJP_UNJITTABLE
+            g3 = grad_once()          # stays on the eager path
+            np.testing.assert_allclose(g3, g1, rtol=1e-6)
+        finally:
+            registry._OP_REGISTRY.pop(name, None)
+            registry._VJP_UNJITTABLE.discard(name)
+            registry.vjp_cache_clear()

@@ -427,6 +427,22 @@ add("bilinear_resize", rnd(), attrs={"height": 6, "width": 6},
     shapes=NCHW, dtypes=F2, rtol=3e-2, atol=3e-2)
 add("UpSampling", rnd(), attrs={"scale": 2, "sample_type": "nearest"},
     shapes=NCHW, dtypes=F2)
+# spatial sampler family (r5): grid coords in [-1,1]; thetas near identity
+# bf16 grid coords quantize at ~8e-3, and d(out)/d(coord) scales with
+# the pixel gradient x (W-1)/2 — conv-family tolerance applies
+add("BilinearSampler", rnd(),
+    lambda s: _r((s[0], 2, s[2], s[3]), -0.9, 0.9),
+    shapes=NCHW, dtypes=F2, rtol=1e-1, atol=1e-1)
+add("GridGenerator",
+    lambda s: np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32),
+                      (s[0], 1)) + _r((s[0], 6), -0.1, 0.1),
+    attrs={"transform_type": "affine", "target_shape": (4, 4)},
+    shapes=NCHW, dtypes=F2)
+add("SpatialTransformer", rnd(),
+    lambda s: np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32),
+                      (s[0], 1)) + _r((s[0], 6), -0.1, 0.1),
+    attrs={"target_shape": (4, 4)},
+    shapes=NCHW, dtypes=F2, rtol=1e-1, atol=1e-1)
 add("BatchNorm", rnd(), lambda s: pos((s[1],)), lambda s: _r((s[1],)),
     lambda s: _r((s[1],)), lambda s: pos((s[1],)), shapes=NCHW,
     dtypes=F2, rtol=3e-2, atol=3e-2)

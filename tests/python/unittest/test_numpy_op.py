@@ -415,3 +415,19 @@ def test_np_fallback_logged_once(caplog):
     assert len(msgs) == 1, "expected one fallback warning, got %d" % \
         len(msgs)
     assert name in mnp.fallback_names()
+
+
+def test_np_copyto_device_side():
+    """np.copyto mutates the destination NDArray on device (jnp has no
+    copyto; the host fallback could never write back)."""
+    from mxnet_tpu.numpy import resolve_source
+
+    assert resolve_source("copyto") == "jnp"
+    dst = mx.np.zeros((4,))
+    mx.np.copyto(dst, onp.arange(4, dtype=onp.float32))
+    onp.testing.assert_allclose(dst.asnumpy(), [0, 1, 2, 3])
+    mx.np.copyto(dst, onp.full(4, 9.0, onp.float32),
+                 where=onp.array([True, False, True, False]))
+    onp.testing.assert_allclose(dst.asnumpy(), [9, 1, 9, 3])
+    with pytest.raises(mx.MXNetError):
+        mx.np.copyto(onp.zeros(3), onp.ones(3))
