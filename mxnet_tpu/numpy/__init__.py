@@ -263,14 +263,16 @@ def copyto(dst, src, casting="same_kind", where=True):
             % (src_dtype, dst.dtype, casting))
     src_nd = src if isinstance(src, NDArray) else \
         module.array(src, dtype=dst.dtype)
+    if str(src_nd.dtype) != str(dst.dtype):
+        # cast BEFORE any where-merge: a promoted merge dtype would
+        # round-trip the untouched (where=False) dst elements
+        src_nd = src_nd.astype(dst.dtype)
     if tuple(src_nd.shape) != tuple(dst.shape):
         src_nd = module.broadcast_to(src_nd, tuple(dst.shape))
     if where is True:
         src_nd.copyto(dst)
         return
-    merged = module.where(where, src_nd, dst)
-    (merged if isinstance(merged, NDArray)
-     else module.array(merged)).copyto(dst)
+    module.where(where, src_nd, dst).copyto(dst)
 
 
 # creation / conversion with mxnet semantics ---------------------------------

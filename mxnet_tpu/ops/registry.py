@@ -191,6 +191,7 @@ def vjp_cache_info():
 
 def vjp_cache_clear():
     _VJP_CACHE.clear()
+    _VJP_UNJITTABLE.clear()   # re-registration under a name starts fresh
 
 
 def invoke(op, inputs, attrs):
@@ -265,11 +266,18 @@ def invoke(op, inputs, attrs):
                 try:
                     return list(_bwd(tuple(_p[i] for i in _ai),
                                      tuple(out_cts)))
-                except Exception:  # noqa: BLE001 - fn not jit-traceable
+                except Exception as exc:  # noqa: BLE001
                     # an op that concretizes a primal (static axis from
                     # an array value) cannot ride the jitted backward:
                     # drop ALL of its entries (none can ever hit again
-                    # once blacklisted) and recompute eagerly
+                    # once blacklisted), log once, recompute eagerly
+                    if _key[0] not in _VJP_UNJITTABLE:
+                        import logging
+
+                        logging.getLogger("mxnet_tpu").warning(
+                            "eager vjp cache: op %r backward is not "
+                            "jittable (%s); falling back to per-call "
+                            "retrace for this op", _key[0], exc)
                     _VJP_UNJITTABLE.add(_key[0])
                     for k in [k for k in _VJP_CACHE if k[0] == _key[0]]:
                         _VJP_CACHE.pop(k, None)

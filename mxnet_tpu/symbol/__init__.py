@@ -327,6 +327,11 @@ class Executor:
             return self._grad_req.get(name, "null")
         return self._grad_req
 
+    def get_optimized_symbol(self):
+        """Reference executor.py get_optimized_symbol: the (possibly
+        partition-rewritten) symbol this executor is bound to."""
+        return self._sym
+
     def copy_params_from(self, arg_params, aux_params=None):
         """Reference executor.py copy_params_from: load a param dict into
         the bound arg arrays (shape-checked)."""
