@@ -323,6 +323,39 @@ def _bench_resnet_infer(dtype="bfloat16", batch=32, iters=30):
             "batch": batch, "dtype": dtype}
 
 
+def _bench_resnet_infer_int8(batch=32, iters=30):
+    """Post-training-quantized int8 inference (reference perf.md int8
+    rows; contrib/quantization quantize_net -> int8 MXU path)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.contrib.quantization import quantize_net
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    net = vision.resnet50_v1()
+    net.initialize()
+    rs = np.random.RandomState(0)
+    calib = nd.array(rs.rand(8, 3, 224, 224).astype(np.float32))
+    net(calib[:1])     # resolve deferred shapes
+    quantize_net(net, calib_data=[calib], calib_mode="naive")
+    net.hybridize()
+
+    x = nd.array(rs.rand(batch, 3, 224, 224).astype(np.float32))
+    for _ in range(WARMUP):
+        out = net(x)
+    float(out.asnumpy().ravel()[0])  # hard sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = net(x)
+    float(out.asnumpy().ravel()[0])
+    dt_s = time.perf_counter() - t0
+    return {"imgs_per_sec": round(batch * iters / dt_s, 2),
+            "step_ms": round(1000 * dt_s / iters, 3),
+            "batch": batch, "dtype": "int8"}
+
+
 def main():
     extra = {}
     _log("start; budget %.0fs" % BUDGET_S)
@@ -395,6 +428,10 @@ def main():
             ("lstm_lm", _bench_lstm_lm, "lstm_lm_650"),
             ("resnet50_infer_bf16", _bench_resnet_infer,
              "resnet50_infer_bf16_bs32"),
+            # int8 post-training quantization (reference perf.md int8
+            # inference rows; MXU int8 path)
+            ("resnet50_infer_int8", _bench_resnet_infer_int8,
+             "resnet50_infer_int8_bs32"),
             # larger batch fills the MXU better; tracked as a secondary
             # row (BASELINE's headline config stays bs128)
             ("resnet50_bf16_bs256",
