@@ -23,7 +23,7 @@ import time
 
 def main(out_path="TESTS.json"):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    cmd = [sys.executable, "-m", "pytest", "tests/", "-q", "-rN",
+    cmd = [sys.executable, "-m", "pytest", "tests/", "-q", "-rfE",
            "--tb=no", "-p", "no:warnings"]
     t0 = time.time()
     proc = subprocess.run(cmd, cwd=repo, capture_output=True, text=True,
@@ -45,6 +45,10 @@ def main(out_path="TESTS.json"):
     m = re.search(r"(\d+) skipped", text)
     if m:
         summary["skipped"] = int(m.group(1))
+    # record WHICH tests failed (the -rfE short summary lines) so a
+    # flaky failure is diagnosable from the artifact alone
+    summary["failed_names"] = re.findall(
+        r"^(?:FAILED|ERROR) (\S+)", text, re.M)
     summary["collected"] = (summary["passed"] + summary["failed"]
                             + summary["skipped"] + summary["errors"])
 
