@@ -146,7 +146,13 @@ class PipelineTrainer:
             optimizer, learning_rate=self._lr, **optimizer_params)
         self._user_loss = loss_fn is not None
         self._loss_fn = loss_fn or _make_loss(loss)
-        if dtype not in (None, "float32", "fp32"):
+        if dtype in (None, "float32", "fp32"):
+            self._dtype = None
+        elif engine == "1f1b" and dtype in ("bfloat16", "bf16"):
+            # mixed precision: f32 master params, bf16 stage compute —
+            # stage-boundary transfers and in-flight activations halve
+            self._dtype = jnp.bfloat16
+        else:
             raise MXNetError("%s pipeline computes in f32 (got dtype=%r)"
                              % (engine, dtype))
         self._step_count = 0

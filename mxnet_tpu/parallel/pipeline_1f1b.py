@@ -307,11 +307,26 @@ class OneFOneBTrainer(_pipeline_trainer_cls()):
                 self._opt_init(params)))
 
             last = si == C - 1
+            comp_dt = self._dtype   # bf16 mixed precision (or None)
 
-            def stage_out(p, xin, rng, m, _f=apply_fn, _s=si):
+            def stage_out(p, xin, rng, m, _f=apply_fn, _s=si,
+                          _dt=comp_dt):
                 key = jax.random.fold_in(jax.random.fold_in(rng, _s), m)
+                if _dt is not None:
+                    # f32 master params -> bf16 compute; activations at
+                    # stage boundaries (and their cotangents) ride bf16,
+                    # halving transfer bytes and in-flight memory
+                    p = {n: (v.astype(_dt) if v.dtype == jnp.float32
+                             else v) for n, v in p.items()}
+                    if jnp.issubdtype(xin.dtype, jnp.floating):
+                        xin = xin.astype(_dt)
                 outs2, _ = _f(p, key, xin)
                 return outs2[0]
+
+            # output aval through the COMPUTE dtype; in f32 mode it is
+            # exactly the apply_fn aval already traced above
+            out_aval = outs[0] if comp_dt is None else jax.eval_shape(
+                stage_out, params, abstract, rng0, jnp.uint32(0))
 
             if not last:
                 fwd = jax.jit(
@@ -334,6 +349,8 @@ class OneFOneBTrainer(_pipeline_trainer_cls()):
                 def last_fb(p, xin, ylab, rng, m, _so=stage_out):
                     def lossf(pp, xx):
                         out = _so(pp, xx, rng, m)
+                        if jnp.issubdtype(out.dtype, jnp.floating):
+                            out = out.astype(jnp.float32)  # f32 loss math
                         if user_loss:
                             return jnp.mean(loss_fn([out], ylab))
                         return jnp.mean(loss_fn(out, ylab))
@@ -358,7 +375,8 @@ class OneFOneBTrainer(_pipeline_trainer_cls()):
                 donate_argnums=(1, 3)))
             self._fwd.append(fwd)
             self._bwd.append(bwd)
-            abstract = jax.ShapeDtypeStruct(outs[0].shape, outs[0].dtype)
+            abstract = jax.ShapeDtypeStruct(out_aval.shape,
+                                            out_aval.dtype)
 
         self._mb = mb
         self._order = (build_1f1b_schedule(C, M) if self._V == 1
