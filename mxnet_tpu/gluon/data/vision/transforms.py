@@ -7,7 +7,7 @@ import numpy as _np
 from .... import ndarray as nd
 from ....ndarray.ndarray import NDArray
 from ...block import Block, HybridBlock
-from ...nn.basic_layers import Sequential
+from ...nn.basic_layers import HybridSequential, Sequential
 
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
            "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
@@ -327,6 +327,9 @@ def _rotate(x, deg, zoom_in=False, zoom_out=False):
 class CropResize(HybridBlock):
     """Fixed crop then resize (reference transforms/image.py:259)."""
 
+    # imresize's uint8 path concretizes (asnumpy); keep out of jit traces
+    _trace_safe = False
+
     def __init__(self, x, y, width, height, size=None, interpolation=None):
         super().__init__()
         self._x, self._y = x, y
@@ -372,4 +375,52 @@ class RandomApply(Block):
 
 
 __all__ += ["RandomCrop", "RandomHue", "RandomGray", "Rotate",
-            "RandomRotation", "CropResize", "RandomApply"]
+            "RandomRotation", "CropResize", "RandomApply",
+            "HybridCompose", "HybridRandomApply"]
+
+
+class HybridCompose(Compose):
+    """Reference transforms/__init__.py:80 HybridCompose: consecutive
+    hybridizable transforms are GROUPED into one hybridized
+    HybridSequential segment (one jitted program per run of hybrid
+    stages — the reference's exact strategy), with plain-Block or
+    non-trace-safe transforms (CropResize's uint8 resize concretizes)
+    breaking the segments."""
+
+    def __init__(self, transforms):
+        grouped = []
+        seg = []
+
+        def flush():
+            if not seg:
+                return
+            if len(seg) == 1:
+                grouped.append(seg[0])
+            else:
+                hs = HybridSequential()
+                hs.add(*seg)
+                hs.hybridize()
+                grouped.append(hs)
+            seg.clear()
+
+        for t in transforms:
+            if isinstance(t, HybridBlock) and \
+                    getattr(t, "_trace_safe", True):
+                seg.append(t)
+            else:
+                flush()
+                grouped.append(t)
+        flush()
+        super().__init__(grouped)
+
+
+class HybridRandomApply(RandomApply):
+    """Reference transforms/__init__.py:168: RandomApply whose wrapped
+    transform is hybridized (compiled once, reused across the calls the
+    host-side bernoulli gate lets through)."""
+
+    def __init__(self, transforms, p=0.5):
+        super().__init__(transforms, p)
+        if isinstance(transforms, HybridBlock) and \
+                getattr(transforms, "_trace_safe", True):
+            transforms.hybridize()

@@ -493,3 +493,45 @@ def test_image_list_dataset(tmp_path):
     assert list(ds2[2][1].asnumpy()) == [2.0, 3.0]
     with pytest.raises(ValueError):
         vision.ImageListDataset(root=root, imglist=[[0, 1]])
+
+
+def test_hybrid_compose_and_random_apply():
+    """Transform name parity tail (reference transforms/__init__.py:80,
+    168): HybridCompose compiles the chain; HybridRandomApply gates."""
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    chain = T.HybridCompose([T.Resize(8), T.ToTensor(),
+                             T.Normalize(0.5, 0.5)])
+    img = nd.array(np.random.RandomState(0).randint(0, 255, (16, 16, 3)),
+                   dtype="uint8")
+    out = chain(img)
+    assert out.shape == (3, 8, 8) and str(out.dtype) == "float32"
+    # parity with the plain Compose chain
+    plain = T.Compose([T.Resize(8), T.ToTensor(), T.Normalize(0.5, 0.5)])
+    np.testing.assert_allclose(out.asnumpy(), plain(img).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    always = T.HybridRandomApply(T.Cast("float16"), p=1.0)
+    never = T.HybridRandomApply(T.Cast("float16"), p=0.0)
+    x = nd.array(np.zeros((2, 2, 3), np.float32))
+    assert str(always(x).dtype) == "float16"
+    assert str(never(x).dtype) == "float32"
+
+
+def test_hybrid_compose_segments_and_trace_safety():
+    """HybridCompose fuses consecutive hybrid transforms into ONE
+    HybridSequential segment and keeps non-trace-safe ones (CropResize's
+    concretizing resize) out of jit."""
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    chain = T.HybridCompose([T.CropResize(0, 0, 8, 8, (4, 4)),
+                             T.ToTensor(), T.Normalize(0.5, 0.5)])
+    kinds = [type(c).__name__ for c in chain]
+    assert kinds == ["CropResize", "HybridSequential"], kinds
+    img = nd.array(np.random.RandomState(1).randint(0, 255, (16, 16, 3)),
+                   dtype="uint8")
+    out = chain(img)
+    assert out.shape == (3, 4, 4)
+    plain = T.Compose([T.CropResize(0, 0, 8, 8, (4, 4)), T.ToTensor(),
+                       T.Normalize(0.5, 0.5)])
+    np.testing.assert_allclose(out.asnumpy(), plain(img).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
