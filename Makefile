@@ -9,7 +9,7 @@ LDLIBS ?= -ljpeg -lz
 SRCS := $(wildcard src/native/*.cc)
 SO := build/libmxtpu_native.so
 
-.PHONY: native test cpptest clean
+.PHONY: native test cpptest telemetry-smoke clean
 
 native: $(SO)
 
@@ -29,6 +29,13 @@ $(CPPTEST): tests/cpp/test_native_main.cc $(SRCS) $(wildcard src/native/*.h)
 # cpptest runs inside the pytest suite (test_cpp_native.py)
 test: native
 	python -m pytest tests/ -q
+
+# fast telemetry smoke (tier-1 exercises the mx.telemetry registry,
+# the cross-stack instrumentation hooks, and the profiler Counter fix)
+telemetry-smoke:
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/python/unittest/test_telemetry.py \
+	  tests/python/unittest/test_profiler.py -q -m 'not slow'
 
 # suite summary artifact (TESTS_r{N}.json) — round-2 advisor contract
 test-report:

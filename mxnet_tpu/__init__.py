@@ -47,7 +47,8 @@ def _maybe_init_distributed():
 
 _maybe_init_distributed()
 
-from . import autograd, base, context, engine
+from . import base, telemetry  # telemetry first: instrumented layers use it
+from . import autograd, context, engine
 from . import ndarray
 from . import ndarray as nd
 from . import random

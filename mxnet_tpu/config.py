@@ -49,6 +49,14 @@ ENV_VARS = {
     "MXNET_PROFILER_AUTOSTART": (
         bool, False,
         "Start the profiler at import (reference env_var.md)."),
+    "MXNET_TELEMETRY_DISABLE": (
+        bool, False,
+        "Disable the runtime telemetry registry (mx.telemetry); hooks "
+        "reduce to one boolean check."),
+    "MXNET_TELEMETRY_LOG_INTERVAL": (
+        float, 0.0,
+        "Seconds between periodic 'telemetry k=v ...' log lines "
+        "(mxnet_tpu.telemetry logger; 0 disables)."),
     "MXNET_EAGER_VJP_CACHE": (
         bool, True,
         "Reuse jitted forward+vjp pairs for repeated eager recorded-op "

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import contextlib
 
+from . import telemetry as _tel
 from .base import get_env
 
 __all__ = ["Engine", "get", "set_bulk_size", "bulk"]
@@ -42,12 +43,16 @@ class Engine:
 
     def push(self, fn, *args):
         """Run fn; in naive mode block immediately (exception surfacing)."""
+        if _tel.ENABLED:
+            _tel.ENGINE_PUSH.inc()
         out = fn(*args)
         if self.naive:
             from .ndarray.ndarray import NDArray
 
             for o in out if isinstance(out, (tuple, list)) else [out]:
                 if isinstance(o, NDArray):
+                    if _tel.ENABLED:
+                        _tel.ENGINE_NAIVE_WAIT.inc()
                     o.wait_to_read()
         return out
 

@@ -24,11 +24,13 @@ no hidden side effects inside the compiled program.
 """
 from __future__ import annotations
 
+import time as _time
 from collections import OrderedDict
 
 import jax
 
 from .. import autograd, random as mxrandom
+from .. import telemetry as _tel
 from ..base import MXNetError
 from ..context import current_context
 from ..ndarray.ndarray import NDArray
@@ -394,9 +396,21 @@ class HybridBlock(Block):
                tuple((x.shape, str(x.dtype)) if isinstance(x, NDArray)
                      else ("static", repr(x)) for x in flat_inputs))
         centry = self._cached_ops.get(key)
+        built_t0 = None
         if centry is None:
+            # jax.jit traces+compiles lazily on first execution, so build
+            # latency is observed at function exit (cold-start latency:
+            # trace + compile + first run), not around _build_cache alone
+            built_t0 = _time.perf_counter()
             centry = self._build_cache(flat_inputs, in_spec, training, kwargs)
+            if _tel.ENABLED:
+                blk = type(self).__name__
+                _tel.CACHEDOP_BUILD.labels(block=blk).inc()
+                if self._cached_ops:
+                    _tel.CACHEDOP_RECOMPILE.labels(block=blk).inc()
             self._cached_ops[key] = centry
+        elif _tel.ENABLED:
+            _tel.CACHEDOP_HIT.labels(block=type(self).__name__).inc()
 
         params = list(self.collect_params().values())
         param_datas = [p._data._data for p in params]
@@ -453,6 +467,9 @@ class HybridBlock(Block):
         it = iter(outs)
         result = _unflatten_nd(centry.out_spec, it)
         result = result[0] if len(result) == 1 else tuple(result)
+        if built_t0 is not None and _tel.ENABLED:
+            _tel.CACHEDOP_BUILD_SECONDS.observe(
+                _time.perf_counter() - built_t0)
         return result
 
     def _build_cache(self, flat_inputs, in_spec, training, call_kwargs):

@@ -33,6 +33,7 @@ import warnings as _warnings
 import numpy as _np
 
 from ... import ndarray as nd
+from ... import telemetry as _tel
 from ...ndarray.ndarray import NDArray
 from .dataset import Dataset
 from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler
@@ -239,6 +240,10 @@ class _MultiWorkerIter:
         if self._rcvd >= self._sent:
             self.shutdown()
             raise StopIteration
+        # latch the flag: enabling telemetry mid-fetch must not observe
+        # perf_counter() against a 0.0 sentinel (~process uptime)
+        tel_on = _tel.ENABLED
+        t0 = _time.perf_counter() if tel_on else 0.0
         while self._rcvd not in self._reorder:
             try:
                 idx, payload, err = self._data_queue.get(
@@ -267,6 +272,9 @@ class _MultiWorkerIter:
         if err is not None:
             self.shutdown()
             raise RuntimeError(f"DataLoader worker failed:\n{err}")
+        if tel_on:
+            # time the consumer spent blocked on workers = loader stall
+            _tel.DATALOADER_WAIT_SECONDS.observe(_time.perf_counter() - t0)
         return _shm_decode(payload, self._to_device)
 
     def shutdown(self):
@@ -376,7 +384,14 @@ class DataLoader:
     def __iter__(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
-                yield self._load_batch(indices)
+                if _tel.ENABLED:
+                    t0 = _time.perf_counter()
+                    batch = self._load_batch(indices)
+                    _tel.DATALOADER_WAIT_SECONDS.observe(
+                        _time.perf_counter() - t0)
+                    yield batch
+                else:
+                    yield self._load_batch(indices)
             return
         if not self._thread_pool:
             try:
@@ -428,7 +443,12 @@ class DataLoader:
         thread = threading.Thread(target=producer, daemon=True)
         thread.start()
         while True:
+            tel_on = _tel.ENABLED
+            t0 = _time.perf_counter() if tel_on else 0.0
             item = q.get(timeout=self._timeout)
+            if tel_on and item is not sentinel:
+                _tel.DATALOADER_WAIT_SECONDS.observe(
+                    _time.perf_counter() - t0)
             if item is sentinel:
                 break
             if isinstance(item, Exception):

@@ -16,12 +16,14 @@ synchronous all-reduce over the ICI mesh:
 from __future__ import annotations
 
 import os
+import time as _time
 
 import jax
 import jax.numpy as jnp
 import numpy as _np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import telemetry as _tel
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .base import KVStoreBase
@@ -106,6 +108,8 @@ class CollectiveKVStore(KVStoreBase):
             nonlocal bucket, nbytes
             if not bucket:
                 return
+            tel_on = _tel.ENABLED
+            t0 = _time.perf_counter() if tel_on else 0.0
             flat = jnp.concatenate(
                 [jnp.ravel(a) for _, a in bucket]) if len(bucket) > 1 \
                 else jnp.ravel(bucket[0][1])
@@ -129,6 +133,12 @@ class CollectiveKVStore(KVStoreBase):
                 n = a.size
                 out[i] = local_sum[off:off + n].reshape(a.shape)
                 off += n
+            if tel_on:
+                # dispatch latency only — the psum itself is async (hard
+                # syncs would serialize the bucket overlap noted above)
+                _tel.COLLECTIVE_CALLS.labels(op="allreduce").inc()
+                _tel.COLLECTIVE_BYTES.labels(op="allreduce").inc(nbytes)
+                _tel.COLLECTIVE_SECONDS.observe(_time.perf_counter() - t0)
             bucket = []
             nbytes = 0
 
@@ -160,8 +170,16 @@ class CollectiveKVStore(KVStoreBase):
                 # host-staged numpy in/out: init-time only, and the result
                 # must be a process-local array — eager consumers (copyto
                 # etc.) must never see non-addressable global devices
-                data = multihost_utils.broadcast_one_to_all(
-                    _np.asarray(v._data))
+                host = _np.asarray(v._data)
+                tel_on = _tel.ENABLED
+                t0 = _time.perf_counter() if tel_on else 0.0
+                data = multihost_utils.broadcast_one_to_all(host)
+                if tel_on:
+                    _tel.COLLECTIVE_CALLS.labels(op="broadcast").inc()
+                    _tel.COLLECTIVE_BYTES.labels(op="broadcast").inc(
+                        host.nbytes)
+                    _tel.COLLECTIVE_SECONDS.observe(
+                        _time.perf_counter() - t0)
                 data = jnp.asarray(data)
             else:
                 data = v._data

@@ -89,8 +89,7 @@ def array(source_array, ctx=None, dtype=None):
                       else None)
     if arr.dtype == _np.float64 and dtype is None:
         arr = arr.astype(_np.float32)
-    data = _jnp().asarray(arr)
-    return NDArray(data, ctx=ctx or current_context())
+    return NDArray._from_np(arr, ctx=ctx or current_context())
 
 
 def zeros(shape, ctx=None, dtype="float32", **kw):

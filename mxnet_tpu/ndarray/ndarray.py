@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import numpy as _np
 
+from .. import telemetry as _tel
 from ..base import MXNetError, _as_np_dtype, integer_types, numeric_types
 from ..context import Context, cpu, current_context
 
@@ -65,6 +66,8 @@ class NDArray:
         """Wrap a host numpy array (device transfer deferred to jnp)."""
         import jax.numpy as jnp
 
+        if _tel.ENABLED and isinstance(arr, _np.ndarray):
+            _tel.TRANSFER_H2D.inc(arr.nbytes)
         return cls(jnp.asarray(arr), ctx=ctx)
 
     # ---- basic properties -------------------------------------------------
@@ -121,7 +124,10 @@ class NDArray:
     def asnumpy(self):
         import jax
 
-        return _np.asarray(jax.device_get(self._data))
+        arr = _np.asarray(jax.device_get(self._data))
+        if _tel.ENABLED:
+            _tel.TRANSFER_D2H.inc(arr.nbytes)
+        return arr
 
     def asscalar(self):
         if self.size != 1:

@@ -22,6 +22,7 @@ import functools
 
 import jax
 
+from .. import telemetry as _tel
 from ..base import MXNetError, thread_state
 
 __all__ = ["Operator", "register", "get_op", "list_ops", "invoke", "apply_op"]
@@ -202,6 +203,10 @@ def invoke(op, inputs, attrs):
     """
     from ..ndarray.ndarray import NDArray
 
+    if _tel.ENABLED:
+        # the imperative invoke IS the engine push of the reference
+        # (PushFCompute); the facade's Engine.push counts separately
+        _tel.ENGINE_PUSH.inc()
     out_arg = attrs.pop("out", None) if attrs else None
     datas = [x._data if isinstance(x, NDArray) else x for x in inputs]
     raw_attrs = attrs

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 
 from .base import MXNetError, get_env
@@ -31,6 +32,10 @@ _config = {
     "aggregate_stats": False,
 }
 _state = {"running": False, "trace_dir": None, "events": []}
+# one lock for every _state["events"] append AND Counter value updates —
+# spans/counters are hit from dataloader worker threads and the engine
+# path, and a torn read-modify-write would lose counts
+_events_lock = threading.Lock()
 
 
 def set_config(**kwargs):
@@ -224,9 +229,11 @@ class _Span:
             self._jax_ctx.__exit__(None, None, None)
             self._jax_ctx = None
         if self._start is not None:
-            _state["events"].append({
-                "name": self.name, "cat": self._kind, "ts": self._start,
-                "dur": time.perf_counter() - self._start})
+            with _events_lock:
+                _state["events"].append({
+                    "name": self.name, "cat": self._kind,
+                    "ts": self._start,
+                    "dur": time.perf_counter() - self._start})
             self._start = None
 
     def __enter__(self):
@@ -254,19 +261,30 @@ class Event(_Span):
 class Counter:
     def __init__(self, domain, name, value=None):
         self.name = name
-        self.value = value or 0
+        # `value or 0` collapsed an explicit 0/0.0 into int 0 (losing the
+        # float-ness of 0.0 and conflating "unset" with "set to zero");
+        # only None means unset
+        self.value = 0 if value is None else value
+
+    def _record(self, value):
+        with _events_lock:
+            self.value = value
+            _state["events"].append({"name": self.name, "cat": "counter",
+                                     "ph": "C", "ts": time.perf_counter(),
+                                     "args": {"value": value}})
 
     def set_value(self, value):
-        self.value = value
-        _state["events"].append({"name": self.name, "cat": "counter",
-                                 "ph": "C", "ts": time.perf_counter(),
-                                 "args": {"value": value}})
+        self._record(value)
 
     def increment(self, delta=1):
-        self.set_value(self.value + delta)
+        with _events_lock:
+            self.value += delta
+            _state["events"].append({"name": self.name, "cat": "counter",
+                                     "ph": "C", "ts": time.perf_counter(),
+                                     "args": {"value": self.value}})
 
     def decrement(self, delta=1):
-        self.set_value(self.value - delta)
+        self.increment(-delta)
 
     def __iadd__(self, delta):
         self.increment(delta)
@@ -282,5 +300,6 @@ class Marker:
         self.name = name
 
     def mark(self, scope="process"):
-        _state["events"].append({"name": self.name, "cat": "marker",
-                                 "ph": "i", "ts": time.perf_counter()})
+        with _events_lock:
+            _state["events"].append({"name": self.name, "cat": "marker",
+                                     "ph": "i", "ts": time.perf_counter()})

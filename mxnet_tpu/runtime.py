@@ -33,6 +33,7 @@ def _detect():
         "INT64_TENSOR_SIZE": True,
         "SIGNAL_HANDLER": True,
         "PROFILER": True,
+        "TELEMETRY": True,
         "OPENMP": True,
         "SSE": False,
         "F16C": False,

@@ -1,0 +1,579 @@
+"""Runtime telemetry: process-wide metrics registry + exporters.
+
+Always-on, low-overhead observability for the runtime — the layer a full
+xplane trace (mx.profiler) is too heavy for.  Counters/Gauges/Histograms
+with labels cover compile-cache behaviour (gluon/block.py), engine pushes
+(engine.py), host<->device transfer volume (ndarray), collective traffic
+(kvstore/collective.py), dataloader stalls (gluon/data/dataloader.py) and
+device-memory watermarks (``sample_device_memory`` over
+``profiler.memory_info``).
+
+Design constraints:
+
+- Disabled cost is ONE boolean check per instrumentation hook
+  (``if telemetry.ENABLED:``) — no dict lookups, no label/string work.
+  ``MXNET_TELEMETRY_DISABLE=1`` flips it at import; ``disable()`` /
+  ``enable()`` flip it at runtime.
+- All mutation goes through one module lock, so metrics are safe to
+  update from dataloader worker threads and the engine path.
+- Timers use the monotonic clock (``time.perf_counter``); ``span(...)``
+  and ``@timed(...)`` additionally feed profiler events when an xplane
+  trace is live, so ad-hoc telemetry spans land in the chrome trace too.
+
+Exporters: ``prometheus()`` (text exposition format), ``snapshot()`` /
+``dump(path)`` (JSON), ``totals()`` (flat name->value convenience), and
+an optional periodic log line driven by MXNET_TELEMETRY_LOG_INTERVAL.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+
+from .base import get_env
+
+__all__ = [
+    "ENABLED", "enable", "disable",
+    "counter", "gauge", "histogram", "get_metric",
+    "span", "timed",
+    "snapshot", "totals", "value", "dump", "prometheus", "reset",
+    "sample_device_memory", "log_line", "start_logger",
+    "DEFAULT_BUCKETS",
+]
+
+_LOGGER = logging.getLogger("mxnet_tpu.telemetry")
+
+# single lock for all registry + sample mutation (cheap: held only for
+# a float add / list append, never across user code)
+_LOCK = threading.Lock()
+_REGISTRY = {}  # name -> metric, insertion-ordered
+
+ENABLED = not get_env("MXNET_TELEMETRY_DISABLE", bool, False)
+
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def enable():
+    """Turn instrumentation hooks back on (module-wide)."""
+    global ENABLED
+    ENABLED = True
+
+
+def disable():
+    """Turn instrumentation hooks off; metrics keep their current values."""
+    global ENABLED
+    ENABLED = False
+
+
+# ---------------------------------------------------------------------------
+# metric kinds
+# ---------------------------------------------------------------------------
+
+class _CounterChild:
+    __slots__ = ("_value",)
+
+    def __init__(self):
+        self._value = 0.0
+
+    def inc(self, amount=1.0):
+        if amount < 0:
+            raise ValueError("counters can only increase (got %r)" % amount)
+        with _LOCK:
+            self._value += amount
+
+    @property
+    def value(self):
+        return self._value
+
+
+class _GaugeChild:
+    __slots__ = ("_value",)
+
+    def __init__(self):
+        self._value = 0.0
+
+    def set(self, v):
+        with _LOCK:
+            self._value = float(v)
+
+    def inc(self, amount=1.0):
+        with _LOCK:
+            self._value += amount
+
+    def dec(self, amount=1.0):
+        with _LOCK:
+            self._value -= amount
+
+    @property
+    def value(self):
+        return self._value
+
+
+class _HistogramChild:
+    __slots__ = ("_buckets", "_counts", "_sum", "_count")
+
+    def __init__(self, buckets):
+        self._buckets = buckets
+        self._counts = [0] * (len(buckets) + 1)  # last = +Inf overflow
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v):
+        v = float(v)
+        i = 0
+        for i, ub in enumerate(self._buckets):
+            if v <= ub:
+                break
+        else:
+            i = len(self._buckets)
+        with _LOCK:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def read(self):
+        """Locked consistent view: (count, sum, cumulative buckets)."""
+        with _LOCK:
+            count, total = self._count, self._sum
+            counts = list(self._counts)
+        out, acc = [], 0
+        for ub, c in zip(self._buckets, counts):
+            acc += c
+            out.append((ub, acc))
+        out.append((float("inf"), acc + counts[-1]))
+        return count, total, out
+
+    def cumulative(self):
+        """[(upper_bound, cumulative_count), ...] ending with +Inf."""
+        return self.read()[2]
+
+
+_CHILD_FACTORY = {
+    "counter": lambda m: _CounterChild(),
+    "gauge": lambda m: _GaugeChild(),
+    "histogram": lambda m: _HistogramChild(m.buckets),
+}
+
+
+class Metric:
+    """A named metric family; label children are created on demand."""
+
+    def __init__(self, kind, name, help="", labelnames=(), buckets=None):
+        self.kind = kind
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS)) \
+            if kind == "histogram" else None
+        self._children = {}  # labelvalues tuple -> child
+        self._default = None if self.labelnames \
+            else _CHILD_FACTORY[kind](self)
+
+    def labels(self, *values, **kwargs):
+        if not self.labelnames:
+            # a shadow () child would duplicate the default sample's
+            # (empty-label) series in the prometheus output
+            raise ValueError("%s has no labels: use it directly"
+                             % self.name)
+        if kwargs:
+            if values:
+                raise ValueError("pass labels positionally or by name, "
+                                 "not both")
+            if set(kwargs) != set(self.labelnames):
+                raise ValueError(
+                    "%s takes labels %s, got %s"
+                    % (self.name, self.labelnames, sorted(kwargs)))
+            values = tuple(kwargs[k] for k in self.labelnames)
+        values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise ValueError("%s expects labels %s, got %r"
+                             % (self.name, self.labelnames, values))
+        child = self._children.get(values)
+        if child is None:
+            with _LOCK:
+                child = self._children.setdefault(
+                    values, _CHILD_FACTORY[self.kind](self))
+        return child
+
+    def _delegate(self):
+        if self._default is None:
+            raise ValueError("%s has labels %s: call .labels(...) first"
+                             % (self.name, self.labelnames))
+        return self._default
+
+    # unlabelled convenience surface
+    def inc(self, amount=1.0):
+        self._delegate().inc(amount)
+
+    def dec(self, amount=1.0):
+        self._delegate().dec(amount)
+
+    def set(self, v):
+        self._delegate().set(v)
+
+    def observe(self, v):
+        self._delegate().observe(v)
+
+    @property
+    def value(self):
+        return self._delegate().value
+
+    def _samples(self):
+        """[(labelvalues tuple, child), ...] including the default child.
+
+        The children dict is snapshotted under the lock: exporters (and
+        the periodic log thread) iterate while labels() inserts."""
+        with _LOCK:
+            items = list(self._children.items())
+        out = []
+        if self._default is not None:
+            out.append(((), self._default))
+        out.extend(sorted(items))
+        return out
+
+    def _reset(self):
+        # zero IN PLACE: instrumentation sites hold direct child refs
+        # (e.g. TRANSFER_H2D), so replacing children would orphan them
+        with _LOCK:
+            children = list(self._children.values())
+            if self._default is not None:
+                children.append(self._default)
+            for child in children:
+                if self.kind == "histogram":
+                    child._counts = [0] * (len(self.buckets) + 1)
+                    child._sum = 0.0
+                    child._count = 0
+                else:
+                    child._value = 0.0
+
+
+def _register(kind, name, help, labelnames, buckets=None):
+    # registration is cold-path: always validate under the lock so a
+    # racing mis-typed registration raises instead of silently returning
+    # a metric of the wrong kind
+    with _LOCK:
+        m = _REGISTRY.get(name)
+        if m is not None:
+            if m.kind != kind or m.labelnames != tuple(labelnames):
+                raise ValueError(
+                    "metric %r already registered as %s%s"
+                    % (name, m.kind, m.labelnames))
+            return m
+        m = Metric(kind, name, help, labelnames, buckets)
+        _REGISTRY[name] = m
+    return m
+
+
+def counter(name, help="", labelnames=()):
+    """Get-or-create a monotonically increasing counter."""
+    return _register("counter", name, help, labelnames)
+
+
+def gauge(name, help="", labelnames=()):
+    """Get-or-create a gauge (set/inc/dec)."""
+    return _register("gauge", name, help, labelnames)
+
+
+def histogram(name, help="", labelnames=(), buckets=None):
+    """Get-or-create a histogram with fixed upper-bound buckets."""
+    return _register("histogram", name, help, labelnames, buckets)
+
+
+def get_metric(name):
+    """Look up a registered metric (None if absent)."""
+    return _REGISTRY.get(name)
+
+
+def reset():
+    """Zero every registered metric (registrations are kept)."""
+    for m in list(_REGISTRY.values()):
+        m._reset()
+
+
+# ---------------------------------------------------------------------------
+# timers
+# ---------------------------------------------------------------------------
+
+def _feed_profiler(name, start, dur):
+    """Land the span in the chrome trace when an xplane trace is live."""
+    from . import profiler
+
+    if profiler._state["running"]:
+        with profiler._events_lock:
+            profiler._state["events"].append(
+                {"name": name, "cat": "telemetry", "ts": start, "dur": dur})
+
+
+class span:
+    """Monotonic-clock timing context: observes ``<name>_seconds`` (or the
+    given histogram) and feeds a profiler event when a trace is live.
+
+    >>> with telemetry.span("train_step"):
+    ...     step()
+    """
+
+    __slots__ = ("name", "_hist", "_start")
+
+    def __init__(self, name, hist=None):
+        self.name = name
+        self._hist = hist
+        self._start = None
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self._start
+        if ENABLED:
+            hist = self._hist
+            if hist is None:
+                hist = histogram(self.name + "_seconds",
+                                 "duration of %s spans" % self.name)
+            hist.observe(dur)
+            _feed_profiler(self.name, self._start, dur)
+        return False
+
+
+def timed(name, hist=None):
+    """Decorator form of ``span``: time every call of fn."""
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not ENABLED:
+                return fn(*args, **kwargs)
+            with span(name, hist):
+                return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+def _labels_dict(metric, values):
+    return dict(zip(metric.labelnames, values))
+
+
+def snapshot():
+    """JSON-ready view: {name: {type, help, samples: [...]}}.
+
+    Counter/gauge samples: {"labels": {...}, "value": v}; histogram
+    samples: {"labels": {...}, "count": n, "sum": s, "buckets": {le: n}}
+    with cumulative bucket counts ("+Inf" last).
+    """
+    out = {}
+    for name, m in list(_REGISTRY.items()):
+        samples = []
+        for values, child in m._samples():
+            labels = _labels_dict(m, values)
+            if m.kind == "histogram":
+                count, total, cum = child.read()
+                samples.append({
+                    "labels": labels, "count": count, "sum": total,
+                    "buckets": {_fmt_le(ub): c for ub, c in cum}})
+            else:
+                samples.append({"labels": labels, "value": child.value})
+        out[name] = {"type": m.kind, "help": m.help, "samples": samples}
+    return out
+
+
+def totals(nonzero=False):
+    """Flat {name: summed value} over all label children; histograms
+    contribute ``<name>_count`` and ``<name>_sum``.  The compact form
+    bench rows and the periodic log line carry."""
+    out = {}
+    for name, m in list(_REGISTRY.items()):
+        if m.kind == "histogram":
+            reads = [c.read() for _, c in m._samples()]
+            out[name + "_count"] = sum(r[0] for r in reads)
+            out[name + "_sum"] = round(sum(r[1] for r in reads), 6)
+        else:
+            out[name] = sum(c.value for _, c in m._samples())
+    if nonzero:
+        out = {k: v for k, v in out.items() if v}
+    return out
+
+
+def value(name, labels=None):
+    """Sum of a counter/gauge's samples whose labels contain ``labels``."""
+    m = _REGISTRY.get(name)
+    if m is None:
+        return 0.0
+    want = {k: str(v) for k, v in (labels or {}).items()}
+    tot = 0.0
+    for values, child in m._samples():
+        have = _labels_dict(m, values)
+        if all(have.get(k) == v for k, v in want.items()):
+            tot += child.value if m.kind != "histogram" else child.count
+    return tot
+
+
+def dump(path):
+    """Write the JSON snapshot to ``path``; returns the path."""
+    with open(path, "w") as f:
+        json.dump({"time": time.time(), "enabled": ENABLED,
+                   "metrics": snapshot()}, f, indent=2, sort_keys=True)
+    return path
+
+
+def _fmt_le(ub):
+    return "+Inf" if ub == float("inf") else repr(float(ub))
+
+
+def _esc(v):
+    return str(v).replace("\\", r"\\").replace('"', r'\"') \
+        .replace("\n", r"\n")
+
+
+def _labelstr(metric, values, extra=()):
+    pairs = list(zip(metric.labelnames, values)) + list(extra)
+    if not pairs:
+        return ""
+    return "{%s}" % ",".join('%s="%s"' % (k, _esc(v)) for k, v in pairs)
+
+
+def prometheus():
+    """Prometheus text exposition format (one # HELP/# TYPE pair plus
+    sample lines per registered metric)."""
+    lines = []
+    for name, m in list(_REGISTRY.items()):
+        if m.help:
+            lines.append("# HELP %s %s" % (name, _esc(m.help)))
+        lines.append("# TYPE %s %s" % (name, m.kind))
+        for values, child in m._samples():
+            if m.kind == "histogram":
+                count, total, cum = child.read()
+                for ub, c in cum:
+                    lines.append("%s_bucket%s %d" % (
+                        name, _labelstr(m, values, [("le", _fmt_le(ub))]),
+                        c))
+                lines.append("%s_sum%s %s"
+                             % (name, _labelstr(m, values), repr(total)))
+                lines.append("%s_count%s %d"
+                             % (name, _labelstr(m, values), count))
+            else:
+                lines.append("%s%s %s" % (name, _labelstr(m, values),
+                                          repr(float(child.value))))
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# device-memory sampler
+# ---------------------------------------------------------------------------
+
+def sample_device_memory(device=None):
+    """Refresh ``device_memory_bytes`` gauges from profiler.memory_info()
+    (PJRT memory_stats; CPU backends report nothing).  Returns the raw
+    report for convenience."""
+    if not ENABLED:
+        return {}
+    from . import profiler
+
+    try:
+        report = profiler.memory_info(device)
+    except Exception:  # backend down: telemetry must never raise
+        return {}
+    for dev, stats in report.items():
+        for stat, v in stats.items():
+            DEVICE_MEMORY.labels(device=dev, stat=stat).set(v)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# periodic log line
+# ---------------------------------------------------------------------------
+
+_logger_started = False
+
+
+def log_line():
+    """One compact 'telemetry k=v ...' line over the nonzero totals."""
+    tot = totals(nonzero=True)
+    body = " ".join(
+        "%s=%s" % (k, ("%d" % v) if float(v).is_integer() else
+                   ("%.6g" % v))
+        for k, v in sorted(tot.items()))
+    return "telemetry " + (body or "(all zero)")
+
+
+def _log_loop(interval):
+    while True:
+        time.sleep(interval)
+        try:
+            if ENABLED:
+                sample_device_memory()
+                _LOGGER.info(log_line())
+        except Exception:  # noqa: BLE001 - the log thread must survive
+            _LOGGER.exception("telemetry log tick failed")
+
+
+def start_logger(interval=None):
+    """Start the periodic telemetry log thread (idempotent).  With no
+    argument, reads MXNET_TELEMETRY_LOG_INTERVAL (seconds; 0 = off)."""
+    global _logger_started
+    if interval is None:
+        interval = get_env("MXNET_TELEMETRY_LOG_INTERVAL", float, 0.0)
+    if not interval or interval <= 0 or _logger_started:
+        return False
+    t = threading.Thread(target=_log_loop, args=(float(interval),),
+                         daemon=True, name="mxnet-telemetry-log")
+    t.start()
+    _logger_started = True
+    return True
+
+
+# ---------------------------------------------------------------------------
+# canonical framework metrics (registered at import so every exporter
+# emits a stable, documented set — see README "Telemetry & observability")
+# ---------------------------------------------------------------------------
+
+CACHEDOP_BUILD = counter(
+    "cachedop_build_total",
+    "hybridize cache compiles (one jit trace per new signature)",
+    ("block",))
+CACHEDOP_HIT = counter(
+    "cachedop_hit_total", "hybridize cache hits", ("block",))
+CACHEDOP_RECOMPILE = counter(
+    "cachedop_recompile_total",
+    "cache builds that added a signature to an already-warm block "
+    "(shape/dtype/mode churn)", ("block",))
+CACHEDOP_BUILD_SECONDS = histogram(
+    "cachedop_build_seconds", "hybridize trace+compile latency")
+ENGINE_PUSH = counter(
+    "engine_push_total", "ops pushed through the engine facade")
+ENGINE_NAIVE_WAIT = counter(
+    "engine_naive_wait_total",
+    "blocking waits forced by NaiveEngine mode")
+TRANSFER_BYTES = counter(
+    "transfer_bytes_total", "host<->device transfer volume",
+    ("direction",))
+TRANSFER_D2H = TRANSFER_BYTES.labels(direction="d2h")
+TRANSFER_H2D = TRANSFER_BYTES.labels(direction="h2d")
+COLLECTIVE_CALLS = counter(
+    "collective_calls_total", "collective programs dispatched", ("op",))
+COLLECTIVE_BYTES = counter(
+    "collective_bytes_total", "bytes moved by collectives", ("op",))
+COLLECTIVE_SECONDS = histogram(
+    "collective_seconds", "collective dispatch+assembly latency")
+DATALOADER_WAIT_SECONDS = histogram(
+    "dataloader_batch_wait_seconds",
+    "time the training loop blocked waiting for the next batch")
+DEVICE_MEMORY = gauge(
+    "device_memory_bytes", "PJRT device memory stats "
+    "(sample_device_memory refreshes)", ("device", "stat"))
+
+start_logger()

@@ -72,6 +72,17 @@ def test_error_classes_dual_catch():
     assert "nd.foo" in str(err)
 
 
+def test_runtime_telemetry_feature_enabled():
+    """The TELEMETRY feature flag must track the shipped subsystem (so it
+    can't silently drift out of runtime feature detection)."""
+    from mxnet_tpu import runtime, telemetry
+
+    assert runtime.features.is_enabled("TELEMETRY")
+    assert any(f.name == "TELEMETRY" and f.enabled
+               for f in runtime.feature_list())
+    assert mx.telemetry is telemetry  # exposed as mx.telemetry
+
+
 def test_lr_scheduler_top_level_alias():
     sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5,
                                             base_lr=1.0)

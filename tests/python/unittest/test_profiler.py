@@ -50,6 +50,36 @@ def test_profiler_spans_counters_markers():
     assert "t" in table and "Calls" in table
 
 
+def test_counter_explicit_zero_kept_distinct_from_unset():
+    dom = profiler.Domain("d")
+    # unset -> int 0; explicit 0.0 must stay a float 0.0 (the old
+    # `value or 0` collapsed it to int 0), explicit 5 stays 5
+    assert profiler.Counter(dom, "unset").value == 0
+    c0 = profiler.Counter(dom, "zero_f", 0.0)
+    assert c0.value == 0.0 and isinstance(c0.value, float)
+    c1 = profiler.Counter(dom, "zero_i", 0)
+    assert c1.value == 0 and isinstance(c1.value, int)
+    assert profiler.Counter(dom, "five", 5).value == 5
+
+
+def test_counter_thread_safe_increments():
+    import threading
+
+    c = profiler.Counter(profiler.Domain("d"), "concurrent", 0)
+    n, per = 8, 200
+
+    def bump():
+        for _ in range(per):
+            c.increment()
+
+    threads = [threading.Thread(target=bump) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n * per
+
+
 def test_profiler_invalid_state():
     with pytest.raises(mx.MXNetError):
         profiler.set_state("bogus")

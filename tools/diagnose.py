@@ -111,6 +111,21 @@ def framework_info(device_check=True):
               "retry with JAX_PLATFORMS=cpu")
 
 
+def telemetry_info():
+    """Live mx.telemetry snapshot (counters accumulated by this process —
+    the matmul smoke and import path already populate transfer/engine
+    metrics), plus a fresh device-memory sample."""
+    section("Telemetry")
+    import json
+
+    from mxnet_tpu import telemetry
+
+    telemetry.sample_device_memory()
+    print("enabled      :", telemetry.ENABLED)
+    print(json.dumps(telemetry.snapshot(), indent=2, sort_keys=True))
+    print("totals       :", telemetry.totals(nonzero=True))
+
+
 def env_info():
     section("Environment")
     from mxnet_tpu import config
@@ -129,11 +144,15 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--no-device-check", action="store_true",
                     help="skip the on-device matmul smoke")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="print the live mx.telemetry snapshot")
     args = ap.parse_args()
     python_info()
     platform_info()
     deps_info()
     framework_info(device_check=not args.no_device_check)
+    if args.telemetry:
+        telemetry_info()
     env_info()
     print()
 
