@@ -9,7 +9,7 @@ LDLIBS ?= -ljpeg -lz
 SRCS := $(wildcard src/native/*.cc)
 SO := build/libmxtpu_native.so
 
-.PHONY: native test cpptest telemetry-smoke clean
+.PHONY: native test cpptest telemetry-smoke checkpoint-smoke clean
 
 native: $(SO)
 
@@ -36,6 +36,15 @@ telemetry-smoke:
 	JAX_PLATFORMS=cpu python -m pytest \
 	  tests/python/unittest/test_telemetry.py \
 	  tests/python/unittest/test_profiler.py -q -m 'not slow'
+
+# mx.checkpoint crash-consistency smoke: save -> corrupt one shard ->
+# validate flags + quarantines it -> restore falls back to the previous
+# good step; then the full pytest suite for the subsystem
+checkpoint-smoke:
+	JAX_PLATFORMS=cpu python tools/checkpoint_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/python/unittest/test_checkpoint.py \
+	  tests/python/unittest/test_elastic.py -q -m 'not slow'
 
 # suite summary artifact (TESTS_r{N}.json) — round-2 advisor contract
 test-report:

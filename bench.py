@@ -115,6 +115,15 @@ def _probe_backend(timeout_s=None):
 def _telemetry_totals():
     """Nonzero telemetry totals, or {} when the runtime can't import (a
     wedged backend must not take the fail-soft path down with it)."""
+    import sys
+
+    # never trigger the FIRST mxnet_tpu import here: on the dead-backend
+    # path the probe thread may be wedged inside jax/PJRT init, and a
+    # fresh import would block on the same locks (a hang, which the
+    # except below cannot catch).  If the package was never imported,
+    # its registry holds no samples anyway.
+    if "mxnet_tpu" not in sys.modules:
+        return {}
     try:
         from mxnet_tpu import telemetry
 

@@ -68,24 +68,36 @@ class ProgressBar:
 
 
 def do_checkpoint(prefix, period=1):
-    """Epoch-end callback saving block parameters [callback.py:38]: works
-    with anything exposing ``save_parameters`` (gluon) or
-    ``save_checkpoint``."""
+    """Epoch-end callback routing through ``mx.checkpoint``
+    [callback.py:38].  Blocks keep the classic ``<prefix>-NNNN.params``
+    file (now committed via the subsystem's atomic-file path, so a
+    crash mid-save can't truncate the previous epoch); targets exposing
+    ``save_checkpoint`` but not ``save_parameters`` — ``gluon.Trainer``,
+    ``parallel.FusedTrainer`` — get a sharded, crash-consistent
+    checkpoint step under ``<prefix>-ckpt/`` instead (params +
+    optimizer state + step in one atomic unit)."""
     period = int(max(1, period))
 
     def _callback(epoch, sym=None, arg=None, aux=None):
         if (epoch + 1) % period != 0:
             return
         target = sym if sym is not None else arg
-        fname = "%s-%04d.params" % (prefix, epoch + 1)
         if hasattr(target, "save_parameters"):
+            fname = "%s-%04d.params" % (prefix, epoch + 1)
             target.save_parameters(fname)
+        elif hasattr(target, "save_checkpoint"):
+            # max_keep=None: keep every epoch, matching the historical
+            # one-file-per-epoch behavior of the .params branch
+            fname = target.save_checkpoint("%s-ckpt" % prefix,
+                                           step=epoch + 1, max_keep=None)
         elif hasattr(target, "save"):
+            fname = "%s-%04d.params" % (prefix, epoch + 1)
             target.save(fname)
         else:
             raise MXNetError(
-                "do_checkpoint: %r has neither save_parameters nor save — "
-                "nothing was written" % (type(target).__name__,))
+                "do_checkpoint: %r has none of save_parameters/"
+                "save_checkpoint/save — nothing was written"
+                % (type(target).__name__,))
         logging.info("Saved checkpoint to \"%s\"", fname)
 
     return _callback

@@ -1,0 +1,43 @@
+"""mx.checkpoint — async, sharded, crash-consistent checkpointing.
+
+The single persistence layer of the stack (ROADMAP: survive preemption
+on TPU pods).  One ``CheckpointManager`` front-end gives you:
+
+- **async saves** — ``save_async(step, tree)`` pays only the
+  device->host snapshot on the training thread; serialize + fsync +
+  atomic publish run on a background writer with bounded in-flight
+  saves.  ``SaveFuture.result()`` / ``manager.wait()`` join.
+- **sharded layout** — one ``.npy`` per large leaf, small leaves
+  bundled into shard-group ``.npz`` files, all described by a JSON
+  manifest (tree spec, shapes, dtypes, per-file CRC32, step, framework
+  version) so restores can read subsets (``load_leaves``).
+- **crash consistency** — write-to-temp + per-file fsync + a
+  ``COMMITTED`` marker + atomic rename; overwrites park the old dir at
+  ``*.prev`` until the new one is published; transient I/O errors are
+  retried with backoff; ``validate()`` checksums every shard and can
+  quarantine torn/corrupt directories.
+- **retention + resharding** — ``max_keep`` rolling GC with
+  ``keep_every`` pinning, ``latest_step()``, and ``restore()`` that
+  places leaves onto the caller's CURRENT ctx/mesh sharding
+  (replica-count changes between save and restore are fine).
+
+Entry points elsewhere in the stack route here:
+``gluon.Trainer.save_checkpoint``/``load_checkpoint`` (params +
+optimizer state + step in one atomic unit),
+``gluon.Block.save_checkpoint``, ``parallel.FusedTrainer
+.save_checkpoint``, ``callback.do_checkpoint``, and the
+``mxnet_tpu.elastic`` manager (now a thin shim).  Every save/restore
+emits ``mx.telemetry`` metrics (``checkpoint_*``).
+"""
+from __future__ import annotations
+
+from .layout import (COMMITTED, DEFAULT_GROUP_BYTES, FORMAT, MANIFEST,
+                     atomic_file, leaf_paths, tree_from_spec, tree_spec)
+from .manager import CheckpointManager, cached_manager
+from .writer import AsyncWriter, SaveFuture
+
+__all__ = [
+    "CheckpointManager", "SaveFuture", "AsyncWriter", "cached_manager",
+    "tree_spec", "tree_from_spec", "leaf_paths", "atomic_file",
+    "FORMAT", "MANIFEST", "COMMITTED", "DEFAULT_GROUP_BYTES",
+]

@@ -1,0 +1,311 @@
+"""On-disk layout for mx.checkpoint — sharded leaves + JSON manifest.
+
+A committed checkpoint directory looks like::
+
+    ckpt-00000042/
+        MANIFEST.json     # tree spec, per-leaf + per-file metadata
+        COMMITTED         # two-phase marker, written LAST (fsync'd)
+        leaf_00000.npy    # one file per large leaf ...
+        group_0000.npz    # ... small leaves bundled per shard-group
+
+The manifest carries everything needed to restore without a live
+template (tree spec, dtypes, shapes), to verify integrity (per-file
+CRC32 + byte sizes), and to audit provenance (step, wall time,
+framework version).  A directory WITHOUT the ``COMMITTED`` marker is
+torn by definition and never trusted — the marker is only ever written
+after every data file and the manifest have been fsync'd.
+
+Tree handling mirrors ``jax.tree_util`` flatten order (dicts in sorted
+key order, tuples/lists positionally, ``None`` contributes no leaf) so
+leaves serialized from ``jax.tree_util.tree_leaves`` re-enter a
+template tree via ``tree_unflatten`` unchanged.
+"""
+from __future__ import annotations
+
+import json
+import os
+import zlib
+
+import numpy as _np
+
+MANIFEST = "MANIFEST.json"
+COMMITTED = "COMMITTED"
+FORMAT = "mx-checkpoint-v1"
+
+# probed ONCE at import (single-threaded under the import lock): the
+# os.umask(0)/restore dance is a process-global race if done per call
+_UMASK = os.umask(0)
+os.umask(_UMASK)
+
+# leaves smaller than this are bundled into a shard-group .npz so a
+# million tiny biases don't become a million files; larger leaves get a
+# private .npy so partial restore never reads more than it needs
+DEFAULT_GROUP_BYTES = 1 << 20
+
+
+# ---------------------------------------------------------------------------
+# tree spec (structure without code objects — JSON-serializable)
+# ---------------------------------------------------------------------------
+
+def tree_spec(tree):
+    """JSON-serializable structure of a pytree of dict/list/tuple/None/
+    leaves.  Dict keys are recorded in sorted order to match jax's
+    flatten order; ``None`` is structure (no leaf), like jax."""
+    if tree is None:
+        return {"t": "none"}
+    if isinstance(tree, dict):
+        keys = sorted(tree.keys())
+        return {"t": "dict", "k": keys,
+                "v": [tree_spec(tree[k]) for k in keys]}
+    if isinstance(tree, tuple):
+        return {"t": "tuple", "v": [tree_spec(v) for v in tree]}
+    if isinstance(tree, list):
+        return {"t": "list", "v": [tree_spec(v) for v in tree]}
+    return {"t": "leaf"}
+
+
+def tree_from_spec(spec, leaves_iter):
+    """Rebuild a tree from its spec, drawing leaves in flatten order."""
+    t = spec["t"]
+    if t == "none":
+        return None
+    if t == "dict":
+        return {k: tree_from_spec(v, leaves_iter)
+                for k, v in zip(spec["k"], spec["v"])}
+    if t == "tuple":
+        return tuple(tree_from_spec(v, leaves_iter) for v in spec["v"])
+    if t == "list":
+        return [tree_from_spec(v, leaves_iter) for v in spec["v"]]
+    return next(leaves_iter)
+
+
+def leaf_paths(spec, prefix=""):
+    """Human-readable '/'-joined path per leaf, in flatten order —
+    these name the leaves in the manifest and drive partial restore."""
+    t = spec["t"]
+    if t == "leaf":
+        return [prefix or "."]
+    if t == "none":
+        return []
+    out = []
+    if t == "dict":
+        for k, sub in zip(spec["k"], spec["v"]):
+            # escape separator chars so a flat key containing '/' can't
+            # collide with a genuinely nested path in the manifest
+            k = str(k).replace("\\", "\\\\").replace("/", "\\/")
+            p = "%s/%s" % (prefix, k) if prefix else k
+            out.extend(leaf_paths(sub, p))
+    else:  # tuple / list
+        for i, sub in enumerate(spec["v"]):
+            p = "%s/%d" % (prefix, i) if prefix else str(i)
+            out.extend(leaf_paths(sub, p))
+    return out
+
+
+def n_leaves(spec):
+    t = spec["t"]
+    if t == "leaf":
+        return 1
+    if t == "none":
+        return 0
+    return sum(n_leaves(v) for v in spec["v"])
+
+
+def snapshot_leaf(leaf):
+    """Device -> host COPY of one leaf (the only work an async save does
+    on the critical path).  Handles jax arrays, mx NDArray, numpy and
+    python scalars.
+
+    The result must never alias caller-visible memory: ``np.asarray``
+    is zero-copy for numpy inputs AND for CPU jax arrays, so without a
+    copy an async snapshot would alias live training memory — the fused
+    step's donated params/opt_state buffers get reused by XLA while the
+    background writer is still serializing them, and the checksum would
+    bless the corrupted bytes.  When the device transfer already
+    produced a fresh owning host array (TPU ``device_get``), that copy
+    suffices — don't pay a second one on the critical path."""
+    src = leaf.asnumpy() if hasattr(leaf, "asnumpy") else leaf
+    host = _np.asarray(src)
+    if host is leaf or host.base is not None or not host.flags.owndata:
+        host = _np.array(host, copy=True)
+    return host
+
+
+# ---------------------------------------------------------------------------
+# durable file primitives
+# ---------------------------------------------------------------------------
+
+def fsync_dir(path):
+    """fsync a directory so renames/creates inside it are durable."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_file_durable(path, data):
+    """Write bytes + fsync; returns (crc32, nbytes)."""
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    return zlib.crc32(data) & 0xFFFFFFFF, len(data)
+
+
+def write_stream_durable(path, writer):
+    """Stream ``writer(fileobj)`` into ``path`` + fsync, then CRC what
+    actually landed on disk (O(chunk) memory — no serialized copy of
+    the payload is ever held in RAM).  Returns (crc32, nbytes)."""
+    with open(path, "wb") as f:
+        writer(f)
+        f.flush()
+        os.fsync(f.fileno())
+    return file_crc32(path), os.path.getsize(path)
+
+
+def atomic_file(path, data):
+    """Crash-consistent single-file write: temp + fsync + atomic rename.
+    The shared primitive behind ``nd.save``/``Block.save_parameters`` —
+    a crash mid-write never truncates an existing file at ``path``.
+
+    ``data`` is either bytes or a callable ``writer(fileobj)`` that
+    streams directly into the temp file (no full in-memory copy for
+    multi-GB payloads).  The temp name comes from ``mkstemp``, so
+    concurrent saves to the same path from multiple threads/processes
+    never share a temp file.  A symlink destination is resolved first
+    so the TARGET is replaced (readers of the real file see the
+    update); FIFOs/device files are not supported."""
+    import tempfile
+
+    # rename-over-a-symlink would replace the link, not its target
+    path = os.path.realpath(os.fspath(path))
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(
+        dir=d, prefix=".%s.tmp-" % os.path.basename(path))
+    try:
+        # mkstemp creates 0600; restore the umask-honoring mode a plain
+        # open() would have produced so shared readers keep working
+        os.fchmod(fd, 0o666 & ~_UMASK)
+        with os.fdopen(fd, "wb") as f:
+            if callable(data):
+                data(f)
+            else:
+                f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, path)
+        fsync_dir(d)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _sweep_stale_tmp(d, os.path.basename(path))
+    return path
+
+
+def _sweep_stale_tmp(d, basename, max_age=3600.0):
+    """Best-effort removal of orphan ``.{basename}.tmp-*`` files a
+    crashed earlier save left behind (mirrors the checkpoint dirs'
+    ``.saving-*`` recovery; fresh temps may belong to a live writer)."""
+    import time
+
+    prefix = ".%s.tmp-" % basename
+    try:
+        now = time.time()
+        for name in os.listdir(d):
+            if not name.startswith(prefix):
+                continue
+            p = os.path.join(d, name)
+            try:
+                if now - os.path.getmtime(p) > max_age:
+                    os.unlink(p)
+            except OSError:
+                pass
+    except OSError:
+        pass
+
+
+def file_crc32(path, chunk=1 << 20):
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            crc = zlib.crc32(b, crc)
+    return crc & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# manifest build / plan
+# ---------------------------------------------------------------------------
+
+def plan_shards(host_leaves, group_bytes=DEFAULT_GROUP_BYTES):
+    """Assign each leaf to a file: big leaves get a private .npy,
+    consecutive small leaves share a group .npz capped at roughly
+    ``group_bytes`` each.  Returns (leaf_entries, shard_writers) where
+    leaf_entries[i] = {file, key?} and shard_writers = [(fname,
+    writer)] with ``writer(fileobj)`` STREAMING the shard — no
+    serialized copy of a leaf is ever held in memory."""
+    entries = [None] * len(host_leaves)
+    writers = []
+    group, group_idx = {}, []
+    group_size = 0
+    n_groups = 0
+
+    def _npy_writer(arr):
+        return lambda f: _np.save(f, arr, allow_pickle=False)
+
+    def _npz_writer(named):
+        return lambda f: _np.savez(f, **named)
+
+    def flush_group():
+        nonlocal group, group_idx, group_size, n_groups
+        if not group:
+            return
+        fname = "group_%04d.npz" % n_groups
+        n_groups += 1
+        writers.append((fname, _npz_writer(group)))
+        for i in group_idx:
+            entries[i]["file"] = fname
+        group, group_idx = {}, []
+        group_size = 0
+
+    for i, arr in enumerate(host_leaves):
+        if arr.nbytes >= group_bytes:
+            fname = "leaf_%05d.npy" % i
+            writers.append((fname, _npy_writer(arr)))
+            entries[i] = {"file": fname}
+        else:
+            if group and group_size + arr.nbytes > group_bytes:
+                flush_group()
+            entries[i] = {"key": "l%d" % i}  # file filled at flush
+            group["l%d" % i] = arr
+            group_idx.append(i)
+            group_size += arr.nbytes
+    flush_group()
+    return entries, writers
+
+
+def build_manifest(step, spec, host_leaves, shard_entries, file_meta,
+                   version, extra=None):
+    import time
+
+    names = leaf_paths(spec)
+    leaves = []
+    for i, arr in enumerate(host_leaves):
+        e = dict(shard_entries[i])
+        e.update({"name": names[i] if i < len(names) else "leaf_%d" % i,
+                  "shape": list(arr.shape), "dtype": str(arr.dtype),
+                  "nbytes": int(arr.nbytes)})
+        leaves.append(e)
+    m = {"format": FORMAT, "framework_version": version,
+         "step": int(step), "time": time.time(),
+         "n_leaves": len(host_leaves), "spec": spec,
+         "leaves": leaves, "files": file_meta}
+    if extra:
+        m.update(extra)
+    return m

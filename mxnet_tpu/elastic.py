@@ -22,15 +22,11 @@ resume half replaces the manual CheckpointHandler restart
 """
 from __future__ import annotations
 
-import json
-import os
-import shutil
-import tempfile
-import time
-
 import numpy as _np
 
 from .base import MXNetError
+from .checkpoint import CheckpointManager as _CheckpointManager
+from .checkpoint.layout import tree_from_spec, tree_spec
 
 __all__ = ["device_health_check", "CheckpointManager",
            "FaultTolerantRunner"]
@@ -57,132 +53,30 @@ def device_health_check(timeout_ok=True):
     return report
 
 
-def _tree_spec(tree):
-    """JSON-serializable structure of a pytree of dict/list/tuple/arrays
-    (enough to rebuild without a live template — the fresh-process resume
-    path has no trainer state yet)."""
-    if isinstance(tree, dict):
-        # jax flattens dicts in SORTED key order — the spec must match or
-        # leaves land in the wrong slots on restore
-        keys = sorted(tree.keys())
-        return {"t": "dict", "k": keys,
-                "v": [_tree_spec(tree[k]) for k in keys]}
-    if isinstance(tree, tuple):
-        return {"t": "tuple", "v": [_tree_spec(v) for v in tree]}
-    if isinstance(tree, list):
-        return {"t": "list", "v": [_tree_spec(v) for v in tree]}
-    return {"t": "leaf"}
+# compat aliases: the pytree structure codec moved to mx.checkpoint
+_tree_spec = tree_spec
+_tree_from_spec = tree_from_spec
 
 
-def _tree_from_spec(spec, leaves_iter):
-    t = spec["t"]
-    if t == "dict":
-        return {k: _tree_from_spec(v, leaves_iter)
-                for k, v in zip(spec["k"], spec["v"])}
-    if t == "tuple":
-        return tuple(_tree_from_spec(v, leaves_iter) for v in spec["v"])
-    if t == "list":
-        return [_tree_from_spec(v, leaves_iter) for v in spec["v"]]
-    return next(leaves_iter)
+class CheckpointManager(_CheckpointManager):
+    """Compat shim over ``mx.checkpoint.CheckpointManager`` (the old
+    elastic manager's API, the new subsystem's machinery).
 
-
-class CheckpointManager:
-    """Step-tagged rolling checkpoints of a jax pytree.
-
-    Atomic: each checkpoint is written to a temp dir and renamed into
-    place, so a crash mid-save never corrupts the latest good state.
-    Leaves are stored positionally (flatten order is deterministic for a
-    fixed tree structure); ``restore`` rebuilds using the caller's
-    template tree, so no pickling of code objects is involved.
+    Inherits the two-phase COMMITTED commit (the old implementation's
+    rmtree-before-rename crash window is closed: an overwrite parks the
+    previous copy at ``*.prev`` until the new one is published),
+    sharded manifests with per-file checksums, async ``save_async``/
+    ``wait``, ``validate``/quarantine, and torn-directory-aware
+    ``steps()``/``latest_step()``.  Checkpoints written by the old
+    manager (``leaves.npz`` + ``meta.json``) still restore.  New code
+    should use ``mx.checkpoint`` directly.
     """
 
-    def __init__(self, root, max_keep=3, prefix="ckpt"):
-        self._root = root
-        self._max_keep = int(max_keep)
-        self._prefix = prefix
-        os.makedirs(root, exist_ok=True)
-
-    def _dir_for(self, step):
-        return os.path.join(self._root, "%s-%08d" % (self._prefix, step))
-
-    def save(self, step, tree):
-        import jax
-
-        leaves = jax.tree_util.tree_leaves(tree)
-        tmp = tempfile.mkdtemp(dir=self._root, prefix=".saving-")
-        try:
-            arrays = {"leaf_%d" % i: _np.asarray(v)
-                      for i, v in enumerate(leaves)}
-            with open(os.path.join(tmp, "leaves.npz"), "wb") as f:
-                _np.savez(f, **arrays)
-            with open(os.path.join(tmp, "meta.json"), "w") as f:
-                json.dump({"step": int(step), "n_leaves": len(leaves),
-                           "spec": _tree_spec(tree),
-                           "time": time.time()}, f)
-            final = self._dir_for(step)
-            if os.path.exists(final):
-                shutil.rmtree(final)
-            os.rename(tmp, final)
-        except Exception:
-            shutil.rmtree(tmp, ignore_errors=True)
-            raise
-        self._gc()
-        return self._dir_for(step)
-
-    def _gc(self):
-        steps = self.steps()
-        for s in steps[:-self._max_keep]:
-            shutil.rmtree(self._dir_for(s), ignore_errors=True)
-
-    def steps(self):
-        out = []
-        for name in os.listdir(self._root):
-            if name.startswith(self._prefix + "-"):
-                try:
-                    out.append(int(name.rsplit("-", 1)[1]))
-                except ValueError:
-                    pass
-        return sorted(out)
-
-    def latest_step(self):
-        steps = self.steps()
-        return steps[-1] if steps else None
-
-    def restore(self, template_tree=None, step=None):
-        """Load checkpoint ``step`` (default latest).  With a
-        ``template_tree`` the leaves keep the template's dtypes; without
-        one (fresh-process resume) the structure is rebuilt from the
-        spec stored inside the checkpoint.  Returns (step, tree)."""
-        import jax
-        import jax.numpy as jnp
-
-        step = self.latest_step() if step is None else step
-        if step is None:
-            raise MXNetError("no checkpoints in %s" % self._root)
-        d = self._dir_for(step)
-        with _np.load(os.path.join(d, "leaves.npz")) as npz:
-            leaves = [npz["leaf_%d" % i] for i in range(len(npz.files))]
-        if template_tree is None:
-            with open(os.path.join(d, "meta.json")) as f:
-                meta = json.load(f)
-            spec = meta.get("spec")
-            if spec is None:
-                raise MXNetError(
-                    "checkpoint at step %d predates structure specs; pass "
-                    "a template_tree" % step)
-            it = iter(jnp.asarray(v) for v in leaves)
-            return step, _tree_from_spec(spec, it)
-        treedef = jax.tree_util.tree_structure(template_tree)
-        if treedef.num_leaves != len(leaves):
-            raise MXNetError(
-                "checkpoint at step %d has %d leaves, template has %d — "
-                "the model/optimizer structure changed" %
-                (step, len(leaves), treedef.num_leaves))
-        tmpl_leaves = jax.tree_util.tree_leaves(template_tree)
-        new_leaves = [jnp.asarray(v, t.dtype if hasattr(t, "dtype") else
-                                  None)
-                      for v, t in zip(leaves, tmpl_leaves)]
-        return step, jax.tree_util.tree_unflatten(treedef, new_leaves)
+    # the override exists to keep the OLD positional order
+    # (root, max_keep, prefix) — the parent inserts keep_every between
+    # them; new kwargs still pass through
+    def __init__(self, root, max_keep=3, prefix="ckpt", **kwargs):
+        super().__init__(root, max_keep=max_keep, prefix=prefix, **kwargs)
 
 
 class FaultTolerantRunner:

@@ -213,42 +213,106 @@ class Block:
         return self
 
     # ---- persistence (reference block.py:340 save_parameters) ------------
-    def save_parameters(self, filename, deduplicate=False):
-        from .. import ndarray as nd
-
-        arg_dict = {}
-        seen = {}
+    def _initialized_params(self, deduplicate):
+        """{name: param} for initialized params; with ``deduplicate``,
+        tied params (one Parameter under several names) appear once.
+        The single serialization contract behind save_parameters AND
+        save_checkpoint."""
+        out, seen = {}, set()
         for name, param in self.collect_params().items():
             if param._data is None:
                 continue
             if deduplicate and id(param) in seen:
                 continue
-            seen[id(param)] = name
-            arg_dict[name] = param.data()
-        nd.save(filename, arg_dict)
+            seen.add(id(param))
+            out[name] = param
+        return out
+
+    def save_parameters(self, filename, deduplicate=False):
+        from .. import ndarray as nd
+
+        arg_dict = {name: p.data() for name, p in
+                    self._initialized_params(deduplicate).items()}
+        nd.save(filename, arg_dict)  # atomic via mx.checkpoint
+
+    def _apply_loaded(self, loaded, source, ctx, allow_missing,
+                      ignore_extra, require_all):
+        """Place loaded arrays into this block's parameters — the one
+        restore loop behind load_parameters AND load_checkpoint.  Tied
+        params restored under one name satisfy their aliases.  With
+        ``require_all`` every (non-aliased) name must be present; else
+        only initialized params are required (checkpoints skip
+        deferred-init params on save)."""
+        params = self.collect_params()
+        restored = set()
+        for name, param in params.items():
+            if name not in loaded:
+                continue
+            if param._needs_shape():
+                param.shape = loaded[name].shape
+            if param._data is None and param._deferred_init is None:
+                param.initialize(ctx=ctx)
+            param.set_data(loaded[name])
+            restored.add(id(param))
+        if not allow_missing:
+            for name, param in params.items():
+                if name in loaded or id(param) in restored:
+                    continue
+                if require_all or param._data is not None:
+                    raise MXNetError("Parameter %s missing in %s"
+                                     % (name, source))
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise MXNetError("%s has extra parameters: %s"
+                                 % (source, sorted(extra)))
 
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False,
                         dtype_source="current"):
         from .. import ndarray as nd
 
-        loaded = nd.load(filename)
-        params = self.collect_params()
-        for name, param in params.items():
-            if name in loaded:
-                if param._needs_shape():
-                    param.shape = loaded[name].shape
-                if param._data is None and param._deferred_init is None:
-                    param.initialize(ctx=ctx)
-                param.set_data(loaded[name])
-            elif not allow_missing:
-                raise MXNetError("Parameter %s missing in file %s"
-                                 % (name, filename))
-        if not ignore_extra:
-            extra = set(loaded) - set(params)
-            if extra:
-                raise MXNetError("file %s has extra parameters: %s"
-                                 % (filename, sorted(extra)))
+        self._apply_loaded(nd.load(filename), "file %s" % filename,
+                           ctx, allow_missing, ignore_extra,
+                           require_all=True)
+
+    def _checkpoint_manager(self, root, **manager_kwargs):
+        from ..checkpoint import cached_manager
+
+        return cached_manager(self, root, **manager_kwargs)
+
+    def save_checkpoint(self, root, step=0, **manager_kwargs):
+        """Save this block's parameters as a sharded, crash-consistent
+        ``mx.checkpoint`` step under directory ``root`` (manifest +
+        checksums + COMMITTED marker; see mx.checkpoint).  Returns the
+        committed directory."""
+        params = {name: p.data() for name, p in
+                  self._initialized_params(deduplicate=True).items()}
+        if self.collect_params() and not params:
+            raise MXNetError(
+                "save_checkpoint: no parameter is initialized yet — a "
+                "zero-leaf checkpoint would restore nothing; run a "
+                "forward pass (or pass static shapes) first")
+        mgr = self._checkpoint_manager(root, **manager_kwargs)
+        return mgr.save(step, params)
+
+    def load_checkpoint(self, root, step=None, ctx=None,
+                        allow_missing=False, ignore_extra=False):
+        """Restore parameters from a ``save_checkpoint`` directory
+        (default: latest committed step).  Returns the restored step."""
+        mgr = self._checkpoint_manager(root)
+        step, loaded = mgr.restore(step=step, ctx=ctx)
+        if self.collect_params() and not loaded:
+            raise MXNetError(
+                "load_checkpoint: step %d of %s contains no parameters "
+                "— restoring it would silently keep the random init"
+                % (step, root))
+        # require_all=False: save_checkpoint skips deferred-init params,
+        # so a param uninitialized on BOTH sides is not an error
+        self._apply_loaded(loaded, "checkpoint %s" % root, ctx,
+                           allow_missing, ignore_extra,
+                           require_all=False)
+        return step
 
     def load_dict(self, param_dict, ctx=None, allow_missing=False,
                   ignore_extra=False):

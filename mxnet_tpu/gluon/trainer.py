@@ -51,6 +51,7 @@ class Trainer:
         self._update_on_kvstore = update_on_kvstore
         self._kv_initialized = False
         self._states = {}
+        self._step_count = 0
         self._params_to_init = list(self._params)
         self._zero = zero
         self._zero_mesh = mesh
@@ -239,6 +240,7 @@ class Trainer:
             else:
                 self._optimizer.update_multi_precision(
                     i, param.data(), grad, self._states[i])
+        self._step_count += 1
 
     # ---- persistence ------------------------------------------------------
     def save_states(self, fname):
@@ -271,3 +273,112 @@ class Trainer:
             # memory contract after checkpoint resume
             self._states = {k: self._shard_state(v)
                             for k, v in self._states.items()}
+
+    # ---- mx.checkpoint integration ----------------------------------------
+    @property
+    def step_count(self):
+        """Optimizer updates applied so far (persisted by
+        ``save_checkpoint``)."""
+        return self._step_count
+
+    def _checkpoint_manager(self, root, **manager_kwargs):
+        from ..checkpoint import cached_manager
+
+        return cached_manager(self, root, **manager_kwargs)
+
+    def save_checkpoint(self, root, step=None, **manager_kwargs):
+        """Save parameters + optimizer state + step counter as ONE
+        atomic ``mx.checkpoint`` unit under ``root`` (default step tag:
+        the trainer's own update count).  Crash-consistent: a save that
+        dies mid-write never corrupts the previous checkpoint.  Extra
+        kwargs (``max_keep``, ``keep_every``, ...) configure the
+        manager.  Returns the committed directory."""
+        from ..optimizer.optimizer import _state_np
+
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError(
+                "save_checkpoint: optimizer state lives on the kvstore "
+                "when update_on_kvstore=True; use save_states/load_states")
+        step = self._step_count if step is None else int(step)
+        opt = self._optimizer
+        # states/counts are keyed by PARAMETER NAME, not positional
+        # index: a restoring trainer built with a different param
+        # insertion order must not attach moments to the wrong weights
+        names = [str(n) for n in self._param_names]
+        tree = {"params": {names[i]: p.data()
+                           for i, p in enumerate(self._params)
+                           if p._data is not None},
+                "states": {names[i]: _state_np(s)
+                           for i, s in self._states.items()},
+                # per-param update counts drive Adam-style bias
+                # correction — losing them skews the first resumed steps
+                "updates": {"num_update": int(opt.num_update),
+                            "counts": {names[i]: int(c) for i, c in
+                                       opt._index_update_count.items()
+                                       if i < len(names)}},
+                # the TRUE update counter, independent of the caller's
+                # directory tag (do_checkpoint tags by epoch)
+                "step": self._step_count}
+        mgr = self._checkpoint_manager(root, **manager_kwargs)
+        return mgr.save(step, tree)
+
+    def load_checkpoint(self, root, step=None):
+        """Restore a ``save_checkpoint`` bundle (default: latest step).
+        Parameters are written back into the live Parameter objects,
+        optimizer state is rebuilt (re-sharded under ZeRO), and the
+        step counter resumes.  Returns the restored step."""
+        import jax.numpy as jnp
+
+        from ..ndarray.ndarray import NDArray
+
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError(
+                "load_checkpoint: optimizer state lives on the kvstore "
+                "when update_on_kvstore=True; use save_states/load_states")
+        mgr = self._checkpoint_manager(root)
+        step, tree = mgr.restore(step=step)
+        loaded = tree["params"]
+        for n, param in zip(self._param_names, self._params):
+            key = str(n)
+            if key in loaded:
+                param.set_data(loaded[key])
+            elif param._data is not None:
+                raise MXNetError(
+                    "checkpoint at %s step %d is missing parameter %r"
+                    % (root, step, key))
+
+        def _to_nd(state):
+            if state is None:
+                return None
+            if isinstance(state, tuple):
+                return tuple(_to_nd(s) for s in state)
+            if isinstance(state, list):
+                return [_to_nd(s) for s in state]
+            if isinstance(state, dict):
+                return {k: _to_nd(v) for k, v in state.items()}
+            return NDArray(jnp.asarray(state))
+
+        index_of = {str(n): i for i, n in enumerate(self._param_names)}
+        unknown = [k for k in tree["states"] if k not in index_of]
+        if unknown:
+            raise MXNetError(
+                "checkpoint at %s step %d has optimizer state for "
+                "unknown parameter(s) %s — the model structure changed"
+                % (root, step, sorted(unknown)))
+        self._states = {index_of[k]: _to_nd(v)
+                        for k, v in tree["states"].items()}
+        updates = tree.get("updates")
+        if updates is not None:
+            self._optimizer.num_update = int(updates["num_update"])
+            self._optimizer._index_update_count = {
+                index_of[k]: int(v)
+                for k, v in updates["counts"].items() if k in index_of}
+        if self._zero:
+            self._states = {k: self._shard_state(v)
+                            for k, v in self._states.items()}
+        self._step_count = int(tree["step"])
+        return step

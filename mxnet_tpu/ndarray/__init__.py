@@ -157,7 +157,9 @@ def save(fname, data, format="npz"):
     """Save NDArray / list / dict of NDArray (reference ndarray/utils.py:149).
 
     Default format: numpy .npz (TPU-native: the reference's custom binary
-    chunk format served its C++ loader; npz keeps numpy interop).
+    chunk format served its C++ loader; npz keeps numpy interop),
+    committed via the mx.checkpoint atomic-file path so a crash mid-save
+    never truncates an existing file at ``fname``.
     ``format="reference"`` writes the incumbent's binary NDArray-list
     format instead, loadable by the reference's mx.nd.load."""
     if format == "reference":
@@ -173,8 +175,10 @@ def save(fname, data, format="npz"):
                    for i, v in enumerate(data)}
     else:
         raise MXNetError("save: unsupported data type %r" % type(data))
-    with open(fname, "wb") as f:
-        _np.savez(f, **payload)
+    from ..checkpoint.layout import atomic_file
+
+    # streamed into the temp file — no full in-memory .npz copy
+    atomic_file(fname, lambda f: _np.savez(f, **payload))
 
 
 def load(fname):

@@ -479,6 +479,39 @@ class FusedTrainer:
         self._opt_state = opt_state
         self._step_count = int(state["step"])
 
+    def _checkpoint_manager(self, root, **manager_kwargs):
+        from ..checkpoint import cached_manager
+
+        return cached_manager(self, root, **manager_kwargs)
+
+    def save_checkpoint(self, root, step=None, block=True,
+                        manager=None, **manager_kwargs):
+        """Persist the full training state (params + optimizer state +
+        step) through ``mx.checkpoint``.  ``block=False`` returns a
+        ``SaveFuture`` after only the device->host snapshot — the step
+        loop keeps running while the background writer commits.  Pass
+        ``manager`` to share one ``CheckpointManager`` across trainers;
+        otherwise one is cached per root on this trainer."""
+        state = self.state_dict()
+        if state is None:
+            raise MXNetError(
+                "save_checkpoint before the first step: the trainer has "
+                "no state yet")
+        mgr = manager or self._checkpoint_manager(root, **manager_kwargs)
+        step = self._step_count if step is None else int(step)
+        fut = mgr.save_async(step, state)
+        return fut.result() if block else fut
+
+    def load_checkpoint(self, root, step=None, manager=None):
+        """Restore a ``save_checkpoint`` step (default latest).  Leaves
+        land back on THIS trainer's current mesh/sharding — restarting
+        on a different replica count reshards transparently.  Returns
+        the restored step."""
+        mgr = manager or self._checkpoint_manager(root)
+        step, state = mgr.restore(self.state_dict(), step=step)
+        self.load_state_dict(state)
+        return step
+
     @property
     def params(self):
         return self._params

@@ -34,6 +34,7 @@ def _detect():
         "SIGNAL_HANDLER": True,
         "PROFILER": True,
         "TELEMETRY": True,
+        "CHECKPOINT": True,
         "OPENMP": True,
         "SSE": False,
         "F16C": False,

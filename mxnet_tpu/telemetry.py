@@ -268,6 +268,11 @@ def _register(kind, name, help, labelnames, buckets=None):
                 raise ValueError(
                     "metric %r already registered as %s%s"
                     % (name, m.kind, m.labelnames))
+            if kind == "histogram" and buckets is not None \
+                    and tuple(sorted(buckets)) != m.buckets:
+                raise ValueError(
+                    "histogram %r already registered with buckets %s"
+                    % (name, m.buckets))
             return m
         m = Metric(kind, name, help, labelnames, buckets)
         _REGISTRY[name] = m
@@ -575,5 +580,31 @@ DATALOADER_WAIT_SECONDS = histogram(
 DEVICE_MEMORY = gauge(
     "device_memory_bytes", "PJRT device memory stats "
     "(sample_device_memory refreshes)", ("device", "stat"))
+# mx.checkpoint (checkpoint/manager.py + writer.py): snapshot is the
+# only critical-path phase of an async save; serialize/commit run on
+# the background writer
+CHECKPOINT_SNAPSHOT_SECONDS = histogram(
+    "checkpoint_snapshot_seconds",
+    "device->host snapshot time (critical path of an async save)")
+CHECKPOINT_SERIALIZE_SECONDS = histogram(
+    "checkpoint_serialize_seconds",
+    "background shard serialize+durable-write (streamed) time")
+CHECKPOINT_COMMIT_SECONDS = histogram(
+    "checkpoint_commit_seconds",
+    "background manifest/marker write + atomic-publish time")
+CHECKPOINT_BYTES = counter(
+    "checkpoint_bytes_total", "checkpoint shard bytes moved",
+    ("direction",))
+CHECKPOINT_QUEUE_DEPTH = gauge(
+    "checkpoint_async_queue_depth",
+    "async saves snapshotted but not yet committed")
+CHECKPOINT_RETRIES = counter(
+    "checkpoint_retries_total",
+    "commit attempts retried after a transient I/O error")
+CHECKPOINT_SAVES = counter(
+    "checkpoint_saves_total", "checkpoint commits by outcome",
+    ("result",))
+CHECKPOINT_RESTORES = counter(
+    "checkpoint_restores_total", "checkpoint restore calls")
 
 start_logger()

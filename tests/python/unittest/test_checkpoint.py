@@ -1,0 +1,510 @@
+"""mx.checkpoint — async, sharded, crash-consistent checkpointing.
+
+Covers the subsystem's hard guarantees: an aborted save never corrupts
+or shadows the latest restorable checkpoint, validate() catches a
+flipped shard byte via CRC32, async saves only pay the snapshot on the
+calling thread, and the gluon Trainer bundle (params + optimizer state
++ step counter) round-trips bit-exact.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint as ckpt
+from mxnet_tpu import telemetry
+from mxnet_tpu.checkpoint import layout
+from mxnet_tpu.gluon import nn
+
+
+def _tree():
+    rs = np.random.RandomState(0)
+    return {"params": {"w": rs.rand(64, 64).astype(np.float32),
+                       "b": rs.rand(64).astype(np.float32)},
+            "opt": (rs.rand(64, 64).astype(np.float32), None),
+            "step": 7}
+
+
+def _assert_tree_equal(a, b):
+    import jax
+
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# layout + round trip
+# ---------------------------------------------------------------------------
+
+def test_roundtrip_sharded_layout(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path), group_bytes=1024)
+    path = mgr.save(10, _tree())
+    names = sorted(os.listdir(path))
+    assert layout.COMMITTED in names and layout.MANIFEST in names
+    # big leaves get private .npy shards, small ones share a group .npz
+    assert any(n.startswith("leaf_") for n in names)
+    assert any(n.startswith("group_") for n in names)
+    with open(os.path.join(path, layout.MANIFEST)) as f:
+        manifest = json.load(f)
+    assert manifest["format"] == ckpt.FORMAT
+    assert manifest["step"] == 10
+    assert manifest["n_leaves"] == 4
+    leaf_names = [e["name"] for e in manifest["leaves"]]
+    assert "params/w" in leaf_names and "opt/0" in leaf_names
+    for e in manifest["leaves"]:
+        assert e["file"] in manifest["files"]
+
+    step, tree = mgr.restore()
+    assert step == 10
+    _assert_tree_equal(tree, _tree())
+    assert np.asarray(tree["params"]["w"]).dtype == np.float32
+    assert tree["opt"][1] is None
+    assert int(tree["step"]) == 7
+
+
+def test_template_restore_keeps_dtype_and_structure(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path))
+    mgr.save(1, _tree())
+    tmpl = _tree()
+    tmpl["params"]["w"] = tmpl["params"]["w"].astype(np.float16)
+    step, tree = mgr.restore(tmpl)
+    assert np.asarray(tree["params"]["w"]).dtype == np.float16
+    assert isinstance(tree["opt"], tuple)
+
+
+def test_partial_restore_reads_subset(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path), group_bytes=1024)
+    mgr.save(3, _tree())
+    sub = mgr.load_leaves(select=lambda n: n.startswith("params/"))
+    assert sorted(sub) == ["params/b", "params/w"]
+    np.testing.assert_array_equal(sub["params/w"], _tree()["params"]["w"])
+
+
+def test_max_keep_zero_keeps_everything(tmp_path):
+    # old elastic semantics: max_keep=0 never garbage-collected
+    mgr = ckpt.CheckpointManager(str(tmp_path), max_keep=0)
+    for s in (1, 2, 3, 4, 5):
+        path = mgr.save(s, {"x": np.zeros(2, np.float32)})
+    assert os.path.isdir(path)
+    assert mgr.steps() == [1, 2, 3, 4, 5]
+
+
+def test_small_leaves_split_into_bounded_groups(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path), group_bytes=1024)
+    tree = {"l%02d" % i: np.zeros(128, np.float32)  # 512B each
+            for i in range(8)}
+    path = mgr.save(1, tree)
+    groups = sorted(n for n in os.listdir(path) if n.startswith("group_"))
+    assert len(groups) > 1, groups  # 4KiB of small leaves, 1KiB cap
+    _assert_tree_equal(mgr.restore()[1], tree)
+
+
+def test_validate_flags_missing_manifest_and_missing_step(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path))
+    mgr.save(1, {"x": np.zeros(2, np.float32)})
+    os.unlink(os.path.join(mgr._dir_for(1), layout.MANIFEST))
+    report = mgr.validate(quarantine=True)
+    assert not report[1]["ok"]
+    assert any("MANIFEST" in e for e in report[1]["errors"])
+    # a nonexistent step must report, not crash, even with quarantine
+    report = mgr.validate(step=999, quarantine=True)
+    assert not report[999]["ok"]
+    assert "missing directory" in report[999]["errors"][0]
+
+
+def test_retention_max_keep_and_keep_every(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path), max_keep=2, keep_every=20)
+    for s in (10, 20, 25, 30):
+        mgr.save(s, {"x": np.zeros(4, np.float32)})
+    # 20 survives the rolling window because keep_every pins it
+    assert mgr.steps() == [20, 25, 30]
+
+
+# ---------------------------------------------------------------------------
+# crash consistency
+# ---------------------------------------------------------------------------
+
+def test_steps_ignores_torn_and_foreign_dirs(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path))
+    mgr.save(5, _tree())
+    # torn save: a step-named dir without the COMMITTED marker
+    torn = tmp_path / "ckpt-00000099"
+    torn.mkdir()
+    (torn / layout.MANIFEST).write_text("{}")
+    (tmp_path / "ckpt-notanumber").mkdir()
+    assert mgr.steps() == [5]
+    assert mgr.latest_step() == 5
+    with pytest.raises(mx.MXNetError, match="torn"):
+        mgr.restore(step=99)
+
+
+def test_crash_mid_overwrite_preserves_previous(tmp_path, monkeypatch):
+    """Kill the commit between unpublishing the old dir and publishing
+    the new one — the crash window that destroyed the only copy under
+    the old elastic rmtree-then-rename protocol."""
+    mgr = ckpt.CheckpointManager(str(tmp_path))
+    original = {"x": np.arange(8, dtype=np.float32)}
+    final = mgr.save(5, original)
+
+    real_rename = os.rename
+
+    def dying_rename(src, dst):
+        if dst == final and ".saving-" in src:
+            raise RuntimeError("simulated crash mid-commit")
+        return real_rename(src, dst)
+
+    monkeypatch.setattr(os, "rename", dying_rename)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        mgr.save(5, {"x": np.zeros(8, np.float32)})
+    monkeypatch.undo()
+
+    # a fresh manager (fresh process) must recover the parked .prev copy
+    mgr2 = ckpt.CheckpointManager(str(tmp_path))
+    assert mgr2.steps() == [5]
+    step, tree = mgr2.restore()
+    np.testing.assert_array_equal(np.asarray(tree["x"]), original["x"])
+
+
+def test_crash_before_marker_never_shadows_latest(tmp_path, monkeypatch):
+    """A save that dies before the COMMITTED marker leaves no trace a
+    restore could trust — latest_step() stays on the good step."""
+    mgr = ckpt.CheckpointManager(str(tmp_path))
+    good = {"x": np.ones(4, np.float32)}
+    mgr.save(7, good)
+
+    real_write = layout.write_file_durable
+
+    def dying_write(path, data):
+        if os.path.basename(path) == layout.COMMITTED:
+            raise RuntimeError("simulated crash before marker")
+        return real_write(path, data)
+
+    monkeypatch.setattr(layout, "write_file_durable", dying_write)
+    with pytest.raises(RuntimeError, match="before marker"):
+        mgr.save(8, {"x": np.zeros(4, np.float32)})
+    monkeypatch.undo()
+
+    mgr2 = ckpt.CheckpointManager(str(tmp_path))
+    assert mgr2.latest_step() == 7
+    step, tree = mgr2.restore()
+    assert step == 7
+    np.testing.assert_array_equal(np.asarray(tree["x"]), good["x"])
+
+
+def test_validate_detects_and_quarantines_corrupt_shard(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path), group_bytes=1024)
+    mgr.save(1, {"x": np.ones(4, np.float32)})
+    mgr.save(2, _tree())
+    d = mgr._dir_for(2)
+    shard = sorted(n for n in os.listdir(d)
+                   if n.endswith((".npy", ".npz")))[0]
+    with open(os.path.join(d, shard), "r+b") as f:
+        f.seek(12)
+        f.write(b"\xde\xad\xbe\xef")
+    report = mgr.validate()
+    assert report[1]["ok"]
+    assert not report[2]["ok"]
+    assert any("checksum mismatch" in e for e in report[2]["errors"])
+
+    report = mgr.validate(quarantine=True)
+    assert report[2]["quarantined"].endswith(".corrupt")
+    # the corrupt step is out of the discovery path; restore falls back
+    # to the previous good step
+    assert mgr.steps() == [1]
+    step, tree = mgr.restore()
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(tree["x"]),
+                                  np.ones(4, np.float32))
+
+
+def test_retry_recovers_from_transient_io_error(tmp_path, monkeypatch):
+    mgr = ckpt.CheckpointManager(str(tmp_path), io_retries=3,
+                                 retry_backoff=0.01)
+    before = telemetry.value("checkpoint_retries_total")
+    real_write = layout.write_file_durable
+    fails = {"n": 1}
+
+    def flaky_write(path, data):
+        if fails["n"] and os.path.basename(path) == layout.MANIFEST:
+            fails["n"] -= 1
+            raise OSError("transient I/O error")
+        return real_write(path, data)
+
+    monkeypatch.setattr(layout, "write_file_durable", flaky_write)
+    path = mgr.save(4, {"x": np.ones(2, np.float32)})
+    assert os.path.isdir(path)
+    assert telemetry.value("checkpoint_retries_total") == before + 1
+    assert mgr.validate()[4]["ok"]
+
+
+def test_atomic_file_preserves_existing_on_crash(tmp_path, monkeypatch):
+    target = tmp_path / "model.params"
+    ckpt.atomic_file(str(target), b"good data")
+
+    def dying_rename(src, dst):
+        raise RuntimeError("simulated crash")
+
+    monkeypatch.setattr(os, "rename", dying_rename)
+    with pytest.raises(RuntimeError):
+        ckpt.atomic_file(str(target), b"half written garbage")
+    monkeypatch.undo()
+    assert target.read_bytes() == b"good data"
+
+
+# ---------------------------------------------------------------------------
+# async saves
+# ---------------------------------------------------------------------------
+
+def test_async_save_does_not_block_past_snapshot(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path))
+    gate = threading.Event()
+    real_commit = mgr._commit_once
+
+    def slow_commit(step, spec, host):
+        gate.wait(5.0)  # hold the background commit open
+        return real_commit(step, spec, host)
+
+    mgr._commit_once = slow_commit
+    snap_before = telemetry.value("checkpoint_snapshot_seconds")
+
+    t0 = time.perf_counter()
+    fut = mgr.save_async(1, _tree())
+    submit_time = time.perf_counter() - t0
+    # the training thread got control back after the snapshot, while
+    # the commit is still parked on the gate
+    assert not fut.done()
+    assert submit_time < 2.0
+    # critical-path time is measured via telemetry
+    assert telemetry.value("checkpoint_snapshot_seconds") == \
+        snap_before + 1
+
+    gate.set()
+    path = fut.result(timeout=30)
+    assert path == mgr._dir_for(1)
+    assert mgr.wait() == path
+    assert telemetry.value("checkpoint_async_queue_depth") == 0
+    assert mgr.steps() == [1]
+
+
+def test_snapshot_copies_not_aliases(tmp_path):
+    """The snapshot must COPY: mutating (or donating) the source arrays
+    after save_async must not leak into the committed checkpoint."""
+    mgr = ckpt.CheckpointManager(str(tmp_path))
+    gate = threading.Event()
+    real_commit = mgr._commit_once
+
+    def gated_commit(step, spec, host):
+        gate.wait(5.0)
+        return real_commit(step, spec, host)
+
+    mgr._commit_once = gated_commit
+    src = np.arange(16, dtype=np.float32)
+    fut = mgr.save_async(1, {"x": src})
+    src[:] = -1.0  # simulates XLA reusing a donated buffer mid-commit
+    gate.set()
+    fut.result(timeout=30)
+    _, tree = mgr.restore()
+    np.testing.assert_array_equal(np.asarray(tree["x"]),
+                                  np.arange(16, dtype=np.float32))
+
+
+def test_async_bounded_inflight_and_fifo(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path), max_keep=None,
+                                 max_inflight=2)
+    futs = [mgr.save_async(s, {"x": np.full(4, s, np.float32)})
+            for s in range(1, 5)]
+    assert mgr.wait() == mgr._dir_for(4)
+    assert all(f.done() for f in futs)
+    assert mgr.steps() == [1, 2, 3, 4]
+
+
+def test_async_save_failure_surfaces_on_wait(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path), io_retries=1)
+
+    def broken_commit(step, spec, host):
+        raise OSError("disk on fire")
+
+    mgr._commit_once = broken_commit
+    mgr.save_async(1, {"x": np.zeros(2, np.float32)})
+    with pytest.raises(OSError, match="disk on fire"):
+        mgr.wait()
+    # the error was consumed; a later wait() is clean
+    assert mgr.wait() is None
+
+
+# ---------------------------------------------------------------------------
+# cross-layer integration
+# ---------------------------------------------------------------------------
+
+def _gluon_pair(seed, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9}):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=8),
+            nn.Dense(4, in_units=16))
+    net.initialize()
+    tr = mx.gluon.Trainer(net.collect_params(), optimizer,
+                          dict(optimizer_params))
+    return net, tr
+
+
+def _gluon_step(net, tr, step):
+    rs = np.random.RandomState(step)
+    x = mx.nd.array(rs.rand(8, 8).astype(np.float32))
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(8)
+
+
+@pytest.mark.parametrize("opt,opt_params", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    # adam additionally exercises the per-index update counts that
+    # drive bias correction — they live on the Optimizer, not in
+    # _states, and resume drifts without them
+    ("adam", {"learning_rate": 1e-3}),
+])
+def test_trainer_checkpoint_roundtrip_with_optimizer_state(
+        tmp_path, opt, opt_params):
+    net, tr = _gluon_pair(3, opt, opt_params)
+    for s in range(3):
+        _gluon_step(net, tr, s)
+    assert tr.step_count == 3
+    path = tr.save_checkpoint(str(tmp_path))
+    assert os.path.basename(path) == "ckpt-00000003"
+
+    net2, tr2 = _gluon_pair(4, opt, opt_params)  # different init
+    _gluon_step(net2, tr2, 99)  # different optimizer state too
+    step = tr2.load_checkpoint(str(tmp_path))
+    assert tr2.optimizer.num_update == tr.optimizer.num_update
+    assert step == 3 and tr2.step_count == 3
+    for (n, p), (n2, p2) in zip(net.collect_params().items(),
+                                net2.collect_params().items()):
+        np.testing.assert_array_equal(p.data().asnumpy(),
+                                      p2.data().asnumpy())
+    # optimizer (momentum) state restored: one more identical step must
+    # produce identical weights on both trainers
+    _gluon_step(net, tr, 5)
+    _gluon_step(net2, tr2, 5)
+    for (n, p), (n2, p2) in zip(net.collect_params().items(),
+                                net2.collect_params().items()):
+        np.testing.assert_allclose(p.data().asnumpy(),
+                                   p2.data().asnumpy(), rtol=1e-6)
+
+
+def test_load_does_not_pin_default_retention(tmp_path):
+    """A kwargs-less load_checkpoint must not lock the cached manager to
+    max_keep=3 against later saves that ask to keep every step."""
+    net, tr = _gluon_pair(9)
+    _gluon_step(net, tr, 0)
+    tr.save_checkpoint(str(tmp_path), step=1)
+    net2, tr2 = _gluon_pair(10)
+    tr2.load_checkpoint(str(tmp_path))   # caches a defaults manager
+    for s in (2, 3, 4, 5, 6):
+        tr2.save_checkpoint(str(tmp_path), step=s, max_keep=None)
+    mgr = ckpt.CheckpointManager(str(tmp_path), recover=False)
+    assert mgr.steps() == [1, 2, 3, 4, 5, 6], mgr.steps()
+
+
+def test_trainer_restore_with_reordered_params(tmp_path):
+    """Optimizer state is keyed by param NAME: a restoring trainer with
+    a different param insertion order must still attach each moment to
+    the right weight (then track the original trainer exactly)."""
+    net, tr = _gluon_pair(12)
+    for s in range(3):
+        _gluon_step(net, tr, s)
+    tr.save_checkpoint(str(tmp_path))
+
+    net2, _ = _gluon_pair(13)
+    reordered = dict(reversed(list(net2.collect_params().items())))
+    tr2 = mx.gluon.Trainer(reordered, "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9})
+    tr2.load_checkpoint(str(tmp_path))
+    _gluon_step(net, tr, 7)
+    _gluon_step(net2, tr2, 7)
+    for (n, p), (n2, p2) in zip(net.collect_params().items(),
+                                net2.collect_params().items()):
+        np.testing.assert_allclose(p.data().asnumpy(),
+                                   p2.data().asnumpy(), rtol=1e-6)
+
+
+def test_leaf_paths_escape_separator(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path))
+    mgr.save(1, {"a": {"b": np.ones(2, np.float32)},
+                 "a/b": np.zeros(2, np.float32)})
+    leaves = mgr.load_leaves()
+    assert sorted(leaves) == ["a/b", "a\\/b"]
+    np.testing.assert_array_equal(leaves["a/b"], np.ones(2, np.float32))
+    np.testing.assert_array_equal(leaves["a\\/b"],
+                                  np.zeros(2, np.float32))
+
+
+def test_block_checkpoint_roundtrip(tmp_path):
+    net, tr = _gluon_pair(5)
+    net.save_checkpoint(str(tmp_path), step=2)
+    net2, _ = _gluon_pair(6)
+    step = net2.load_checkpoint(str(tmp_path))
+    assert step == 2
+    for (n, p), (n2, p2) in zip(net.collect_params().items(),
+                                net2.collect_params().items()):
+        np.testing.assert_array_equal(p.data().asnumpy(),
+                                      p2.data().asnumpy())
+
+
+def test_block_checkpoint_roundtrip_with_tied_params(tmp_path):
+    """Tied params are saved once and their aliases restore from the
+    same leaf — a self-round-trip must not raise 'missing'."""
+    mx.random.seed(11)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4))
+    tied = nn.Dense(8, in_units=4)
+    net.initialize()
+    tied.weight = net[0].weight
+    tied.bias = net[0].bias
+    net.add(tied)
+    net.save_checkpoint(str(tmp_path), step=1)
+    # only one copy of each tensor on disk
+    mgr = ckpt.CheckpointManager(str(tmp_path), recover=False)
+    assert mgr.manifest(1)["n_leaves"] == 2
+    assert net.load_checkpoint(str(tmp_path)) == 1  # aliases satisfied
+
+
+def test_elastic_shim_reads_legacy_layout(tmp_path):
+    """Checkpoints written by the pre-mx.checkpoint elastic manager
+    (leaves.npz + meta.json, no COMMITTED marker) still restore."""
+    from mxnet_tpu.elastic import CheckpointManager
+
+    tree = {"a": np.arange(4, dtype=np.float32), "b": (np.ones(2), None)}
+    d = tmp_path / "ckpt-00000012"
+    d.mkdir()
+    leaves = [tree["a"], np.asarray(tree["b"][0])]
+    np.savez(d / "leaves.npz",
+             **{"leaf_%d" % i: v for i, v in enumerate(leaves)})
+    (d / "meta.json").write_text(json.dumps(
+        {"step": 12, "n_leaves": 2, "spec": layout.tree_spec(tree)}))
+
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.steps() == [12]
+    assert mgr.validate()[12]["legacy"]
+    step, restored = mgr.restore()
+    assert step == 12
+    np.testing.assert_array_equal(np.asarray(restored["a"]), tree["a"])
+    assert restored["b"][1] is None
+
+
+def test_do_checkpoint_routes_trainer_through_subsystem(tmp_path):
+    net, tr = _gluon_pair(8)
+    _gluon_step(net, tr, 0)
+    cb = mx.callback.do_checkpoint(str(tmp_path / "run"))
+    cb(0, tr)
+    root = str(tmp_path / "run-ckpt")
+    mgr = ckpt.CheckpointManager(root)
+    assert mgr.steps() == [1]
+    assert mgr.validate()[1]["ok"]

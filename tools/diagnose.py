@@ -126,6 +126,43 @@ def telemetry_info():
     print("totals       :", telemetry.totals(nonzero=True))
 
 
+def checkpoints_info(root):
+    """Audit a checkpoint root: one line per step with size, shard
+    count, and checksum status (mx.checkpoint.validate, read-only —
+    nothing is quarantined)."""
+    section("Checkpoints")
+    import os as _os
+
+    from mxnet_tpu import checkpoint as ckpt
+
+    if not _os.path.isdir(root):
+        print("root         : %s (missing)" % root)
+        return
+    # recover=False: auditing must not promote/sweep anything in a root
+    # another process may be actively writing
+    mgr = ckpt.CheckpointManager(root, recover=False)
+    report = mgr.validate()
+    if not report:
+        print("root         : %s (no checkpoint directories)" % root)
+        return
+    print("root         : %s" % root)
+    ok_steps = [s for s in report if report[s]["ok"]]
+    latest = max(ok_steps) if ok_steps else None
+    for step in sorted(report):
+        info = report[step]
+        if info["ok"]:
+            status = "legacy-ok" if info.get("legacy") else "ok"
+        else:
+            status = "CORRUPT: " + "; ".join(info["errors"])
+        d = mgr._dir_for(step)
+        shards = len([n for n in _os.listdir(d)
+                      if n.endswith((".npy", ".npz"))]) \
+            if _os.path.isdir(d) else 0
+        print("step %8d : %10.1f KiB  %3d shard(s)  %s%s"
+              % (step, info["nbytes"] / 1024.0, shards, status,
+                 "  <- latest restorable" if step == latest else ""))
+
+
 def env_info():
     section("Environment")
     from mxnet_tpu import config
@@ -146,7 +183,17 @@ def main():
                     help="skip the on-device matmul smoke")
     ap.add_argument("--telemetry", action="store_true",
                     help="print the live mx.telemetry snapshot")
+    ap.add_argument("--checkpoints", metavar="ROOT",
+                    help="audit a checkpoint root: steps, sizes, "
+                         "checksum status (read-only; skips the "
+                         "environment sections, honors --telemetry)")
     args = ap.parse_args()
+    if args.checkpoints:
+        checkpoints_info(args.checkpoints)
+        if args.telemetry:
+            telemetry_info()
+        print()
+        return
     python_info()
     platform_info()
     deps_info()
