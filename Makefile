@@ -9,7 +9,7 @@ LDLIBS ?= -ljpeg -lz
 SRCS := $(wildcard src/native/*.cc)
 SO := build/libmxtpu_native.so
 
-.PHONY: native test cpptest telemetry-smoke checkpoint-smoke clean
+.PHONY: native test cpptest telemetry-smoke checkpoint-smoke serve-smoke clean
 
 native: $(SO)
 
@@ -45,6 +45,15 @@ checkpoint-smoke:
 	JAX_PLATFORMS=cpu python -m pytest \
 	  tests/python/unittest/test_checkpoint.py \
 	  tests/python/unittest/test_elastic.py -q -m 'not slow'
+
+# mx.serve smoke: serve a tiny checkpointed model, concurrent requests
+# across 2 shape buckets (<=1 compile per bucket), clean ServerOverloaded
+# rejection beyond queue_depth, serve_* metrics in the Prometheus export;
+# then the subsystem's pytest suite
+serve-smoke:
+	JAX_PLATFORMS=cpu python tools/serve_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/python/unittest/test_serve.py -q -m 'not slow'
 
 # suite summary artifact (TESTS_r{N}.json) — round-2 advisor contract
 test-report:

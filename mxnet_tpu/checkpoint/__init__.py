@@ -33,11 +33,12 @@ from __future__ import annotations
 
 from .layout import (COMMITTED, DEFAULT_GROUP_BYTES, FORMAT, MANIFEST,
                      atomic_file, leaf_paths, tree_from_spec, tree_spec)
-from .manager import CheckpointManager, cached_manager
+from .manager import CheckpointManager, cached_manager, latest_step
 from .writer import AsyncWriter, SaveFuture
 
 __all__ = [
     "CheckpointManager", "SaveFuture", "AsyncWriter", "cached_manager",
+    "latest_step",
     "tree_spec", "tree_from_spec", "leaf_paths", "atomic_file",
     "FORMAT", "MANIFEST", "COMMITTED", "DEFAULT_GROUP_BYTES",
 ]

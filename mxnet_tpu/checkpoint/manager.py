@@ -47,7 +47,39 @@ from .. import telemetry
 from . import layout
 from .writer import AsyncWriter
 
-__all__ = ["CheckpointManager", "cached_manager"]
+__all__ = ["CheckpointManager", "cached_manager", "latest_step"]
+
+
+def _scan_steps(root, prefix):
+    """Sorted [(step, dirname)] for every directory under ``root`` named
+    like a step (``<prefix>-<digits>``), committed or not — the ONE
+    place the on-disk naming scheme is parsed."""
+    out = []
+    for name in os.listdir(root):
+        if not name.startswith(prefix + "-"):
+            continue
+        tail = name[len(prefix) + 1:]
+        if not tail.isdigit():
+            continue
+        d = os.path.join(root, name)
+        if os.path.isdir(d):
+            out.append((int(tail), d))
+    return sorted(out)
+
+
+def latest_step(root, prefix="ckpt"):
+    """Latest COMMITTED step under ``root``, or None.
+
+    Read-only probe: no manager construction, no crash recovery, no
+    directory creation — safe to call against a root another process
+    is actively writing.  This is what ``mx.serve`` hot-swap polling
+    and ``tools/diagnose.py`` use to peek at a serving root."""
+    root = os.fspath(root)
+    if not os.path.isdir(root):
+        return None
+    committed = [s for s, d in _scan_steps(root, prefix)
+                 if _is_committed(d)]
+    return committed[-1] if committed else None
 
 
 def cached_manager(owner, root, **manager_kwargs):
@@ -143,17 +175,7 @@ class CheckpointManager:
     def _scan(self):
         """[(step, dirname)] for every directory named like a step,
         committed or not."""
-        out = []
-        for name in os.listdir(self._root):
-            if not name.startswith(self._prefix + "-"):
-                continue
-            tail = name[len(self._prefix) + 1:]
-            if not tail.isdigit():
-                continue
-            d = os.path.join(self._root, name)
-            if os.path.isdir(d):
-                out.append((int(tail), d))
-        return sorted(out)
+        return _scan_steps(self._root, self._prefix)
 
     def steps(self):
         """Sorted steps with a COMMITTED (or legacy) checkpoint; torn or

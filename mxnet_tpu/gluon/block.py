@@ -444,12 +444,15 @@ class HybridBlock(Block):
                 Block.__call__(self, *args)
 
     # ---- the cached-op path ----------------------------------------------
-    def _call_cached_op(self, *args, **kwargs):
-        self._ensure_initialized(args)
-        flat_inputs = []
-        in_spec = _flatten_nd(list(args), flat_inputs)
-        nd_inputs = [x for x in flat_inputs if isinstance(x, NDArray)]
-        training = autograd.is_training()
+    def _get_cached_op(self, flat_inputs, in_spec, training, kwargs):
+        """Get-or-build the compiled signature for these flat inputs —
+        the one cache entry point behind ``__call__`` AND ``warm_up``, so
+        build/hit/recompile telemetry is emitted for both paths.  Returns
+        ``(centry, built_t0)``; ``built_t0`` is the perf-counter at build
+        start (None on a hit) — jax.jit traces+compiles lazily on first
+        execution, so the caller observes CACHEDOP_BUILD_SECONDS at
+        first-execution exit (cold-start latency: trace + compile + first
+        run), not around ``_build_cache`` alone."""
         from ..contrib import amp as _amp
 
         key = (training, tuple(sorted(kwargs.items())),
@@ -462,9 +465,6 @@ class HybridBlock(Block):
         centry = self._cached_ops.get(key)
         built_t0 = None
         if centry is None:
-            # jax.jit traces+compiles lazily on first execution, so build
-            # latency is observed at function exit (cold-start latency:
-            # trace + compile + first run), not around _build_cache alone
             built_t0 = _time.perf_counter()
             centry = self._build_cache(flat_inputs, in_spec, training, kwargs)
             if _tel.ENABLED:
@@ -475,6 +475,72 @@ class HybridBlock(Block):
             self._cached_ops[key] = centry
         elif _tel.ENABLED:
             _tel.CACHEDOP_HIT.labels(block=type(self).__name__).inc()
+        return centry, built_t0
+
+    def warm_up(self, signatures, dtype="float32", training=False,
+                **call_kwargs):
+        """Pre-compile the hybridize cache for a set of input signatures
+        without real data (mx.serve pre-warms its shape buckets here).
+
+        ``signatures`` is a list of input signatures.  Each signature is
+        a shape tuple (single-input blocks) or a sequence of per-input
+        entries, where an entry is a shape tuple or a ``(shape, dtype)``
+        pair.  Every signature is traced through the SAME cached-op path
+        as a real call on zero-filled inputs — deferred parameter shapes
+        resolve, the usual cachedop build/hit telemetry is emitted, and
+        the jitted program runs once so XLA compilation (not just
+        tracing) happens now rather than on the first live request.
+
+        Activates hybridization if needed (without clearing entries that
+        are already warm).  Returns the number of newly compiled
+        signatures; already-warm signatures count as cache hits.
+        """
+        from .. import ndarray as _nd
+
+        if not self._active:
+            self.hybridize(True, clear=False)
+
+        def _is_shape(t):
+            return isinstance(t, (tuple, list)) and \
+                all(isinstance(d, int) for d in t)
+
+        built = 0
+        for sig in signatures:
+            if _is_shape(sig):
+                sig = [tuple(sig)]
+            elif (isinstance(sig, (tuple, list)) and len(sig) == 2
+                    and _is_shape(sig[0]) and isinstance(sig[1], str)):
+                sig = [sig]  # one bare (shape, dtype) entry, not 2 inputs
+            args = []
+            for entry in sig:
+                if (isinstance(entry, (tuple, list)) and len(entry) == 2
+                        and isinstance(entry[0], (tuple, list))
+                        and isinstance(entry[1], str)):
+                    shape, dt = tuple(entry[0]), entry[1]
+                else:
+                    shape, dt = tuple(entry), dtype
+                args.append(_nd.zeros(shape, dtype=dt))
+            before = len(self._cached_ops)
+            with autograd._mode(record=False, train=training):
+                out = self(*args, **call_kwargs)
+            # block until the compiled program actually ran: dispatch is
+            # async, and a warm-up that returns before XLA finishes would
+            # let the first live request pay the compile anyway
+            for o in (out if isinstance(out, (list, tuple)) else [out]):
+                if isinstance(o, NDArray):
+                    o._data.block_until_ready()
+            if len(self._cached_ops) > before:
+                built += 1
+        return built
+
+    def _call_cached_op(self, *args, **kwargs):
+        self._ensure_initialized(args)
+        flat_inputs = []
+        in_spec = _flatten_nd(list(args), flat_inputs)
+        nd_inputs = [x for x in flat_inputs if isinstance(x, NDArray)]
+        training = autograd.is_training()
+        centry, built_t0 = self._get_cached_op(flat_inputs, in_spec,
+                                               training, kwargs)
 
         params = list(self.collect_params().values())
         param_datas = [p._data._data for p in params]

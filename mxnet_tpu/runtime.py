@@ -35,6 +35,7 @@ def _detect():
         "PROFILER": True,
         "TELEMETRY": True,
         "CHECKPOINT": True,
+        "SERVE": True,
         "OPENMP": True,
         "SSE": False,
         "F16C": False,

@@ -1,0 +1,39 @@
+"""mx.serve — dynamic-batching TPU inference serving.
+
+The serving counterpart of the training-side subsystems (telemetry,
+checkpoint): compile-once/run-many execution behind a request queue.
+
+- ``ModelRunner`` loads a HybridBlock from an ``mx.checkpoint`` root
+  (restore-with-resharding onto the serving ctx), pre-warms the
+  hybridize cache for a configured bucket table (batch sizes x sample
+  shapes), and pads/bucketizes inputs so steady-state serving triggers
+  at most ONE compile per bucket — XLA recompiles never land on the
+  hot path.
+- ``BatchQueue`` + ``Scheduler`` coalesce concurrent single requests
+  into micro-batches under a ``max_batch_size`` / ``max_wait_us``
+  policy, with bounded queue depth, per-request deadlines, and
+  explicit backpressure: overload REJECTS with ``ServerOverloaded``
+  instead of queueing unboundedly.
+- ``Server`` is the thread-safe front end: ``submit()`` /
+  ``submit_async()`` futures, graceful drain on ``shutdown()``, hot
+  model swap via atomic runner replacement (``swap()``), and a
+  minimal stdlib HTTP endpoint (``/predict``, ``/healthz``,
+  ``/readyz``, ``/metrics``, ``/statz``).
+
+Every stage is metered through ``mx.telemetry`` (``serve_*`` queue
+wait, batch size, pad waste, compile count, latency, rejections) and
+exported through the existing Prometheus/JSON exporters.  See README
+"Serving" for the knobs and the hot-swap workflow.
+"""
+from __future__ import annotations
+
+from .batching import (BatchQueue, NoBucketError, Request, RequestTimeout,
+                       Scheduler, ServeError, ServerClosed, ServerOverloaded)
+from .runner import DEFAULT_BATCH_SIZES, ModelRunner
+from .server import ServeConfig, Server
+
+__all__ = [
+    "Server", "ServeConfig", "ModelRunner", "BatchQueue", "Scheduler",
+    "Request", "ServeError", "ServerOverloaded", "ServerClosed",
+    "RequestTimeout", "NoBucketError", "DEFAULT_BATCH_SIZES",
+]

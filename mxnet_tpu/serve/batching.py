@@ -1,0 +1,288 @@
+"""Request queue + micro-batching scheduler for mx.serve.
+
+The serving hot path is a single bounded FIFO (``BatchQueue``) drained
+by one ``Scheduler`` thread.  The scheduler coalesces concurrent
+single-sample requests into micro-batches under a
+``max_batch_size`` / ``max_wait_us`` policy: a batch is dispatched as
+soon as ``max_batch_size`` requests of the SAME bucket class are
+queued, or when the oldest of them has waited ``max_wait_us``.
+Batches are homogeneous per bucket class (requests padding to
+different shape buckets never mix), so every dispatch hits exactly one
+pre-warmed compiled signature.
+
+Overload policy is explicit backpressure: a full queue REJECTS with
+``ServerOverloaded`` immediately — requests never queue unboundedly
+and callers never hang.  Each request carries an optional deadline;
+expired requests are failed with ``RequestTimeout`` before dispatch
+and never reach the model.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
+
+from .. import telemetry
+from ..base import MXNetError
+
+__all__ = ["ServeError", "ServerOverloaded", "ServerClosed",
+           "RequestTimeout", "NoBucketError", "Request", "BatchQueue",
+           "Scheduler"]
+
+
+class ServeError(MXNetError):
+    """Root of mx.serve errors."""
+
+
+class ServerOverloaded(ServeError):
+    """The batch queue is full: the request was rejected, not queued.
+    Clients should back off and retry (HTTP surface: 429)."""
+
+
+class ServerClosed(ServeError):
+    """The server is shut down (or shutting down without drain)."""
+
+
+class RequestTimeout(ServeError, TimeoutError):
+    """The request's deadline expired before it was dispatched."""
+
+
+class NoBucketError(ServeError, ValueError):
+    """No configured shape bucket can hold the request's input shapes."""
+
+
+def _fail(req, exc, result):
+    """Resolve a request exceptionally (idempotent) + count the outcome."""
+    try:
+        req.future.set_exception(exc)
+    except InvalidStateError:
+        return
+    if telemetry.ENABLED:
+        telemetry.SERVE_REQUESTS.labels(result=result).inc()
+
+
+class Request:
+    """One queued inference request.
+
+    ``inputs`` is a tuple of numpy arrays (one per model input);
+    ``bucket_class`` is the hashable bucket the runner assigned (only
+    same-class requests are batched together); ``deadline`` is a
+    monotonic timestamp or None."""
+
+    __slots__ = ("inputs", "single", "bucket_class", "future",
+                 "enqueued", "deadline")
+
+    def __init__(self, inputs, bucket_class, deadline=None, single=True):
+        self.inputs = tuple(inputs)
+        self.single = single
+        self.bucket_class = bucket_class
+        self.future = Future()
+        self.enqueued = time.perf_counter()
+        self.deadline = deadline
+
+    def expired(self, now=None):
+        return self.deadline is not None and \
+            (time.perf_counter() if now is None else now) >= self.deadline
+
+
+class BatchQueue:
+    """Bounded FIFO with class-grouped batch collection.
+
+    ``put`` never blocks: it raises ``ServerOverloaded`` when ``depth``
+    requests are already queued (reject-early backpressure) and
+    ``ServerClosed`` after ``close()``.  ``collect`` is the scheduler's
+    side: it blocks for the next micro-batch, expiring dead requests
+    along the way, and returns None once the queue is closed AND
+    drained."""
+
+    def __init__(self, depth):
+        self._depth = int(depth)
+        self._items = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+
+    def __len__(self):
+        return len(self._items)
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def put(self, req):
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("server is shut down")
+            if len(self._items) >= self._depth:
+                if telemetry.ENABLED:
+                    telemetry.SERVE_REQUESTS.labels(result="rejected").inc()
+                raise ServerOverloaded(
+                    "batch queue full (%d queued, depth=%d): retry with "
+                    "backoff" % (len(self._items), self._depth))
+            self._items.append(req)
+            if telemetry.ENABLED:
+                telemetry.SERVE_QUEUE_DEPTH.set(len(self._items))
+            self._cond.notify_all()
+
+    def close(self):
+        """Stop accepting requests; ``collect`` drains what is queued."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def cancel_pending(self):
+        """Fail every queued request with ServerClosed (abort path)."""
+        with self._cond:
+            items, self._items = list(self._items), deque()
+            if telemetry.ENABLED:
+                telemetry.SERVE_QUEUE_DEPTH.set(0)
+            self._cond.notify_all()
+        for req in items:
+            _fail(req, ServerClosed("server shut down before dispatch"),
+                  "cancelled")
+
+    def _expire_locked(self):
+        if not self._items:
+            return
+        now = time.perf_counter()
+        live = deque(r for r in self._items if not r.expired(now))
+        if len(live) != len(self._items):
+            dead = [r for r in self._items if r.expired(now)]
+            self._items = live
+            if telemetry.ENABLED:
+                telemetry.SERVE_QUEUE_DEPTH.set(len(self._items))
+            for req in dead:
+                _fail(req, RequestTimeout(
+                    "deadline expired after %.1f ms in queue"
+                    % ((now - req.enqueued) * 1e3)), "timeout")
+
+    def collect(self, max_batch, max_wait):
+        """Block for the next micro-batch: up to ``max_batch`` queued
+        requests of the head request's bucket class, waiting at most
+        ``max_wait`` seconds from the head's ENQUEUE for stragglers — a
+        request that already sat out its window while the scheduler ran
+        the previous batch dispatches immediately.  Returns None when
+        closed and drained."""
+        max_batch = max(1, int(max_batch))
+        with self._cond:
+            while True:
+                self._expire_locked()
+                if not self._items:
+                    if self._closed:
+                        return None
+                    self._cond.wait(timeout=0.5)
+                    continue
+                cls = self._items[0].bucket_class
+                t_end = self._items[0].enqueued + max_wait
+                while not self._closed:
+                    n = sum(1 for r in self._items
+                            if r.bucket_class == cls)
+                    if n >= max_batch:
+                        break
+                    remaining = t_end - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                    self._expire_locked()
+                    if not self._items:
+                        break
+                    if not any(r.bucket_class == cls
+                               for r in self._items):
+                        cls = self._items[0].bucket_class
+                        t_end = self._items[0].enqueued + max_wait
+                batch, rest = [], deque()
+                for r in self._items:
+                    if r.bucket_class == cls and len(batch) < max_batch:
+                        batch.append(r)
+                    else:
+                        rest.append(r)
+                self._items = rest
+                if telemetry.ENABLED:
+                    telemetry.SERVE_QUEUE_DEPTH.set(len(self._items))
+                if batch:
+                    return batch
+
+
+class Scheduler:
+    """The single dispatch loop: collect a micro-batch, hand it to the
+    CURRENT model runner, resolve futures.
+
+    ``runner_fn`` is called once per batch — that one read is the hot
+    model swap's atomicity point: a batch runs either entirely on the
+    old runner or entirely on the new one."""
+
+    def __init__(self, queue, runner_fn, max_batch_size=8, max_wait_us=2000):
+        self._queue = queue
+        self._runner_fn = runner_fn
+        self._max_batch = int(max_batch_size)
+        self._max_wait = float(max_wait_us) / 1e6
+        self._thread = None
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="mx-serve-scheduler")
+        self._thread.start()
+
+    @property
+    def alive(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    def _loop(self):
+        while True:
+            try:
+                batch = self._queue.collect(self._max_batch, self._max_wait)
+            except BaseException:  # collect must never kill the loop
+                continue
+            if batch is None:
+                return
+            self._dispatch(batch)
+
+    def _dispatch(self, batch):
+        # deadline re-check: time passed between collect and dispatch
+        now = time.perf_counter()
+        live = []
+        for req in batch:
+            if req.expired(now) or req.future.cancelled():
+                if req.expired(now):
+                    _fail(req, RequestTimeout(
+                        "deadline expired before dispatch"), "timeout")
+                continue
+            live.append(req)
+        if not live:
+            return
+        if telemetry.ENABLED:
+            telemetry.SERVE_BATCHES.inc()
+            telemetry.SERVE_BATCH_SIZE.observe(len(live))
+            for req in live:
+                telemetry.SERVE_QUEUE_WAIT_SECONDS.observe(
+                    now - req.enqueued)
+        runner = self._runner_fn()
+        try:
+            results = runner.run_batch(live)
+        except BaseException as exc:  # noqa: BLE001 - surfaced per-request
+            for req in live:
+                _fail(req, exc, "error")
+            return
+        done_t = time.perf_counter()
+        for req, res in zip(live, results):
+            try:
+                req.future.set_result(res)
+            except InvalidStateError:
+                continue
+            if telemetry.ENABLED:
+                telemetry.SERVE_REQUESTS.labels(result="ok").inc()
+                telemetry.SERVE_REQUEST_SECONDS.observe(
+                    done_t - req.enqueued)
+
+    def stop(self, drain=True, timeout=None):
+        """Close the queue and join the loop.  With ``drain`` (default)
+        queued requests are served first; otherwise they fail with
+        ServerClosed immediately."""
+        self._queue.close()
+        if not drain:
+            self._queue.cancel_pending()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        return not self.alive

@@ -1,0 +1,340 @@
+"""Server — the mx.serve front-end.
+
+Thread-safe ``submit()`` / ``submit_async()`` (futures) over one
+``BatchQueue`` + ``Scheduler``, with:
+
+- **graceful drain**: ``shutdown()`` (default) stops intake, serves
+  everything already queued, then joins the scheduler; ``drain=False``
+  fails queued requests with ``ServerClosed`` instead.
+- **hot model swap**: ``swap()`` builds and WARMS a whole new
+  ``ModelRunner`` from a (new) checkpoint step off the serving path,
+  then replaces the runner reference atomically.  The scheduler reads
+  that reference once per batch, so every request runs entirely on the
+  old model or entirely on the new one — no half-swapped state is
+  observable, and readiness never flaps during a swap.
+- **HTTP endpoint** (stdlib ``http.server``, threading): POST
+  ``/predict``; GET ``/healthz`` (process up), ``/readyz`` (model
+  loaded + buckets warmed -> 200, else 503), ``/metrics`` (Prometheus
+  text), ``/statz`` (JSON: scheduler config, bucket table, queue
+  depth, serve_* totals — what ``tools/diagnose.py --serve`` reads).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as _np
+
+from .. import telemetry
+from ..gluon.block import Block
+from ..ndarray.ndarray import NDArray
+from .batching import (BatchQueue, NoBucketError, Request, RequestTimeout,
+                       Scheduler, ServeError, ServerClosed, ServerOverloaded)
+from .runner import DEFAULT_BATCH_SIZES, ModelRunner
+
+__all__ = ["ServeConfig", "Server"]
+
+
+class ServeConfig:
+    """Batching-policy + bucket-spec knobs (see README "Serving").
+
+    max_batch_size : dispatch as soon as this many same-bucket
+        requests are queued (clamped to the largest batch bucket).
+    max_wait_us : how long an incomplete batch waits for stragglers.
+    queue_depth : bound on queued requests; beyond it submissions are
+        rejected with ``ServerOverloaded`` (explicit backpressure).
+    timeout_ms : default per-request deadline (None = no deadline).
+    batch_sizes : batch-dim padding targets (default: powers of two up
+        to ``max_batch_size``).
+    sample_shapes : per-request shape buckets — a list of shape tuples
+        (single-input) or tuples of per-input shapes.  None = exact
+        shapes, compile-per-new-shape (dev only).
+    dtype : request arrays are cast to this dtype.
+    """
+
+    def __init__(self, max_batch_size=8, max_wait_us=2000, queue_depth=64,
+                 timeout_ms=None, batch_sizes=None, sample_shapes=None,
+                 dtype="float32"):
+        self.max_batch_size = int(max_batch_size)
+        self.max_wait_us = int(max_wait_us)
+        self.queue_depth = int(queue_depth)
+        self.timeout_ms = timeout_ms
+        if batch_sizes is None:
+            batch_sizes = [b for b in DEFAULT_BATCH_SIZES
+                           if b <= self.max_batch_size]
+            while batch_sizes and batch_sizes[-1] < self.max_batch_size:
+                batch_sizes.append(min(batch_sizes[-1] * 2,
+                                       self.max_batch_size))
+            batch_sizes = batch_sizes or [self.max_batch_size]
+        self.batch_sizes = tuple(sorted(set(int(b) for b in batch_sizes)))
+        self.sample_shapes = sample_shapes
+        self.dtype = dtype
+        self.max_batch_size = min(self.max_batch_size, self.batch_sizes[-1])
+
+    def as_dict(self):
+        return {
+            "max_batch_size": self.max_batch_size,
+            "max_wait_us": self.max_wait_us,
+            "queue_depth": self.queue_depth,
+            "timeout_ms": self.timeout_ms,
+            "batch_sizes": list(self.batch_sizes),
+            "sample_shapes": None if self.sample_shapes is None else [
+                [list(s) for s in (sig if not all(
+                    isinstance(d, int) for d in sig) else [sig])]
+                for sig in self.sample_shapes],
+            "dtype": self.dtype,
+        }
+
+
+class Server:
+    """Dynamic-batching inference server over one ModelRunner."""
+
+    def __init__(self, block=None, root=None, step=None, ctx=None,
+                 config=None, runner=None):
+        self._config = config or ServeConfig()
+        self._ctx = ctx
+        # keep the factory (not just the instance) so swap() can build
+        # a FRESH block: loading new params into the live block would
+        # be observable mid-load
+        self._block_factory = block if block is not None and \
+            not isinstance(block, Block) and callable(block) else None
+        if runner is None:
+            if block is None:
+                raise ValueError("Server needs a block (or factory) or a "
+                                 "pre-built runner")
+            runner = ModelRunner(
+                block, root=root, step=step, ctx=ctx,
+                batch_sizes=self._config.batch_sizes,
+                sample_shapes=self._config.sample_shapes,
+                dtype=self._config.dtype)
+        self._runner = runner
+        self._root = root if root is not None else runner.root
+        self._queue = BatchQueue(self._config.queue_depth)
+        self._scheduler = Scheduler(
+            self._queue, lambda: self._runner,
+            max_batch_size=self._config.max_batch_size,
+            max_wait_us=self._config.max_wait_us)
+        self._scheduler.start()
+        self._swap_lock = threading.Lock()
+        self._httpd = None
+        self._closed = False
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def config(self):
+        return self._config
+
+    @property
+    def runner(self):
+        return self._runner
+
+    @property
+    def step(self):
+        return self._runner.step
+
+    def healthy(self):
+        """Liveness: the dispatch loop is running."""
+        return not self._closed and self._scheduler.alive
+
+    def ready(self):
+        """Readiness: healthy AND the current runner finished warm-up
+        (every bucket compiled) — traffic sent now will not hit a
+        cold-compile stall."""
+        return self.healthy() and self._runner.warmed
+
+    def queue_depth(self):
+        return len(self._queue)
+
+    def stats(self):
+        serve_totals = {k: v for k, v in telemetry.totals().items()
+                        if k.startswith("serve_")}
+        by_result = {}
+        req = telemetry.get_metric("serve_requests_total")
+        if req is not None:
+            for values, child in req._samples():
+                if values:
+                    by_result[values[0]] = child.value
+        return {
+            "ready": self.ready(),
+            "healthy": self.healthy(),
+            "queue_depth": self.queue_depth(),
+            "config": self._config.as_dict(),
+            "runner": self._runner.stats(),
+            "requests": by_result,
+            "totals": serve_totals,
+        }
+
+    # -- submission ---------------------------------------------------------
+    def _normalize(self, inputs):
+        """-> (tuple of numpy arrays, single_flag).  A tuple means
+        multi-input; anything else (array/NDArray/nested list) is one
+        input."""
+        single = not isinstance(inputs, tuple)
+        seq = (inputs,) if single else inputs
+        arrays = []
+        for x in seq:
+            if isinstance(x, NDArray):
+                x = x.asnumpy()
+            arrays.append(_np.asarray(x, dtype=self._config.dtype))
+        return tuple(arrays), single
+
+    def submit_async(self, inputs, timeout_ms=None):
+        """Enqueue one request; returns a ``concurrent.futures.Future``
+        resolving to the (unpadded) model output.  Raises
+        ``ServerOverloaded`` when the queue is full, ``NoBucketError``
+        when no shape bucket covers the input, ``ServerClosed`` after
+        shutdown."""
+        if self._closed:
+            raise ServerClosed("server is shut down")
+        arrays, single = self._normalize(inputs)
+        cls = self._runner.bucket_for(tuple(a.shape for a in arrays))
+        timeout_ms = self._config.timeout_ms if timeout_ms is None \
+            else timeout_ms
+        deadline = None if timeout_ms is None \
+            else time.perf_counter() + float(timeout_ms) / 1e3
+        req = Request(arrays, cls, deadline=deadline, single=single)
+        self._queue.put(req)
+        return req.future
+
+    def submit(self, inputs, timeout_ms=None):
+        """Synchronous ``submit_async``: blocks for the result (the
+        scheduler resolves every future — ok, timeout, or error — so
+        this cannot hang on a dead deadline)."""
+        return self.submit_async(inputs, timeout_ms=timeout_ms).result()
+
+    # -- hot swap -----------------------------------------------------------
+    def swap(self, root=None, step=None, block=None):
+        """Atomically repoint serving at a new checkpoint step.
+
+        Builds a NEW runner (fresh block from ``block``/the factory
+        given at construction), restores ``step`` (default: latest
+        committed) from ``root`` (default: the serving root), warms
+        every bucket, then replaces the runner reference.  In-flight
+        batches finish on the old model; requests dispatched after the
+        swap run on the new one.  Returns the restored step."""
+        with self._swap_lock:
+            factory = block if block is not None else self._block_factory
+            if factory is None:
+                raise ServeError(
+                    "hot swap needs a fresh block: construct the Server "
+                    "with a block FACTORY (callable), or pass block= "
+                    "here — reloading params into the live block would "
+                    "not be atomic")
+            new_block = factory() if not isinstance(factory, Block) and \
+                callable(factory) else factory
+            root = self._root if root is None else root
+            if root is None:
+                raise ServeError("hot swap needs a checkpoint root")
+            new_runner = ModelRunner(
+                new_block, root=root, step=step, ctx=self._ctx,
+                batch_sizes=self._config.batch_sizes,
+                sample_shapes=self._config.sample_shapes,
+                dtype=self._config.dtype)
+            self._runner = new_runner  # the atomic publication point
+            self._root = root
+            if telemetry.ENABLED:
+                telemetry.SERVE_SWAPS.inc()
+            return new_runner.step
+
+    # -- lifecycle ----------------------------------------------------------
+    def shutdown(self, drain=True, timeout=None):
+        """Stop intake and join the scheduler.  With ``drain`` (the
+        default) queued requests are served first; with
+        ``drain=False`` they fail fast with ``ServerClosed``."""
+        self._closed = True
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+        return self._scheduler.stop(drain=drain, timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # -- HTTP surface -------------------------------------------------------
+    def start_http(self, host="127.0.0.1", port=0):
+        """Start the stdlib HTTP endpoint on a daemon thread; returns
+        ``(host, port)`` (port 0 picks a free one)."""
+        if self._httpd is not None:
+            return self._httpd.server_address[:2]
+        httpd = ThreadingHTTPServer((host, port), _Handler)
+        httpd.daemon_threads = True
+        httpd.mx_server = self
+        self._httpd = httpd
+        t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                             name="mx-serve-http")
+        t.start()
+        return httpd.server_address[:2]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "mx-serve/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route access logs to logging
+        import logging
+
+        logging.getLogger("mxnet_tpu.serve.http").debug(fmt, *args)
+
+    def _send(self, code, body, content_type="application/json"):
+        data = body if isinstance(body, bytes) else \
+            json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        srv = self.server.mx_server
+        if self.path == "/healthz":
+            if srv.healthy():
+                self._send(200, {"status": "ok"})
+            else:
+                self._send(503, {"status": "down"})
+        elif self.path == "/readyz":
+            ready = srv.ready()
+            self._send(200 if ready else 503,
+                       {"ready": ready, "step": srv.step})
+        elif self.path == "/metrics":
+            self._send(200, telemetry.prometheus().encode(),
+                       content_type="text/plain; version=0.0.4")
+        elif self.path == "/statz":
+            self._send(200, srv.stats())
+        else:
+            self._send(404, {"error": "unknown path %s" % self.path})
+
+    def do_POST(self):  # noqa: N802
+        srv = self.server.mx_server
+        if self.path != "/predict":
+            self._send(404, {"error": "unknown path %s" % self.path})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n) or b"{}")
+            inputs = payload["inputs"]
+            if payload.get("multi"):
+                inputs = tuple(inputs)
+            out = srv.submit(inputs,
+                             timeout_ms=payload.get("timeout_ms"))
+            if isinstance(out, tuple):
+                body = {"outputs": [o.tolist() for o in out]}
+            else:
+                body = {"outputs": out.tolist()}
+            body["step"] = srv.step
+            self._send(200, body)
+        except ServerOverloaded as exc:
+            self._send(429, {"error": str(exc)})
+        except RequestTimeout as exc:
+            self._send(504, {"error": str(exc)})
+        except ServerClosed as exc:
+            self._send(503, {"error": str(exc)})
+        except (KeyError, ValueError, NoBucketError) as exc:
+            self._send(400, {"error": str(exc)})
+        except Exception as exc:  # noqa: BLE001 - surfaced to the client
+            self._send(500, {"error": str(exc)})

@@ -228,6 +228,14 @@ class Metric:
     def value(self):
         return self._delegate().value
 
+    @property
+    def count(self):
+        return self._delegate().count
+
+    @property
+    def sum(self):
+        return self._delegate().sum
+
     def _samples(self):
         """[(labelvalues tuple, child), ...] including the default child.
 
@@ -606,5 +614,38 @@ CHECKPOINT_SAVES = counter(
     ("result",))
 CHECKPOINT_RESTORES = counter(
     "checkpoint_restores_total", "checkpoint restore calls")
+# mx.serve (serve/): dynamic-batching inference serving.  Queue wait is
+# the time a request sat in the BatchQueue before its micro-batch was
+# dispatched; pad waste is the zero-fill the bucket table forced.
+SERVE_REQUESTS = counter(
+    "serve_requests_total", "serving requests by outcome "
+    "(ok/rejected/timeout/error/cancelled)", ("result",))
+SERVE_REQUEST_SECONDS = histogram(
+    "serve_request_seconds",
+    "end-to-end request latency (enqueue -> result set)")
+SERVE_QUEUE_WAIT_SECONDS = histogram(
+    "serve_queue_wait_seconds",
+    "time a request waited in the batch queue before dispatch")
+SERVE_QUEUE_DEPTH = gauge(
+    "serve_queue_depth", "requests currently waiting in the batch queue")
+SERVE_BATCHES = counter(
+    "serve_batches_total", "micro-batches dispatched to the model runner")
+SERVE_BATCH_SIZE = histogram(
+    "serve_batch_size", "requests coalesced per dispatched micro-batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+SERVE_PAD_ELEMENTS = counter(
+    "serve_pad_elements_total",
+    "zero elements added by bucket padding (batch + shape fill)")
+SERVE_PAD_FRACTION = histogram(
+    "serve_pad_fraction",
+    "padded/total element fraction per dispatched micro-batch",
+    buckets=(0.01, 0.05, 0.1, 0.2, 0.3, 0.5, 0.7, 0.9))
+SERVE_COMPILES = counter(
+    "serve_compile_total",
+    "hybridize compiles triggered by serving, by bucket "
+    "(steady state: one per bucket, all during warm-up)", ("bucket",))
+SERVE_SWAPS = counter(
+    "serve_model_swaps_total", "hot model swaps (atomic runner "
+    "replacement pointing at a new checkpoint step)")
 
 start_logger()

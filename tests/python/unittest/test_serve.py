@@ -1,0 +1,429 @@
+"""mx.serve tests: bucketing/pad correctness (padded result equals the
+unpadded forward), warm-up compile-once, deadline expiry, backpressure
+rejection (never hangs), graceful drain, hot-swap atomicity (no request
+observes a half-swapped model), telemetry counter deltas, and the HTTP
+surface."""
+import json
+import tempfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serve, telemetry
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.serve.batching import BatchQueue, Request
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    telemetry.enable()
+    telemetry.reset()
+    yield
+    telemetry.enable()
+    telemetry.reset()
+
+
+def _factory(in_units=16, units=4):
+    # Dense over the last dim: row-independent, so batch/sequence
+    # padding followed by slicing is exact
+    def make():
+        return nn.Dense(units, flatten=False, in_units=in_units)
+    return make
+
+
+def _checkpointed_model(tmp_path, step=1, scale=None):
+    make = _factory()
+    blk = make()
+    blk.initialize()
+    blk(mx.nd.zeros((1, 2, 16)))
+    if scale is not None:
+        for p in blk.collect_params().values():
+            p.set_data(mx.nd.array(np.full(p.shape, scale,
+                                           dtype="float32")))
+    root = str(tmp_path / "ckpt")
+    blk.save_checkpoint(root, step=step)
+    return make, blk, root
+
+
+def _server(make, root, **cfg_kwargs):
+    cfg_kwargs.setdefault("max_batch_size", 4)
+    cfg_kwargs.setdefault("batch_sizes", (4,))
+    cfg_kwargs.setdefault("sample_shapes", [(8, 16), (16, 16)])
+    cfg_kwargs.setdefault("max_wait_us", 1000)
+    cfg = serve.ServeConfig(**cfg_kwargs)
+    return serve.Server(make, root=root, config=cfg)
+
+
+class _GatedRunner(serve.ModelRunner):
+    """Real runner whose dispatch can be stalled deterministically."""
+
+    def __init__(self, *a, **k):
+        self.gate = threading.Event()
+        self.gate.set()
+        self.served = []          # every Request that reached the model
+        super().__init__(*a, **k)
+
+    def run_batch(self, requests):
+        self.gate.wait()
+        self.served.extend(requests)
+        return super().run_batch(requests)
+
+
+# ---------------------------------------------------------------------------
+# feature flag
+# ---------------------------------------------------------------------------
+
+def test_serve_feature_flag():
+    from mxnet_tpu import runtime
+
+    assert runtime.features.is_enabled("SERVE")
+    assert any(f.name == "SERVE" and f.enabled
+               for f in runtime.feature_list())
+    assert mx.serve is serve  # exposed as mx.serve
+
+
+# ---------------------------------------------------------------------------
+# bucketing + padding
+# ---------------------------------------------------------------------------
+
+def test_bucket_for_picks_smallest_cover(tmp_path):
+    make, _, root = _checkpointed_model(tmp_path)
+    runner = serve.ModelRunner(make, root=root, batch_sizes=(4,),
+                               sample_shapes=[(16, 16), (8, 16)],
+                               warm=False)
+    # table is sorted by volume, so (8,16) is bucket 0
+    assert runner.bucket_for(((5, 16),)) == 0
+    assert runner.bucket_for(((8, 16),)) == 0
+    assert runner.bucket_for(((9, 16),)) == 1
+    with pytest.raises(serve.NoBucketError):
+        runner.bucket_for(((17, 16),))     # taller than every bucket
+    with pytest.raises(serve.NoBucketError):
+        runner.bucket_for(((8, 32),))      # wider than every bucket
+    with pytest.raises(serve.NoBucketError):
+        runner.bucket_for(((8,),))         # rank mismatch
+
+
+def test_padded_result_equals_unpadded_forward(tmp_path):
+    make, blk, root = _checkpointed_model(tmp_path)
+    with _server(make, root) as srv:
+        rng = np.random.RandomState(0)
+        for shape in ((3, 16), (8, 16), (11, 16)):
+            x = rng.rand(*shape).astype("float32")
+            got = srv.submit(x)
+            want = blk(mx.nd.array(x[None])).asnumpy()[0]
+            assert got.shape == want.shape
+            np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+def test_pad_waste_metered(tmp_path):
+    make, _, root = _checkpointed_model(tmp_path)
+    with _server(make, root) as srv:
+        srv.submit(np.ones((5, 16), dtype="float32"))
+        # bucket (8,16) at batch 4: 4*8*16 total, 5*16 real
+        assert telemetry.value("serve_pad_elements_total") == \
+            4 * 8 * 16 - 5 * 16
+        assert telemetry.value("serve_pad_fraction") == 1  # one observation
+
+
+def test_warm_up_compiles_each_bucket_once(tmp_path):
+    make, _, root = _checkpointed_model(tmp_path)
+    with _server(make, root) as srv:
+        assert srv.ready()
+        buckets = srv.runner.stats()["buckets"]
+        assert buckets == ["4x8,16", "4x16,16"]
+        for b in buckets:
+            assert telemetry.value("serve_compile_total",
+                                   {"bucket": b}) == 1
+        builds = telemetry.value("cachedop_build_total")
+        # traffic across both buckets: cache hits only
+        srv.submit(np.ones((4, 16), dtype="float32"))
+        srv.submit(np.ones((12, 16), dtype="float32"))
+        assert telemetry.value("cachedop_build_total") == builds
+        # re-warming is a no-op
+        assert srv.runner.warm_up() == 0
+        # ...in every signature spelling: bare shape and (shape, dtype)
+        assert srv.runner.block.warm_up([(4, 8, 16)]) == 0
+        assert srv.runner.block.warm_up([((4, 8, 16), "float32")]) == 0
+
+
+def test_multi_input_requests(tmp_path):
+    class TwoIn(nn.HybridSequential):
+        def forward(self, a, b):
+            return a + b
+
+    def make():
+        return TwoIn()
+
+    runner = serve.ModelRunner(make, batch_sizes=(2,),
+                               sample_shapes=[((4,), (4,))])
+    srv = serve.Server(runner=runner,
+                       config=serve.ServeConfig(
+                           max_batch_size=2, batch_sizes=(2,),
+                           sample_shapes=[((4,), (4,))]))
+    try:
+        a = np.arange(3, dtype="float32")
+        b = np.ones(3, dtype="float32")
+        out = srv.submit((a, b))  # tuple = multi-input
+        np.testing.assert_allclose(out, a + b)
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# queue policy: coalescing, deadlines, backpressure, drain
+# ---------------------------------------------------------------------------
+
+def test_batchqueue_collects_same_class_only():
+    q = BatchQueue(depth=16)
+    for cls in (0, 0, 1, 0, 1):
+        q.put(Request((np.zeros(1),), cls))
+    batch = q.collect(max_batch=8, max_wait=0.0)
+    assert [r.bucket_class for r in batch] == [0, 0, 0]
+    batch = q.collect(max_batch=8, max_wait=0.0)
+    assert [r.bucket_class for r in batch] == [1, 1]
+
+
+def test_batchqueue_max_batch_dispatches_immediately():
+    q = BatchQueue(depth=16)
+    for _ in range(6):
+        q.put(Request((np.zeros(1),), 0))
+    t0 = time.perf_counter()
+    batch = q.collect(max_batch=4, max_wait=10.0)
+    assert len(batch) == 4                      # capped
+    assert time.perf_counter() - t0 < 1.0       # no max_wait stall
+    assert len(q.collect(max_batch=4, max_wait=0.0)) == 2
+
+
+def test_backpressure_rejects_fast_and_meters(tmp_path):
+    make, _, root = _checkpointed_model(tmp_path)
+    cfg = serve.ServeConfig(max_batch_size=4, batch_sizes=(4,),
+                            sample_shapes=[(8, 16)], queue_depth=3)
+    runner = _GatedRunner(make, root=root, batch_sizes=cfg.batch_sizes,
+                          sample_shapes=cfg.sample_shapes)
+    srv = serve.Server(runner=runner, config=cfg)
+    try:
+        runner.gate.clear()
+        x = np.ones((4, 16), dtype="float32")
+        futs = [srv.submit_async(x) for _ in range(3)]
+        t0 = time.perf_counter()
+        with pytest.raises(serve.ServerOverloaded):
+            srv.submit_async(x)
+        assert time.perf_counter() - t0 < 1.0   # reject, don't block
+        assert telemetry.value("serve_requests_total",
+                               {"result": "rejected"}) == 1
+        runner.gate.set()
+        for f in futs:
+            f.result(timeout=30)
+    finally:
+        runner.gate.set()
+        srv.shutdown()
+
+
+def test_deadline_expiry_never_dispatches(tmp_path):
+    make, _, root = _checkpointed_model(tmp_path)
+    cfg = serve.ServeConfig(max_batch_size=4, batch_sizes=(4,),
+                            sample_shapes=[(8, 16)])
+    runner = _GatedRunner(make, root=root, batch_sizes=cfg.batch_sizes,
+                          sample_shapes=cfg.sample_shapes)
+    srv = serve.Server(runner=runner, config=cfg)
+    try:
+        runner.gate.clear()
+        x = np.ones((4, 16), dtype="float32")
+        blocker = srv.submit_async(x)   # dispatched, stalls in run_batch
+        for _ in range(500):            # wait until the scheduler took it
+            if srv.queue_depth() == 0:
+                break
+            time.sleep(0.01)
+        assert srv.queue_depth() == 0
+        # this one waits IN THE QUEUE behind the stalled batch until its
+        # deadline passes, so expiry must fail it before dispatch
+        fut = srv.submit_async(x, timeout_ms=30)
+        time.sleep(0.1)
+        runner.gate.set()
+        with pytest.raises(serve.RequestTimeout):
+            fut.result(timeout=30)
+        blocker.result(timeout=30)      # the undeadlined request completes
+        assert telemetry.value("serve_requests_total",
+                               {"result": "timeout"}) == 1
+        assert telemetry.value("serve_requests_total",
+                               {"result": "ok"}) == 1
+        # the expired request never reached the model
+        assert all(r.future is not fut for r in runner.served)
+    finally:
+        runner.gate.set()
+        srv.shutdown()
+
+
+def test_graceful_drain_serves_queued_requests(tmp_path):
+    make, blk, root = _checkpointed_model(tmp_path)
+    cfg = serve.ServeConfig(max_batch_size=2, batch_sizes=(2,),
+                            sample_shapes=[(8, 16)], queue_depth=32)
+    runner = _GatedRunner(make, root=root, batch_sizes=cfg.batch_sizes,
+                          sample_shapes=cfg.sample_shapes)
+    srv = serve.Server(runner=runner, config=cfg)
+    runner.gate.clear()
+    x = np.ones((4, 16), dtype="float32")
+    futs = [srv.submit_async(x) for _ in range(5)]
+    runner.gate.set()
+    assert srv.shutdown(drain=True, timeout=60)
+    want = blk(mx.nd.array(x[None])).asnumpy()[0]
+    for f in futs:
+        np.testing.assert_allclose(f.result(timeout=1), want,
+                                   rtol=2e-5, atol=1e-6)
+    with pytest.raises(serve.ServerClosed):
+        srv.submit(x)
+
+
+def test_shutdown_without_drain_fails_pending(tmp_path):
+    make, _, root = _checkpointed_model(tmp_path)
+    cfg = serve.ServeConfig(max_batch_size=2, batch_sizes=(2,),
+                            sample_shapes=[(8, 16)], queue_depth=32)
+    runner = _GatedRunner(make, root=root, batch_sizes=cfg.batch_sizes,
+                          sample_shapes=cfg.sample_shapes)
+    srv = serve.Server(runner=runner, config=cfg)
+    runner.gate.clear()
+    futs = [srv.submit_async(np.ones((4, 16), dtype="float32"))
+            for _ in range(4)]
+    # requests still queued (not yet collected) must fail fast
+    runner.gate.set()
+    srv.shutdown(drain=False, timeout=60)
+    failed = 0
+    for f in futs:
+        try:
+            f.result(timeout=5)
+        except serve.ServeError:
+            failed += 1
+    assert failed >= 1
+
+
+# ---------------------------------------------------------------------------
+# hot swap
+# ---------------------------------------------------------------------------
+
+def test_hot_swap_is_atomic(tmp_path):
+    make = _factory(in_units=8)
+
+    blk = make()
+    blk.initialize()
+    blk(mx.nd.zeros((1, 2, 8)))
+    root = str(tmp_path / "ckpt")
+    for step, val in ((1, 1.0), (2, 2.0)):
+        for p in blk.collect_params().values():
+            p.set_data(mx.nd.array(np.full(p.shape, val, dtype="float32")))
+        blk.save_checkpoint(root, step=step)
+
+    cfg = serve.ServeConfig(max_batch_size=2, batch_sizes=(2,),
+                            sample_shapes=[(4, 8)], max_wait_us=200,
+                            queue_depth=64)
+    srv = serve.Server(make, root=root, step=1, config=cfg)
+    try:
+        x = np.ones((4, 8), dtype="float32")
+        out1 = float(srv.submit(x)[0, 0])     # w=1,b=1: 8+1
+        assert out1 == 9.0
+
+        seen, stop = [], threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                seen.append(float(srv.submit(x)[0, 0]))
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        try:
+            assert srv.swap() == 2            # default: latest committed
+        finally:
+            stop.set()
+            t.join()
+        assert float(srv.submit(x)[0, 0]) == 18.0
+        # every request saw EXACTLY model 1 or model 2, never a mixture
+        assert set(seen) <= {9.0, 18.0}
+        assert telemetry.value("serve_model_swaps_total") == 1
+        assert srv.step == 2
+    finally:
+        srv.shutdown()
+
+
+def test_swap_without_factory_fails_loudly(tmp_path):
+    make, _, root = _checkpointed_model(tmp_path)
+    blk = make()
+    srv = _server(blk, root)  # instance, not factory
+    try:
+        with pytest.raises(serve.ServeError):
+            srv.swap()
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# telemetry integration
+# ---------------------------------------------------------------------------
+
+def test_serve_counter_deltas_and_prometheus(tmp_path):
+    make, _, root = _checkpointed_model(tmp_path)
+    with _server(make, root) as srv:
+        n0 = telemetry.value("serve_requests_total", {"result": "ok"})
+        for _ in range(3):
+            srv.submit(np.ones((5, 16), dtype="float32"))
+        assert telemetry.value("serve_requests_total",
+                               {"result": "ok"}) - n0 == 3
+        assert telemetry.value("serve_batches_total") >= 1
+        m = telemetry.get_metric("serve_queue_wait_seconds")
+        assert m.count == 3
+        prom = telemetry.prometheus()
+        for fam in ("serve_requests_total", "serve_batch_size",
+                    "serve_queue_wait_seconds", "serve_request_seconds",
+                    "serve_pad_elements_total", "serve_compile_total",
+                    "serve_model_swaps_total", "serve_queue_depth"):
+            assert "# TYPE %s" % fam in prom
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+# ---------------------------------------------------------------------------
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, json.load(r)
+
+
+def test_http_predict_health_ready_statz_metrics(tmp_path):
+    make, blk, root = _checkpointed_model(tmp_path)
+    with _server(make, root) as srv:
+        host, port = srv.start_http()
+        base = "http://%s:%d" % (host, port)
+        assert _get(base + "/healthz")[0] == 200
+        status, ready = _get(base + "/readyz")
+        assert status == 200 and ready == {"ready": True, "step": 1}
+
+        x = np.ones((5, 16), dtype="float32")
+        body = json.dumps({"inputs": x.tolist()}).encode()
+        req = urllib.request.Request(base + "/predict", data=body)
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.load(r)
+        want = blk(mx.nd.array(x[None])).asnumpy()[0]
+        np.testing.assert_allclose(np.array(out["outputs"],
+                                            dtype="float32"),
+                                   want, rtol=2e-5, atol=1e-6)
+        assert out["step"] == 1
+
+        status, stats = _get(base + "/statz")
+        assert status == 200
+        assert stats["config"]["max_batch_size"] == 4
+        assert stats["runner"]["buckets"] == ["4x8,16", "4x16,16"]
+        assert stats["requests"].get("ok", 0) >= 1
+
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            prom = r.read().decode()
+        assert "serve_requests_total" in prom
+
+        # malformed + oversized requests -> 400, not 500
+        bad = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"inputs": np.ones((99, 16)).tolist()}).encode())
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad, timeout=10)
+        assert ei.value.code == 400

@@ -163,6 +163,80 @@ def checkpoints_info(root):
                  "  <- latest restorable" if step == latest else ""))
 
 
+def serve_info(src):
+    """Dump the serving plane: scheduler config, bucket table, queue
+    depth and rejection/outcome counters.  ``src`` is either a RUNNING
+    server's base URL (http://host:port — reads its /statz endpoint)
+    or a telemetry JSON snapshot path (as written by
+    ``telemetry.dump``)."""
+    section("Serving")
+    import json
+
+    if src.startswith("http://") or src.startswith("https://"):
+        import urllib.request
+
+        with urllib.request.urlopen(src.rstrip("/") + "/statz",
+                                    timeout=10) as resp:
+            stats = json.load(resp)
+        print("source       : %s/statz (live)" % src.rstrip("/"))
+        print("ready        : %s   healthy: %s"
+              % (stats.get("ready"), stats.get("healthy")))
+        cfg = stats.get("config", {})
+        for k in ("max_batch_size", "max_wait_us", "queue_depth",
+                  "timeout_ms", "batch_sizes", "dtype"):
+            print("%-12s : %r" % (k, cfg.get(k)))
+        runner = stats.get("runner", {})
+        print("model        : step=%r root=%r warmed=%r compiled=%r"
+              % (runner.get("step"), runner.get("root"),
+                 runner.get("warmed"), runner.get("compiled_signatures")))
+        print("buckets      : %s"
+              % (", ".join(runner.get("buckets", [])) or "(exact shapes)"))
+        print("queue depth  : %r" % stats.get("queue_depth"))
+        totals = dict(stats.get("totals", {}))
+        totals.pop("serve_requests_total", None)
+        for result, v in sorted(stats.get("requests", {}).items()):
+            totals["serve_requests_total{result=%s}" % result] = v
+    else:
+        with open(src) as f:
+            snap = json.load(f)
+        metrics = snap.get("metrics", snap)
+        print("source       : %s (snapshot)" % src)
+        depth = metrics.get("serve_queue_depth", {}).get("samples", [])
+        print("queue depth  : %r"
+              % (depth[0]["value"] if depth else "n/a"))
+        compiles = metrics.get("serve_compile_total", {}).get("samples", [])
+        if compiles:
+            print("buckets      : %s" % ", ".join(
+                "%s (%d compiles)" % (s["labels"].get("bucket"),
+                                      s["value"]) for s in compiles))
+        totals = {}
+        for name, m in sorted(metrics.items()):
+            if not name.startswith("serve_"):
+                continue
+            for s in m.get("samples", []):
+                if m.get("type") == "histogram":
+                    totals[name + "_count"] = \
+                        totals.get(name + "_count", 0) + s.get("count", 0)
+                else:
+                    key = name if not s.get("labels") else \
+                        "%s{%s}" % (name, ",".join(
+                            "%s=%s" % kv
+                            for kv in sorted(s["labels"].items())))
+                    totals[key] = totals.get(key, 0) + s.get("value", 0)
+    print("requests     :")
+    shown = False
+    for k in sorted(totals):
+        if k.startswith("serve_requests_total"):
+            print("  %-36s %g" % (k, totals[k]))
+            shown = True
+    if not shown:
+        print("  (no serve_requests_total samples)")
+    print("other serve_* totals:")
+    for k in sorted(totals):
+        if not k.startswith("serve_requests_total") and totals[k]:
+            print("  %-36s %g" % (k, totals[k]))
+
+
 def env_info():
     section("Environment")
     from mxnet_tpu import config
@@ -187,7 +261,18 @@ def main():
                     help="audit a checkpoint root: steps, sizes, "
                          "checksum status (read-only; skips the "
                          "environment sections, honors --telemetry)")
+    ap.add_argument("--serve", metavar="SRC",
+                    help="dump serving-plane state (scheduler config, "
+                         "bucket table, queue/rejection counters) from "
+                         "a running server URL (http://host:port) or a "
+                         "telemetry JSON snapshot file")
     args = ap.parse_args()
+    if args.serve:
+        serve_info(args.serve)
+        if args.telemetry:
+            telemetry_info()
+        print()
+        return
     if args.checkpoints:
         checkpoints_info(args.checkpoints)
         if args.telemetry:
