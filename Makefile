@@ -9,7 +9,8 @@ LDLIBS ?= -ljpeg -lz
 SRCS := $(wildcard src/native/*.cc)
 SO := build/libmxtpu_native.so
 
-.PHONY: native test cpptest telemetry-smoke checkpoint-smoke serve-smoke clean
+.PHONY: native test cpptest telemetry-smoke checkpoint-smoke serve-smoke \
+	compile-cache-smoke clean
 
 native: $(SO)
 
@@ -54,6 +55,16 @@ serve-smoke:
 	JAX_PLATFORMS=cpu python tools/serve_smoke.py
 	JAX_PLATFORMS=cpu python -m pytest \
 	  tests/python/unittest/test_serve.py -q -m 'not slow'
+
+# mx.compile smoke: compile in process A -> process B warm-starts from
+# the persistent cache with 0 fresh jax.jit builds (verified through
+# cachedop_build / compile_cache_hit telemetry deltas) -> a corrupted
+# artifact is quarantined and the run degrades to an in-memory compile;
+# then the subsystem's pytest suite
+compile-cache-smoke:
+	JAX_PLATFORMS=cpu python tools/compile_cache_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/python/unittest/test_compile_cache.py -q -m 'not slow'
 
 # suite summary artifact (TESTS_r{N}.json) — round-2 advisor contract
 test-report:

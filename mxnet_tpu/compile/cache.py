@@ -1,0 +1,551 @@
+"""CompileCache — the disk store behind mx.compile.
+
+One cache entry per compiled XLA executable, keyed by a SHA-256
+fingerprint of (StableHLO text of the lowered program, backend platform
++ device topology, jax & framework versions, relevant XLA env flags).
+Anything that could make a stored executable wrong for this process is
+IN the key, so a mismatch is a clean miss — never a wrong artifact.
+
+Entry layout (``<root>/<fp[:2]>/<fp>/``)::
+
+    ARTIFACT.bin   # pickle: {exe, in_tree, out_tree, key}
+    META.json      # JSON-safe metadata: out/in specs, block sig, crc32
+    COMMITTED      # two-phase marker, written LAST (fsync'd)
+
+Durability follows the mx.checkpoint discipline (the primitives are
+imported from ``checkpoint/layout.py``): every file is written +
+fsync'd into a hidden temp dir, the COMMITTED marker lands last, and
+the temp dir is atomically renamed into place.  Concurrent writers
+race benignly: the key is content-derived, so whichever commit renames
+first wins and the loser just discards its temp dir.  Corrupt entries
+(bad CRC, truncated file, missing marker) are quarantined — renamed to
+``*.corrupt`` so no future load ever trusts them — and counted.
+
+An LRU size cap (``max_bytes``) evicts the least-recently-LOADED
+entries after each commit; loads refresh the entry dir's mtime.
+
+Every method that touches storage is exception-safe: cache I/O failure
+degrades to a miss (or a no-op), never an error on the compile path.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import shutil
+import time
+
+from .. import telemetry
+from ..base import get_env
+from ..checkpoint import layout as _layout
+
+__all__ = ["CompileCache", "default_cache_dir", "block_signature",
+           "FORMAT"]
+
+FORMAT = "mx-compile-cache-v1"
+ARTIFACT = "ARTIFACT.bin"
+META = "META.json"
+COMMITTED = "COMMITTED"
+BY_BLOCK = "by-block"  # <root>/by-block/<sig[:2]>/<sig>/<fp> markers
+
+_LOGGER = logging.getLogger("mxnet_tpu.compile")
+
+# temp commit dirs older than this are swept before each commit (a
+# fresh one may belong to another process's in-flight commit)
+_STALE_TMP_SECONDS = 3600.0
+
+DEFAULT_MAX_BYTES = 1 << 30
+
+
+def default_cache_dir():
+    """MXNET_COMPILE_CACHE_DIR, else ``<MXNET_HOME>/compile_cache``."""
+    d = get_env("MXNET_COMPILE_CACHE_DIR", str, None)
+    if not d:
+        home = get_env("MXNET_HOME", str, "~/.mxnet")
+        d = os.path.join(home, "compile_cache")
+    return os.path.expanduser(d)
+
+
+def block_signature(block):
+    """Stable cross-process identity of a hybridizable block: class
+    qualname + sorted (param name, shape, dtype).  Returns None while
+    any parameter is uninitialized (shapes unknown -> no identity
+    yet)."""
+    try:
+        params = block.collect_params()
+    except Exception:
+        return None
+    parts = ["%s.%s" % (type(block).__module__, type(block).__qualname__)]
+    for name in sorted(params):
+        p = params[name]
+        if p._data is None:
+            return None
+        parts.append("%s:%s:%s" % (name, tuple(p._data.shape),
+                                   str(p._data.dtype)))
+    h = hashlib.sha256("\n".join(parts).encode())
+    return h.hexdigest()
+
+
+class CompileCache:
+    """Persistent, size-capped artifact store (see module docstring)."""
+
+    def __init__(self, root=None, max_bytes=None):
+        self._root = os.path.abspath(root or default_cache_dir())
+        if max_bytes is None:
+            max_bytes = get_env("MXNET_COMPILE_CACHE_MAX_BYTES", int,
+                                DEFAULT_MAX_BYTES)
+        self._max_bytes = int(max_bytes)
+        self._env_fp = None  # lazily computed: touches jax.devices()
+        # directory creation and the stale-temp sweep are deferred to
+        # the first commit: read-only consumers (stats(), diagnose
+        # --compile-cache audits) must not mutate the filesystem
+
+    # -- fingerprinting -----------------------------------------------------
+    def _env_parts(self):
+        """Everything besides the program itself that decides whether a
+        stored executable is valid here: backend platform, device
+        topology, jax/framework versions, XLA-relevant env flags."""
+        if self._env_fp is None:
+            import jax
+
+            from .. import __version__
+
+            try:
+                import jaxlib
+
+                jaxlib_ver = jaxlib.__version__
+            except Exception:
+                jaxlib_ver = "unknown"
+            devs = jax.devices()
+            topo = ";".join("%s:%s:%d:%d" % (d.platform, d.device_kind,
+                                             d.id, d.process_index)
+                            for d in devs)
+            self._env_fp = "\n".join([
+                FORMAT,
+                "platform=%s" % jax.default_backend(),
+                "topology=%s" % topo,
+                "jax=%s" % jax.__version__,
+                # jaxlib ships the XLA runtime and versions
+                # independently of jax: an executable serialized by an
+                # older compiler must be a clean miss after a
+                # jaxlib-only upgrade
+                "jaxlib=%s" % jaxlib_ver,
+                "framework=%s" % __version__,
+                "xla_flags=%s" % os.environ.get("XLA_FLAGS", ""),
+                "libtpu_init_args=%s"
+                % os.environ.get("LIBTPU_INIT_ARGS", ""),
+            ])
+        return self._env_fp
+
+    def fingerprint(self, hlo_text):
+        """SHA-256 hex key of (StableHLO text, environment parts)."""
+        h = hashlib.sha256()
+        h.update(self._env_parts().encode())
+        h.update(b"\0")
+        h.update(hlo_text.encode() if isinstance(hlo_text, str)
+                 else hlo_text)
+        return h.hexdigest()
+
+    def env_fingerprint(self):
+        """SHA-256 hex of the environment parts ALONE.  Stored in META
+        at commit so ``warm_start`` — which never re-lowers, so it can't
+        recompute the full program fingerprint — can still reject
+        entries built under a different platform/topology/version/flag
+        environment instead of silently installing them."""
+        return hashlib.sha256(self._env_parts().encode()).hexdigest()
+
+    # -- paths --------------------------------------------------------------
+    @property
+    def root(self):
+        return self._root
+
+    @property
+    def max_bytes(self):
+        return self._max_bytes
+
+    def _entry_dir(self, fp):
+        return os.path.join(self._root, fp[:2], fp)
+
+    def _index_dir(self, block_sig):
+        return os.path.join(self._root, BY_BLOCK, block_sig[:2],
+                            block_sig)
+
+    def _sweep_stale_tmp(self):
+        now = time.time()
+        try:
+            names = os.listdir(self._root)
+        except OSError:
+            return
+        for name in names:
+            if not name.startswith(".committing-"):
+                continue
+            p = os.path.join(self._root, name)
+            try:
+                if now - os.path.getmtime(p) > _STALE_TMP_SECONDS:
+                    shutil.rmtree(p, ignore_errors=True)
+            except OSError:
+                pass
+
+    # -- load ---------------------------------------------------------------
+    def load(self, fp):
+        """Return ``(artifact_bytes, meta_dict)`` for a committed,
+        checksum-clean entry, else None.  Corruption quarantines the
+        entry; any other I/O failure is a plain miss.  A successful
+        load refreshes the entry's LRU clock."""
+        d = self._entry_dir(fp)
+        t0 = time.perf_counter()
+        try:
+            if not os.path.isfile(os.path.join(d, COMMITTED)):
+                if os.path.isdir(d):
+                    # marker-less dir = torn remains of an interrupted
+                    # eviction/clear (commits publish atomically, so a
+                    # live entry always has its marker): park it so its
+                    # bytes count against the cap and the next commit
+                    # of this fingerprint can land
+                    self.quarantine(fp, reason="torn entry (no marker)")
+                return None
+        except OSError:
+            return None
+        try:
+            with open(os.path.join(d, META)) as f:
+                meta = json.load(f)
+            with open(os.path.join(d, ARTIFACT), "rb") as f:
+                raw = f.read()
+            import zlib
+
+            if len(raw) != meta.get("artifact_nbytes") or \
+                    (zlib.crc32(raw) & 0xFFFFFFFF) != meta.get(
+                        "artifact_crc32"):
+                self.quarantine(fp, reason="checksum mismatch")
+                return None
+        except FileNotFoundError:
+            # a component vanished under us: when another process's
+            # eviction is concurrently rmtree-ing this entry the
+            # COMMITTED marker is (or will be) gone too — that is a
+            # plain miss, not corruption.  Only a dir STILL claiming
+            # completeness via its marker is genuinely torn and must be
+            # quarantined, or commit() would forever treat the broken
+            # dir as already-present and discard every repair.
+            try:
+                torn = os.path.isfile(os.path.join(d, COMMITTED))
+            except OSError:
+                torn = False
+            if torn:
+                self.quarantine(fp, reason="entry incomplete")
+            return None
+        except ValueError:
+            self.quarantine(fp, reason="META undecodable")
+            return None
+        except OSError:
+            # transient I/O failure (fd exhaustion, EACCES, EIO): the
+            # entry may be perfectly loadable next time — a plain miss,
+            # never a quarantine of a healthy artifact
+            return None
+        try:
+            os.utime(d, None)  # LRU clock
+        except OSError:
+            pass
+        sig = meta.get("block_sig")
+        if sig:
+            # self-heal the warm-start index: a commit whose
+            # best-effort marker write failed would otherwise stay
+            # invisible to entries_for_block forever once the
+            # signature's index dir exists (the scan repair only runs
+            # while it doesn't) — any successful load re-adds it
+            try:
+                if not os.path.isfile(os.path.join(
+                        self._index_dir(sig), fp)):
+                    self._index_add(sig, fp)
+            except OSError:
+                pass
+        if telemetry.ENABLED:
+            telemetry.COMPILE_CACHE_LOAD_SECONDS.observe(
+                time.perf_counter() - t0)
+        return raw, meta
+
+    def quarantine(self, fp, reason=""):
+        """Park a bad entry at ``*.corrupt`` so it is never loaded
+        again (same discipline as checkpoint validate(quarantine))."""
+        d = self._entry_dir(fp)
+        if not os.path.isdir(d):
+            return None
+        q = d + ".corrupt"
+        n = 0
+        while os.path.exists(q):
+            n += 1
+            q = "%s.corrupt.%d" % (d, n)
+        try:
+            os.rename(d, q)
+        except OSError:
+            return None
+        _LOGGER.warning("compile cache entry %s quarantined (%s)",
+                        fp[:12], reason or "corrupt")
+        if telemetry.ENABLED:
+            telemetry.COMPILE_CACHE_QUARANTINE.inc()
+        return q
+
+    # -- commit -------------------------------------------------------------
+    def commit(self, fp, artifact, meta):
+        """Durably publish one entry (write-to-temp + fsync + COMMITTED
+        marker + atomic rename).  Racing writers are benign: if the
+        entry landed meanwhile, this commit discards its temp dir.
+        Returns the entry dir, or None on any I/O failure."""
+        import tempfile
+
+        t0 = time.perf_counter()
+        final = self._entry_dir(fp)
+        try:
+            os.makedirs(os.path.dirname(final), exist_ok=True)
+            self._sweep_stale_tmp()
+            tmp = tempfile.mkdtemp(dir=self._root, prefix=".committing-")
+        except OSError:
+            return None
+        try:
+            crc, n = _layout.write_file_durable(
+                os.path.join(tmp, ARTIFACT), artifact)
+            meta = dict(meta)
+            meta.update({"format": FORMAT, "fingerprint": fp,
+                         "env_fingerprint": self.env_fingerprint(),
+                         "created": time.time(),
+                         "artifact_crc32": crc, "artifact_nbytes": n})
+            _layout.write_file_durable(
+                os.path.join(tmp, META),
+                json.dumps(meta, sort_keys=True).encode())
+            _layout.write_file_durable(
+                os.path.join(tmp, COMMITTED),
+                json.dumps({"fingerprint": fp}).encode())
+            _layout.fsync_dir(tmp)
+            # rename FIRST and diagnose only on failure: checking the
+            # path before renaming is a TOCTOU hole where a racing
+            # writer lands between check and action (and a pre-check
+            # that quarantines a marker-less dir could park the
+            # winner's healthy entry).  rename is atomic, so a
+            # rename-blocking dir is either a complete racing entry
+            # (marker present — equivalent by construction) or torn
+            # remains of an interrupted eviction (marker-less, since
+            # commits only ever publish complete dirs) that must be
+            # parked or this fingerprint stays uncacheable forever.
+            published = False
+            try:
+                os.rename(tmp, final)
+                _layout.fsync_dir(os.path.dirname(final))
+                published = True
+            except OSError:
+                if os.path.isfile(os.path.join(final, COMMITTED)):
+                    shutil.rmtree(tmp, ignore_errors=True)
+                else:
+                    self.quarantine(fp, reason="torn entry (no marker)")
+                    try:
+                        os.rename(tmp, final)
+                        _layout.fsync_dir(os.path.dirname(final))
+                        published = True
+                    except OSError:
+                        shutil.rmtree(tmp, ignore_errors=True)
+                        if not os.path.isfile(os.path.join(
+                                final, COMMITTED)):
+                            return None
+        except (OSError, TypeError, ValueError):
+            # TypeError: caller-provided meta that json.dumps can't
+            # encode must honor the None-on-failure contract too, not
+            # leak the temp dir
+            shutil.rmtree(tmp, ignore_errors=True)
+            return None
+        # index marker for entries_for_block's fast path — written even
+        # on the race-loser branch (idempotent; the winner may have
+        # crashed between its rename and its marker write)
+        if meta.get("block_sig"):
+            self._index_add(meta["block_sig"], fp)
+        if not published:
+            # nothing new landed on disk (race loser): don't count a
+            # commit or evict — the winner's commit already did both
+            return final
+        if telemetry.ENABLED:
+            telemetry.COMPILE_CACHE_COMMIT.inc()
+            telemetry.COMPILE_CACHE_COMMIT_SECONDS.observe(
+                time.perf_counter() - t0)
+        try:
+            self._evict(keep=fp)
+        except OSError:
+            pass
+        return final
+
+    # -- enumeration / stats ------------------------------------------------
+    def entries(self):
+        """[(fingerprint, entry_dir, nbytes, lru_mtime)] for every
+        committed entry (quarantined/torn dirs excluded)."""
+        out = []
+        try:
+            shards = os.listdir(self._root)
+        except OSError:
+            return out
+        for shard in shards:
+            sd = os.path.join(self._root, shard)
+            if len(shard) != 2 or not os.path.isdir(sd):
+                continue
+            try:
+                names = os.listdir(sd)
+            except OSError:
+                continue
+            for name in names:
+                d = os.path.join(sd, name)
+                if ".corrupt" in name or not os.path.isdir(d) \
+                        or not os.path.isfile(os.path.join(d, COMMITTED)):
+                    continue
+                try:
+                    nbytes = sum(
+                        os.path.getsize(os.path.join(d, f))
+                        for f in os.listdir(d))
+                    out.append((name, d, nbytes, os.path.getmtime(d)))
+                except OSError:
+                    continue
+        return out
+
+    def _index_add(self, block_sig, fp):
+        """Touch ``by-block/<sig>/<fp>`` so warm_start can find this
+        entry without scanning every META in the cache.  Best-effort:
+        a failed marker write only costs the fast path (full scan still
+        finds the entry while no index dir exists for the sig)."""
+        try:
+            idx = self._index_dir(block_sig)
+            os.makedirs(idx, exist_ok=True)
+            with open(os.path.join(idx, fp), "w"):
+                pass
+        except OSError:
+            pass
+
+    def entries_for_block(self, block_sig):
+        """[(fingerprint, meta)] of entries whose META records this
+        block signature — the warm-start index.  Served from the
+        ``by-block`` marker index when one exists for this signature
+        (O(matching entries), not O(whole cache)); dangling markers —
+        their entry was evicted or quarantined meanwhile — are pruned
+        as they are seen.  A signature with no index dir yet (a
+        pre-index cache, or a commit whose best-effort marker write
+        failed) pays ONE full META scan that repairs the index as it
+        goes and then creates the index dir even when empty — so a
+        never-cached model warm-starting against a shared populated
+        cache amortizes to a single scan, not one per restart."""
+        idx = self._index_dir(block_sig)
+        names = None
+        if os.path.isdir(idx):
+            try:
+                names = os.listdir(idx)
+            except OSError:
+                names = None
+        out = []
+        if names is not None:
+            for fp in names:
+                d = self._entry_dir(fp)
+                try:
+                    if not os.path.isfile(os.path.join(d, COMMITTED)):
+                        os.unlink(os.path.join(idx, fp))
+                        continue
+                    with open(os.path.join(d, META)) as f:
+                        meta = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                if meta.get("block_sig") == block_sig:
+                    out.append((fp, meta))
+            return out
+        for fp, d, _n, _m in self.entries():
+            try:
+                with open(os.path.join(d, META)) as f:
+                    meta = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if meta.get("block_sig") == block_sig:
+                out.append((fp, meta))
+                self._index_add(block_sig, fp)
+        try:
+            # even an empty result gets its index dir, so the next
+            # lookup for this signature is O(1) instead of re-scanning
+            os.makedirs(idx, exist_ok=True)
+        except OSError:
+            pass
+        return out
+
+    def quarantined(self):
+        """Paths of quarantined (``*.corrupt``) entry dirs."""
+        out = []
+        try:
+            shards = os.listdir(self._root)
+        except OSError:
+            return out
+        for shard in shards:
+            sd = os.path.join(self._root, shard)
+            if not os.path.isdir(sd):
+                continue
+            try:
+                out.extend(os.path.join(sd, n) for n in os.listdir(sd)
+                           if ".corrupt" in n)
+            except OSError:
+                continue
+        return sorted(out)
+
+    def stats(self):
+        entries = self.entries()
+        return {"dir": self._root,
+                "entries": len(entries),
+                "total_bytes": sum(e[2] for e in entries),
+                "max_bytes": self._max_bytes,
+                "quarantined": self.quarantined()}
+
+    def clear(self):
+        """Remove every entry (and quarantined remains)."""
+        try:
+            for name in os.listdir(self._root):
+                shutil.rmtree(os.path.join(self._root, name),
+                              ignore_errors=True)
+        except OSError:
+            pass
+
+    # -- retention ----------------------------------------------------------
+    def _evict(self, keep=None):
+        """Drop least-recently-loaded entries until under ``max_bytes``.
+        Quarantined ``*.corrupt`` dirs count against the cap and go
+        FIRST (they can never be loaded, so dropping them is free —
+        without this they would accumulate unboundedly past the cap).
+        The just-committed entry (``keep``) is never evicted to make
+        room for older entries — but if it ALONE exceeds the cap, no
+        amount of evicting others could ever satisfy the limit, so it
+        is dropped first and the rest of the cache is left intact."""
+        if self._max_bytes <= 0:
+            return
+        entries = self.entries()
+        dead = []  # (dir, nbytes, mtime) of quarantined remains
+        for q in self.quarantined():
+            try:
+                nbytes = sum(os.path.getsize(os.path.join(q, f))
+                             for f in os.listdir(q))
+                dead.append((q, nbytes, os.path.getmtime(q)))
+            except OSError:
+                continue
+        total = sum(e[2] for e in entries) + sum(d[1] for d in dead)
+        if total <= self._max_bytes:
+            return
+        for d, nbytes, _m in sorted(dead, key=lambda e: e[2]):
+            if total <= self._max_bytes:
+                break
+            shutil.rmtree(d, ignore_errors=True)
+            total -= nbytes
+        entries.sort(key=lambda e: e[3])  # oldest LRU clock first
+        keep_entry = next((e for e in entries if e[0] == keep), None)
+        if keep_entry is not None and keep_entry[2] > self._max_bytes:
+            # oversized artifact: evicting every OTHER entry could
+            # never get under the cap, so drop the newcomer itself
+            # instead of wiping a cache full of healthy entries
+            shutil.rmtree(keep_entry[1], ignore_errors=True)
+            total -= keep_entry[2]
+            entries.remove(keep_entry)
+            if telemetry.ENABLED:
+                telemetry.COMPILE_CACHE_EVICT.inc()
+        for fp, d, nbytes, _m in entries:
+            if total <= self._max_bytes:
+                break
+            if fp == keep:
+                continue
+            shutil.rmtree(d, ignore_errors=True)
+            total -= nbytes
+            if telemetry.ENABLED:
+                telemetry.COMPILE_CACHE_EVICT.inc()

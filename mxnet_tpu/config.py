@@ -57,6 +57,20 @@ ENV_VARS = {
         float, 0.0,
         "Seconds between periodic 'telemetry k=v ...' log lines "
         "(mxnet_tpu.telemetry logger; 0 disables)."),
+    "MXNET_COMPILE_CACHE": (
+        bool, False,
+        "Enable the mx.compile persistent compilation cache: hybridize "
+        "builds consult/commit serialized XLA executables on disk "
+        "(compile/cache.py).  Also implied by setting "
+        "MXNET_COMPILE_CACHE_DIR."),
+    "MXNET_COMPILE_CACHE_DIR": (
+        str, None,
+        "Directory for persistent compiled artifacts (default "
+        "<MXNET_HOME>/compile_cache).  Setting it enables the cache."),
+    "MXNET_COMPILE_CACHE_MAX_BYTES": (
+        int, 1 << 30,
+        "LRU size cap for the compile cache; least-recently-loaded "
+        "entries are evicted after each commit (<=0 disables the cap)."),
     "MXNET_EAGER_VJP_CACHE": (
         bool, True,
         "Reuse jitted forward+vjp pairs for repeated eager recorded-op "

@@ -53,6 +53,34 @@ def _flatten_nd(obj, out_list):
     return "_"
 
 
+def _require_jax_export():
+    """Capability probe for the ``jax.export`` AOT API.
+
+    ``HybridBlock.export`` / ``SymbolBlock.imports`` need
+    ``jax.export.export`` / ``deserialize`` / ``symbolic_shape``; older
+    (or stripped-down) jax builds lack some or all of them.  Probing up
+    front turns the former call-time ``AttributeError`` deep inside the
+    export path into one clear MXNetError naming the fix."""
+    try:
+        from jax import export as jax_export
+    except ImportError as exc:
+        raise MXNetError(
+            "this jax installation has no jax.export module — "
+            "HybridBlock.export/SymbolBlock.imports need the AOT export "
+            "API (jax >= 0.4.30); upgrade jax or deploy with "
+            "mx.compile.precompile/warm_start instead") from exc
+    missing = [a for a in ("export", "deserialize", "symbolic_shape")
+               if not hasattr(jax_export, a)]
+    if missing:
+        raise MXNetError(
+            "this jax installation's jax.export lacks %s — the "
+            "serialized-StableHLO export path needs the full AOT API "
+            "(jax >= 0.4.30); upgrade jax or deploy with "
+            "mx.compile.precompile/warm_start instead"
+            % ", ".join(missing))
+    return jax_export
+
+
 def _unflatten_nd(spec, it):
     if spec == "_":
         return next(it)
@@ -369,18 +397,60 @@ class _HookHandle:
         self._hooks.pop(self._hid, None)
 
 
+def normalize_signature(sig, default_dtype="float32"):
+    """Normalize one ``warm_up``-style input signature to a list of
+    ``(shape-tuple, dtype-str)`` pairs, one per input.  Accepts a bare
+    shape tuple (single input), a bare ``(shape, dtype)`` pair, or a
+    sequence of per-input entries each a shape tuple or ``(shape,
+    dtype)`` pair.  Shared by ``HybridBlock.warm_up`` and
+    ``mx.compile.warm_start(signatures=...)`` so both read the same
+    spelling."""
+    def _is_shape(t):
+        return isinstance(t, (tuple, list)) and \
+            all(isinstance(d, int) for d in t)
+
+    if _is_shape(sig):
+        sig = [tuple(sig)]
+    elif (isinstance(sig, (tuple, list)) and len(sig) == 2
+            and _is_shape(sig[0]) and isinstance(sig[1], str)):
+        sig = [sig]  # one bare (shape, dtype) entry, not 2 inputs
+    out = []
+    for entry in sig:
+        if (isinstance(entry, (tuple, list)) and len(entry) == 2
+                and isinstance(entry[0], (tuple, list))
+                and isinstance(entry[1], str)):
+            out.append((tuple(entry[0]), entry[1]))
+        else:
+            out.append((tuple(entry), default_dtype))
+    return out
+
+
 class _CachedOp:
     """One compiled signature of a hybridized block — the CachedOp
-    equivalent (reference src/imperative/cached_op.cc)."""
+    equivalent (reference src/imperative/cached_op.cc).
 
-    __slots__ = ("jfn", "out_spec", "state_ids", "uses_rng", "n_outs")
+    ``jfn`` is the traceable ``jax.jit`` entry (compiles lazily; the
+    only path autograd can differentiate through).  ``cfn``, when set,
+    is an AOT-compiled executable — either compiled eagerly here or
+    deserialized from the mx.compile persistent cache — and is
+    preferred for non-recording calls; any call failure (aval drift)
+    drops back to ``jfn`` permanently for this entry.  ``provenance``
+    records how the entry came to be ("cache" = persistent-cache disk
+    hit, "fresh" = compiled in this process) so callers like
+    serve.ModelRunner can report it without relying on telemetry."""
+
+    __slots__ = ("jfn", "cfn", "out_spec", "in_spec", "fingerprint",
+                 "provenance", "cfn_ok", "commit_io_seconds")
 
     def __init__(self):
         self.jfn = None
+        self.cfn = None
         self.out_spec = None
-        self.state_ids = []
-        self.uses_rng = False
-        self.n_outs = 0
+        self.in_spec = None
+        self.fingerprint = None
+        self.provenance = "fresh"
+        self.cfn_ok = False  # True once cfn served a call successfully
+        self.commit_io_seconds = 0.0  # disk-commit time inside a build
 
 
 class HybridBlock(Block):
@@ -453,21 +523,38 @@ class HybridBlock(Block):
         execution, so the caller observes CACHEDOP_BUILD_SECONDS at
         first-execution exit (cold-start latency: trace + compile + first
         run), not around ``_build_cache`` alone."""
-        from ..contrib import amp as _amp
-
-        key = (training, tuple(sorted(kwargs.items())),
-               # AMP toggles must invalidate cached traces: the op-list
-               # rewrite happens at trace time, so a cached f32 program
-               # would silently ignore a later amp.init()
-               (_amp.is_active(), _amp.target_dtype()),
-               tuple((x.shape, str(x.dtype)) if isinstance(x, NDArray)
-                     else ("static", repr(x)) for x in flat_inputs))
+        key = self._cachedop_key(
+            tuple((x.shape, str(x.dtype)) if isinstance(x, NDArray)
+                  else ("static", repr(x)) for x in flat_inputs),
+            training, kwargs)
         centry = self._cached_ops.get(key)
         built_t0 = None
         if centry is None:
             built_t0 = _time.perf_counter()
             centry = self._build_cache(flat_inputs, in_spec, training, kwargs)
-            if _tel.ENABLED:
+            from_disk = None
+            from .. import compile as _compile
+
+            if _compile.is_enabled() and not autograd.is_recording():
+                # persistent cache: lower + fingerprint the StableHLO;
+                # a hit deserializes the stored executable (no XLA
+                # compile), a miss compiles eagerly and commits.  Any
+                # cache failure returns None -> plain lazy-jit build.
+                # Recording calls are excluded: autograd can only
+                # differentiate through the traceable jfn, so an eager
+                # compile + disk commit here would be pure overhead on
+                # the training hot path.
+                from_disk = _compile.attach_from_cache(
+                    self, centry, key, flat_inputs, training, kwargs)
+            if from_disk:
+                # a disk hit is not a build: suppress the build-latency
+                # histogram along with the build counter below
+                centry.provenance = "cache"
+                built_t0 = None
+            if _tel.ENABLED and not from_disk:
+                # a disk hit is NOT a fresh build: compile_cache_hit is
+                # counted instead (smoke contract: a warm-started
+                # process records 0 cachedop builds)
                 blk = type(self).__name__
                 _tel.CACHEDOP_BUILD.labels(block=blk).inc()
                 if self._cached_ops:
@@ -476,6 +563,47 @@ class HybridBlock(Block):
         elif _tel.ENABLED:
             _tel.CACHEDOP_HIT.labels(block=type(self).__name__).inc()
         return centry, built_t0
+
+    def _cachedop_key(self, avals, training, kwargs):
+        """The hybridize cache key for one call signature.  ``avals`` is
+        the flat-input tuple: ``(shape, dtype-str)`` per NDArray input,
+        ``("static", repr)`` per non-array."""
+        from ..contrib import amp as _amp
+
+        return (training, tuple(sorted(kwargs.items())),
+                # AMP toggles must invalidate cached traces: the op-list
+                # rewrite happens at trace time, so a cached f32 program
+                # would silently ignore a later amp.init()
+                (_amp.is_active(), _amp.target_dtype()),
+                tuple(avals))
+        # NOTE: the tuple layout above is private — external readers
+        # (mx.compile AOT metadata) go through the accessors below, so
+        # inserting/reordering components only requires updating them
+
+    @staticmethod
+    def cachedop_key_avals(key):
+        """Flat-input aval tuple inside a hybridize cache key —
+        ``(shape, dtype-str)`` per NDArray input, ``("static", repr)``
+        per non-array."""
+        return key[3]
+
+    @staticmethod
+    def cachedop_key_call(key):
+        """``(training, sorted kwargs items)`` halves of a hybridize
+        cache key."""
+        return key[0], key[1]
+
+    def find_cached_entry(self, avals, training=False, **kwargs):
+        """Look up the hybridize cache entry previously compiled for
+        these flat-input avals (``(shape, dtype-str)`` per NDArray
+        input) under the current AMP state.  Returns ``(key, entry)``,
+        or ``(None, None)`` when that signature was never compiled.
+        Lets callers (mx.serve provenance reporting) inspect the cache
+        without depending on the private key layout."""
+        key = self._cachedop_key(
+            tuple((tuple(s), str(d)) for s, d in avals), training, kwargs)
+        centry = self._cached_ops.get(key)
+        return (key, centry) if centry is not None else (None, None)
 
     def warm_up(self, signatures, dtype="float32", training=False,
                 **call_kwargs):
@@ -492,35 +620,21 @@ class HybridBlock(Block):
         tracing) happens now rather than on the first live request.
 
         Activates hybridization if needed (without clearing entries that
-        are already warm).  Returns the number of newly compiled
-        signatures; already-warm signatures count as cache hits.
+        are already warm).  Returns the number of FRESHLY compiled
+        signatures: already-warm signatures count as cache hits, and a
+        signature restored from the mx.compile persistent cache counts
+        as 0 builds (it still executes once so its program is resident).
         """
         from .. import ndarray as _nd
 
         if not self._active:
             self.hybridize(True, clear=False)
 
-        def _is_shape(t):
-            return isinstance(t, (tuple, list)) and \
-                all(isinstance(d, int) for d in t)
-
         built = 0
         for sig in signatures:
-            if _is_shape(sig):
-                sig = [tuple(sig)]
-            elif (isinstance(sig, (tuple, list)) and len(sig) == 2
-                    and _is_shape(sig[0]) and isinstance(sig[1], str)):
-                sig = [sig]  # one bare (shape, dtype) entry, not 2 inputs
-            args = []
-            for entry in sig:
-                if (isinstance(entry, (tuple, list)) and len(entry) == 2
-                        and isinstance(entry[0], (tuple, list))
-                        and isinstance(entry[1], str)):
-                    shape, dt = tuple(entry[0]), entry[1]
-                else:
-                    shape, dt = tuple(entry), dtype
-                args.append(_nd.zeros(shape, dtype=dt))
-            before = len(self._cached_ops)
+            args = [_nd.zeros(shape, dtype=dt)
+                    for shape, dt in normalize_signature(sig, dtype)]
+            before = set(self._cached_ops)
             with autograd._mode(record=False, train=training):
                 out = self(*args, **call_kwargs)
             # block until the compiled program actually ran: dispatch is
@@ -529,8 +643,10 @@ class HybridBlock(Block):
             for o in (out if isinstance(out, (list, tuple)) else [out]):
                 if isinstance(o, NDArray):
                     o._data.block_until_ready()
-            if len(self._cached_ops) > before:
-                built += 1
+            built += sum(
+                1 for k, c in self._cached_ops.items()
+                if k not in before
+                and getattr(c, "provenance", "fresh") != "cache")
         return built
 
     def _call_cached_op(self, *args, **kwargs):
@@ -542,7 +658,8 @@ class HybridBlock(Block):
         centry, built_t0 = self._get_cached_op(flat_inputs, in_spec,
                                                training, kwargs)
 
-        params = list(self.collect_params().values())
+        named = self.collect_params()
+        params = list(named.values())
         param_datas = [p._data._data for p in params]
         input_datas = [x._data for x in nd_inputs]
         rng = mxrandom.take_key()
@@ -582,32 +699,104 @@ class HybridBlock(Block):
                 if jnp.issubdtype(o._data.dtype, jnp.floating):
                     o._entry = (node, i)
         else:
-            out_datas, states = centry.jfn(param_datas, rng, *input_datas)
+            out_datas, states = self._run_compiled(centry, param_datas,
+                                                   rng, input_datas)
             outs = [NDArray(o) for o in out_datas]
 
-        # write back functionalized state (running stats etc.)
+        # write back functionalized state (running stats etc.); keys
+        # are structured param names (stable across processes, so
+        # AOT-cached executables restored by mx.compile write back
+        # correctly), with stringified ids as the legacy fallback
         if states:
             id2param = {id(p): p for p in params}
-            for pid, new_val in states.items():
-                param = id2param.get(pid if isinstance(pid, int) else None)
-                # keys are stringified ids for jit pytree stability
-                param = id2param.get(int(pid)) if param is None else param
+            for pkey, new_val in states.items():
+                param = named.get(pkey)
+                if param is None:
+                    try:
+                        param = id2param.get(int(pkey))
+                    except (TypeError, ValueError):
+                        param = None
                 if param is not None:
                     param._data._data = new_val
         it = iter(outs)
         result = _unflatten_nd(centry.out_spec, it)
         result = result[0] if len(result) == 1 else tuple(result)
         if built_t0 is not None and _tel.ENABLED:
+            # the build histogram means trace + compile + first run:
+            # time attach_from_cache spent serializing/committing the
+            # artifact is disk I/O, measured separately by
+            # compile_cache_commit_seconds
             _tel.CACHEDOP_BUILD_SECONDS.observe(
-                _time.perf_counter() - built_t0)
+                _time.perf_counter() - built_t0
+                - getattr(centry, "commit_io_seconds", 0.0))
         return result
+
+    def _run_compiled(self, centry, param_datas, rng, input_datas):
+        """Non-recording execution: prefer the AOT executable when one
+        is attached (eagerly compiled or loaded from the mx.compile
+        persistent cache); ANY failure drops this entry back to the
+        traceable jit path for good — the cache must never be the
+        reason a forward pass errors."""
+        cfn = centry.cfn
+        if cfn is not None:
+            try:
+                out = cfn(param_datas, rng, *input_datas)
+                centry.cfn_ok = True
+                return out
+            except Exception:
+                centry.cfn = None
+                if _tel.ENABLED:
+                    _tel.COMPILE_CACHE_FALLBACK.inc()
+                out = centry.jfn(param_datas, rng, *input_datas)
+                # quarantine the disk entry only when BOTH hold: the
+                # traceable path succeeded on the same inputs (a
+                # transient device OOM/EIO would have failed here too
+                # and propagated) AND cfn never served a call in this
+                # process (an artifact that worked until one anomalous
+                # request — e.g. an input device_put somewhere jit
+                # recompiles for but the AOT executable rejects — is
+                # healthy; poisoning a fleet-shared cache over it would
+                # cost every process its warm start).  A first-call
+                # failure, by contrast, implicates the artifact itself:
+                # without quarantine every future warm_start would
+                # re-install it and re-pay failed-call + recompile.
+                fp = getattr(centry, "fingerprint", None)
+                if fp and not centry.cfn_ok:
+                    try:
+                        from .. import compile as _compile
+
+                        cache = _compile.get_cache()
+                        if cache is not None:
+                            cache.quarantine(
+                                fp, reason="failed at call time")
+                    except Exception:
+                        pass
+                return out
+        return centry.jfn(param_datas, rng, *input_datas)
 
     def _build_cache(self, flat_inputs, in_spec, training, call_kwargs):
         centry = _CachedOp()
-        block = self
-        params = list(self.collect_params().values())
         static_inputs = [x if not isinstance(x, NDArray) else None
                          for x in flat_inputs]
+        centry.in_spec = in_spec
+        centry.jfn = jax.jit(self._make_pure_fn(
+            static_inputs, in_spec, training, call_kwargs, centry))
+        return centry
+
+    def _make_pure_fn(self, static_inputs, in_spec, training,
+                      call_kwargs, centry):
+        """The pure (params, rng, *inputs) -> (outputs, states) function
+        one signature jit-compiles.  Factored from ``_build_cache`` so
+        ``mx.compile.warm_start`` can rebuild the traceable fallback for
+        a disk-restored entry without re-tracing anything up front.
+        State updates are keyed by structured param NAME (stable across
+        processes) so AOT artifacts stay portable."""
+        block = self
+        named = self.collect_params()
+        params = list(named.values())
+        id2name = {}
+        for n, p in named.items():
+            id2name.setdefault(id(p), n)
 
         def pure_fn(param_datas, rng_key, *input_datas):
             tctx = _TraceContext()
@@ -629,12 +818,12 @@ class HybridBlock(Block):
             flat_out = []
             centry.out_spec = _flatten_nd(
                 out if isinstance(out, (list, tuple)) else [out], flat_out)
-            states = {str(pid): v for pid, v in tctx.state_updates.items()}
+            states = {id2name.get(pid, str(pid)): v
+                      for pid, v in tctx.state_updates.items()}
             return tuple(o._data if isinstance(o, NDArray) else o
                          for o in flat_out), states
 
-        centry.jfn = jax.jit(pure_fn)
-        return centry
+        return pure_fn
 
     # ---- pure export (flax-style), powers parallel/pjit + bench ----------
     def export_pure(self, training=False):
@@ -698,7 +887,8 @@ class HybridBlock(Block):
         import json
 
         import jax
-        from jax import export as jax_export
+
+        jax_export = _require_jax_export()
 
         if inputs is None:
             inputs = getattr(self, "_last_input_avals", None)
@@ -855,7 +1045,7 @@ class SymbolBlock(HybridBlock):
             return blk
         if manifest.get("format") == "mxnet_tpu-hybrid-2" and \
                 "program" in manifest:
-            from jax import export as jax_export
+            jax_export = _require_jax_export()
 
             exported = jax_export.deserialize(
                 base64.b64decode(manifest["program"]))

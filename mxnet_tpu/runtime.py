@@ -14,6 +14,34 @@ class Feature:
         return "%s %s" % ("✔" if self.enabled else "✖", self.name)
 
 
+def _compile_cache_enabled():
+    """mx.compile's persistent compilation cache: built in, but OFF
+    unless switched on (env knobs or mxnet_tpu.compile.enable())."""
+    try:
+        from . import compile as _compile
+
+        return _compile.is_enabled()
+    except Exception:
+        return False
+
+
+class _DynamicFeature(Feature):
+    """Feature whose enabled state is re-read on every access —
+    COMPILE_CACHE toggles at runtime (compile.enable()/disable()), so
+    baking it into the one-shot detection map would go stale."""
+
+    def __init__(self, name, probe):
+        self.name = name
+        self._probe = probe
+
+    @property
+    def enabled(self):
+        try:
+            return bool(self._probe())
+        except Exception:
+            return False
+
+
 def _detect():
     import jax
 
@@ -42,7 +70,10 @@ def _detect():
         "TENSORRT": False,
         "OPENCV": False,
     }
-    return {k: Feature(k, v) for k, v in feats.items()}
+    out = {k: Feature(k, v) for k, v in feats.items()}
+    out["COMPILE_CACHE"] = _DynamicFeature("COMPILE_CACHE",
+                                           _compile_cache_enabled)
+    return out
 
 
 class Features(dict):

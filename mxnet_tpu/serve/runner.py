@@ -101,6 +101,7 @@ class ModelRunner:
             raise ValueError("batch_sizes must be non-empty")
         self._sample_buckets = _normalize_sample_shapes(sample_shapes)
         self._warmed = False
+        self._warm_provenance = {}
         self._run_lock = RLock()  # one compiled program at a time
         if warm:
             self.warm_up()
@@ -137,6 +138,15 @@ class ModelRunner:
                         if sig],
             "compiled_signatures": len(getattr(self._block, "_cached_ops",
                                                ())),
+            # per-bucket compile provenance from the last warm_up():
+            # fresh (XLA compiled here) / warm-start (installed from
+            # disk by that warm_up's mx.compile.warm_start preamble) /
+            # cache (restored from the persistent cache earlier in this
+            # process) / cache-failed (restored but failed at call
+            # time; the jit fallback compiled fresh) / warm (compiled
+            # earlier in this process) — operators verify a
+            # zero-compile restart here (/statz)
+            "warm_provenance": dict(self._warm_provenance),
         }
 
     # -- warm-up ------------------------------------------------------------
@@ -144,23 +154,84 @@ class ModelRunner:
         """Pre-compile every (batch_size x sample_shape) bucket.  Emits
         one ``serve_compile_total{bucket=...}`` per newly built
         signature; re-warming an already-hot runner is a no-op (cache
-        hits).  Returns the number of new compiles."""
+        hits).  Returns the number of new signatures this process built.
+
+        When the mx.compile persistent cache is enabled, the whole
+        bucket table is first ``warm_start``-ed from disk (a restarted
+        server reaches readiness with zero fresh XLA compiles), and
+        each bucket's provenance — cache / fresh / warm-start /
+        cache-failed / warm —
+        is recorded for ``stats()`` (surfaced at ``/statz``)."""
         built = 0
+        self._warm_provenance = {}
         if not isinstance(self._block, HybridBlock):
             self._warmed = True  # nothing to compile
             return built
+        from .. import compile as _compile
+
+        pre_ws = set(self._block._cached_ops)
+        ws_installed = set()
+        if _compile.is_enabled():
+            try:
+                # scope the restore to THIS runner's buckets: a shared
+                # cache may hold many other deployments' signatures for
+                # the same model, and each install pays a pickle +
+                # executable device-load
+                sigs = [[((b,) + tuple(s), self._dtype) for s in sig]
+                        for b, sig in self.bucket_table() if sig]
+                # no sample buckets configured means lazy compile —
+                # NOT "restore every signature the shared cache holds"
+                if sigs:
+                    _compile.warm_start(self._block, signatures=sigs)
+                    # keys warm_start ACTUALLY installed — a bucket the
+                    # live attach path restores later in this loop must
+                    # report "cache", not "warm-start"
+                    ws_installed = set(self._block._cached_ops) - pre_ws
+            except Exception:  # the cache must never block readiness
+                pass
         for b, sig in self.bucket_table():
             if not sig:
                 continue  # no sample buckets configured: lazy compile
+            label = _bucket_label(b, sig)
             n = self._block.warm_up(
                 [[((b,) + s, self._dtype) for s in sig]])
             if n:
+                # warm_up counts only fresh XLA compiles (disk restores
+                # return 0), so n > 0 means this process built it
                 built += n
+                self._warm_provenance[label] = "fresh"
                 if telemetry.ENABLED:
-                    telemetry.SERVE_COMPILES.labels(
-                        bucket=_bucket_label(b, sig)).inc(n)
+                    telemetry.SERVE_COMPILES.labels(bucket=label).inc(n)
+            else:
+                # provenance comes from THIS bucket's cache entry (not
+                # telemetry deltas or global warm_start counts, which
+                # misattribute when telemetry is off or other buckets
+                # were the ones installed)
+                key, centry = self._bucket_centry(b, sig)
+                if centry is not None and \
+                        getattr(centry, "provenance", "fresh") == "cache":
+                    if centry.cfn is None:
+                        # the restored executable failed at call time
+                        # during this warm_up's execution pass and the
+                        # jit fallback compiled fresh — reporting
+                        # "warm-start"/0 compiles would be the exact
+                        # false positive /statz exists to catch
+                        self._warm_provenance[label] = "cache-failed"
+                    else:
+                        self._warm_provenance[label] = \
+                            "warm-start" if key in ws_installed \
+                            else "cache"
+                else:
+                    self._warm_provenance[label] = "warm"
         self._warmed = True
         return built
+
+    def _bucket_centry(self, b, sig):
+        """The hybridize cache (key, entry) serving this warm-up bucket:
+        inference mode, flat-input avals matching the bucket's padded
+        shapes.  (None, None) when not yet compiled."""
+        avals = [((b,) + tuple(s), self._dtype) for s in sig]
+        return self._block.find_cached_entry(avals, training=False)
 
     # -- bucketing ----------------------------------------------------------
     def bucket_for(self, sample_shapes):

@@ -647,5 +647,35 @@ SERVE_COMPILES = counter(
 SERVE_SWAPS = counter(
     "serve_model_swaps_total", "hot model swaps (atomic runner "
     "replacement pointing at a new checkpoint step)")
+# mx.compile (compile/): persistent compilation cache + AOT warm-start.
+# A hit means a stored XLA executable was loaded and the compile was
+# skipped; a miss means the lookup ran but nothing usable was stored.
+COMPILE_CACHE_HIT = counter(
+    "compile_cache_hit_total",
+    "persistent compile-cache artifact loads (XLA compile skipped)")
+COMPILE_CACHE_MISS = counter(
+    "compile_cache_miss_total",
+    "persistent compile-cache lookups with no usable artifact "
+    "(fresh compile follows, then a commit)")
+COMPILE_CACHE_COMMIT = counter(
+    "compile_cache_commit_total",
+    "compiled executables durably committed to the persistent cache")
+COMPILE_CACHE_EVICT = counter(
+    "compile_cache_evict_total",
+    "cache entries evicted by the LRU size cap")
+COMPILE_CACHE_QUARANTINE = counter(
+    "compile_cache_quarantine_total",
+    "corrupt cache entries quarantined (renamed *.corrupt, never "
+    "loaded again)")
+COMPILE_CACHE_FALLBACK = counter(
+    "compile_cache_fallback_total",
+    "AOT executable calls that failed and fell back to the in-memory "
+    "jit path (aval drift etc.)")
+COMPILE_CACHE_LOAD_SECONDS = histogram(
+    "compile_cache_load_seconds",
+    "artifact read + checksum-verify latency")
+COMPILE_CACHE_COMMIT_SECONDS = histogram(
+    "compile_cache_commit_seconds",
+    "artifact serialize + durable-commit latency")
 
 start_logger()

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""mx.compile end-to-end smoke (the `make compile-cache-smoke` target).
+
+Exercises the cross-process warm-start contract in one shot:
+
+1. process A hybridizes a model over two shape buckets: every build is
+   a compile-cache miss followed by a durable commit;
+2. process B (fresh interpreter, same model) warm-starts from disk:
+   >=1 ``compile_cache_hit`` and ZERO fresh builds
+   (``cachedop_build_total`` == 0) for the pre-warmed buckets, and its
+   outputs bit-match process A's;
+3. one artifact is corrupted on disk: process C must quarantine it and
+   still complete via a normal in-memory compile (graceful
+   degradation, never an error on the hot path);
+4. the cache dir is removed entirely: the same run still completes.
+
+Exits non-zero (and prints the failing stage) on any violation.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# the worker every stage runs in a FRESH interpreter: build + execute
+# the same two-bucket hybridized model and report telemetry deltas
+WORKER = r"""
+import json, sys
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import compile as mxcompile, telemetry
+from mxnet_tpu.gluon import nn
+
+blk = nn.Dense(4, flatten=False, in_units=16)
+blk.initialize()
+# deterministic params so every process computes identical outputs
+for p in blk.collect_params().values():
+    p.set_data(mx.nd.array(np.arange(int(np.prod(p.shape)),
+                                     dtype="float32")
+                           .reshape(p.shape) / 100.0))
+blk.hybridize()
+installed = mxcompile.warm_start(blk)
+outs = []
+for shape in ((2, 3, 16), (4, 5, 16)):
+    outs.append(float(blk(mx.nd.ones(shape)).asnumpy().sum()))
+tot = telemetry.totals()
+print(json.dumps({
+    "installed": installed,
+    "outs": outs,
+    "builds": tot.get("cachedop_build_total", 0),
+    "hits": tot.get("compile_cache_hit_total", 0),
+    "misses": tot.get("compile_cache_miss_total", 0),
+    "commits": tot.get("compile_cache_commit_total", 0),
+    "quarantined": tot.get("compile_cache_quarantine_total", 0),
+    "fallbacks": tot.get("compile_cache_fallback_total", 0),
+    "entries": mxcompile.stats()["entries"],
+}))
+"""
+
+
+def run_worker(cache_dir):
+    env = dict(os.environ, MXNET_COMPILE_CACHE_DIR=cache_dir,
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+               PYTHONPATH=REPO)
+    out = subprocess.run([sys.executable, "-c", WORKER], env=env,
+                         capture_output=True, text=True, timeout=600)
+    if out.returncode != 0:
+        print(out.stdout)
+        print(out.stderr)
+        raise AssertionError("worker process failed")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main():
+    cache_dir = tempfile.mkdtemp(prefix="mx-compile-smoke-")
+
+    a = run_worker(cache_dir)
+    assert a["builds"] == 2 and a["commits"] == 2, \
+        "stage 1: expected 2 fresh builds + commits, got %r" % (a,)
+    print("process A    : %d fresh builds, %d committed artifacts"
+          % (a["builds"], a["entries"]))
+
+    b = run_worker(cache_dir)
+    assert b["installed"] >= 2, \
+        "stage 2: warm_start installed %r signatures" % b["installed"]
+    assert b["hits"] >= 1 and b["builds"] == 0, \
+        "stage 2: wanted >=1 compile_cache_hit and 0 fresh builds, " \
+        "got %r" % (b,)
+    assert b["fallbacks"] == 0, \
+        "stage 2: a warm-started executable failed at call time and " \
+        "silently re-traced through jfn (builds==0 can't see that " \
+        "recompile): %r" % (b,)
+    assert b["outs"] == a["outs"], \
+        "stage 2: warm-started outputs diverged: %r vs %r" \
+        % (b["outs"], a["outs"])
+    print("process B    : warm-started %d signature(s), 0 fresh builds, "
+          "outputs match" % b["installed"])
+
+    artifacts = []
+    for root, _dirs, files in os.walk(cache_dir):
+        artifacts.extend(os.path.join(root, f) for f in files
+                         if f == "ARTIFACT.bin")
+    with open(sorted(artifacts)[0], "r+b") as f:
+        f.seek(64)
+        f.write(b"\xde\xad\xbe\xef" * 8)
+    print("corrupt      : flipped 32 bytes in %s"
+          % os.path.relpath(sorted(artifacts)[0], cache_dir))
+
+    c = run_worker(cache_dir)
+    assert c["quarantined"] >= 1, \
+        "stage 3: corrupt artifact was not quarantined: %r" % (c,)
+    assert c["outs"] == a["outs"], \
+        "stage 3: degraded run produced wrong outputs"
+    print("process C    : corrupt entry quarantined, run completed "
+          "(%d fresh build(s) as fallback)" % c["builds"])
+
+    shutil.rmtree(cache_dir)
+    d = run_worker(cache_dir)
+    assert d["outs"] == a["outs"], \
+        "stage 4: run without a cache dir produced wrong outputs"
+    print("process D    : cache dir removed, run still completed")
+
+    shutil.rmtree(cache_dir, ignore_errors=True)
+    print("compile-cache-smoke PASS")
+
+
+if __name__ == "__main__":
+    main()

@@ -237,6 +237,44 @@ def serve_info(src):
             print("  %-36s %g" % (k, totals[k]))
 
 
+def compile_cache_info():
+    """Audit the mx.compile persistent compilation cache: directory,
+    entry count, total bytes, per-entry age/size, quarantined entries,
+    and this process's hit/miss/commit telemetry."""
+    section("Compile Cache")
+    import time as _time
+
+    from mxnet_tpu import compile as mxcompile
+    from mxnet_tpu import telemetry
+
+    print("enabled      :", mxcompile.is_enabled(),
+          "" if mxcompile.is_enabled() else
+          "(set MXNET_COMPILE_CACHE=1 / MXNET_COMPILE_CACHE_DIR)")
+    cache = mxcompile.get_cache()
+    # one directory walk serves the summary AND the per-entry listing
+    # (a cache near its cap holds hundreds of dirs, stat'd per file)
+    entries = cache.entries() if cache is not None else []
+    quarantined = cache.quarantined() if cache is not None else []
+    print("dir          :", mxcompile.cache_dir())
+    print("entries      : %d  (%.1f KiB total, cap %.1f MiB)"
+          % (len(entries), sum(e[2] for e in entries) / 1024.0,
+             (cache.max_bytes if cache is not None else 0) / 1048576.0))
+    now = _time.time()
+    for fp, _d, nbytes, mtime in sorted(entries, key=lambda e: -e[3]):
+        print("entry %s : %8.1f KiB  last-used %.0fs ago"
+              % (fp[:12], nbytes / 1024.0, now - mtime))
+    if quarantined:
+        print("quarantined  :")
+        for q in quarantined:
+            print("  %s" % q)
+    else:
+        print("quarantined  : none")
+    tot = {k: v for k, v in telemetry.totals(nonzero=True).items()
+           if k.startswith("compile_cache_")}
+    print("telemetry    : %s" % (tot or "(no compile_cache_* activity "
+                                        "in this process)"))
+
+
 def env_info():
     section("Environment")
     from mxnet_tpu import config
@@ -266,15 +304,20 @@ def main():
                          "bucket table, queue/rejection counters) from "
                          "a running server URL (http://host:port) or a "
                          "telemetry JSON snapshot file")
+    ap.add_argument("--compile-cache", action="store_true",
+                    help="audit the mx.compile persistent compilation "
+                         "cache: dir, entries, bytes, quarantined "
+                         "entries, hit/miss telemetry")
     args = ap.parse_args()
-    if args.serve:
-        serve_info(args.serve)
-        if args.telemetry:
-            telemetry_info()
-        print()
-        return
-    if args.checkpoints:
-        checkpoints_info(args.checkpoints)
+    # section flags compose: --compile-cache --serve URL prints both
+    # (each skips the environment dump, all honor --telemetry)
+    if args.compile_cache or args.serve or args.checkpoints:
+        if args.compile_cache:
+            compile_cache_info()
+        if args.serve:
+            serve_info(args.serve)
+        if args.checkpoints:
+            checkpoints_info(args.checkpoints)
         if args.telemetry:
             telemetry_info()
         print()
