@@ -10,7 +10,7 @@ SRCS := $(wildcard src/native/*.cc)
 SO := build/libmxtpu_native.so
 
 .PHONY: native test cpptest telemetry-smoke checkpoint-smoke serve-smoke \
-	compile-cache-smoke clean
+	compile-cache-smoke trainer-smoke clean
 
 native: $(SO)
 
@@ -65,6 +65,15 @@ compile-cache-smoke:
 	JAX_PLATFORMS=cpu python tools/compile_cache_smoke.py
 	JAX_PLATFORMS=cpu python -m pytest \
 	  tests/python/unittest/test_compile_cache.py -q -m 'not slow'
+
+# multi-tensor Trainer smoke: 3-step CPU train asserting ONE fused
+# update program per parameter group (no per-step retraces), zero eager
+# fallbacks, fused-vs-eager parity, and the collective bucket-count
+# bound; then the subsystem's pytest suite
+trainer-smoke:
+	JAX_PLATFORMS=cpu python tools/trainer_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/python/unittest/test_trainer_fused.py -q -m 'not slow'
 
 # suite summary artifact (TESTS_r{N}.json) — round-2 advisor contract
 test-report:

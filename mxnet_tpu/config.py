@@ -30,6 +30,13 @@ ENV_VARS = {
         int, 4 << 20,
         "Collective kvstore gradient-fusion bucket size in bytes "
         "(kvstore/collective.py; replaces MXNET_KVSTORE_BIGARRAY_BOUND)."),
+    "MXNET_MULTI_TENSOR": (
+        bool, True,
+        "Multi-tensor fused optimizer apply in the imperative Trainer "
+        "(optimizer/multi_tensor.py): one jitted, buffer-donated update "
+        "program per parameter group per step.  Set 0 to force the "
+        "classic per-parameter eager updates (automatic for row_sparse "
+        "grads and non-fusable optimizers)."),
     "MXNET_TPU_NO_NATIVE": (
         bool, False,
         "Disable the C++ native host runtime (pure-python fallbacks for "

@@ -1068,6 +1068,11 @@ def _rnn_common(self, node, vals, mode):
         raise MXNetError("onnx import: RNN sequence_lens")
     h0 = vals[5] if len(vals) > 5 else None
     c0 = vals[6] if len(vals) > 6 else None
+    if len(vals) > 7 and vals[7] is not None:
+        # ADVICE r5 medium: the fused RNN op has no peephole weights —
+        # importing and silently dropping P would compute wrong outputs
+        raise MXNetError("onnx import: LSTM peephole weights (input P) "
+                         "are not supported")
 
     def reorder(mat):
         """Reorder ONNX gate blocks to the fused op's order."""

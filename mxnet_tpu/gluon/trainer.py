@@ -53,6 +53,7 @@ class Trainer:
         self._states = {}
         self._step_count = 0
         self._params_to_init = list(self._params)
+        self._mt_groups = {}   # multi-tensor fused update programs
         self._zero = zero
         self._zero_mesh = mesh
         if zero and (mesh is None or "dp" not in getattr(mesh, "shape", {})):
@@ -187,12 +188,18 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        keys, grads = [], []
         for i, param in enumerate(self._params):
             if param.grad_req != "null" and param._data is not None:
                 if self._update_on_kvstore:
                     continue
-                grads = param.list_grad()
-                self._kvstore.pushpull(i, grads, out=grads)
+                keys.append(i)
+                grads.append(param.list_grad())
+        if keys:
+            # the ENTIRE gradient list in one call: the collective store
+            # fuses keys into ~bucket-sized all-reduce programs instead
+            # of one-key-per-program (kvstore/collective.py)
+            self._kvstore.pushpull_all(keys, grads, out=grads)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
@@ -201,6 +208,9 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        from ..optimizer import multi_tensor as _mt
+
+        items = []
         for i, param in enumerate(self._params):
             if param.grad_req == "null" or param._data is None:
                 continue
@@ -222,25 +232,32 @@ class Trainer:
 
                 if not isinstance(grad, RowSparseNDArray):
                     grad = row_sparse_from_dense(grad)
-            if self._zero:
-                if getattr(grad, "stype", "default") != "default":
-                    # ADVICE r3: the sparse branch would mix dp-sharded
-                    # optimizer state with single-device weight/grad and
-                    # crash deep inside jax on device mismatch; fail with
-                    # the actual contract instead
-                    from ..base import MXNetError
-
-                    raise MXNetError(
-                        "Trainer(zero=True) does not support row_sparse "
-                        "gradients (parameter %r): ZeRO shards optimizer "
-                        "state along the dp axis, which requires dense "
-                        "grads. Use grad_stype='default' or zero=False."
-                        % (param.name,))
-                self._zero_update(i, param, grad)
-            else:
-                self._optimizer.update_multi_precision(
-                    i, param.data(), grad, self._states[i])
+            if self._zero and getattr(grad, "stype",
+                                      "default") != "default":
+                # ADVICE r3: the sparse branch would mix dp-sharded
+                # optimizer state with single-device weight/grad and
+                # crash deep inside jax on device mismatch; fail with
+                # the actual contract instead
+                raise MXNetError(
+                    "Trainer(zero=True) does not support row_sparse "
+                    "gradients (parameter %r): ZeRO shards optimizer "
+                    "state along the dp axis, which requires dense "
+                    "grads. Use grad_stype='default' or zero=False."
+                    % (param.name,))
+            items.append((i, param, grad))
+        # one fused, buffer-donated program per (optimizer, dtype, stype,
+        # lr/wd-mult, placement) group; automatic per-param eager
+        # fallback for row_sparse grads / non-fusable optimizers
+        _mt.apply_updates(self, items)
         self._step_count += 1
+
+    def _eager_update(self, i, param, grad):
+        """The classic per-parameter update (multi_tensor fallback)."""
+        if self._zero:
+            self._zero_update(i, param, grad)
+        else:
+            self._optimizer.update_multi_precision(
+                i, param.data(), grad, self._states[i])
 
     # ---- persistence ------------------------------------------------------
     def save_states(self, fname):
@@ -267,6 +284,7 @@ class Trainer:
         with open(fname, "rb") as f:
             self._states = {k: _state_nd(v)
                             for k, v in pickle.load(f).items()}
+        self._mt_groups.clear()  # fused programs close over live state
         if self._zero:
             # re-establish the dp-sharded placement — a plain load would
             # leave every state replicated and silently void the ZeRO-1
@@ -377,6 +395,7 @@ class Trainer:
             self._optimizer._index_update_count = {
                 index_of[k]: int(v)
                 for k, v in updates["counts"].items() if k in index_of}
+        self._mt_groups.clear()  # fused programs close over live state
         if self._zero:
             self._states = {k: self._shard_state(v)
                             for k, v in self._states.items()}

@@ -33,6 +33,17 @@ class KVStoreBase:
     def pushpull(self, key, value, out=None, priority=0):
         raise NotImplementedError
 
+    def pushpull_all(self, keys, values, out=None, priority=0):
+        """Fused multi-key pushpull: the Trainer hands its ENTIRE
+        gradient list here in one call so stores that fuse collectives
+        (CollectiveKVStore) can fill cross-parameter buckets to
+        MXNET_KVSTORE_BUCKET_BYTES.  The base implementation loops
+        per-key so third-party stores registered via ``register`` keep
+        working unchanged."""
+        outs = [None] * len(keys) if out is None else out
+        for k, v, o in zip(keys, values, outs):
+            self.pushpull(k, v, out=o, priority=priority)
+
     def push(self, key, value, priority=0):
         raise NotImplementedError
 

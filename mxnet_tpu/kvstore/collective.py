@@ -36,6 +36,36 @@ _BUCKET_BYTES = int(os.environ.get("MXNET_KVSTORE_BUCKET_BYTES",
                                    4 << 20))
 
 
+def plan_buckets(sizes_dtypes, bucket_bytes=None):
+    """Pure bucket planner: ``[(nbytes, dtype_str), ...]`` (in push
+    order) -> list of index buckets, each reduced by ONE collective
+    program.
+
+    Deterministic and order-preserving — every rank pushes the same
+    keys in the same order, so identical plans (and therefore identical
+    program sequences) fall out on all processes.  Buckets are
+    per-dtype (the flat concat needs one dtype) and close once they
+    reach ``bucket_bytes``; a single array larger than the bound gets
+    its own bucket.  Total program count is therefore at most
+    ``ceil(total_bytes / bucket_bytes)`` plus one per dtype switch."""
+    if bucket_bytes is None:
+        bucket_bytes = _BUCKET_BYTES
+    plan, bucket, nbytes, last_dtype = [], [], 0, None
+    for i, (size, dtype) in enumerate(sizes_dtypes):
+        if bucket and last_dtype != dtype:
+            plan.append(bucket)
+            bucket, nbytes = [], 0
+        last_dtype = dtype
+        bucket.append(i)
+        nbytes += size
+        if nbytes >= bucket_bytes:
+            plan.append(bucket)
+            bucket, nbytes = [], 0
+    if bucket:
+        plan.append(bucket)
+    return plan
+
+
 class CollectiveKVStore(KVStoreBase):
     def __init__(self, mode="dist_sync", **kwargs):
         self._mode = mode
@@ -100,14 +130,13 @@ class CollectiveKVStore(KVStoreBase):
         so program sequences match across processes."""
         if jax.process_count() == 1:
             return list(datas)
+        datas = [jnp.asarray(d) for d in datas]
         out = [None] * len(datas)
-        bucket = []  # list of (index, array)
-        nbytes = 0
-
-        def flush():
-            nonlocal bucket, nbytes
-            if not bucket:
-                return
+        plan = plan_buckets([(d.size * d.dtype.itemsize, str(d.dtype))
+                             for d in datas])
+        for idxs in plan:
+            bucket = [(i, datas[i]) for i in idxs]
+            nbytes = sum(a.size * a.dtype.itemsize for _, a in bucket)
             tel_on = _tel.ENABLED
             t0 = _time.perf_counter() if tel_on else 0.0
             flat = jnp.concatenate(
@@ -117,7 +146,7 @@ class CollectiveKVStore(KVStoreBase):
             # assemble the (nproc, L) global array directly from device
             # buffers — no host round-trip; the per-local-device put is a
             # device-to-device copy (the P('proc') shard is replicated over
-            # the local axis).  Flushes are async dispatches, so successive
+            # the local axis).  Buckets are async dispatches, so successive
             # buckets overlap on the interconnect.
             local = flat[None]
             arrs = [jax.device_put(local, d) for d in jax.local_devices()]
@@ -139,20 +168,8 @@ class CollectiveKVStore(KVStoreBase):
                 _tel.COLLECTIVE_CALLS.labels(op="allreduce").inc()
                 _tel.COLLECTIVE_BYTES.labels(op="allreduce").inc(nbytes)
                 _tel.COLLECTIVE_SECONDS.observe(_time.perf_counter() - t0)
-            bucket = []
-            nbytes = 0
-
-        last_dtype = None
-        for i, d in enumerate(datas):
-            d = jnp.asarray(d)
-            if last_dtype is not None and d.dtype != last_dtype:
-                flush()  # buckets are per-dtype (concat needs one dtype)
-            last_dtype = d.dtype
-            bucket.append((i, d))
-            nbytes += d.size * d.dtype.itemsize
-            if nbytes >= _BUCKET_BYTES:
-                flush()
-        flush()
+                _tel.ALLREDUCE_BUCKET_FILL.observe(
+                    nbytes / float(_BUCKET_BYTES))
         return out
 
     def init(self, key, value):
@@ -212,6 +229,14 @@ class CollectiveKVStore(KVStoreBase):
         self.push(key, value, priority)
         if out is not None:
             self.pull(key, out, priority)
+
+    def pushpull_all(self, keys, values, out=None, priority=0):
+        """The whole gradient list in one call: ``push`` hands every
+        merged value to ``_allreduce_many`` at once, so CROSS-parameter
+        buckets fill to MXNET_KVSTORE_BUCKET_BYTES — O(total_bytes /
+        bucket) collective programs per step instead of one per key."""
+        self.pushpull(list(keys), list(values), out=out,
+                      priority=priority)
 
     def set_optimizer(self, optimizer):
         raise MXNetError(

@@ -92,6 +92,13 @@ class KVStore(KVStoreBase):
                 for dst in (o if isinstance(o, (list, tuple)) else [o]):
                     src.copyto(dst)
 
+    def pushpull_all(self, keys, values, out=None, priority=0):
+        """Single-process store: ``pushpull`` already takes parallel key
+        lists, so the fused entry point is one pass over them (no
+        collectives to bucket locally)."""
+        self.pushpull(list(keys), list(values), out=out,
+                      priority=priority)
+
     def broadcast(self, key, value, out):
         self.init(key, value)
         if out is not None:

@@ -175,7 +175,14 @@ def _vjp_cache_key(op, attrs, datas, train):
         # backward would replay a stale buffer after in-place updates
         return None
     try:
-        attrs_key = tuple(sorted(attrs.items())) if attrs else ()
+        # scalar values key by repr like positional scalars: hash/
+        # equality folds 1 / True / 1.0 (and 0.0 / -0.0) into ONE cache
+        # entry, replaying a backward traced for a differently-typed
+        # attr; strings join the repr set so 1 and "1" stay distinct
+        attrs_key = tuple(sorted(
+            (k, repr(v) if isinstance(v, (bool, int, float, complex,
+                                          str)) else v)
+            for k, v in attrs.items())) if attrs else ()
         hash(attrs_key)
     except TypeError:
         return None       # unhashable attrs

@@ -1,7 +1,9 @@
 """Optimizer package (reference python/mxnet/optimizer/)."""
-from . import lr_scheduler, optimizer
+from . import lr_scheduler, multi_tensor, optimizer
 from .lr_scheduler import *  # noqa: F401,F403
+from .multi_tensor import register_fusable  # noqa: F401
 from .optimizer import *  # noqa: F401,F403
 from .optimizer import _OPT_REGISTRY  # noqa: F401
 
-__all__ = optimizer.__all__ + lr_scheduler.__all__
+__all__ = (optimizer.__all__ + lr_scheduler.__all__
+           + ["multi_tensor", "register_fusable"])

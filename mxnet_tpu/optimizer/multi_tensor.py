@@ -1,0 +1,654 @@
+"""Multi-tensor fused optimizer apply for the imperative Trainer.
+
+Reference: the multi-tensor fused kernels (src/operator/contrib/
+multi_lamb.cc, multi_sgd / aggregate_num batching in optimizer.py:243) —
+one kernel launch updates MANY parameters.  The TPU-native rendering: the
+Trainer groups its parameters by (optimizer, dtype, stype, lr/wd
+multipliers, device placement), and each group's weights/grads/states are
+flattened into pytrees driven through ONE jitted, buffer-donated XLA
+program per step that replays the *existing* ``Optimizer.update_multi_
+precision`` rules over the tree — the rules are pure jnp code, so they
+trace as-is and XLA fuses the whole group into a few kernels.
+
+The retrace problem: the update rules read per-step host values —
+``rescale_grad``, ``_get_lr``/``_get_wd`` (scheduler/warmup control
+flow), and the Adam-family bias-correction terms built from
+``_index_update_count`` — which a naive trace would bake in as
+constants, forcing a recompile EVERY step.  Solution: during the one
+trace, those reads return :class:`_HostScalar` stand-ins.  A host
+scalar stays SYMBOLIC through python arithmetic (``1 - beta1 ** t`` is
+replayed on the host in float64, exactly like the eager path computes
+it) and only when it meets a traced array does it materialize as one
+slot of a small f32 input vector.  Each step the recorded slot
+closures are re-evaluated eagerly (scheduler steps, warmup ramps and
+bias corrections all see live state) and passed in — zero retraces,
+and the scalar values entering the program are bit-identical to the
+eager path's.
+
+Numerics: XLA compiles the whole group as one program and (by default,
+``xla_allow_excess_precision``) may contract mul+add chains into FMAs,
+so fused results can differ from the op-by-op eager path by a couple
+of ulps — the fused side carries MORE precision, not less.  The
+hyperparameter scalars themselves are exact (see above).
+
+Fallbacks (automatic, per parameter): ``row_sparse`` gradients or
+weights, optimizers with data-dependent python state (Nadam's
+``m_schedule``) or trace-time RNG (SGLD), optimizer classes not
+registered fusable (custom subclasses with overridden ``update``), and
+the global kill switch ``MXNET_MULTI_TENSOR=0`` all take the
+per-parameter eager path, counted in ``trainer_eager_updates_total``.
+
+Persistent warm start: when ``mx.compile`` is enabled each group
+program is lowered, fingerprinted by its StableHLO text and served
+from / committed to the persistent compilation cache — a restarted
+training job re-traces (cheap) but never re-compiles an unchanged
+group.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import operator
+import time as _time
+import warnings
+
+import numpy as _np
+
+from .. import telemetry as _tel
+from ..base import MXNetError, get_env
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["apply_updates", "partition", "group_table", "is_fusable",
+           "register_fusable"]
+
+_LOGGER = logging.getLogger("mxnet_tpu.multi_tensor")
+
+
+@contextlib.contextmanager
+def _quiet_donation():
+    # donation is advisory: CPU (tests) cannot donate and jax warns per
+    # compile; the fallback is silent buffer copies, not wrong results.
+    # Scoped, not module-level — users' own donate_argnums code must
+    # still see the warning (there it flags 2x HBM held).
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        yield
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+# ---------------------------------------------------------------------------
+# host scalars: per-step hyperparameters as traced inputs
+# ---------------------------------------------------------------------------
+
+class _Trace:
+    """Slot registry for one group trace: ``hscal`` is the traced f32
+    input vector, ``fns`` the host closures that refill it each step."""
+
+    __slots__ = ("hscal", "fns")
+
+    def __init__(self, hscal):
+        self.hscal = hscal
+        self.fns = []
+
+    def materialize(self, fn):
+        k = len(self.fns)
+        if k >= self.hscal.shape[0]:
+            raise MXNetError(
+                "multi-tensor trace exhausted %d host-scalar slots — an "
+                "optimizer rule materializes far more per-step scalars "
+                "than expected" % self.hscal.shape[0])
+        self.fns.append(fn)
+        return self.hscal[k]
+
+
+class _HostScalar:
+    """A per-step host scalar, symbolic during the group trace.
+
+    Arithmetic against python numbers or other host scalars composes a
+    host closure (replayed in float64 each step — the same precision
+    the eager path's python math carries right up to the array op).
+    Arithmetic against a traced array materializes the closure as one
+    f32 slot of the group's scalar input vector, which is exactly the
+    single f64->f32 rounding the eager path pays when its python
+    scalar meets a jnp array.
+    """
+
+    __slots__ = ("_tr", "_fn")
+    # jax array dunders defer to unrecognized operand types
+    __array_priority__ = 200.0
+
+    def __init__(self, tr, fn):
+        self._tr = tr
+        self._fn = fn
+
+    def _bin(self, other, op, rev):
+        f = self._fn
+        if isinstance(other, _HostScalar):
+            g = other._fn
+            if rev:
+                return _HostScalar(self._tr, lambda: op(g(), f()))
+            return _HostScalar(self._tr, lambda: op(f(), g()))
+        if isinstance(other, (int, float)):
+            if rev:
+                return _HostScalar(self._tr, lambda: op(other, f()))
+            return _HostScalar(self._tr, lambda: op(f(), other))
+        # traced array (or NDArray): materialize into a slot and let
+        # jnp take over
+        x = self._tr.materialize(f)
+        return op(other, x) if rev else op(x, other)
+
+    def __add__(self, o):
+        return self._bin(o, operator.add, False)
+
+    def __radd__(self, o):
+        return self._bin(o, operator.add, True)
+
+    def __sub__(self, o):
+        return self._bin(o, operator.sub, False)
+
+    def __rsub__(self, o):
+        return self._bin(o, operator.sub, True)
+
+    def __mul__(self, o):
+        return self._bin(o, operator.mul, False)
+
+    def __rmul__(self, o):
+        return self._bin(o, operator.mul, True)
+
+    def __truediv__(self, o):
+        return self._bin(o, operator.truediv, False)
+
+    def __rtruediv__(self, o):
+        return self._bin(o, operator.truediv, True)
+
+    def __pow__(self, o):
+        return self._bin(o, operator.pow, False)
+
+    def __rpow__(self, o):
+        return self._bin(o, operator.pow, True)
+
+    def __neg__(self):
+        f = self._fn
+        return _HostScalar(self._tr, lambda: -f())
+
+    def __float__(self):
+        # a float() during trace would BAKE the per-step value into the
+        # compiled program; fail loud so the group falls back to eager
+        raise MXNetError(
+            "optimizer rule concretizes a per-step hyperparameter during "
+            "the multi-tensor trace (float() on a host scalar)")
+
+    def __bool__(self):
+        raise MXNetError(
+            "optimizer rule branches on a per-step hyperparameter during "
+            "the multi-tensor trace — register it non-fusable")
+
+
+class _CountView:
+    """Stand-in for ``Optimizer._index_update_count`` during the trace:
+    lookups yield host scalars reading the LIVE count each step.
+
+    Slot closures dereference ``opt._index_update_count`` at eval time
+    rather than capturing the dict object: ``Trainer.load_checkpoint``
+    REBINDS that attribute to a fresh dict, and a captured reference
+    would silently freeze bias-correction ``t`` at its pre-restore
+    value for every cached group."""
+
+    __slots__ = ("_tr", "_opt", "_counts")
+
+    def __init__(self, tr, opt, counts):
+        self._tr = tr
+        self._opt = opt
+        self._counts = counts
+
+    @staticmethod
+    def _live(opt):
+        counts = opt._index_update_count
+        if isinstance(counts, _CountView):  # mid-trace of another group
+            counts = counts._counts
+        return counts
+
+    def __getitem__(self, index):
+        opt = self._opt
+        return _HostScalar(
+            self._tr, lambda i=index: float(_CountView._live(opt)[i]))
+
+    def __contains__(self, index):
+        return index in _CountView._live(self._opt)
+
+
+@contextlib.contextmanager
+def _trace_hparams(opt, tr):
+    """Reroute the optimizer's per-step host reads through ``tr`` for
+    the duration of one group trace.  The real count bump happens
+    eagerly in ``_apply_group`` before each program launch."""
+    orig_lr, orig_wd = opt._get_lr, opt._get_wd
+    counts = opt._index_update_count
+    rescale = opt.rescale_grad
+    opt._get_lr = lambda index: _HostScalar(
+        tr, lambda i=index: float(orig_lr(i)))
+    opt._get_wd = lambda index: _HostScalar(
+        tr, lambda i=index: float(orig_wd(i)))
+    opt._update_count = lambda index: None
+    opt._index_update_count = _CountView(tr, opt, counts)
+    # reads the live attribute at slot-eval time (the Trainer rewrites
+    # rescale_grad every step before _update)
+    opt.rescale_grad = _HostScalar(tr, lambda: float(opt.rescale_grad))
+    try:
+        yield
+    finally:
+        for name in ("_get_lr", "_get_wd", "_update_count"):
+            opt.__dict__.pop(name, None)
+        opt._index_update_count = counts
+        opt.rescale_grad = rescale
+
+
+# ---------------------------------------------------------------------------
+# fusability registry
+# ---------------------------------------------------------------------------
+
+_FUSABLE = set()
+_FUSABLE_READY = [False]
+
+
+def register_fusable(cls):
+    """Declare an Optimizer class safe for the fused multi-tensor path:
+    its ``update`` must be pure jnp given the weight/grad/state arrays
+    plus host hyperparameters — no RNG draws, no python state mutated
+    with per-step values, no branching on hyperparameter VALUES."""
+    _FUSABLE.add(cls)
+    return cls
+
+
+def _builtin_fusable():
+    if _FUSABLE_READY[0]:
+        return
+    from . import optimizer as _opt
+
+    # excluded by design: Nadam (mutates python m_schedule with a
+    # per-step value), SGLD (draws RNG keys during the update)
+    for name in ("SGD", "NAG", "Adam", "AdamW", "Adamax", "LAMB", "LANS",
+                 "LARS", "Ftrl", "FTML", "AdaGrad", "AdaDelta", "RMSProp",
+                 "Signum", "DCASGD", "LBSGD", "Test"):
+        _FUSABLE.add(getattr(_opt, name))
+    _FUSABLE_READY[0] = True
+
+
+def is_fusable(optimizer):
+    """Exact-class check: a subclass with an overridden ``update`` must
+    opt in via ``register_fusable`` — silently fusing unknown python
+    would be wrong, not slow."""
+    _builtin_fusable()
+    return type(optimizer) in _FUSABLE
+
+
+# ---------------------------------------------------------------------------
+# grouping
+# ---------------------------------------------------------------------------
+
+def _hparams_sig(opt):
+    """Scalar hyperparameters baked into a group trace (momentum, betas,
+    eps, clip_gradient, the raw ``lr`` attr that AdaDelta/Test read
+    directly, ...).  A change — e.g. ``set_learning_rate`` — rebuilds
+    the group programs; per-step values (counts, rescale, scheduler lr)
+    flow through host-scalar slots instead and never appear here."""
+    return tuple(sorted(
+        (k, repr(v)) for k, v in vars(opt).items()
+        if k not in ("num_update", "rescale_grad", "begin_num_update")
+        and isinstance(v, (int, float, bool, str, type(None)))))
+
+
+def _group_key(trainer, i, param, grad):
+    jax = _jax()
+    opt = trainer._optimizer
+    w = param.data()
+    try:
+        devs = tuple(sorted(str(d) for d in w._data.devices()))
+    except Exception:  # tracer / uncommitted
+        devs = ("uncommitted",)
+    return (type(opt).__name__, id(opt), str(w.dtype), str(grad.dtype),
+            repr(float(param.lr_mult)), repr(float(param.wd_mult)),
+            jax.tree_util.tree_structure(trainer._states[i]), devs,
+            bool(trainer._zero))
+
+
+def partition(trainer, items):
+    """Split ``[(index, param, grad)]`` into fused groups and eager
+    leftovers.  Returns ``(groups, eager)``: ``groups`` maps group key
+    -> member list (insertion-ordered, ascending param index — every
+    rank partitions identically), ``eager`` is ``[(i, param, grad,
+    reason)]``."""
+    from ..ndarray.sparse import RowSparseNDArray
+
+    opt = trainer._optimizer
+    if not get_env("MXNET_MULTI_TENSOR", bool, True):
+        return {}, [(i, p, g, "disabled") for i, p, g in items]
+    if not is_fusable(opt):
+        return {}, [(i, p, g, "optimizer") for i, p, g in items]
+    groups, eager = {}, []
+    for i, param, grad in items:
+        if isinstance(grad, RowSparseNDArray) or \
+                getattr(grad, "stype", "default") != "default":
+            eager.append((i, param, grad, "row_sparse"))
+            continue
+        if getattr(param, "stype", "default") != "default":
+            eager.append((i, param, grad, "stype"))
+            continue
+        groups.setdefault(_group_key(trainer, i, param, grad),
+                          []).append((i, param, grad))
+    return groups, eager
+
+
+# ---------------------------------------------------------------------------
+# the fused group program
+# ---------------------------------------------------------------------------
+
+def _is_nd(x):
+    return isinstance(x, NDArray)
+
+
+def _deleted(a):
+    return getattr(a, "is_deleted", lambda: False)()
+
+
+def _unwrap_state(tree):
+    return _jax().tree_util.tree_map(lambda x: x._data, tree,
+                                     is_leaf=_is_nd)
+
+
+class _Group:
+    """One compiled multi-tensor update program (one per group key)."""
+
+    __slots__ = ("key", "indices", "members_sig", "hsig", "n_slots",
+                 "slot_fns", "jfn", "cfn", "cfn_ok", "fingerprint",
+                 "provenance", "zero", "nbytes", "opt_name")
+
+    def __init__(self):
+        self.slot_fns = None
+        self.jfn = None
+        self.cfn = None
+        self.cfn_ok = False
+        self.fingerprint = None
+        self.provenance = "fresh"
+
+    def call(self, weights, grads, states, hscal):
+        with _quiet_donation():
+            if self.cfn is not None:
+                try:
+                    out = self.cfn(weights, grads, states, hscal)
+                    self.cfn_ok = True
+                    return out
+                except Exception:
+                    if self.cfn_ok:
+                        raise  # served before: surface the real error
+                    self.cfn = None  # aval/placement drift: lazy jit
+                    if any(_deleted(a) for a in weights):
+                        # the failed launch already consumed its donated
+                        # inputs — a jfn retry (or the eager fallback)
+                        # would read deleted buffers
+                        raise MXNetError(
+                            "multi-tensor cached program failed after "
+                            "consuming its donated weight buffers")
+            return self.jfn(weights, grads, states, hscal)
+
+
+def _make_fn(opt, indices, group):
+    """The pure group program: replay ``update_multi_precision`` over
+    every member, host hyperparameters rerouted through ``hscal``."""
+
+    def fn(weights, grads, states, hscal):
+        tr = _Trace(hscal)
+        new_w, new_s = [], []
+        with _trace_hparams(opt, tr):
+            for j, idx in enumerate(indices):
+                w = NDArray(weights[j])
+                g = NDArray(grads[j])
+                st = _jax().tree_util.tree_map(NDArray, states[j])
+                opt.update_multi_precision(idx, w, g, st)
+                new_w.append(w._data)
+                new_s.append(_unwrap_state(st))
+        group.slot_fns = tr.fns
+        return new_w, new_s
+
+    return fn
+
+
+def _attach_cache(lowered, group):
+    """Compile the lowered group program, consulting / committing the
+    mx.compile persistent store when enabled.  Returns ``(compiled,
+    provenance)``; ``(None, "fresh")`` leaves the lazy jit path."""
+    from .. import compile as _compile
+
+    if _compile.is_enabled():
+        try:
+            import pickle
+
+            from ..compile.aot import _deserialize, _serialize_api
+
+            cache = _compile.get_cache()
+            se = _serialize_api()
+            if cache is not None and se is not None:
+                fp = cache.fingerprint(lowered.as_text())
+                group.fingerprint = fp
+                try:
+                    loaded = cache.load(fp)
+                except Exception:
+                    loaded = None
+                if loaded is not None:
+                    raw, _meta = loaded
+                    try:
+                        cfn, _key = _deserialize(se, raw)
+                        if _tel.ENABLED:
+                            _tel.COMPILE_CACHE_HIT.inc()
+                        return cfn, "cache"
+                    except Exception:
+                        cache.quarantine(
+                            fp, reason="artifact undeserializable")
+                if _tel.ENABLED:
+                    _tel.COMPILE_CACHE_MISS.inc()
+                compiled = lowered.compile()
+                try:
+                    exe, in_tree, out_tree = se.serialize(compiled)
+                    artifact = pickle.dumps(
+                        {"exe": exe, "in_tree": in_tree,
+                         "out_tree": out_tree, "key": None})
+                    cache.commit(fp, artifact, {
+                        "block_class": "_MultiTensorGroup",
+                        "block_sig": "multi_tensor:%s:%d"
+                                     % (group.opt_name,
+                                        len(group.indices)),
+                        # never a warm_start candidate: the trainer
+                        # re-traces (cheap) and hits by fingerprint
+                        "portable": False})
+                except Exception:
+                    _LOGGER.debug("multi-tensor cache commit failed",
+                                  exc_info=True)
+                return compiled, "fresh"
+        except Exception:
+            _LOGGER.debug("multi-tensor cache attach failed",
+                          exc_info=True)
+    try:
+        return lowered.compile(), "fresh"
+    except Exception:
+        return None, "fresh"
+
+
+def _build_group(trainer, key, indices, members_sig, hsig,
+                 w_arrs, g_arrs, s_trees, zero):
+    jax = _jax()
+    opt = trainer._optimizer
+    group = _Group()
+    group.key = key
+    group.indices = indices
+    group.members_sig = members_sig
+    group.hsig = hsig
+    group.zero = zero
+    group.opt_name = type(opt).__name__
+    # generous bound: the builtin rules materialize <= ~6 host scalars
+    # per parameter (lr, wd, rescale, bias corrections)
+    group.n_slots = 12 * len(indices) + 8
+    group.nbytes = sum(a.size * a.dtype.itemsize for a in w_arrs)
+    fn = _make_fn(opt, indices, group)
+    # weights and states are donated: the update is in-place at the XLA
+    # level, so a 100M-param group does not hold 2x weight HBM
+    group.jfn = jax.jit(fn, donate_argnums=(0, 2))
+    hscal0 = _np.zeros((group.n_slots,), _np.float32)
+    lowered = None
+    with _quiet_donation():
+        try:
+            lowered = group.jfn.lower(w_arrs, g_arrs, s_trees, hscal0)
+        except Exception:
+            # no AOT lowering on this backend: one abstract trace still
+            # discovers the slot closures; jfn compiles lazily on call
+            jax.eval_shape(fn, w_arrs, g_arrs, s_trees,
+                           jax.ShapeDtypeStruct(hscal0.shape,
+                                                hscal0.dtype))
+        if group.slot_fns is None:
+            raise MXNetError("multi-tensor trace recorded no host "
+                             "state for group %r" % (group.opt_name,))
+        if lowered is not None:
+            group.cfn, group.provenance = _attach_cache(lowered, group)
+    if _tel.ENABLED:
+        _tel.TRAINER_FUSED_BUILDS.labels(optimizer=group.opt_name).inc()
+    return group
+
+
+def _apply_group(trainer, key, members, hsig, cache):
+    jax = _jax()
+    opt = trainer._optimizer
+    indices = tuple(i for i, _, _ in members)
+    w_handles = [p.data() for _, p, _ in members]
+    w_arrs = [h._data for h in w_handles]
+    g_arrs = [g._data for _, _, g in members]
+    s_trees = [_unwrap_state(trainer._states[i]) for i in indices]
+    members_sig = (
+        indices,
+        tuple((a.shape, str(a.dtype)) for a in w_arrs),
+        tuple((a.shape, str(a.dtype)) for a in g_arrs),
+        tuple(tuple((leaf.shape, str(leaf.dtype))
+                    for leaf in jax.tree_util.tree_leaves(t))
+              for t in s_trees))
+    homes = None
+    if trainer._zero:
+        # ZeRO-1: ONE replicate-in transfer for the whole group (the
+        # per-param path paid 3 device_puts x N), the dp-sharded states
+        # stay put, and the program runs SPMD over the mesh
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = NamedSharding(trainer._zero_mesh, P())
+        homes = [next(iter(a.devices())) for a in w_arrs]
+        w_arrs, g_arrs = jax.device_put((w_arrs, g_arrs), rep)
+    group = cache.get(key)
+    if group is None or group.members_sig != members_sig \
+            or group.hsig != hsig:
+        group = _build_group(trainer, key, indices, members_sig, hsig,
+                             w_arrs, g_arrs, s_trees,
+                             bool(trainer._zero))
+        cache[key] = group
+    # the real host-side bookkeeping the traced no-ops stand in for;
+    # snapshot first so a failed launch can rewind — the eager fallback
+    # calls _update_count itself, and a double bump would skew the
+    # Adam-family bias-correction t for the degraded step
+    counts = opt._index_update_count
+    prev_counts = {i: counts.get(i) for i in indices}
+    prev_num_update = opt.num_update
+    for i in indices:
+        opt._update_count(i)
+    try:
+        vals = _np.zeros((group.n_slots,), _np.float32)
+        for k, f in enumerate(group.slot_fns):
+            vals[k] = f()
+        new_w, new_s = group.call(w_arrs, g_arrs, s_trees, vals)
+    except Exception:
+        for i, v in prev_counts.items():
+            if v is None:
+                counts.pop(i, None)
+            else:
+                counts[i] = v
+        opt.num_update = prev_num_update
+        raise
+    if _tel.ENABLED:
+        _tel.TRAINER_FUSED_APPLY.labels(optimizer=group.opt_name).inc()
+    if homes is not None:
+        # scatter-home: one transfer for the whole group
+        new_w = jax.device_put(new_w, homes)
+
+    def _wb(old, new):
+        old._data = new
+        return old
+
+    for j, i in enumerate(indices):
+        w_handles[j]._data = new_w[j]
+        if trainer._states[i] is not None:
+            jax.tree_util.tree_map(_wb, trainer._states[i], new_s[j],
+                                   is_leaf=_is_nd)
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def apply_updates(trainer, items):
+    """Apply one optimizer step over ``items = [(index, param, grad)]``:
+    fused multi-tensor programs for every eligible group, the classic
+    per-parameter eager path for the rest.  Called by
+    ``gluon.Trainer._update``."""
+    tel_on = _tel.ENABLED
+    t0 = _time.perf_counter() if tel_on else 0.0
+    groups, eager = partition(trainer, items)
+    cache = trainer._mt_groups
+    hsig = _hparams_sig(trainer._optimizer)
+    for key, members in groups.items():
+        try:
+            _apply_group(trainer, key, members, hsig, cache)
+        except Exception:
+            # never lose a step to the fast path: degrade this group to
+            # eager updates and retire its broken program
+            cache.pop(key, None)
+            if any(_deleted(p.data()._data) for _, p, _ in members):
+                # a failed launch consumed its donated inputs: the
+                # weights are gone, an eager replay would read deleted
+                # buffers — fail loud instead of corrupting the model
+                raise MXNetError(
+                    "multi-tensor group %s failed after its donated "
+                    "weight buffers were consumed; parameter state is "
+                    "unrecoverable for this step" % (key[0],))
+            _LOGGER.warning(
+                "multi-tensor group %s degraded to eager updates",
+                key[0], exc_info=True)
+            for i, param, grad in members:
+                trainer._eager_update(i, param, grad)
+                if tel_on:
+                    _tel.TRAINER_EAGER_UPDATES.labels(
+                        reason="trace-error").inc()
+    for i, param, grad, reason in eager:
+        trainer._eager_update(i, param, grad)
+        if tel_on:
+            _tel.TRAINER_EAGER_UPDATES.labels(reason=reason).inc()
+    if tel_on:
+        _tel.TRAINER_FUSED_GROUPS.set(len(groups))
+        _tel.TRAINER_UPDATE_SECONDS.observe(_time.perf_counter() - t0)
+
+
+def group_table(trainer):
+    """Introspection for tools/diagnose.py --trainer and tests: one row
+    per live group — optimizer, member count, parameter bytes, programs
+    per step (always 1), provenance, host-scalar slots in use."""
+    rows = []
+    for group in trainer._mt_groups.values():
+        rows.append({
+            "optimizer": group.opt_name,
+            "params": len(group.indices),
+            "bytes": int(group.nbytes),
+            "programs_per_step": 1,
+            "provenance": group.provenance,
+            "zero": bool(group.zero),
+            "host_scalar_slots": len(group.slot_fns or ()),
+        })
+    return rows

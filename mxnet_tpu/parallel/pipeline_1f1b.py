@@ -221,11 +221,22 @@ class OneFOneBTrainer(_pipeline_trainer_cls()):
                  optimizer_params=None, mesh=None, loss_fn=None,
                  num_microbatches=4, dtype=None, *, schedule="1f1b",
                  num_virtual_stages=1):
+        if schedule != "1f1b":
+            # ADVICE r5: the schedule kwarg exists for PipelineTrainer
+            # dispatch parity — accepting e.g. "gpipe" here would
+            # silently run the 1F1B engine anyway
+            raise MXNetError(
+                "OneFOneBTrainer implements schedule='1f1b' only (got "
+                "%r); construct PipelineTrainer(..., schedule=%r) for "
+                "other schedules" % (schedule, schedule))
         self._init_common(block, loss, optimizer, optimizer_params, mesh,
                           loss_fn, num_microbatches, dtype, "1f1b")
         self._V = int(num_virtual_stages)
         if self._V < 1:
             raise MXNetError("num_virtual_stages must be >= 1")
+        # >= 2 model chunks always: _init_common rejects pp < 2 and
+        # V >= 1 is enforced above, so the single-chunk degenerate case
+        # (which would die in step()'s acts bookkeeping) cannot be built
         self._C = self._S * self._V          # model chunks
         if self._V > 1 and self._M % self._S:
             raise MXNetError(

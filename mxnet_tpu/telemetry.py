@@ -582,6 +582,31 @@ COLLECTIVE_BYTES = counter(
     "collective_bytes_total", "bytes moved by collectives", ("op",))
 COLLECTIVE_SECONDS = histogram(
     "collective_seconds", "collective dispatch+assembly latency")
+ALLREDUCE_BUCKET_FILL = histogram(
+    "allreduce_bucket_fill",
+    "fill fraction of each fused all-reduce bucket relative to "
+    "MXNET_KVSTORE_BUCKET_BYTES (>1 = one oversized array)",
+    buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0, 1.5, 2.0))
+# imperative Trainer multi-tensor update engine (optimizer/
+# multi_tensor.py): one fused, buffer-donated program per parameter
+# group per step; eager per-parameter updates are the fallback path
+TRAINER_FUSED_GROUPS = gauge(
+    "trainer_fused_groups",
+    "multi-tensor update groups in the last imperative Trainer step")
+TRAINER_FUSED_APPLY = counter(
+    "trainer_fused_apply_total",
+    "fused multi-tensor update programs launched", ("optimizer",))
+TRAINER_FUSED_BUILDS = counter(
+    "trainer_fused_builds_total",
+    "multi-tensor group program builds (trace + compile)",
+    ("optimizer",))
+TRAINER_EAGER_UPDATES = counter(
+    "trainer_eager_updates_total",
+    "per-parameter eager optimizer updates (multi-tensor fallback)",
+    ("reason",))
+TRAINER_UPDATE_SECONDS = histogram(
+    "trainer_update_seconds",
+    "imperative Trainer optimizer-apply dispatch latency per step")
 DATALOADER_WAIT_SECONDS = histogram(
     "dataloader_batch_wait_seconds",
     "time the training loop blocked waiting for the next batch")

@@ -237,6 +237,70 @@ def serve_info(src):
             print("  %-36s %g" % (k, totals[k]))
 
 
+def trainer_info():
+    """Audit the imperative Trainer's multi-tensor update engine by
+    training a representative mixed-group model for 2 steps: group
+    table (params-per-group, bytes, programs/step, provenance) plus the
+    collective bucket plan (programs and fill % at the current
+    MXNET_KVSTORE_BUCKET_BYTES)."""
+    section("Trainer / multi-tensor")
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd, telemetry
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.kvstore import collective
+    from mxnet_tpu.optimizer import multi_tensor
+
+    from mxnet_tpu.base import get_env
+
+    enabled = get_env("MXNET_MULTI_TENSOR", bool, True)
+    print("multi-tensor :", "enabled" if enabled else
+          "DISABLED (MXNET_MULTI_TENSOR=0 — eager per-param updates)")
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    for _ in range(8):
+        net.add(nn.Dense(32, in_units=32))
+    net.initialize()
+    params = net.collect_params()
+    # a distinct lr_mult splits a group — makes the table representative
+    list(params.values())[-1].lr_mult = 0.5
+    trainer = gluon.Trainer(params, "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    x = nd.array(np.random.RandomState(0).rand(4, 32).astype(np.float32))
+    for _ in range(2):
+        with autograd.record():
+            loss = (net(x) ** 2).mean()
+        loss.backward()
+        trainer.step(4)
+    rows = multi_tensor.group_table(trainer)
+    print("groups       : %d  (demo model: %d params)"
+          % (len(rows), len(trainer._params)))
+    for r in rows:
+        print("  %-10s %3d params  %10.1f KiB  %d program/step  "
+              "%s%s  (%d host scalars)"
+              % (r["optimizer"], r["params"], r["bytes"] / 1024.0,
+                 r["programs_per_step"], r["provenance"],
+                 "  [zero]" if r["zero"] else "",
+                 r["host_scalar_slots"]))
+    grads = [(p.grad().size * p.grad().dtype.itemsize,
+              str(p.grad().dtype)) for p in trainer._params]
+    plan = collective.plan_buckets(grads)
+    total = sum(n for n, _ in grads)
+    print("bucket plan  : %d collective program(s) for %.1f KiB grads "
+          "(bucket=%.1f MiB)"
+          % (len(plan), total / 1024.0,
+             collective._BUCKET_BYTES / 1048576.0))
+    for b, idxs in enumerate(plan):
+        nbytes = sum(grads[i][0] for i in idxs)
+        print("  bucket %d   : %3d key(s)  %10.1f KiB  fill %5.1f%%"
+              % (b, len(idxs), nbytes / 1024.0,
+                 100.0 * nbytes / collective._BUCKET_BYTES))
+    tot = {k: v for k, v in telemetry.totals(nonzero=True).items()
+           if k.startswith("trainer_")}
+    print("telemetry    : %s" % (tot or "(telemetry disabled)"))
+
+
 def compile_cache_info():
     """Audit the mx.compile persistent compilation cache: directory,
     entry count, total bytes, per-entry age/size, quarantined entries,
@@ -308,12 +372,19 @@ def main():
                     help="audit the mx.compile persistent compilation "
                          "cache: dir, entries, bytes, quarantined "
                          "entries, hit/miss telemetry")
+    ap.add_argument("--trainer", action="store_true",
+                    help="audit the imperative Trainer's multi-tensor "
+                         "update engine: group table, programs/step, "
+                         "collective bucket fill")
     args = ap.parse_args()
     # section flags compose: --compile-cache --serve URL prints both
     # (each skips the environment dump, all honor --telemetry)
-    if args.compile_cache or args.serve or args.checkpoints:
+    if args.compile_cache or args.serve or args.checkpoints or \
+            args.trainer:
         if args.compile_cache:
             compile_cache_info()
+        if args.trainer:
+            trainer_info()
         if args.serve:
             serve_info(args.serve)
         if args.checkpoints:
