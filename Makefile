@@ -10,7 +10,7 @@ SRCS := $(wildcard src/native/*.cc)
 SO := build/libmxtpu_native.so
 
 .PHONY: native test cpptest telemetry-smoke checkpoint-smoke serve-smoke \
-	compile-cache-smoke trainer-smoke clean
+	compile-cache-smoke trainer-smoke trace-smoke clean
 
 native: $(SO)
 
@@ -74,6 +74,15 @@ trainer-smoke:
 	JAX_PLATFORMS=cpu python tools/trainer_smoke.py
 	JAX_PLATFORMS=cpu python -m pytest \
 	  tests/python/unittest/test_trainer_fused.py -q -m 'not slow'
+
+# mx.trace smoke: traced CPU train step + serve request (>=4 nested
+# phase spans each, one trace id, distinct thread tracks), parseable
+# Perfetto dump, X-Request-Id echo, watchdog dry-run writing stacks +
+# flight record; then the subsystem's pytest suite
+trace-smoke:
+	JAX_PLATFORMS=cpu python tools/trace_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/python/unittest/test_trace.py -q -m 'not slow'
 
 # suite summary artifact (TESTS_r{N}.json) — round-2 advisor contract
 test-report:

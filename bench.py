@@ -439,7 +439,7 @@ def _bench_imperative_trainer(batch=64, iters=10, dtype="bfloat16"):
     import numpy as np
 
     import mxnet_tpu as mx
-    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu import autograd, gluon, nd, trace
     from mxnet_tpu.gluon.model_zoo import vision
 
     mx.random.seed(0)
@@ -459,10 +459,19 @@ def _bench_imperative_trainer(batch=64, iters=10, dtype="bfloat16"):
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
 
     def step():
-        with autograd.record():
-            loss = loss_fn(net(x), y).mean()
-        loss.backward()
-        trainer.step(batch)
+        # full-step trace: forward / backward / (nested) trainer_step
+        # share one trace id per iteration, so the first live tunnel
+        # window leaves a phase-level flight record next to the row.
+        # (no anomaly= here: the nested trainer_step span already feeds
+        # the slow-step detector — a second feed from a different
+        # duration distribution would skew its trailing p99)
+        with trace.span("train_step", hist=False):
+            with trace.span("forward", hist=False):
+                with autograd.record():
+                    loss = loss_fn(net(x), y).mean()
+            with trace.span("backward", hist=False):
+                loss.backward()
+            trainer.step(batch)
         return loss
 
     _log("imperative trainer %s: compiling+warmup" % dtype)

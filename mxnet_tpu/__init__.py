@@ -48,6 +48,7 @@ def _maybe_init_distributed():
 _maybe_init_distributed()
 
 from . import base, telemetry  # telemetry first: instrumented layers use it
+from . import trace  # structured tracing + flight recorder (uses telemetry)
 from . import autograd, context, engine
 from . import ndarray
 from . import ndarray as nd

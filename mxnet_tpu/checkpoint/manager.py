@@ -43,7 +43,7 @@ import time
 import numpy as _np
 
 from ..base import MXNetError
-from .. import telemetry
+from .. import telemetry, trace
 from . import layout
 from .writer import AsyncWriter
 
@@ -230,20 +230,23 @@ class CheckpointManager:
         """Critical-path phase: structure + device->host copies only."""
         import jax
 
-        t0 = time.perf_counter()
-        spec = layout.tree_spec(tree)
-        leaves = jax.tree_util.tree_leaves(tree)
-        want = layout.n_leaves(spec)
-        if want != len(leaves):
-            raise MXNetError(
-                "checkpoint tree has %d leaves but its structure spec "
-                "describes %d — the tree mixes containers mx.checkpoint "
-                "cannot describe (dict/list/tuple/None only)"
-                % (len(leaves), want))
-        host = [layout.snapshot_leaf(v) for v in leaves]
-        if telemetry.ENABLED:
-            telemetry.CHECKPOINT_SNAPSHOT_SECONDS.observe(
-                time.perf_counter() - t0)
+        with trace.span("checkpoint_snapshot", hist=False,
+                        cat="checkpoint"):
+            t0 = time.perf_counter()
+            spec = layout.tree_spec(tree)
+            leaves = jax.tree_util.tree_leaves(tree)
+            want = layout.n_leaves(spec)
+            if want != len(leaves):
+                raise MXNetError(
+                    "checkpoint tree has %d leaves but its structure "
+                    "spec describes %d — the tree mixes containers "
+                    "mx.checkpoint cannot describe (dict/list/tuple/"
+                    "None only)"
+                    % (len(leaves), want))
+            host = [layout.snapshot_leaf(v) for v in leaves]
+            if telemetry.ENABLED:
+                telemetry.CHECKPOINT_SNAPSHOT_SECONDS.observe(
+                    time.perf_counter() - t0)
         return spec, host
 
     def save(self, step, tree):
@@ -253,9 +256,12 @@ class CheckpointManager:
     def save_async(self, step, tree):
         """Snapshot on the calling thread, serialize+commit in the
         background.  Returns a ``SaveFuture``; blocks only when
-        ``max_inflight`` saves are already queued."""
+        ``max_inflight`` saves are already queued.  The caller's trace
+        context travels with the payload, so the background serialize/
+        commit spans join the step that triggered the save."""
         spec, host = self._snapshot(tree)
-        return self._writer.submit(int(step), (spec, host))
+        return self._writer.submit(int(step),
+                                   (spec, host, trace.current()))
 
     def wait(self):
         """Block until every queued async save commits; re-raises the
@@ -265,12 +271,24 @@ class CheckpointManager:
 
     def _commit(self, step, payload):
         """Background phase: serialize, durably write, atomically
-        publish.  Retries transient OSErrors with backoff."""
-        spec, host = payload
+        publish.  Retries transient OSErrors with backoff.  Runs under
+        the submitting step's trace context (carried in the payload),
+        so the writer thread's spans share the step's trace id."""
+        spec, host, tctx = payload if len(payload) == 3 \
+            else (payload[0], payload[1], None)
+        with trace.use(tctx):
+            return self._commit_traced(step, spec, host)
+
+    def _commit_traced(self, step, spec, host):
         delay = self._retry_backoff
         for attempt in range(self._io_retries):
             try:
-                path = self._commit_once(step, spec, host)
+                with trace.span("checkpoint_save", hist=False,
+                                cat="checkpoint",
+                                args={"step": int(step),
+                                      "attempt": attempt}), \
+                        trace.watchdog.watch("checkpoint_commit"):
+                    path = self._commit_once(step, spec, host)
                 if telemetry.ENABLED:
                     telemetry.CHECKPOINT_SAVES.labels(result="ok").inc()
                 # the commit is durable; GC is best-effort and must not
@@ -305,44 +323,50 @@ class CheckpointManager:
         prev = final + ".prev"
         parked = False
         try:
-            file_meta, total = {}, 0
-            # shards stream straight into the temp dir (the CRC re-reads
-            # what landed on disk) — serialization never doubles the
-            # host snapshot in memory
-            for fname, writer in writers:
-                crc, n = layout.write_stream_durable(
-                    os.path.join(tmp, fname), writer)
-                file_meta[fname] = {"crc32": crc, "nbytes": n}
-                total += n
-            if telemetry.ENABLED:
-                telemetry.CHECKPOINT_SERIALIZE_SECONDS.observe(
-                    time.perf_counter() - t_ser)
+            with trace.span("checkpoint_serialize", hist=False,
+                            cat="checkpoint"):
+                file_meta, total = {}, 0
+                # shards stream straight into the temp dir (the CRC
+                # re-reads what landed on disk) — serialization never
+                # doubles the host snapshot in memory
+                for fname, writer in writers:
+                    crc, n = layout.write_stream_durable(
+                        os.path.join(tmp, fname), writer)
+                    file_meta[fname] = {"crc32": crc, "nbytes": n}
+                    total += n
+                if telemetry.ENABLED:
+                    telemetry.CHECKPOINT_SERIALIZE_SECONDS.observe(
+                        time.perf_counter() - t_ser)
             t_commit = time.perf_counter()
-            manifest = layout.build_manifest(
-                step, spec, host, entries, file_meta, __version__)
-            mbytes = json.dumps(manifest, sort_keys=True).encode()
-            layout.write_file_durable(
-                os.path.join(tmp, layout.MANIFEST), mbytes)
-            # phase 2: the marker makes the dir trustworthy; everything
-            # above is already durable when this lands
-            marker = json.dumps({"step": int(step),
-                                 "n_files": len(file_meta) + 1}).encode()
-            layout.write_file_durable(
-                os.path.join(tmp, layout.COMMITTED), marker)
-            layout.fsync_dir(tmp)
+            with trace.span("checkpoint_commit", hist=False,
+                            cat="checkpoint", args={"step": int(step)}):
+                manifest = layout.build_manifest(
+                    step, spec, host, entries, file_meta, __version__)
+                mbytes = json.dumps(manifest, sort_keys=True).encode()
+                layout.write_file_durable(
+                    os.path.join(tmp, layout.MANIFEST), mbytes)
+                # phase 2: the marker makes the dir trustworthy;
+                # everything above is already durable when this lands
+                marker = json.dumps(
+                    {"step": int(step),
+                     "n_files": len(file_meta) + 1}).encode()
+                layout.write_file_durable(
+                    os.path.join(tmp, layout.COMMITTED), marker)
+                layout.fsync_dir(tmp)
 
-            if os.path.exists(final):
-                if os.path.exists(prev):
-                    shutil.rmtree(prev)
-                os.rename(final, prev)   # old copy survives until ...
-                parked = True
-                os.rename(tmp, final)    # ... the new one is published
-            else:
-                os.rename(tmp, final)
-            layout.fsync_dir(self._root)
-            # also sweeps a .prev parked by an earlier attempt of THIS
-            # commit that failed between its two renames and retried
-            shutil.rmtree(prev, ignore_errors=True)
+                if os.path.exists(final):
+                    if os.path.exists(prev):
+                        shutil.rmtree(prev)
+                    os.rename(final, prev)  # old copy survives until ...
+                    parked = True
+                    os.rename(tmp, final)   # ... the new one publishes
+                else:
+                    os.rename(tmp, final)
+                layout.fsync_dir(self._root)
+                # also sweeps a .prev parked by an earlier attempt of
+                # THIS commit that failed between its two renames and
+                # retried
+                shutil.rmtree(prev, ignore_errors=True)
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             # a failed publish must not leave the step parked at .prev

@@ -37,6 +37,7 @@ import shutil
 import time
 
 from .. import telemetry
+from .. import trace as _trace
 from ..base import get_env
 from ..checkpoint import layout as _layout
 
@@ -193,6 +194,11 @@ class CompileCache:
         checksum-clean entry, else None.  Corruption quarantines the
         entry; any other I/O failure is a plain miss.  A successful
         load refreshes the entry's LRU clock."""
+        with _trace.span("compile_cache_load", hist=False, cat="compile",
+                         args={"fp": fp[:12]}):
+            return self._load_entry(fp)
+
+    def _load_entry(self, fp):
         d = self._entry_dir(fp)
         t0 = time.perf_counter()
         try:
@@ -291,6 +297,15 @@ class CompileCache:
         marker + atomic rename).  Racing writers are benign: if the
         entry landed meanwhile, this commit discards its temp dir.
         Returns the entry dir, or None on any I/O failure."""
+        with _trace.span("compile_cache_commit", hist=False,
+                         cat="compile",
+                         args={"fp": fp[:12],
+                               "bytes": len(artifact)
+                               if isinstance(artifact, (bytes, bytearray))
+                               else None}):
+            return self._commit_entry(fp, artifact, meta)
+
+    def _commit_entry(self, fp, artifact, meta):
         import tempfile
 
         t0 = time.perf_counter()

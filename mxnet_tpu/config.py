@@ -56,6 +56,50 @@ ENV_VARS = {
     "MXNET_PROFILER_AUTOSTART": (
         bool, False,
         "Start the profiler at import (reference env_var.md)."),
+    "MXNET_TRACE_DISABLE": (
+        bool, False,
+        "Disable mx.trace recording (spans still feed telemetry "
+        "histograms; the flight-recorder ring stops filling)."),
+    "MXNET_TRACE_RING_EVENTS": (
+        int, 8192,
+        "Flight-recorder capacity: the last N trace events kept in "
+        "memory for dump-on-demand/-crash/-anomaly (trace/core.py)."),
+    "MXNET_TRACE_DUMP_DIR": (
+        str, None,
+        "Where flight-record dumps (mxtrace-<pid>-<reason>-*.json) and "
+        "watchdog stack reports land (default <tempdir>/mxnet_trace)."),
+    "MXNET_TRACE_DUMP_ON_CRASH": (
+        bool, True,
+        "Dump the flight record from sys/threading excepthook on an "
+        "uncaught exception (trace/export.py)."),
+    "MXNET_TRACE_DUMP_AT_EXIT": (
+        bool, False,
+        "Also dump the flight record at normal interpreter exit."),
+    "MXNET_TRACE_DUMP_MIN_SECONDS": (
+        float, 30.0,
+        "Rate limit between anomaly-triggered dumps of the same reason "
+        "(slow_step / deadline_burst / hang)."),
+    "MXNET_TRACE_SLOW_STEP_FACTOR": (
+        float, 3.0,
+        "Dump the flight record when a trainer step exceeds this "
+        "factor x the trailing p99 step latency (0 disables)."),
+    "MXNET_TRACE_DEADLINE_BURST": (
+        int, 8,
+        "Serve deadline misses within MXNET_TRACE_DEADLINE_WINDOW that "
+        "trigger a flight-record dump (0 disables)."),
+    "MXNET_TRACE_DEADLINE_WINDOW": (
+        float, 5.0,
+        "Sliding window (seconds) for the serve deadline-miss burst "
+        "detector."),
+    "MXNET_TRACE_WATCHDOG": (
+        bool, False,
+        "Arm the hang watchdog lazily on the first watched scope "
+        "(trainer step / serve dispatch / checkpoint commit): no "
+        "progress for MXNET_TRACE_WATCHDOG_SECONDS dumps all-thread "
+        "stacks + the flight record."),
+    "MXNET_TRACE_WATCHDOG_SECONDS": (
+        float, 120.0,
+        "Default no-progress timeout per watched scope."),
     "MXNET_TELEMETRY_DISABLE": (
         bool, False,
         "Disable the runtime telemetry registry (mx.telemetry); hooks "

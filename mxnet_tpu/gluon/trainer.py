@@ -17,6 +17,7 @@ from __future__ import annotations
 import pickle
 
 from .. import optimizer as opt_mod
+from .. import trace
 from ..base import MXNetError
 from ..kvstore import create as kv_create
 from .parameter import Parameter
@@ -173,12 +174,22 @@ class Trainer:
         pdata._data = jax.device_put(wrap._data, home)
 
     def step(self, batch_size, ignore_stale_grad=False):
-        """allreduce_grads + update (reference trainer.py:334)."""
+        """allreduce_grads + update (reference trainer.py:334).
+
+        The whole step runs under an ``mx.trace`` span (one trace id
+        per step; allreduce / update / per-group apply nest inside it
+        in the flight record), a watchdog scope (a step stalled on a
+        dead backend trips the hang report), and the slow-step anomaly
+        detector (latency > kx trailing p99 dumps the ring)."""
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        with trace.span("trainer_step", hist=False, anomaly=True,
+                        args={"step": self._step_count}), \
+                trace.watchdog.watch("trainer_step"):
+            with trace.span("trainer_allreduce", hist=False):
+                self._allreduce_grads()
+            self._update(ignore_stale_grad)
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -248,7 +259,8 @@ class Trainer:
         # one fused, buffer-donated program per (optimizer, dtype, stype,
         # lr/wd-mult, placement) group; automatic per-param eager
         # fallback for row_sparse grads / non-fusable optimizers
-        _mt.apply_updates(self, items)
+        with trace.span("trainer_update", hist=False):
+            _mt.apply_updates(self, items)
         self._step_count += 1
 
     def _eager_update(self, i, param, grad):

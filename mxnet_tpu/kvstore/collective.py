@@ -24,6 +24,7 @@ import numpy as _np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import telemetry as _tel
+from .. import trace as _trace
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .base import KVStoreBase
@@ -134,34 +135,42 @@ class CollectiveKVStore(KVStoreBase):
         out = [None] * len(datas)
         plan = plan_buckets([(d.size * d.dtype.itemsize, str(d.dtype))
                              for d in datas])
-        for idxs in plan:
+        for b, idxs in enumerate(plan):
             bucket = [(i, datas[i]) for i in idxs]
             nbytes = sum(a.size * a.dtype.itemsize for _, a in bucket)
             tel_on = _tel.ENABLED
             t0 = _time.perf_counter() if tel_on else 0.0
-            flat = jnp.concatenate(
-                [jnp.ravel(a) for _, a in bucket]) if len(bucket) > 1 \
-                else jnp.ravel(bucket[0][1])
-            sharding = NamedSharding(self._global_mesh(), P("proc"))
-            # assemble the (nproc, L) global array directly from device
-            # buffers — no host round-trip; the per-local-device put is a
-            # device-to-device copy (the P('proc') shard is replicated over
-            # the local axis).  Buckets are async dispatches, so successive
-            # buckets overlap on the interconnect.
-            local = flat[None]
-            arrs = [jax.device_put(local, d) for d in jax.local_devices()]
-            garr = jax.make_array_from_single_device_arrays(
-                (jax.process_count(),) + flat.shape, sharding, arrs)
-            summed = self._sum_program(flat.shape, flat.dtype)(garr)
-            # detach the replicated global result into this process's local
-            # buffer (still on device) — downstream eager ops must not mix
-            # multi-process global arrays with single-device arrays
-            local_sum = summed.addressable_shards[0].data
-            off = 0
-            for i, a in bucket:
-                n = a.size
-                out[i] = local_sum[off:off + n].reshape(a.shape)
-                off += n
+            # one flight-recorder span per collective program: bucket
+            # index / key count / bytes are exactly the per-(op, phase)
+            # measurements the autotune direction needs (ROADMAP 3)
+            with _trace.span("allreduce_bucket", hist=False,
+                             args={"bucket": b, "keys": len(idxs),
+                                   "bytes": nbytes}):
+                flat = jnp.concatenate(
+                    [jnp.ravel(a) for _, a in bucket]) if len(bucket) > 1 \
+                    else jnp.ravel(bucket[0][1])
+                sharding = NamedSharding(self._global_mesh(), P("proc"))
+                # assemble the (nproc, L) global array directly from device
+                # buffers — no host round-trip; the per-local-device put is a
+                # device-to-device copy (the P('proc') shard is replicated over
+                # the local axis).  Buckets are async dispatches, so successive
+                # buckets overlap on the interconnect.
+                local = flat[None]
+                arrs = [jax.device_put(local, d)
+                        for d in jax.local_devices()]
+                garr = jax.make_array_from_single_device_arrays(
+                    (jax.process_count(),) + flat.shape, sharding, arrs)
+                summed = self._sum_program(flat.shape, flat.dtype)(garr)
+                # detach the replicated global result into this process's
+                # local buffer (still on device) — downstream eager ops must
+                # not mix multi-process global arrays with single-device
+                # arrays
+                local_sum = summed.addressable_shards[0].data
+                off = 0
+                for i, a in bucket:
+                    n = a.size
+                    out[i] = local_sum[off:off + n].reshape(a.shape)
+                    off += n
             if tel_on:
                 # dispatch latency only — the psum itself is async (hard
                 # syncs would serialize the bucket overlap noted above)
@@ -235,8 +244,10 @@ class CollectiveKVStore(KVStoreBase):
         merged value to ``_allreduce_many`` at once, so CROSS-parameter
         buckets fill to MXNET_KVSTORE_BUCKET_BYTES — O(total_bytes /
         bucket) collective programs per step instead of one per key."""
-        self.pushpull(list(keys), list(values), out=out,
-                      priority=priority)
+        with _trace.span("pushpull_all", hist=False,
+                         args={"keys": len(keys)}):
+            self.pushpull(list(keys), list(values), out=out,
+                          priority=priority)
 
     def set_optimizer(self, optimizer):
         raise MXNetError(

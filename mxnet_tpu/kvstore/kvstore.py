@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import pickle
 
+from .. import trace as _trace
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .base import KVStoreBase, _pair
@@ -96,8 +97,10 @@ class KVStore(KVStoreBase):
         """Single-process store: ``pushpull`` already takes parallel key
         lists, so the fused entry point is one pass over them (no
         collectives to bucket locally)."""
-        self.pushpull(list(keys), list(values), out=out,
-                      priority=priority)
+        with _trace.span("pushpull_all", hist=False,
+                         args={"keys": len(keys)}):
+            self.pushpull(list(keys), list(values), out=out,
+                          priority=priority)
 
     def broadcast(self, key, value, out):
         self.init(key, value)

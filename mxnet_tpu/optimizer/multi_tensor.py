@@ -55,6 +55,7 @@ import warnings
 import numpy as _np
 
 from .. import telemetry as _tel
+from .. import trace as _trace
 from ..base import MXNetError, get_env
 from ..ndarray.ndarray import NDArray
 
@@ -606,7 +607,10 @@ def apply_updates(trainer, items):
     hsig = _hparams_sig(trainer._optimizer)
     for key, members in groups.items():
         try:
-            _apply_group(trainer, key, members, hsig, cache)
+            with _trace.span("fused_apply", hist=False,
+                             args={"optimizer": key[0],
+                                   "params": len(members)}):
+                _apply_group(trainer, key, members, hsig, cache)
         except Exception:
             # never lose a step to the fast path: degrade this group to
             # eager updates and retire its broken program

@@ -82,15 +82,30 @@ def resume(profile_process="worker"):
 def dump(finished=True, profile_process="worker"):
     """Write the chrome-trace JSON (reference profiler.py:125).  Custom
     domain/task events are written directly; device activity lives in the
-    xplane directory next to it (TensorBoard-loadable)."""
+    xplane directory next to it (TensorBoard-loadable).
+
+    Events carry the REAL pid and the thread id recorded when each
+    event was appended (plus ``thread_name`` metadata), so spans from
+    the serve scheduler, checkpoint writer, and trainer land on
+    separate Perfetto tracks instead of one overlapping tid-0 row."""
     if _state["running"] and finished:
         set_state("stop")
-    trace = {"traceEvents": [
+    pid = os.getpid()
+    with _events_lock:
+        events = list(_state["events"])
+    threads = {}
+    for ev in events:
+        if ev.get("tid") and ev.get("tname"):
+            threads.setdefault(ev["tid"], ev["tname"])
+    meta = [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+             "args": {"name": tname}}
+            for tid, tname in sorted(threads.items())]
+    trace = {"traceEvents": meta + [
         {"name": ev["name"], "cat": ev.get("cat", "user"),
          "ph": ev.get("ph", "X"), "ts": ev["ts"] * 1e6,
-         "dur": ev.get("dur", 0) * 1e6, "pid": 0, "tid": ev.get("tid", 0),
-         "args": ev.get("args", {})}
-        for ev in _state["events"]]}
+         "dur": ev.get("dur", 0) * 1e6, "pid": pid,
+         "tid": ev.get("tid", 0), "args": ev.get("args", {})}
+        for ev in events]}
     with open(_config["filename"], "w") as f:
         json.dump(trace, f)
     return _config["filename"]
@@ -229,11 +244,16 @@ class _Span:
             self._jax_ctx.__exit__(None, None, None)
             self._jax_ctx = None
         if self._start is not None:
+            # tid is recorded at append time (not dump time): the span
+            # may be stopped from any thread, and dump() runs on
+            # whichever thread asks for the file
+            t = threading.current_thread()
             with _events_lock:
                 _state["events"].append({
                     "name": self.name, "cat": self._kind,
                     "ts": self._start,
-                    "dur": time.perf_counter() - self._start})
+                    "dur": time.perf_counter() - self._start,
+                    "tid": t.ident, "tname": t.name})
             self._start = None
 
     def __enter__(self):
@@ -267,20 +287,24 @@ class Counter:
         self.value = 0 if value is None else value
 
     def _record(self, value):
+        t = threading.current_thread()
         with _events_lock:
             self.value = value
             _state["events"].append({"name": self.name, "cat": "counter",
                                      "ph": "C", "ts": time.perf_counter(),
+                                     "tid": t.ident, "tname": t.name,
                                      "args": {"value": value}})
 
     def set_value(self, value):
         self._record(value)
 
     def increment(self, delta=1):
+        t = threading.current_thread()
         with _events_lock:
             self.value += delta
             _state["events"].append({"name": self.name, "cat": "counter",
                                      "ph": "C", "ts": time.perf_counter(),
+                                     "tid": t.ident, "tname": t.name,
                                      "args": {"value": self.value}})
 
     def decrement(self, delta=1):
@@ -300,6 +324,8 @@ class Marker:
         self.name = name
 
     def mark(self, scope="process"):
+        t = threading.current_thread()
         with _events_lock:
             _state["events"].append({"name": self.name, "cat": "marker",
-                                     "ph": "i", "ts": time.perf_counter()})
+                                     "ph": "i", "ts": time.perf_counter(),
+                                     "tid": t.ident, "tname": t.name})

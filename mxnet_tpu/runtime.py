@@ -62,6 +62,7 @@ def _detect():
         "SIGNAL_HANDLER": True,
         "PROFILER": True,
         "TELEMETRY": True,
+        "TRACE": True,
         "CHECKPOINT": True,
         "SERVE": True,
         "OPENMP": True,

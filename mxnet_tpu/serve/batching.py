@@ -23,7 +23,7 @@ import time
 from collections import deque
 from concurrent.futures import Future, InvalidStateError
 
-from .. import telemetry
+from .. import telemetry, trace
 from ..base import MXNetError
 
 __all__ = ["ServeError", "ServerOverloaded", "ServerClosed",
@@ -60,6 +60,16 @@ def _fail(req, exc, result):
         return
     if telemetry.ENABLED:
         telemetry.SERVE_REQUESTS.labels(result=result).inc()
+    if trace.ENABLED and req.trace is not None:
+        trace.record_span(
+            "serve_request", req.enqueued,
+            time.perf_counter() - req.enqueued, ctx=req.trace,
+            root=True, cat="serve",
+            args={"result": result, "request_id": req.request_id})
+    if result == "timeout":
+        # deadline-miss bursts are the stalled-backend signature: the
+        # monitor dumps the flight record when they cluster
+        trace.anomaly.deadline_miss()
 
 
 class Request:
@@ -68,18 +78,27 @@ class Request:
     ``inputs`` is a tuple of numpy arrays (one per model input);
     ``bucket_class`` is the hashable bucket the runner assigned (only
     same-class requests are batched together); ``deadline`` is a
-    monotonic timestamp or None."""
+    monotonic timestamp or None; ``request_id`` is the client's
+    correlation id (X-Request-Id) — when tracing is on it becomes the
+    request's trace id, so its flight-record spans are greppable by
+    the id the client logged."""
 
     __slots__ = ("inputs", "single", "bucket_class", "future",
-                 "enqueued", "deadline")
+                 "enqueued", "deadline", "request_id", "trace")
 
-    def __init__(self, inputs, bucket_class, deadline=None, single=True):
+    def __init__(self, inputs, bucket_class, deadline=None, single=True,
+                 request_id=None):
         self.inputs = tuple(inputs)
         self.single = single
         self.bucket_class = bucket_class
         self.future = Future()
         self.enqueued = time.perf_counter()
         self.deadline = deadline
+        self.request_id = request_id
+        self.trace = trace.new_request(request_id)  # None when disabled
+        if self.trace is not None:
+            trace.instant("serve_enqueue", cat="serve", ctx=self.trace,
+                          args={"request_id": request_id})
 
     def expired(self, now=None):
         return self.deadline is not None and \
@@ -258,23 +277,52 @@ class Scheduler:
             for req in live:
                 telemetry.SERVE_QUEUE_WAIT_SECONDS.observe(
                     now - req.enqueued)
+        head = live[0]
+        if trace.ENABLED:
+            # queue-wait is reconstructed per request from its enqueue
+            # timestamp: the span lived before any scheduler-thread
+            # context existed for it
+            for req in live:
+                if req.trace is not None:
+                    trace.record_span("serve_queue_wait", req.enqueued,
+                                      now - req.enqueued, ctx=req.trace,
+                                      cat="serve")
         runner = self._runner_fn()
         try:
-            results = runner.run_batch(live)
+            # batch-level spans (pad/execute/unpad inside the runner)
+            # adopt the HEAD request's trace context — for a batch the
+            # other members are linked through the `requests` arg list
+            with trace.use(head.trace), \
+                    trace.span("serve_dispatch", hist=False, cat="serve",
+                               args={"batch": len(live),
+                                     "requests": [
+                                         r.trace.trace_id for r in live
+                                         if r.trace is not None]}), \
+                    trace.watchdog.watch("serve_dispatch"):
+                results = runner.run_batch(live)
         except BaseException as exc:  # noqa: BLE001 - surfaced per-request
             for req in live:
                 _fail(req, exc, "error")
             return
-        done_t = time.perf_counter()
-        for req, res in zip(live, results):
-            try:
-                req.future.set_result(res)
-            except InvalidStateError:
-                continue
-            if telemetry.ENABLED:
-                telemetry.SERVE_REQUESTS.labels(result="ok").inc()
-                telemetry.SERVE_REQUEST_SECONDS.observe(
-                    done_t - req.enqueued)
+        with trace.use(head.trace), \
+                trace.span("serve_respond", hist=False, cat="serve"):
+            done_t = time.perf_counter()
+            for req, res in zip(live, results):
+                try:
+                    req.future.set_result(res)
+                except InvalidStateError:
+                    continue
+                if telemetry.ENABLED:
+                    telemetry.SERVE_REQUESTS.labels(result="ok").inc()
+                    telemetry.SERVE_REQUEST_SECONDS.observe(
+                        done_t - req.enqueued)
+                if trace.ENABLED and req.trace is not None:
+                    # the request's root span: enqueue -> result set
+                    trace.record_span(
+                        "serve_request", req.enqueued,
+                        done_t - req.enqueued, ctx=req.trace, root=True,
+                        cat="serve", args={"result": "ok",
+                                           "request_id": req.request_id})
 
     def stop(self, drain=True, timeout=None):
         """Close the queue and join the loop.  With ``drain`` (default)

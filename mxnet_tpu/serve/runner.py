@@ -31,7 +31,7 @@ from threading import RLock
 
 import numpy as _np
 
-from .. import autograd, telemetry
+from .. import autograd, telemetry, trace
 from ..gluon.block import Block, HybridBlock
 from .batching import NoBucketError
 
@@ -289,51 +289,62 @@ class ModelRunner:
         sig = self._target_sig(requests)
         n = len(requests)
         B = self._batch_bucket(n)
-        bufs, real = [], 0
-        for j, bucket_shape in enumerate(sig):
-            buf = _np.zeros((B,) + bucket_shape, dtype=self._dtype)
-            for i, req in enumerate(requests):
-                a = req.inputs[j]
-                real += a.size
-                buf[(i,) + tuple(slice(0, d) for d in a.shape)] = a
-            bufs.append(buf)
-        total = sum(b.size for b in bufs)
-        if telemetry.ENABLED and total:
-            telemetry.SERVE_PAD_ELEMENTS.inc(total - real)
-            telemetry.SERVE_PAD_FRACTION.observe((total - real) / total)
+        # phase spans nest under the scheduler's serve_dispatch span
+        # (the head request's trace context) — or stand alone when
+        # run_batch is called directly
+        with trace.span("serve_pad", hist=False, cat="serve",
+                        args={"batch": B, "requests": n}):
+            bufs, real = [], 0
+            for j, bucket_shape in enumerate(sig):
+                buf = _np.zeros((B,) + bucket_shape, dtype=self._dtype)
+                for i, req in enumerate(requests):
+                    a = req.inputs[j]
+                    real += a.size
+                    buf[(i,) + tuple(slice(0, d) for d in a.shape)] = a
+                bufs.append(buf)
+            total = sum(b.size for b in bufs)
+            if telemetry.ENABLED and total:
+                telemetry.SERVE_PAD_ELEMENTS.inc(total - real)
+                telemetry.SERVE_PAD_FRACTION.observe(
+                    (total - real) / total)
 
         cached = getattr(self._block, "_cached_ops", None)
         before = len(cached) if cached is not None else 0
-        with self._run_lock, autograd.pause():
-            if self._ctx is not None:
-                with self._ctx:
-                    out = self._block(*[nd.array(b, ctx=self._ctx)
-                                        for b in bufs])
-            else:
-                out = self._block(*[nd.array(b) for b in bufs])
+        with trace.span("serve_execute", hist=False, cat="serve",
+                        args={"bucket": _bucket_label(B, sig)
+                              if sig else str(B)}):
+            with self._run_lock, autograd.pause():
+                if self._ctx is not None:
+                    with self._ctx:
+                        out = self._block(*[nd.array(b, ctx=self._ctx)
+                                            for b in bufs])
+                else:
+                    out = self._block(*[nd.array(b) for b in bufs])
+            outs = out if isinstance(out, tuple) else (out,)
+            # asnumpy is the hard sync: device time lands in THIS span
+            outs_np = [o.asnumpy() for o in outs]
         if cached is not None and len(cached) > before \
                 and telemetry.ENABLED:
             # a compile escaped warm-up (unwarmed bucket or lazy mode)
             telemetry.SERVE_COMPILES.labels(
                 bucket=_bucket_label(B, sig)).inc(len(cached) - before)
 
-        outs = out if isinstance(out, tuple) else (out,)
-        outs_np = [o.asnumpy() for o in outs]
-        lead = sig[0] if sig else requests[0].inputs[0].shape
-        results = []
-        for i, req in enumerate(requests):
-            orig = req.inputs[0].shape
-            per_req = []
-            for o in outs_np:
-                row = o[i]
-                if self._unpad:
-                    slices = tuple(
-                        slice(0, orig[a]) if a < len(lead)
-                        and a < len(orig) and row.shape[a] == lead[a]
-                        else slice(None)
-                        for a in range(row.ndim))
-                    row = row[slices]
-                per_req.append(row)
-            results.append(per_req[0] if len(per_req) == 1
-                           else tuple(per_req))
+        with trace.span("serve_unpad", hist=False, cat="serve"):
+            lead = sig[0] if sig else requests[0].inputs[0].shape
+            results = []
+            for i, req in enumerate(requests):
+                orig = req.inputs[0].shape
+                per_req = []
+                for o in outs_np:
+                    row = o[i]
+                    if self._unpad:
+                        slices = tuple(
+                            slice(0, orig[a]) if a < len(lead)
+                            and a < len(orig) and row.shape[a] == lead[a]
+                            else slice(None)
+                            for a in range(row.ndim))
+                        row = row[slices]
+                    per_req.append(row)
+                results.append(per_req[0] if len(per_req) == 1
+                               else tuple(per_req))
         return results

@@ -180,12 +180,14 @@ class Server:
             arrays.append(_np.asarray(x, dtype=self._config.dtype))
         return tuple(arrays), single
 
-    def submit_async(self, inputs, timeout_ms=None):
+    def submit_async(self, inputs, timeout_ms=None, request_id=None):
         """Enqueue one request; returns a ``concurrent.futures.Future``
         resolving to the (unpadded) model output.  Raises
         ``ServerOverloaded`` when the queue is full, ``NoBucketError``
         when no shape bucket covers the input, ``ServerClosed`` after
-        shutdown."""
+        shutdown.  ``request_id`` (the HTTP front-end passes
+        X-Request-Id) becomes the request's trace id in the flight
+        record."""
         if self._closed:
             raise ServerClosed("server is shut down")
         arrays, single = self._normalize(inputs)
@@ -194,15 +196,17 @@ class Server:
             else timeout_ms
         deadline = None if timeout_ms is None \
             else time.perf_counter() + float(timeout_ms) / 1e3
-        req = Request(arrays, cls, deadline=deadline, single=single)
+        req = Request(arrays, cls, deadline=deadline, single=single,
+                      request_id=request_id)
         self._queue.put(req)
         return req.future
 
-    def submit(self, inputs, timeout_ms=None):
+    def submit(self, inputs, timeout_ms=None, request_id=None):
         """Synchronous ``submit_async``: blocks for the result (the
         scheduler resolves every future — ok, timeout, or error — so
         this cannot hang on a dead deadline)."""
-        return self.submit_async(inputs, timeout_ms=timeout_ms).result()
+        return self.submit_async(inputs, timeout_ms=timeout_ms,
+                                 request_id=request_id).result()
 
     # -- hot swap -----------------------------------------------------------
     def swap(self, root=None, step=None, block=None):
@@ -281,12 +285,15 @@ class _Handler(BaseHTTPRequestHandler):
 
         logging.getLogger("mxnet_tpu.serve.http").debug(fmt, *args)
 
-    def _send(self, code, body, content_type="application/json"):
+    def _send(self, code, body, content_type="application/json",
+              headers=()):
         data = body if isinstance(body, bytes) else \
             json.dumps(body).encode()
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in headers:
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
@@ -314,6 +321,21 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/predict":
             self._send(404, {"error": "unknown path %s" % self.path})
             return
+        # X-Request-Id: accepted, attached to the request as its trace
+        # id, and ECHOED on every /predict response (success or error)
+        # so clients and the flight record agree on the correlation id.
+        # The SAME sanitizer the trace id uses: echoing raw client
+        # bytes into send_header is a response-splitting vector
+        # (obs-folded CRLF survives Python's header parser verbatim)
+        from .. import trace
+
+        rid = trace.sanitize_request_id(
+            self.headers.get("X-Request-Id"))
+        echo = (("X-Request-Id", rid),) if rid else ()
+
+        def send(code, body):
+            self._send(code, body, headers=echo)
+
         try:
             n = int(self.headers.get("Content-Length", 0))
             payload = json.loads(self.rfile.read(n) or b"{}")
@@ -321,20 +343,21 @@ class _Handler(BaseHTTPRequestHandler):
             if payload.get("multi"):
                 inputs = tuple(inputs)
             out = srv.submit(inputs,
-                             timeout_ms=payload.get("timeout_ms"))
+                             timeout_ms=payload.get("timeout_ms"),
+                             request_id=rid)
             if isinstance(out, tuple):
                 body = {"outputs": [o.tolist() for o in out]}
             else:
                 body = {"outputs": out.tolist()}
             body["step"] = srv.step
-            self._send(200, body)
+            send(200, body)
         except ServerOverloaded as exc:
-            self._send(429, {"error": str(exc)})
+            send(429, {"error": str(exc)})
         except RequestTimeout as exc:
-            self._send(504, {"error": str(exc)})
+            send(504, {"error": str(exc)})
         except ServerClosed as exc:
-            self._send(503, {"error": str(exc)})
+            send(503, {"error": str(exc)})
         except (KeyError, ValueError, NoBucketError) as exc:
-            self._send(400, {"error": str(exc)})
+            send(400, {"error": str(exc)})
         except Exception as exc:  # noqa: BLE001 - surfaced to the client
-            self._send(500, {"error": str(exc)})
+            send(500, {"error": str(exc)})

@@ -38,6 +38,7 @@ __all__ = [
     "counter", "gauge", "histogram", "get_metric",
     "span", "timed",
     "snapshot", "totals", "value", "dump", "prometheus", "reset",
+    "histogram_quantiles",
     "sample_device_memory", "log_line", "start_logger",
     "DEFAULT_BUCKETS",
 ]
@@ -317,14 +318,34 @@ def reset():
 # timers
 # ---------------------------------------------------------------------------
 
-def _feed_profiler(name, start, dur):
-    """Land the span in the chrome trace when an xplane trace is live."""
+def _feed_profiler(name, start, dur, cat="telemetry", args=None):
+    """Land the span in the chrome trace when an xplane trace is live
+    (``mx.trace`` spans route through here too, with their own cat and
+    trace-id args).
+
+    The running flag is read under ``_events_lock`` — the same lock
+    appends take — so a concurrent ``set_state('stop')`` can't
+    interleave between the check and the append.  The REAL thread id
+    (and name) is recorded at append time so ``profiler.dump`` can put
+    serve-scheduler / checkpoint-writer / trainer spans on separate
+    Perfetto tracks."""
     from . import profiler
 
-    if profiler._state["running"]:
-        with profiler._events_lock:
-            profiler._state["events"].append(
-                {"name": name, "cat": "telemetry", "ts": start, "dur": dur})
+    # unlocked peek first: with no trace live (the steady state) this
+    # must stay a boolean read, not a global lock acquisition on every
+    # span exit across every thread; the flag is re-checked under the
+    # lock so a concurrent set_state('stop') still can't interleave
+    # with the append
+    if not profiler._state["running"]:
+        return
+    with profiler._events_lock:
+        if profiler._state["running"]:
+            t = threading.current_thread()
+            ev = {"name": name, "cat": cat, "ts": start, "dur": dur,
+                  "tid": t.ident, "tname": t.name}
+            if args:
+                ev["args"] = args
+            profiler._state["events"].append(ev)
 
 
 class span:
@@ -343,18 +364,23 @@ class span:
         self._start = None
 
     def __enter__(self):
-        self._start = time.perf_counter()
+        # disabled-at-enter spans stay dead for their whole lifetime:
+        # no clock read here, and __exit__ is a single None check (a
+        # span that straddles an enable() observes nothing — half a
+        # duration would be a lie)
+        self._start = time.perf_counter() if ENABLED else None
         return self
 
     def __exit__(self, *exc):
+        if self._start is None or not ENABLED:
+            return False
         dur = time.perf_counter() - self._start
-        if ENABLED:
-            hist = self._hist
-            if hist is None:
-                hist = histogram(self.name + "_seconds",
-                                 "duration of %s spans" % self.name)
-            hist.observe(dur)
-            _feed_profiler(self.name, self._start, dur)
+        hist = self._hist
+        if hist is None:
+            hist = histogram(self.name + "_seconds",
+                             "duration of %s spans" % self.name)
+        hist.observe(dur)
+        _feed_profiler(self.name, self._start, dur)
         return False
 
 
@@ -404,16 +430,74 @@ def snapshot():
     return out
 
 
-def totals(nonzero=False):
+def _bucket_quantile(cum, count, q):
+    """Estimate the q-quantile from cumulative bucket counts (linear
+    interpolation within the covering bucket, Prometheus
+    histogram_quantile style).  Observations in the +Inf overflow
+    bucket clamp to the last finite bound — the estimate never invents
+    a value beyond what the buckets can resolve."""
+    if count <= 0:
+        return 0.0
+    target = q * count
+    lo, prev_c, last_finite = 0.0, 0, 0.0
+    for ub, c in cum:
+        if ub != float("inf"):
+            last_finite = ub
+        if c >= target:
+            if ub == float("inf"):
+                return last_finite
+            width = c - prev_c
+            if width <= 0:
+                return ub
+            return lo + (target - prev_c) / width * (ub - lo)
+        prev_c = c
+        if ub != float("inf"):
+            lo = ub
+    return last_finite
+
+
+def _merged_read(metric):
+    """(count, sum, merged cumulative buckets) across every label child
+    of a histogram family (all children share the family's bucket
+    edges)."""
+    reads = [c.read() for _, c in metric._samples()]
+    count = sum(r[0] for r in reads)
+    total = sum(r[1] for r in reads)
+    cum = [(ub, sum(r[2][i][1] for r in reads))
+           for i, (ub, _) in enumerate(reads[0][2])] if reads else []
+    return count, total, cum
+
+
+def histogram_quantiles(name, qs=(0.5, 0.95, 0.99)):
+    """Bucket-estimated quantiles of a histogram family, merged over
+    its label children: {q: seconds}.  {} for unknown/empty/non-
+    histogram names — SLO-ish latency without scraping Prometheus."""
+    m = _REGISTRY.get(name)
+    if m is None or m.kind != "histogram":
+        return {}
+    count, _, cum = _merged_read(m)
+    if not count:
+        return {}
+    return {q: _bucket_quantile(cum, count, q) for q in qs}
+
+
+def totals(nonzero=False, quantiles=False):
     """Flat {name: summed value} over all label children; histograms
-    contribute ``<name>_count`` and ``<name>_sum``.  The compact form
-    bench rows and the periodic log line carry."""
+    contribute ``<name>_count`` and ``<name>_sum`` — plus bucket-
+    estimated ``_p50``/``_p95``/``_p99`` when ``quantiles`` is set (the
+    periodic log line asks for them).  The compact form bench rows and
+    the periodic log line carry."""
     out = {}
     for name, m in list(_REGISTRY.items()):
         if m.kind == "histogram":
-            reads = [c.read() for _, c in m._samples()]
-            out[name + "_count"] = sum(r[0] for r in reads)
-            out[name + "_sum"] = round(sum(r[1] for r in reads), 6)
+            count, total, cum = _merged_read(m)
+            out[name + "_count"] = count
+            out[name + "_sum"] = round(total, 6)
+            if quantiles and count:
+                for q, label in ((0.5, "_p50"), (0.95, "_p95"),
+                                 (0.99, "_p99")):
+                    out[name + label] = round(
+                        _bucket_quantile(cum, count, q), 6)
         else:
             out[name] = sum(c.value for _, c in m._samples())
     if nonzero:
@@ -514,8 +598,9 @@ _logger_started = False
 
 
 def log_line():
-    """One compact 'telemetry k=v ...' line over the nonzero totals."""
-    tot = totals(nonzero=True)
+    """One compact 'telemetry k=v ...' line over the nonzero totals
+    (histograms carry their bucket-estimated p50/p95/p99)."""
+    tot = totals(nonzero=True, quantiles=True)
     body = " ".join(
         "%s=%s" % (k, ("%d" % v) if float(v).is_integer() else
                    ("%.6g" % v))
@@ -702,5 +787,15 @@ COMPILE_CACHE_LOAD_SECONDS = histogram(
 COMPILE_CACHE_COMMIT_SECONDS = histogram(
     "compile_cache_commit_seconds",
     "artifact serialize + durable-commit latency")
+# mx.trace (trace/): flight-recorder dumps and watchdog activity —
+# reason is manual / crash / exit / slow_step / deadline_burst / hang /
+# dry_run (export.py), scope names the watch that stalled (watchdog.py)
+TRACE_DUMPS = counter(
+    "trace_dumps_total",
+    "flight-recorder dumps written, by trigger reason", ("reason",))
+TRACE_WATCHDOG_FIRES = counter(
+    "trace_watchdog_fires_total",
+    "hang-watchdog reports (no progress past the scope timeout)",
+    ("scope",))
 
 start_logger()

@@ -1,0 +1,59 @@
+"""mx.trace — structured tracing, flight recorder, and hang watchdog.
+
+The third observability layer (README "Tracing & flight recorder"):
+
+- ``mx.telemetry`` answers "how much / how often" (aggregates);
+- ``mx.profiler`` answers "show me everything" (heavyweight xplane);
+- ``mx.trace`` answers "where did THIS step / THIS request go, and
+  what was the process doing when it died" — always-on, bounded
+  memory, dumpable after the fact.
+
+Surface::
+
+    with mx.trace.span("train_step"):          # nest freely; ids
+        with mx.trace.span("forward"): ...     # propagate via
+                                               # contextvars
+    mx.trace.dump()                            # Perfetto JSON of the
+                                               # flight-recorder ring
+    mx.trace.watchdog.install(timeout=60)      # hang -> stacks + dump
+
+Env knobs: ``MXNET_TRACE_DISABLE``, ``MXNET_TRACE_RING_EVENTS``,
+``MXNET_TRACE_DUMP_DIR``, ``MXNET_TRACE_DUMP_ON_CRASH``,
+``MXNET_TRACE_DUMP_AT_EXIT``, ``MXNET_TRACE_DUMP_MIN_SECONDS``,
+``MXNET_TRACE_SLOW_STEP_FACTOR``, ``MXNET_TRACE_DEADLINE_BURST`` /
+``_WINDOW``, ``MXNET_TRACE_WATCHDOG`` / ``_SECONDS``.
+"""
+from __future__ import annotations
+
+from . import anomaly, core, export, watchdog
+from .core import (FlightRecorder, RECORDER, TraceContext, clear,
+                   current, current_trace_id, enable, disable, events,
+                   instant, new_context, new_request, record_span,
+                   sanitize_request_id, span, use)
+from .export import chrome_trace, dump, dump_async, dump_dir, last_dumps
+
+__all__ = [
+    "span", "instant", "record_span", "use",
+    "current", "current_trace_id", "new_context", "new_request",
+    "sanitize_request_id",
+    "TraceContext", "FlightRecorder", "RECORDER", "events", "clear",
+    "chrome_trace", "dump", "dump_async", "dump_dir", "last_dumps",
+    "enable", "disable", "is_enabled",
+    "anomaly", "watchdog", "core", "export",
+]
+
+
+def is_enabled():
+    """Current state of the trace-recording flag (the flag itself lives
+    in ``trace.core.ENABLED``; read it through here so runtime toggles
+    are always visible)."""
+    return core.ENABLED
+
+
+def __getattr__(name):
+    # trace.ENABLED mirrors core.ENABLED (a mutable module flag —
+    # re-exporting the value at import would freeze it)
+    if name == "ENABLED":
+        return core.ENABLED
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))

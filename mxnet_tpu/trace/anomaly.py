@@ -1,0 +1,141 @@
+"""Dump-on-anomaly triggers for the flight recorder.
+
+Two detectors feed ``export.dump``:
+
+- ``SlowStepDetector`` — a trailing window of step durations; when one
+  step exceeds ``factor`` x the trailing p99 the ring is dumped with
+  ``reason="slow_step"``, so the trace of the outlier step (and what
+  preceded it) survives for inspection.  ``MXNET_TRACE_SLOW_STEP_
+  FACTOR`` tunes the factor (default 3.0; 0 disables).
+- ``DeadlineMissMonitor`` — a sliding window of serve deadline misses;
+  ``MXNET_TRACE_DEADLINE_BURST`` misses (default 8) within
+  ``MXNET_TRACE_DEADLINE_WINDOW`` seconds (default 5) dump with
+  ``reason="deadline_burst"`` — the signature of a stalled backend or a
+  batch policy gone wrong.
+
+Both are rate-limited by ``export.dump`` itself, so a persistently sick
+process produces a bounded trickle of dumps rather than a flood."""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from ..base import get_env
+from . import core, export
+
+__all__ = ["SlowStepDetector", "DeadlineMissMonitor", "observe_step",
+           "deadline_miss", "STEP_DETECTOR", "DEADLINE_MONITOR"]
+
+
+class SlowStepDetector:
+    """Trailing-p99 outlier detector over step durations."""
+
+    # recompute the trailing p99 every N observations: sorting the
+    # window per step would put an O(W log W) on the hot path
+    _REFRESH = 16
+
+    def __init__(self, factor=None, window=256, min_samples=32):
+        if factor is None:
+            factor = get_env("MXNET_TRACE_SLOW_STEP_FACTOR", float, 3.0)
+        self.factor = float(factor)
+        self.min_samples = int(min_samples)
+        self._lock = threading.Lock()
+        self._window = deque(maxlen=int(window))
+        self._p99 = 0.0
+        self._since_refresh = 0
+
+    def trailing_p99(self):
+        with self._lock:
+            if self._since_refresh == 0 and self._p99:
+                return self._p99
+            return self._refresh_locked()
+
+    def _refresh_locked(self):
+        vals = sorted(self._window)
+        if vals:
+            self._p99 = vals[min(len(vals) - 1,
+                                 int(0.99 * len(vals)))]
+        self._since_refresh = 0
+        return self._p99
+
+    def observe(self, dur):
+        """Record one step duration; returns the dump path when this
+        step triggered an anomaly dump, else None."""
+        if self.factor <= 0:
+            return None
+        with self._lock:
+            n = len(self._window)
+            warm = n >= self.min_samples
+            if warm and (self._since_refresh >= self._REFRESH
+                         or not self._p99):
+                self._refresh_locked()
+            p99 = self._p99
+            self._window.append(dur)
+            self._since_refresh += 1
+        if not warm or p99 <= 0 or dur <= self.factor * p99:
+            return None
+        # async: observe() runs on span exit in the training thread —
+        # the dump write must not stretch the very step being flagged
+        return export.dump_async(
+            "slow_step",
+            extra={"step_seconds": round(dur, 6),
+                   "trailing_p99_seconds": round(p99, 6),
+                   "factor": self.factor})
+
+
+class DeadlineMissMonitor:
+    """Sliding-window burst detector over serve deadline misses."""
+
+    def __init__(self, burst=None, window_seconds=None):
+        if burst is None:
+            burst = get_env("MXNET_TRACE_DEADLINE_BURST", int, 8)
+        if window_seconds is None:
+            window_seconds = get_env("MXNET_TRACE_DEADLINE_WINDOW",
+                                     float, 5.0)
+        self.burst = int(burst)
+        self.window = float(window_seconds)
+        self._lock = threading.Lock()
+        self._times = deque()
+
+    def miss(self):
+        """Record one deadline miss; returns the dump path when the
+        burst threshold tripped, else None."""
+        if self.burst <= 0:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            self._times.append(now)
+            while self._times and now - self._times[0] > self.window:
+                self._times.popleft()
+            n = len(self._times)
+            if n < self.burst:
+                return None
+            self._times.clear()  # one dump per burst episode
+        # async is load-bearing here: miss() fires from serve's _fail,
+        # which BatchQueue._expire_locked calls while holding the queue
+        # condition lock — a synchronous multi-MB write there would
+        # freeze submission and the scheduler during the very outage
+        # being diagnosed
+        return export.dump_async(
+            "deadline_burst",
+            extra={"misses": n, "window_seconds": self.window})
+
+
+STEP_DETECTOR = SlowStepDetector()
+DEADLINE_MONITOR = DeadlineMissMonitor()
+
+
+def observe_step(dur):
+    """Feed one train-step duration to the slow-step detector (called
+    by ``trace.span(..., anomaly=True)`` on exit)."""
+    if not core.ENABLED:
+        return None
+    return STEP_DETECTOR.observe(dur)
+
+
+def deadline_miss():
+    """Feed one serve deadline miss to the burst monitor."""
+    if not core.ENABLED:
+        return None
+    return DEADLINE_MONITOR.miss()

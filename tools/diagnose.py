@@ -114,16 +114,70 @@ def framework_info(device_check=True):
 def telemetry_info():
     """Live mx.telemetry snapshot (counters accumulated by this process —
     the matmul smoke and import path already populate transfer/engine
-    metrics), plus a fresh device-memory sample."""
+    metrics), plus a fresh device-memory sample and bucket-estimated
+    latency quantiles per histogram."""
     section("Telemetry")
     import json
 
     from mxnet_tpu import telemetry
 
     telemetry.sample_device_memory()
+    snap = telemetry.snapshot()
     print("enabled      :", telemetry.ENABLED)
-    print(json.dumps(telemetry.snapshot(), indent=2, sort_keys=True))
+    print(json.dumps(snap, indent=2, sort_keys=True))
     print("totals       :", telemetry.totals(nonzero=True))
+    shown = False
+    for name, m in sorted(snap.items()):
+        if m["type"] != "histogram":
+            continue
+        qs = telemetry.histogram_quantiles(name)
+        if not qs:
+            continue
+        if not shown:
+            print("quantiles (bucket-estimated, seconds):")
+            shown = True
+        print("  %-38s p50=%.6g p95=%.6g p99=%.6g"
+              % (name, qs[0.5], qs[0.95], qs[0.99]))
+    if not shown:
+        print("quantiles    : (no histogram observations)")
+
+
+def trace_info():
+    """Dump the mx.trace plane: flag, ring occupancy, watchdog state,
+    dump destinations, and the dumps this process has written."""
+    section("Trace / flight recorder")
+    from mxnet_tpu import trace
+
+    print("enabled      :", trace.is_enabled())
+    ring = trace.RECORDER
+    print("ring         : %d / %d events buffered (%d displaced)"
+          % (len(ring), ring.capacity, ring.dropped))
+    print("dump dir     :", trace.dump_dir())
+    wd = trace.watchdog.get()
+    if wd is None:
+        print("watchdog     : not armed "
+              "(MXNET_TRACE_WATCHDOG=1 or trace.watchdog.install())")
+    else:
+        print("watchdog     : %s  timeout=%.1fs poll=%.1fs fires=%d"
+              % ("alive" if wd.alive else "stopped", wd.timeout,
+                 wd.poll, wd.fires))
+        if wd.last_report:
+            print("last report  : scope=%s stacks=%s trace=%s"
+                  % wd.last_report)
+        active = wd.active()
+        print("active scopes:", ", ".join(sorted(set(active)))
+              if active else "(none)")
+    p99 = trace.anomaly.STEP_DETECTOR.trailing_p99()
+    print("slow-step    : factor=%.1f trailing_p99=%s"
+          % (trace.anomaly.STEP_DETECTOR.factor,
+             ("%.6gs" % p99) if p99 else "(warming up)"))
+    dumps = trace.last_dumps()
+    if dumps:
+        print("dumps written:")
+        for reason, path in dumps:
+            print("  [%s] %s" % (reason, path))
+    else:
+        print("dumps written: none this process")
 
 
 def checkpoints_info(root):
@@ -376,11 +430,15 @@ def main():
                     help="audit the imperative Trainer's multi-tensor "
                          "update engine: group table, programs/step, "
                          "collective bucket fill")
+    ap.add_argument("--trace", action="store_true",
+                    help="dump the mx.trace plane: flight-recorder "
+                         "occupancy, watchdog state, anomaly "
+                         "detectors, dumps written")
     args = ap.parse_args()
     # section flags compose: --compile-cache --serve URL prints both
     # (each skips the environment dump, all honor --telemetry)
     if args.compile_cache or args.serve or args.checkpoints or \
-            args.trainer:
+            args.trainer or args.trace:
         if args.compile_cache:
             compile_cache_info()
         if args.trainer:
@@ -389,6 +447,8 @@ def main():
             serve_info(args.serve)
         if args.checkpoints:
             checkpoints_info(args.checkpoints)
+        if args.trace:
+            trace_info()
         if args.telemetry:
             telemetry_info()
         print()

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""mx.trace smoke: the observability acceptance run on CPU.
+
+1. A traced train step — forward / backward / trainer_step phases nest
+   under one trace id, with allreduce + fused-apply children.
+2. A traced serve request through the HTTP front-end — X-Request-Id is
+   accepted, echoed, and becomes the trace id; enqueue -> queue-wait ->
+   dispatch -> pad -> execute -> respond spans land on distinct thread
+   tracks.
+3. The flight recorder dumps as parseable Perfetto/Chrome-trace JSON
+   (microsecond units, real pid/tid, thread_name metadata).
+4. A watchdog dry-run writes BOTH hang artifacts (all-thread stacks +
+   flight record) — the forensic pair a real hang produces.
+
+Run: JAX_PLATFORMS=cpu python tools/trace_smoke.py   (or make trace-smoke)
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+WORK = tempfile.mkdtemp(prefix="mx-trace-smoke-")
+os.environ.setdefault("MXNET_TRACE_DUMP_DIR", WORK)
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon, nd, serve, trace  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+def log(msg):
+    print("[trace-smoke] %s" % msg, flush=True)
+
+
+def check(ok, msg):
+    if not ok:
+        log("FAIL: %s" % msg)
+        sys.exit(1)
+    log("ok: %s" % msg)
+
+
+def spans_of(trace_id):
+    return [e for e in trace.events() if e.get("trace") == trace_id]
+
+
+def main():
+    # -- 1. traced train step ----------------------------------------------
+    net = nn.HybridSequential()
+    for _ in range(3):
+        net.add(nn.Dense(16, in_units=16))
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    x = nd.array(np.random.RandomState(0).rand(4, 16).astype(np.float32))
+
+    trace.clear()
+    step_trace = None
+    for _ in range(3):
+        with trace.span("train_step", hist=False):
+            step_trace = trace.current().trace_id
+            with trace.span("forward", hist=False):
+                with autograd.record():
+                    loss = (net(x) ** 2).mean()
+            with trace.span("backward", hist=False):
+                loss.backward()
+            trainer.step(4)
+    names = set(e["name"] for e in spans_of(step_trace))
+    check({"train_step", "forward", "backward", "trainer_step",
+           "trainer_update"} <= names,
+          "train step traced: %d phase spans under one trace id (%s)"
+          % (len(names), ", ".join(sorted(names))))
+    check(len(names) >= 4, "train step has >= 4 nested phase spans")
+
+    # -- 2. traced serve request over HTTP ---------------------------------
+    blk = nn.Dense(4, flatten=False, in_units=16)
+    blk.initialize()
+    blk(mx.nd.zeros((1, 2, 16)))
+    root = os.path.join(WORK, "ckpt")
+    blk.save_checkpoint(root, step=1)
+
+    cfg = serve.ServeConfig(max_batch_size=4, batch_sizes=(4,),
+                            sample_shapes=[(8, 16)], max_wait_us=1000)
+    rid = "smoke-req-1"
+    with serve.Server(lambda: nn.Dense(4, flatten=False, in_units=16),
+                      root=root, config=cfg) as srv:
+        host, port = srv.start_http()
+        body = json.dumps({"inputs": np.ones((5, 16)).tolist()}).encode()
+        req = urllib.request.Request(
+            "http://%s:%d/predict" % (host, port), data=body,
+            headers={"X-Request-Id": rid})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            echoed = resp.headers.get("X-Request-Id")
+            out = json.load(resp)
+        check(echoed == rid, "X-Request-Id echoed on /predict")
+        check(np.asarray(out["outputs"]).shape == (5, 4),
+              "served output unpadded to the request extent")
+    req_spans = spans_of(rid)
+    req_names = set(e["name"] for e in req_spans)
+    check({"serve_enqueue", "serve_queue_wait", "serve_dispatch",
+           "serve_execute", "serve_request"} <= req_names,
+          "request traced end-to-end (%s)" % ", ".join(sorted(req_names)))
+    check(len(set(e["tid"] for e in req_spans)) >= 2,
+          "request spans on distinct thread tracks")
+
+    # -- 3. Perfetto dump round-trip ---------------------------------------
+    path = trace.dump(os.path.join(WORK, "smoke.json"))
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    pid = os.getpid()
+    check(all(e["pid"] == pid for e in evs if e.get("ph") != "M"
+              or e["name"] == "process_name"),
+          "dump carries the real pid")
+    named = [e for e in evs if e["name"] == "thread_name"]
+    check(any(e["args"]["name"] == "mx-serve-scheduler" for e in named),
+          "scheduler thread named in dump metadata")
+    ts = [e for e in evs if e["name"] == "serve_request"]
+    check(ts and 0 < ts[0]["dur"] < 60e6,
+          "serve_request dur is microseconds (%.0fus)" % ts[0]["dur"])
+    parents = {e["args"].get("span"): e for e in evs if e.get("args")}
+    disp = [e for e in evs if e["name"] == "serve_dispatch"][0]
+    check(parents.get(disp["args"]["parent"])["name"] == "serve_request",
+          "dispatch span nests under the request root in the dump")
+
+    # -- 4. watchdog dry-run ------------------------------------------------
+    wd = trace.watchdog.install(timeout=60)
+    try:
+        stacks_path, trace_path = wd.dry_run()
+    finally:
+        trace.watchdog.uninstall()
+    check(stacks_path and os.path.exists(stacks_path),
+          "watchdog wrote all-thread stacks: %s" % stacks_path)
+    check("MainThread" in open(stacks_path).read(),
+          "stack report names threads")
+    check(trace_path and os.path.exists(trace_path),
+          "watchdog wrote the flight record: %s" % trace_path)
+    with open(trace_path) as f:
+        head = json.load(f)["traceEvents"][0]
+    check(head["args"]["reason"] == "dry_run",
+          "drill dump flagged reason=dry_run (real hangs keep their "
+          "own dump budget)")
+
+    log("PASS (artifacts in %s)" % WORK)
+
+
+if __name__ == "__main__":
+    main()
