@@ -10,7 +10,8 @@ SRCS := $(wildcard src/native/*.cc)
 SO := build/libmxtpu_native.so
 
 .PHONY: native test cpptest telemetry-smoke checkpoint-smoke serve-smoke \
-	compile-cache-smoke trainer-smoke trace-smoke clean
+	compile-cache-smoke trainer-smoke trace-smoke monitor-smoke \
+	smoke-all clean
 
 native: $(SO)
 
@@ -83,6 +84,22 @@ trace-smoke:
 	JAX_PLATFORMS=cpu python tools/trace_smoke.py
 	JAX_PLATFORMS=cpu python -m pytest \
 	  tests/python/unittest/test_trace.py -q -m 'not slow'
+
+# mx.monitor smoke: 5-step CPU train with an Inf gradient injected on
+# step 3 under MXNET_MONITOR_SENTINEL=skip_step — the step is skipped
+# bit-identically, exactly one divergence flight-record dump names the
+# offending group, the JSONL health stream parses, and stat programs
+# build once per group (zero per-step retraces); then the subsystem's
+# pytest suite
+monitor-smoke:
+	JAX_PLATFORMS=cpu python tools/monitor_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/python/unittest/test_monitor.py -q -m 'not slow'
+
+# every subsystem smoke in sequence — the one-command pre-flight before
+# a tunnel window (each target is independent; failures stop the chain)
+smoke-all: telemetry-smoke checkpoint-smoke serve-smoke \
+	compile-cache-smoke trainer-smoke trace-smoke monitor-smoke
 
 # suite summary artifact (TESTS_r{N}.json) — round-2 advisor contract
 test-report:

@@ -152,8 +152,28 @@ def _write_probe_artifact(last_error):
     return path
 
 
-def _attach_telemetry(row, before):
-    """Attach the per-row delta of telemetry totals to a bench row."""
+def _monitor_summary(reset_peak=False):
+    """mx.monitor run summary, or {} when the monitor plane is off /
+    unimportable (same fail-soft contract as _telemetry_totals — a
+    dead backend or MXNET_MONITOR unset must cost the row nothing)."""
+    import sys
+
+    if "mxnet_tpu" not in sys.modules:
+        return {}
+    try:
+        from mxnet_tpu import monitor
+
+        if not monitor.is_enabled():
+            return {}
+        monitor.flush(timeout=10.0)
+        return monitor.summary(reset_peak=reset_peak)
+    except Exception:  # noqa: BLE001 - diagnostics are best-effort
+        return {}
+
+
+def _attach_telemetry(row, before, mon_before=None):
+    """Attach the per-row delta of telemetry totals (and, when
+    MXNET_MONITOR=1, the numeric-health columns) to a bench row."""
     after = _telemetry_totals()
     # union of key sets: a gauge dropping to exactly zero disappears from
     # the nonzero `after` view but must still show as a negative delta
@@ -162,6 +182,21 @@ def _attach_telemetry(row, before):
              if after.get(k, 0) != before.get(k, 0)}
     if isinstance(row, dict) and delta:
         row["telemetry"] = delta
+    # numeric health next to the throughput/mfu numbers: a banked
+    # tunnel window must prove the run stayed FINITE, not just fast.
+    # reset_peak in the row's "before" snapshot makes max per-row.
+    mon = _monitor_summary()
+    if isinstance(row, dict) and mon:
+        mb = mon_before or {}
+        row["grad_global_norm"] = {
+            "last": round(mon.get("grad_global_norm_last", 0.0), 6),
+            "max": round(mon.get("grad_global_norm_max", 0.0), 6)}
+        row["nonfinite_steps"] = int(
+            mon.get("nonfinite_steps", 0) - mb.get("nonfinite_steps", 0))
+        skipped = int(mon.get("skipped_steps", 0)
+                      - mb.get("skipped_steps", 0))
+        if skipped:
+            row["skipped_steps"] = skipped
     return row
 
 
@@ -512,7 +547,9 @@ def main():
     for attempt in range(3):
         try:
             before = _telemetry_totals()
-            bf16 = _attach_telemetry(_bench_resnet("bfloat16", 128), before)
+            mon_before = _monitor_summary(reset_peak=True)
+            bf16 = _attach_telemetry(_bench_resnet("bfloat16", 128),
+                                     before, mon_before)
             break
         except Exception as exc:  # noqa: BLE001 - headline must stay parseable
             last_exc = exc
@@ -592,7 +629,8 @@ def main():
             continue
         try:
             before = _telemetry_totals()
-            extra[key] = _attach_telemetry(fn(), before)
+            mon_before = _monitor_summary(reset_peak=True)
+            extra[key] = _attach_telemetry(fn(), before, mon_before)
             _log("%s done" % phase)
         except Exception as exc:  # pragma: no cover - keep headline alive
             _log("%s FAILED: %r" % (phase, exc))

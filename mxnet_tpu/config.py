@@ -100,6 +100,48 @@ ENV_VARS = {
     "MXNET_TRACE_WATCHDOG_SECONDS": (
         float, 120.0,
         "Default no-progress timeout per watched scope."),
+    "MXNET_MONITOR": (
+        bool, False,
+        "Arm mx.monitor training-health numerics: one fused stat "
+        "reduction program per multi-tensor parameter group per step "
+        "(grad/weight norms, max|x|, nonfinite counts) feeding "
+        "telemetry gauges, the divergence detector, and the nonfinite "
+        "sentinel (monitor/)."),
+    "MXNET_MONITOR_SENTINEL": (
+        str, "warn",
+        "Nonfinite-gradient sentinel policy: warn (async, log only), "
+        "skip_step (drop the whole step before any state mutates — "
+        "bit-identical to never calling step()), raise (MXNetError at "
+        "the first bad step), off.  Gates the imperative update path "
+        "only; inert (with a warning) under update_on_kvstore=True, "
+        "where the kvstore applies updates itself."),
+    "MXNET_MONITOR_STREAM": (
+        str, None,
+        "Append one JSON line of per-group health stats per observed "
+        "step to this path (the numerics post-mortem artifact for "
+        "tunnel captures)."),
+    "MXNET_MONITOR_INTERVAL": (
+        int, 1,
+        "Observe every Nth trainer step (1 = every step; the sentinel "
+        "only gates observed steps)."),
+    "MXNET_MONITOR_RING": (
+        int, 256,
+        "Bounded host-fetch ring capacity: stat entries awaiting the "
+        "async publisher; oldest are dropped (monitor_dropped_total) "
+        "under pressure so Trainer.step never blocks."),
+    "MXNET_MONITOR_SPIKE_FACTOR": (
+        float, 10.0,
+        "Divergence detector: dump when the global grad norm exceeds "
+        "this factor x the trailing-window max (0 disables)."),
+    "MXNET_MONITOR_SPIKE_WINDOW": (
+        int, 64,
+        "Trailing window length (observed steps) for the grad-norm "
+        "spike detector."),
+    "MXNET_MONITOR_PLATEAU_WINDOW": (
+        int, 0,
+        "Loss observations without a new best before a loss_plateau "
+        "divergence dump (0 disables; fed via monitor.observe_loss / "
+        "the estimator TrainingHealthHandler)."),
     "MXNET_TELEMETRY_DISABLE": (
         bool, False,
         "Disable the runtime telemetry registry (mx.telemetry); hooks "

@@ -7,8 +7,11 @@ CheckpointHandler/EarlyStoppingHandler (event_handler.py:160,226,336,614).
 from __future__ import annotations
 
 import logging
+import math
 import os
 import time
+
+import numpy as _np
 
 from ... import autograd
 from ...base import MXNetError
@@ -18,7 +21,8 @@ from ..trainer import Trainer
 __all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
            "BatchBegin", "BatchEnd", "LoggingHandler", "CheckpointHandler",
            "EarlyStoppingHandler", "ValidationHandler", "StoppingHandler",
-           "MetricHandler", "GradientUpdateHandler"]
+           "MetricHandler", "GradientUpdateHandler",
+           "TrainingHealthHandler"]
 
 
 class TrainBegin:
@@ -172,7 +176,19 @@ class EarlyStoppingHandler(TrainBegin, EpochEnd):
 
     def epoch_end(self, estimator, *args, **kwargs):
         name, value = self.monitor.get()
-        if value != value:  # nan
+        value = float(value)
+        if not math.isfinite(value):
+            # A NaN/Inf metric is a DIVERGED run, not a missing sample:
+            # the old silent `return` idled forever while the TPU window
+            # burned.  Nonfinite never improves `best` (patience counts
+            # down like any bad epoch), and an infinity the mode could
+            # never beat (+Inf under max, -Inf under min) stops
+            # immediately — no later epoch can improve past it.
+            unbeatable = (value == float("inf") and self.mode == "max") \
+                or (value == float("-inf") and self.mode != "max")
+            self.wait += 1
+            if unbeatable or self.wait >= self.patience:
+                self.stop_training = True
             return
         improved = (self.best is None or
                     (value > self.best + self.min_delta
@@ -185,6 +201,79 @@ class EarlyStoppingHandler(TrainBegin, EpochEnd):
             self.wait += 1
             if self.wait >= self.patience:
                 self.stop_training = True
+
+
+class TrainingHealthHandler(TrainBegin, BatchEnd, EpochEnd):
+    """mx.monitor bridge for the Estimator loop.
+
+    Feeds every batch loss to the divergence detector
+    (``mx.monitor.observe_loss`` — NaN/plateau dumps through the trace
+    anomaly path), stops training after ``patience`` consecutive
+    nonfinite losses (default 1: the first NaN ends the run instead of
+    burning the rest of the schedule), and logs the monitor's health
+    summary at each epoch end when the stat plane is armed
+    (``MXNET_MONITOR=1``).
+
+    The loss read is one scalar device->host sync per batch — the
+    health handler's price of admission; leave it out of
+    microbenchmark loops.
+    """
+
+    def __init__(self, patience=1, stop_on_nonfinite=True,
+                 priority=-500):
+        self.patience = max(1, int(patience))
+        self.stop_on_nonfinite = stop_on_nonfinite
+        # after GradientUpdateHandler (-2000) / MetricHandler (-1000):
+        # health reads post-update state
+        self.priority = priority
+        self.logger = logging.getLogger("mxnet_tpu.estimator")
+        self.nonfinite_batches = 0
+        self._consecutive = 0
+        self._batches = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.nonfinite_batches = 0
+        self._consecutive = 0
+        self._batches = 0
+        self.stop_training = False
+
+    def batch_end(self, estimator, *args, **kwargs):
+        loss = kwargs.get("loss")
+        if loss is None:
+            return
+        from ... import monitor
+
+        value = loss.asnumpy() if hasattr(loss, "asnumpy") else loss
+        value = float(_np.mean(value))
+        self._batches += 1
+        monitor.observe_loss(value, step=self._batches)
+        if math.isfinite(value):
+            self._consecutive = 0
+            return
+        self.nonfinite_batches += 1
+        self._consecutive += 1
+        if self.stop_on_nonfinite and \
+                self._consecutive >= self.patience:
+            self.logger.error(
+                "TrainingHealthHandler: loss is %r for %d consecutive "
+                "batch(es) — stopping training (divergence dump "
+                "requested through mx.trace)", value, self._consecutive)
+            self.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        from ... import monitor
+
+        if not monitor.is_enabled():
+            return
+        monitor.flush(timeout=5.0)
+        s = monitor.summary()
+        self.logger.info(
+            "training health: steps=%d grad_norm last=%.6g max=%.6g "
+            "nonfinite_steps=%d skipped_steps=%d",
+            s["steps"], s["grad_global_norm_last"],
+            s["grad_global_norm_max"], s["nonfinite_steps"],
+            s["skipped_steps"])
 
 
 class ValidationHandler(BatchEnd, EpochEnd):

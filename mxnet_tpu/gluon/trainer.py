@@ -55,6 +55,7 @@ class Trainer:
         self._step_count = 0
         self._params_to_init = list(self._params)
         self._mt_groups = {}   # multi-tensor fused update programs
+        self._monitor_kv_warned = False
         self._zero = zero
         self._zero_mesh = mesh
         if zero and (mesh is None or "dp" not in getattr(mesh, "shape", {})):
@@ -221,6 +222,23 @@ class Trainer:
     def _update(self, ignore_stale_grad=False):
         from ..optimizer import multi_tensor as _mt
 
+        if self._update_on_kvstore and not self._monitor_kv_warned:
+            from .. import monitor as _monitor
+
+            if _monitor.core.ENABLED:
+                # the kvstore applies updates inside pushpull, before
+                # apply_updates sees any items — stats, sentinel
+                # skip/raise, and divergence detection cannot gate
+                # those steps; say so instead of silently not guarding
+                self._monitor_kv_warned = True
+                import logging
+
+                logging.getLogger("mxnet_tpu.monitor").warning(
+                    "mx.monitor: Trainer(update_on_kvstore=True) "
+                    "applies updates on the kvstore; the nonfinite "
+                    "sentinel and per-group stats are INACTIVE for "
+                    "this trainer — use update_on_kvstore=False to "
+                    "monitor this run")
         items = []
         for i, param in enumerate(self._params):
             if param.grad_req == "null" or param._data is None:
@@ -258,10 +276,15 @@ class Trainer:
             items.append((i, param, grad))
         # one fused, buffer-donated program per (optimizer, dtype, stype,
         # lr/wd-mult, placement) group; automatic per-param eager
-        # fallback for row_sparse grads / non-fusable optimizers
+        # fallback for row_sparse grads / non-fusable optimizers.
+        # apply_updates returns False when the mx.monitor nonfinite
+        # sentinel (policy=skip_step) vetoed the step — nothing was
+        # mutated, so the step counter must not advance either (a
+        # skipped step is a no-op end to end)
         with trace.span("trainer_update", hist=False):
-            _mt.apply_updates(self, items)
-        self._step_count += 1
+            applied = _mt.apply_updates(self, items)
+        if applied is not False:
+            self._step_count += 1
 
     def _eager_update(self, i, param, grad):
         """The classic per-parameter update (multi_tensor fallback)."""

@@ -1,0 +1,410 @@
+"""mx.monitor core — orchestration of the training-health stat plane.
+
+The hook (``observe_update``) sits inside ``optimizer/multi_tensor.
+apply_updates``: it sees the SAME parameter groups the fused update
+engine built (PR 5's partition — no second grouping pass), dispatches
+one stat reduction program per group (``stats.py``) BEFORE any update
+program consumes its donated buffers, and hands the resulting device
+vectors to a bounded ring a background publisher thread drains:
+
+- device->host fetch happens on the publisher, so ``Trainer.step``
+  never blocks on stat readback (the ring drops oldest-first under
+  pressure, counted in ``monitor_dropped_total``);
+- EXCEPT when the sentinel policy is ``skip_step``/``raise``, which by
+  definition must know the nonfinite count before the update launches
+  — those fetch synchronously (``monitor_fetch_seconds`` meters it)
+  and may veto the whole step (``sentinel.py``).
+
+The publisher converts each entry into telemetry gauges/counters, the
+optional per-step JSONL stream (``MXNET_MONITOR_STREAM``), the
+divergence detector feed (``divergence.py``), and the run summary
+(``summary()`` — what bench rows and diagnose read).
+
+Disabled cost on the trainer hot path is one boolean check
+(``core.ENABLED``), same discipline as telemetry/trace.  Enable with
+``MXNET_MONITOR=1`` or ``mx.monitor.enable()``.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import math
+import threading
+import time
+
+import numpy as _np
+
+from .. import telemetry as _tel
+from .. import trace as _trace
+from ..base import MXNetError, get_env
+from . import divergence, sentinel, stats
+
+__all__ = ["ENABLED", "enable", "disable", "is_enabled",
+           "observe_update", "flush", "summary", "reset",
+           "stream_path", "group_values"]
+
+_LOGGER = logging.getLogger("mxnet_tpu.monitor")
+
+ENABLED = get_env("MXNET_MONITOR", bool, False)
+
+_COND = threading.Condition()
+_QUEUE = []          # pending entries, oldest first
+_BUSY = [False]      # publisher mid-publish (flush must wait it out)
+_THREAD = [None]
+_STREAM = [None, None]  # (path, file handle)
+
+_SUM_LOCK = threading.Lock()
+
+
+def _new_summary():
+    return {"steps": 0, "grad_global_norm_last": 0.0,
+            "grad_global_norm_max": 0.0, "nonfinite_steps": 0,
+            "skipped_steps": 0, "dropped": 0}
+
+
+_SUMMARY = _new_summary()
+_LAST_GROUPS = {}  # label -> last host stat dict (diagnose table)
+
+
+def enable():
+    """Turn the monitor stat plane on (module-wide).  Re-reads the
+    divergence-detector env knobs, so enabling at runtime after
+    setting MXNET_MONITOR_SPIKE_*/_PLATEAU_WINDOW behaves like
+    enabling at import."""
+    global ENABLED
+    divergence.DETECTOR.refresh_env()
+    ENABLED = True
+
+
+def disable():
+    """Turn the monitor stat plane off; counters keep their values."""
+    global ENABLED
+    ENABLED = False
+
+
+def is_enabled():
+    return ENABLED
+
+
+# ---------------------------------------------------------------------------
+# the trainer hook
+# ---------------------------------------------------------------------------
+
+def _group_label(trainer, key, members):
+    """Stable, human-greppable group name: optimizer class + the FIRST
+    member's parameter name (+member count).  Ascending param index
+    inside a group is guaranteed by partition(), so the label names
+    the earliest layer of the group."""
+    i0 = members[0][0]
+    names = trainer._param_names
+    name = str(names[i0]) if 0 <= i0 < len(names) else str(i0)
+    label = "%s:%s" % (key[0] if isinstance(key, tuple) and key
+                       else type(trainer._optimizer).__name__, name)
+    if len(members) > 1:
+        label += "+%d" % (len(members) - 1)
+    return label
+
+
+def _dense_eager(eager):
+    # partition() already classified sparse members ("row_sparse" /
+    # "stype" reasons) — reuse its verdict rather than re-inspecting;
+    # sparse members stay unmonitored (the stat program is dense math)
+    return [(i, p, g) for i, p, g, reason in eager
+            if reason not in ("row_sparse", "stype")]
+
+
+def observe_update(trainer, groups, eager):
+    """Monitor one optimizer apply.  Returns ``"skip"`` when the
+    sentinel vetoed the step (policy=skip_step and nonfinite grads
+    found), else ``"ok"``.  May raise ``MXNetError`` under
+    policy=raise.  Stat failures degrade to an unmonitored step —
+    monitoring must never lose a step the update engine could run."""
+    if not ENABLED:
+        return "ok"
+    step = trainer._step_count
+    interval = get_env("MXNET_MONITOR_INTERVAL", int, 1)
+    if interval > 1 and step % interval:
+        return "ok"
+    pol = sentinel.policy()  # validate even when nothing trips
+    entries = []
+    try:
+        for key, members in groups.items():
+            w = [p.data()._data for _, p, _ in members]
+            g = [grad._data for _, _, grad in members]
+            entries.append((_group_label(trainer, key, members),
+                            stats.group_stats(w, g)))
+        dense = _dense_eager(eager)
+        if dense:
+            w = [p.data()._data for _, p, _ in dense]
+            g = [grad._data for _, _, grad in dense]
+            entries.append(("%s:eager"
+                            % type(trainer._optimizer).__name__,
+                            stats.group_stats(w, g)))
+    except Exception:
+        _LOGGER.warning("mx.monitor: stat dispatch failed; step %d "
+                        "runs unmonitored", step, exc_info=True)
+        return "ok"
+    if not entries:
+        return "ok"
+    if pol in sentinel.SYNC_POLICIES:
+        t0 = time.perf_counter()
+        try:
+            host = {label: stats.unpack(_np.asarray(vec))
+                    for label, vec in entries}
+        except Exception:
+            _LOGGER.warning("mx.monitor: synchronous stat fetch failed; "
+                            "sentinel cannot gate step %d", step,
+                            exc_info=True)
+            _enqueue(step, entries, pol, skipped=False, tripped=False)
+            return "ok"
+        if _tel.ENABLED:
+            _tel.MONITOR_FETCH_SECONDS.observe(time.perf_counter() - t0)
+        label, st = sentinel.first_offender(host)
+        if label is not None:
+            if _tel.ENABLED:
+                _tel.MONITOR_SENTINEL_TRIPS.labels(policy=pol).inc()
+                _tel.MONITOR_NONFINITE_STEPS.inc()
+            divergence.DETECTOR.nonfinite(label, st, step=step,
+                                          policy=pol)
+            _trace.instant("monitor_sentinel_trip", cat="monitor",
+                           args={"group": label, "policy": pol,
+                                 "step": step,
+                                 "grad_nonfinite":
+                                     int(st["g_nonfinite"])})
+            skipped = pol == "skip_step"
+            if skipped and _tel.ENABLED:
+                _tel.MONITOR_SKIPPED_STEPS.inc()
+            _enqueue(step, list(host.items()), pol, skipped=skipped,
+                     tripped=True)
+            if skipped:
+                _LOGGER.warning(
+                    "mx.monitor: step %d SKIPPED — nonfinite gradients "
+                    "in group %s (%d elements); parameters and "
+                    "optimizer state untouched", step, label,
+                    int(st["g_nonfinite"]))
+                return "skip"
+            raise MXNetError(
+                "mx.monitor sentinel: nonfinite gradients in group %s "
+                "at step %d (%d elements, policy=raise)"
+                % (label, step, int(st["g_nonfinite"])))
+        _enqueue(step, list(host.items()), pol, skipped=False,
+                 tripped=False)
+        return "ok"
+    _enqueue(step, entries, pol, skipped=False, tripped=False)
+    return "ok"
+
+
+# ---------------------------------------------------------------------------
+# bounded ring + publisher thread
+# ---------------------------------------------------------------------------
+
+_SEQ = [0]  # monotonically-increasing observation counter: a skipped
+# step and its retry share a trainer step id (the skip contract), so
+# stream consumers need seq for an unambiguous x-axis
+
+
+def _enqueue(step, entry_stats, pol, skipped, tripped):
+    cap = max(1, get_env("MXNET_MONITOR_RING", int, 256))
+    with _SUM_LOCK:
+        _SEQ[0] += 1
+        seq = _SEQ[0]
+    entry = {"seq": seq, "step": step, "stats": entry_stats,
+             "policy": pol, "skipped": skipped, "tripped": tripped,
+             "time": time.time()}
+    with _COND:
+        if len(_QUEUE) >= cap:
+            # prefer displacing an entry that carries no trip evidence;
+            # fold the victim's step-level flags into the summary so
+            # bench/summary stay consistent with the telemetry counters
+            # incremented at observe time (per-group gauges/warn logs
+            # for the victim are lost — that's the bounded-ring deal)
+            victim_idx = next((j for j, e in enumerate(_QUEUE)
+                               if not e["tripped"]), 0)
+            victim = _QUEUE.pop(victim_idx)
+            with _SUM_LOCK:
+                _SUMMARY["dropped"] += 1
+                _SUMMARY["steps"] += 1
+                if victim["tripped"]:
+                    _SUMMARY["nonfinite_steps"] += 1
+                if victim["skipped"]:
+                    _SUMMARY["skipped_steps"] += 1
+            if _tel.ENABLED:
+                _tel.MONITOR_DROPS.inc()
+        _QUEUE.append(entry)
+        t = _THREAD[0]
+        if t is None or not t.is_alive():
+            t = threading.Thread(target=_publisher_loop, daemon=True,
+                                 name="mx-monitor-publish")
+            _THREAD[0] = t
+            t.start()
+        _COND.notify_all()
+
+
+def _publisher_loop():
+    while True:
+        with _COND:
+            while not _QUEUE:
+                _COND.notify_all()  # wake any flush() waiter
+                _COND.wait()
+            entry = _QUEUE.pop(0)
+            _BUSY[0] = True
+        try:
+            _publish(entry)
+        except Exception:  # noqa: BLE001 - the publisher must survive
+            _LOGGER.exception("mx.monitor: publish failed")
+        finally:
+            with _COND:
+                _BUSY[0] = False
+                _COND.notify_all()
+
+
+def _publish(entry):
+    host = {}
+    for label, vec in entry["stats"]:
+        host[label] = vec if isinstance(vec, dict) \
+            else stats.unpack(_np.asarray(vec))
+    step = entry["step"]
+    global_sq = sum(st["g_sq_sum"] for st in host.values())
+    gnorm = math.sqrt(max(global_sq, 0.0))
+    nonfinite_g = int(sum(st["g_nonfinite"] for st in host.values()))
+    if _tel.ENABLED:
+        for label, st in host.items():
+            _tel.MONITOR_GRAD_NORM.labels(group=label).set(st["g_norm"])
+            _tel.MONITOR_WEIGHT_NORM.labels(group=label).set(
+                st["w_norm"])
+            _tel.MONITOR_GRAD_MAX.labels(group=label).set(
+                st["g_max_abs"])
+            _tel.MONITOR_WEIGHT_MAX.labels(group=label).set(
+                st["w_max_abs"])
+            if st["g_nonfinite"]:
+                _tel.MONITOR_NONFINITE.labels(
+                    kind="grad", group=label).inc(st["g_nonfinite"])
+            if st["w_nonfinite"]:
+                _tel.MONITOR_NONFINITE.labels(
+                    kind="weight", group=label).inc(st["w_nonfinite"])
+        _tel.MONITOR_GRAD_GLOBAL_NORM.set(gnorm)
+        _tel.MONITOR_GRAD_GLOBAL_NORM_HIST.observe(gnorm)
+    if nonfinite_g and not entry["tripped"]:
+        # async policies (warn/off) account their trips here, a step
+        # or two after the fact — the price of never blocking step()
+        if _tel.ENABLED:
+            _tel.MONITOR_NONFINITE_STEPS.inc()
+        label, st = sentinel.first_offender(host)
+        if entry["policy"] == "warn":
+            if _tel.ENABLED:
+                _tel.MONITOR_SENTINEL_TRIPS.labels(policy="warn").inc()
+            sentinel.warn_trip(label, st, step)
+        divergence.DETECTOR.nonfinite(label, st, step=step,
+                                      policy=entry["policy"])
+    if not nonfinite_g:
+        # a nonfinite step must not poison the spike window (its
+        # cleaned norm under-reports), and its dump already fired
+        divergence.DETECTOR.observe_grad_norm(gnorm, step=step)
+    with _SUM_LOCK:
+        _SUMMARY["steps"] += 1
+        _SUMMARY["grad_global_norm_last"] = gnorm
+        _SUMMARY["grad_global_norm_max"] = max(
+            _SUMMARY["grad_global_norm_max"], gnorm)
+        if nonfinite_g:
+            _SUMMARY["nonfinite_steps"] += 1
+        if entry["skipped"]:
+            _SUMMARY["skipped_steps"] += 1
+        _LAST_GROUPS.clear()
+        _LAST_GROUPS.update(host)
+    _stream_write(entry, host, gnorm)
+
+
+# ---------------------------------------------------------------------------
+# JSONL stream
+# ---------------------------------------------------------------------------
+
+def stream_path():
+    """The per-step JSONL stream destination (``MXNET_MONITOR_STREAM``;
+    None = off)."""
+    return get_env("MXNET_MONITOR_STREAM", str, None)
+
+
+def _stream_write(entry, host, gnorm):
+    path = stream_path()
+    if not path:
+        return
+    try:
+        if _STREAM[0] != path:
+            if _STREAM[1] is not None:
+                _STREAM[1].close()
+            _STREAM[0], _STREAM[1] = path, open(path, "a")
+        line = {"seq": entry["seq"], "step": entry["step"],
+                "time": round(entry["time"], 3),
+                "skipped": entry["skipped"],
+                "policy": entry["policy"],
+                "grad_global_norm": round(gnorm, 8),
+                "groups": {
+                    label: {"grad_norm": round(st["g_norm"], 8),
+                            "grad_max_abs": round(st["g_max_abs"], 8),
+                            "weight_norm": round(st["w_norm"], 8),
+                            "weight_max_abs": round(st["w_max_abs"], 8),
+                            "nonfinite_grad": int(st["g_nonfinite"]),
+                            "nonfinite_weight": int(st["w_nonfinite"])}
+                    for label, st in host.items()}}
+        _STREAM[1].write(json.dumps(line) + "\n")
+        _STREAM[1].flush()
+    except OSError:
+        _LOGGER.warning("mx.monitor: stream write to %s failed", path,
+                        exc_info=True)
+
+
+# ---------------------------------------------------------------------------
+# introspection / lifecycle
+# ---------------------------------------------------------------------------
+
+def flush(timeout=None):
+    """Block until the publisher has drained every queued entry (tests,
+    bench rows, smoke tools — anything that reads gauges right after a
+    step).  Returns True when drained, False on timeout."""
+    with _COND:
+        _COND.notify_all()
+        return _COND.wait_for(lambda: not _QUEUE and not _BUSY[0],
+                              timeout)
+
+
+def summary(reset_peak=False):
+    """Run-level health summary: observed steps, last/max global grad
+    norm, nonfinite/skipped step counts, ring drops.  With
+    ``reset_peak`` the max restarts from ZERO — bench rows use it so
+    each row's max covers only that row's own observations (a max of 0
+    on a later read means nothing was observed since the reset, not a
+    carried-over peak from a different model)."""
+    with _SUM_LOCK:
+        out = dict(_SUMMARY)
+        if reset_peak:
+            _SUMMARY["grad_global_norm_max"] = 0.0
+    return out
+
+
+def group_values():
+    """Last published per-group stat dicts {label: stats} (the
+    diagnose --monitor table)."""
+    with _SUM_LOCK:
+        return {k: dict(v) for k, v in _LAST_GROUPS.items()}
+
+
+def reset(clear_programs=False):
+    """Zero the summary, queue, and detector state (tests / between
+    bench rows).  Compiled stat programs survive unless
+    ``clear_programs`` — dropping them would force rebuilds."""
+    global _SUMMARY
+    with _COND:
+        del _QUEUE[:]
+    with _SUM_LOCK:
+        _SUMMARY = _new_summary()
+        _LAST_GROUPS.clear()
+        _SEQ[0] = 0
+    divergence.DETECTOR.reset()
+    if clear_programs:
+        stats.clear()
+    if _STREAM[1] is not None:
+        try:
+            _STREAM[1].close()
+        except OSError:
+            pass
+        _STREAM[0] = _STREAM[1] = None

@@ -1,0 +1,70 @@
+"""Nonfinite sentinel: the policy layer that gates a trainer step on
+the health of its gradients.
+
+Policies (``MXNET_MONITOR_SENTINEL``):
+
+- ``off``        — never fetch synchronously; stats stream async only.
+- ``warn``       — default.  Stats stay async; the publisher thread
+  logs a warning (and counts the trip) when a drained step shows
+  nonfinite gradients.  Zero added sync points on the step path.
+- ``skip_step``  — fetch the stat vectors synchronously BEFORE any
+  update program launches; a step with >=1 nonfinite gradient element
+  is skipped whole — no parameter touched, no optimizer-state slot
+  written, no ``_index_update_count``/``num_update`` bump (the skip
+  happens before PR 5's count bookkeeping, so Adam bias correction
+  never advances; a skipped step is bit-identical to never calling
+  ``step()``).  The standard bf16/loss-scaling survival move.
+- ``raise``      — same synchronous check, but raise ``MXNetError``
+  instead of skipping (CI / debugging: fail the run at the FIRST bad
+  step, with the offending group named, instead of 40k steps later).
+
+``skip_step``/``raise`` cost one device->host sync per observed step
+(a ~24-byte fetch per group, but it waits for the grads to be
+computed); ``warn``/``off`` cost nothing on the step path.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError, get_env
+
+__all__ = ["POLICIES", "SYNC_POLICIES", "policy", "first_offender"]
+
+_LOGGER = logging.getLogger("mxnet_tpu.monitor")
+
+POLICIES = ("off", "warn", "skip_step", "raise")
+# policies that need the nonfinite count ON THE TRAINING THREAD before
+# the update programs may launch
+SYNC_POLICIES = ("skip_step", "raise")
+
+
+def policy():
+    """The sentinel policy in force (validated; a typo'd value must
+    fail loud — a silently-disabled guard is the worst outcome)."""
+    p = get_env("MXNET_MONITOR_SENTINEL", str, "warn")
+    if p not in POLICIES:
+        raise MXNetError(
+            "MXNET_MONITOR_SENTINEL=%r is not a sentinel policy "
+            "(choose from %s)" % (p, "|".join(POLICIES)))
+    return p
+
+
+def first_offender(host_stats):
+    """First group (insertion order == ascending param index) whose
+    gradients contain nonfinite elements; ``(label, stats)`` or
+    ``(None, None)``.  Insertion order matters: with several sick
+    groups the EARLIEST parameters name the layer that diverged
+    first."""
+    for label, st in host_stats.items():
+        if st["g_nonfinite"] > 0:
+            return label, st
+    return None, None
+
+
+def warn_trip(label, st, step):
+    """The async (policy=warn) trip report, called by the publisher."""
+    _LOGGER.warning(
+        "mx.monitor: nonfinite gradients at step %s in group %s "
+        "(%d nonfinite elements, grad_norm=%g) — policy=warn, update "
+        "was applied; set MXNET_MONITOR_SENTINEL=skip_step to drop "
+        "such steps", step, label, int(st["g_nonfinite"]), st["g_norm"])

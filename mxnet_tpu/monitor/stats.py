@@ -1,0 +1,106 @@
+"""Fused on-device stat reduction programs for mx.monitor.
+
+One jitted program per parameter group computes every health number the
+monitor needs — weight/grad squared L2 norms, max|x|, and nonfinite
+counts — and returns them as ONE tiny f32 vector, so the host fetch is
+a 24-byte transfer, not a per-parameter readback (the Relay
+whole-program argument, arXiv 1810.00952: measurement belongs INSIDE
+the step program, not bolted on as eager op-by-op reads).
+
+Program discipline mirrors ``optimizer/multi_tensor.py``: the jit
+wrapper is cached by the exact (shape, dtype) signature of the group's
+weight+grad lists, so monitor-on adds AT MOST one extra compiled
+program per group and zero per-step retraces (asserted in tests via
+``monitor_stat_builds_total``).  Nothing here donates buffers — the
+stat program is dispatched BEFORE the fused update program consumes
+its donated inputs, and its outputs are fresh buffers the async
+publisher can fetch long after the update ran.
+
+All accumulation is float32: the nonfinite count is exact up to 2^24
+elements per program and saturates (not wraps) beyond — the sentinel
+only needs ``count > 0``, and 16M nonfinite elements is diverged by
+any reading.
+"""
+from __future__ import annotations
+
+from .. import telemetry as _tel
+
+__all__ = ["group_stats", "unpack", "programs", "clear", "STAT_FIELDS"]
+
+# layout of the stat vector every program returns
+STAT_FIELDS = ("w_sq_sum", "w_max_abs", "w_nonfinite",
+               "g_sq_sum", "g_max_abs", "g_nonfinite")
+
+# (weights signature, grads signature) -> jitted stat program.  One
+# entry per live group signature; process-lifetime bounded by the
+# number of distinct group shapes (the same bound the multi-tensor
+# update cache has).
+_PROGRAMS = {}
+
+
+def _sig(arrays):
+    return tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+
+
+def _stat_fn(weights, grads):
+    import jax.numpy as jnp
+
+    def reduce3(arrays):
+        sq = jnp.float32(0.0)
+        mx = jnp.float32(0.0)
+        nf = jnp.float32(0.0)
+        for a in arrays:
+            af = a.astype(jnp.float32)
+            finite = jnp.isfinite(af)
+            clean = jnp.where(finite, af, jnp.float32(0.0))
+            sq = sq + jnp.sum(clean * clean)
+            mx = jnp.maximum(mx, jnp.max(jnp.abs(clean)))
+            nf = nf + jnp.sum((~finite).astype(jnp.float32))
+        return sq, mx, nf
+
+    w_sq, w_mx, w_nf = reduce3(weights)
+    g_sq, g_mx, g_nf = reduce3(grads)
+    return jnp.stack([w_sq, w_mx, w_nf, g_sq, g_mx, g_nf])
+
+
+def group_stats(w_arrs, g_arrs):
+    """Dispatch the group's stat program over raw jax arrays; returns
+    the (device, async) f32 stat vector ordered as ``STAT_FIELDS``.
+    First call per signature traces+compiles (counted in
+    ``monitor_stat_builds_total``); every later step is a cache hit."""
+    import jax
+
+    key = (_sig(w_arrs), _sig(g_arrs))
+    fn = _PROGRAMS.get(key)
+    if fn is None:
+        fn = jax.jit(_stat_fn)
+        _PROGRAMS[key] = fn
+        if _tel.ENABLED:
+            _tel.MONITOR_STAT_BUILDS.inc()
+    if _tel.ENABLED:
+        _tel.MONITOR_STAT_PROGRAMS.inc()
+    return fn(list(w_arrs), list(g_arrs))
+
+
+def unpack(vec):
+    """Host-side stat vector -> named float dict (norms sqrt'd here:
+    the device program ships squared sums so the global norm can be
+    aggregated across groups without re-reading the device)."""
+    import math
+
+    vals = [float(v) for v in vec]
+    out = dict(zip(STAT_FIELDS, vals))
+    out["w_norm"] = math.sqrt(max(out["w_sq_sum"], 0.0))
+    out["g_norm"] = math.sqrt(max(out["g_sq_sum"], 0.0))
+    return out
+
+
+def programs():
+    """Number of live compiled stat programs (== distinct group
+    signatures seen)."""
+    return len(_PROGRAMS)
+
+
+def clear():
+    """Drop the program cache (tests; a shape churn would rebuild)."""
+    _PROGRAMS.clear()

@@ -599,12 +599,27 @@ def apply_updates(trainer, items):
     """Apply one optimizer step over ``items = [(index, param, grad)]``:
     fused multi-tensor programs for every eligible group, the classic
     per-parameter eager path for the rest.  Called by
-    ``gluon.Trainer._update``."""
+    ``gluon.Trainer._update``.  Returns False when the mx.monitor
+    nonfinite sentinel skipped the step whole (no parameter, state, or
+    update-count mutation happened), else True."""
     tel_on = _tel.ENABLED
     t0 = _time.perf_counter() if tel_on else 0.0
     groups, eager = partition(trainer, items)
     cache = trainer._mt_groups
     hsig = _hparams_sig(trainer._optimizer)
+    from .. import monitor as mon
+
+    if mon.core.ENABLED:
+        # one extra jitted reduction program per group reads the SAME
+        # weight/grad buffers the update programs are about to donate
+        # (dispatch order keeps that safe); under skip_step the whole
+        # step is vetoed HERE — before any count bump or launch, so a
+        # skipped step is bit-identical to never calling step()
+        if mon.core.observe_update(trainer, groups, eager) == "skip":
+            if tel_on:
+                _tel.TRAINER_UPDATE_SECONDS.observe(
+                    _time.perf_counter() - t0)
+            return False
     for key, members in groups.items():
         try:
             with _trace.span("fused_apply", hist=False,
@@ -638,6 +653,7 @@ def apply_updates(trainer, items):
     if tel_on:
         _tel.TRAINER_FUSED_GROUPS.set(len(groups))
         _tel.TRAINER_UPDATE_SECONDS.observe(_time.perf_counter() - t0)
+    return True
 
 
 def group_table(trainer):

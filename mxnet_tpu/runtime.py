@@ -25,6 +25,17 @@ def _compile_cache_enabled():
         return False
 
 
+def _monitor_enabled():
+    """mx.monitor training-health numerics: built in, but OFF unless
+    armed (MXNET_MONITOR=1 or mxnet_tpu.monitor.enable())."""
+    try:
+        from . import monitor as _monitor
+
+        return _monitor.is_enabled()
+    except Exception:
+        return False
+
+
 class _DynamicFeature(Feature):
     """Feature whose enabled state is re-read on every access —
     COMPILE_CACHE toggles at runtime (compile.enable()/disable()), so
@@ -74,6 +85,7 @@ def _detect():
     out = {k: Feature(k, v) for k, v in feats.items()}
     out["COMPILE_CACHE"] = _DynamicFeature("COMPILE_CACHE",
                                            _compile_cache_enabled)
+    out["MONITOR"] = _DynamicFeature("MONITOR", _monitor_enabled)
     return out
 
 

@@ -233,6 +233,34 @@ class ModelRunner:
         avals = [((b,) + tuple(s), self._dtype) for s in sig]
         return self._block.find_cached_entry(avals, training=False)
 
+    # -- output guard -------------------------------------------------------
+    def _guard_outputs(self, outs_np, B, sig):
+        """mx.monitor's serve-side guard: count nonfinite elements in
+        the per-request (unpadded) outputs — already on host, the
+        asnumpy sync paid for the scan — so a model serving NaN logits
+        is visible at /statz (``serve_nonfinite_*`` totals) instead of
+        silently poisoning clients.  Armed with the rest of the
+        monitor plane (``MXNET_MONITOR=1``); detection only — requests
+        still get their outputs (the client contract is the caller's
+        call)."""
+        from .. import monitor as _monitor
+
+        if not _monitor.core.ENABLED:
+            return
+        bad = 0
+        for o in outs_np:
+            if getattr(o.dtype, "kind", "") == "f":
+                bad += int(o.size) - int(_np.isfinite(o).sum())
+        if not bad:
+            return
+        if telemetry.ENABLED:
+            telemetry.SERVE_NONFINITE_OUTPUTS.inc(bad)
+            telemetry.SERVE_NONFINITE_BATCHES.inc()
+        trace.instant("serve_nonfinite_outputs", cat="serve",
+                      args={"elements": bad,
+                            "bucket": _bucket_label(B, sig)
+                            if sig else str(B)})
+
     # -- bucketing ----------------------------------------------------------
     def bucket_for(self, sample_shapes):
         """Map a request's per-input sample shapes to its bucket class.
@@ -347,4 +375,10 @@ class ModelRunner:
                     per_req.append(row)
                 results.append(per_req[0] if len(per_req) == 1
                                else tuple(per_req))
+        # guard AFTER unpad: only values actually returned to clients
+        # count — padding rows/regions may legitimately go nonfinite
+        # (log/division on zero-fill) without the model being sick
+        self._guard_outputs(
+            [a for r in results
+             for a in (r if isinstance(r, tuple) else (r,))], B, sig)
         return results

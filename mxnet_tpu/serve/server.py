@@ -16,7 +16,8 @@ Thread-safe ``submit()`` / ``submit_async()`` (futures) over one
   ``/predict``; GET ``/healthz`` (process up), ``/readyz`` (model
   loaded + buckets warmed -> 200, else 503), ``/metrics`` (Prometheus
   text), ``/statz`` (JSON: scheduler config, bucket table, queue
-  depth, serve_* totals — what ``tools/diagnose.py --serve`` reads).
+  depth, serve_* totals, nonfinite-output health block — what
+  ``tools/diagnose.py --serve`` reads).
 """
 from __future__ import annotations
 
@@ -156,6 +157,8 @@ class Server:
             for values, child in req._samples():
                 if values:
                     by_result[values[0]] = child.value
+        from .. import monitor as _monitor
+
         return {
             "ready": self.ready(),
             "healthy": self.healthy(),
@@ -164,6 +167,16 @@ class Server:
             "runner": self._runner.stats(),
             "requests": by_result,
             "totals": serve_totals,
+            # mx.monitor output guard: nonfinite logits served (the
+            # serve-side face of the training-health plane; counts also
+            # appear in totals as serve_nonfinite_*)
+            "health": {
+                "monitor": _monitor.core.ENABLED,
+                "nonfinite_output_elems": telemetry.value(
+                    "serve_nonfinite_outputs_total"),
+                "nonfinite_batches": telemetry.value(
+                    "serve_nonfinite_batches_total"),
+            },
         }
 
     # -- submission ---------------------------------------------------------

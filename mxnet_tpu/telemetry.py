@@ -788,8 +788,9 @@ COMPILE_CACHE_COMMIT_SECONDS = histogram(
     "compile_cache_commit_seconds",
     "artifact serialize + durable-commit latency")
 # mx.trace (trace/): flight-recorder dumps and watchdog activity —
-# reason is manual / crash / exit / slow_step / deadline_burst / hang /
-# dry_run (export.py), scope names the watch that stalled (watchdog.py)
+# reason is manual / crash / exit / slow_step / deadline_burst /
+# divergence / hang / dry_run (export.py), scope names the watch that
+# stalled (watchdog.py)
 TRACE_DUMPS = counter(
     "trace_dumps_total",
     "flight-recorder dumps written, by trigger reason", ("reason",))
@@ -797,5 +798,68 @@ TRACE_WATCHDOG_FIRES = counter(
     "trace_watchdog_fires_total",
     "hang-watchdog reports (no progress past the scope timeout)",
     ("scope",))
+# mx.monitor (monitor/): on-device training-health numerics.  One
+# fused stat reduction program per multi-tensor parameter group per
+# step (grad/weight L2 norm, max|x|, nonfinite counts); values reach
+# the gauges through the async host-fetch ring, so a lag of a step or
+# two behind the live device state is expected.
+MONITOR_STAT_BUILDS = counter(
+    "monitor_stat_builds_total",
+    "stat reduction program builds (trace + compile; steady state: "
+    "one per parameter group, zero per-step retraces)")
+MONITOR_STAT_PROGRAMS = counter(
+    "monitor_stat_programs_total",
+    "stat reduction programs dispatched (groups x observed steps)")
+MONITOR_GRAD_NORM = gauge(
+    "monitor_grad_norm", "last observed per-group gradient L2 norm",
+    ("group",))
+MONITOR_WEIGHT_NORM = gauge(
+    "monitor_weight_norm", "last observed per-group weight L2 norm",
+    ("group",))
+MONITOR_GRAD_MAX = gauge(
+    "monitor_grad_max_abs", "last observed per-group max |grad|",
+    ("group",))
+MONITOR_WEIGHT_MAX = gauge(
+    "monitor_weight_max_abs", "last observed per-group max |weight|",
+    ("group",))
+MONITOR_GRAD_GLOBAL_NORM = gauge(
+    "monitor_grad_global_norm",
+    "last observed global gradient L2 norm (sqrt of the per-group "
+    "squared-norm sum)")
+MONITOR_GRAD_GLOBAL_NORM_HIST = histogram(
+    "monitor_grad_global_norm_hist",
+    "distribution of the global gradient L2 norm over observed steps",
+    buckets=(0.001, 0.01, 0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 50.0, 100.0,
+             1000.0))
+MONITOR_NONFINITE = counter(
+    "monitor_nonfinite_total",
+    "nonfinite (NaN/Inf) elements observed, saturating at ~2^24 per "
+    "program (f32 on-device count)", ("kind", "group"))
+MONITOR_NONFINITE_STEPS = counter(
+    "monitor_nonfinite_steps_total",
+    "observed steps with at least one nonfinite gradient element")
+MONITOR_SKIPPED_STEPS = counter(
+    "monitor_skipped_steps_total",
+    "trainer steps skipped whole by the nonfinite sentinel "
+    "(policy=skip_step; params/optimizer state untouched)")
+MONITOR_SENTINEL_TRIPS = counter(
+    "monitor_sentinel_trips_total",
+    "nonfinite sentinel trips by the policy in force", ("policy",))
+MONITOR_DROPS = counter(
+    "monitor_dropped_total",
+    "stat entries displaced from the bounded host-fetch ring before "
+    "the publisher drained them")
+MONITOR_FETCH_SECONDS = histogram(
+    "monitor_fetch_seconds",
+    "device->host stat vector fetch latency (synchronous only when "
+    "the sentinel policy needs the value to gate the step)")
+SERVE_NONFINITE_OUTPUTS = counter(
+    "serve_nonfinite_outputs_total",
+    "nonfinite (NaN/Inf) elements in served model outputs "
+    "(mx.monitor output guard; surfaced at /statz)")
+SERVE_NONFINITE_BATCHES = counter(
+    "serve_nonfinite_batches_total",
+    "dispatched micro-batches containing at least one nonfinite "
+    "output element")
 
 start_logger()

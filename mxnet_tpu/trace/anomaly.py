@@ -1,6 +1,7 @@
 """Dump-on-anomaly triggers for the flight recorder.
 
-Two detectors feed ``export.dump``:
+Two detectors (plus the mx.monitor divergence feed below) route
+through ``export.dump``:
 
 - ``SlowStepDetector`` — a trailing window of step durations; when one
   step exceeds ``factor`` x the trailing p99 the ring is dumped with
@@ -12,8 +13,12 @@ Two detectors feed ``export.dump``:
   ``MXNET_TRACE_DEADLINE_WINDOW`` seconds (default 5) dump with
   ``reason="deadline_burst"`` — the signature of a stalled backend or a
   batch policy gone wrong.
+- ``divergence(extra)`` — the mx.monitor entry point: training-health
+  events (nonfinite gradients, grad-norm spikes, loss NaN/plateau)
+  dump with ``reason="divergence"`` and the offending parameter group
+  / detector kind named in the dump metadata.
 
-Both are rate-limited by ``export.dump`` itself, so a persistently sick
+All are rate-limited by ``export.dump`` itself, so a persistently sick
 process produces a bounded trickle of dumps rather than a flood."""
 from __future__ import annotations
 
@@ -25,7 +30,8 @@ from ..base import get_env
 from . import core, export
 
 __all__ = ["SlowStepDetector", "DeadlineMissMonitor", "observe_step",
-           "deadline_miss", "STEP_DETECTOR", "DEADLINE_MONITOR"]
+           "deadline_miss", "divergence", "STEP_DETECTOR",
+           "DEADLINE_MONITOR"]
 
 
 class SlowStepDetector:
@@ -139,3 +145,18 @@ def deadline_miss():
     if not core.ENABLED:
         return None
     return DEADLINE_MONITOR.miss()
+
+
+def divergence(extra=None):
+    """Dump the flight record for a training-health divergence event
+    (mx.monitor: nonfinite gradients, grad-norm spike, loss
+    NaN/plateau).  ``extra`` names the kind, step, and offending
+    parameter group so the dump is self-describing.  Async for the
+    same reason the other detectors are — the sentinel fires on the
+    training thread mid-step, and the publisher fires under the
+    monitor ring lock's shadow; neither may stall on a multi-MB
+    write.  Rate-limited per ``MXNET_TRACE_DUMP_MIN_SECONDS`` like
+    every anomaly reason."""
+    if not core.ENABLED:
+        return None
+    return export.dump_async("divergence", extra=extra)

@@ -90,6 +90,72 @@ def test_checkpoint_and_early_stopping(tmp_path):
     assert any(f.startswith("m") for f in os.listdir(str(tmp_path)))
 
 
+class _FakeMetric:
+    """Scripted metric: .get() pops the next value in sequence."""
+
+    def __init__(self, values):
+        self._values = list(values)
+
+    def get(self):
+        return "loss", self._values.pop(0)
+
+
+def _run_early_stopping(values, **kwargs):
+    h = est.EarlyStoppingHandler(monitor=_FakeMetric(values), **kwargs)
+    epochs = 0
+    for _ in values:
+        h.epoch_end(None)
+        epochs += 1
+        if h.stop_training:
+            break
+    return h, epochs
+
+
+def test_early_stopping_nan_counts_as_no_improvement():
+    # ISSUE 8 satellite: a NaN metric used to `return` silently, so a
+    # diverged run trained forever.  NaN must consume patience like
+    # any non-improving epoch.
+    h, epochs = _run_early_stopping(
+        [1.0, float("nan"), float("nan")], patience=2, mode="min")
+    assert h.stop_training
+    assert epochs == 3
+    assert h.best == 1.0  # NaN never becomes the best
+
+
+def test_early_stopping_all_nan_from_start():
+    h, epochs = _run_early_stopping(
+        [float("nan"), float("nan")], patience=2, mode="min")
+    assert h.stop_training
+    assert epochs == 2
+    assert h.best is None
+
+
+def test_early_stopping_recovers_after_nan():
+    h, epochs = _run_early_stopping(
+        [1.0, float("nan"), 0.5, 0.4], patience=3, mode="min")
+    assert not h.stop_training
+    assert h.best == 0.4
+    assert h.wait == 0
+
+
+def test_early_stopping_unbeatable_inf_stops_immediately():
+    # +Inf under mode=max (or -Inf under min) can never be improved
+    # past: stop NOW regardless of patience
+    h, epochs = _run_early_stopping(
+        [0.5, float("inf")], patience=10, mode="max")
+    assert h.stop_training
+    assert epochs == 2
+    h, epochs = _run_early_stopping(
+        [0.5, float("-inf")], patience=10, mode="min")
+    assert h.stop_training
+    assert epochs == 2
+    # the OTHER infinity is just a terrible epoch: patience applies
+    h, epochs = _run_early_stopping(
+        [0.5, float("inf"), 0.4], patience=5, mode="min")
+    assert not h.stop_training
+    assert h.best == 0.4
+
+
 def test_validation_handler_runs_eval():
     e = _estimator()
     evals = []

@@ -355,6 +355,183 @@ def trainer_info():
     print("telemetry    : %s" % (tot or "(telemetry disabled)"))
 
 
+def _monitor_table(rows):
+    """Print one aligned row per parameter group from {label: stats}
+    dicts carrying grad/weight norm, max|x|, nonfinite counts."""
+    if not rows:
+        print("groups       : (no per-group stats observed)")
+        return
+    print("groups       :")
+    print("  %-28s %12s %12s %12s %12s %6s %6s"
+          % ("group", "grad_norm", "grad_max", "w_norm", "w_max",
+             "nf_g", "nf_w"))
+    for label in sorted(rows):
+        st = rows[label]
+        print("  %-28s %12.6g %12.6g %12.6g %12.6g %6d %6d"
+              % (label, st.get("g_norm", 0.0), st.get("g_max_abs", 0.0),
+                 st.get("w_norm", 0.0), st.get("w_max_abs", 0.0),
+                 int(st.get("g_nonfinite", 0)),
+                 int(st.get("w_nonfinite", 0))))
+
+
+def monitor_info(src):
+    """The mx.monitor stat plane.  ``src`` is ``live`` (default: train
+    a tiny monitored model for a few steps and read the live
+    registry), a telemetry JSON snapshot (``telemetry.dump``), or a
+    ``MXNET_MONITOR_STREAM`` JSONL file."""
+    section("Monitor / training health")
+    import json
+
+    if src != "live":
+        with open(src) as f:
+            content = f.read()
+        first, _, rest = content.partition("\n")
+        try:
+            head = json.loads(first)
+        except ValueError:
+            head = {}
+        if isinstance(head, dict) and "groups" in head:
+            # MXNET_MONITOR_STREAM JSONL: one line per observed step.
+            # A crashed run leaves a torn final line — report the
+            # intact steps instead of dying on the tear (the stream's
+            # whole point is the post-mortem)
+            lines, torn = [head], 0
+            for ln in rest.splitlines():
+                if not ln.strip():
+                    continue
+                try:
+                    lines.append(json.loads(ln))
+                except ValueError:
+                    torn += 1
+            print("source       : %s (JSONL stream, %d step(s)%s)"
+                  % (src, len(lines),
+                     ", %d torn line(s) skipped" % torn if torn else ""))
+            last = lines[-1]
+            skipped = sum(1 for ln in lines if ln.get("skipped"))
+            nonfinite = sum(
+                1 for ln in lines
+                if any(g.get("nonfinite_grad") for g in
+                       ln.get("groups", {}).values()))
+            norms = [ln.get("grad_global_norm", 0.0) for ln in lines]
+            print("steps        : %d  (nonfinite %d, skipped %d)"
+                  % (len(lines), nonfinite, skipped))
+            print("grad norm    : last=%.6g max=%.6g"
+                  % (norms[-1], max(norms)))
+            print("last step    : %s  policy=%s%s"
+                  % (last.get("step"), last.get("policy"),
+                     "  [SKIPPED]" if last.get("skipped") else ""))
+            _monitor_table({
+                label: {"g_norm": g.get("grad_norm", 0.0),
+                        "g_max_abs": g.get("grad_max_abs", 0.0),
+                        "w_norm": g.get("weight_norm", 0.0),
+                        "w_max_abs": g.get("weight_max_abs", 0.0),
+                        "g_nonfinite": g.get("nonfinite_grad", 0),
+                        "w_nonfinite": g.get("nonfinite_weight", 0)}
+                for label, g in last.get("groups", {}).items()})
+            return
+        # telemetry snapshot (telemetry.dump JSON)
+        try:
+            snap = json.loads(content)
+        except ValueError:
+            # not a snapshot either — e.g. a stream whose FIRST line
+            # is the torn one; say so instead of dying in a traceback
+            print("source       : %s (unparseable: neither a telemetry "
+                  "snapshot nor an intact JSONL stream)" % src)
+            return
+        metrics = snap.get("metrics", snap)
+        print("source       : %s (telemetry snapshot)" % src)
+
+        def _gauge(name):
+            out = {}
+            for s in metrics.get(name, {}).get("samples", []):
+                out[s["labels"].get("group", "")] = s.get("value", 0.0)
+            return out
+
+        rows = {}
+        for label, v in _gauge("monitor_grad_norm").items():
+            rows.setdefault(label, {})["g_norm"] = v
+        for label, v in _gauge("monitor_weight_norm").items():
+            rows.setdefault(label, {})["w_norm"] = v
+        for label, v in _gauge("monitor_grad_max_abs").items():
+            rows.setdefault(label, {})["g_max_abs"] = v
+        for label, v in _gauge("monitor_weight_max_abs").items():
+            rows.setdefault(label, {})["w_max_abs"] = v
+        for s in metrics.get("monitor_nonfinite_total",
+                             {}).get("samples", []):
+            key = "g_nonfinite" if s["labels"].get("kind") == "grad" \
+                else "w_nonfinite"
+            rows.setdefault(s["labels"].get("group", ""),
+                            {})[key] = s.get("value", 0)
+        _monitor_table(rows)
+        for name in ("monitor_grad_global_norm",
+                     "monitor_nonfinite_steps_total",
+                     "monitor_skipped_steps_total",
+                     "monitor_stat_builds_total",
+                     "monitor_dropped_total"):
+            samples = metrics.get(name, {}).get("samples", [])
+            if samples:
+                print("%-26s : %g" % (name, samples[0].get("value", 0)))
+        trips = metrics.get("monitor_sentinel_trips_total",
+                            {}).get("samples", [])
+        for s in trips:
+            print("sentinel trips (%s)     : %g"
+                  % (s["labels"].get("policy"), s.get("value", 0)))
+        return
+
+    # live: train a tiny monitored model (mirrors trainer_info's demo)
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, monitor, nd, telemetry
+    from mxnet_tpu.gluon import nn
+
+    telemetry.enable()
+    monitor.enable()
+    print("enabled      :", monitor.is_enabled())
+    print("sentinel     :", monitor.sentinel.policy())
+    print("stream       :", monitor.stream_path() or "(off)")
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    for _ in range(6):
+        net.add(nn.Dense(16, in_units=16))
+    net.initialize()
+    params = net.collect_params()
+    list(params.values())[-1].lr_mult = 0.5  # a second group
+    trainer = gluon.Trainer(params, "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    x = nd.array(np.random.RandomState(0).rand(4, 16).astype(np.float32))
+    for _ in range(3):
+        with autograd.record():
+            loss = (net(x) ** 2).mean()
+        loss.backward()
+        trainer.step(4)
+        monitor.observe_loss(float(loss.asnumpy()))
+    monitor.flush(timeout=10.0)
+    s = monitor.summary()
+    print("steps        : %d  (nonfinite %d, skipped %d, dropped %d)"
+          % (s["steps"], s["nonfinite_steps"], s["skipped_steps"],
+             s["dropped"]))
+    print("grad norm    : last=%.6g max=%.6g"
+          % (s["grad_global_norm_last"], s["grad_global_norm_max"]))
+    print("stat programs: %d compiled (builds=%g, dispatches=%g)"
+          % (monitor.stats.programs(),
+             telemetry.value("monitor_stat_builds_total"),
+             telemetry.value("monitor_stat_programs_total")))
+    _monitor_table(monitor.group_values())
+    det = monitor.DETECTOR.state()
+    print("detector     : spikes=%d nonfinite_grad_steps=%d "
+          "loss_nonfinite=%d plateaus=%d"
+          % (det["spikes"], det["nonfinite_grad_steps"],
+             det["loss_nonfinite"], det["plateaus"]))
+    print("               spike_factor=%.1f window=%d (fill %d) "
+          "trailing_max=%.6g"
+          % (det["spike_factor"], det["window"], det["window_fill"],
+             det["trailing_max"]))
+    tot = {k: v for k, v in telemetry.totals(nonzero=True).items()
+           if k.startswith("monitor_")}
+    print("telemetry    : %s" % (tot or "(no monitor_* activity)"))
+
+
 def compile_cache_info():
     """Audit the mx.compile persistent compilation cache: directory,
     entry count, total bytes, per-entry age/size, quarantined entries,
@@ -434,15 +611,24 @@ def main():
                     help="dump the mx.trace plane: flight-recorder "
                          "occupancy, watchdog state, anomaly "
                          "detectors, dumps written")
+    ap.add_argument("--monitor", nargs="?", const="live", metavar="SRC",
+                    help="mx.monitor training-health stats: per-group "
+                         "norms, nonfinite totals, sentinel "
+                         "policy/trips, detector state — live (train "
+                         "a tiny monitored model; the default), or "
+                         "from a telemetry JSON snapshot / "
+                         "MXNET_MONITOR_STREAM JSONL file")
     args = ap.parse_args()
     # section flags compose: --compile-cache --serve URL prints both
     # (each skips the environment dump, all honor --telemetry)
     if args.compile_cache or args.serve or args.checkpoints or \
-            args.trainer or args.trace:
+            args.trainer or args.trace or args.monitor:
         if args.compile_cache:
             compile_cache_info()
         if args.trainer:
             trainer_info()
+        if args.monitor:
+            monitor_info(args.monitor)
         if args.serve:
             serve_info(args.serve)
         if args.checkpoints:
