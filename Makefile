@@ -11,7 +11,7 @@ SO := build/libmxtpu_native.so
 
 .PHONY: native test cpptest telemetry-smoke checkpoint-smoke serve-smoke \
 	compile-cache-smoke trainer-smoke trace-smoke monitor-smoke \
-	smoke-all clean
+	faults-smoke smoke-all clean
 
 native: $(SO)
 
@@ -96,10 +96,22 @@ monitor-smoke:
 	JAX_PLATFORMS=cpu python -m pytest \
 	  tests/python/unittest/test_monitor.py -q -m 'not slow'
 
+# mx.resilience fault drills: writer killed mid-commit -> recover;
+# collective fault mid-run -> backoff + bit-identical resume; real
+# SIGTERM -> emergency checkpoint -> cross-process bit-identical
+# resume; save on 4 virtual devices -> restore-with-resharding on 2;
+# then the subsystem's pytest suite
+faults-smoke:
+	JAX_PLATFORMS=cpu python tools/faults_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/python/unittest/test_resilience.py \
+	  tests/python/unittest/test_elastic.py -q -m 'not slow'
+
 # every subsystem smoke in sequence — the one-command pre-flight before
 # a tunnel window (each target is independent; failures stop the chain)
 smoke-all: telemetry-smoke checkpoint-smoke serve-smoke \
-	compile-cache-smoke trainer-smoke trace-smoke monitor-smoke
+	compile-cache-smoke trainer-smoke trace-smoke monitor-smoke \
+	faults-smoke
 
 # suite summary artifact (TESTS_r{N}.json) — round-2 advisor contract
 test-report:

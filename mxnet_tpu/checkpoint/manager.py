@@ -44,6 +44,7 @@ import numpy as _np
 
 from ..base import MXNetError
 from .. import telemetry, trace
+from ..resilience import inject as _inject
 from . import layout
 from .writer import AsyncWriter
 
@@ -316,6 +317,10 @@ class CheckpointManager:
     def _commit_once(self, step, spec, host):
         from .. import __version__
 
+        # mx.resilience drill site (checkpoint writer IO): an :io fault
+        # here exercises the retry-with-backoff loop above; nothing is
+        # on disk yet, so the previous checkpoint is untouched
+        _inject.fire("checkpoint_commit")
         t_ser = time.perf_counter()
         entries, writers = layout.plan_shards(host, self._group_bytes)
         tmp = tempfile.mkdtemp(dir=self._root, prefix=".saving-")
@@ -345,6 +350,11 @@ class CheckpointManager:
                 mbytes = json.dumps(manifest, sort_keys=True).encode()
                 layout.write_file_durable(
                     os.path.join(tmp, layout.MANIFEST), mbytes)
+                # mx.resilience drill site: an :abort fault here is the
+                # "writer killed mid-commit" drill — shards + manifest
+                # durable, marker never lands, the dir is torn by
+                # definition and discovery must skip it
+                _inject.fire("checkpoint_marker")
                 # phase 2: the marker makes the dir trustworthy;
                 # everything above is already durable when this lands
                 marker = json.dumps(

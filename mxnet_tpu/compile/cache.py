@@ -311,6 +311,12 @@ class CompileCache:
         t0 = time.perf_counter()
         final = self._entry_dir(fp)
         try:
+            # mx.resilience drill site (use kind :io — an OSError here
+            # proves a failing cache commit degrades to the in-memory
+            # compile, never breaks the build)
+            from ..resilience import inject as _inject
+
+            _inject.fire("compile_commit")
             os.makedirs(os.path.dirname(final), exist_ok=True)
             self._sweep_stale_tmp()
             tmp = tempfile.mkdtemp(dir=self._root, prefix=".committing-")

@@ -142,6 +142,62 @@ ENV_VARS = {
         "Loss observations without a new best before a loss_plateau "
         "divergence dump (0 disables; fed via monitor.observe_loss / "
         "the estimator TrainingHealthHandler)."),
+    "MXNET_FAULTS": (
+        str, None,
+        "Deterministic fault plan for mx.resilience drills: comma-"
+        "separated site@key[:kind][*count] entries (sites: "
+        "trainer_step / collective / checkpoint_commit / "
+        "checkpoint_marker / compile_commit / serve_dispatch / "
+        "serve_poison; kinds: transient / io / fatal / abort).  Faults "
+        "fire by (site, sequence), so every drill replays identically "
+        "(resilience/inject.py)."),
+    "MXNET_PREEMPT_INSTALL": (
+        bool, False,
+        "Arm the SIGTERM preemption handler at import: the supervisor "
+        "stops at the next step boundary, flushes an emergency "
+        "checkpoint, drains serve, and exits with "
+        "MXNET_PREEMPT_EXIT_CODE (resilience/preempt.py)."),
+    "MXNET_PREEMPT_GRACE_SECONDS": (
+        float, 30.0,
+        "Grace budget after SIGTERM: shutdown hooks are skipped (the "
+        "emergency checkpoint is not) once it is exhausted; a second "
+        "SIGTERM exits immediately."),
+    "MXNET_PREEMPT_EXIT_CODE": (
+        int, 85,
+        "Exit status of a clean preemption shutdown — distinct from "
+        "crash codes so the pod scheduler knows to simply resume."),
+    "MXNET_RESTART_BUDGET": (
+        int, 3,
+        "Supervisor restart budget: max transient-failure restarts "
+        "within MXNET_RESTART_WINDOW_STEPS (resilience/supervisor.py)."),
+    "MXNET_RESTART_WINDOW_STEPS": (
+        int, 0,
+        "Sliding step window the restart budget applies over (0 = "
+        "whole-run lifetime, the old FaultTolerantRunner semantics)."),
+    "MXNET_RESTART_BACKOFF_BASE": (
+        float, 1.0,
+        "First-restart backoff delay in seconds (doubles per restart, "
+        "jittered, capped at MXNET_RESTART_BACKOFF_MAX)."),
+    "MXNET_RESTART_BACKOFF_MAX": (
+        float, 60.0,
+        "Backoff delay ceiling between supervisor restarts."),
+    "MXNET_HEALTH_TIMEOUT": (
+        float, 60.0,
+        "Wall-clock bound on the post-failure device health probe; a "
+        "hung transfer reports 'error: timeout' instead of blocking "
+        "the supervisor forever."),
+    "MXNET_SERVE_BREAKER_THRESHOLD": (
+        int, 5,
+        "Consecutive failed dispatches that open a serve bucket's "
+        "circuit breaker (serve/breaker.py; <= 0 disables breakers)."),
+    "MXNET_SERVE_BREAKER_COOLDOWN": (
+        float, 30.0,
+        "Seconds a tripped bucket stays quarantined before the "
+        "half-open trial dispatch."),
+    "MXNET_SERVE_RETRY_AFTER": (
+        float, 1.0,
+        "Retry-After seconds the HTTP front-end advertises on "
+        "overload 503 responses."),
     "MXNET_TELEMETRY_DISABLE": (
         bool, False,
         "Disable the runtime telemetry registry (mx.telemetry); hooks "

@@ -1,56 +1,44 @@
-"""Failure detection + checkpoint auto-resume.
+"""Failure detection + checkpoint auto-resume (compat surface).
 
-SURVEY §5.3 names this an explicit gap to CLOSE (the reference has no
-elastic training: engine exceptions surface at sync points,
-threaded_engine.cc:379-416, and recovery means "restart the job from a
-checkpoint by hand").  The TPU-native version automates that contract:
+SURVEY §5.3 named this an explicit gap to CLOSE; PRs 2 and 9 closed it
+in layers.  Today this module is the thin compatibility face over two
+real subsystems:
 
-- ``device_health_check()`` — run a tiny program on every local device
-  and report per-device health (PJRT surfaces dead/hung chips as errors
-  at dispatch or transfer time).
-- ``CheckpointManager`` — step-tagged atomic checkpoints of an arbitrary
-  jax pytree (FusedTrainer state, Gluon params, ...), rolling retention.
-- ``FaultTolerantRunner`` — drives a trainer step loop; on failure it
-  re-checks device health, restores the latest checkpoint, and resumes —
-  the "slice-restart with auto-resume" loop a pod scheduler performs,
-  usable single-host too.
+- ``mx.checkpoint`` owns persistence (the ``CheckpointManager`` here
+  is a positional-arg-compatible shim over it);
+- ``mx.resilience`` owns detection and recovery: the exception
+  taxonomy, backoff/budget policy, preemption handling, bounded
+  health probes, and the ``Supervisor`` loop.
 
-The reference's closest machinery for the *detection* half is the engine
-exception chain (src/engine/threaded_engine.h:64-65 ExceptionRef); the
-resume half replaces the manual CheckpointHandler restart
-(python/mxnet/gluon/contrib/estimator/event_handler.py:336).
+``FaultTolerantRunner`` is kept for existing callers but is now a
+deprecated alias configured for the OLD semantics (lifetime restart
+budget, no backoff sleep) — new code should use
+``mx.resilience.Supervisor`` directly, which adds exponential backoff
+with jitter, a sliding restart window, preemption-aware shutdown, and
+restore-on-divergence.
 """
 from __future__ import annotations
 
-import numpy as _np
-
-from .base import MXNetError
 from .checkpoint import CheckpointManager as _CheckpointManager
 from .checkpoint.layout import tree_from_spec, tree_spec
+from .resilience.supervisor import Backoff, Supervisor
+from .resilience.supervisor import health_check as _health_check
 
 __all__ = ["device_health_check", "CheckpointManager",
            "FaultTolerantRunner"]
 
 
-def device_health_check(timeout_ok=True):
+def device_health_check(timeout_ok=True, timeout=None):
     """Probe every local device with a trivial program + host transfer.
 
-    Returns {device_str: "ok" | "error: ..."}.  A dead chip (or a dead
-    tunnel to it) fails the transfer rather than hanging forever in most
-    PJRT implementations; callers wanting a hard wall-clock bound should
-    run this in a worker with a timeout.
-    """
-    import jax
-
-    report = {}
-    for d in jax.local_devices():
-        try:
-            val = _np.asarray(jax.device_put(_np.float32(2.0), d) * 2)
-            ok = float(val) == 4.0
-            report[str(d)] = "ok" if ok else "error: bad arithmetic"
-        except Exception as exc:  # pragma: no cover - real device failure
-            report[str(d)] = "error: %s" % (exc,)
-    return report
+    Returns ``{device_str: "ok" | "error: ..."}``.  With ``timeout``
+    (seconds) each device is probed in a worker thread under a shared
+    wall-clock bound, and a hung transfer — a dead chip, or a dead
+    tunnel to it — reports ``"error: timeout"`` instead of blocking
+    the caller forever (the gap this function's own docstring used to
+    document).  ``timeout=None`` keeps the old unbounded behavior.
+    ``timeout_ok`` is accepted for signature compatibility."""
+    return _health_check(timeout=timeout)
 
 
 # compat aliases: the pytree structure codec moved to mx.checkpoint
@@ -79,68 +67,28 @@ class CheckpointManager(_CheckpointManager):
         super().__init__(root, max_keep=max_keep, prefix=prefix, **kwargs)
 
 
-class FaultTolerantRunner:
-    """Resumable training loop with failure detection.
-
-    ``trainer`` needs ``state_dict()``/``load_state_dict(state)`` (both
-    FusedTrainer and PipelineTrainer provide them) and ``step(x, y)``.
-    ``batches`` is ``fn(step_index) -> (x, y)`` so the data position is a
-    pure function of the step (resume lands on the right batch).
-    """
+class FaultTolerantRunner(Supervisor):
+    """DEPRECATED alias of ``mx.resilience.Supervisor`` keeping the old
+    constructor and semantics: a LIFETIME restart budget and no
+    backoff sleep between restarts.  It still gains the new hardening
+    for free — exception taxonomy (fatal shape/user errors raise
+    immediately instead of burning restarts), bounded health probes,
+    contained ``on_failure`` callbacks (a raising callback no longer
+    masks the original training error), preemption polling, and a
+    flight-record dump per restart."""
 
     def __init__(self, trainer, manager, checkpoint_every=50,
                  max_restarts=3, on_failure=None):
-        self._trainer = trainer
-        self._manager = manager
-        self._every = int(checkpoint_every)
-        self._max_restarts = int(max_restarts)
-        self._on_failure = on_failure
-        self.restarts = 0
+        import warnings
 
-    def run(self, batches, num_steps, start_step=0):
-        losses = []
-        step = start_step
-        # resume if the manager already holds newer state
-        latest = self._manager.latest_step()
-        if latest is not None and latest >= step:
-            step = self._resume() + 1
-        while step < num_steps:
-            try:
-                x, y = batches(step)
-                loss = self._trainer.step(x, y)
-                losses.append(float(loss.asscalar()))
-                if (step + 1) % self._every == 0 or step == num_steps - 1:
-                    self._manager.save(step, self._trainer.state_dict())
-                step += 1
-            except KeyboardInterrupt:
-                raise
-            except Exception as exc:
-                self.restarts += 1
-                if self._on_failure is not None:
-                    self._on_failure(step, exc)
-                if self.restarts > self._max_restarts:
-                    raise MXNetError(
-                        "training failed at step %d after %d restarts: %s"
-                        % (step, self.restarts - 1, exc)) from exc
-                health = device_health_check()
-                bad = {k: v for k, v in health.items() if v != "ok"}
-                if bad:  # pragma: no cover - real chip loss
-                    raise MXNetError(
-                        "device(s) unhealthy after failure at step %d: %s"
-                        % (step, bad)) from exc
-                if self._manager.latest_step() is not None:
-                    step = self._resume() + 1
-                    # drop losses from steps that will be replayed so the
-                    # returned series has exactly one entry per step
-                    losses = losses[:max(0, step - start_step)]
-                # else: retry from the current in-memory state
-        return losses
-
-    def _resume(self):
-        # state_dict() is None before the trainer's first step; the
-        # checkpoint's embedded structure spec covers that fresh-process
-        # case
-        saved_step, state = self._manager.restore(
-            self._trainer.state_dict())
-        self._trainer.load_state_dict(state)
-        return saved_step
+        warnings.warn(
+            "elastic.FaultTolerantRunner is deprecated; use "
+            "mxnet_tpu.resilience.Supervisor (adds backoff with "
+            "jitter, sliding restart windows, preemption handling, "
+            "and restore-on-divergence)",
+            DeprecationWarning, stacklevel=2)
+        super().__init__(
+            trainer, manager, checkpoint_every=checkpoint_every,
+            max_restarts=max_restarts, restart_window=0,
+            backoff=Backoff(base=0.0, jitter=0.0),
+            on_failure=on_failure)

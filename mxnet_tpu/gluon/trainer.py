@@ -20,6 +20,7 @@ from .. import optimizer as opt_mod
 from .. import trace
 from ..base import MXNetError
 from ..kvstore import create as kv_create
+from ..resilience import inject as _inject
 from .parameter import Parameter
 
 __all__ = ["Trainer"]
@@ -188,6 +189,10 @@ class Trainer:
         with trace.span("trainer_step", hist=False, anomaly=True,
                         args={"step": self._step_count}), \
                 trace.watchdog.watch("trainer_step"):
+            # mx.resilience drill site: a planned fault at this step
+            # index fires before any state mutates (the step is cleanly
+            # retryable from the last checkpoint)
+            _inject.fire("trainer_step", seq=self._step_count)
             with trace.span("trainer_allreduce", hist=False):
                 self._allreduce_grads()
             self._update(ignore_stale_grad)
@@ -339,28 +344,25 @@ class Trainer:
 
         return cached_manager(self, root, **manager_kwargs)
 
-    def save_checkpoint(self, root, step=None, **manager_kwargs):
-        """Save parameters + optimizer state + step counter as ONE
-        atomic ``mx.checkpoint`` unit under ``root`` (default step tag:
-        the trainer's own update count).  Crash-consistent: a save that
-        dies mid-write never corrupts the previous checkpoint.  Extra
-        kwargs (``max_keep``, ``keep_every``, ...) configure the
-        manager.  Returns the committed directory."""
+    def state_dict(self):
+        """Full training state (params + optimizer state + per-param
+        update counts + step counter) as ONE checkpointable tree —
+        the ``mx.resilience`` supervisor protocol (``FusedTrainer``
+        provides the same surface).  States/counts are keyed by
+        PARAMETER NAME, not positional index: a restoring trainer
+        built with a different param insertion order must not attach
+        moments to the wrong weights."""
         from ..optimizer.optimizer import _state_np
 
         if not self._kv_initialized:
             self._init_kvstore()
         if self._update_on_kvstore:
             raise MXNetError(
-                "save_checkpoint: optimizer state lives on the kvstore "
+                "state_dict: optimizer state lives on the kvstore "
                 "when update_on_kvstore=True; use save_states/load_states")
-        step = self._step_count if step is None else int(step)
         opt = self._optimizer
-        # states/counts are keyed by PARAMETER NAME, not positional
-        # index: a restoring trainer built with a different param
-        # insertion order must not attach moments to the wrong weights
         names = [str(n) for n in self._param_names]
-        tree = {"params": {names[i]: p.data()
+        return {"params": {names[i]: p.data()
                            for i, p in enumerate(self._params)
                            if p._data is not None},
                 "states": {names[i]: _state_np(s)
@@ -374,6 +376,16 @@ class Trainer:
                 # the TRUE update counter, independent of the caller's
                 # directory tag (do_checkpoint tags by epoch)
                 "step": self._step_count}
+
+    def save_checkpoint(self, root, step=None, **manager_kwargs):
+        """Save parameters + optimizer state + step counter as ONE
+        atomic ``mx.checkpoint`` unit under ``root`` (default step tag:
+        the trainer's own update count).  Crash-consistent: a save that
+        dies mid-write never corrupts the previous checkpoint.  Extra
+        kwargs (``max_keep``, ``keep_every``, ...) configure the
+        manager.  Returns the committed directory."""
+        tree = self.state_dict()
+        step = self._step_count if step is None else int(step)
         mgr = self._checkpoint_manager(root, **manager_kwargs)
         return mgr.save(step, tree)
 
@@ -381,7 +393,23 @@ class Trainer:
         """Restore a ``save_checkpoint`` bundle (default: latest step).
         Parameters are written back into the live Parameter objects,
         optimizer state is rebuilt (re-sharded under ZeRO), and the
-        step counter resumes.  Returns the restored step."""
+        step counter resumes.  Returns the restored step.
+        (``load_state_dict`` enforces the update_on_kvstore contract.)"""
+        mgr = self._checkpoint_manager(root)
+        step, tree = mgr.restore(step=step)
+        try:
+            self.load_state_dict(tree)
+        except MXNetError as exc:
+            # load_state_dict validates structure but cannot know WHICH
+            # checkpoint was bad — add the root/step an operator needs
+            raise MXNetError("checkpoint at %s step %d: %s"
+                             % (root, step, exc)) from exc
+        return step
+
+    def load_state_dict(self, tree):
+        """Apply a ``state_dict`` tree (the supervisor restore path;
+        values may be jax/numpy arrays from either the spec-based or
+        the template-based ``CheckpointManager.restore``)."""
         import jax.numpy as jnp
 
         from ..ndarray.ndarray import NDArray
@@ -390,10 +418,8 @@ class Trainer:
             self._init_kvstore()
         if self._update_on_kvstore:
             raise MXNetError(
-                "load_checkpoint: optimizer state lives on the kvstore "
+                "load_state_dict: optimizer state lives on the kvstore "
                 "when update_on_kvstore=True; use save_states/load_states")
-        mgr = self._checkpoint_manager(root)
-        step, tree = mgr.restore(step=step)
         loaded = tree["params"]
         for n, param in zip(self._param_names, self._params):
             key = str(n)
@@ -401,8 +427,7 @@ class Trainer:
                 param.set_data(loaded[key])
             elif param._data is not None:
                 raise MXNetError(
-                    "checkpoint at %s step %d is missing parameter %r"
-                    % (root, step, key))
+                    "checkpoint state is missing parameter %r" % (key,))
 
         def _to_nd(state):
             if state is None:
@@ -419,9 +444,9 @@ class Trainer:
         unknown = [k for k in tree["states"] if k not in index_of]
         if unknown:
             raise MXNetError(
-                "checkpoint at %s step %d has optimizer state for "
-                "unknown parameter(s) %s — the model structure changed"
-                % (root, step, sorted(unknown)))
+                "checkpoint state has optimizer state for unknown "
+                "parameter(s) %s — the model structure changed"
+                % (sorted(unknown),))
         self._states = {index_of[k]: _to_nd(v)
                         for k, v in tree["states"].items()}
         updates = tree.get("updates")
@@ -435,4 +460,3 @@ class Trainer:
             self._states = {k: self._shard_state(v)
                             for k, v in self._states.items()}
         self._step_count = int(tree["step"])
-        return step

@@ -40,6 +40,9 @@ class KVStoreBase:
         MXNET_KVSTORE_BUCKET_BYTES.  The base implementation loops
         per-key so third-party stores registered via ``register`` keep
         working unchanged."""
+        from ..resilience import inject as _inject
+
+        _inject.fire("collective")
         outs = [None] * len(keys) if out is None else out
         for k, v, o in zip(keys, values, outs):
             self.pushpull(k, v, out=o, priority=priority)

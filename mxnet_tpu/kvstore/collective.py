@@ -27,6 +27,7 @@ from .. import telemetry as _tel
 from .. import trace as _trace
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
+from ..resilience import inject as _inject
 from .base import KVStoreBase
 from .kvstore import _pair, _reduce
 
@@ -246,6 +247,9 @@ class CollectiveKVStore(KVStoreBase):
         bucket) collective programs per step instead of one per key."""
         with _trace.span("pushpull_all", hist=False,
                          args={"keys": len(keys)}):
+            # mx.resilience drill site: the collective-failure drill
+            # fires here, before any bucket program launches
+            _inject.fire("collective")
             self.pushpull(list(keys), list(values), out=out,
                           priority=priority)
 

@@ -14,6 +14,7 @@ import pickle
 from .. import trace as _trace
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
+from ..resilience import inject as _inject
 from .base import KVStoreBase, _pair
 
 
@@ -99,6 +100,9 @@ class KVStore(KVStoreBase):
         collectives to bucket locally)."""
         with _trace.span("pushpull_all", hist=False,
                          args={"keys": len(keys)}):
+            # mx.resilience drill site: fires before any key merges, so
+            # gradients are intact for the retried step
+            _inject.fire("collective")
             self.pushpull(list(keys), list(values), out=out,
                           priority=priority)
 

@@ -408,6 +408,12 @@ class FusedTrainer:
         or a tuple (multi-input models / multi-label losses); all leading
         dims are the batch."""
         from .. import random as mxrandom
+        from ..resilience import inject as _inject
+
+        # mx.resilience drill site: fires BEFORE the donated launch, so
+        # a faulted step leaves params/opt_state untouched and the
+        # supervisor's restore-and-replay is exact
+        _inject.fire("trainer_step", seq=self._step_count)
 
         def as_jax(v):
             return v._data if isinstance(v, NDArray) else jnp.asarray(v)

@@ -76,6 +76,7 @@ def _detect():
         "TRACE": True,
         "CHECKPOINT": True,
         "SERVE": True,
+        "RESILIENCE": True,
         "OPENMP": True,
         "SSE": False,
         "F16C": False,

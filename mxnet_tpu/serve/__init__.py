@@ -20,6 +20,14 @@ checkpoint): compile-once/run-many execution behind a request queue.
   minimal stdlib HTTP endpoint (``/predict``, ``/healthz``,
   ``/readyz``, ``/metrics``, ``/statz``).
 
+- **graceful degradation** (mx.resilience): a failing batch is
+  retried bisected down to singles so a poisoned request fails ALONE
+  (``serve_poison_requests_total``); repeatedly-failing buckets are
+  quarantined by per-bucket circuit breakers (``BucketQuarantined``,
+  HTTP 503 + ``Retry-After``, state visible in ``/healthz`` and
+  ``/statz``); overload maps to 503 + ``Retry-After`` and deadline
+  expiry to 504, with ``X-Request-Id`` echoed on every response.
+
 Every stage is metered through ``mx.telemetry`` (``serve_*`` queue
 wait, batch size, pad waste, compile count, latency, rejections) and
 exported through the existing Prometheus/JSON exporters.  See README
@@ -27,13 +35,16 @@ exported through the existing Prometheus/JSON exporters.  See README
 """
 from __future__ import annotations
 
-from .batching import (BatchQueue, NoBucketError, Request, RequestTimeout,
-                       Scheduler, ServeError, ServerClosed, ServerOverloaded)
+from .batching import (BatchQueue, BucketQuarantined, NoBucketError,
+                       Request, RequestTimeout, Scheduler, ServeError,
+                       ServerClosed, ServerOverloaded)
+from .breaker import BreakerBoard, CircuitBreaker
 from .runner import DEFAULT_BATCH_SIZES, ModelRunner
 from .server import ServeConfig, Server
 
 __all__ = [
     "Server", "ServeConfig", "ModelRunner", "BatchQueue", "Scheduler",
     "Request", "ServeError", "ServerOverloaded", "ServerClosed",
-    "RequestTimeout", "NoBucketError", "DEFAULT_BATCH_SIZES",
+    "RequestTimeout", "NoBucketError", "BucketQuarantined",
+    "CircuitBreaker", "BreakerBoard", "DEFAULT_BATCH_SIZES",
 ]

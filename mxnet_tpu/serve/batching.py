@@ -25,10 +25,12 @@ from concurrent.futures import Future, InvalidStateError
 
 from .. import telemetry, trace
 from ..base import MXNetError
+from ..resilience import inject as _inject
+from ..resilience.inject import InjectedFault
 
 __all__ = ["ServeError", "ServerOverloaded", "ServerClosed",
-           "RequestTimeout", "NoBucketError", "Request", "BatchQueue",
-           "Scheduler"]
+           "RequestTimeout", "NoBucketError", "BucketQuarantined",
+           "Request", "BatchQueue", "Scheduler"]
 
 
 class ServeError(MXNetError):
@@ -37,7 +39,8 @@ class ServeError(MXNetError):
 
 class ServerOverloaded(ServeError):
     """The batch queue is full: the request was rejected, not queued.
-    Clients should back off and retry (HTTP surface: 429)."""
+    Clients should back off and retry (HTTP surface: 503 +
+    ``Retry-After``)."""
 
 
 class ServerClosed(ServeError):
@@ -50,6 +53,17 @@ class RequestTimeout(ServeError, TimeoutError):
 
 class NoBucketError(ServeError, ValueError):
     """No configured shape bucket can hold the request's input shapes."""
+
+
+class BucketQuarantined(ServeError):
+    """The request's shape bucket is quarantined by an open circuit
+    breaker (repeated dispatch failures); other buckets still serve.
+    Clients should retry after ``retry_after`` seconds (HTTP surface:
+    503 + ``Retry-After``)."""
+
+    def __init__(self, msg, retry_after=None):
+        super().__init__(msg)
+        self.retry_after = retry_after
 
 
 def _fail(req, exc, result):
@@ -228,13 +242,25 @@ class Scheduler:
 
     ``runner_fn`` is called once per batch — that one read is the hot
     model swap's atomicity point: a batch runs either entirely on the
-    old runner or entirely on the new one."""
+    old runner or entirely on the new one.
 
-    def __init__(self, queue, runner_fn, max_batch_size=8, max_wait_us=2000):
+    Failure containment (mx.resilience): a batch whose execution
+    raises is retried **bisected** down to singles, so a poisoned
+    request fails alone and its batch-mates still get answers
+    (``serve_poison_requests_total``); repeated failed dispatches of
+    one bucket open that bucket's circuit breaker (``breakers``, a
+    ``breaker.BreakerBoard``) and its requests are quarantined with
+    ``BucketQuarantined`` until the cooldown's half-open trial
+    succeeds.  Every path resolves every future — the scheduler
+    thread itself never dies to a model error."""
+
+    def __init__(self, queue, runner_fn, max_batch_size=8, max_wait_us=2000,
+                 breakers=None):
         self._queue = queue
         self._runner_fn = runner_fn
         self._max_batch = int(max_batch_size)
         self._max_wait = float(max_wait_us) / 1e6
+        self._breakers = breakers
         self._thread = None
 
     def start(self):
@@ -287,7 +313,22 @@ class Scheduler:
                     trace.record_span("serve_queue_wait", req.enqueued,
                                       now - req.enqueued, ctx=req.trace,
                                       cat="serve")
+        cls = head.bucket_class
+        if self._breakers is not None and not self._breakers.allow(cls):
+            exc = self._breakers.quarantine_error(cls)
+            for req in live:
+                _fail(req, exc, "quarantined")
+            return
         runner = self._runner_fn()
+        if runner is None:
+            # the owning Server was garbage-collected (dropped without
+            # shutdown): fail whatever is queued and wind the loop down
+            exc = ServerClosed("server was dropped without shutdown")
+            for req in live:
+                _fail(req, exc, "cancelled")
+            self._queue.close()
+            self._queue.cancel_pending()
+            return
         try:
             # batch-level spans (pad/execute/unpad inside the runner)
             # adopt the HEAD request's trace context — for a batch the
@@ -299,15 +340,39 @@ class Scheduler:
                                          r.trace.trace_id for r in live
                                          if r.trace is not None]}), \
                     trace.watchdog.watch("serve_dispatch"):
-                results = runner.run_batch(live)
+                _inject.fire("serve_dispatch")
+                pairs = self._run_split(runner, live)
         except BaseException as exc:  # noqa: BLE001 - surfaced per-request
             for req in live:
                 _fail(req, exc, "error")
+            if self._breakers is not None:
+                self._breakers.failure(cls)
             return
+        failed = [p for p in pairs if p[2] is not None]
+        if self._breakers is not None:
+            # one strike per DISPATCH (not per request): the bisect
+            # already confined the damage; the breaker watches for the
+            # whole bucket going repeatedly bad
+            if failed:
+                self._breakers.failure(cls)
+            else:
+                self._breakers.success(cls)
+        # "poisoned" means the request failed ALONE while at least one
+        # batch-mate was served — a bucket-wide systemic failure (every
+        # single fails after bisection) is an "error" story, not a
+        # poison one, and must not inflate the poison counter
+        any_ok = any(p[2] is None for p in pairs)
         with trace.use(head.trace), \
                 trace.span("serve_respond", hist=False, cat="serve"):
             done_t = time.perf_counter()
-            for req, res in zip(live, results):
+            for req, res, exc, isolated in pairs:
+                if exc is not None:
+                    poisoned = isolated and any_ok
+                    if poisoned and telemetry.ENABLED:
+                        telemetry.SERVE_POISON.inc()
+                    _fail(req, exc,
+                          "poisoned" if poisoned else "error")
+                    continue
                 try:
                     req.future.set_result(res)
                 except InvalidStateError:
@@ -323,6 +388,44 @@ class Scheduler:
                         done_t - req.enqueued, ctx=req.trace, root=True,
                         cat="serve", args={"result": "ok",
                                            "request_id": req.request_id})
+
+    def _run_split(self, runner, reqs, depth=0):
+        """Run ``reqs``; on failure retry bisected until single
+        requests, so one poisoned request cannot fail its batch-mates.
+        Returns ``[(req, result, exc, isolated)]`` aligned with
+        ``reqs`` — ``exc`` set for failures, ``isolated`` True when
+        the failure was pinned to a single request by bisection.  At
+        most ``2n - 1`` executions for a batch of n (and only when
+        something actually fails)."""
+        try:
+            bad = [r for r in reqs
+                   if _inject.poisoned(r.request_id)]
+            if bad:
+                if len(reqs) == 1:
+                    _inject.record_firing("serve_poison",
+                                          bad[0].request_id,
+                                          consume=True)
+                raise InjectedFault(
+                    "injected poison request %s"
+                    % [r.request_id for r in bad],
+                    site="serve_poison")
+            results = runner.run_batch(reqs)
+        except BaseException as exc:  # noqa: BLE001 - contained below
+            if len(reqs) == 1:
+                isolated = depth > 0 or \
+                    getattr(exc, "site", None) == "serve_poison"
+                return [(reqs[0], None, exc, isolated)]
+            if telemetry.ENABLED:
+                telemetry.SERVE_BISECT_SPLITS.inc()
+            trace.instant("serve_bisect", cat="serve",
+                          args={"requests": len(reqs),
+                                "depth": depth,
+                                "error": type(exc).__name__})
+            mid = len(reqs) // 2
+            return self._run_split(runner, reqs[:mid], depth + 1) + \
+                self._run_split(runner, reqs[mid:], depth + 1)
+        return [(req, res, None, False)
+                for req, res in zip(reqs, results)]
 
     def stop(self, drain=True, timeout=None):
         """Close the queue and join the loop.  With ``drain`` (default)

@@ -29,10 +29,14 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as _np
 
 from .. import telemetry
+from ..base import get_env
 from ..gluon.block import Block
 from ..ndarray.ndarray import NDArray
-from .batching import (BatchQueue, NoBucketError, Request, RequestTimeout,
-                       Scheduler, ServeError, ServerClosed, ServerOverloaded)
+from ..resilience import preempt as _preempt
+from .batching import (BatchQueue, BucketQuarantined, NoBucketError,
+                       Request, RequestTimeout, Scheduler, ServeError,
+                       ServerClosed, ServerOverloaded)
+from .breaker import BreakerBoard
 from .runner import DEFAULT_BATCH_SIZES, ModelRunner
 
 __all__ = ["ServeConfig", "Server"]
@@ -53,15 +57,32 @@ class ServeConfig:
         (single-input) or tuples of per-input shapes.  None = exact
         shapes, compile-per-new-shape (dev only).
     dtype : request arrays are cast to this dtype.
+    breaker_threshold : consecutive failed dispatches that open a
+        bucket's circuit breaker (``MXNET_SERVE_BREAKER_THRESHOLD``,
+        default 5; <= 0 disables breakers).
+    breaker_cooldown_s : quarantine seconds before the half-open trial
+        (``MXNET_SERVE_BREAKER_COOLDOWN``, default 30).
+    retry_after_s : the ``Retry-After`` the HTTP front-end advertises
+        on overload 503s (``MXNET_SERVE_RETRY_AFTER``, default 1).
     """
 
     def __init__(self, max_batch_size=8, max_wait_us=2000, queue_depth=64,
                  timeout_ms=None, batch_sizes=None, sample_shapes=None,
-                 dtype="float32"):
+                 dtype="float32", breaker_threshold=None,
+                 breaker_cooldown_s=None, retry_after_s=None):
         self.max_batch_size = int(max_batch_size)
         self.max_wait_us = int(max_wait_us)
         self.queue_depth = int(queue_depth)
         self.timeout_ms = timeout_ms
+        self.breaker_threshold = get_env(
+            "MXNET_SERVE_BREAKER_THRESHOLD", int, 5) \
+            if breaker_threshold is None else int(breaker_threshold)
+        self.breaker_cooldown_s = get_env(
+            "MXNET_SERVE_BREAKER_COOLDOWN", float, 30.0) \
+            if breaker_cooldown_s is None else float(breaker_cooldown_s)
+        self.retry_after_s = get_env(
+            "MXNET_SERVE_RETRY_AFTER", float, 1.0) \
+            if retry_after_s is None else float(retry_after_s)
         if batch_sizes is None:
             batch_sizes = [b for b in DEFAULT_BATCH_SIZES
                            if b <= self.max_batch_size]
@@ -86,6 +107,9 @@ class ServeConfig:
                     isinstance(d, int) for d in sig) else [sig])]
                 for sig in self.sample_shapes],
             "dtype": self.dtype,
+            "breaker_threshold": self.breaker_threshold,
+            "breaker_cooldown_s": self.breaker_cooldown_s,
+            "retry_after_s": self.retry_after_s,
         }
 
 
@@ -113,14 +137,49 @@ class Server:
         self._runner = runner
         self._root = root if root is not None else runner.root
         self._queue = BatchQueue(self._config.queue_depth)
+        self._breakers = BreakerBoard(
+            self._config.breaker_threshold,
+            self._config.breaker_cooldown_s) \
+            if self._config.breaker_threshold > 0 else None
+        # the scheduler (and its daemon thread) hold the server WEAKLY:
+        # a Server dropped without shutdown() must become collectable —
+        # its dispatch loop sees the dead ref and winds itself down —
+        # rather than being pinned for the process lifetime by its own
+        # thread.  The per-batch ref() read keeps the hot-swap
+        # atomicity point: one runner read per batch.
+        import weakref
+
+        ref = weakref.ref(self)
+
+        def _current_runner():
+            srv = ref()
+            return None if srv is None else srv._runner
+
         self._scheduler = Scheduler(
-            self._queue, lambda: self._runner,
+            self._queue, _current_runner,
             max_batch_size=self._config.max_batch_size,
-            max_wait_us=self._config.max_wait_us)
+            max_wait_us=self._config.max_wait_us,
+            breakers=self._breakers)
         self._scheduler.start()
         self._swap_lock = threading.Lock()
         self._httpd = None
         self._closed = False
+        # preemption (mx.resilience): SIGTERM drains this server's
+        # queue before the process exits — in-flight answers beat a
+        # dropped queue every time.  Weak for the same reason as the
+        # scheduler: the module-global hook list must not pin dead
+        # servers (a zombie drain would eat grace budget on a real
+        # preemption); stale hooks self-remove.
+        self._preempt_hook = "serve-drain-%d" % id(self)
+
+        def _drain(hook=self._preempt_hook):
+            srv = ref()
+            if srv is None or srv._closed:
+                _preempt.remove_shutdown_hook(hook)
+                return
+            srv.shutdown(drain=True, timeout=10.0)
+
+        _preempt.add_shutdown_hook(self._preempt_hook, _drain)
 
     # -- introspection ------------------------------------------------------
     @property
@@ -136,8 +195,17 @@ class Server:
         return self._runner.step
 
     def healthy(self):
-        """Liveness: the dispatch loop is running."""
+        """Liveness: the dispatch loop is running.  (An open circuit
+        breaker does NOT make the process unhealthy — other buckets
+        still serve; breaker state rides in the /healthz body.)"""
         return not self._closed and self._scheduler.alive
+
+    def breakers(self):
+        """{bucket_label: breaker state} — open breakers mean that
+        bucket's traffic is quarantined (503 + Retry-After) until the
+        cooldown's half-open trial succeeds."""
+        return self._breakers.snapshot() \
+            if self._breakers is not None else {}
 
     def ready(self):
         """Readiness: healthy AND the current runner finished warm-up
@@ -167,6 +235,9 @@ class Server:
             "runner": self._runner.stats(),
             "requests": by_result,
             "totals": serve_totals,
+            # mx.resilience serve degradation: per-bucket circuit
+            # breaker states (open = quarantined)
+            "breakers": self.breakers(),
             # mx.monitor output guard: nonfinite logits served (the
             # serve-side face of the training-health plane; counts also
             # appear in totals as serve_nonfinite_*)
@@ -205,6 +276,16 @@ class Server:
             raise ServerClosed("server is shut down")
         arrays, single = self._normalize(inputs)
         cls = self._runner.bucket_for(tuple(a.shape for a in arrays))
+        if self._breakers is not None and self._breakers.blocked(cls):
+            # fast-reject at the front door (same philosophy as the
+            # queue-depth backpressure): an open breaker means this
+            # bucket's dispatches keep failing — don't queue more.
+            # Counted like every other rejection, or the incident the
+            # breaker surfaces would read as vanishing traffic
+            if telemetry.ENABLED:
+                telemetry.SERVE_REQUESTS.labels(
+                    result="quarantined").inc()
+            raise self._breakers.quarantine_error(cls)
         timeout_ms = self._config.timeout_ms if timeout_ms is None \
             else timeout_ms
         deadline = None if timeout_ms is None \
@@ -261,6 +342,7 @@ class Server:
         default) queued requests are served first; with
         ``drain=False`` they fail fast with ``ServerClosed``."""
         self._closed = True
+        _preempt.remove_shutdown_hook(self._preempt_hook)
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd = None
@@ -313,10 +395,19 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
         srv = self.server.mx_server
         if self.path == "/healthz":
+            # liveness + the degradation picture: an open breaker is
+            # visible here (status "degraded") but the process is still
+            # alive — only a dead scheduler is a 503
             if srv.healthy():
-                self._send(200, {"status": "ok"})
+                breakers = srv.breakers()
+                degraded = any(b["state"] != "closed"
+                               for b in breakers.values())
+                self._send(200, {
+                    "status": "degraded" if degraded else "ok",
+                    "breakers": breakers})
             else:
-                self._send(503, {"status": "down"})
+                self._send(503, {"status": "down",
+                                 "breakers": srv.breakers()})
         elif self.path == "/readyz":
             ready = srv.ready()
             self._send(200 if ready else 503,
@@ -346,8 +437,9 @@ class _Handler(BaseHTTPRequestHandler):
             self.headers.get("X-Request-Id"))
         echo = (("X-Request-Id", rid),) if rid else ()
 
-        def send(code, body):
-            self._send(code, body, headers=echo)
+        def send(code, body, extra=()):
+            # X-Request-Id rides on EVERY response — success, 503, 504
+            self._send(code, body, headers=echo + tuple(extra))
 
         try:
             n = int(self.headers.get("Content-Length", 0))
@@ -364,9 +456,22 @@ class _Handler(BaseHTTPRequestHandler):
                 body = {"outputs": out.tolist()}
             body["step"] = srv.step
             send(200, body)
+        except BucketQuarantined as exc:
+            # the bucket's circuit breaker is open: tell the client
+            # when the half-open trial will admit traffic again
+            send(503, {"error": str(exc)},
+                 extra=(("Retry-After", "%d" % max(
+                     1, round(exc.retry_after or 1))),))
         except ServerOverloaded as exc:
-            send(429, {"error": str(exc)})
+            # overload is a server state, not a client error: 503 with
+            # an explicit Retry-After so well-behaved clients back off
+            # instead of hammering the full queue
+            send(503, {"error": str(exc)},
+                 extra=(("Retry-After", "%d" % max(
+                     1, round(srv.config.retry_after_s))),))
         except RequestTimeout as exc:
+            # distinct from a generic 500: the deadline expired before
+            # dispatch — the model never saw the request
             send(504, {"error": str(exc)})
         except ServerClosed as exc:
             send(503, {"error": str(exc)})

@@ -729,7 +729,8 @@ CHECKPOINT_RESTORES = counter(
 # dispatched; pad waste is the zero-fill the bucket table forced.
 SERVE_REQUESTS = counter(
     "serve_requests_total", "serving requests by outcome "
-    "(ok/rejected/timeout/error/cancelled)", ("result",))
+    "(ok/rejected/timeout/error/cancelled/quarantined/poisoned)",
+    ("result",))
 SERVE_REQUEST_SECONDS = histogram(
     "serve_request_seconds",
     "end-to-end request latency (enqueue -> result set)")
@@ -861,5 +862,40 @@ SERVE_NONFINITE_BATCHES = counter(
     "serve_nonfinite_batches_total",
     "dispatched micro-batches containing at least one nonfinite "
     "output element")
+# mx.resilience (resilience/): deterministic fault injection,
+# preemption handling, and the hardened restart supervisor — plus the
+# serve-side graceful-degradation counters (bisect/poison/breakers).
+RESILIENCE_FAULTS = counter(
+    "resilience_faults_injected_total",
+    "planned faults fired, by injection site (MXNET_FAULTS / "
+    "resilience.plan())", ("site",))
+RESILIENCE_RESTARTS = counter(
+    "resilience_restarts_total",
+    "supervisor recovery events by kind (transient / divergence / "
+    "fatal / budget_exhausted / unhealthy)", ("kind",))
+RESILIENCE_BACKOFF_SECONDS = histogram(
+    "resilience_backoff_seconds",
+    "jittered exponential backoff slept between restarts")
+RESILIENCE_PREEMPTIONS = counter(
+    "resilience_preemptions_total",
+    "preemption requests observed (SIGTERM or resilience.request())")
+RESILIENCE_EMERGENCY_SAVES = counter(
+    "resilience_emergency_saves_total",
+    "emergency checkpoints flushed during preemption shutdown")
+SERVE_POISON = counter(
+    "serve_poison_requests_total",
+    "requests whose failure was isolated by bisect retry while their "
+    "batch-mates were served independently")
+SERVE_BISECT_SPLITS = counter(
+    "serve_bisect_splits_total",
+    "failed micro-batches split in half for retry (poison isolation)")
+SERVE_BREAKER_STATE = gauge(
+    "serve_breaker_state",
+    "per-bucket circuit breaker state (0=closed 1=half-open 2=open)",
+    ("bucket",))
+SERVE_BREAKER_TRIPS = counter(
+    "serve_breaker_trips_total",
+    "circuit breaker openings (bucket quarantined after repeated "
+    "dispatch failures)", ("bucket",))
 
 start_logger()

@@ -30,7 +30,8 @@ from ..base import get_env
 from . import core, export
 
 __all__ = ["SlowStepDetector", "DeadlineMissMonitor", "observe_step",
-           "deadline_miss", "divergence", "STEP_DETECTOR",
+           "deadline_miss", "divergence", "on_divergence",
+           "remove_divergence_listener", "STEP_DETECTOR",
            "DEADLINE_MONITOR"]
 
 
@@ -147,6 +148,29 @@ def deadline_miss():
     return DEADLINE_MONITOR.miss()
 
 
+# subscribers to the divergence feed (mx.resilience's supervisor
+# registers one to roll back to the latest checkpoint); notified even
+# when trace recording is disabled — reacting to divergence must not
+# depend on the flight recorder being armed
+_DIVERGENCE_LISTENERS = []
+
+
+def on_divergence(cb):
+    """Register ``cb(extra)`` to run on every divergence event (before
+    the dump).  Returns ``cb`` so it can be removed later.  Listener
+    exceptions are swallowed — a sick observer must not take down the
+    training thread the event fired from."""
+    _DIVERGENCE_LISTENERS.append(cb)
+    return cb
+
+
+def remove_divergence_listener(cb):
+    try:
+        _DIVERGENCE_LISTENERS.remove(cb)
+    except ValueError:
+        pass
+
+
 def divergence(extra=None):
     """Dump the flight record for a training-health divergence event
     (mx.monitor: nonfinite gradients, grad-norm spike, loss
@@ -157,6 +181,11 @@ def divergence(extra=None):
     monitor ring lock's shadow; neither may stall on a multi-MB
     write.  Rate-limited per ``MXNET_TRACE_DUMP_MIN_SECONDS`` like
     every anomaly reason."""
+    for cb in list(_DIVERGENCE_LISTENERS):
+        try:
+            cb(extra)
+        except Exception:  # noqa: BLE001 - observer must not kill training
+            pass
     if not core.ENABLED:
         return None
     return export.dump_async("divergence", extra=extra)

@@ -570,6 +570,59 @@ def compile_cache_info():
                                         "in this process)"))
 
 
+def resilience_info():
+    """mx.resilience state: the armed fault plan, preemption handler,
+    recent supervisor restarts, serve breaker gauges, and the
+    injected-fault / restart / poison counters."""
+    section("Resilience")
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.resilience import inject, preempt, supervisor
+
+    plan = inject.state()
+    print("fault plan   : %s" % ("armed (%d entries)"
+                                 % len(plan["entries"])
+                                 if plan["active"] else "none"))
+    for e in plan["entries"]:
+        print("  %s@%s kind=%s fired=%d/%s"
+              % (e["site"], e["key"], e["kind"], e["fired"],
+                 e["count"] if e["count"] is not None else "inf"))
+    pre = preempt.state()
+    print("preemption   : handler %s, %s (exit code %d, hooks: %s)"
+          % ("installed" if pre["installed"] else "not installed",
+             "REQUESTED (%.1fs grace left)" % pre["grace_remaining"]
+             if pre["requested"] else "idle",
+             pre["exit_code"], ", ".join(pre["hooks"]) or "none"))
+    restarts = supervisor.recent_restarts()
+    if restarts:
+        print("restarts     : %d recorded (newest last)" % len(restarts))
+        for r in restarts[-8:]:
+            print("  step %-6d %-16s restored=%-6s backoff=%-6s %s"
+                  % (r["step"], r["kind"], r["restored_step"],
+                     "%.2fs" % r["backoff_seconds"]
+                     if r["backoff_seconds"] else "-",
+                     (r["error"] or "")[:60]))
+    else:
+        print("restarts     : none in this process")
+    breakers = {}
+    m = telemetry.get_metric("serve_breaker_state")
+    if m is not None:
+        for values, child in m._samples():
+            if values:
+                breakers[values[0]] = int(child.value)
+    if breakers:
+        names = {0: "closed", 1: "half-open", 2: "open"}
+        print("breakers     :")
+        for bucket, st in sorted(breakers.items()):
+            print("  %-24s %s" % (bucket, names.get(st, st)))
+    else:
+        print("breakers     : none registered in this process")
+    tot = {k: v for k, v in telemetry.totals(nonzero=True).items()
+           if k.startswith(("resilience_", "serve_poison",
+                            "serve_bisect", "serve_breaker"))}
+    print("telemetry    : %s" % (tot or "(no resilience_* activity in "
+                                        "this process)"))
+
+
 def env_info():
     section("Environment")
     from mxnet_tpu import config
@@ -618,13 +671,21 @@ def main():
                          "a tiny monitored model; the default), or "
                          "from a telemetry JSON snapshot / "
                          "MXNET_MONITOR_STREAM JSONL file")
+    ap.add_argument("--resilience", action="store_true",
+                    help="dump the mx.resilience plane: armed fault "
+                         "plan, preemption handler state, recent "
+                         "supervisor restarts, serve breaker states, "
+                         "injected-fault counters")
     args = ap.parse_args()
     # section flags compose: --compile-cache --serve URL prints both
     # (each skips the environment dump, all honor --telemetry)
     if args.compile_cache or args.serve or args.checkpoints or \
-            args.trainer or args.trace or args.monitor:
+            args.trainer or args.trace or args.monitor or \
+            args.resilience:
         if args.compile_cache:
             compile_cache_info()
+        if args.resilience:
+            resilience_info()
         if args.trainer:
             trainer_info()
         if args.monitor:
