@@ -11,7 +11,7 @@ SO := build/libmxtpu_native.so
 
 .PHONY: native test cpptest telemetry-smoke checkpoint-smoke serve-smoke \
 	compile-cache-smoke trainer-smoke trace-smoke monitor-smoke \
-	faults-smoke smoke-all clean
+	faults-smoke dist-faults-smoke smoke-all clean
 
 native: $(SO)
 
@@ -107,11 +107,24 @@ faults-smoke:
 	  tests/python/unittest/test_resilience.py \
 	  tests/python/unittest/test_elastic.py -q -m 'not slow'
 
+# mx.dist coordinated fault drills (2 local CPU processes over
+# tools/launch.py): rank SIGKILLed mid-step -> DistTimeout within the
+# deadline -> whole-world restart resumes bit-identically from the max
+# common committed pod step; SIGTERM to ONE rank -> every rank
+# emergency-commits the SAME step + exits with the preempt code ->
+# shrink-world (2->1) lossless resume; torn pod commit (rank killed
+# before its shard ack) never selected at restore; then the subsystem's
+# pytest suite
+dist-faults-smoke:
+	JAX_PLATFORMS=cpu python tools/dist_faults_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/python/unittest/test_dist_ft.py -q -m 'not slow'
+
 # every subsystem smoke in sequence — the one-command pre-flight before
 # a tunnel window (each target is independent; failures stop the chain)
 smoke-all: telemetry-smoke checkpoint-smoke serve-smoke \
 	compile-cache-smoke trainer-smoke trace-smoke monitor-smoke \
-	faults-smoke
+	faults-smoke dist-faults-smoke
 
 # suite summary artifact (TESTS_r{N}.json) — round-2 advisor contract
 test-report:

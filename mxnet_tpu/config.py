@@ -53,6 +53,46 @@ ENV_VARS = {
         bool, False,
         "Remove PJRT-plugin sitecustomize dirs from child import paths "
         "(CPU multi-process CI mode)."),
+    "MXNET_DIST_COLLECTIVE_TIMEOUT": (
+        float, 0.0,
+        "Deadline (seconds) on collective dispatch (gradient pushpull, "
+        "init broadcast): a dead peer raises a transient-classified "
+        "DistTimeout into the supervisor instead of hanging this rank "
+        "forever (dist/timeouts.py; 0 = no deadline).  Arm it on every "
+        "multi-host run and during tunnel windows."),
+    "MXNET_DIST_MEMBER_DIR": (
+        str, None,
+        "Shared membership directory (exported by tools/launch.py): "
+        "rank heartbeats, world generation records, and the "
+        "first-writer-wins world-stop flag live here "
+        "(dist/membership.py FileKV backend)."),
+    "MXNET_DIST_HEARTBEAT_SECONDS": (
+        float, 2.0,
+        "Interval of each rank's background membership heartbeat."),
+    "MXNET_DIST_DEAD_AFTER_SECONDS": (
+        float, 10.0,
+        "Heartbeat staleness bound: a rank silent this long is "
+        "reported dead by Membership.alive()/dead_ranks()."),
+    "MXNET_DIST_BARRIER_TIMEOUT": (
+        float, 20.0,
+        "Pod checkpoint barrier bound: how long rank 0 waits for all "
+        "ranks' shard acks before declaring the pod commit torn (and "
+        "non-zero ranks wait for the pod marker; dist/podckpt.py).  "
+        "Under a pending preemption the wait is additionally clipped "
+        "to the remaining grace budget; keep this below "
+        "MXNET_PREEMPT_GRACE_SECONDS and launch.py --term-grace so an "
+        "emergency publish can finish before the SIGKILL."),
+    "MXNET_DIST_ATTEMPT": (
+        int, 0,
+        "World launch attempt, exported by tools/launch.py --restarts; "
+        "pins the membership generation deterministically across "
+        "whole-world restarts."),
+    "MXNET_DIST_WORLD_NONCE": (
+        str, None,
+        "Unique (launcher, attempt) token exported by tools/launch.py; "
+        "Membership.join matches it exactly so a reused member dir "
+        "never hands a rank a stale previous-incarnation world "
+        "record."),
     "MXNET_PROFILER_AUTOSTART": (
         bool, False,
         "Start the profiler at import (reference env_var.md)."),

@@ -1,0 +1,604 @@
+"""Rank membership + heartbeats + world-stop signalling.
+
+Every multi-host failure mode PR 8 could not touch starts with the
+same question no rank could answer: *who is alive, and which world
+incarnation am I in?*  This module answers it with a deliberately
+small coordination surface:
+
+- a **KV backend**: a shared directory (``FileKV`` — what the CPU
+  drills and ``tools/launch.py`` use, exported as
+  ``MXNET_DIST_MEMBER_DIR``) or, on a real pod, the same
+  ``jax.distributed`` coordination service the launcher's rendezvous
+  already stands up (``CoordKV``, best-effort: the client KV API is
+  internal to jax and probed defensively);
+- a **generation number**: the world incarnation.  Rank 0 bumps it at
+  every ``join()`` (the launcher's ``MXNET_DIST_ATTEMPT`` pins it
+  deterministically across whole-world restarts), so state written by
+  a previous incarnation is never mistaken for a live peer;
+- **heartbeats**: each rank writes ``members/<gen>/<rank>`` on a
+  background daemon thread; ``alive()``/``dead_ranks()`` classify
+  peers by heartbeat freshness (``MXNET_DIST_DEAD_AFTER_SECONDS``);
+- a **stop flag**: ``signal_stop(reason, step)`` posts one
+  first-writer-wins record per generation.  Any rank's transient
+  failure or SIGTERM propagates through it; every peer polls
+  ``stop_requested()`` at its step boundary and joins the coordinated
+  shutdown (emergency pod checkpoint + preempt exit code) instead of
+  hanging in a collective against a world that is already dying.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+
+from .. import telemetry
+from ..base import MXNetError, get_env
+
+_LOG = logging.getLogger("mxnet_tpu.dist")
+
+__all__ = ["FileKV", "CoordKV", "MemKV", "Membership",
+           "default_backend", "member_dir"]
+
+
+def member_dir():
+    """The shared membership directory (``MXNET_DIST_MEMBER_DIR``,
+    exported by ``tools/launch.py``), or None."""
+    return get_env("MXNET_DIST_MEMBER_DIR", str, None)
+
+
+# ---------------------------------------------------------------------------
+# KV backends
+# ---------------------------------------------------------------------------
+
+class FileKV:
+    """Directory-backed KV store: one file per key, atomic writes
+    (write-temp + rename), mtime-free semantics — every record carries
+    its own wall-clock payload so shared-filesystem mtime skew cannot
+    misclassify a live rank.  The CPU-drill (and single-host
+    multi-process) backend."""
+
+    def __init__(self, root):
+        self.root = os.fspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key):
+        # keys use '/' for namespacing; keep it as directories
+        safe = [p for p in str(key).split("/") if p not in ("", ".", "..")]
+        return os.path.join(self.root, *safe)
+
+    def set(self, key, value, overwrite=True):
+        """Write ``value`` (a JSON-able dict).  With
+        ``overwrite=False`` the FIRST writer wins: an existing record
+        is left untouched and False is returned (the stop-flag
+        semantics).  ``os.link`` makes first-wins atomic across
+        processes — two racing ranks cannot both see "absent"."""
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                   prefix=".kv-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(value, f)
+            if overwrite:
+                os.replace(tmp, path)
+                return True
+            try:
+                os.link(tmp, path)   # atomic fail-if-exists publish
+                return True
+            except FileExistsError:
+                return False
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def get(self, key):
+        try:
+            with open(self._path(key)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            # a reader can catch a record mid-replace on exotic
+            # filesystems; absent and torn read the same: "not there"
+            return None
+
+    def delete(self, key):
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
+    def list(self, prefix):
+        """Immediate child key names under ``prefix`` (not recursive)."""
+        d = self._path(prefix)
+        try:
+            return sorted(n for n in os.listdir(d)
+                          if not n.startswith("."))
+        except OSError:
+            return []
+
+    def delete_prefix(self, prefix):
+        """Remove every key under ``prefix`` (best-effort)."""
+        import shutil
+
+        shutil.rmtree(self._path(prefix), ignore_errors=True)
+
+
+class MemKV:
+    """In-process dict backend — the single-process fallback so every
+    Membership code path is drivable in unit tests without a world."""
+
+    def __init__(self):
+        self._data = {}
+        self._lock = threading.Lock()
+
+    def set(self, key, value, overwrite=True):
+        with self._lock:
+            if not overwrite and key in self._data:
+                return False
+            self._data[str(key)] = json.loads(json.dumps(value))
+            return True
+
+    def get(self, key):
+        with self._lock:
+            return self._data.get(str(key))
+
+    def delete(self, key):
+        with self._lock:
+            self._data.pop(str(key), None)
+
+    def list(self, prefix):
+        p = str(prefix).rstrip("/") + "/"
+        with self._lock:
+            return sorted({k[len(p):].split("/")[0]
+                           for k in self._data if k.startswith(p)})
+
+    def delete_prefix(self, prefix):
+        p = str(prefix).rstrip("/") + "/"
+        with self._lock:
+            for k in [k for k in self._data if k.startswith(p)]:
+                del self._data[k]
+
+
+class CoordKV:
+    """KV over the live ``jax.distributed`` coordination service — the
+    same rendezvous ``tools/launch.py`` already stands up, so a TPU pod
+    needs no extra infrastructure.  The client API is internal to jax
+    (``key_value_set``/``key_value_try_get``/``key_value_dir_get``)
+    and probed defensively: construction raises ``MXNetError`` when
+    the service (or the API surface) is unavailable, and callers fall
+    back to ``FileKV``/``MemKV``."""
+
+    def __init__(self, client=None):
+        if client is None:
+            try:
+                from jax._src import distributed as _jd
+
+                client = _jd.global_state.client
+            except Exception as exc:  # pragma: no cover - jax internals
+                raise MXNetError(
+                    "jax.distributed coordination client unavailable: "
+                    "%s" % (exc,))
+        if client is None:
+            raise MXNetError("jax.distributed is not initialized "
+                             "(no coordination service to back CoordKV)")
+        # key_value_delete is load-bearing, not optional: the
+        # coordinator KV is write-once per key, so heartbeat refreshes
+        # are delete-then-set — without it every beat() would fail
+        for api in ("key_value_set", "key_value_try_get",
+                    "key_value_delete"):
+            if not hasattr(client, api):  # pragma: no cover - old jax
+                raise MXNetError(
+                    "jax coordination client lacks %s; use the "
+                    "MXNET_DIST_MEMBER_DIR FileKV backend" % api)
+        self._client = client
+
+    def set(self, key, value, overwrite=True):
+        blob = json.dumps(value)
+        if not overwrite and self.get(key) is not None:
+            return False
+        try:
+            if overwrite:
+                # write-once KV: refresh heartbeat-style keys by
+                # delete-then-set
+                try:
+                    self._client.key_value_delete(str(key))
+                except Exception:  # noqa: BLE001 - absent key
+                    pass
+            self._client.key_value_set(str(key), blob)
+            return True
+        except Exception as exc:
+            if not overwrite and self.get(key) is not None:
+                # lost the first-writer race: the winner's record
+                # stands — this is the stop-flag contract, and raising
+                # here would abort the loser's coordinated shutdown
+                return False
+            raise MXNetError(  # pragma: no cover - service loss
+                "CoordKV set(%r) failed: %s" % (key, exc))
+
+    def get(self, key):
+        try:
+            blob = self._client.key_value_try_get(str(key))
+        except Exception:  # noqa: BLE001 - absent key surfaces as error
+            return None
+        try:
+            return json.loads(blob)
+        except (TypeError, ValueError):
+            return None
+
+    def delete(self, key):
+        if hasattr(self._client, "key_value_delete"):
+            try:
+                self._client.key_value_delete(str(key))
+            except Exception:  # noqa: BLE001
+                pass
+
+    def list(self, prefix):
+        if not hasattr(self._client, "key_value_dir_get"):
+            return []
+        try:
+            pairs = self._client.key_value_dir_get(
+                str(prefix).rstrip("/") + "/")
+        except Exception:  # noqa: BLE001
+            return []
+        p = str(prefix).rstrip("/") + "/"
+        return sorted({str(k)[len(p):].split("/")[0]
+                       for k, _v in pairs if str(k).startswith(p)})
+
+    def delete_prefix(self, prefix):
+        if hasattr(self._client, "key_value_delete"):
+            try:  # the coordinator API deletes directories by prefix
+                self._client.key_value_delete(
+                    str(prefix).rstrip("/") + "/")
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def default_backend():
+    """Pick the membership backend for this process: the launcher's
+    shared directory when exported, else the live jax.distributed
+    coordination service, else an in-process MemKV (world of one)."""
+    d = member_dir()
+    if d:
+        return FileKV(d)
+    try:
+        return CoordKV()
+    except MXNetError:
+        return MemKV()
+
+
+# ---------------------------------------------------------------------------
+# membership
+# ---------------------------------------------------------------------------
+
+class Membership:
+    """One rank's view of the world (see module docstring).
+
+    Parameters
+    ----------
+    kv : backend (default :func:`default_backend`).
+    rank / world_size : this process's coordinates (default: the
+        launcher's ``MXNET_DIST_RANK`` / ``MXNET_DIST_NUM_WORKERS``,
+        else a world of one).
+    heartbeat : seconds between background heartbeats (default
+        ``MXNET_DIST_HEARTBEAT_SECONDS``); 0 disables the thread
+        (``beat()`` still works for drills).
+    dead_after : heartbeat staleness bound for ``alive()`` (default
+        ``MXNET_DIST_DEAD_AFTER_SECONDS``).
+    """
+
+    def __init__(self, kv=None, rank=None, world_size=None,
+                 heartbeat=None, dead_after=None):
+        self.kv = kv if kv is not None else default_backend()
+        self.rank = get_env("MXNET_DIST_RANK", int, 0) \
+            if rank is None else int(rank)
+        self.world_size = get_env("MXNET_DIST_NUM_WORKERS", int, 1) \
+            if world_size is None else int(world_size)
+        self.heartbeat_seconds = get_env(
+            "MXNET_DIST_HEARTBEAT_SECONDS", float, 2.0) \
+            if heartbeat is None else float(heartbeat)
+        self.dead_after = get_env(
+            "MXNET_DIST_DEAD_AFTER_SECONDS", float, 10.0) \
+            if dead_after is None else float(dead_after)
+        self.generation = None
+        self._hb_thread = None
+        self._hb_stop = threading.Event()
+        self._step = None
+        self._left = False
+        self._barrier_seq = 0             # call-order sequence in keys
+        self._barrier_history = deque()   # own last 2 barrier prefixes
+        self._stop_cache = None           # posted flags never retract
+        self._stop_polled_at = None
+
+    # -- join / generation ---------------------------------------------------
+    def join(self, start_heartbeat=True, timeout=60.0):
+        """Enter the world: resolve the generation number, write the
+        first heartbeat, start the heartbeat thread.  Rank 0 bumps the
+        stored generation (``MXNET_DIST_ATTEMPT`` pins the floor
+        across launcher restarts) and stamps the world record with the
+        launcher's ``MXNET_DIST_WORLD_NONCE``; other ranks wait for a
+        record carrying THEIR nonce — an exact-match handshake, so a
+        reused member directory can never hand a rank the previous
+        incarnation's record (a ``>=`` generation floor alone would
+        accept it and split the world across two generations).
+        Without a launcher nonce, ranks fall back to the generation
+        floor.  Returns the generation."""
+        attempt = get_env("MXNET_DIST_ATTEMPT", int, None)
+        nonce = get_env("MXNET_DIST_WORLD_NONCE", str, None)
+        if self.rank == 0:
+            prev = self.kv.get("world")
+            prev_gen = -1 if prev is None else int(prev.get(
+                "generation", -1))
+            gen = prev_gen + 1 if attempt is None \
+                else max(prev_gen + 1, int(attempt))
+            self.kv.set("world", {
+                "generation": gen, "world_size": self.world_size,
+                "nonce": nonce, "coordinator_pid": os.getpid(),
+                "wall": time.time()})
+            self.generation = gen
+        else:
+            deadline = time.monotonic() + float(timeout)
+            floor = -1 if attempt is None else int(attempt)
+            while True:
+                rec = self.kv.get("world")
+                if rec is not None and (
+                        rec.get("nonce") == nonce if nonce is not None
+                        else int(rec.get("generation", -1)) >= floor):
+                    self.generation = int(rec["generation"])
+                    self.world_size = int(rec.get("world_size",
+                                                  self.world_size))
+                    break
+                if time.monotonic() >= deadline:
+                    raise MXNetError(
+                        "membership join timed out after %.0fs waiting "
+                        "for rank 0's world record (%s)"
+                        % (timeout, "nonce %s" % nonce
+                           if nonce is not None
+                           else "generation >= %d" % floor))
+                time.sleep(0.05)
+        self._left = False
+        # barrier sequence restarts with the incarnation: every rank
+        # of a generation counts its (identically-ordered) barriers
+        # from the same origin
+        self._barrier_seq = 0
+        self._barrier_history.clear()
+        self._stop_cache = None
+        self._stop_polled_at = None
+        self.beat()
+        if start_heartbeat and self.heartbeat_seconds > 0:
+            self._start_heartbeat()
+        return self.generation
+
+    def _require_joined(self):
+        if self.generation is None:
+            raise MXNetError("Membership.join() first")
+
+    # -- heartbeats ----------------------------------------------------------
+    def _member_key(self, rank):
+        return "members/%d/%d" % (self.generation, int(rank))
+
+    def beat(self, step=None):
+        """Write this rank's heartbeat record now.  Best-effort: a
+        failing KV write (lost shared FS, flaky coordinator) makes
+        this rank LOOK dead to peers — which is the correct signal —
+        but must never raise into the training loop and abort a
+        healthy run over bookkeeping."""
+        self._require_joined()
+        if step is not None:
+            self._step = int(step)
+        self._last_beat = time.monotonic()
+        try:
+            self.kv.set(self._member_key(self.rank), {
+                "rank": self.rank, "pid": os.getpid(),
+                "wall": time.time(), "step": self._step,
+                "status": "left" if self._left else "alive"})
+        except Exception as exc:  # noqa: BLE001 - see docstring
+            _LOG.warning("membership heartbeat write failed: %s", exc)
+
+    def note_step(self, step):
+        """Record training progress cheaply: the step lands in the
+        NEXT heartbeat; a write happens now only when the background
+        thread is off or the last beat is already stale (the
+        supervisor calls this every step — it must not turn into one
+        filesystem write per training step)."""
+        self._step = int(step)
+        if self.heartbeat_seconds <= 0 or time.monotonic() - \
+                getattr(self, "_last_beat", 0.0) >= self.heartbeat_seconds:
+            self.beat()
+
+    def _start_heartbeat(self):
+        if self._hb_thread is not None and self._hb_thread.is_alive():
+            return
+        self._hb_stop.clear()
+
+        def loop():
+            while not self._hb_stop.wait(self.heartbeat_seconds):
+                try:
+                    self.beat()
+                except Exception:  # noqa: BLE001 - lost FS must not kill
+                    pass
+
+        self._hb_thread = threading.Thread(
+            target=loop, daemon=True, name="mx-dist-heartbeat")
+        self._hb_thread.start()
+
+    def stop_heartbeat(self):
+        self._hb_stop.set()
+        t = self._hb_thread
+        if t is not None:
+            t.join(timeout=max(1.0, self.heartbeat_seconds * 2))
+        self._hb_thread = None
+
+    # -- liveness ------------------------------------------------------------
+    def members(self):
+        """{rank: record} for every heartbeat of this generation."""
+        self._require_joined()
+        out = {}
+        for name in self.kv.list("members/%d" % self.generation):
+            try:
+                r = int(name)
+            except ValueError:
+                continue
+            rec = self.kv.get(self._member_key(r))
+            if rec is not None:
+                out[r] = rec
+        return out
+
+    def alive(self, max_age=None):
+        """Sorted ranks whose heartbeat is fresh (within ``max_age``
+        seconds, default ``dead_after``) and not marked left."""
+        max_age = self.dead_after if max_age is None else float(max_age)
+        now = time.time()
+        return sorted(
+            r for r, rec in self.members().items()
+            if rec.get("status") != "left"
+            and now - float(rec.get("wall", 0.0)) <= max_age)
+
+    def dead_ranks(self, max_age=None):
+        """Expected-world ranks with no fresh heartbeat."""
+        live = set(self.alive(max_age))
+        return [r for r in range(self.world_size) if r not in live]
+
+    def leave(self, reason="shutdown"):
+        """Mark this rank as cleanly departed and stop heartbeating."""
+        if self.generation is None or self._left:
+            return
+        self._left = True
+        self.stop_heartbeat()
+        try:
+            self.beat()
+        except Exception:  # noqa: BLE001 - best-effort on the way out
+            pass
+        if telemetry.ENABLED:
+            telemetry.DIST_LEAVES.labels(reason=reason).inc()
+
+    # -- step barrier --------------------------------------------------------
+    def barrier(self, name, timeout=None):
+        """Block until every rank of this generation reaches the
+        ``name`` barrier, under the collective deadline: a dead peer
+        raises :class:`~mxnet_tpu.dist.DistTimeout` instead of hanging
+        forever, and a pending world-stop flag posted by another rank
+        aborts the wait immediately (the poster will never arrive).
+
+        This is the lockstep point of the CPU fault drills — the
+        environments where XLA's own multi-process collectives are
+        unavailable — and doubles as an explicit step-boundary sync on
+        real pods.  ``timeout`` defaults to the armed
+        ``MXNET_DIST_COLLECTIVE_TIMEOUT`` (0/None waits forever).
+
+        Every rank must issue its barriers in the same order; an
+        internal per-membership sequence number joins the key, so a
+        REUSED name (``barrier("step")`` every iteration — the natural
+        call pattern) still synchronizes each call independently
+        instead of sailing through on the previous call's records.
+
+        Records are swept two barriers behind: by the time this rank
+        ENTERS barrier k every rank has entered k-1 — which means
+        every rank has PASSED k-2 and its records can go.  A long run
+        therefore keeps at most two barriers' worth of keys instead of
+        one per step forever."""
+        from .timeouts import (DistTimeout, collective_timeout,
+                               run_with_deadline)
+
+        self._require_joined()
+        self._barrier_seq += 1
+        prefix = "barrier/%d/%06d-%s" % (self.generation,
+                                         self._barrier_seq, name)
+        self.kv.set("%s/%d" % (prefix, self.rank),
+                    {"rank": self.rank, "wall": time.time()})
+        self._barrier_history.append(prefix)
+        if len(self._barrier_history) > 2:
+            self.kv.delete_prefix(self._barrier_history.popleft())
+        if timeout is None:
+            timeout = collective_timeout()
+
+        def wait():
+            while True:
+                if len(self.kv.list(prefix)) >= self.world_size:
+                    return True
+                stop = self.stop_requested()
+                if stop is not None and stop.get("rank") != self.rank:
+                    raise DistTimeout(
+                        "barrier %r abandoned: rank %s posted a world "
+                        "stop (%s) and will never arrive"
+                        % (name, stop.get("rank"), stop.get("reason")),
+                        site="barrier")
+                time.sleep(0.02)
+
+        return run_with_deadline(wait, site="barrier", timeout=timeout)
+
+    # -- coordinated stop ----------------------------------------------------
+    def _stop_key(self):
+        return "stop/%d" % self.generation
+
+    def signal_stop(self, reason, step=None, error=None):
+        """Post the world-stop flag for this generation (first writer
+        wins; re-posts are no-ops).  Returns the flag actually in
+        effect — possibly a peer's earlier one."""
+        self._require_joined()
+        rec = {"reason": str(reason), "rank": self.rank,
+               "step": None if step is None else int(step),
+               "error": None if error is None else str(error)[:500],
+               "wall": time.time()}
+        first = self.kv.set(self._stop_key(), rec, overwrite=False)
+        if first and telemetry.ENABLED:
+            telemetry.DIST_WORLD_STOPS.labels(reason=str(reason)).inc()
+        from .. import trace
+
+        if first:
+            trace.instant("dist_world_stop", cat="dist", args=rec)
+        return self.stop_requested()
+
+    def stop_requested(self):
+        """The generation's stop flag (dict), or None.  Reads the KV
+        every call — use :meth:`poll_stop` on per-step hot paths."""
+        if self.generation is None:
+            return None
+        flag = self.kv.get(self._stop_key())
+        if flag is not None:
+            self._stop_cache = flag   # a posted flag never retracts
+        return flag
+
+    def poll_stop(self, interval=None):
+        """Throttled :meth:`stop_requested` for the supervisor's
+        per-step poll: a posted flag is cached forever (it never
+        retracts within a generation), a negative answer for
+        ``interval`` seconds (default: the heartbeat cadence) — so a
+        sub-millisecond training step costs a dict probe, not a
+        filesystem read or coordinator RPC, at the price of up to one
+        heartbeat interval of stop latency the membership design
+        already accepts elsewhere."""
+        if self._stop_cache is not None:
+            return self._stop_cache
+        interval = self.heartbeat_seconds if interval is None \
+            else float(interval)
+        now = time.monotonic()
+        if self._stop_polled_at is not None and interval > 0 \
+                and now - self._stop_polled_at < interval:
+            return None
+        self._stop_polled_at = now
+        return self.stop_requested()
+
+    def clear_stop(self):
+        """Drills only: retract the flag (a real stop never is)."""
+        self._require_joined()
+        self.kv.delete(self._stop_key())
+
+    # -- introspection -------------------------------------------------------
+    def state(self):
+        """Snapshot for ``tools/diagnose.py --dist``."""
+        if self.generation is None:
+            return {"joined": False, "rank": self.rank,
+                    "world_size": self.world_size}
+        return {"joined": True, "rank": self.rank,
+                "world_size": self.world_size,
+                "generation": self.generation,
+                "alive": self.alive(),
+                "dead": self.dead_ranks(),
+                "stop": self.stop_requested(),
+                "heartbeat_seconds": self.heartbeat_seconds,
+                "dead_after": self.dead_after}

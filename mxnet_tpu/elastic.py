@@ -67,6 +67,11 @@ class CheckpointManager(_CheckpointManager):
         super().__init__(root, max_keep=max_keep, prefix=prefix, **kwargs)
 
 
+# one DeprecationWarning per process (not per construction: a restart
+# loop re-building its runner must not spam the log; tests reset this)
+_FTR_WARNED = False
+
+
 class FaultTolerantRunner(Supervisor):
     """DEPRECATED alias of ``mx.resilience.Supervisor`` keeping the old
     constructor and semantics: a LIFETIME restart budget and no
@@ -75,18 +80,22 @@ class FaultTolerantRunner(Supervisor):
     immediately instead of burning restarts), bounded health probes,
     contained ``on_failure`` callbacks (a raising callback no longer
     masks the original training error), preemption polling, and a
-    flight-record dump per restart."""
+    flight-record dump per restart.  Emits ``DeprecationWarning`` once
+    per process."""
 
     def __init__(self, trainer, manager, checkpoint_every=50,
                  max_restarts=3, on_failure=None):
-        import warnings
+        global _FTR_WARNED
+        if not _FTR_WARNED:
+            _FTR_WARNED = True
+            import warnings
 
-        warnings.warn(
-            "elastic.FaultTolerantRunner is deprecated; use "
-            "mxnet_tpu.resilience.Supervisor (adds backoff with "
-            "jitter, sliding restart windows, preemption handling, "
-            "and restore-on-divergence)",
-            DeprecationWarning, stacklevel=2)
+            warnings.warn(
+                "elastic.FaultTolerantRunner is deprecated; use "
+                "mxnet_tpu.resilience.Supervisor (adds backoff with "
+                "jitter, sliding restart windows, preemption handling, "
+                "and restore-on-divergence)",
+                DeprecationWarning, stacklevel=2)
         super().__init__(
             trainer, manager, checkpoint_every=checkpoint_every,
             max_restarts=max_restarts, restart_window=0,

@@ -68,6 +68,23 @@ def plan_buckets(sizes_dtypes, bucket_bytes=None):
     return plan
 
 
+def _deadline(fn, site):
+    """Run one collective phase under ``MXNET_DIST_COLLECTIVE_TIMEOUT``
+    (mx.dist): a dead peer raises a transient-classified
+    ``DistTimeout`` instead of hanging this rank forever, and the
+    trace watchdog is armed around the wait.  Unarmed (the default, and
+    always in a world of one) this is a plain call."""
+    if jax.process_count() == 1:
+        return fn()
+    from ..dist import timeouts as _dt
+
+    timeout = _dt.collective_timeout()
+    if not timeout or timeout <= 0:
+        with _trace.watchdog.watch(site):
+            return fn()
+    return _dt.run_with_deadline(fn, site=site, timeout=timeout)
+
+
 class CollectiveKVStore(KVStoreBase):
     def __init__(self, mode="dist_sync", **kwargs):
         self._mode = mode
@@ -200,7 +217,9 @@ class CollectiveKVStore(KVStoreBase):
                 host = _np.asarray(v._data)
                 tel_on = _tel.ENABLED
                 t0 = _time.perf_counter() if tel_on else 0.0
-                data = multihost_utils.broadcast_one_to_all(host)
+                data = _deadline(
+                    lambda: multihost_utils.broadcast_one_to_all(host),
+                    "broadcast")
                 if tel_on:
                     _tel.COLLECTIVE_CALLS.labels(op="broadcast").inc()
                     _tel.COLLECTIVE_BYTES.labels(op="broadcast").inc(
@@ -250,8 +269,13 @@ class CollectiveKVStore(KVStoreBase):
             # mx.resilience drill site: the collective-failure drill
             # fires here, before any bucket program launches
             _inject.fire("collective")
-            self.pushpull(list(keys), list(values), out=out,
-                          priority=priority)
+            # mx.dist deadline: the gradient all-reduce is where a dead
+            # peer strands this rank — before any optimizer state has
+            # mutated, which is why DistTimeout marks the state clean
+            _deadline(
+                lambda: self.pushpull(list(keys), list(values), out=out,
+                                      priority=priority),
+                "pushpull_all")
 
     def set_optimizer(self, optimizer):
         raise MXNetError(

@@ -299,12 +299,22 @@ class Supervisor:
     restore_on_divergence : roll back to the latest checkpoint when
         mx.monitor reports divergence (grad spike / nonfinite / loss
         NaN); counts against the same restart budget.
+    membership : an ``mx.dist.Membership`` arms **dist mode**: the
+        supervisor heartbeats its step, polls the world-stop flag at
+        every step boundary, and turns any rank's transient failure or
+        SIGTERM into a COORDINATED stop — post the flag, stop at the
+        boundary, emergency-checkpoint through the (pod) manager, and
+        exit with the preempt code so the launcher relaunches the
+        whole world.  Local restore-and-retry is disabled (peers
+        cannot rejoin a collective this rank replays alone); the
+        restart loop moves up to ``tools/launch.py --restarts``.
     """
 
     def __init__(self, trainer, manager, checkpoint_every=50,
                  max_restarts=None, restart_window=None, backoff=None,
                  on_failure=None, health_timeout=None,
-                 exit_on_preempt=False, restore_on_divergence=False):
+                 exit_on_preempt=False, restore_on_divergence=False,
+                 membership=None):
         self._trainer = trainer
         self._manager = manager
         self._every = max(1, int(checkpoint_every))
@@ -321,11 +331,13 @@ class Supervisor:
             if health_timeout is None else health_timeout
         self._exit_on_preempt = bool(exit_on_preempt)
         self._restore_on_divergence = bool(restore_on_divergence)
+        self._membership = membership
         self._divergence_pending = None
         self._state_suspect = False  # failed mid-step, no ckpt to trust
         self.restarts = 0            # transient-failure restarts
         self.divergence_restores = 0
         self.preempted = False
+        self.world_stopped = None    # dist mode: the stop flag we obeyed
         self.emergency_checkpoint = None
 
     # -- resume -------------------------------------------------------------
@@ -402,19 +414,38 @@ class Supervisor:
             from ..trace import anomaly
 
             listener = anomaly.on_divergence(self._on_divergence)
+        if self._membership is not None \
+                and self._membership.generation is None:
+            self._membership.join()
         try:
             latest = self._manager.latest_step()
             if latest is not None and latest >= step:
                 step = self._resume() + 1
             while step < num_steps:
                 if preempt.requested():
+                    # dist mode: SIGTERM on THIS host preempts the
+                    # whole world — post the flag before saving so
+                    # peers reach their own step boundary (or their
+                    # collective deadline) and flush the SAME step
+                    if self._membership is not None:
+                        self.world_stopped = \
+                            self._membership.signal_stop(
+                                "preempt", step - 1)
                     self.preempted = True
                     self._emergency(step - 1)
+                    if self._membership is not None:
+                        self._membership.leave("preempt")
                     if self._exit_on_preempt:
                         import sys
 
                         sys.exit(preempt.exit_code())
                     return losses
+                if self._membership is not None:
+                    self._membership.note_step(step)
+                    stop = self._membership.poll_stop()
+                    if stop is not None:
+                        return self._obey_world_stop(stop, step - 1,
+                                                     losses)
                 if self._divergence_pending is not None:
                     info, self._divergence_pending = \
                         self._divergence_pending, None
@@ -437,6 +468,8 @@ class Supervisor:
                 except Exception as exc:
                     step, losses = self._handle_failure(
                         exc, step, start_step, losses, budget)
+                    if step is None:   # dist mode: world stopping
+                        return losses
             return losses
         finally:
             if listener is not None:
@@ -444,13 +477,66 @@ class Supervisor:
 
                 anomaly.remove_divergence_listener(listener)
 
+    def _obey_world_stop(self, info, last_done, losses):
+        """Dist mode: a peer (or this rank, below) posted the world-
+        stop flag.  Stop at the boundary, emergency-checkpoint through
+        the pod manager (every obeying rank saves its last completed
+        step; the pod marker only lands for a step ALL ranks flushed,
+        so restore is consistent by construction), leave membership,
+        and exit with the preempt code for the launcher to relaunch."""
+        self.preempted = True
+        self.world_stopped = dict(info or {})
+        _record_restart("world_stop", max(0, last_done), None)
+        _LOG.warning(
+            "world stop (reason=%s from rank %s at step %s): stopping "
+            "at step boundary %d, flushing emergency checkpoint",
+            self.world_stopped.get("reason"),
+            self.world_stopped.get("rank"),
+            self.world_stopped.get("step"), last_done)
+        self._emergency(last_done)
+        if self._membership is not None:
+            self._membership.leave("world_stop")
+        if self._exit_on_preempt:
+            import sys
+
+            sys.exit(preempt.exit_code())
+        return losses
+
+    def _world_failure(self, exc, step, losses):
+        """Dist mode transient failure on THIS rank: propagate through
+        the stop flag and join the coordinated shutdown.  A failure
+        marked state-clean (``DistTimeout``: the collective deadline
+        fires before any optimizer state mutates) may still emergency-
+        checkpoint the last completed step; anything else is suspect
+        and saves nothing — peers' shards plus the pod max-common rule
+        keep the restore consistent either way."""
+        self.restarts += 1
+        if not getattr(exc, "mx_state_clean", False):
+            self._state_suspect = True
+        info = None
+        if self._membership is not None:
+            info = self._membership.signal_stop(
+                "failure", step - 1,
+                error="%s: %s" % (type(exc).__name__, exc))
+        return None, self._obey_world_stop(
+            info or {"reason": "failure", "rank": None, "step": step - 1},
+            step - 1, losses)
+
     def _handle_failure(self, exc, step, start_step, losses, budget):
         kind = classify(exc)
         _safe_on_failure(self._on_failure, step, exc)
         trace.dump_async("restart", extra={
             "step": int(step), "classified": kind,
             "error": "%s: %s" % (type(exc).__name__, exc)})
+        if kind == "transient" and self._membership is not None:
+            return self._world_failure(exc, step, losses)
         if kind == "fatal":
+            if self._membership is not None:
+                # peers must not wait out their collective deadline to
+                # learn the world is dead — flag it before raising
+                self._membership.signal_stop(
+                    "failure", step - 1,
+                    error="%s: %s" % (type(exc).__name__, exc))
             _record_restart("fatal", step, exc)
             raise MXNetError(
                 "fatal training error at step %d (%s — not retried: "

@@ -11,9 +11,14 @@ class gets a classic three-state breaker:
   quarantined: submissions and dispatches are rejected immediately
   (HTTP 503 + ``Retry-After``) for ``cooldown`` seconds.  Other
   buckets are untouched.
-- **half-open** — after the cooldown ONE trial dispatch is let
-  through; success closes the breaker, failure re-opens it for a
-  fresh cooldown.
+- **half-open** — after the cooldown EXACTLY ONE trial dispatch is
+  admitted; while it is in flight every other submit/dispatch keeps
+  fast-rejecting (a thundering herd re-probing a sick bucket
+  concurrently is indistinguishable from no breaker at all).  Success
+  closes the breaker, failure re-opens it for a fresh cooldown, and a
+  trial that never resolves (its dispatch path died without recording
+  an outcome) self-heals: a new trial is allowed one cooldown after
+  the stuck one was admitted.
 
 State is surfaced in ``/healthz`` and ``/statz`` (and the
 ``serve_breaker_state`` gauge: 0 closed / 1 half-open / 2 open), so an
@@ -54,10 +59,13 @@ class CircuitBreaker:
         self._state = CLOSED
         self._failures = 0          # consecutive, while closed
         self._opened_at = None
+        self._trial_at = None       # half-open trial admission time
         self.trips = 0              # lifetime closed/half-open -> open
 
     def _set_state(self, state):
         self._state = state
+        if state != HALF_OPEN:
+            self._trial_at = None
         if telemetry.ENABLED and self._label is not None:
             telemetry.SERVE_BREAKER_STATE.labels(
                 bucket=self._label).set(_STATE_GAUGE[state])
@@ -67,25 +75,47 @@ class CircuitBreaker:
                 now - self._opened_at >= self.cooldown:
             self._set_state(HALF_OPEN)
 
+    def _trial_inflight_locked(self, now):
+        # a trial that was admitted but whose outcome never landed
+        # (its dispatch path died) expires after one cooldown, so the
+        # bucket cannot be stuck half-open-and-rejecting forever
+        return self._trial_at is not None and \
+            now - self._trial_at < self.cooldown
+
     def blocked(self):
-        """Non-mutating probe for submit-time fast-reject: True only
-        while OPEN with cooldown remaining.  (Half-open admits traffic
-        so the trial dispatch can happen.)"""
+        """Non-mutating probe for submit-time fast-reject: True while
+        OPEN with cooldown remaining, and while the half-open trial is
+        in flight (only the single trial may probe the bucket; every
+        other concurrent request keeps fast-rejecting)."""
         with self._lock:
-            self._maybe_half_open_locked(self._clock())
-            return self._state == OPEN
+            now = self._clock()
+            self._maybe_half_open_locked(now)
+            if self._state == OPEN:
+                return True
+            return self._state == HALF_OPEN and \
+                self._trial_inflight_locked(now)
 
     def allow(self):
-        """Dispatch-time gate.  CLOSED/HALF_OPEN admit (the half-open
-        admission IS the trial); OPEN rejects until the cooldown
-        elapses."""
+        """Dispatch-time gate.  CLOSED admits; OPEN rejects until the
+        cooldown elapses; HALF_OPEN admits EXACTLY ONE caller — the
+        first ``allow()`` after the cooldown is the trial, and every
+        other caller is rejected until that trial resolves via
+        ``record_success``/``record_failure``."""
         with self._lock:
-            self._maybe_half_open_locked(self._clock())
-            return self._state != OPEN
+            now = self._clock()
+            self._maybe_half_open_locked(now)
+            if self._state == OPEN:
+                return False
+            if self._state == HALF_OPEN:
+                if self._trial_inflight_locked(now):
+                    return False
+                self._trial_at = now   # this caller IS the trial
+            return True
 
     def record_success(self):
         with self._lock:
             self._failures = 0
+            self._trial_at = None
             if self._state != CLOSED:
                 self._set_state(CLOSED)
                 self._opened_at = None
@@ -98,6 +128,7 @@ class CircuitBreaker:
             self._maybe_half_open_locked(now)
             if self._state == HALF_OPEN:
                 tripped = True          # the trial failed: re-open
+                self._trial_at = None
             else:
                 self._failures += 1
                 tripped = self._state == CLOSED and \
@@ -122,10 +153,13 @@ class CircuitBreaker:
 
     def state(self):
         with self._lock:
-            self._maybe_half_open_locked(self._clock())
+            now = self._clock()
+            self._maybe_half_open_locked(now)
             return {
                 "state": self._state,
                 "consecutive_failures": self._failures,
+                "trial_inflight": self._state == HALF_OPEN
+                and self._trial_inflight_locked(now),
                 "trips": self.trips,
                 "retry_after_seconds": round(
                     max(0.0, self.cooldown -

@@ -897,5 +897,23 @@ SERVE_BREAKER_TRIPS = counter(
     "serve_breaker_trips_total",
     "circuit breaker openings (bucket quarantined after repeated "
     "dispatch failures)", ("bucket",))
+# mx.dist (dist/): coordinated multi-host fault tolerance —
+# collective deadlines, membership, pod-consistent checkpoints.
+DIST_COLLECTIVE_TIMEOUTS = counter(
+    "dist_collective_timeouts_total",
+    "collectives that missed MXNET_DIST_COLLECTIVE_TIMEOUT (a peer "
+    "rank unreachable), by site", ("site",))
+DIST_WORLD_STOPS = counter(
+    "dist_world_stops_total",
+    "coordinated world-stop flags this rank posted first, by reason "
+    "(failure / preempt / drill)", ("reason",))
+DIST_POD_COMMITS = counter(
+    "dist_pod_commits_total",
+    "pod-level checkpoint barrier outcomes (ok = POD marker "
+    "published after all ranks acked; timeout = torn pod commit, "
+    "step unselectable at restore)", ("result",))
+DIST_LEAVES = counter(
+    "dist_member_leaves_total",
+    "clean membership departures by reason", ("reason",))
 
 start_logger()

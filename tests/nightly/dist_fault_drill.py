@@ -1,0 +1,166 @@
+"""Multi-process mx.dist fault-drill worker (ISSUE-10 acceptance).
+
+One rank of a coordinated-fault drill, launched N-wide by
+``tools/launch.py`` (which exports ``MXNET_DIST_RANK`` /
+``MXNET_DIST_NUM_WORKERS`` / ``MXNET_DIST_MEMBER_DIR`` /
+``MXNET_DIST_ATTEMPT``).  Training is deterministic (fixed init,
+batch = fn(step), every rank computes the same replicated state), and
+each step locksteps the world through ``Membership.barrier`` placed
+where the gradient all-reduce sits — between backward and the
+optimizer update — so a dead peer surfaces as ``DistTimeout`` BEFORE
+any state mutates, exactly like the real collective deadline.  (This
+container's XLA cannot run multi-process collectives on CPU; the
+barrier is the drillable stand-in for the psum, and the SAME
+supervisor/membership/pod-checkpoint protocol runs either way.)
+
+Fault injections (all no-ops on relaunch attempts > 0):
+
+- ``--die-at K --die-rank R``: rank R SIGKILLs itself at step K,
+  after backward but BEFORE the barrier — peers hang at the barrier
+  until the collective deadline rescues them (the rank-kill drill);
+- ``--torn-rank R --torn-at-save K``: rank R arms
+  ``checkpoint_marker@K:abort`` so its K-th shard commit hard-exits
+  before the COMMITTED marker — the pod marker for that step must
+  never land (the torn-pod-commit drill);
+- a real SIGTERM (sent by the driver to ONE rank's pid, published
+  under ``--pid-dir``) drills coordinated preemption.
+
+Each rank prints machine-checkable lines the drivers assert on::
+
+    rank 0 resume_from 3
+    rank 1 PREEMPT step=5 exit=85
+    rank 0 FINAL 1.23456789
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, resilience
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.resilience import GluonStepLoop, Supervisor, preempt
+
+SEED = 13
+
+
+def batch_for(step, sleep=0.0):
+    if sleep:
+        time.sleep(sleep)
+    rs = np.random.RandomState(500 + step)
+    return (rs.rand(8, 8).astype(np.float32),
+            rs.randint(0, 4, 8).astype(np.float32))
+
+
+class BarrierStepLoop(GluonStepLoop):
+    """GluonStepLoop with the world lockstep point where the gradient
+    all-reduce lives: backward -> (fault hook) -> barrier -> update.
+    A peer that dies pre-barrier leaves this rank's state at the last
+    completed step when ``DistTimeout`` fires — the same pre-mutation
+    guarantee the collective deadline gives the real pushpull."""
+
+    def __init__(self, block, trainer, loss_fn, membership, hook=None):
+        super().__init__(block, trainer, loss_fn)
+        self._membership = membership
+        self._hook = hook
+        self._seq = 0
+
+    def step(self, x, y):
+        from mxnet_tpu import ndarray as nd
+
+        x = x if isinstance(x, nd.NDArray) else nd.array(x)
+        y = y if isinstance(y, nd.NDArray) else nd.array(y)
+        with autograd.record():
+            loss = self._loss_fn(self._block(x), y)
+        loss.backward()
+        seq = self._seq
+        self._seq += 1
+        if self._hook is not None:
+            self._hook(seq)
+        if self._membership.world_size > 1:
+            self._membership.barrier("step-%d" % seq)
+        self._trainer.step(x.shape[0])
+        return loss.mean()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt", required=True)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--checkpoint-every", type=int, default=2)
+    ap.add_argument("--die-at", type=int, default=None)
+    ap.add_argument("--die-rank", type=int, default=1)
+    ap.add_argument("--torn-at-save", type=int, default=None)
+    ap.add_argument("--torn-rank", type=int, default=1)
+    ap.add_argument("--pid-dir", default=None)
+    ap.add_argument("--ready-at", type=int, default=2)
+    ap.add_argument("--step-sleep", type=float, default=0.0)
+    args = ap.parse_args()
+
+    attempt = int(os.environ.get("MXNET_DIST_ATTEMPT", "0"))
+    membership = mx.dist.join()
+    rank, world = membership.rank, membership.world_size
+
+    if args.torn_at_save is not None and rank == args.torn_rank \
+            and attempt == 0:
+        resilience.plan("checkpoint_marker@%d:abort" % args.torn_at_save)
+
+    mx.random.seed(SEED)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=8),
+            nn.Dense(4, in_units=16))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def hook(seq):
+        if args.pid_dir and seq == args.ready_at:
+            path = os.path.join(args.pid_dir, "rank-%d.ready" % rank)
+            with open(path, "w") as f:
+                f.write(str(os.getpid()))
+        if args.die_at is not None and attempt == 0 \
+                and rank == args.die_rank and seq == args.die_at:
+            sys.stdout.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    loop = BarrierStepLoop(net, trainer, loss_fn, membership, hook=hook)
+    pod = mx.dist.PodCheckpointManager(args.ckpt, membership=membership)
+
+    if args.pid_dir:
+        os.makedirs(args.pid_dir, exist_ok=True)
+        with open(os.path.join(args.pid_dir,
+                               "rank-%d.pid" % rank), "w") as f:
+            f.write(str(os.getpid()))
+
+    assert resilience.install()   # SIGTERM -> coordinated preemption
+    resumed = pod.latest_step()
+    print("rank %d resume_from %s" % (rank, resumed))
+    sys.stdout.flush()
+
+    sup = Supervisor(loop, pod,
+                     checkpoint_every=args.checkpoint_every,
+                     membership=membership)
+    sup.run(lambda s: batch_for(s, args.step_sleep), args.steps)
+    if sup.preempted:
+        stop = sup.world_stopped or {}
+        print("rank %d PREEMPT step=%s reason=%s exit=%d pod=%s"
+              % (rank, stop.get("step"), stop.get("reason"),
+                 preempt.exit_code(), pod.latest_step()))
+        sys.stdout.flush()
+        sys.exit(preempt.exit_code())
+
+    sums = [float(p.data().asnumpy().sum())
+            for _n, p in sorted(net.collect_params().items())]
+    membership.leave("done")
+    print("rank %d FINAL %.8f" % (rank, float(np.asarray(sums).sum())))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

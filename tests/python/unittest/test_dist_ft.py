@@ -1,0 +1,636 @@
+"""mx.dist coordinated fault-tolerance tests: FileKV atomicity,
+membership generations/heartbeats/stop flags, barrier + collective
+deadlines, pod-consistent checkpoint commit/restore (incl. the
+torn-pod-commit acceptance rule), supervisor dist mode, launcher
+SIGTERM forwarding/orphan reaping, and the 2-proc rank-kill +
+whole-world-restart subprocess drill."""
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel, resilience, telemetry
+from mxnet_tpu.dist import (DistTimeout, FileKV, MemKV, Membership,
+                            PodCheckpointManager, pod_latest_step,
+                            run_with_deadline)
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.resilience import Backoff, Supervisor, classify, preempt
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.enable()
+    telemetry.reset()
+    preempt.clear()
+    yield
+    preempt.clear()
+    telemetry.enable()
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# KV + membership
+# ---------------------------------------------------------------------------
+
+def test_filekv_roundtrip_and_first_writer_wins(tmp_path):
+    kv = FileKV(str(tmp_path))
+    kv.set("members/0/1", {"rank": 1})
+    assert kv.get("members/0/1") == {"rank": 1}
+    assert kv.list("members/0") == ["1"]
+    assert kv.get("absent") is None
+    # first-wins flag (the stop-flag contract): the losing write must
+    # not clobber the winner
+    assert kv.set("stop/0", {"rank": 2}, overwrite=False)
+    assert not kv.set("stop/0", {"rank": 3}, overwrite=False)
+    assert kv.get("stop/0") == {"rank": 2}
+    kv.delete("stop/0")
+    assert kv.get("stop/0") is None
+
+
+def _pair(kv, world=2):
+    ms = [Membership(kv=kv, rank=r, world_size=world, heartbeat=0,
+                     dead_after=5.0) for r in range(world)]
+    for m in ms:
+        m.join(start_heartbeat=False)
+    return ms
+
+
+def test_membership_join_generation_and_liveness(tmp_path):
+    kv = FileKV(str(tmp_path))
+    m0, m1 = _pair(kv)
+    assert m0.generation == m1.generation == 0
+    assert m0.alive() == [0, 1] and m0.dead_ranks() == []
+    # a silent rank goes dead once its heartbeat stales out
+    m1.dead_after = 0.05
+    time.sleep(0.1)
+    m0.beat()
+    assert m1.dead_ranks() == [0] or m1.dead_ranks() == [1]
+    assert 0 in m1.alive(max_age=60)
+    # a clean leave is not "alive" regardless of freshness
+    m1.leave("test")
+    assert m0.alive(max_age=60) == [0]
+    # a NEW incarnation bumps the generation and starts clean
+    m2 = Membership(kv=kv, rank=0, world_size=2, heartbeat=0)
+    assert m2.join(start_heartbeat=False) == 1
+    assert m2.stop_requested() is None
+
+
+def test_membership_stop_flag_first_wins_and_per_generation(tmp_path):
+    kv = FileKV(str(tmp_path))
+    m0, m1 = _pair(kv)
+    flag = m1.signal_stop("failure", step=7, error="boom")
+    assert flag["rank"] == 1 and flag["step"] == 7
+    # a later poster observes the FIRST flag, not its own
+    flag2 = m0.signal_stop("preempt", step=9)
+    assert flag2["rank"] == 1 and flag2["reason"] == "failure"
+    assert m0.stop_requested()["step"] == 7
+    # the next generation is unaffected
+    m3 = Membership(kv=kv, rank=0, world_size=2, heartbeat=0)
+    m3.join(start_heartbeat=False)
+    assert m3.generation == 1 and m3.stop_requested() is None
+
+
+def test_membership_join_nonce_rejects_stale_world_record(
+        tmp_path, monkeypatch):
+    """A reused member dir holds the PREVIOUS incarnation's world
+    record; with the launcher nonce armed, a non-zero rank must wait
+    for the record carrying ITS nonce instead of adopting the stale
+    one (which would split the world across two generations)."""
+    kv = FileKV(str(tmp_path))
+    # leftover from a previous world (no nonce / old nonce, gen 3)
+    kv.set("world", {"generation": 3, "world_size": 2,
+                     "nonce": "old-0", "wall": 0.0})
+    monkeypatch.setenv("MXNET_DIST_WORLD_NONCE", "new-1")
+    m1 = Membership(kv=kv, rank=1, world_size=2, heartbeat=0)
+    with pytest.raises(mx.MXNetError, match="nonce new-1"):
+        m1.join(start_heartbeat=False, timeout=0.3)
+    # rank 0 of the NEW incarnation publishes gen 4 with the nonce:
+    # now (and only now) rank 1 joins, on the SAME generation
+    m0 = Membership(kv=kv, rank=0, world_size=2, heartbeat=0)
+    assert m0.join(start_heartbeat=False) == 4
+    assert m1.join(start_heartbeat=False, timeout=5) == 4
+
+
+def test_barrier_records_swept_two_behind(tmp_path):
+    """Per-step barriers must not grow the member dir forever: records
+    two barriers back (every rank provably passed them) are swept."""
+    kv = FileKV(str(tmp_path))
+    m0, m1 = _pair(kv)
+    for i in range(4):
+        t = threading.Thread(
+            target=lambda i=i: m1.barrier("s%d" % i, timeout=10))
+        t.start()
+        m0.barrier("s%d" % i, timeout=10)
+        t.join(10)
+    gen = m0.generation
+    # the first two swept by both ranks reaching the last two; only
+    # the trailing pair of barrier dirs remains
+    remaining = kv.list("barrier/%d" % gen)
+    assert len(remaining) == 2, remaining
+    assert any(n.endswith("-s3") for n in remaining), remaining
+    assert not any(n.endswith(("-s0", "-s1")) for n in remaining)
+
+
+def test_barrier_reused_name_still_synchronizes(tmp_path):
+    """barrier('step') every iteration (the natural call pattern) must
+    synchronize EACH call: the internal sequence number keys every
+    call independently, so call 2 cannot sail through on call 1's
+    records."""
+    kv = FileKV(str(tmp_path))
+    m0, m1 = _pair(kv)
+    t = threading.Thread(target=lambda: m1.barrier("step", timeout=10))
+    t.start()
+    m0.barrier("step", timeout=10)
+    t.join(10)
+    # m1 has NOT issued its second 'step' barrier: m0's second call
+    # must block and time out rather than pass on stale records
+    with pytest.raises(DistTimeout):
+        m0.barrier("step", timeout=0.3)
+
+
+def test_run_with_deadline_reuses_worker_thread():
+    """The armed hot path (one deadline per pushpull_all per step)
+    must not create a thread per call: a finished worker is pooled and
+    reused; only a timed-out (abandoned) worker is replaced."""
+    from mxnet_tpu.dist import timeouts as dt
+
+    with dt._IDLE_LOCK:
+        dt._IDLE.clear()
+    assert run_with_deadline(lambda: 1, timeout=5.0) == 1
+    with dt._IDLE_LOCK:
+        assert len(dt._IDLE) == 1
+        pooled = dt._IDLE[0]
+    assert run_with_deadline(lambda: 2, timeout=5.0) == 2
+    with dt._IDLE_LOCK:
+        assert len(dt._IDLE) == 1 and dt._IDLE[0] is pooled
+    # a miss abandons the worker instead of re-pooling it
+    with pytest.raises(DistTimeout):
+        run_with_deadline(lambda: time.sleep(30), timeout=0.2)
+    with dt._IDLE_LOCK:
+        assert pooled not in dt._IDLE
+
+
+def test_membership_heartbeat_thread_is_daemon(tmp_path):
+    m = Membership(kv=FileKV(str(tmp_path)), rank=0, world_size=1,
+                   heartbeat=0.05)
+    m.join()
+    try:
+        assert m._hb_thread is not None and m._hb_thread.daemon
+        first = m.members()[0]["wall"]
+        deadline = time.time() + 5
+        while m.members()[0]["wall"] == first:
+            assert time.time() < deadline, "heartbeat never refreshed"
+            time.sleep(0.02)
+    finally:
+        m.stop_heartbeat()
+
+
+# ---------------------------------------------------------------------------
+# deadlines + barrier
+# ---------------------------------------------------------------------------
+
+def test_run_with_deadline_passthrough_and_timeout():
+    assert run_with_deadline(lambda: 41 + 1, timeout=5.0) == 42
+    with pytest.raises(ValueError, match="inner"):
+        run_with_deadline(lambda: (_ for _ in ()).throw(
+            ValueError("inner")), timeout=5.0)
+    t0 = time.monotonic()
+    with pytest.raises(DistTimeout) as err:
+        run_with_deadline(lambda: time.sleep(30), site="pushpull_all",
+                          timeout=0.3)
+    assert time.monotonic() - t0 < 5.0          # no hang
+    assert err.value.site == "pushpull_all"
+    assert telemetry.value("dist_collective_timeouts_total",
+                           {"site": "pushpull_all"}) == 1
+
+
+def test_dist_timeout_classified_transient_and_state_clean():
+    exc = DistTimeout("peer dead", site="pushpull_all", timeout=1.0)
+    assert classify(exc) == "transient"   # retried, not fatal MXNetError
+    assert exc.mx_state_clean             # fired before any update
+
+
+def test_barrier_passes_times_out_and_aborts_on_stop(tmp_path):
+    kv = FileKV(str(tmp_path))
+    m0, m1 = _pair(kv)
+    done = []
+    t = threading.Thread(
+        target=lambda: done.append(m1.barrier("s0", timeout=10)))
+    t.start()
+    m0.barrier("s0", timeout=10)          # both arrive -> both pass
+    t.join(10)
+    assert done == [True]
+    # a dead peer: the barrier raises within the deadline
+    with pytest.raises(DistTimeout):
+        m0.barrier("s1", timeout=0.3)
+    # a peer that posted the world-stop flag will never arrive: the
+    # wait aborts immediately instead of burning the whole deadline
+    m1.signal_stop("preempt", step=1)
+    t0 = time.monotonic()
+    with pytest.raises(DistTimeout, match="world stop"):
+        m0.barrier("s2", timeout=30)
+    assert time.monotonic() - t0 < 5.0
+
+
+# ---------------------------------------------------------------------------
+# pod-consistent checkpoints
+# ---------------------------------------------------------------------------
+
+def _tree(k=1.0):
+    return {"w": np.arange(8, dtype=np.float32) * k,
+            "b": np.ones(3, dtype=np.float32) * k}
+
+
+def test_pod_commit_all_ranks_ack_then_marker(tmp_path):
+    root = str(tmp_path)
+    p0 = PodCheckpointManager(root, rank=0, world_size=2, ack_timeout=10)
+    p1 = PodCheckpointManager(root, rank=1, world_size=2, ack_timeout=10)
+    t = threading.Thread(target=lambda: p1.save(2, _tree(2)))
+    t.start()
+    p0.save(2, _tree(1))
+    t.join(30)
+    assert p0.last_pod_commit == (2, True)
+    assert p1.last_pod_commit == (2, True)
+    assert p0.steps() == p1.steps() == [2]
+    assert pod_latest_step(root) == 2
+    m = p0.marker(2)
+    assert m["world_size"] == 2 and m["step"] == 2
+    # each rank restores ITS shard
+    s, tree = p1.restore()
+    assert s == 2
+    np.testing.assert_array_equal(np.asarray(tree["w"]), _tree(2)["w"])
+
+
+def test_torn_pod_commit_never_selected(tmp_path):
+    """ISSUE-10 acceptance: one rank never acks -> the step has no pod
+    marker and latest_step answers the previous fully-committed step
+    on ALL ranks, even though the surviving rank's own shard for the
+    torn step is durably committed."""
+    root = str(tmp_path)
+    p0 = PodCheckpointManager(root, rank=0, world_size=2, ack_timeout=10)
+    p1 = PodCheckpointManager(root, rank=1, world_size=2, ack_timeout=10)
+    t = threading.Thread(target=lambda: p1.save(1, _tree()))
+    t.start()
+    p0.save(1, _tree())
+    t.join(30)
+    # step 4: rank 1 dies before its shard ack (never saves)
+    p0._ack_timeout = 0.3
+    p0.save(4, _tree(4))
+    assert p0.last_pod_commit == (4, False)
+    assert p0.rank_manager.latest_step() == 4    # rank-local commit OK
+    assert p0.latest_step() == 1                 # pod says NO
+    assert p1.latest_step() == 1
+    assert pod_latest_step(root) == 1
+    s, _ = p0.restore()
+    assert s == 1
+    with pytest.raises(mx.MXNetError, match="no pod marker"):
+        p0.restore(step=4)
+    assert telemetry.value("dist_pod_commits_total",
+                           {"result": "timeout"}) == 1
+    # strict mode surfaces the torn commit as DistTimeout
+    p0._strict = True
+    with pytest.raises(DistTimeout, match="torn"):
+        p0.save(6, _tree(6))
+
+
+def test_pod_restore_shrink_world_resharding(tmp_path):
+    """Save on a 2-rank world, restore on a 1-rank world: lossless
+    (replicated data-parallel state; the template-based restore places
+    leaves onto the new process's devices)."""
+    root = str(tmp_path)
+    p0 = PodCheckpointManager(root, rank=0, world_size=2, ack_timeout=10)
+    p1 = PodCheckpointManager(root, rank=1, world_size=2, ack_timeout=10)
+    t = threading.Thread(target=lambda: p1.save(3, _tree(3)))
+    t.start()
+    p0.save(3, _tree(3))
+    t.join(30)
+    shrunk = PodCheckpointManager(root, rank=0, world_size=1,
+                                  ack_timeout=10)
+    assert shrunk.latest_step() == 3
+    assert shrunk.source_rank(3) == 0
+    s, tree = shrunk.restore(template_tree=_tree(0))
+    assert s == 3
+    np.testing.assert_array_equal(np.asarray(tree["w"]), _tree(3)["w"])
+    # world of 1 degrades to manager+markers: save publishes instantly
+    shrunk.save(5, _tree(5))
+    assert shrunk.last_pod_commit == (5, True)
+    assert telemetry.value("dist_pod_commits_total",
+                           {"result": "ok"}) >= 1
+
+
+def test_pod_async_save_publishes_on_wait(tmp_path):
+    p = PodCheckpointManager(str(tmp_path), rank=0, world_size=1,
+                             ack_timeout=10)
+    fut = p.save_async(7, _tree(7))
+    fut.result()
+    assert p.wait() is not None
+    assert p.last_pod_commit == (7, True) and p.latest_step() == 7
+
+
+# ---------------------------------------------------------------------------
+# supervisor dist mode
+# ---------------------------------------------------------------------------
+
+def _fused(seed):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=8),
+            nn.Dense(4, in_units=16))
+    net.initialize()
+    return parallel.FusedTrainer(
+        net, loss="softmax_ce", optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+
+
+def _batches(step):
+    rs = np.random.RandomState(step % 7)
+    return (rs.rand(16, 8).astype(np.float32),
+            rs.randint(0, 4, 16).astype(np.int32))
+
+
+def _member(tmp_path, rank=0, world=1):
+    kv = FileKV(str(tmp_path / "mem"))
+    m = Membership(kv=kv, rank=rank, world_size=world, heartbeat=0)
+    m.join(start_heartbeat=False)
+    return m
+
+
+def test_supervisor_obeys_peer_world_stop(tmp_path):
+    """A stop flag posted by a peer stops THIS rank at the step
+    boundary with an emergency pod checkpoint of its last completed
+    step, preempted semantics, and a world_stop restart record."""
+    m = _member(tmp_path)
+    peer = Membership(kv=m.kv, rank=1, world_size=1, heartbeat=0)
+    peer.generation = m.generation
+    pod = PodCheckpointManager(str(tmp_path / "ckpt"), rank=0,
+                               world_size=1, ack_timeout=10)
+    tr = _fused(5)
+    sup = Supervisor(tr, pod, checkpoint_every=100, membership=m,
+                     backoff=Backoff(base=0.0, jitter=0.0))
+    real = tr.step
+    count = {"n": 0}
+
+    def stepper(x, y):
+        count["n"] += 1
+        if count["n"] == 4:
+            peer.signal_stop("preempt", step=99)
+        return real(x, y)
+
+    tr.step = stepper
+    losses = sup.run(_batches, 20)
+    assert len(losses) == 4                   # stopped at the boundary
+    assert sup.preempted
+    assert sup.world_stopped["reason"] == "preempt"
+    assert pod.latest_step() == 3             # last completed step
+    kinds = [r["kind"] for r in resilience.recent_restarts()]
+    assert "world_stop" in kinds
+
+
+def test_supervisor_dist_transient_failure_propagates(tmp_path):
+    """DistTimeout in dist mode: no local retry — the supervisor posts
+    the stop flag, emergency-commits the last completed step (the
+    failure is state-clean), and stops preempted."""
+    m = _member(tmp_path)
+    pod = PodCheckpointManager(str(tmp_path / "ckpt"), rank=0,
+                               world_size=1, ack_timeout=10)
+    tr = _fused(6)
+    sup = Supervisor(tr, pod, checkpoint_every=100, membership=m,
+                     backoff=Backoff(base=0.0, jitter=0.0))
+    real = tr.step
+    count = {"n": 0}
+
+    def stepper(x, y):
+        count["n"] += 1
+        if count["n"] == 3:
+            raise DistTimeout("peer dead", site="pushpull_all",
+                              timeout=2.0)
+        return real(x, y)
+
+    tr.step = stepper
+    sup.run(_batches, 20)
+    assert sup.preempted and sup.restarts == 1
+    flag = m.stop_requested()
+    assert flag["reason"] == "failure" and flag["step"] == 1
+    assert "DistTimeout" in flag["error"]
+    assert pod.latest_step() == 1             # clean-state emergency
+    assert telemetry.value("dist_world_stops_total",
+                           {"reason": "failure"}) == 1
+
+
+def test_supervisor_dist_suspect_state_not_saved(tmp_path):
+    """A mid-update failure (not state-clean) still coordinates the
+    stop but must NOT emergency-commit the possibly-corrupt state."""
+    m = _member(tmp_path)
+    pod = PodCheckpointManager(str(tmp_path / "ckpt"), rank=0,
+                               world_size=1, ack_timeout=10)
+    tr = _fused(7)
+    sup = Supervisor(tr, pod, checkpoint_every=100, membership=m)
+
+    def bad_step(x, y):
+        raise RuntimeError("device lost mid-update")
+
+    tr.step = bad_step
+    sup.run(_batches, 20)
+    assert sup.preempted
+    assert pod.latest_step() is None          # nothing durable to trust
+
+
+def test_supervisor_local_sigterm_propagates_to_world(tmp_path):
+    """preempt.request() on this rank posts the membership stop flag
+    before the emergency save, so peers join the same shutdown."""
+    m = _member(tmp_path)
+    pod = PodCheckpointManager(str(tmp_path / "ckpt"), rank=0,
+                               world_size=1, ack_timeout=10)
+    tr = _fused(8)
+    sup = Supervisor(tr, pod, checkpoint_every=100, membership=m)
+    real = tr.step
+    count = {"n": 0}
+
+    def stepper(x, y):
+        count["n"] += 1
+        if count["n"] == 3:
+            preempt.request()
+        return real(x, y)
+
+    tr.step = stepper
+    sup.run(_batches, 20)
+    assert sup.preempted
+    assert m.stop_requested()["reason"] == "preempt"
+    assert pod.latest_step() == 2
+
+
+# ---------------------------------------------------------------------------
+# launcher: SIGTERM forwarding + orphan reaping + deterministic ports
+# ---------------------------------------------------------------------------
+
+def test_launch_pick_port_deterministic_and_bindable():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import launch
+
+        p1 = launch.pick_port(12345)
+        assert p1 == launch.pick_port(12345)        # deterministic
+        assert 1024 < p1 < 65536
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))                    # unrelated port OK
+        s.close()
+    finally:
+        sys.path.remove(os.path.join(REPO, "tools"))
+
+
+def _spawn_launcher(pid_dir, child_body, n=2, extra=()):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        return subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+             "-n", str(n), "--rendezvous", "none", *extra,
+             sys.executable, "-c", child_body, pid_dir],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    except OSError as exc:  # pragma: no cover - sandboxed env
+        pytest.skip("cannot spawn subprocesses: %s" % exc)
+
+
+def _wait_pids(pid_dir, n, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        pids = [f for f in os.listdir(pid_dir) if f.endswith(".pid")]
+        if len(pids) >= n:
+            return [int(open(os.path.join(pid_dir, f)).read())
+                    for f in pids]
+        time.sleep(0.05)
+    raise AssertionError("children never wrote pidfiles")
+
+
+def _gone(pid):
+    try:
+        os.kill(pid, 0)
+        return False
+    except ProcessLookupError:
+        return True
+    except PermissionError:  # pragma: no cover
+        return False
+
+
+_CHILD_POLITE = """
+import os, sys, time
+open(os.path.join(sys.argv[1],
+     os.environ["MXNET_DIST_RANK"] + ".pid"), "w").write(str(os.getpid()))
+time.sleep(120)
+"""
+
+_CHILD_STUBBORN = """
+import os, signal, sys, time
+signal.signal(signal.SIGTERM, signal.SIG_IGN)
+open(os.path.join(sys.argv[1],
+     os.environ["MXNET_DIST_RANK"] + ".pid"), "w").write(str(os.getpid()))
+time.sleep(120)
+"""
+
+
+def test_launcher_forwards_sigterm_to_all_children(tmp_path):
+    """SIGTERM on the launcher reaches every rank (preemption drills
+    preempt the WORLD), and the launcher exits promptly."""
+    proc = _spawn_launcher(str(tmp_path), _CHILD_POLITE)
+    pids = _wait_pids(str(tmp_path), 2)
+    proc.send_signal(signal.SIGTERM)
+    proc.wait(timeout=60)
+    deadline = time.time() + 10
+    while not all(_gone(p) for p in pids):
+        assert time.time() < deadline, "children leaked past SIGTERM"
+        time.sleep(0.05)
+
+
+def test_launcher_reaps_stubborn_children_after_grace(tmp_path):
+    """A worker that ignores SIGTERM is SIGKILLed after --term-grace:
+    no orphaned rank processes ever outlive the launcher."""
+    proc = _spawn_launcher(str(tmp_path), _CHILD_STUBBORN,
+                           extra=["--term-grace", "1"])
+    pids = _wait_pids(str(tmp_path), 2)
+    t0 = time.time()
+    proc.send_signal(signal.SIGTERM)
+    proc.wait(timeout=60)
+    deadline = time.time() + 10
+    while not all(_gone(p) for p in pids):
+        assert time.time() < deadline, "stubborn children leaked"
+        time.sleep(0.05)
+    assert time.time() - t0 < 30
+
+
+def test_launcher_reaps_world_when_one_rank_dies(tmp_path):
+    """One rank crashing tears the whole world down (SIGTERM then
+    SIGKILL) instead of leaving peers running against a dead member."""
+    body = _CHILD_STUBBORN.replace(
+        'time.sleep(120)',
+        'time.sleep(120) if os.environ["MXNET_DIST_RANK"] != "1" '
+        'else os._exit(3)')
+    proc = _spawn_launcher(str(tmp_path), body,
+                           extra=["--term-grace", "1"])
+    rc = proc.wait(timeout=60)
+    assert rc == 3
+    pids = [int(open(os.path.join(str(tmp_path), f)).read())
+            for f in os.listdir(str(tmp_path)) if f.endswith(".pid")]
+    deadline = time.time() + 10
+    while not all(_gone(p) for p in pids):
+        assert time.time() < deadline, "peers leaked past rank death"
+        time.sleep(0.05)
+
+
+# ---------------------------------------------------------------------------
+# the 2-proc rank-kill + whole-world-restart drill (subprocess)
+# ---------------------------------------------------------------------------
+
+def test_dist_rank_kill_world_restart_and_bit_identical_resume(tmp_path):
+    """ISSUE-10 acceptance drill 1, in-suite: rank 1 SIGKILLed mid-step
+    -> the survivor's collective deadline raises DistTimeout (no
+    hang), the launcher restarts the world, and training resumes
+    bit-identically from the max common committed pod step."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    env.update({"MXNET_DIST_COLLECTIVE_TIMEOUT": "2",
+                "MXNET_DIST_BARRIER_TIMEOUT": "6",
+                "MXNET_DIST_HEARTBEAT_SECONDS": "0.5"})
+    worker = os.path.join(REPO, "tests", "nightly",
+                          "dist_fault_drill.py")
+
+    def launch(ckpt, extra):
+        try:
+            return subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "launch.py"), "-n", "2",
+                 "--backend", "cpu", "--rendezvous", "none",
+                 "--term-grace", "25", *extra[0],
+                 sys.executable, worker, "--ckpt", ckpt,
+                 "--steps", "8", *extra[1]],
+                env=env, capture_output=True, text=True, timeout=300)
+        except OSError as exc:  # pragma: no cover - sandboxed env
+            pytest.skip("cannot spawn subprocesses: %s" % exc)
+
+    proc = launch(str(tmp_path / "kill"),
+                  (["--restarts", "1"],
+                   ["--die-at", "4", "--die-rank", "1"]))
+    assert proc.returncode == 0, (proc.returncode, proc.stdout,
+                                  proc.stderr[-3000:])
+    assert "PREEMPT step=3 reason=failure" in proc.stdout, proc.stdout
+    assert proc.stdout.count("resume_from 3") == 2, proc.stdout
+    ref = launch(str(tmp_path / "ref"), ([], []))
+    assert ref.returncode == 0, ref.stdout + ref.stderr
+
+    import re
+
+    finals = re.findall(r"FINAL (-?[\d.]+)", proc.stdout)
+    ref_finals = re.findall(r"FINAL (-?[\d.]+)", ref.stdout)
+    assert len(finals) == 2 and len(ref_finals) == 2
+    assert set(finals) == set(ref_finals), (finals, ref_finals)

@@ -33,6 +33,50 @@ def test_device_health_check():
     assert report and all(v == "ok" for v in report.values()), report
 
 
+def test_health_probe_threads_are_daemon():
+    """Probe workers must be daemon threads: a probe hung on a dead
+    device past the timeout can never block interpreter exit."""
+    import threading
+
+    from mxnet_tpu.resilience import health_check
+
+    seen = {}
+
+    def probe(d):
+        t = threading.current_thread()
+        seen[str(d)] = (t.daemon, t.name)
+
+    report = health_check(timeout=10, devices=["dev:0", "dev:1"],
+                          probe=probe)
+    assert all(v == "ok" for v in report.values()), report
+    assert len(seen) == 2
+    assert all(daemon for daemon, _name in seen.values()), seen
+    assert all(name == "mx-health-probe"
+               for _d, name in seen.values()), seen
+
+
+def test_fault_tolerant_runner_deprecation_warning(tmp_path):
+    """The deprecated alias warns — exactly once per process."""
+    import warnings
+
+    from mxnet_tpu import elastic
+
+    tr = _trainer(41)
+    mgr = CheckpointManager(str(tmp_path))
+    elastic._FTR_WARNED = False
+    try:
+        with pytest.warns(DeprecationWarning,
+                          match="FaultTolerantRunner is deprecated"):
+            FaultTolerantRunner(tr, mgr)
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            FaultTolerantRunner(tr, mgr)   # second build: silent
+        assert not [w for w in rec
+                    if issubclass(w.category, DeprecationWarning)], rec
+    finally:
+        elastic._FTR_WARNED = True
+
+
 def test_checkpoint_manager_roundtrip_and_retention(tmp_path):
     mgr = CheckpointManager(str(tmp_path), max_keep=2)
     tr = _trainer(1)

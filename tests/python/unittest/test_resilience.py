@@ -591,16 +591,101 @@ def test_breaker_state_machine_unit():
     assert 0 < b.retry_after() <= 10.0
     clock["t"] += 10.0
     assert b.allow()                       # half-open trial admitted
-    assert b.state()["state"] == "half-open" and not b.blocked()
+    assert b.state()["state"] == "half-open"
+    assert b.blocked()                     # trial in flight: submits
+    assert not b.allow()                   # and dispatches fast-reject
     assert b.record_failure()              # trial failed -> re-open
     assert b.state()["state"] == "open"
     clock["t"] += 10.0
     assert b.allow()
     b.record_success()                     # trial passed -> closed
     assert b.state()["state"] == "closed" and b.trips == 2
+    assert not b.blocked()
     b.record_failure()
     b.record_success()                     # success resets the count
     assert not b.record_failure()
+
+
+def test_breaker_half_open_admits_exactly_one_trial():
+    """Concurrent dispatches racing the half-open transition: exactly
+    ONE wins the trial; the rest reject until the trial resolves.  A
+    trial whose outcome never lands self-heals after one cooldown."""
+    import threading
+
+    clock = {"t": 0.0}
+    b = CircuitBreaker(threshold=1, cooldown=5.0,
+                       clock=lambda: clock["t"])
+    assert b.record_failure()              # open
+    clock["t"] += 5.0                      # cooldown elapsed
+    results = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(8)
+
+    def worker():
+        barrier.wait()
+        ok = b.allow()
+        with lock:
+            results.append(ok)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(results) == 1, results      # exactly one trial
+    assert b.state()["trial_inflight"]
+    assert b.blocked()                     # submit-side fast-reject too
+    b.record_success()                     # trial resolves -> closed
+    assert b.state()["state"] == "closed" and not b.blocked()
+    # stuck trial self-heals: admitted but never resolved, a fresh
+    # trial is allowed one cooldown later
+    assert b.record_failure()              # re-open
+    clock["t"] += 5.0
+    assert b.allow() and not b.allow()     # trial admitted, in flight
+    clock["t"] += 5.0                      # outcome never landed
+    assert b.allow()                       # replacement trial admitted
+
+
+def test_breaker_half_open_trial_under_concurrent_dispatch(tmp_path):
+    """Serve-level satellite contract: with the bucket half-open and a
+    burst of concurrent requests, exactly one trial request reaches
+    the model (and fails, re-opening the breaker) while every other
+    request fast-rejects with BucketQuarantined."""
+    import concurrent.futures as cf
+
+    srv = _serve_fixture(tmp_path, breaker_threshold=1,
+                         breaker_cooldown_s=0.3, max_batch_size=1,
+                         max_wait_us=1000)
+    try:
+        inject.plan("serve_poison@*")      # every dispatch fails
+        x = np.ones((4, 16), dtype="float32")
+        with pytest.raises(inject.InjectedFault):
+            srv.submit(x, request_id="open-it")   # 1 strike -> open
+        assert any(b["state"] == "open"
+                   for b in srv.breakers().values())
+        time.sleep(0.35)                   # cooldown -> half-open
+        futs = [srv.submit_async(x, request_id="burst-%d" % i)
+                for i in range(6)]
+        outcomes = {"poison": 0, "quarantined": 0}
+        for f in futs:
+            try:
+                f.result(timeout=60)
+                raise AssertionError("a burst request was served")
+            except inject.InjectedFault:
+                outcomes["poison"] += 1
+            except serve.BucketQuarantined:
+                outcomes["quarantined"] += 1
+        assert outcomes == {"poison": 1, "quarantined": 5}, outcomes
+        assert any(b["state"] == "open"
+                   for b in srv.breakers().values())
+        # trial succeeds once the poison clears: bucket recovers
+        inject.clear()
+        time.sleep(0.35)
+        assert srv.submit(x, request_id="recover").shape == (4, 4)
+        assert all(b["state"] == "closed"
+                   for b in srv.breakers().values())
+    finally:
+        srv.shutdown()
 
 
 def test_breaker_opens_visible_in_healthz_and_recovers(tmp_path):

@@ -623,6 +623,59 @@ def resilience_info():
                                         "this process)"))
 
 
+def dist_info(root=None):
+    """mx.dist state: membership backend + world view, collective
+    deadline, pod-checkpoint discovery for an optional ROOT."""
+    section("Dist")
+    from mxnet_tpu import dist, telemetry
+
+    st = dist.state()
+    print("member dir   : %s" % (st["member_dir"] or "(not exported — "
+                                 "FileKV backend inactive)"))
+    print("collective   : deadline %s"
+          % ("%.1fs" % st["collective_timeout"]
+             if st["collective_timeout"] else "DISARMED "
+             "(set MXNET_DIST_COLLECTIVE_TIMEOUT on multi-host runs)"))
+    mem = st["membership"]
+    if mem is None and st["member_dir"]:
+        # peek at the shared dir without joining (read-only view)
+        m = dist.Membership(heartbeat=0)
+        rec = m.kv.get("world")
+        if rec is not None:
+            m.generation = int(rec.get("generation", 0))
+            m.world_size = int(rec.get("world_size", m.world_size))
+            mem = m.state()
+    if mem is None:
+        print("membership   : not joined in this process")
+    elif not mem.get("joined"):
+        print("membership   : rank %d / world %d (not joined)"
+              % (mem["rank"], mem["world_size"]))
+    else:
+        print("membership   : rank %d / world %d, generation %d"
+              % (mem["rank"], mem["world_size"], mem["generation"]))
+        print("  alive      : %s" % (mem["alive"] or "(none fresh)"))
+        print("  dead       : %s" % (mem["dead"] or "none"))
+        stop = mem.get("stop")
+        print("  stop flag  : %s"
+              % ("none" if stop is None else
+                 "reason=%s rank=%s step=%s %s"
+                 % (stop.get("reason"), stop.get("rank"),
+                    stop.get("step"), (stop.get("error") or "")[:60])))
+    if root:
+        from mxnet_tpu.dist import podckpt
+
+        steps = podckpt._scan_pod_markers(root)
+        print("pod ckpts    : %s" % (("%d pod-committed step(s), "
+                                      "latest %d" % (len(steps),
+                                                     steps[-1]))
+                                     if steps else "none under %s"
+                                     % root))
+    tot = {k: v for k, v in telemetry.totals(nonzero=True).items()
+           if k.startswith("dist_")}
+    print("telemetry    : %s" % (tot or "(no dist_* activity in this "
+                                        "process)"))
+
+
 def env_info():
     section("Environment")
     from mxnet_tpu import config
@@ -676,16 +729,23 @@ def main():
                          "plan, preemption handler state, recent "
                          "supervisor restarts, serve breaker states, "
                          "injected-fault counters")
+    ap.add_argument("--dist", nargs="?", const="", metavar="CKPT_ROOT",
+                    help="dump the mx.dist plane: membership/world "
+                         "view, collective deadline, world-stop flag, "
+                         "and (with a root) pod-committed checkpoint "
+                         "steps")
     args = ap.parse_args()
     # section flags compose: --compile-cache --serve URL prints both
     # (each skips the environment dump, all honor --telemetry)
     if args.compile_cache or args.serve or args.checkpoints or \
             args.trainer or args.trace or args.monitor or \
-            args.resilience:
+            args.resilience or args.dist is not None:
         if args.compile_cache:
             compile_cache_info()
         if args.resilience:
             resilience_info()
+        if args.dist is not None:
+            dist_info(args.dist or None)
         if args.trainer:
             trainer_info()
         if args.monitor:

@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""mx.dist coordinated fault drills (make dist-faults-smoke, CPU).
+
+Three scripted 2-process drills over ``tools/launch.py`` +
+``tests/nightly/dist_fault_drill.py``, each asserting the ISSUE-10
+acceptance contract end to end.  The drill worker locksteps ranks
+through ``Membership.barrier`` at the gradient-allreduce position
+(this container's XLA cannot run multi-process collectives on CPU;
+the supervisor/membership/pod-checkpoint protocol is identical either
+way) and every rank's training is deterministic, so recovery is
+checked BIT-identically against uninterrupted reference runs.
+
+1. **rank-kill mid-step, whole-world restart** — rank 1 SIGKILLs
+   itself after backward, before the lockstep point; rank 0's
+   collective deadline (``MXNET_DIST_COLLECTIVE_TIMEOUT``) raises
+   ``DistTimeout`` instead of hanging, the supervisor posts the
+   world-stop flag, emergency-commits the pod checkpoint and exits
+   with the preempt code; ``launch.py --restarts 1`` relaunches the
+   world, which resumes from the max common committed step and lands
+   on the reference FINAL exactly.
+2. **coordinated SIGTERM** — SIGTERM is delivered to ONE rank's pid;
+   the flag propagates through membership, EVERY rank flushes an
+   emergency checkpoint for the SAME step and exits with the preempt
+   code; a relaunch on FEWER processes (2 -> 1) restores losslessly
+   via the pod layout and matches the uninterrupted reference.
+3. **torn pod commit** — rank 1 is hard-killed (``checkpoint_marker
+   @K:abort``) after its shards land but before its COMMITTED marker;
+   the pod marker for that step never publishes, so ``latest_step``
+   across the pod answers the PREVIOUS fully-committed step on every
+   rank, and the relaunched world resumes from it bit-identically.
+"""
+from __future__ import annotations
+
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+LAUNCH = os.path.join(REPO, "tools", "launch.py")
+WORKER = os.path.join(REPO, "tests", "nightly", "dist_fault_drill.py")
+STEPS = 8
+REF_FINAL = None  # filled by the reference run
+
+
+def _env():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "MXNET_DIST_COLLECTIVE_TIMEOUT": "2",
+        "MXNET_DIST_BARRIER_TIMEOUT": "6",
+        "MXNET_DIST_HEARTBEAT_SECONDS": "0.5",
+        "MXNET_DIST_DEAD_AFTER_SECONDS": "3",
+    })
+    return env
+
+
+def _launch(n, worker_args, launch_args=(), timeout=300):
+    proc = subprocess.run(
+        [sys.executable, LAUNCH, "-n", str(n), "--backend", "cpu",
+         "--rendezvous", "none", "--term-grace", "25",
+         *launch_args, sys.executable, WORKER, *worker_args],
+        env=_env(), capture_output=True, text=True, timeout=timeout)
+    return proc
+
+
+def _finals(out):
+    return re.findall(r"FINAL (-?[\d.]+)", out)
+
+
+def _assert_final(proc, n, label):
+    finals = _finals(proc.stdout)
+    assert len(finals) == n and set(finals) == {REF_FINAL}, (
+        "%s: FINAL %s != reference %s\n%s\n%s"
+        % (label, finals, REF_FINAL, proc.stdout, proc.stderr[-2000:]))
+
+
+def reference(tmp):
+    global REF_FINAL
+    proc = _launch(2, ["--ckpt", os.path.join(tmp, "ref"),
+                       "--steps", str(STEPS)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    finals = _finals(proc.stdout)
+    assert len(finals) == 2 and len(set(finals)) == 1, proc.stdout
+    REF_FINAL = finals[0]
+    print("reference OK: 2-proc uninterrupted FINAL %s" % REF_FINAL)
+
+
+def drill_rank_kill(tmp):
+    root = os.path.join(tmp, "kill")
+    proc = _launch(
+        2, ["--ckpt", root, "--steps", str(STEPS), "--die-at", "4",
+            "--die-rank", "1"], launch_args=["--restarts", "1"])
+    assert proc.returncode == 0, (proc.returncode, proc.stdout,
+                                  proc.stderr[-3000:])
+    # the survivor's collective deadline fired (no hang) and it joined
+    # the coordinated stop; the RELAUNCHED world resumed from the max
+    # common committed step
+    assert "PREEMPT step=3 reason=failure" in proc.stdout, proc.stdout
+    assert "coordinated restart 1/1" in proc.stderr, proc.stderr[-2000:]
+    assert proc.stdout.count("resume_from 3") == 2, proc.stdout
+    _assert_final(proc, 2, "rank-kill resume")
+    print("drill 1 OK: rank 1 SIGKILLed at step 4; DistTimeout within "
+          "the 2s deadline, world restarted, resumed from pod step 3, "
+          "FINAL bit-identical to the uninterrupted run")
+
+
+def drill_coordinated_sigterm(tmp):
+    from mxnet_tpu.dist import pod_latest_step
+
+    root = os.path.join(tmp, "sigterm")
+    pids = os.path.join(tmp, "sigterm-pids")
+    os.makedirs(pids, exist_ok=True)
+    proc = subprocess.Popen(
+        [sys.executable, LAUNCH, "-n", "2", "--backend", "cpu",
+         "--rendezvous", "none", "--term-grace", "25",
+         sys.executable, WORKER, "--ckpt", root, "--steps", "400",
+         "--step-sleep", "0.02", "--pid-dir", pids],
+        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        ready = os.path.join(pids, "rank-1.ready")
+        deadline = time.time() + 240
+        while not os.path.exists(ready):
+            assert proc.poll() is None, proc.communicate()
+            assert time.time() < deadline, "rank 1 never reached step 2"
+            time.sleep(0.1)
+        time.sleep(0.3)
+        with open(os.path.join(pids, "rank-1.pid")) as f:
+            os.kill(int(f.read()), signal.SIGTERM)   # ONE rank only
+        out, err = proc.communicate(timeout=240)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 85, (proc.returncode, out, err[-2000:])
+    preempts = re.findall(r"rank (\d) PREEMPT step=(\d+)", out)
+    assert len(preempts) == 2, out            # EVERY rank flushed
+    steps = {s for _r, s in preempts}
+    assert len(steps) == 1, out               # ... the SAME step
+    stop_step = int(steps.pop())
+    assert pod_latest_step(root) == stop_step
+    # shrink-world resume: 2 -> 1 process, lossless via the pod layout
+    total = stop_step + 3
+    resumed = _launch(1, ["--ckpt", root, "--steps", str(total)])
+    assert resumed.returncode == 0, resumed.stdout + resumed.stderr
+    assert "resume_from %d" % stop_step in resumed.stdout, resumed.stdout
+    ref = _launch(1, ["--ckpt", os.path.join(tmp, "sigterm-ref"),
+                      "--steps", str(total)])
+    assert ref.returncode == 0, ref.stdout + ref.stderr
+    assert _finals(resumed.stdout) == _finals(ref.stdout), (
+        resumed.stdout, ref.stdout)
+    print("drill 2 OK: SIGTERM to rank 1 only -> both ranks emergency-"
+          "committed step %d and exited 85; 1-proc relaunch restored "
+          "losslessly and matched the uninterrupted reference"
+          % stop_step)
+
+
+def drill_torn_pod_commit(tmp):
+    from mxnet_tpu.dist import pod_latest_step
+
+    root = os.path.join(tmp, "torn")
+    proc = _launch(
+        2, ["--ckpt", root, "--steps", str(STEPS),
+            "--torn-at-save", "1", "--torn-rank", "1"])
+    assert proc.returncode == 77, (proc.returncode, proc.stdout,
+                                   proc.stderr[-2000:])
+    assert "hard exit 77" in proc.stderr, proc.stderr[-2000:]
+    # rank 0 committed ITS step-3 shard, but the pod marker never
+    # landed: the torn step is unselectable on every rank
+    assert pod_latest_step(root) == 1, pod_latest_step(root)
+    r0 = os.path.join(root, "rank-00000", "ckpt-00000003")
+    assert os.path.isdir(r0), "rank 0 should hold a committed step 3"
+    resumed = _launch(2, ["--ckpt", root, "--steps", str(STEPS)])
+    assert resumed.returncode == 0, resumed.stdout + resumed.stderr
+    assert resumed.stdout.count("resume_from 1") == 2, resumed.stdout
+    _assert_final(resumed, 2, "torn-pod resume")
+    print("drill 3 OK: rank 1 killed before its shard ack; pod "
+          "latest_step stayed 1 on all ranks (rank 0's lone step-3 "
+          "commit unselectable), resume bit-identical")
+
+
+def main():
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="mxnet_dist_faults_")
+    t0 = time.time()
+    reference(tmp)
+    drill_rank_kill(tmp)
+    drill_coordinated_sigterm(tmp)
+    drill_torn_pod_commit(tmp)
+    print("dist faults smoke OK (3 drills, %.1fs)" % (time.time() - t0))
+
+
+if __name__ == "__main__":
+    main()
