@@ -10,8 +10,8 @@ SRCS := $(wildcard src/native/*.cc)
 SO := build/libmxtpu_native.so
 
 .PHONY: native test cpptest telemetry-smoke checkpoint-smoke serve-smoke \
-	compile-cache-smoke trainer-smoke trace-smoke monitor-smoke \
-	faults-smoke dist-faults-smoke smoke-all clean
+	compile-cache-smoke trainer-smoke step-smoke trace-smoke \
+	monitor-smoke faults-smoke dist-faults-smoke smoke-all clean
 
 native: $(SO)
 
@@ -96,6 +96,17 @@ monitor-smoke:
 	JAX_PLATFORMS=cpu python -m pytest \
 	  tests/python/unittest/test_monitor.py -q -m 'not slow'
 
+# mx.step whole-step capture: capture -> ONE executable (no cachedop/
+# fused-group/monitor-stat builds during captured steps), bit-identical
+# params + optimizer state vs the stitched path, skip_step inside the
+# program mutates nothing, and a fault at the step_capture site
+# degrades cleanly to a stitched (still applied) step; then the
+# subsystem's pytest suite
+step-smoke:
+	JAX_PLATFORMS=cpu python tools/step_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/python/unittest/test_step_capture.py -q -m 'not slow'
+
 # mx.resilience fault drills: writer killed mid-commit -> recover;
 # collective fault mid-run -> backoff + bit-identical resume; real
 # SIGTERM -> emergency checkpoint -> cross-process bit-identical
@@ -123,8 +134,8 @@ dist-faults-smoke:
 # every subsystem smoke in sequence — the one-command pre-flight before
 # a tunnel window (each target is independent; failures stop the chain)
 smoke-all: telemetry-smoke checkpoint-smoke serve-smoke \
-	compile-cache-smoke trainer-smoke trace-smoke monitor-smoke \
-	faults-smoke dist-faults-smoke
+	compile-cache-smoke trainer-smoke step-smoke trace-smoke \
+	monitor-smoke faults-smoke dist-faults-smoke
 
 # suite summary artifact (TESTS_r{N}.json) — round-2 advisor contract
 test-report:

@@ -527,6 +527,116 @@ def _bench_imperative_trainer(batch=64, iters=10, dtype="bfloat16"):
             "update_groups": multi_tensor.group_table(trainer)}
 
 
+def _bench_captured_step(batch=64, iters=10, dtype="bfloat16",
+                         fused_ref=None):
+    """Whole-step captured ResNet-50 training (mx.step): the SAME
+    model/data as the imperative-trainer row, but forward + loss +
+    backward + allreduce + fused apply run as ONE donated XLA program
+    per step.  Reports img/s for both the captured and the stitched
+    path (same process, same weights-at-start discipline), the
+    captured/stitched delta, the delta vs the FusedTrainer headline
+    when available, and a bit-parity check of final params after
+    PARITY_STEPS captured-vs-stitched steps on fresh models."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd, trace
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    PARITY_STEPS = 3
+
+    def build(seed=0):
+        mx.random.seed(seed)
+        net = vision.resnet50_v1()
+        net.initialize()
+        if dtype != "float32":
+            net.cast(dtype)
+        net.hybridize()
+        trainer = gluon.Trainer(
+            net.collect_params(), "sgd",
+            {"learning_rate": 0.05, "momentum": 0.9,
+             "multi_precision": dtype != "float32"})
+        return net, trainer
+
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.rand(batch, 3, 224, 224).astype(np.float32)) \
+        .astype(dtype)
+    y = nd.array(rs.randint(0, 1000, batch).astype(np.int32))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def time_loop(step_once):
+        for _ in range(WARMUP):
+            loss = step_once()
+        float(loss.mean().asnumpy())  # hard sync
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = step_once()
+        float(loss.mean().asnumpy())
+        return batch * iters / (time.perf_counter() - t0)
+
+    _log("captured step %s: capture+warmup" % dtype)
+    net_c, tr_c = build()
+    program = tr_c.capture(net_c, gluon.loss.SoftmaxCrossEntropyLoss())
+    captured_ips = time_loop(lambda: program(x, y))
+    rep = program.report()
+    if rep["paths"]["captured"] == 0:
+        # capture degraded (e.g. dead backend quirk): the row must say
+        # so instead of mislabeling a stitched timing as captured
+        return {"error": "capture degraded: %s" % rep["fallbacks"][:1],
+                "report": rep}
+
+    _log("captured step %s: stitched reference timing" % dtype)
+    net_s, tr_s = build()
+
+    def stitched_step():
+        with trace.span("train_step", hist=False):
+            with autograd.record():
+                loss = loss_fn(net_s(x), y)
+            loss.backward()
+            tr_s.step(batch)
+        return loss
+
+    stitched_ips = time_loop(stitched_step)
+
+    _log("captured step %s: bit-parity check (%d steps)"
+         % (dtype, PARITY_STEPS))
+    net_p, tr_p = build(seed=1)
+    prog_p = tr_p.capture(net_p, gluon.loss.SoftmaxCrossEntropyLoss())
+    net_q, tr_q = build(seed=1)
+    for _ in range(PARITY_STEPS):
+        prog_p(x, y)
+        with autograd.record():
+            loss = loss_fn(net_q(x), y)
+        loss.backward()
+        tr_q.step(batch)
+    worst = 0.0
+    bitwise = True
+    for k, p in net_q.collect_params().items():
+        a = p.data().astype("float32").asnumpy()
+        b = net_p.collect_params()[k].data().astype("float32").asnumpy()
+        if not np.array_equal(a, b):
+            bitwise = False
+            denom = np.abs(a) + 1e-8
+            worst = max(worst, float(np.max(np.abs(a - b) / denom)))
+
+    row = {"imgs_per_sec": round(captured_ips, 2),
+           "stitched_imgs_per_sec": round(stitched_ips, 2),
+           "speedup_vs_stitched": round(captured_ips / stitched_ips, 3),
+           "batch": batch, "dtype": dtype,
+           "bit_parity": {"steps": PARITY_STEPS, "bitwise": bitwise,
+                          "worst_rel_diff": worst},
+           "capture": {"paths": rep["paths"],
+                       "fallbacks": rep["fallbacks"],
+                       "provenance": [p["provenance"]
+                                      for p in rep["programs"]],
+                       "segments": [s["segment"] for s in
+                                    rep["programs"][0]["segments"]]}}
+    if fused_ref and fused_ref.get("imgs_per_sec"):
+        row["vs_fused_trainer"] = round(
+            captured_ips / fused_ref["imgs_per_sec"], 3)
+    return row
+
+
 def main():
     extra = {}
     _log("start; budget %.0fs" % BUDGET_S)
@@ -617,6 +727,14 @@ def main():
             # O(groups) update programs/step vs ~160 eager chains)
             ("resnet50_imperative_trainer", _bench_imperative_trainer,
              "resnet50_imperative_trainer_bf16"),
+            # mx.step whole-step capture: fwd+loss+bwd+allreduce+apply
+            # as ONE donated XLA program/step; row carries the delta vs
+            # the stitched imperative path AND the FusedTrainer
+            # headline, plus a bit-parity check of final params
+            ("resnet50_captured_step",
+             lambda: _bench_captured_step(
+                 fused_ref=extra.get("resnet50_bf16")),
+             "resnet50_captured_step_bf16"),
             # flash fwd+bwd kernel vs blockwise recompute (VERDICT r3 #7)
             ("attention_T2k", lambda: _attn(2048), "attention_T2k"),
             ("attention_T8k", lambda: _attn(8192), "attention_T8k"),

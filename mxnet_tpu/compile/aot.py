@@ -101,6 +101,68 @@ def _deserialize(se, raw):
     return cfn, payload["key"]
 
 
+def attach_lowered(lowered, block_class, block_sig):
+    """Compile an already-lowered jax program, consulting / committing
+    the persistent cache when enabled.  The shared backend behind the
+    non-hybridize program caches — the multi-tensor optimizer groups
+    (optimizer/multi_tensor.py) and the whole-step captured programs
+    (mx.step) — which re-trace cheaply per process and hit purely by
+    StableHLO fingerprint, so their entries are never ``warm_start``
+    candidates (``portable: False``).
+
+    Returns ``(compiled_or_None, fingerprint, provenance)``:
+    ``provenance`` is ``"cache"`` on a disk hit (zero fresh XLA
+    compiles), else ``"fresh"``; ``None`` for the callable means even
+    the plain ``lowered.compile()`` failed and the caller should keep
+    its lazy-jit path.  Every cache failure degrades to a plain
+    compile — the hot path never raises from here."""
+    from . import get_cache, is_enabled
+
+    fingerprint = None
+    if is_enabled():
+        try:
+            cache = get_cache()
+            se = _serialize_api()
+            if cache is not None and se is not None:
+                fingerprint = cache.fingerprint(lowered.as_text())
+                try:
+                    loaded = cache.load(fingerprint)
+                except Exception:
+                    loaded = None
+                if loaded is not None:
+                    raw, _meta = loaded
+                    try:
+                        cfn, _key = _deserialize(se, raw)
+                        if telemetry.ENABLED:
+                            telemetry.COMPILE_CACHE_HIT.inc()
+                        return cfn, fingerprint, "cache"
+                    except Exception:
+                        cache.quarantine(
+                            fingerprint, reason="artifact undeserializable")
+                if telemetry.ENABLED:
+                    telemetry.COMPILE_CACHE_MISS.inc()
+                compiled = lowered.compile()
+                try:
+                    exe, in_tree, out_tree = se.serialize(compiled)
+                    artifact = pickle.dumps(
+                        {"exe": exe, "in_tree": in_tree,
+                         "out_tree": out_tree, "key": None})
+                    cache.commit(fingerprint, artifact, {
+                        "block_class": block_class,
+                        "block_sig": block_sig,
+                        "portable": False})
+                except Exception:
+                    _LOGGER.debug("program cache commit failed",
+                                  exc_info=True)
+                return compiled, fingerprint, "fresh"
+        except Exception:
+            _LOGGER.debug("program cache attach failed", exc_info=True)
+    try:
+        return lowered.compile(), fingerprint, "fresh"
+    except Exception:
+        return None, fingerprint, "fresh"
+
+
 # ---------------------------------------------------------------------------
 # live path: consult on miss, commit on build
 # ---------------------------------------------------------------------------

@@ -188,9 +188,27 @@ ENV_VARS = {
         "separated site@key[:kind][*count] entries (sites: "
         "trainer_step / collective / checkpoint_commit / "
         "checkpoint_marker / compile_commit / serve_dispatch / "
-        "serve_poison; kinds: transient / io / fatal / abort).  Faults "
-        "fire by (site, sequence), so every drill replays identically "
-        "(resilience/inject.py)."),
+        "serve_poison / step_capture; kinds: transient / io / fatal / "
+        "abort).  Faults fire by (site, sequence), so every drill "
+        "replays identically (resilience/inject.py).  The "
+        "step_capture site fires twice per captured step lifecycle: "
+        "at capture/build time (poisons the capture -> clean stitched "
+        "fallback) and at program dispatch (exercises the supervisor "
+        "rewind path)."),
+    "MXNET_STEP_CAPTURE": (
+        bool, True,
+        "Kill switch for mx.step whole-program training-step capture: "
+        "0 makes every StepProgram call run the stitched imperative "
+        "sequence (fwd/bwd/allreduce/apply as separate programs) "
+        "instead of the one donated whole-step XLA program "
+        "(step/capture.py).  Checked per call."),
+    "MXNET_STEP_REMAT": (
+        str, "off",
+        "Rematerialization policy inside the captured step program: "
+        "off (default) keeps activations live for backward; all wraps "
+        "forward+loss in one jax.checkpoint; blocks checkpoints each "
+        "direct-child Block boundary (best effort).  Trades backward "
+        "recompute for activation memory (step/capture.py)."),
     "MXNET_PREEMPT_INSTALL": (
         bool, False,
         "Arm the SIGTERM preemption handler at import: the supervisor "

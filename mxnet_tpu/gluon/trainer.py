@@ -56,6 +56,7 @@ class Trainer:
         self._step_count = 0
         self._params_to_init = list(self._params)
         self._mt_groups = {}   # multi-tensor fused update programs
+        self._step_programs = []  # weakrefs to mx.step StepPrograms
         self._monitor_kv_warned = False
         self._zero = zero
         self._zero_mesh = mesh
@@ -116,6 +117,39 @@ class Trainer:
 
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
+
+    # ---- whole-step capture (mx.step) -------------------------------------
+    def capture(self, block, loss_fn, **kwargs):
+        """Capture the WHOLE training step — ``block`` forward,
+        ``loss_fn``, backward, bucketed allreduce, this trainer's fused
+        optimizer apply, and the mx.monitor stat reductions — into one
+        donated XLA program (``mx.step.capture``).  The returned
+        ``StepProgram`` replaces the classic record/backward/step
+        triple: ``loss = program(data, label)``; it degrades to that
+        exact stitched sequence whenever capture cannot apply
+        (``MXNET_STEP_CAPTURE=0``, non-fusable optimizers, sparse
+        grads, any capture/compile failure), so adopting it is always
+        safe."""
+        from .. import step as _step
+
+        return _step.capture(block, loss_fn, trainer=self, **kwargs)
+
+    def _register_step_program(self, program):
+        import weakref
+
+        self._step_programs = [r for r in self._step_programs
+                               if r() is not None]
+        self._step_programs.append(weakref.ref(program))
+
+    def _invalidate_step_programs(self):
+        """Checkpoint restores rebind the optimizer-state arrays that
+        captured step programs were traced over — drop those programs
+        so the next step re-traces (cheap; the persistent compile
+        cache still serves the executable)."""
+        for ref in self._step_programs:
+            program = ref()
+            if program is not None:
+                program.invalidate()
 
     # ---- the step ---------------------------------------------------------
     def _maybe_init_states(self, i, param):
@@ -325,6 +359,7 @@ class Trainer:
             self._states = {k: _state_nd(v)
                             for k, v in pickle.load(f).items()}
         self._mt_groups.clear()  # fused programs close over live state
+        self._invalidate_step_programs()
         if self._zero:
             # re-establish the dp-sharded placement — a plain load would
             # leave every state replicated and silently void the ZeRO-1
@@ -456,6 +491,7 @@ class Trainer:
                 index_of[k]: int(v)
                 for k, v in updates["counts"].items() if k in index_of}
         self._mt_groups.clear()  # fused programs close over live state
+        self._invalidate_step_programs()
         if self._zero:
             self._states = {k: self._shard_state(v)
                             for k, v in self._states.items()}

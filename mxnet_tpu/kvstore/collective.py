@@ -68,6 +68,20 @@ def plan_buckets(sizes_dtypes, bucket_bytes=None):
     return plan
 
 
+def observe_bucket_fill(bucket_nbytes):
+    """Feed the ``allreduce_bucket_fill`` histogram from a precomputed
+    bucket plan (``[payload bytes per bucket]``).  The per-call bucketed
+    path observes fill inline in ``_allreduce_many``; a captured step
+    program (mx.step) reduces inside ONE whole-step XLA program where
+    that observation point never runs, so it feeds its static plan
+    through here each dispatch — keeping the two paths comparable in
+    telemetry."""
+    if not _tel.ENABLED:
+        return
+    for nbytes in bucket_nbytes:
+        _tel.ALLREDUCE_BUCKET_FILL.observe(nbytes / float(_BUCKET_BYTES))
+
+
 def _deadline(fn, site):
     """Run one collective phase under ``MXNET_DIST_COLLECTIVE_TIMEOUT``
     (mx.dist): a dead peer raises a transient-classified

@@ -40,8 +40,8 @@ from ..base import MXNetError, get_env
 from . import divergence, sentinel, stats
 
 __all__ = ["ENABLED", "enable", "disable", "is_enabled",
-           "observe_update", "flush", "summary", "reset",
-           "stream_path", "group_values"]
+           "observe_update", "observe_captured", "gate_and_publish",
+           "flush", "summary", "reset", "stream_path", "group_values"]
 
 _LOGGER = logging.getLogger("mxnet_tpu.monitor")
 
@@ -146,10 +146,26 @@ def observe_update(trainer, groups, eager):
         return "ok"
     if not entries:
         return "ok"
+    return gate_and_publish(step, entries, pol)
+
+
+def gate_and_publish(step, entries, pol):
+    """Shared sentinel gate + ring handoff for one observed step.
+
+    ``entries`` is ``[(label, stat_vec)]`` — device vectors (or
+    pre-unpacked host dicts) in ascending-param-index group order.
+    Sync policies fetch HERE (``monitor_fetch_seconds`` meters the
+    wait) and may veto the step (``"skip"``) or raise (policy=raise);
+    async policies enqueue without touching the device.  Both the
+    stitched ``observe_update`` hook and the captured-step path
+    (``observe_captured`` — stats computed INSIDE the step program)
+    funnel through this, so trip counters, divergence feed, warn logs
+    and the JSONL stream are identical across the two engines."""
     if pol in sentinel.SYNC_POLICIES:
         t0 = time.perf_counter()
         try:
-            host = {label: stats.unpack(_np.asarray(vec))
+            host = {label: vec if isinstance(vec, dict)
+                    else stats.unpack(_np.asarray(vec))
                     for label, vec in entries}
         except Exception:
             _LOGGER.warning("mx.monitor: synchronous stat fetch failed; "
@@ -192,6 +208,21 @@ def observe_update(trainer, groups, eager):
         return "ok"
     _enqueue(step, entries, pol, skipped=False, tripped=False)
     return "ok"
+
+
+def observe_captured(trainer, step, entries):
+    """Publish the fused stat vectors a captured step program (mx.step)
+    computed INSIDE the one whole-step XLA program — health numerics
+    with zero extra dispatches or readbacks beyond the program's own
+    outputs.  Returns ``"skip"`` when the sentinel verdict is a veto
+    (the program already where-selected no-op updates on device; the
+    caller rewinds its host-side count bookkeeping), ``"ok"``
+    otherwise; raises ``MXNetError`` under policy=raise.  Unlike the
+    stitched hook, stats arrive every captured step regardless of
+    ``MXNET_MONITOR_INTERVAL`` — they are free once fused."""
+    if not ENABLED or not entries:
+        return "ok"
+    return gate_and_publish(step, entries, sentinel.policy())
 
 
 # ---------------------------------------------------------------------------

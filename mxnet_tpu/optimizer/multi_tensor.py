@@ -422,62 +422,18 @@ def _make_fn(opt, indices, group):
 
 def _attach_cache(lowered, group):
     """Compile the lowered group program, consulting / committing the
-    mx.compile persistent store when enabled.  Returns ``(compiled,
-    provenance)``; ``(None, "fresh")`` leaves the lazy jit path."""
-    from .. import compile as _compile
+    mx.compile persistent store when enabled (the shared
+    ``compile.aot.attach_lowered`` backend; entries hit by StableHLO
+    fingerprint and are never warm_start candidates — the trainer
+    re-traces cheaply).  Returns ``(compiled, provenance)``;
+    ``(None, "fresh")`` leaves the lazy jit path."""
+    from ..compile.aot import attach_lowered
 
-    if _compile.is_enabled():
-        try:
-            import pickle
-
-            from ..compile.aot import _deserialize, _serialize_api
-
-            cache = _compile.get_cache()
-            se = _serialize_api()
-            if cache is not None and se is not None:
-                fp = cache.fingerprint(lowered.as_text())
-                group.fingerprint = fp
-                try:
-                    loaded = cache.load(fp)
-                except Exception:
-                    loaded = None
-                if loaded is not None:
-                    raw, _meta = loaded
-                    try:
-                        cfn, _key = _deserialize(se, raw)
-                        if _tel.ENABLED:
-                            _tel.COMPILE_CACHE_HIT.inc()
-                        return cfn, "cache"
-                    except Exception:
-                        cache.quarantine(
-                            fp, reason="artifact undeserializable")
-                if _tel.ENABLED:
-                    _tel.COMPILE_CACHE_MISS.inc()
-                compiled = lowered.compile()
-                try:
-                    exe, in_tree, out_tree = se.serialize(compiled)
-                    artifact = pickle.dumps(
-                        {"exe": exe, "in_tree": in_tree,
-                         "out_tree": out_tree, "key": None})
-                    cache.commit(fp, artifact, {
-                        "block_class": "_MultiTensorGroup",
-                        "block_sig": "multi_tensor:%s:%d"
-                                     % (group.opt_name,
-                                        len(group.indices)),
-                        # never a warm_start candidate: the trainer
-                        # re-traces (cheap) and hits by fingerprint
-                        "portable": False})
-                except Exception:
-                    _LOGGER.debug("multi-tensor cache commit failed",
-                                  exc_info=True)
-                return compiled, "fresh"
-        except Exception:
-            _LOGGER.debug("multi-tensor cache attach failed",
-                          exc_info=True)
-    try:
-        return lowered.compile(), "fresh"
-    except Exception:
-        return None, "fresh"
+    compiled, fp, provenance = attach_lowered(
+        lowered, "_MultiTensorGroup",
+        "multi_tensor:%s:%d" % (group.opt_name, len(group.indices)))
+    group.fingerprint = fp
+    return compiled, provenance
 
 
 def _build_group(trainer, key, indices, members_sig, hsig,

@@ -636,10 +636,15 @@ class GluonStepLoop:
     kvstore ``pushpull_all`` (the ``collective`` injection site) and
     the real multi-tensor update engine."""
 
-    def __init__(self, block, trainer, loss_fn):
+    def __init__(self, block, trainer, loss_fn, step_program=None):
         self._block = block
         self._trainer = trainer
         self._loss_fn = loss_fn
+        # optional mx.step whole-step captured program: the supervisor
+        # then drills the ONE-program path (fused fwd/bwd/allreduce/
+        # apply) — a transient at the step_capture site must rewind
+        # update counts exactly once before the restore-and-retry
+        self._step_program = step_program
 
     @property
     def block(self):
@@ -655,6 +660,8 @@ class GluonStepLoop:
 
         x = x if isinstance(x, nd.NDArray) else nd.array(x)
         y = y if isinstance(y, nd.NDArray) else nd.array(y)
+        if self._step_program is not None:
+            return self._step_program(x, y).mean()
         with autograd.record():
             loss = self._loss_fn(self._block(x), y)
         loss.backward()

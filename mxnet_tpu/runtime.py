@@ -36,6 +36,18 @@ def _monitor_enabled():
         return False
 
 
+def _step_capture_enabled():
+    """mx.step whole-program training-step capture: ON by default,
+    killed by MXNET_STEP_CAPTURE=0 (re-read per access — the kill
+    switch is checked per call)."""
+    try:
+        from . import step as _step
+
+        return _step.is_enabled()
+    except Exception:
+        return False
+
+
 class _DynamicFeature(Feature):
     """Feature whose enabled state is re-read on every access —
     COMPILE_CACHE toggles at runtime (compile.enable()/disable()), so
@@ -87,6 +99,8 @@ def _detect():
     out["COMPILE_CACHE"] = _DynamicFeature("COMPILE_CACHE",
                                            _compile_cache_enabled)
     out["MONITOR"] = _DynamicFeature("MONITOR", _monitor_enabled)
+    out["STEP_CAPTURE"] = _DynamicFeature("STEP_CAPTURE",
+                                          _step_capture_enabled)
     return out
 
 

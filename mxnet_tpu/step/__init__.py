@@ -1,0 +1,17 @@
+"""mx.step — whole-program training-step capture.
+
+``capture(net, loss_fn, trainer=trainer)`` returns a
+:class:`StepProgram`: one call = one full training step (forward,
+loss, backward, bucketed allreduce, fused optimizer apply, fused
+health numerics) executed as ONE donated XLA program, with the
+stitched imperative path as the always-available fallback
+(``MXNET_STEP_CAPTURE=0`` kill switch; every degradation is counted,
+never a lost step).  See ``capture.py`` for the design notes.
+"""
+from __future__ import annotations
+
+from .capture import (CaptureError, StepProgram, capture, is_enabled,
+                      remat_mode)
+
+__all__ = ["CaptureError", "StepProgram", "capture", "is_enabled",
+           "remat_mode"]

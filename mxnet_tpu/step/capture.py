@@ -1,0 +1,872 @@
+"""mx.step — whole-program training-step capture.
+
+Hybridize compiles one Block at a time, so the imperative training
+step (forward -> loss -> backward -> bucketed allreduce -> fused
+optimizer apply) is stitched from separate XLA programs with host
+round-trips between them.  Following Relay's whole-model IR argument
+(arXiv 1810.00952) and whole-graph capture/optimization (arXiv
+2604.16498), ``capture()`` traces the ENTIRE step into ONE jitted,
+end-to-end buffer-donated XLA program:
+
+- **forward + loss** through the block's pure export
+  (``HybridBlock.export_pure``) — the same pure function hybridize
+  compiles, so the math is the stitched math;
+- **backward** as one ``jax.vjp`` seeded with ones, exactly the
+  cotangent ``autograd.backward`` seeds on a non-scalar loss;
+- **per-bucket allreduce** over the ``plan_buckets()`` plan (kvstore/
+  collective.py).  Each bucket's reduction depends ONLY on its member
+  gradients — bucket-ordered dependency structure, no post-backward
+  barrier — so XLA is free to issue early buckets' collectives while
+  later layers still differentiate.  In a world of one the sum over
+  one replica is the identity; under an SPMD ``axis_name`` each
+  bucket is a ``lax.psum``;
+- **fused optimizer apply** replaying the PR 5 multi-tensor groups'
+  ``update_multi_precision`` rules in-trace, per-step host values
+  (scheduler lr/wd, rescale_grad, Adam bias corrections) flowing
+  through the same ``_HostScalar`` slot machinery — zero per-step
+  retraces and bit-identical scalar math vs the stitched path;
+- **fused health numerics**: the PR 7 monitor stat reductions
+  (grad/weight norms, nonfinite counts) computed inside the SAME
+  program — monitoring becomes free — and, under a sync sentinel
+  policy, a nonfinite predicate that where-selects NO-OP updates on
+  device (``skip_step`` without a separate stat fetch);
+- an opt-in **rematerialization policy** (``MXNET_STEP_REMAT``:
+  ``all`` = ``jax.checkpoint`` around forward+loss, ``blocks`` =
+  per direct-child Block boundary) trading backward-pass recompute
+  for activation memory.
+
+Parameters and optimizer state are DONATED into the program (the
+whole step is in-place at the XLA level), the lowered program
+fingerprints into the ``mx.compile`` persistent cache (a fresh
+process re-traces cheaply but never re-compiles an unchanged step),
+and every capture/compile/dispatch failure degrades to the stitched
+imperative path — counted by reason in
+``step_capture_fallback_total``, never a lost step.
+``MXNET_STEP_CAPTURE=0`` is the kill switch: the same ``StepProgram``
+callable then runs the stitched loop, so training scripts adopt it
+unconditionally.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import time as _time
+
+import numpy as _np
+
+from .. import telemetry as _tel
+from .. import trace as _trace
+from ..base import MXNetError, get_env
+from ..kvstore.collective import observe_bucket_fill, plan_buckets
+from ..ndarray.ndarray import NDArray
+from ..optimizer import multi_tensor as _mt
+from ..resilience import inject as _inject
+
+__all__ = ["StepProgram", "capture", "is_enabled", "CaptureError",
+           "remat_mode"]
+
+_LOGGER = logging.getLogger("mxnet_tpu.step")
+
+# index of g_nonfinite in monitor.stats.STAT_FIELDS — the gate
+# predicate reads it straight out of the fused stat vectors
+_G_NONFINITE = 5
+
+REMAT_MODES = ("off", "all", "blocks")
+
+
+def is_enabled():
+    """The ``MXNET_STEP_CAPTURE`` kill switch (default ON).  Checked
+    per call, so flipping it mid-run moves the very next step to the
+    stitched path."""
+    return get_env("MXNET_STEP_CAPTURE", bool, True)
+
+
+def remat_mode():
+    """The armed rematerialization policy (``MXNET_STEP_REMAT``):
+    ``off`` (default) keeps every activation live for backward;
+    ``all`` wraps forward+loss in one ``jax.checkpoint``; ``blocks``
+    checkpoints at each direct-child Block boundary (best effort — a
+    block whose forward mutates traced python state degrades to
+    ``all`` with a warning)."""
+    v = str(get_env("MXNET_STEP_REMAT", str, "off") or "off").lower()
+    if v in ("0", "", "none", "false"):
+        return "off"
+    if v in ("1", "true"):
+        return "all"
+    if v not in REMAT_MODES:
+        raise MXNetError("MXNET_STEP_REMAT=%r is not a remat policy "
+                         "(choose from %s)" % (v, "|".join(REMAT_MODES)))
+    return v
+
+
+class CaptureError(MXNetError):
+    """Whole-step capture is not possible for this trainer/signature;
+    the step runs stitched (``reason`` becomes the telemetry label)."""
+
+    def __init__(self, reason, detail=""):
+        super().__init__("step capture unavailable (%s)%s"
+                         % (reason, ": " + detail if detail else ""))
+        self.reason = reason
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def _bucket_allreduce(grads, plan_pos, axis_name):
+    """Reduce gradients bucket by bucket inside the captured program.
+
+    ``plan_pos`` is the ``plan_buckets`` output re-indexed to grad-list
+    positions.  Each bucket flattens ONLY its members and (under an
+    SPMD ``axis_name``) psums them as one collective — no dependency
+    on other buckets, so the XLA scheduler can overlap early buckets'
+    collectives with the still-running backward of later layers.
+    ``axis_name=None`` (a world of one) is the identity: summing one
+    replica's gradient is the gradient."""
+    if axis_name is None:
+        return list(grads)
+    import jax
+    import jax.numpy as jnp
+
+    out = list(grads)
+    for idxs in plan_pos:
+        if len(idxs) == 1:
+            j = idxs[0]
+            out[j] = jax.lax.psum(grads[j], axis_name)
+            continue
+        flat = jnp.concatenate([jnp.ravel(grads[j]) for j in idxs])
+        summed = jax.lax.psum(flat, axis_name)
+        off = 0
+        for j in idxs:
+            n = grads[j].size
+            out[j] = summed[off:off + n].reshape(grads[j].shape)
+            off += n
+    return out
+
+
+@contextlib.contextmanager
+def _remat_block_boundaries(root):
+    """Scope: wrap each DIRECT child of ``root`` in ``jax.checkpoint``
+    for the duration of one capture trace (``MXNET_STEP_REMAT=blocks``)
+    — activations inside a child are rematerialized during backward
+    instead of held live across the whole step."""
+    import jax
+
+    from ..gluon import block as _blk
+
+    boundaries = {id(c) for c in root._children.values()}
+    if not boundaries:
+        yield
+        return
+    orig = _blk.Block.__call__
+
+    def remat_call(self, *args, **kwargs):
+        if id(self) not in boundaries:
+            return orig(self, *args, **kwargs)
+        flat = []
+        in_spec = _blk._flatten_nd(list(args), flat)
+        nd_pos = [k for k, a in enumerate(flat) if isinstance(a, NDArray)]
+        datas = [flat[k]._data for k in nd_pos]
+        box = {}
+
+        def f(*ds):
+            merged = list(flat)
+            for k, d in zip(nd_pos, ds):
+                merged[k] = NDArray(d)
+            rebuilt = _blk._unflatten_nd(in_spec, iter(merged))
+            out = orig(self, *rebuilt, **kwargs)
+            flat_out = []
+            spec = _blk._flatten_nd(
+                out if isinstance(out, (list, tuple)) else [out], flat_out)
+            box["spec"] = spec
+            box["is_nd"] = [isinstance(o, NDArray) for o in flat_out]
+            box["static"] = [o for o in flat_out
+                             if not isinstance(o, NDArray)]
+            return tuple(o._data for o in flat_out
+                         if isinstance(o, NDArray))
+
+        outs = jax.checkpoint(f)(*datas)
+        nd_it, st_it = iter(outs), iter(box["static"])
+        flat2 = [NDArray(next(nd_it)) if is_nd else next(st_it)
+                 for is_nd in box["is_nd"]]
+        result = _blk._unflatten_nd(box["spec"], iter(flat2))
+        return result[0] if len(result) == 1 else tuple(result)
+
+    _blk.Block.__call__ = remat_call
+    try:
+        yield
+    finally:
+        _blk.Block.__call__ = orig
+
+
+class _Captured:
+    """One compiled whole-step signature (the _CachedOp/_Group analog
+    for the captured path)."""
+
+    __slots__ = ("sig", "train_idx", "train_names", "other_names",
+                 "group_list", "labels", "pos_of", "bucket_plan",
+                 "bucket_nbytes", "n_slots", "slot_fns", "jfn", "cfn",
+                 "cfn_ok", "fingerprint", "provenance", "gate",
+                 "monitor", "remat", "segments", "donation")
+
+    def __init__(self):
+        self.slot_fns = None
+        self.jfn = None
+        self.cfn = None
+        self.cfn_ok = False
+        self.fingerprint = None
+        self.provenance = "fresh"
+
+    def call(self, *args):
+        with _mt._quiet_donation():
+            if self.cfn is not None:
+                try:
+                    out = self.cfn(*args)
+                    self.cfn_ok = True
+                    return out
+                except Exception:
+                    if self.cfn_ok:
+                        raise  # served before: surface the real error
+                    self.cfn = None  # aval/placement drift: lazy jit
+                    if any(_mt._deleted(a) for a in args[0]):
+                        raise MXNetError(
+                            "captured step program failed after "
+                            "consuming its donated weight buffers")
+            return self.jfn(*args)
+
+
+class StepProgram:
+    """The whole training step as one callable.
+
+    ``program(data, label)`` runs forward, loss, backward, bucketed
+    allreduce, the fused optimizer apply and the monitor stat
+    reductions as ONE donated XLA program (captured lazily per input
+    signature) and returns the loss.  When capture is impossible —
+    kill switch, non-fusable optimizer, sparse grads, ZeRO trainer,
+    capture/compile failure — the SAME call runs the stitched
+    imperative sequence (``autograd.record`` forward, ``backward()``,
+    ``Trainer.step``), so the step is never lost and the callable is a
+    drop-in replacement for the classic three-line loop either way.
+    """
+
+    def __init__(self, block, trainer, loss_fn, axis_name=None):
+        from ..gluon.block import HybridBlock
+
+        if not isinstance(block, HybridBlock):
+            raise MXNetError(
+                "mx.step.capture needs a HybridBlock (whole-step "
+                "capture rides the block's pure export); got %r"
+                % type(block).__name__)
+        if not callable(loss_fn):
+            raise MXNetError("loss_fn must be callable")
+        self._block = block
+        self._trainer = trainer
+        self._loss_fn = loss_fn
+        self._axis_name = axis_name
+        self._programs = {}      # sig -> _Captured
+        self._dead = {}          # sig -> fallback reason (stitched for good)
+        self._remat_override = None  # blocks-mode failure degrades to all
+        self._fallbacks = []     # bounded log of degradations
+        self._path_counts = {"captured": 0, "stitched": 0}
+        self._skipped = 0
+        self._disabled_noted = False
+        try:
+            self._world = _jax().process_count()
+        except Exception:
+            self._world = 1
+
+    # ---- public surface ---------------------------------------------------
+    def __call__(self, data, label=None, batch_size=None):
+        datas = tuple(data) if isinstance(data, (list, tuple)) else (data,)
+        labels = () if label is None else (
+            tuple(label) if isinstance(label, (list, tuple)) else (label,))
+        if batch_size is None:
+            batch_size = datas[0].shape[0]
+        if not is_enabled():
+            if not self._disabled_noted:
+                self._disabled_noted = True
+                self._note_fallback("disabled", "MXNET_STEP_CAPTURE=0")
+            return self._stitched(datas, labels, batch_size)
+        cap = self._get_program(datas, labels)
+        if cap is None:
+            return self._stitched(datas, labels, batch_size)
+        fall_reason = None
+        try:
+            return self._run_captured(cap, datas, labels, batch_size)
+        except Exception as exc:
+            from ..resilience.inject import InjectedFault, InjectedIOError
+
+            if getattr(exc, "mx_step_no_fallback", False):
+                # raised AFTER the captured program ran (sentinel
+                # policy=raise, publish/bookkeeping errors): the step's
+                # device effects already happened (or were gated to
+                # no-ops) — a stitched replay would apply it TWICE
+                raise
+            if isinstance(exc, (InjectedFault, InjectedIOError)) or \
+                    getattr(exc, "mx_fault_kind", None) is not None:
+                # injected faults and DistTimeout carry resilience
+                # semantics — the supervisor owns recovery, a silent
+                # stitched replay here would hide the drill/failure
+                raise
+            if any(_mt._deleted(self._trainer._params[i].data()._data)
+                   for i in cap.train_idx):
+                raise MXNetError(
+                    "captured step failed after its donated weight "
+                    "buffers were consumed; parameter state is "
+                    "unrecoverable for this step") from exc
+            self._programs.pop(cap.sig, None)
+            if cap.remat == "blocks":
+                # a block whose forward mutates traced python state
+                # (BatchNorm running stats) cannot live inside a
+                # per-block jax.checkpoint — degrade the POLICY to
+                # whole-forward remat and recapture next step
+                self._remat_override = "all"
+                fall_reason = ("remat_blocks_degraded", repr(exc))
+                _LOGGER.warning(
+                    "mx.step: MXNET_STEP_REMAT=blocks failed for this "
+                    "model; degrading to remat=all", exc_info=True)
+            else:
+                self._dead[cap.sig] = "dispatch_error"
+                fall_reason = ("dispatch_error", repr(exc))
+                _LOGGER.warning(
+                    "mx.step: captured dispatch failed; step degrades "
+                    "to the stitched path", exc_info=True)
+        # outside the except block so a stitched failure isn't chained
+        # onto (and masked by) the captured one
+        self._note_fallback(*fall_reason)
+        return self._stitched(datas, labels, batch_size)
+
+    def step(self, data, label=None, batch_size=None):
+        """Alias of ``__call__`` (Trainer-protocol spelling)."""
+        return self(data, label=label, batch_size=batch_size)
+
+    def invalidate(self):
+        """Drop every captured program (checkpoint restore rebinds the
+        optimizer-state arrays the programs were traced over; the next
+        step re-traces — cheap — and re-hits the persistent cache)."""
+        self._programs.clear()
+        self._dead.clear()
+
+    def report(self):
+        """Capture report for ``tools/diagnose.py --step`` and tests:
+        per-signature segment list, donation map, remat policy,
+        provenance (fresh vs compile-cache hit), path counts and
+        fallback reasons."""
+        return {
+            "enabled": is_enabled(),
+            "world": self._world,
+            "axis_name": self._axis_name,
+            "paths": dict(self._path_counts),
+            "skipped_steps": self._skipped,
+            "programs": [{
+                "provenance": cap.provenance,
+                "fingerprint": cap.fingerprint,
+                "remat": cap.remat,
+                "monitor_fused": cap.monitor,
+                "gate": cap.gate,
+                "host_scalar_slots": len(cap.slot_fns or ()),
+                "segments": list(cap.segments),
+                "donation": dict(cap.donation),
+                "bucket_plan": [list(b) for b in cap.bucket_plan],
+            } for cap in self._programs.values()],
+            "fallbacks": list(self._fallbacks),
+        }
+
+    # ---- stitched fallback ------------------------------------------------
+    def _stitched(self, datas, labels, batch_size):
+        """The classic imperative sequence — always correct, never
+        fast-path dependent.  (No ``anomaly=`` on the outer span: the
+        nested ``trainer_step`` span already feeds the slow-step
+        detector.)"""
+        from .. import autograd
+
+        self._path_counts["stitched"] += 1
+        if _tel.ENABLED:
+            _tel.STEP_CAPTURE_STEPS.labels(path="stitched").inc()
+        with _trace.span("train_step", hist=False, args={"captured": 0}):
+            with _trace.span("forward", hist=False):
+                with autograd.record():
+                    out = self._block(*datas)
+                    loss = self._loss_fn(out, *labels)
+            with _trace.span("backward", hist=False):
+                loss.backward()
+            self._trainer.step(batch_size)
+        return loss
+
+    def _note_fallback(self, reason, detail=""):
+        if _tel.ENABLED:
+            _tel.STEP_CAPTURE_FALLBACKS.labels(reason=reason).inc()
+        _trace.instant("step_capture_fallback", cat="step",
+                       args={"reason": reason})
+        self._fallbacks.append({"reason": reason, "detail": str(detail)[:200],
+                                "step": self._trainer._step_count})
+        del self._fallbacks[:-32]
+
+    # ---- capture ----------------------------------------------------------
+    def _sig(self, datas, labels):
+        from .. import monitor as _mon
+        from ..contrib import amp as _amp
+        from ..monitor import sentinel as _sentinel
+
+        mon_on = _mon.core.ENABLED
+        gate = mon_on and _sentinel.policy() in _sentinel.SYNC_POLICIES
+        remat = self._remat_override or remat_mode()
+        return (tuple((tuple(x.shape), str(x.dtype)) for x in datas),
+                tuple((tuple(x.shape), str(x.dtype)) for x in labels),
+                mon_on, gate, _mt._hparams_sig(self._trainer._optimizer),
+                remat, _amp.is_active(), _amp.target_dtype())
+
+    def _get_program(self, datas, labels):
+        sig = self._sig(datas, labels)  # typo'd env values fail loud
+        reason = self._dead.get(sig)
+        if reason is not None:
+            return None
+        cap = self._programs.get(sig)
+        if cap is not None:
+            return cap
+        try:
+            with _trace.span("step_capture", hist=False,
+                             args={"step": self._trainer._step_count}):
+                cap = self._build(sig, datas, labels)
+        except Exception as exc:
+            from ..resilience.inject import InjectedFault, InjectedIOError
+
+            reason = getattr(exc, "reason", None) or (
+                "injected_fault" if isinstance(
+                    exc, (InjectedFault, InjectedIOError))
+                else "trace_error")
+            self._dead[sig] = reason
+            if reason == "trace_error" and sig[5] == "blocks":
+                # per-block checkpoints choked on this model's forward:
+                # degrade the remat POLICY, not the capture — the next
+                # step recaptures with whole-forward remat
+                self._remat_override = "all"
+                reason = "remat_blocks_degraded"
+            self._note_fallback(reason, repr(exc))
+            _LOGGER.warning(
+                "mx.step: capture failed (%s); this signature runs "
+                "stitched", reason, exc_info=True)
+            return None
+        self._programs[sig] = cap
+        return cap
+
+    def _build(self, sig, datas, labels):
+        jax = _jax()
+        trainer = self._trainer
+        opt = trainer._optimizer
+        block = self._block
+        # mx.resilience drill site: a planned fault here poisons the
+        # CAPTURE — the step must cleanly degrade to the stitched path
+        _inject.fire("step_capture", seq=trainer._step_count)
+        if not trainer._kv_initialized:
+            trainer._init_kvstore()
+        if trainer._update_on_kvstore:
+            raise CaptureError("update_on_kvstore")
+        if trainer._zero:
+            # the ZeRO replicate-in/scatter-home placement dance is a
+            # cross-device protocol, not a pure program (ROADMAP item 1
+            # shards the captured program instead)
+            raise CaptureError("zero_trainer")
+        if self._world > 1 and self._axis_name is None:
+            # cross-process collectives need the program to be SPMD
+            # over the global mesh — that is ROADMAP item 1 sharding
+            # THIS program, not something a per-process jit can capture
+            raise CaptureError("multi_process")
+        block._ensure_initialized(datas)  # resolve deferred shapes
+        items = []
+        for i, param in enumerate(trainer._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            trainer._maybe_init_states(i, param)
+            items.append((i, param, param.grad()))
+        if not items:
+            raise CaptureError("no_trainable_params")
+        groups, eager = _mt.partition(trainer, items)
+        if eager:
+            raise CaptureError("eager_members", eager[0][3])
+        named = block.collect_params()
+        name_of = {}
+        for n, p in named.items():
+            name_of.setdefault(id(p), n)
+        missing = [i for i, p, _ in items if id(p) not in name_of]
+        if missing:
+            raise CaptureError("params_not_in_block",
+                               "trainer indices %s" % missing[:5])
+
+        from ..monitor.core import _group_label
+
+        cap = _Captured()
+        cap.sig = sig
+        cap.train_idx = tuple(i for i, _, _ in items)
+        cap.pos_of = {i: j for j, i in enumerate(cap.train_idx)}
+        cap.train_names = [name_of[id(p)] for _, p, _ in items]
+        train_set = set(cap.train_names)
+        cap.other_names = [n for n in named if n not in train_set]
+        cap.group_list = [
+            (_group_label(trainer, key, members),
+             tuple(i for i, _, _ in members))
+            for key, members in groups.items()]
+        cap.labels = [label for label, _ in cap.group_list]
+        cap.monitor = bool(sig[2])
+        cap.gate = bool(sig[3])
+        cap.remat = sig[5]
+        grad_arrs = [g._data for _, _, g in items]
+        cap.bucket_plan = plan_buckets(
+            [(a.size * a.dtype.itemsize, str(a.dtype))
+             for a in grad_arrs])
+        cap.bucket_nbytes = [
+            sum(grad_arrs[j].size * grad_arrs[j].dtype.itemsize
+                for j in bucket)
+            for bucket in cap.bucket_plan]
+        cap.n_slots = 12 * len(items) + 8
+        w_bytes = sum(p.data()._data.size * p.data()._data.dtype.itemsize
+                      for _, p, _ in items)
+        s_leaves = [leaf for i in cap.train_idx
+                    for leaf in jax.tree_util.tree_leaves(
+                        _mt._unwrap_state(trainer._states[i]))]
+        s_bytes = sum(a.size * a.dtype.itemsize for a in s_leaves)
+        cap.donation = {
+            "params": {"arrays": len(items), "bytes": int(w_bytes),
+                       "donated": True},
+            "optimizer_state": {"arrays": len(s_leaves),
+                                "bytes": int(s_bytes), "donated": True},
+            "forward_only_params": {"arrays": len(cap.other_names),
+                                    "donated": False},
+        }
+        cap.segments = [
+            {"segment": "forward", "params": len(named),
+             "remat": cap.remat},
+            {"segment": "loss", "fn": type(self._loss_fn).__name__},
+            {"segment": "backward", "grads": len(items)},
+            {"segment": "allreduce", "buckets": len(cap.bucket_plan),
+             "world": self._world,
+             "bytes": int(sum(cap.bucket_nbytes)),
+             "axis": self._axis_name},
+        ]
+        if cap.monitor:
+            cap.segments.append({"segment": "stats",
+                                 "groups": len(cap.group_list)})
+        cap.segments.append({"segment": "apply",
+                             "groups": len(cap.group_list),
+                             "optimizer": type(opt).__name__})
+        if cap.gate:
+            cap.segments.append({"segment": "gate",
+                                 "policy": "sync-sentinel"})
+        for seg in cap.segments:
+            _trace.instant("step_segment", cat="step", args=seg)
+
+        step_fn = self._make_step_fn(cap)
+        cap.jfn = jax.jit(step_fn, donate_argnums=(0, 1))
+        train_datas = [p.data()._data for _, p, _ in items]
+        state_trees = [_mt._unwrap_state(trainer._states[i])
+                       for i in cap.train_idx]
+        other_datas = [named[n]._data._data for n in cap.other_names]
+        hscal0 = _np.zeros((cap.n_slots,), _np.float32)
+        rng0 = jax.random.PRNGKey(0)
+        args = (train_datas, state_trees, other_datas, hscal0, rng0,
+                [x._data for x in datas], [y._data for y in labels])
+        lowered = None
+        with _mt._quiet_donation():
+            with _trace.span("step_trace", hist=False):
+                try:
+                    lowered = cap.jfn.lower(*args)
+                except Exception:
+                    # no AOT lowering on this backend: one abstract
+                    # trace still discovers the slot closures; jfn
+                    # compiles lazily on first call
+                    jax.eval_shape(step_fn, *args)
+            if cap.slot_fns is None:
+                raise CaptureError("trace_error",
+                                   "no host state recorded")
+            if lowered is not None:
+                from ..compile.aot import attach_lowered
+
+                with _trace.span("step_compile", hist=False):
+                    cap.cfn, cap.fingerprint, cap.provenance = \
+                        attach_lowered(
+                            lowered, "_StepProgram",
+                            "step:%s:%s:%d" % (type(block).__name__,
+                                               type(opt).__name__,
+                                               len(items)))
+        if _tel.ENABLED:
+            _tel.STEP_CAPTURE_BUILDS.inc()
+        _LOGGER.info(
+            "mx.step: captured whole-step program (%d params, %d "
+            "groups, %d buckets, remat=%s, monitor=%s, provenance=%s)",
+            len(items), len(cap.group_list), len(cap.bucket_plan),
+            cap.remat, cap.monitor, cap.provenance)
+        return cap
+
+    def _make_step_fn(self, cap):
+        """The pure whole-step function ONE signature jit-compiles."""
+        jax = _jax()
+        import jax.numpy as jnp
+
+        from ..monitor import stats as _mstats
+
+        trainer = self._trainer
+        opt = trainer._optimizer
+        loss_fn = self._loss_fn
+        block = self._block
+        apply_fn, _ = block.export_pure(training=True)
+        train_names = list(cap.train_names)
+        other_names = list(cap.other_names)
+        pos_of = dict(cap.pos_of)
+        group_list = list(cap.group_list)
+        train_idx = cap.train_idx
+        plan_pos = [[pos_of[train_idx[j]] for j in bucket]
+                    for bucket in cap.bucket_plan]
+        axis_name = self._axis_name
+        remat = cap.remat
+        monitor_on = cap.monitor
+        gate = cap.gate
+
+        def step_fn(train_datas, state_trees, other_datas, hscal, rng,
+                    input_datas, label_datas):
+            base = dict(zip(other_names, other_datas))
+
+            def fwd(tds):
+                pd = dict(base)
+                pd.update(zip(train_names, tds))
+                ctx = contextlib.nullcontext() if remat != "blocks" \
+                    else _remat_block_boundaries(block)
+                with ctx:
+                    outs, states = apply_fn(pd, rng, *input_datas)
+                outs_nd = [NDArray(o) for o in outs]
+                out = outs_nd[0] if len(outs_nd) == 1 else tuple(outs_nd)
+                loss = loss_fn(out, *[NDArray(y) for y in label_datas])
+                if not isinstance(loss, NDArray):
+                    raise CaptureError("loss_not_ndarray",
+                                       type(loss).__name__)
+                return loss._data, states
+
+            fwd2 = jax.checkpoint(fwd) if remat == "all" else fwd
+            # ones cotangent == autograd.backward's seed on a
+            # non-scalar loss: grads are d(sum(loss))/dw
+            loss, vjp, states = jax.vjp(fwd2, list(train_datas),
+                                        has_aux=True)
+            (grads,) = vjp(jnp.ones_like(loss))
+            grads = _bucket_allreduce(list(grads), plan_pos, axis_name)
+            statvecs = []
+            if monitor_on:
+                for _label, idxs in group_list:
+                    w = [train_datas[pos_of[i]] for i in idxs]
+                    g = [grads[pos_of[i]] for i in idxs]
+                    statvecs.append(_mstats._stat_fn(w, g))
+            ok = None
+            if gate:
+                nf = jnp.float32(0.0)
+                for vec in statvecs:
+                    nf = nf + vec[_G_NONFINITE]
+                ok = nf == 0
+            tr = _mt._Trace(hscal)
+            new_w = list(train_datas)
+            new_s = list(state_trees)
+            with _mt._trace_hparams(opt, tr):
+                for _label, idxs in group_list:
+                    for i in idxs:
+                        j = pos_of[i]
+                        w = NDArray(train_datas[j])
+                        g = NDArray(grads[j])
+                        st = jax.tree_util.tree_map(NDArray,
+                                                    state_trees[j])
+                        opt.update_multi_precision(i, w, g, st)
+                        new_w[j] = w._data
+                        new_s[j] = _mt._unwrap_state(st)
+            cap.slot_fns = tr.fns
+            if ok is not None:
+                # skip_step INSIDE the program: a nonfinite grad
+                # where-selects the untouched inputs — bit-identical
+                # to never launching the update, no separate fetch
+                new_w = [jnp.where(ok, n, o)
+                         for n, o in zip(new_w, train_datas)]
+                new_s = [jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(ok, n, o), n, o)
+                    for n, o in zip(new_s, state_trees)]
+            return new_w, new_s, states, loss, statvecs
+
+        return step_fn
+
+    # ---- captured dispatch ------------------------------------------------
+    def _run_captured(self, cap, datas, labels, batch_size):
+        jax = _jax()
+        from .. import monitor as _mon
+        from .. import random as _mxrandom
+
+        trainer = self._trainer
+        opt = trainer._optimizer
+        step = trainer._step_count
+        t0 = _time.perf_counter() if _tel.ENABLED else 0.0
+        with _trace.span("train_step", hist=False, anomaly=True,
+                         args={"step": step, "captured": 1}), \
+                _trace.watchdog.watch("train_step"):
+            opt.rescale_grad = trainer._scale / batch_size
+            named = self._block.collect_params()
+            w_handles = [trainer._params[i].data() for i in cap.train_idx]
+            train_datas = [h._data for h in w_handles]
+            state_trees = [_mt._unwrap_state(trainer._states[i])
+                           for i in cap.train_idx]
+            other_datas = [named[n]._data._data for n in cap.other_names]
+            rng = _mxrandom.take_key()
+            # the real host bookkeeping the traced no-ops stand in for;
+            # snapshot first so a failed/vetoed launch rewinds exactly
+            # once (Adam bias-correction t must not advance for a step
+            # that never applied)
+            counts = opt._index_update_count
+            prev_counts = {i: counts.get(i) for i in cap.train_idx}
+            prev_num_update = opt.num_update
+            for i in cap.train_idx:
+                opt._update_count(i)
+            try:
+                # mx.resilience drill site, AFTER the count bump: a
+                # transient here exercises the supervisor rewind path
+                _inject.fire("step_capture", seq=step)
+                with _trace.span("step_slots", hist=False):
+                    vals = _np.zeros((cap.n_slots,), _np.float32)
+                    for k, f in enumerate(cap.slot_fns):
+                        vals[k] = f()
+                with _trace.span("step_dispatch", hist=False,
+                                 args={"groups": len(cap.group_list),
+                                       "buckets": len(cap.bucket_plan)}):
+                    out = self._dispatch(
+                        cap, train_datas, state_trees, other_datas,
+                        vals, rng, [x._data for x in datas],
+                        [y._data for y in labels])
+            except Exception:
+                self._rewind(prev_counts, prev_num_update)
+                raise
+            # from here on the program RAN: its device effects are
+            # real (or were gated to no-ops), so any error below must
+            # surface as-is — a stitched replay would apply the step
+            # twice.  __call__ honors the mx_step_no_fallback tag.
+            try:
+                new_w, new_s, aux_states, loss, statvecs = out
+                with _trace.span("step_writeback", hist=False):
+                    for j, i in enumerate(cap.train_idx):
+                        w_handles[j]._data = new_w[j]
+                        st = trainer._states[i]
+                        if st is not None:
+                            jax.tree_util.tree_map(_wb, st, new_s[j],
+                                                   is_leaf=_mt._is_nd)
+                    # functionalized forward state (BatchNorm running
+                    # stats etc.) updates on EVERY step, skipped or
+                    # not — exactly like the stitched path, whose
+                    # forward ran before the sentinel verdict
+                    for pkey, val in aux_states.items():
+                        p = named.get(pkey)
+                        if p is not None:
+                            p._data._data = val
+                applied = True
+                if cap.monitor:
+                    entries = list(zip(cap.labels, statvecs))
+                    with _trace.span("step_publish", hist=False):
+                        try:
+                            verdict = _mon.core.observe_captured(
+                                trainer, step, entries)
+                        except MXNetError:
+                            # policy=raise: the program gated updates
+                            # to no-ops on device; rewind the host
+                            # counters before surfacing
+                            self._rewind(prev_counts, prev_num_update)
+                            raise
+                    if verdict == "skip":
+                        self._rewind(prev_counts, prev_num_update)
+                        self._skipped += 1
+                        applied = False
+                if applied:
+                    trainer._step_count += 1
+                self._path_counts["captured"] += 1
+                if self._world > 1 or self._axis_name is not None:
+                    # the stitched path only observes bucket fill when
+                    # collectives actually run; mirror that so the two
+                    # paths stay comparable (a world of one reduces
+                    # nothing)
+                    observe_bucket_fill(cap.bucket_nbytes)
+                if _tel.ENABLED:
+                    _tel.STEP_CAPTURE_STEPS.labels(path="captured").inc()
+                    _tel.STEP_PROGRAM_SECONDS.observe(
+                        _time.perf_counter() - t0)
+            except Exception as exc:
+                exc.mx_step_no_fallback = True
+                raise
+        return NDArray(loss)
+
+    def _dispatch(self, cap, *args):
+        """Launch the captured program, bounded by the mx.dist
+        collective deadline when one is armed in a multi-process world
+        (the whole captured dispatch IS the collective phase)."""
+        if self._world <= 1:
+            return cap.call(*args)
+        from ..dist import timeouts as _dt
+
+        timeout = _dt.collective_timeout()
+        if not timeout or timeout <= 0:
+            return cap.call(*args)
+        try:
+            return _dt.run_with_deadline(lambda: cap.call(*args),
+                                         site="step_capture",
+                                         timeout=timeout)
+        except _dt.DistTimeout as exc:
+            # unlike the stitched allreduce (which times out BEFORE any
+            # optimizer mutation), a captured program may have consumed
+            # its donated buffers mid-flight: the state is suspect and
+            # must not be emergency-saved
+            exc.mx_state_clean = False
+            raise
+
+    def _rewind(self, prev_counts, prev_num_update):
+        opt = self._trainer._optimizer
+        counts = opt._index_update_count
+        for i, v in prev_counts.items():
+            if v is None:
+                counts.pop(i, None)
+            else:
+                counts[i] = v
+        opt.num_update = prev_num_update
+
+
+def _wb(old, new):
+    old._data = new
+    return old
+
+
+def capture(block_or_trainer, loss_fn, trainer=None, block=None,
+            axis_name=None):
+    """Capture the whole training step — ``block`` forward, ``loss_fn``
+    loss, backward, bucketed allreduce, fused optimizer apply and the
+    monitor stat reductions — into one donated XLA program.
+
+    Accepts the block or the trainer first (``capture(net, loss_fn,
+    trainer=t)`` / ``capture(t, loss_fn, block=net)``); both must be
+    supplied.  Returns a :class:`StepProgram`; each call of it runs one
+    full training step (``program(data, label)`` -> loss) and degrades
+    to the stitched imperative path whenever capture cannot apply.
+    ``axis_name`` names the SPMD mesh axis bucket allreduces psum over
+    (a world of one needs none).  The program registers with the
+    trainer so checkpoint restores invalidate captured traces."""
+    from ..gluon.trainer import Trainer
+
+    obj = block_or_trainer
+    if isinstance(obj, Trainer):
+        if trainer is not None and trainer is not obj:
+            raise MXNetError("capture: two different trainers supplied")
+        trainer = obj
+    else:
+        if block is not None and block is not obj:
+            raise MXNetError("capture: two different blocks supplied")
+        block = obj
+    if trainer is None:
+        raise MXNetError(
+            "mx.step.capture needs the gluon.Trainer that owns the "
+            "parameters: capture(net, loss_fn, trainer=trainer)")
+    if block is None:
+        raise MXNetError(
+            "mx.step.capture needs the HybridBlock to capture: "
+            "capture(trainer, loss_fn, block=net)")
+    prog = StepProgram(block, trainer, loss_fn, axis_name=axis_name)
+    register = getattr(trainer, "_register_step_program", None)
+    if register is not None:
+        register(prog)
+    return prog

@@ -862,6 +862,30 @@ SERVE_NONFINITE_BATCHES = counter(
     "serve_nonfinite_batches_total",
     "dispatched micro-batches containing at least one nonfinite "
     "output element")
+# mx.step (step/): whole-program training-step capture — forward,
+# loss, backward, bucketed allreduce, fused optimizer apply and the
+# monitor stat reductions traced into ONE donated XLA program per
+# step.  The stitched imperative path stays the always-correct
+# fallback; every degradation is counted by reason, never a lost step.
+STEP_CAPTURE_BUILDS = counter(
+    "step_capture_builds_total",
+    "whole-step captured program builds (trace + compile; steady "
+    "state: one per (input-signature, optimizer-hparams, monitor "
+    "mode) — zero per-step retraces)")
+STEP_CAPTURE_STEPS = counter(
+    "step_capture_steps_total",
+    "training steps executed through mx.step, by path "
+    "(captured = one whole-step XLA program; stitched = the "
+    "imperative fwd/bwd/allreduce/apply sequence)", ("path",))
+STEP_CAPTURE_FALLBACKS = counter(
+    "step_capture_fallback_total",
+    "captured-step degradations to the stitched path, by reason "
+    "(capture/compile/dispatch failure, kill switch, unsupported "
+    "trainer shape) — the step is still applied", ("reason",))
+STEP_PROGRAM_SECONDS = histogram(
+    "step_program_seconds",
+    "captured whole-step program host latency per step (slot eval + "
+    "dispatch + writeback; the program itself runs async)")
 # mx.resilience (resilience/): deterministic fault injection,
 # preemption handling, and the hardened restart supervisor — plus the
 # serve-side graceful-degradation counters (bisect/poison/breakers).

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""One fresh-interpreter stage of the step_smoke warm-start drill:
+capture + run 3 whole-step programs against the shared compile-cache
+dir in argv[1], then print a JSON line with the capture provenance and
+a digest of the trained params (the parent asserts the second process
+reports provenance=cache with the identical digest)."""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import compile as mxcompile
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.gluon import nn
+
+    mxcompile.enable(dir=sys.argv[1])
+    mx.random.seed(7)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=12),
+            nn.Dense(4, in_units=16))
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    program = trainer.capture(net, gluon.loss.L2Loss())
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.rand(8, 12).astype(np.float32))
+    y = nd.array(rs.rand(8, 4).astype(np.float32))
+    for _ in range(3):
+        program(x, y)
+    rep = program.report()
+    assert rep["paths"]["captured"] == 3, rep
+    digest = hashlib.sha256()
+    for k in sorted(net.collect_params()):
+        digest.update(net.collect_params()[k].data().asnumpy().tobytes())
+    print(json.dumps({"provenance": rep["programs"][0]["provenance"],
+                      "params_digest": digest.hexdigest()}))
+
+
+if __name__ == "__main__":
+    main()

@@ -355,6 +355,76 @@ def trainer_info():
     print("telemetry    : %s" % (tot or "(telemetry disabled)"))
 
 
+def step_info():
+    """Print the mx.step capture report by capturing a representative
+    whole-step program (tiny MLP + Adam + monitor fused in) and
+    running it for 2 steps: segment list, donation map, remat policy,
+    provenance (fresh vs compile-cache hit), bucket plan, path counts
+    and fallback reasons if degraded."""
+    section("Whole-step capture (mx.step)")
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, monitor, nd, step, telemetry
+    from mxnet_tpu.gluon import nn
+
+    print("capture      :", "enabled" if step.is_enabled() else
+          "DISABLED (MXNET_STEP_CAPTURE=0 — stitched path)")
+    print("remat policy :", step.remat_mode())
+    mon_was = monitor.core.ENABLED
+    monitor.enable()
+    try:
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(32, activation="relu", in_units=32),
+                nn.Dense(8, in_units=32))
+        net.initialize()
+        net.hybridize()
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": 0.01})
+        program = trainer.capture(net, gluon.loss.L2Loss())
+        rs = np.random.RandomState(0)
+        x = nd.array(rs.rand(4, 32).astype(np.float32))
+        y = nd.array(rs.rand(4, 8).astype(np.float32))
+        for _ in range(2):
+            program(x, y)
+        rep = program.report()
+    finally:
+        if not mon_was:
+            monitor.disable()
+    print("paths        : captured=%d stitched=%d skipped=%d"
+          % (rep["paths"]["captured"], rep["paths"]["stitched"],
+             rep["skipped_steps"]))
+    for prog in rep["programs"]:
+        print("program      : provenance=%s  remat=%s  monitor=%s  "
+              "gate=%s  host-scalar slots=%d"
+              % (prog["provenance"], prog["remat"],
+                 prog["monitor_fused"], prog["gate"],
+                 prog["host_scalar_slots"]))
+        print("  fingerprint: %s" % (prog["fingerprint"] or
+                                     "(cache disabled / no lowering)"))
+        print("  segments   :")
+        for seg in prog["segments"]:
+            extras = {k: v for k, v in seg.items() if k != "segment"}
+            print("    %-10s %s" % (seg["segment"], extras))
+        print("  donation   :")
+        for name, d in prog["donation"].items():
+            print("    %-20s %s" % (name, d))
+        print("  bucket plan: %d bucket(s) %s"
+              % (len(prog["bucket_plan"]),
+                 [len(b) for b in prog["bucket_plan"]]))
+    if rep["fallbacks"]:
+        print("fallbacks    :")
+        for f in rep["fallbacks"]:
+            print("  step %-5s %-24s %s"
+                  % (f["step"], f["reason"], f["detail"]))
+    else:
+        print("fallbacks    : (none)")
+    tot = {k: v for k, v in telemetry.totals(nonzero=True).items()
+           if k.startswith("step_")}
+    print("telemetry    : %s" % (tot or "(telemetry disabled)"))
+
+
 def _monitor_table(rows):
     """Print one aligned row per parameter group from {label: stats}
     dicts carrying grad/weight norm, max|x|, nonfinite counts."""
@@ -713,6 +783,11 @@ def main():
                     help="audit the imperative Trainer's multi-tensor "
                          "update engine: group table, programs/step, "
                          "collective bucket fill")
+    ap.add_argument("--step", action="store_true",
+                    help="audit mx.step whole-step capture: capture a "
+                         "representative program and print segments, "
+                         "donation map, remat policy, provenance, "
+                         "bucket plan and fallback reasons")
     ap.add_argument("--trace", action="store_true",
                     help="dump the mx.trace plane: flight-recorder "
                          "occupancy, watchdog state, anomaly "
@@ -738,7 +813,7 @@ def main():
     # section flags compose: --compile-cache --serve URL prints both
     # (each skips the environment dump, all honor --telemetry)
     if args.compile_cache or args.serve or args.checkpoints or \
-            args.trainer or args.trace or args.monitor or \
+            args.trainer or args.step or args.trace or args.monitor or \
             args.resilience or args.dist is not None:
         if args.compile_cache:
             compile_cache_info()
@@ -748,6 +823,8 @@ def main():
             dist_info(args.dist or None)
         if args.trainer:
             trainer_info()
+        if args.step:
+            step_info()
         if args.monitor:
             monitor_info(args.monitor)
         if args.serve:

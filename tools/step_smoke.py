@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""mx.step whole-step capture smoke (make step-smoke, CPU).
+
+Drills the tentpole contracts end to end on a tiny MLP:
+
+1. capture -> ONE executable: one step_capture build, and during
+   captured steps ZERO cachedop / fused-group / monitor-stat builds
+   (the monitor stat reductions ride inside the same program);
+2. bit-identical params AND optimizer state vs the stitched
+   record/backward/Trainer.step path after several steps;
+3. skip_step INSIDE the program: a NaN batch under
+   MXNET_MONITOR_SENTINEL=skip_step mutates nothing (params, state,
+   update counts, step counter all untouched);
+4. clean fallback: a fault planned at the PR 8 ``step_capture`` site
+   poisons the capture — the step runs stitched, is still applied,
+   and the degradation is counted;
+5. persistent warm start: a FRESH interpreter re-captures the same
+   step against a shared mx.compile cache dir and restores the
+   executable (provenance=cache, zero fresh XLA compiles), with
+   bit-identical trained params.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+STEPS = 5
+
+
+def build(seed=7):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=12),
+            nn.Dense(4, in_units=16))
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    return net, trainer
+
+
+def batch():
+    import numpy as np
+
+    from mxnet_tpu import nd
+
+    rs = np.random.RandomState(0)
+    return (nd.array(rs.rand(8, 12).astype(np.float32)),
+            nd.array(rs.rand(8, 4).astype(np.float32)))
+
+
+def main():
+    import numpy as np
+
+    import jax
+    from mxnet_tpu import autograd, gluon, monitor, resilience, telemetry
+
+    telemetry.enable()
+    x, y = batch()
+
+    # 1. captured run: one executable, no satellite builds ------------
+    monitor.enable()
+    net_c, tr_c = build()
+    program = tr_c.capture(net_c, gluon.loss.L2Loss())
+    names = ("step_capture_builds_total", "cachedop_build_total",
+             "trainer_fused_builds_total", "monitor_stat_builds_total")
+    before = {n: telemetry.value(n) for n in names}
+    for _ in range(STEPS):
+        program(x, y)
+    deltas = {n: telemetry.value(n) - before[n] for n in names}
+    assert deltas["step_capture_builds_total"] == 1, deltas
+    for n in names[1:]:
+        assert deltas[n] == 0, \
+            "captured steps must not build %s: %s" % (n, deltas)
+    rep = program.report()
+    assert rep["paths"] == {"captured": STEPS, "stitched": 0}, rep
+    print("[step-smoke] %d steps -> ONE executable (builds: %s)"
+          % (STEPS, {k: int(v) for k, v in deltas.items()}))
+
+    # 2. bit parity vs the stitched path ------------------------------
+    net_s, tr_s = build()
+    loss_fn = gluon.loss.L2Loss()
+    for _ in range(STEPS):
+        with autograd.record():
+            loss = loss_fn(net_s(x), y)
+        loss.backward()
+        tr_s.step(x.shape[0])
+    for k, p in net_s.collect_params().items():
+        np.testing.assert_array_equal(
+            p.data().asnumpy(),
+            net_c.collect_params()[k].data().asnumpy(), err_msg=k)
+    for i in tr_s._states:
+        for a, b in zip(jax.tree_util.tree_leaves(tr_s._states[i]),
+                        jax.tree_util.tree_leaves(tr_c._states[i])):
+            np.testing.assert_array_equal(np.asarray(a._data),
+                                          np.asarray(b._data))
+    assert tr_s._optimizer.num_update == tr_c._optimizer.num_update
+    print("[step-smoke] bit-identical params + optimizer state vs "
+          "stitched after %d steps" % STEPS)
+
+    # 3. skip_step inside the program mutates nothing -----------------
+    os.environ["MXNET_MONITOR_SENTINEL"] = "skip_step"
+    try:
+        params0 = {k: p.data().asnumpy().copy()
+                   for k, p in net_c.collect_params().items()}
+        counts0 = dict(tr_c._optimizer._index_update_count)
+        sc0 = tr_c._step_count
+        xbad = np.array(x.asnumpy())
+        xbad[2] = np.nan
+        from mxnet_tpu import nd
+
+        program(nd.array(xbad), y)
+        for k, p in net_c.collect_params().items():
+            np.testing.assert_array_equal(params0[k],
+                                          p.data().asnumpy(), err_msg=k)
+        assert dict(tr_c._optimizer._index_update_count) == counts0
+        assert tr_c._step_count == sc0
+        assert monitor.core.flush(5)
+        assert monitor.summary()["skipped_steps"] == 1
+    finally:
+        del os.environ["MXNET_MONITOR_SENTINEL"]
+    monitor.disable()
+    print("[step-smoke] skip_step inside the program mutated nothing")
+
+    # 4. poisoned capture -> clean stitched fallback ------------------
+    resilience.plan("step_capture@0")
+    try:
+        net_f, tr_f = build()
+        prog_f = tr_f.capture(net_f, gluon.loss.L2Loss())
+        fb_before = telemetry.value("step_capture_fallback_total")
+        prog_f(x, y)
+        rep = prog_f.report()
+        assert rep["paths"] == {"captured": 0, "stitched": 1}, rep
+        assert rep["fallbacks"][0]["reason"] == "injected_fault", rep
+        assert tr_f._step_count == 1, "the degraded step was LOST"
+        assert telemetry.value("step_capture_fallback_total") \
+            - fb_before == 1
+    finally:
+        resilience.inject.clear()
+    print("[step-smoke] poisoned capture degraded cleanly "
+          "(step applied, fallback counted)")
+
+    # 5. fresh-process compile-cache warm start ----------------------
+    import json
+    import subprocess
+    import tempfile
+
+    stage = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "_step_smoke_stage.py")
+    with tempfile.TemporaryDirectory() as cache_dir:
+        outs = []
+        for _ in range(2):
+            proc = subprocess.run(
+                [sys.executable, stage, cache_dir],
+                capture_output=True, text=True, timeout=300,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"))
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            outs.append(json.loads(proc.stdout.splitlines()[-1]))
+    assert outs[0]["provenance"] == "fresh", outs[0]
+    assert outs[1]["provenance"] == "cache", \
+        "fresh process did not warm-start the step program: %s" % outs[1]
+    assert outs[0]["params_digest"] == outs[1]["params_digest"], \
+        "cache-restored step program diverged from the fresh compile"
+    print("[step-smoke] fresh process warm-started the captured step "
+          "from the compile cache (bit-identical)")
+    print("[step-smoke] OK")
+
+
+if __name__ == "__main__":
+    main()
