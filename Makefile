@@ -11,7 +11,8 @@ SO := build/libmxtpu_native.so
 
 .PHONY: native test cpptest telemetry-smoke checkpoint-smoke serve-smoke \
 	compile-cache-smoke trainer-smoke step-smoke trace-smoke \
-	monitor-smoke faults-smoke dist-faults-smoke smoke-all clean
+	monitor-smoke faults-smoke dist-faults-smoke zero-smoke smoke-all \
+	clean
 
 native: $(SO)
 
@@ -118,6 +119,18 @@ faults-smoke:
 	  tests/python/unittest/test_resilience.py \
 	  tests/python/unittest/test_elastic.py -q -m 'not slow'
 
+# mx.shard ZeRO-2/3 global-mesh drills (single process, 8 virtual CPU
+# devices): ZeRO-3 captured step = ONE program with 10-step bit parity
+# vs the unsharded mesh reference and ~1/4 per-device param+state
+# residency; sharded pod checkpoint saved at dp=4 resumes on dp=2
+# bit-identically; injected collective hang -> DistTimeout ->
+# supervisor resume from the pod checkpoint; then the subsystem's
+# pytest suite
+zero-smoke:
+	JAX_PLATFORMS=cpu python tools/zero_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/python/unittest/test_shard.py -q -m 'not slow'
+
 # mx.dist coordinated fault drills (2 local CPU processes over
 # tools/launch.py): rank SIGKILLed mid-step -> DistTimeout within the
 # deadline -> whole-world restart resumes bit-identically from the max
@@ -135,7 +148,7 @@ dist-faults-smoke:
 # a tunnel window (each target is independent; failures stop the chain)
 smoke-all: telemetry-smoke checkpoint-smoke serve-smoke \
 	compile-cache-smoke trainer-smoke step-smoke trace-smoke \
-	monitor-smoke faults-smoke dist-faults-smoke
+	monitor-smoke faults-smoke zero-smoke dist-faults-smoke
 
 # suite summary artifact (TESTS_r{N}.json) — round-2 advisor contract
 test-report:

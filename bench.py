@@ -637,6 +637,144 @@ def _bench_captured_step(batch=64, iters=10, dtype="bfloat16",
     return row
 
 
+def _bench_zero3_captured(batch=64, iters=10, dtype="bfloat16"):
+    """ZeRO-3 captured ResNet-50 on a dp=4 GlobalMesh (mx.shard): the
+    whole-step program with dp-sharded params + optimizer state,
+    reduce-scattered gradient buckets and on-demand param gathers,
+    against the unsharded captured reference on the SAME mesh
+    (replicated weight update — the arXiv 2004.13336 baseline).
+    Reports per-device param+state bytes for replicated / ZeRO-1 /
+    ZeRO-3, the step-time delta, the priced wire bytes (reduce-scatter
+    vs all-reduce), and a 3-step bit-parity block (sharding must change
+    layout, never math).  On the CPU drill the 4 'devices' are virtual;
+    on a pod they are 4 real chips — same program either way."""
+    import numpy as np
+
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, shard
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    PARITY_STEPS = 3
+    devs = jax.devices()
+    if len(devs) < 4:
+        return {"error": "needs >= 4 devices for the dp=4 mesh "
+                         "(have %d)" % len(devs)}
+    gm = shard.GlobalMesh(dp=4, devices=devs[:4])
+
+    def build(zero, seed=0):
+        mx.random.seed(seed)
+        net = vision.resnet50_v1()
+        net.initialize()
+        if dtype != "float32":
+            net.cast(dtype)
+        net.hybridize()
+        trainer = gluon.Trainer(
+            net.collect_params(), "sgd",
+            {"learning_rate": 0.05, "momentum": 0.9,
+             "multi_precision": dtype != "float32"},
+            zero=zero, mesh=gm)
+        prog = trainer.capture(net,
+                               gluon.loss.SoftmaxCrossEntropyLoss())
+        return net, trainer, prog
+
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.rand(batch, 3, 224, 224).astype(np.float32)) \
+        .astype(dtype)
+    y = nd.array(rs.randint(0, 1000, batch).astype(np.int32))
+
+    def time_loop(prog):
+        for _ in range(WARMUP):
+            loss = prog(x, y)
+        float(loss.mean().asnumpy())  # hard sync
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = prog(x, y)
+        float(loss.mean().asnumpy())
+        return batch * iters / (time.perf_counter() - t0)
+
+    def device_bytes(net, trainer):
+        return {
+            "params": shard.device_bytes(
+                [p.data() for p in net.collect_params().values()]),
+            "state": shard.device_bytes(
+                [trainer._states[i] for i in trainer._states]),
+        }
+
+    _log("zero3 captured %s: unsharded mesh reference" % dtype)
+    net_u, tr_u, prog_u = build(0)
+    unsharded_ips = time_loop(prog_u)
+    rep_u = prog_u.report()
+    if rep_u["paths"]["captured"] == 0:
+        return {"error": "capture degraded: %s" % rep_u["fallbacks"][:1],
+                "report": rep_u}
+    bytes_u = device_bytes(net_u, tr_u)
+
+    _log("zero3 captured %s: ZeRO-3 timing" % dtype)
+    net_z, tr_z, prog_z = build(3)
+    z3_ips = time_loop(prog_z)
+    rep_z = prog_z.report()
+    if rep_z["paths"]["captured"] == 0:
+        return {"error": "zero3 capture degraded: %s"
+                % rep_z["fallbacks"][:1], "report": rep_z}
+    bytes_z3 = device_bytes(net_z, tr_z)
+
+    _log("zero3 captured %s: ZeRO-1 byte reference" % dtype)
+    net_1, tr_1, prog_1 = build(1)
+    prog_1(x, y)  # one placed step is enough for the residency numbers
+    bytes_z1 = device_bytes(net_1, tr_1)
+
+    _log("zero3 captured %s: bit-parity block (%d steps)"
+         % (dtype, PARITY_STEPS))
+    net_a, _, prog_a = build(3, seed=1)
+    net_b, _, prog_b = build(0, seed=1)
+    for _ in range(PARITY_STEPS):
+        prog_a(x, y)
+        prog_b(x, y)
+    worst = 0.0
+    bitwise = True
+    for k, p in net_b.collect_params().items():
+        a = p.data().astype("float32").asnumpy()
+        b = net_a.collect_params()[k].data().astype("float32").asnumpy()
+        if not np.array_equal(a, b):
+            bitwise = False
+            worst = max(worst, float(np.max(
+                np.abs(a - b) / (np.abs(a) + 1e-8))))
+    parity = {"steps": PARITY_STEPS, "bitwise": bitwise,
+              "worst_rel_diff": worst}
+    if not bitwise:
+        # expected for deep conv residual nets: GSPMD keeps per-layer
+        # partitioning freedom in multi-branch graphs, and the ulp-
+        # level reduction-order differences BN statistics amplify over
+        # ~50 layers.  Matmul-dominated forwards ARE bit-identical —
+        # asserted in test_shard.py / make zero-smoke — so the drift
+        # here measures conv/BN layout sensitivity, not update math.
+        parity["note"] = ("non-bitwise drift is conv/BN layout "
+                          "sensitivity (see test_shard.py for the "
+                          "bitwise weight-update-sharding proof)")
+
+    prog_row = rep_z["programs"][0]
+    return {
+        "imgs_per_sec": round(z3_ips, 2),
+        "unsharded_captured_imgs_per_sec": round(unsharded_ips, 2),
+        "step_time_vs_unsharded": round(unsharded_ips / z3_ips, 3),
+        "batch": batch, "dtype": dtype, "dp": gm.dp,
+        "device_bytes": {"replicated": bytes_u, "zero1": bytes_z1,
+                         "zero3": bytes_z3},
+        "state_bytes_vs_replicated": round(
+            bytes_z3["state"] / max(1, bytes_u["state"]), 4),
+        "param_bytes_vs_replicated": round(
+            bytes_z3["params"] / max(1, bytes_u["params"]), 4),
+        "wire_bytes_per_step": prog_row["wire"],
+        "bit_parity": parity,
+        "capture": {"paths": rep_z["paths"],
+                    "fallbacks": rep_z["fallbacks"],
+                    "collective": [s for s in prog_row["segments"]
+                                   if s["segment"] == "allreduce"][0]},
+    }
+
+
 def main():
     extra = {}
     _log("start; budget %.0fs" % BUDGET_S)
@@ -735,6 +873,12 @@ def main():
              lambda: _bench_captured_step(
                  fused_ref=extra.get("resnet50_bf16")),
              "resnet50_captured_step_bf16"),
+            # mx.shard ZeRO-3 on a dp=4 mesh: sharded params/state
+            # (~1/4 residency per device), reduce-scattered gradient
+            # buckets, on-demand param gathers; bit-parity vs the
+            # unsharded captured reference on the same mesh
+            ("resnet50_zero3_captured", _bench_zero3_captured,
+             "resnet50_zero3_captured_vdev"),
             # flash fwd+bwd kernel vs blockwise recompute (VERDICT r3 #7)
             ("attention_T2k", lambda: _attn(2048), "attention_T2k"),
             ("attention_T8k", lambda: _attn(8192), "attention_T8k"),

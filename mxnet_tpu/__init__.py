@@ -86,6 +86,7 @@ from . import compile  # noqa: E402,A004  (persistent compile cache + AOT)
 from . import monitor  # noqa: E402  (training-health numerics + sentinel)
 from . import resilience  # noqa: E402  (fault injection + preempt + supervisor)
 from . import dist  # noqa: E402  (multi-host membership + pod checkpoints)
+from . import shard  # noqa: E402  (global mesh + ZeRO weight-update sharding)
 from . import step  # noqa: E402  (whole-program training-step capture)
 from . import elastic  # noqa: E402  (failure detection + auto-resume)
 from . import config  # noqa: E402  (env-var registry, reference env_var.md)

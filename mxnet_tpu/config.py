@@ -195,6 +195,26 @@ ENV_VARS = {
         "at capture/build time (poisons the capture -> clean stitched "
         "fallback) and at program dispatch (exercises the supervisor "
         "rewind path)."),
+    "MXNET_SHARD_DP": (
+        int, 0,
+        "Data-parallel axis size for the auto-configured mx.shard "
+        "GlobalMesh (0 = unset; with MXNET_SHARD_MDL also unset, no "
+        "mesh is auto-built).  When set, Trainer(zero=...) and mesh-"
+        "aware step capture adopt a GlobalMesh(dp=N) over the global "
+        "device list without any code change (shard/mesh.py)."),
+    "MXNET_SHARD_MDL": (
+        int, 0,
+        "Optional inner model-parallel axis size of the auto-"
+        "configured GlobalMesh (0/1 = pure data parallelism).  The "
+        "mdl axis is carved from the fast (ICI) end of the device "
+        "order."),
+    "MXNET_SHARD_DATA": (
+        str, "dp",
+        "Input-batch placement inside a mesh-captured step program: "
+        "'dp' (default) splits the global batch along the dp axis — "
+        "each replica's slice feeds its devices; 'replicate' gives "
+        "every replica the whole batch (drill/debug mode).  A batch "
+        "not divisible by dp falls back to replicate."),
     "MXNET_STEP_CAPTURE": (
         bool, True,
         "Kill switch for mx.step whole-program training-step capture: "

@@ -30,6 +30,14 @@ class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
                  update_on_kvstore=None, zero=False, mesh=None):
+        """``zero`` selects the cross-replica weight-update sharding
+        level (mx.shard, arXiv 2004.13336): ``False``/0 off, ``True``/1
+        shard optimizer state over the mesh's ``dp`` axis, 2 also
+        reduce-scatter gradients (captured step), 3 also shard the
+        parameters themselves (captured step; all-gathered on demand).
+        ``mesh`` is a ``jax.sharding.Mesh`` with a ``dp`` axis or an
+        ``mx.shard.GlobalMesh``; with ``zero`` unset a mesh still makes
+        ``capture()`` lay the step out data-parallel over it."""
         if isinstance(params, (dict,)):
             param_dict = dict(params)
         elif isinstance(params, (list, tuple)):
@@ -58,17 +66,33 @@ class Trainer:
         self._mt_groups = {}   # multi-tensor fused update programs
         self._step_programs = []  # weakrefs to mx.step StepPrograms
         self._monitor_kv_warned = False
-        self._zero = zero
-        self._zero_mesh = mesh
-        if zero and (mesh is None or "dp" not in getattr(mesh, "shape", {})):
-            raise MXNetError("Trainer(zero=True) needs mesh= (a "
-                             "jax.sharding.Mesh with a 'dp' axis)")
-        if zero and update_on_kvstore:
+        from .. import shard as _shard
+
+        self._zero = _shard.normalize_level(zero)
+        gmesh = None
+        if mesh is not None:
+            gmesh = _shard.as_global(mesh)
+        elif self._zero:
+            # adopt the process-global mesh so scripts configure ONE
+            # mesh (mx.shard.configure / MXNET_SHARD_DP) and every
+            # trainer agrees with capture/kvstore/checkpoint on it
+            gmesh = _shard.current(auto=True)
+        if self._zero and gmesh is None:
             raise MXNetError(
-                "Trainer(zero=True) is incompatible with "
+                "Trainer(zero=%d) needs a device mesh with a 'dp' axis: "
+                "pass mesh= (jax.sharding.Mesh or mx.shard.GlobalMesh) "
+                "or configure one process-wide with mx.shard.configure()"
+                % self._zero)
+        if self._zero and update_on_kvstore:
+            raise MXNetError(
+                "Trainer(zero=%d) is incompatible with "
                 "update_on_kvstore=True: the kvstore update path would "
                 "create optimizer state fully replicated, silently voiding "
-                "the ZeRO-1 sharding")
+                "the ZeRO weight-update sharding. Use "
+                "update_on_kvstore=False (the collective-store default)."
+                % self._zero)
+        self._zero_gmesh = gmesh
+        self._zero_mesh = None if gmesh is None else gmesh.mesh
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -161,29 +185,22 @@ class Trainer:
             self._states[i] = state
 
     def _shard_state(self, state):
-        """ZeRO-1 for the imperative path: place each optimizer-state array
-        sharded over the mesh's dp axis (first divisible dim).  The per-param
-        jnp update then runs SPMD under XLA with the state never fully
-        materialized on one device — the FusedTrainer(zero=True) layout
-        (parallel/__init__.py:198) brought to reference-style
-        ``Trainer.step`` training."""
+        """ZeRO for the imperative path: place each optimizer-state array
+        sharded over the mesh's dp axis (``shard.GlobalMesh.spec_for``:
+        first divisible dim).  The per-param jnp update then runs SPMD
+        under XLA with the state never fully materialized on one device;
+        the captured step (mx.step) consumes the same placement, so the
+        two paths share one shard layout."""
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
         from ..ndarray.ndarray import NDArray
 
-        dp = self._zero_mesh.shape["dp"]
+        gm = self._zero_gmesh
 
         def place(leaf):
             if not isinstance(leaf, NDArray):
                 return leaf
-            spec = [None] * leaf.ndim
-            for ax, dim in enumerate(leaf.shape):
-                if dim % dp == 0 and dim > 0:
-                    spec[ax] = "dp"
-                    break
-            arr = jax.device_put(
-                leaf._data, NamedSharding(self._zero_mesh, P(*spec)))
+            arr = jax.device_put(leaf._data, gm.sharding_for(leaf.shape))
             return NDArray(arr)
 
         return jax.tree_util.tree_map(

@@ -68,18 +68,51 @@ def plan_buckets(sizes_dtypes, bucket_bytes=None):
     return plan
 
 
-def observe_bucket_fill(bucket_nbytes):
+def observe_bucket_fill(bucket_nbytes, op=None):
     """Feed the ``allreduce_bucket_fill`` histogram from a precomputed
     bucket plan (``[payload bytes per bucket]``).  The per-call bucketed
     path observes fill inline in ``_allreduce_many``; a captured step
     program (mx.step) reduces inside ONE whole-step XLA program where
     that observation point never runs, so it feeds its static plan
     through here each dispatch — keeping the two paths comparable in
-    telemetry."""
+    telemetry.  ``op`` additionally accounts the collective itself
+    (one call per bucket, PAYLOAD bytes — the same semantics the
+    eager ``_allreduce_many`` path feeds) under the given label:
+    ``allreduce`` (the eager path's series), or ``reduce_scatter``
+    for a ZeRO-2/3 sharded step.  Priced WIRE bytes live in the
+    capture report / bench rows, not here."""
     if not _tel.ENABLED:
         return
     for nbytes in bucket_nbytes:
         _tel.ALLREDUCE_BUCKET_FILL.observe(nbytes / float(_BUCKET_BYTES))
+    if op is not None:
+        _tel.COLLECTIVE_CALLS.labels(op=op).inc(len(bucket_nbytes))
+        _tel.COLLECTIVE_BYTES.labels(op=op).inc(
+            int(sum(bucket_nbytes)))
+
+
+def observe_collective(op, nbytes, calls=1):
+    """Account one in-program collective (mx.step sharded dispatch:
+    the params all-gather of a ZeRO update; ``nbytes`` = payload) in
+    the same ``collective_*`` telemetry the eager kvstore path feeds."""
+    if not _tel.ENABLED:
+        return
+    _tel.COLLECTIVE_CALLS.labels(op=op).inc(calls)
+    _tel.COLLECTIVE_BYTES.labels(op=op).inc(int(nbytes))
+
+
+def all_reduce_wire_bytes(payload_bytes, world):
+    """Ring all-reduce wire cost: ``2 (N-1)/N * B`` per replica."""
+    world = max(1, int(world))
+    return 2 * int(payload_bytes) * (world - 1) // world
+
+
+def reduce_scatter_wire_bytes(payload_bytes, world):
+    """Reduce-scatter wire cost: ``(N-1)/N * B`` per replica — half the
+    all-reduce price, which is the ZeRO-2/3 collective saving
+    (arXiv 2004.13336)."""
+    world = max(1, int(world))
+    return int(payload_bytes) * (world - 1) // world
 
 
 def _deadline(fn, site):

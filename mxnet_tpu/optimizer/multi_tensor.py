@@ -316,7 +316,7 @@ def _group_key(trainer, i, param, grad):
     return (type(opt).__name__, id(opt), str(w.dtype), str(grad.dtype),
             repr(float(param.lr_mult)), repr(float(param.wd_mult)),
             jax.tree_util.tree_structure(trainer._states[i]), devs,
-            bool(trainer._zero))
+            int(trainer._zero or 0))
 
 
 def partition(trainer, items):
@@ -493,20 +493,23 @@ def _apply_group(trainer, key, members, hsig, cache):
               for t in s_trees))
     homes = None
     if trainer._zero:
-        # ZeRO-1: ONE replicate-in transfer for the whole group (the
-        # per-param path paid 3 device_puts x N), the dp-sharded states
-        # stay put, and the program runs SPMD over the mesh
+        # ZeRO stitched path: ONE replicate-in transfer for the whole
+        # group (the per-param path paid 3 device_puts x N), the
+        # dp-sharded states stay put, and the program runs SPMD over
+        # the mesh.  "Home" is the weight's PRIOR sharding, not a bare
+        # device — a ZeRO-3 parameter left dp-sharded by the captured
+        # path scatters back to its shards, not onto one device
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         rep = NamedSharding(trainer._zero_mesh, P())
-        homes = [next(iter(a.devices())) for a in w_arrs]
+        homes = [a.sharding for a in w_arrs]
         w_arrs, g_arrs = jax.device_put((w_arrs, g_arrs), rep)
     group = cache.get(key)
     if group is None or group.members_sig != members_sig \
             or group.hsig != hsig:
         group = _build_group(trainer, key, indices, members_sig, hsig,
                              w_arrs, g_arrs, s_trees,
-                             bool(trainer._zero))
+                             int(trainer._zero or 0))
         cache[key] = group
     # the real host-side bookkeeping the traced no-ops stand in for;
     # snapshot first so a failed launch can rewind — the eager fallback
@@ -615,16 +618,29 @@ def apply_updates(trainer, items):
 def group_table(trainer):
     """Introspection for tools/diagnose.py --trainer and tests: one row
     per live group — optimizer, member count, parameter bytes, programs
-    per step (always 1), provenance, host-scalar slots in use."""
+    per step (always 1), provenance, host-scalar slots in use, and the
+    LIVE shard placement of the group's weights and optimizer state
+    (``replicated`` / ``single`` / ``dpN`` — the ZeRO memory contract,
+    read off the actual arrays, not the configuration)."""
+    from .. import shard as _shard
+
     rows = []
     for group in trainer._mt_groups.values():
+        params = [trainer._params[i].data() for i in group.indices
+                  if trainer._params[i]._data is not None]
+        states = [trainer._states[i] for i in group.indices
+                  if trainer._states.get(i) is not None]
         rows.append({
             "optimizer": group.opt_name,
             "params": len(group.indices),
             "bytes": int(group.nbytes),
             "programs_per_step": 1,
             "provenance": group.provenance,
-            "zero": bool(group.zero),
+            "zero": int(group.zero or 0),
+            "placement": {
+                "params": _shard.placement_label(params),
+                "state": _shard.placement_label(states),
+            },
             "host_scalar_slots": len(group.slot_fns or ()),
         })
     return rows

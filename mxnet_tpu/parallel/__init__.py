@@ -25,7 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
-from .optim import make_optimizer
+from .optim import make_optimizer, shard_update
 from .ring import ring_attention, ulysses_attention
 
 __all__ = ["make_mesh", "make_hybrid_mesh", "FusedTrainer",
@@ -180,13 +180,20 @@ class FusedTrainer:
         if grad_accum < 1:
             raise MXNetError("grad_accum must be >= 1, got %r" % grad_accum)
         self._grad_accum = int(grad_accum)
-        # ZeRO-1: shard optimizer state over dp (reduce-scatter the grads,
-        # all-gather the updated shards — XLA derives both collectives from
-        # the state shardings; PAPERS.md cross-replica weight-update
-        # sharding pattern)
-        if zero and (mesh is None or "dp" not in mesh.axis_names):
-            raise MXNetError("zero=True requires a mesh with a dp axis")
-        self._zero = bool(zero) and mesh.shape["dp"] > 1 if zero else False
+        # ZeRO: shard the weight update over dp (PAPERS.md cross-replica
+        # weight-update sharding / mx.shard levels).  True/1 shards
+        # optimizer state (XLA derives the collectives from the state
+        # shardings); 2 additionally constrains gradients to the shard
+        # layout EXPLICITLY (optim.shard_update — a reduce-scatter, never
+        # a replicated grad); 3 also dp-shards the parameters between
+        # steps (forward all-gathers on demand).
+        from ..shard import normalize_level as _zero_level
+
+        level = _zero_level(zero)
+        if level and (mesh is None or "dp" not in mesh.axis_names):
+            raise MXNetError("zero=%r requires a mesh with a dp axis"
+                             % (zero,))
+        self._zero = level if (level and mesh.shape["dp"] > 1) else 0
         optimizer_params = dict(optimizer_params or {})
         self._lr, self._lr_scheduler = _pop_lr_schedule(optimizer_params)
         self._opt_init, self._opt_update = make_optimizer(
@@ -220,6 +227,14 @@ class FusedTrainer:
         if self._mesh is not None:
             self._param_specs = {n: param_pspec(p, self._mesh)
                                  for n, p in named.items()}
+            if self._zero >= 3:
+                # ZeRO-3: trainable params live dp-sharded BETWEEN
+                # steps (same first-divisible-dim rule as the state
+                # shards); the step program all-gathers them on demand
+                self._param_specs = {
+                    n: (self._dp_extend(s, params[n].shape)
+                        if n in self._trainable else s)
+                    for n, s in self._param_specs.items()}
             params = {
                 n: jax.device_put(v, NamedSharding(self._mesh,
                                                    self._param_specs[n]))
@@ -241,6 +256,19 @@ class FusedTrainer:
             self._pending_state = None
             self._apply_state(pending)
 
+    def _dp_extend(self, spec, shape):
+        """Add ``dp`` on the first divisible, unsharded axis of ``spec``
+        (no-op when dp already appears — a user FSDP hint wins)."""
+        dp = self._mesh.shape["dp"]
+        base = list(spec) + [None] * (len(shape) - len(spec))
+        if "dp" in base:
+            return P(*base)
+        for ax, dim in enumerate(shape):
+            if base[ax] is None and dim > 0 and dim % dp == 0:
+                base[ax] = "dp"
+                break
+        return P(*base)
+
     def _make_zero_specs(self, opt_state):
         """Per-leaf PartitionSpecs sharding optimizer state over dp.
 
@@ -251,14 +279,8 @@ class FusedTrainer:
         dp = self._mesh.shape["dp"]
 
         def spec_for(name, leaf):
-            base = list(self._param_specs.get(name, P()))
-            base += [None] * (leaf.ndim - len(base))
-            for ax in range(leaf.ndim):
-                if base[ax] is None and leaf.shape[ax] % dp == 0 \
-                        and leaf.shape[ax] > 0:
-                    base[ax] = "dp"
-                    break
-            return P(*base)
+            return self._dp_extend(self._param_specs.get(name, P()),
+                                   leaf.shape)
 
         specs = {k: jax.tree_util.tree_map(lambda v: spec_for(k, v), leaf)
                  for k, leaf in opt_state.items()}
@@ -279,6 +301,14 @@ class FusedTrainer:
         loss_fn = self._loss_fn
         trainable = self._trainable
         opt_update = self._opt_update
+        if self._zero >= 2 and self._state_specs is not None:
+            # ZeRO-2/3: explicit weight-update-sharding transform — the
+            # grads entering the update are constrained to the state
+            # shard layout (reduce-scatter, never a replicated grad)
+            # and the fresh params to their forward layout
+            opt_update = shard_update(
+                opt_update, self._mesh, self._state_specs,
+                {n: self._param_specs[n] for n in self._trainable})
         accum = self._grad_accum
         compute_dtype = self._dtype
         from ..contrib.amp import FP32_PARAM_SUFFIXES as _fp32_sufs

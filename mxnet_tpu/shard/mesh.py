@@ -1,0 +1,251 @@
+"""The global device mesh — ONE sharding story for the whole stack.
+
+PR 9 built the multi-host *control* plane (membership, deadlines, pod
+checkpoints); this module lays the *data* plane underneath it: a
+``GlobalMesh`` over every device in the world — ICI within a host or
+slice, DCN across them — that the captured step program (mx.step), the
+ZeRO weight-update sharding policies (:mod:`.zero`), the collective
+kvstore and the checkpoint resharding all agree on.
+
+Topology: devices are ordered (process, local) — process-major, so
+neighbouring ``dp`` coordinates within one process sit on ICI and the
+process boundary is the DCN hop.  The ``dp`` axis spans ALL of it (XLA
+routes each collective segment over the right interconnect, the
+``make_hybrid_mesh`` observation generalized); an optional ``mdl``
+axis carves an inner model-parallel dimension out of the fast end.
+
+Rendezvous: ``tools/launch.py`` exports ``MXNET_DIST_*`` and
+``mxnet_tpu.__init__`` calls ``jax.distributed.initialize`` at import
+— by the time a mesh is built, ``jax.devices()`` is already the global
+device list.  ``ensure_distributed()`` re-checks that contract for
+embedders that import jax first, and ``--rendezvous none`` CPU drills
+(single process, virtual devices) skip it entirely: the same mesh code
+runs over ``xla_force_host_platform_device_count`` devices, which is
+how every multi-rank path here stays tier-1-testable.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from ..base import MXNetError, get_env
+
+__all__ = ["GlobalMesh", "ensure_distributed", "configure", "current",
+           "reset", "auto_mesh"]
+
+_LOGGER = logging.getLogger("mxnet_tpu.shard")
+
+# the process-global mesh (configure()/current()); one per process so
+# capture, kvstore, checkpoint resharding and diagnose agree
+_CURRENT = None
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def _distributed_client():
+    """The live jax.distributed client, or None — WITHOUT touching the
+    XLA backend (``jax.process_count()`` would initialize it, and
+    ``jax.distributed.initialize`` must run first)."""
+    try:
+        from jax._src import distributed as _jd
+
+        return _jd.global_state.client
+    except Exception:
+        return None
+
+
+def ensure_distributed():
+    """Join the process group off the launch.py rendezvous env if this
+    process has not already (``mxnet_tpu`` does it at import; this
+    covers embedders that import jax first).  Returns the live process
+    count.  An initialize that fails — e.g. the embedder already ran
+    jax computations, pinning the backend to this host — raises
+    loudly: silently building a local mesh in a multi-host world would
+    train every rank independently with no error anywhere."""
+    import os
+
+    jax = _jax()
+    coord = os.environ.get("MXNET_DIST_COORDINATOR")
+    if coord and int(os.environ.get("MXNET_DIST_NUM_WORKERS", "1")) > 1 \
+            and _distributed_client() is None:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["MXNET_DIST_NUM_WORKERS"]),
+            process_id=int(os.environ["MXNET_DIST_RANK"]))
+    return jax.process_count()
+
+
+class GlobalMesh:
+    """A ``dp`` (× optional ``mdl``) mesh over the global device list.
+
+    Parameters
+    ----------
+    dp : data-parallel axis size (default: all devices / ``mdl``).
+    mdl : optional inner model-parallel axis (default 1 — pure dp).
+    devices : explicit device list (default: ``jax.devices()``, the
+        GLOBAL list when ``jax.distributed`` is initialized).  Devices
+        are consumed process-major so ``dp`` neighbours share ICI.
+    """
+
+    def __init__(self, dp=None, mdl=None, devices=None):
+        jax = _jax()
+        if devices is None:
+            ensure_distributed()
+            devices = jax.devices()
+        devices = list(devices)
+        # process-major order: the DCN hop lands on the outermost
+        # stride of the dp axis, ICI on the inner strides
+        devices.sort(key=lambda d: (d.process_index, d.id))
+        mdl = int(mdl or 1)
+        if mdl < 1:
+            raise MXNetError("GlobalMesh mdl axis must be >= 1, got %d"
+                             % mdl)
+        if len(devices) % mdl:
+            raise MXNetError(
+                "GlobalMesh: mdl=%d does not divide the %d-device world"
+                % (mdl, len(devices)))
+        dp = int(dp) if dp else len(devices) // mdl
+        if dp * mdl > len(devices):
+            raise MXNetError(
+                "GlobalMesh: dp=%d x mdl=%d needs %d devices, world has "
+                "%d" % (dp, mdl, dp * mdl, len(devices)))
+        from jax.sharding import Mesh
+
+        arr = _np.asarray(devices[:dp * mdl])
+        if mdl > 1:
+            self.mesh = Mesh(arr.reshape(dp, mdl), ("dp", "mdl"))
+        else:
+            self.mesh = Mesh(arr.reshape(dp), ("dp",))
+        self.dp = dp
+        self.mdl = mdl
+        self.processes = len({d.process_index for d in devices[:dp * mdl]})
+        # immutable after construction; cached so the per-step program
+        # lookup (_sig) does not rebuild an O(world) tuple every call
+        self._signature = (self.dp, self.mdl,
+                           tuple(d.id for d in self.mesh.devices.flat))
+
+    # -- shardings -----------------------------------------------------------
+    def replicated(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P())
+
+    def spec_for(self, shape):
+        """ZeRO placement rule: shard the FIRST dp-divisible dim along
+        ``dp``; nothing divisible (small biases, scalars) stays
+        replicated — negligible memory, and the update math is
+        unchanged either way."""
+        from jax.sharding import PartitionSpec as P
+
+        spec = [None] * len(shape)
+        for ax, dim in enumerate(shape):
+            if dim > 0 and dim % self.dp == 0:
+                spec[ax] = "dp"
+                break
+        return P(*spec)
+
+    def sharding_for(self, shape):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, self.spec_for(shape))
+
+    def batch_sharding(self, shape):
+        """Input-batch placement: axis 0 split along ``dp`` when the
+        global batch divides (the data-parallel feed), else replicated
+        (``MXNET_SHARD_DATA=replicate`` forces the latter — the drill
+        mode where every shard sees the whole batch)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mode = str(get_env("MXNET_SHARD_DATA", str, "dp") or "dp").lower()
+        if mode not in ("dp", "replicate", "replicated"):
+            raise MXNetError(
+                "MXNET_SHARD_DATA=%r is not a data placement "
+                "(dp|replicate)" % mode)
+        if mode == "dp" and shape and shape[0] % self.dp == 0 \
+                and shape[0] > 0:
+            return NamedSharding(self.mesh, P("dp"))
+        return NamedSharding(self.mesh, P())
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def devices(self):
+        return list(self.mesh.devices.flat)
+
+    def signature(self):
+        """Hashable identity for capture signatures: a program traced
+        over one mesh must never serve another."""
+        return self._signature
+
+    def describe(self):
+        return {"dp": self.dp, "mdl": self.mdl,
+                "devices": len(self.devices),
+                "processes": self.processes,
+                "axis_names": list(self.mesh.axis_names)}
+
+    def __repr__(self):
+        return ("GlobalMesh(dp=%d%s, devices=%d, processes=%d)"
+                % (self.dp,
+                   ", mdl=%d" % self.mdl if self.mdl > 1 else "",
+                   len(self.devices), self.processes))
+
+
+def as_global(mesh):
+    """Adopt a raw ``jax.sharding.Mesh`` (the ``Trainer(mesh=...)``
+    legacy spelling) as a :class:`GlobalMesh`; a GlobalMesh passes
+    through."""
+    if mesh is None or isinstance(mesh, GlobalMesh):
+        return mesh
+    shape = dict(getattr(mesh, "shape", {}) or {})
+    if "dp" not in shape:
+        raise MXNetError("shard.as_global needs a mesh with a 'dp' "
+                         "axis, got axes %s" % (list(shape),))
+    gm = GlobalMesh.__new__(GlobalMesh)
+    gm.mesh = mesh
+    gm.dp = int(shape["dp"])
+    gm.mdl = int(shape.get("mdl", 1))
+    gm.processes = len({d.process_index for d in mesh.devices.flat})
+    gm._signature = (gm.dp, gm.mdl,
+                     tuple(d.id for d in mesh.devices.flat))
+    return gm
+
+
+def configure(mesh):
+    """Install ``mesh`` (GlobalMesh or raw Mesh with a ``dp`` axis) as
+    the process-global mesh consulted by ``Trainer(zero=...)`` and
+    mesh-aware step capture.  Returns the installed GlobalMesh."""
+    global _CURRENT
+    _CURRENT = as_global(mesh)
+    return _CURRENT
+
+
+def current(auto=False):
+    """The configured global mesh, or None.  ``auto=True`` additionally
+    builds one from ``MXNET_SHARD_DP``/``MXNET_SHARD_MDL`` when those
+    are set and nothing was configured."""
+    if _CURRENT is None and auto:
+        dp = get_env("MXNET_SHARD_DP", int, 0)
+        mdl = get_env("MXNET_SHARD_MDL", int, 0)
+        if dp or mdl:
+            configure(GlobalMesh(dp=dp or None, mdl=mdl or None))
+            _LOGGER.info("mx.shard: auto-configured %r from "
+                         "MXNET_SHARD_DP/MDL", _CURRENT)
+    return _CURRENT
+
+
+def auto_mesh():
+    """Build (and install) the env-described mesh unconditionally —
+    the launch-script one-liner: ``shard.auto_mesh()`` after import."""
+    dp = get_env("MXNET_SHARD_DP", int, 0)
+    mdl = get_env("MXNET_SHARD_MDL", int, 0)
+    return configure(GlobalMesh(dp=dp or None, mdl=mdl or None))
+
+
+def reset():
+    """Tests only: drop the process-global mesh."""
+    global _CURRENT
+    _CURRENT = None

@@ -19,7 +19,11 @@ end-to-end buffer-donated XLA program:
   barrier — so XLA is free to issue early buckets' collectives while
   later layers still differentiate.  In a world of one the sum over
   one replica is the identity; under an SPMD ``axis_name`` each
-  bucket is a ``lax.psum``;
+  bucket is a ``lax.psum``; on an ``mx.shard.GlobalMesh`` with a
+  ZeRO-2/3 trainer each bucket REDUCE-SCATTERS straight into the
+  update's shard layout ((N-1)/N of the all-reduce wire bytes,
+  arXiv 2004.13336) and ZeRO-3 parameters all-gather just in time
+  inside forward/backward;
 - **fused optimizer apply** replaying the PR 5 multi-tensor groups'
   ``update_multi_precision`` rules in-trace, per-step host values
   (scheduler lr/wd, rescale_grad, Adam bias corrections) flowing
@@ -57,7 +61,8 @@ import numpy as _np
 from .. import telemetry as _tel
 from .. import trace as _trace
 from ..base import MXNetError, get_env
-from ..kvstore.collective import observe_bucket_fill, plan_buckets
+from ..kvstore.collective import (observe_bucket_fill,
+                                  observe_collective, plan_buckets)
 from ..ndarray.ndarray import NDArray
 from ..optimizer import multi_tensor as _mt
 from ..resilience import inject as _inject
@@ -113,6 +118,28 @@ def _jax():
     import jax
 
     return jax
+
+
+def _bucket_reduce_scatter(grads, plan_pos, grad_shardings):
+    """ZeRO-2/3 collective segment: constrain each bucket's member
+    gradients to their dp-shard layout (aligned with the optimizer
+    state's ``spec_for`` placement, so the sharded update consumes them
+    with zero resharding).  Under GSPMD the pending cross-replica sum
+    into a sharded consumer lowers to a REDUCE-SCATTER — (N-1)/N of the
+    all-reduce wire bytes — and members constrained together within one
+    ``plan_buckets()`` bucket fuse into bucket-granular collectives.
+    Buckets keep their ordered dependency structure: each depends only
+    on its member grads, so early buckets' reduce-scatters overlap the
+    still-running backward of later layers, exactly like the all-reduce
+    path."""
+    import jax
+
+    out = list(grads)
+    for idxs in plan_pos:
+        for j in idxs:
+            out[j] = jax.lax.with_sharding_constraint(
+                grads[j], grad_shardings[j])
+    return out
 
 
 def _bucket_allreduce(grads, plan_pos, axis_name):
@@ -209,7 +236,9 @@ class _Captured:
                  "group_list", "labels", "pos_of", "bucket_plan",
                  "bucket_nbytes", "n_slots", "slot_fns", "jfn", "cfn",
                  "cfn_ok", "fingerprint", "provenance", "gate",
-                 "monitor", "remat", "segments", "donation")
+                 "monitor", "remat", "segments", "donation",
+                 "gmesh", "level", "param_shardings", "grad_shardings",
+                 "state_shardings", "replicated", "wire")
 
     def __init__(self):
         self.slot_fns = None
@@ -218,6 +247,8 @@ class _Captured:
         self.cfn_ok = False
         self.fingerprint = None
         self.provenance = "fresh"
+        self.gmesh = None
+        self.level = 0
 
     def call(self, *args):
         with _mt._quiet_donation():
@@ -243,12 +274,17 @@ class StepProgram:
     ``program(data, label)`` runs forward, loss, backward, bucketed
     allreduce, the fused optimizer apply and the monitor stat
     reductions as ONE donated XLA program (captured lazily per input
-    signature) and returns the loss.  When capture is impossible —
-    kill switch, non-fusable optimizer, sparse grads, ZeRO trainer,
-    capture/compile failure — the SAME call runs the stitched
-    imperative sequence (``autograd.record`` forward, ``backward()``,
-    ``Trainer.step``), so the step is never lost and the callable is a
-    drop-in replacement for the classic three-line loop either way.
+    signature) and returns the loss.  On an ``mx.shard.GlobalMesh``
+    the same program compiles SPMD over the mesh: the batch lands
+    dp-sharded and the trainer's ZeRO level decides what lives sharded
+    between steps (state / + reduce-scattered grads / + params).  When
+    capture is impossible — kill switch, non-fusable optimizer, sparse
+    grads, a multi-process world without a mesh, capture/compile
+    failure — the SAME call runs the stitched imperative sequence
+    (``autograd.record`` forward, ``backward()``, ``Trainer.step``,
+    with mesh-placed arrays first gathered home), so the step is never
+    lost and the callable is a drop-in replacement for the classic
+    three-line loop either way.
     """
 
     def __init__(self, block, trainer, loss_fn, axis_name=None):
@@ -272,6 +308,10 @@ class StepProgram:
         self._path_counts = {"captured": 0, "stitched": 0}
         self._skipped = 0
         self._disabled_noted = False
+        # mx.shard placement bookkeeping: original (pre-mesh) array
+        # placements, restored when a step must run stitched
+        self._homes = None
+        self._placed = False
         try:
             self._world = _jax().process_count()
         except Exception:
@@ -345,19 +385,142 @@ class StepProgram:
     def invalidate(self):
         """Drop every captured program (checkpoint restore rebinds the
         optimizer-state arrays the programs were traced over; the next
-        step re-traces — cheap — and re-hits the persistent cache)."""
+        step re-traces — cheap — and re-hits the persistent cache).
+        Restored arrays arrive host-fresh (single-device), so the mesh
+        placement is re-laid at the next build too."""
         self._programs.clear()
         self._dead.clear()
+        self._placed = False
+
+    def gather(self):
+        """Bring parameters (and forward state) back to their original
+        pre-mesh placement and invalidate the captured programs — call
+        before eager evaluation of a ZeRO-3 model mid-training (the
+        sharded arrays would otherwise mix with single-device inputs).
+        The next captured step re-places and re-traces (cheap; the
+        executable comes back from the persistent cache)."""
+        self._gather_home()
+        self._programs.clear()
+
+    # ---- mx.shard placement ------------------------------------------------
+    def _place(self, items, named, policy):
+        """Lay the trainer's arrays out on the GlobalMesh per the ZeRO
+        policy: params sharded (level 3) or replicated, optimizer state
+        sharded (level >= 1, the trainer's own placement re-asserted),
+        forward-only params replicated.  Original placements are
+        recorded ONCE so a stitched fallback can gather home."""
+        jax = _jax()
+        trainer = self._trainer
+        if self._homes is None:
+            homes = {"params": {}, "states": {}}
+            for n, p in named.items():
+                if p._data is not None:
+                    homes["params"][n] = p._data._data.sharding
+            for i, _, _ in items:
+                st = trainer._states.get(i)
+                if st is not None:
+                    homes["states"][i] = jax.tree_util.tree_map(
+                        lambda leaf: leaf._data.sharding, st,
+                        is_leaf=_mt._is_nd)
+            self._homes = homes
+        train_ids = {id(p) for _, p, _ in items}
+        for _, p, _ in items:
+            h = p.data()
+            h._data = jax.device_put(h._data,
+                                     policy.param_sharding(h.shape))
+        for n, p in named.items():
+            if p._data is not None and id(p) not in train_ids:
+                p._data._data = jax.device_put(p._data._data,
+                                               policy.gmesh.replicated())
+        for i, _, _ in items:
+            st = trainer._states.get(i)
+            if st is not None:
+                def put(leaf):
+                    leaf._data = jax.device_put(
+                        leaf._data, policy.state_sharding(leaf.shape))
+                    return leaf
+                jax.tree_util.tree_map(put, st, is_leaf=_mt._is_nd)
+        self._placed = True
+        if _tel.ENABLED:
+            from .. import shard as _shard
+
+            _tel.SHARD_DEVICE_BYTES.labels(kind="params").set(
+                _shard.device_bytes([p.data() for _, p, _ in items]))
+            _tel.SHARD_DEVICE_BYTES.labels(kind="optimizer_state").set(
+                _shard.device_bytes([trainer._states[i]
+                                     for i, _, _ in items
+                                     if trainer._states.get(i)
+                                     is not None]))
+            _tel.SHARD_ZERO_LEVEL.set(policy.level)
+
+    def _gather_home(self):
+        """Undo ``_place``: device_put every placed array back to its
+        recorded original placement (no-op when nothing is placed) so
+        the eager/stitched engine never mixes mesh-committed arrays
+        with single-device ones."""
+        if not self._placed or self._homes is None:
+            return
+        jax = _jax()
+        named = self._block.collect_params()
+        for n, sh in self._homes["params"].items():
+            p = named.get(n)
+            if p is not None and p._data is not None:
+                p._data._data = jax.device_put(p._data._data, sh)
+        for i, tree_sh in self._homes["states"].items():
+            st = self._trainer._states.get(i)
+            if st is None:
+                continue
+
+            def put(leaf, sh):
+                leaf._data = jax.device_put(leaf._data, sh)
+                return leaf
+
+            jax.tree_util.tree_map(put, st, tree_sh, is_leaf=_mt._is_nd)
+        self._placed = False
+        # mesh programs were traced over the placed layout; drop them
+        # so a later captured step re-places (and re-traces, cheap)
+        # instead of feeding home-placed arrays to a mesh executable
+        for s in [s for s, c in self._programs.items()
+                  if c.gmesh is not None]:
+            self._programs.pop(s, None)
+
+    def _stage(self, cap, inputs, labels, hscal, rng):
+        """Per-dispatch input staging: on a mesh, the batch lands
+        dp-sharded and the scalar vector / rng key replicated.  In a
+        multi-process world each process hands its LOCAL batch and the
+        global array is assembled across hosts (the per-host data
+        feed; gradients then sum over the global batch while
+        ``rescale_grad`` divides by the local batch — exactly the
+        dist_sync kvstore semantics the stitched path has)."""
+        if cap.gmesh is None:
+            return inputs, labels, hscal, rng
+        jax = _jax()
+
+        def put_batch(a):
+            sharding = cap.gmesh.batch_sharding(a.shape)
+            if cap.gmesh.processes > 1:
+                return jax.make_array_from_process_local_data(
+                    sharding, _np.asarray(a))
+            return jax.device_put(a, sharding)
+
+        inputs = [put_batch(a) for a in inputs]
+        labels = [put_batch(a) for a in labels]
+        return (inputs, labels,
+                jax.device_put(hscal, cap.replicated),
+                jax.device_put(rng, cap.replicated))
 
     def report(self):
         """Capture report for ``tools/diagnose.py --step`` and tests:
         per-signature segment list, donation map, remat policy,
         provenance (fresh vs compile-cache hit), path counts and
         fallback reasons."""
+        gm = self._resolve_mesh()
         return {
             "enabled": is_enabled(),
             "world": self._world,
             "axis_name": self._axis_name,
+            "mesh": None if gm is None else gm.describe(),
+            "zero": int(getattr(self._trainer, "_zero", 0) or 0),
             "paths": dict(self._path_counts),
             "skipped_steps": self._skipped,
             "programs": [{
@@ -366,6 +529,10 @@ class StepProgram:
                 "remat": cap.remat,
                 "monitor_fused": cap.monitor,
                 "gate": cap.gate,
+                "zero": cap.level,
+                "mesh": None if cap.gmesh is None
+                else cap.gmesh.describe(),
+                "wire": None if cap.wire is None else dict(cap.wire),
                 "host_scalar_slots": len(cap.slot_fns or ()),
                 "segments": list(cap.segments),
                 "donation": dict(cap.donation),
@@ -382,6 +549,10 @@ class StepProgram:
         detector.)"""
         from .. import autograd
 
+        # a mesh-placed model cannot run the eager sequence (sharded
+        # arrays never mix with single-device ones): gather home first
+        # and drop the mesh programs — the next captured step re-places
+        self._gather_home()
         self._path_counts["stitched"] += 1
         if _tel.ENABLED:
             _tel.STEP_CAPTURE_STEPS.labels(path="stitched").inc()
@@ -405,6 +576,18 @@ class StepProgram:
         del self._fallbacks[:-32]
 
     # ---- capture ----------------------------------------------------------
+    def _resolve_mesh(self):
+        """The GlobalMesh this program shards over: the trainer's own
+        (``Trainer(mesh=...)``), else the process-global one
+        (``mx.shard.configure`` / ``MXNET_SHARD_DP``), else None —
+        the classic single-device capture."""
+        from .. import shard as _shard
+
+        gm = getattr(self._trainer, "_zero_gmesh", None)
+        if gm is None:
+            gm = _shard.current(auto=True)
+        return gm
+
     def _sig(self, datas, labels):
         from .. import monitor as _mon
         from ..contrib import amp as _amp
@@ -413,10 +596,14 @@ class StepProgram:
         mon_on = _mon.core.ENABLED
         gate = mon_on and _sentinel.policy() in _sentinel.SYNC_POLICIES
         remat = self._remat_override or remat_mode()
+        gm = self._resolve_mesh()
         return (tuple((tuple(x.shape), str(x.dtype)) for x in datas),
                 tuple((tuple(x.shape), str(x.dtype)) for x in labels),
                 mon_on, gate, _mt._hparams_sig(self._trainer._optimizer),
-                remat, _amp.is_active(), _amp.target_dtype())
+                remat, _amp.is_active(), _amp.target_dtype(),
+                None if gm is None else gm.signature(),
+                int(getattr(self._trainer, "_zero", 0) or 0),
+                str(get_env("MXNET_SHARD_DATA", str, "dp") or "dp"))
 
     def _get_program(self, datas, labels):
         sig = self._sig(datas, labels)  # typo'd env values fail loud
@@ -464,16 +651,32 @@ class StepProgram:
             trainer._init_kvstore()
         if trainer._update_on_kvstore:
             raise CaptureError("update_on_kvstore")
-        if trainer._zero:
-            # the ZeRO replicate-in/scatter-home placement dance is a
-            # cross-device protocol, not a pure program (ROADMAP item 1
-            # shards the captured program instead)
-            raise CaptureError("zero_trainer")
-        if self._world > 1 and self._axis_name is None:
+        gmesh = self._resolve_mesh()
+        level = int(getattr(trainer, "_zero", 0) or 0)
+        if gmesh is not None and self._axis_name is not None:
+            raise CaptureError(
+                "mesh_conflict",
+                "axis_name=%r (the shard_map spelling) and a GlobalMesh "
+                "are both armed; pick one" % (self._axis_name,))
+        if self._world > 1 and gmesh is None and self._axis_name is None:
             # cross-process collectives need the program to be SPMD
-            # over the global mesh — that is ROADMAP item 1 sharding
-            # THIS program, not something a per-process jit can capture
-            raise CaptureError("multi_process")
+            # over the global mesh; without one configured the step
+            # degrades (counted) instead of silently dropping the
+            # cross-replica reduction
+            raise CaptureError(
+                "unsharded_mesh",
+                "multi-process capture needs a GlobalMesh: call "
+                "mx.shard.configure(mx.shard.GlobalMesh()) or pass "
+                "mesh= to the Trainer")
+        if level and gmesh is None:  # trainer validation makes this dead
+            raise CaptureError("unsharded_mesh", "zero=%d without mesh"
+                               % level)
+        if gmesh is not None and self._world > 1 and \
+                gmesh.processes < self._world:
+            raise CaptureError(
+                "unsharded_mesh",
+                "GlobalMesh spans %d process(es) of a %d-process world"
+                % (gmesh.processes, self._world))
         block._ensure_initialized(datas)  # resolve deferred shapes
         items = []
         for i, param in enumerate(trainer._params):
@@ -497,8 +700,17 @@ class StepProgram:
 
         from ..monitor.core import _group_label
 
+        policy = None
+        if gmesh is not None:
+            from .. import shard as _shard
+
+            policy = _shard.ZeroPolicy(level, gmesh)
+            self._place(items, named, policy)
+
         cap = _Captured()
         cap.sig = sig
+        cap.gmesh = gmesh
+        cap.level = level
         cap.train_idx = tuple(i for i, _, _ in items)
         cap.pos_of = {i: j for j, i in enumerate(cap.train_idx)}
         cap.train_names = [name_of[id(p)] for _, p, _ in items]
@@ -521,12 +733,37 @@ class StepProgram:
                 for j in bucket)
             for bucket in cap.bucket_plan]
         cap.n_slots = 12 * len(items) + 8
+        if policy is None:
+            cap.param_shardings = None
+            cap.grad_shardings = None
+            cap.state_shardings = None
+            cap.replicated = None
+            cap.wire = None
+        else:
+            cap.param_shardings = [
+                policy.param_sharding(p.data().shape) for _, p, _ in items]
+            cap.grad_shardings = [
+                policy.grad_sharding(g.shape) for _, _, g in items]
+            cap.state_shardings = [
+                jax.tree_util.tree_map(
+                    lambda a: policy.state_sharding(a.shape),
+                    _mt._unwrap_state(trainer._states[i]))
+                for i in cap.train_idx]
+            cap.replicated = gmesh.replicated()
         w_bytes = sum(p.data()._data.size * p.data()._data.dtype.itemsize
                       for _, p, _ in items)
         s_leaves = [leaf for i in cap.train_idx
                     for leaf in jax.tree_util.tree_leaves(
                         _mt._unwrap_state(trainer._states[i]))]
         s_bytes = sum(a.size * a.dtype.itemsize for a in s_leaves)
+        if policy is not None:
+            # wire bytes per step, the reduce-scatter-vs-all-reduce
+            # price (fed to collective telemetry each dispatch)
+            cap.wire = {
+                "grads": policy.grad_collective_bytes(
+                    int(sum(cap.bucket_nbytes))),
+                "param_gather": policy.param_gather_bytes(int(w_bytes)),
+            }
         cap.donation = {
             "params": {"arrays": len(items), "bytes": int(w_bytes),
                        "donated": True},
@@ -537,12 +774,19 @@ class StepProgram:
         }
         cap.segments = [
             {"segment": "forward", "params": len(named),
-             "remat": cap.remat},
+             "remat": cap.remat,
+             "gather": "jit-per-layer" if level >= 3 else None},
             {"segment": "loss", "fn": type(self._loss_fn).__name__},
             {"segment": "backward", "grads": len(items)},
             {"segment": "allreduce", "buckets": len(cap.bucket_plan),
              "world": self._world,
              "bytes": int(sum(cap.bucket_nbytes)),
+             "collective": "reduce_scatter" if (
+                 gmesh is not None and level >= 2) else "all_reduce",
+             "dp": None if gmesh is None else gmesh.dp,
+             "zero": level,
+             "wire_bytes": None if cap.wire is None
+             else int(cap.wire["grads"]),
              "axis": self._axis_name},
         ]
         if cap.monitor:
@@ -565,8 +809,11 @@ class StepProgram:
         other_datas = [named[n]._data._data for n in cap.other_names]
         hscal0 = _np.zeros((cap.n_slots,), _np.float32)
         rng0 = jax.random.PRNGKey(0)
+        input_datas, label_datas, hscal0, rng0 = self._stage(
+            cap, [x._data for x in datas], [y._data for y in labels],
+            hscal0, rng0)
         args = (train_datas, state_trees, other_datas, hscal0, rng0,
-                [x._data for x in datas], [y._data for y in labels])
+                input_datas, label_datas)
         lowered = None
         with _mt._quiet_donation():
             with _trace.span("step_trace", hist=False):
@@ -622,12 +869,32 @@ class StepProgram:
         remat = cap.remat
         monitor_on = cap.monitor
         gate = cap.gate
+        gmesh = cap.gmesh
+        level = cap.level
+        param_shardings = cap.param_shardings
+        grad_shardings = cap.grad_shardings
+        state_shardings = cap.state_shardings
+        replicated = cap.replicated
 
         def step_fn(train_datas, state_trees, other_datas, hscal, rng,
                     input_datas, label_datas):
             base = dict(zip(other_names, other_datas))
 
             def fwd(tds):
+                if gmesh is not None and level >= 3:
+                    # ZeRO-3 just-in-time gather: each weight is
+                    # re-materialized (one all-gather per array,
+                    # scheduled by XLA right before first use and
+                    # freed after) INSIDE forward+backward.  The
+                    # explicit constraint also pins the fwd/bwd math
+                    # to the replicated program's exact contraction
+                    # order — sharded params must change layout, not
+                    # bits — and its transpose hands the cotangent
+                    # back toward the reduce-scattered shard layout.
+                    # Under remat the gathers replay in backward, so
+                    # peak parameter memory stays ~1/dp + live layer.
+                    tds = [jax.lax.with_sharding_constraint(t, replicated)
+                           for t in tds]
                 pd = dict(base)
                 pd.update(zip(train_names, tds))
                 ctx = contextlib.nullcontext() if remat != "blocks" \
@@ -649,6 +916,12 @@ class StepProgram:
                                         has_aux=True)
             (grads,) = vjp(jnp.ones_like(loss))
             grads = _bucket_allreduce(list(grads), plan_pos, axis_name)
+            if gmesh is not None and gmesh.dp > 1 and level >= 2:
+                # ZeRO-2/3: the pending cross-replica sum lands
+                # directly in the update's shard layout — a
+                # reduce-scatter per bucket, never a replicated grad
+                grads = _bucket_reduce_scatter(grads, plan_pos,
+                                               grad_shardings)
             statvecs = []
             if monitor_on:
                 for _label, idxs in group_list:
@@ -685,6 +958,22 @@ class StepProgram:
                 new_s = [jax.tree_util.tree_map(
                     lambda n, o: jnp.where(ok, n, o), n, o)
                     for n, o in zip(new_s, state_trees)]
+            if gmesh is not None:
+                # pin the output layout: params stay dp-sharded between
+                # steps under ZeRO-3 (levels 0-2: the post-update
+                # all-gather of the weight-update-sharding transform),
+                # optimizer state stays dp-sharded (levels >= 1), and
+                # everything host-facing (loss, forward state, stat
+                # vectors) comes back replicated
+                wsc = jax.lax.with_sharding_constraint
+                new_w = [wsc(a, param_shardings[j])
+                         for j, a in enumerate(new_w)]
+                new_s = [jax.tree_util.tree_map(wsc, ns, ssh)
+                         for ns, ssh in zip(new_s, state_shardings)]
+                states = {k: wsc(v, replicated)
+                          for k, v in states.items()}
+                loss = wsc(loss, replicated)
+                statvecs = [wsc(v, replicated) for v in statvecs]
             return new_w, new_s, states, loss, statvecs
 
         return step_fn
@@ -727,13 +1016,15 @@ class StepProgram:
                     vals = _np.zeros((cap.n_slots,), _np.float32)
                     for k, f in enumerate(cap.slot_fns):
                         vals[k] = f()
+                inputs, lbls, vals, rng = self._stage(
+                    cap, [x._data for x in datas],
+                    [y._data for y in labels], vals, rng)
                 with _trace.span("step_dispatch", hist=False,
                                  args={"groups": len(cap.group_list),
                                        "buckets": len(cap.bucket_plan)}):
                     out = self._dispatch(
                         cap, train_datas, state_trees, other_datas,
-                        vals, rng, [x._data for x in datas],
-                        [y._data for y in labels])
+                        vals, rng, inputs, lbls)
             except Exception:
                 self._rewind(prev_counts, prev_num_update)
                 raise
@@ -778,12 +1069,27 @@ class StepProgram:
                 if applied:
                     trainer._step_count += 1
                 self._path_counts["captured"] += 1
-                if self._world > 1 or self._axis_name is not None:
+                mesh_reduces = cap.gmesh is not None and cap.gmesh.dp > 1
+                if self._world > 1 or self._axis_name is not None \
+                        or mesh_reduces:
                     # the stitched path only observes bucket fill when
                     # collectives actually run; mirror that so the two
                     # paths stay comparable (a world of one reduces
-                    # nothing)
-                    observe_bucket_fill(cap.bucket_nbytes)
+                    # nothing).  Payload bytes feed the SAME
+                    # collective_* series the eager kvstore path does
+                    # ("allreduce"), or "reduce_scatter" under a
+                    # ZeRO-2/3 mesh — plus the params "all_gather" a
+                    # sharded update pays to re-materialize weights.
+                    # Priced WIRE bytes live in cap.wire / report().
+                    observe_bucket_fill(
+                        cap.bucket_nbytes,
+                        op="reduce_scatter" if (
+                            mesh_reduces and cap.level >= 2)
+                        else "allreduce")
+                    if mesh_reduces and cap.level >= 1:
+                        observe_collective(
+                            "all_gather",
+                            cap.donation["params"]["bytes"])
                 if _tel.ENABLED:
                     _tel.STEP_CAPTURE_STEPS.labels(path="captured").inc()
                     _tel.STEP_PROGRAM_SECONDS.observe(
@@ -796,8 +1102,10 @@ class StepProgram:
     def _dispatch(self, cap, *args):
         """Launch the captured program, bounded by the mx.dist
         collective deadline when one is armed in a multi-process world
-        (the whole captured dispatch IS the collective phase)."""
-        if self._world <= 1:
+        OR on a GlobalMesh (the whole captured dispatch IS the
+        collective phase — and the mesh case is how the single-process
+        virtual-device drills exercise the DistTimeout seam)."""
+        if self._world <= 1 and cap.gmesh is None:
             return cap.call(*args)
         from ..dist import timeouts as _dt
 

@@ -886,6 +886,19 @@ STEP_PROGRAM_SECONDS = histogram(
     "step_program_seconds",
     "captured whole-step program host latency per step (slot eval + "
     "dispatch + writeback; the program itself runs async)")
+# mx.shard (shard/): global-mesh SPMD training with ZeRO-1/2/3
+# cross-replica weight-update sharding.  The gauges record the LIVE
+# per-device residency after mesh placement — the memory contract the
+# bench rows and acceptance tests bound (state ~1/dp for zero>=1,
+# params ~1/dp for zero=3).
+SHARD_DEVICE_BYTES = gauge(
+    "shard_device_bytes",
+    "bytes resident on ONE device after mx.shard mesh placement, by "
+    "array kind (params / optimizer_state)", ("kind",))
+SHARD_ZERO_LEVEL = gauge(
+    "shard_zero_level",
+    "ZeRO weight-update sharding level of the most recently placed "
+    "captured step program (0 = replicated data-parallel)")
 # mx.resilience (resilience/): deterministic fault injection,
 # preemption handling, and the hardened restart supervisor — plus the
 # serve-side graceful-degradation counters (bisect/poison/breakers).

@@ -331,12 +331,14 @@ def trainer_info():
     print("groups       : %d  (demo model: %d params)"
           % (len(rows), len(trainer._params)))
     for r in rows:
+        shard_col = "w=%s s=%s" % (r["placement"]["params"],
+                                   r["placement"]["state"])
         print("  %-10s %3d params  %10.1f KiB  %d program/step  "
-              "%s%s  (%d host scalars)"
+              "%s%s  shard[%s]  (%d host scalars)"
               % (r["optimizer"], r["params"], r["bytes"] / 1024.0,
                  r["programs_per_step"], r["provenance"],
-                 "  [zero]" if r["zero"] else "",
-                 r["host_scalar_slots"]))
+                 "  [zero%d]" % r["zero"] if r["zero"] else "",
+                 shard_col, r["host_scalar_slots"]))
     grads = [(p.grad().size * p.grad().dtype.itemsize,
               str(p.grad().dtype)) for p in trainer._params]
     plan = collective.plan_buckets(grads)
@@ -395,12 +397,23 @@ def step_info():
     print("paths        : captured=%d stitched=%d skipped=%d"
           % (rep["paths"]["captured"], rep["paths"]["stitched"],
              rep["skipped_steps"]))
+    mesh = rep.get("mesh")
+    print("mesh         : %s" % (
+        "dp=%(dp)d mdl=%(mdl)d over %(devices)d device(s), "
+        "%(processes)d process(es)" % mesh if mesh
+        else "(none — single-device capture)"))
+    if rep.get("zero"):
+        print("zero         : level %d (mx.shard weight-update "
+              "sharding)" % rep["zero"])
     for prog in rep["programs"]:
         print("program      : provenance=%s  remat=%s  monitor=%s  "
-              "gate=%s  host-scalar slots=%d"
+              "gate=%s  zero=%s  host-scalar slots=%d"
               % (prog["provenance"], prog["remat"],
                  prog["monitor_fused"], prog["gate"],
-                 prog["host_scalar_slots"]))
+                 prog.get("zero", 0), prog["host_scalar_slots"]))
+        if prog.get("wire"):
+            print("  wire/step  : grads %s B  param gather %s B"
+                  % (prog["wire"]["grads"], prog["wire"]["param_gather"]))
         print("  fingerprint: %s" % (prog["fingerprint"] or
                                      "(cache disabled / no lowering)"))
         print("  segments   :")
