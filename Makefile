@@ -10,9 +10,9 @@ SRCS := $(wildcard src/native/*.cc)
 SO := build/libmxtpu_native.so
 
 .PHONY: native test cpptest telemetry-smoke checkpoint-smoke serve-smoke \
-	compile-cache-smoke trainer-smoke step-smoke trace-smoke \
-	monitor-smoke faults-smoke dist-faults-smoke zero-smoke smoke-all \
-	clean
+	decode-smoke compile-cache-smoke trainer-smoke step-smoke \
+	trace-smoke monitor-smoke faults-smoke dist-faults-smoke \
+	zero-smoke smoke-all clean
 
 native: $(SO)
 
@@ -57,6 +57,18 @@ serve-smoke:
 	JAX_PLATFORMS=cpu python tools/serve_smoke.py
 	JAX_PLATFORMS=cpu python -m pytest \
 	  tests/python/unittest/test_serve.py -q -m 'not slow'
+
+# mx.serve.decode smoke: paged KV-cache + continuous batching — tiny
+# decoder on CPU, concurrent mixed prefill/decode clients (stream +
+# collect over HTTP), sequences verifiably join/leave the running batch
+# mid-flight, <=1 compile per (bucket, page-config), streamed tokens
+# bit-identical to collect mode + X-Request-Id echo, serve_poison drill
+# evicts one sequence alone with pages reclaimed, clean drain audits the
+# pool to zero; then the subsystem's pytest suite
+decode-smoke:
+	JAX_PLATFORMS=cpu python tools/decode_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/python/unittest/test_serve_decode.py -q -m 'not slow'
 
 # mx.compile smoke: compile in process A -> process B warm-starts from
 # the persistent cache with 0 fresh jax.jit builds (verified through
@@ -146,7 +158,7 @@ dist-faults-smoke:
 
 # every subsystem smoke in sequence — the one-command pre-flight before
 # a tunnel window (each target is independent; failures stop the chain)
-smoke-all: telemetry-smoke checkpoint-smoke serve-smoke \
+smoke-all: telemetry-smoke checkpoint-smoke serve-smoke decode-smoke \
 	compile-cache-smoke trainer-smoke step-smoke trace-smoke \
 	monitor-smoke faults-smoke zero-smoke dist-faults-smoke
 

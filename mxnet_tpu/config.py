@@ -191,6 +191,10 @@ ENV_VARS = {
         "serve_poison / step_capture; kinds: transient / io / fatal / "
         "abort).  Faults fire by (site, sequence), so every drill "
         "replays identically (resilience/inject.py).  The "
+        "serve_dispatch and serve_poison sites also fire on the "
+        "serve decode plane: a poisoned request id evicts that "
+        "SEQUENCE alone from the continuous batch (pages reclaimed, "
+        "batch-mates keep decoding).  The "
         "step_capture site fires twice per captured step lifecycle: "
         "at capture/build time (poisons the capture -> clean stitched "
         "fallback) and at program dispatch (exercises the supervisor "
@@ -276,6 +280,30 @@ ENV_VARS = {
         float, 1.0,
         "Retry-After seconds the HTTP front-end advertises on "
         "overload 503 responses."),
+    "MXNET_SERVE_DECODE_PAGE_SIZE": (
+        int, 16,
+        "Token slots per KV-cache page of the serve decode plane "
+        "(serve/kvcache.py): every sequence's context is stored as "
+        "fixed-size pages addressed through its page table."),
+    "MXNET_SERVE_DECODE_POOL_PAGES": (
+        int, 256,
+        "Total pages in the decode plane's device-resident KV pool; "
+        "admission reserves a sequence's whole worst case up front, so "
+        "this bounds concurrent context tokens (pages x page_size)."),
+    "MXNET_SERVE_DECODE_MAX_LIVE": (
+        int, 8,
+        "Max sequences decoding concurrently in the running batch "
+        "(serve/decode.py DecodeScheduler); also caps the decode "
+        "batch-bucket table the runner pre-compiles."),
+    "MXNET_SERVE_DECODE_MAX_NEW": (
+        int, 64,
+        "Default and hard cap on generated tokens per decode request "
+        "(requests may ask for less; more is clamped)."),
+    "MXNET_SERVE_DECODE_STREAM": (
+        bool, True,
+        "Serve chunked per-token streaming on /predict?stream=1; 0 "
+        "forces collect mode (the streamed and collected token "
+        "sequences are bit-identical either way)."),
     "MXNET_TELEMETRY_DISABLE": (
         bool, False,
         "Disable the runtime telemetry registry (mx.telemetry); hooks "

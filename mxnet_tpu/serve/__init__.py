@@ -28,17 +28,33 @@ checkpoint): compile-once/run-many execution behind a request queue.
   ``/statz``); overload maps to 503 + ``Retry-After`` and deadline
   expiry to 504, with ``X-Request-Id`` echoed on every response.
 
+- **autoregressive decode plane** (``decode.py`` + ``kvcache.py``):
+  paged/blocked KV-cache as first-class serving state (``PagePool``:
+  fixed-size pages, per-sequence page tables, admission-time
+  worst-case reservation — OOM is a fast reject, never a mid-decode
+  failure) and Orca-style continuous batching (``DecodeScheduler``:
+  one jitted decode-step program per batch bucket runs every
+  iteration over whichever sequences are live; sequences join freed
+  slots mid-flight and leave — pages reclaimed — the same step),
+  with per-token streaming through ``/predict?stream=1``, the
+  in-program output guard, sequence-granular poison isolation and
+  per-bucket breakers.
+
 Every stage is metered through ``mx.telemetry`` (``serve_*`` queue
-wait, batch size, pad waste, compile count, latency, rejections) and
+wait, batch size, pad waste, compile count, latency, rejections, and
+the ``serve_decode_*`` / ``serve_kv_*`` decode-plane families) and
 exported through the existing Prometheus/JSON exporters.  See README
-"Serving" for the knobs and the hot-swap workflow.
+"Serving" / "Autoregressive serving" for the knobs and workflows.
 """
 from __future__ import annotations
 
 from .batching import (BatchQueue, BucketQuarantined, NoBucketError,
                        Request, RequestTimeout, Scheduler, ServeError,
-                       ServerClosed, ServerOverloaded)
+                       ServerClosed, ServerOverloaded, fail_request)
 from .breaker import BreakerBoard, CircuitBreaker
+from .decode import (DecodeConfig, DecodeError, DecodeRequest,
+                     DecodeRunner, DecodeScheduler, TinyDecoder)
+from .kvcache import PageConfig, PagePool, PagePoolExhausted
 from .runner import DEFAULT_BATCH_SIZES, ModelRunner
 from .server import ServeConfig, Server
 
@@ -47,4 +63,9 @@ __all__ = [
     "Request", "ServeError", "ServerOverloaded", "ServerClosed",
     "RequestTimeout", "NoBucketError", "BucketQuarantined",
     "CircuitBreaker", "BreakerBoard", "DEFAULT_BATCH_SIZES",
+    "fail_request",
+    # autoregressive decode plane (paged KV-cache + continuous batching)
+    "DecodeConfig", "DecodeError", "DecodeRequest", "DecodeRunner",
+    "DecodeScheduler", "TinyDecoder", "PageConfig", "PagePool",
+    "PagePoolExhausted",
 ]

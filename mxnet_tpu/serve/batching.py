@@ -28,7 +28,7 @@ from ..base import MXNetError
 from ..resilience import inject as _inject
 from ..resilience.inject import InjectedFault
 
-__all__ = ["ServeError", "ServerOverloaded", "ServerClosed",
+__all__ = ["ServeError", "ServerOverloaded", "ServerClosed", "fail_request",
            "RequestTimeout", "NoBucketError", "BucketQuarantined",
            "Request", "BatchQueue", "Scheduler"]
 
@@ -66,8 +66,14 @@ class BucketQuarantined(ServeError):
         self.retry_after = retry_after
 
 
-def _fail(req, exc, result):
-    """Resolve a request exceptionally (idempotent) + count the outcome."""
+def fail_request(req, exc, result):
+    """Resolve a request exceptionally (idempotent) + count the outcome.
+
+    Shared by the micro-batch scheduler AND the decode path: anything
+    with the ``Request`` resolution surface (``future`` / ``enqueued``
+    / ``trace`` / ``request_id``) resolves through here so the
+    ``serve_requests_total{result=...}`` taxonomy and the per-request
+    root trace span stay consistent across both serving planes."""
     try:
         req.future.set_exception(exc)
     except InvalidStateError:
@@ -171,7 +177,7 @@ class BatchQueue:
                 telemetry.SERVE_QUEUE_DEPTH.set(0)
             self._cond.notify_all()
         for req in items:
-            _fail(req, ServerClosed("server shut down before dispatch"),
+            fail_request(req, ServerClosed("server shut down before dispatch"),
                   "cancelled")
 
     def _expire_locked(self):
@@ -185,7 +191,7 @@ class BatchQueue:
             if telemetry.ENABLED:
                 telemetry.SERVE_QUEUE_DEPTH.set(len(self._items))
             for req in dead:
-                _fail(req, RequestTimeout(
+                fail_request(req, RequestTimeout(
                     "deadline expired after %.1f ms in queue"
                     % ((now - req.enqueued) * 1e3)), "timeout")
 
@@ -291,7 +297,7 @@ class Scheduler:
         for req in batch:
             if req.expired(now) or req.future.cancelled():
                 if req.expired(now):
-                    _fail(req, RequestTimeout(
+                    fail_request(req, RequestTimeout(
                         "deadline expired before dispatch"), "timeout")
                 continue
             live.append(req)
@@ -317,7 +323,7 @@ class Scheduler:
         if self._breakers is not None and not self._breakers.allow(cls):
             exc = self._breakers.quarantine_error(cls)
             for req in live:
-                _fail(req, exc, "quarantined")
+                fail_request(req, exc, "quarantined")
             return
         runner = self._runner_fn()
         if runner is None:
@@ -325,7 +331,7 @@ class Scheduler:
             # shutdown): fail whatever is queued and wind the loop down
             exc = ServerClosed("server was dropped without shutdown")
             for req in live:
-                _fail(req, exc, "cancelled")
+                fail_request(req, exc, "cancelled")
             self._queue.close()
             self._queue.cancel_pending()
             return
@@ -344,7 +350,7 @@ class Scheduler:
                 pairs = self._run_split(runner, live)
         except BaseException as exc:  # noqa: BLE001 - surfaced per-request
             for req in live:
-                _fail(req, exc, "error")
+                fail_request(req, exc, "error")
             if self._breakers is not None:
                 self._breakers.failure(cls)
             return
@@ -370,7 +376,7 @@ class Scheduler:
                     poisoned = isolated and any_ok
                     if poisoned and telemetry.ENABLED:
                         telemetry.SERVE_POISON.inc()
-                    _fail(req, exc,
+                    fail_request(req, exc,
                           "poisoned" if poisoned else "error")
                     continue
                 try:

@@ -1,0 +1,1308 @@
+"""mx.serve.decode — continuous batching over a paged KV-cache.
+
+The PR 3 scheduler coalesces fixed-shape micro-batches: right for
+vision, wrong for decoder-LLM traffic, where every request is a
+*sequence* that produces one token per model step and lives for
+hundreds of steps.  Request-level batching would hold a finished
+sequence's slot (and its KV cache) hostage until the slowest
+batch-mate finished.  This module implements Orca-style
+**iteration-level scheduling** instead: one jitted decode-step program
+runs every iteration over whichever sequences are live *right now* —
+new sequences are admitted into freed slots mid-flight, finished /
+expired / poisoned sequences are evicted and their KV pages reclaimed
+the same step.
+
+Layers:
+
+- ``DecodeRunner`` — owns the model (a decoder ``HybridBlock``
+  following the contract below), the ``kvcache.PagePool``, and the
+  compiled program table: ONE program per decode batch bucket and one
+  per prefill length bucket, each built once (``jax.jit`` with pool
+  donation), fingerprinted into the ``mx.compile`` persistent cache
+  (``attach_lowered``) so a restarted server reaches readiness with
+  zero fresh XLA compiles, and metered per bucket
+  (``serve_decode_compile_total``: steady state adds nothing).
+- ``DecodeScheduler`` — the admission queue + continuous-batching
+  loop: bounded waiting queue with deadline expiry, page reservation
+  at admission (the whole worst case — never a mid-decode allocation
+  failure), prefill through the bucket path, then the decode loop.
+  Failure containment mirrors the vision scheduler: a failing step is
+  retried **bisected** down to single sequences so a poisoned sequence
+  fails ALONE with its pages reclaimed while batch-mates keep
+  decoding (``serve_poison_requests_total``; drilled via the
+  ``MXNET_FAULTS`` ``serve_poison@<request-id>`` site), and decode
+  buckets carry their own circuit breakers.
+- ``TinyDecoder`` — a small but real transformer decoder implementing
+  the model contract; the reference model for tests, the smoke drill
+  and the bench row, and executable documentation of the contract.
+
+**Decoder model contract.**  Any ``HybridBlock`` with integer
+attributes ``num_layers`` / ``num_kv_heads`` / ``head_dim`` /
+``vocab_size`` (optional ``eos_id``) and the forward signature::
+
+    forward(tokens,        # [B, T]            int32 token ids
+            k_ctx, v_ctx,  # [B, L, S, H, D]   gathered paged context
+            ctx_lengths,   # [B]               int32 cached positions
+            chunk_lengths) # [B]               int32 valid chunk length
+        -> (last_logits,   # [B, vocab]        logits at the last
+                           #                   valid chunk position
+            k_new, v_new)  # [B, T, L, H, D]   cache rows for the chunk
+
+serves through this path.  Prefill is the ``S == 0`` signature
+(``T`` = prompt bucket); decode is ``T == 1`` with the full paged
+context.  The forward must attend causally within the chunk and mask
+context positions ``>= ctx_length``; everything page-shaped (gather,
+scatter, argmax sampling, the per-token nonfinite guard) happens in
+the jitted wrapper the runner builds around ``export_pure``, so the
+model stays paging-agnostic.
+
+Every emitted token passes the PR 7 output guard *in-program* (a
+nonfinite logit row costs one int per sequence, not a logits
+round-trip): a sequence that goes NaN is evicted alone.  Per-token
+``serve_decode_token`` trace spans hang off the request's single
+``X-Request-Id`` trace, and token streaming reaches the HTTP
+front-end through the ``on_token`` callback (``server.py`` chunked
+responses on ``/predict?stream=1``).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
+
+import numpy as _np
+
+from .. import telemetry, trace
+from ..base import get_env
+from ..resilience import inject as _inject
+from ..resilience.inject import InjectedFault, InjectedIOError
+from .batching import (RequestTimeout, ServeError,
+                       ServerClosed, ServerOverloaded, fail_request)
+from .kvcache import (PageConfig, PagePool, PagePoolExhausted,
+                      gather_pages, scatter_pages)
+
+__all__ = ["DecodeError", "DecodeConfig", "DecodeRequest",
+           "DecodeRunner", "DecodeScheduler", "TinyDecoder"]
+
+
+class DecodeError(ServeError):
+    """Decode-path request validation / execution error."""
+
+
+def _pow2_up_to(lo, hi):
+    out, b = [], lo
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(hi)
+    return tuple(sorted(set(out)))
+
+
+class DecodeConfig:
+    """Knobs of the decode path (README "Autoregressive serving").
+
+    page_size / pool_pages : KV page geometry
+        (``MXNET_SERVE_DECODE_PAGE_SIZE`` / ``_POOL_PAGES``).
+    max_live : concurrent sequences in the running batch
+        (``MXNET_SERVE_DECODE_MAX_LIVE``); also caps the decode batch
+        bucket table.
+    max_new_tokens : default + hard per-request generation cap
+        (``MXNET_SERVE_DECODE_MAX_NEW``).
+    max_context : bound on prompt + generated tokens per sequence;
+        fixes the paged-attention context extent every decode program
+        compiles for.
+    prefill_lengths : prompt padding buckets (default: powers of two
+        up to ``max_context``).
+    batch_sizes : decode batch buckets (default: powers of two up to
+        ``max_live``).
+    queue_depth : bound on ADMISSION-waiting sequences; beyond it
+        submissions are rejected with ``ServerOverloaded``.
+    timeout_ms : default per-request deadline (expires a sequence
+        mid-generation too).
+    stream : whether the HTTP front-end advertises/serves chunked
+        token streaming (``MXNET_SERVE_DECODE_STREAM``).
+    eos_id : default stop token (None = length-only stopping).
+    """
+
+    def __init__(self, page_size=None, pool_pages=None, max_live=None,
+                 max_new_tokens=None, max_context=128,
+                 prefill_lengths=None, batch_sizes=None, queue_depth=64,
+                 timeout_ms=None, stream=None, eos_id=None,
+                 dtype="float32"):
+        self.page_size = get_env("MXNET_SERVE_DECODE_PAGE_SIZE", int, 16) \
+            if page_size is None else int(page_size)
+        self.pool_pages = get_env("MXNET_SERVE_DECODE_POOL_PAGES", int,
+                                  256) \
+            if pool_pages is None else int(pool_pages)
+        self.max_live = get_env("MXNET_SERVE_DECODE_MAX_LIVE", int, 8) \
+            if max_live is None else int(max_live)
+        self.max_new_tokens = get_env("MXNET_SERVE_DECODE_MAX_NEW", int,
+                                      64) \
+            if max_new_tokens is None else int(max_new_tokens)
+        self.stream = get_env("MXNET_SERVE_DECODE_STREAM", bool, True) \
+            if stream is None else bool(stream)
+        self.max_context = int(max_context)
+        if prefill_lengths is None:
+            prefill_lengths = _pow2_up_to(
+                min(8, self.max_context), self.max_context)
+        self.prefill_lengths = tuple(sorted(set(
+            int(t) for t in prefill_lengths if int(t) <= self.max_context)))
+        if not self.prefill_lengths:
+            raise ValueError("no prefill bucket <= max_context=%d"
+                             % self.max_context)
+        if batch_sizes is None:
+            batch_sizes = _pow2_up_to(1, max(1, self.max_live))
+        self.batch_sizes = tuple(sorted(set(int(b) for b in batch_sizes)))
+        if self.batch_sizes[-1] < self.max_live:
+            raise ValueError(
+                "largest decode batch bucket %d < max_live=%d: live "
+                "sequences could never all step"
+                % (self.batch_sizes[-1], self.max_live))
+        self.queue_depth = int(queue_depth)
+        self.timeout_ms = timeout_ms
+        self.eos_id = eos_id
+        self.dtype = dtype
+
+    def as_dict(self):
+        return {
+            "page_size": self.page_size, "pool_pages": self.pool_pages,
+            "max_live": self.max_live,
+            "max_new_tokens": self.max_new_tokens,
+            "max_context": self.max_context,
+            "prefill_lengths": list(self.prefill_lengths),
+            "batch_sizes": list(self.batch_sizes),
+            "queue_depth": self.queue_depth,
+            "timeout_ms": self.timeout_ms, "stream": self.stream,
+            "eos_id": self.eos_id, "dtype": self.dtype,
+        }
+
+
+class DecodeRequest:
+    """One autoregressive generation request.
+
+    Carries the same resolution surface as ``batching.Request``
+    (``future`` / ``enqueued`` / ``deadline`` / ``request_id`` /
+    ``trace``) so the shared failure/telemetry plumbing applies; the
+    future resolves to ``{"tokens": [ids...], "finish_reason": ...}``.
+    ``on_token(token_id, index)`` — when given — is called once per
+    emitted token from the decode loop (it must be cheap and
+    non-blocking: enqueue, don't write sockets); the streamed sequence
+    is bit-identical to the future's ``tokens``."""
+
+    __slots__ = ("prompt", "max_new_tokens", "eos_id", "on_token",
+                 "future", "enqueued", "deadline", "request_id", "trace")
+
+    def __init__(self, prompt, max_new_tokens, eos_id=None, deadline=None,
+                 request_id=None, on_token=None):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.on_token = on_token
+        self.future = Future()
+        self.enqueued = time.perf_counter()
+        self.deadline = deadline
+        self.request_id = request_id
+        self.trace = trace.new_request(request_id)
+        if self.trace is not None:
+            trace.instant("serve_decode_enqueue", cat="serve",
+                          ctx=self.trace,
+                          args={"request_id": request_id,
+                                "prompt_tokens": len(self.prompt)})
+
+    def expired(self, now=None):
+        return self.deadline is not None and \
+            (time.perf_counter() if now is None else now) >= self.deadline
+
+
+class _Seq:
+    """Decode-loop bookkeeping for one live sequence."""
+
+    __slots__ = ("req", "sid", "tokens", "length", "pages", "joined_step",
+                 "t_prefill", "first_token_t", "last_token")
+
+    def __init__(self, req, sid):
+        self.req = req
+        self.sid = sid
+        self.tokens = []          # generated token ids
+        self.length = 0           # positions resident in the KV pages
+        self.pages = None
+        self.joined_step = None
+        self.t_prefill = None
+        self.first_token_t = None
+        self.last_token = None    # next decode-step input token
+
+    @property
+    def done_reason(self):
+        if self.req.eos_id is not None and self.tokens and \
+                self.tokens[-1] == self.req.eos_id:
+            return "eos"
+        if len(self.tokens) >= self.req.max_new_tokens:
+            return "length"
+        return None
+
+
+class _Program:
+    __slots__ = ("fn", "label", "provenance", "builds")
+
+    def __init__(self, fn, label, provenance):
+        self.fn = fn
+        self.label = label
+        self.provenance = provenance
+        self.builds = 1
+
+
+class DecodeRunner:
+    """Model + paged KV pool + compiled decode/prefill program table.
+
+    ``block`` is a decoder HybridBlock following the module-doc
+    contract (or a zero-arg factory); ``root``/``step`` restore from an
+    ``mx.checkpoint`` root like ``ModelRunner``.  ``warm_up()`` builds
+    every (bucket, page-config) program — consulting the ``mx.compile``
+    persistent cache first — and runs each once, so steady-state
+    decoding triggers at most ONE compile per bucket and a restarted
+    server can reach readiness with zero fresh XLA compiles."""
+
+    def __init__(self, block, root=None, step=None, ctx=None, config=None,
+                 warm=True):
+        from ..gluon.block import HybridBlock
+        from .runner import resolve_block
+
+        block = resolve_block(block, HybridBlock, "DecodeRunner")
+        for attr in ("num_layers", "num_kv_heads", "head_dim",
+                     "vocab_size"):
+            if not isinstance(getattr(block, attr, None), int):
+                raise ValueError(
+                    "decoder contract: block must carry int attribute "
+                    "%r (see serve/decode.py module doc)" % attr)
+        self._block = block
+        self._ctx = ctx
+        self.config = config or DecodeConfig()
+        # the effective stop token lives on the RUNNER, not the config:
+        # a DecodeConfig may be shared across runners/models and must
+        # not absorb one model's eos_id
+        self.eos_id = self.config.eos_id \
+            if self.config.eos_id is not None \
+            else getattr(block, "eos_id", None)
+        self.root = root
+        self.step = None
+        if root is not None:
+            self.step = block.load_checkpoint(root, step=step, ctx=ctx)
+        self._resolve_params()
+        self._apply_fn, self._params = block.export_pure(training=False)
+        c = self.config
+        self.page_config = PageConfig(
+            c.page_size, c.pool_pages, block.num_layers,
+            block.num_kv_heads, block.head_dim, c.max_context,
+            dtype=c.dtype)
+        self.pool = PagePool(self.page_config)
+        self._programs = {}
+        self._run_lock = threading.RLock()
+        self._warmed = False
+        if warm:
+            self.warm_up()
+
+    # -- setup --------------------------------------------------------------
+    def _resolve_params(self):
+        """One tiny forward resolves deferred parameter shapes before
+        ``export_pure`` (the contract signature with S=0, T=1)."""
+        from .. import ndarray as nd
+
+        b = self._block
+        zero_ctx = nd.zeros((1, b.num_layers, 0, b.num_kv_heads,
+                             b.head_dim), dtype=self.config.dtype)
+        ones = nd.array(_np.array([1], dtype="int32"))
+        self._block(nd.zeros((1, 1), dtype="int32"), zero_ctx, zero_ctx,
+                    nd.zeros((1,), dtype="int32"), ones)
+
+    @property
+    def block(self):
+        return self._block
+
+    @property
+    def warmed(self):
+        return self._warmed
+
+    # -- bucket choice ------------------------------------------------------
+    def prefill_bucket(self, n):
+        for t in self.config.prefill_lengths:
+            if t >= n:
+                return t
+        raise DecodeError(
+            "prompt of %d token(s) exceeds the largest prefill bucket "
+            "(%d); buckets: %s" % (n, self.config.prefill_lengths[-1],
+                                   list(self.config.prefill_lengths)))
+
+    def decode_bucket(self, n):
+        for b in self.config.batch_sizes:
+            if b >= n:
+                return b
+        return self.config.batch_sizes[-1]
+
+    # -- program build ------------------------------------------------------
+    @staticmethod
+    def bucket_key_label(key):
+        kind, n = key
+        return "%s%d" % ("decode:b" if kind == "decode" else "prefill:t",
+                         n)
+
+    def _make_step_fn(self, batch, chunk, with_ctx):
+        """The pure (params, k_pool, v_pool, tokens, tables, ctx_lens,
+        chunk_lens) -> (k_pool, v_pool, next_tokens, nonfinite) function
+        one (bucket, page-config) jit-compiles.  Sampling (greedy
+        argmax) and the per-token output guard run in-program: the host
+        reads B ints per step, never a logits tensor."""
+        import jax.numpy as jnp
+
+        apply_fn = self._apply_fn
+        blk = self._block
+        nlayers, nheads, hdim = (blk.num_layers, blk.num_kv_heads,
+                                 blk.head_dim)
+        dtype = self.page_config.dtype
+
+        def step(params, kp, vp, tokens, tables, ctx_lens, chunk_lens):
+            if with_ctx:
+                k_ctx = gather_pages(kp, tables)
+                v_ctx = gather_pages(vp, tables)
+                # scrub positions past each sequence's length: freed
+                # pages are reallocated WITHOUT zeroing, so a previous
+                # owner's values (possibly NaN — that is how a poisoned
+                # sequence died) sit in the tail of the current page.
+                # Additive attention masking cannot discard NaN inputs
+                # (NaN + -1e9 is NaN, and softmax-0 x NaN is NaN), so
+                # the contract guarantees the model NEVER sees
+                # unwritten context.
+                live = (jnp.arange(k_ctx.shape[2])[None, None, :, None,
+                                                   None]
+                        < ctx_lens[:, None, None, None, None])
+                k_ctx = jnp.where(live, k_ctx, 0)
+                v_ctx = jnp.where(live, v_ctx, 0)
+            else:
+                k_ctx = jnp.zeros((batch, nlayers, 0, nheads, hdim),
+                                  dtype=dtype)
+                v_ctx = k_ctx
+            outs, _states = apply_fn(params, None, tokens, k_ctx, v_ctx,
+                                     ctx_lens, chunk_lens)
+            logits, k_new, v_new = outs
+            pos = ctx_lens[:, None] + jnp.arange(chunk, dtype=jnp.int32)
+            valid = jnp.arange(chunk, dtype=jnp.int32)[None, :] \
+                < chunk_lens[:, None]
+            kp = scatter_pages(kp, tables, pos, valid, k_new)
+            vp = scatter_pages(vp, tables, pos, valid, v_new)
+            next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            bad = jnp.sum(~jnp.isfinite(logits), axis=-1,
+                          dtype=jnp.int32)
+            return kp, vp, next_tok, bad
+
+        return step
+
+    def _build(self, key):
+        """Build (or restore from the mx.compile persistent cache) the
+        program for ``key`` = ("decode", B) | ("prefill", T)."""
+        import jax
+
+        kind, n = key
+        batch = n if kind == "decode" else 1
+        chunk = 1 if kind == "decode" else n
+        label = self.bucket_key_label(key)
+        fn = self._make_step_fn(batch, chunk, with_ctx=(kind == "decode"))
+        jitted = jax.jit(fn, donate_argnums=(1, 2))
+        provenance = "fresh"
+        compiled = None
+        try:
+            aval = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)  # noqa: E731
+            params_avals = jax.tree_util.tree_map(aval, self._params)
+            c = self.page_config
+            pool_aval = jax.ShapeDtypeStruct(
+                (c.num_layers, c.num_pages, c.page_size, c.num_kv_heads,
+                 c.head_dim), _np.dtype(c.dtype))
+            i32 = _np.dtype("int32")
+            lowered = jitted.lower(
+                params_avals, pool_aval, pool_aval,
+                jax.ShapeDtypeStruct((batch, chunk), i32),
+                jax.ShapeDtypeStruct((batch, c.pages_per_seq), i32),
+                jax.ShapeDtypeStruct((batch,), i32),
+                jax.ShapeDtypeStruct((batch,), i32))
+            from ..compile.aot import attach_lowered
+
+            compiled, _fp, provenance = attach_lowered(
+                lowered, type(self._block).__name__ + ".decode_step",
+                label)
+        except Exception:
+            compiled = None  # lazy jit path below; still one compile
+        prog = _Program(compiled if compiled is not None else jitted,
+                        label, provenance)
+        self._programs[key] = prog
+        if telemetry.ENABLED and provenance != "cache":
+            telemetry.SERVE_DECODE_COMPILES.labels(bucket=label).inc()
+        return prog
+
+    def warm_up(self):
+        """Pre-build every decode batch bucket and prefill length
+        bucket program and run each once (compiles now, not on the
+        first live sequence).  Returns the number of fresh builds
+        (cache restores count 0)."""
+        fresh = 0
+        keys = [("decode", b) for b in self.config.batch_sizes] + \
+            [("prefill", t) for t in self.config.prefill_lengths]
+        for key in keys:
+            if key in self._programs:
+                continue
+            with trace.span("serve_decode_warmup", hist=False,
+                            cat="serve",
+                            args={"bucket": self.bucket_key_label(key)}):
+                prog = self._build(key)
+                if prog.provenance != "cache":
+                    fresh += 1
+                # one throw-away execution against all-null page tables
+                # (drop-mode scatter: the pool is untouched) proves the
+                # program runs — and in the lazy-jit fallback forces
+                # the XLA compile to happen before readiness
+                kind, n = key
+                batch = n if kind == "decode" else 1
+                chunk = 1 if kind == "decode" else n
+                self._dispatch(prog, self._null_inputs(batch, chunk))
+        self._warmed = True
+        return fresh
+
+    def _null_inputs(self, batch, chunk):
+        c = self.page_config
+        return (_np.zeros((batch, chunk), dtype=_np.int32),
+                _np.full((batch, c.pages_per_seq), self.pool.null_page,
+                         dtype=_np.int32),
+                _np.zeros((batch,), dtype=_np.int32),
+                _np.ones((batch,), dtype=_np.int32))
+
+    def provenance(self):
+        return {p.label: p.provenance for p in self._programs.values()}
+
+    # -- dispatch -----------------------------------------------------------
+    def _dispatch(self, prog, inputs):
+        """Run one program over the CURRENT pool arrays (donated) and
+        re-bind the updated pool.  Any failure after the donation point
+        can leave the pool consumed — detected and surfaced as a
+        ``pool_lost`` DecodeError (the scheduler evicts everything;
+        per-sequence containment is impossible without storage)."""
+        tokens, tables, ctx_lens, chunk_lens = inputs
+        kp, vp = self.pool.k, self.pool.v
+        try:
+            out = prog.fn(self._params, kp, vp, tokens, tables,
+                          ctx_lens, chunk_lens)
+            next_tok = _np.asarray(out[2])   # hard sync: errors land here
+            bad = _np.asarray(out[3])
+            self.pool.k, self.pool.v = out[0], out[1]
+            return next_tok, bad
+        except (InjectedFault, InjectedIOError):
+            raise
+        except BaseException as exc:  # noqa: BLE001 - classified below
+            if getattr(kp, "is_deleted", lambda: False)():
+                import jax.numpy as jnp
+
+                c = self.page_config
+                shape = (c.num_layers, c.num_pages, c.page_size,
+                         c.num_kv_heads, c.head_dim)
+                self.pool.k = jnp.zeros(shape, dtype=c.dtype)
+                self.pool.v = jnp.zeros(shape, dtype=c.dtype)
+                err = DecodeError(
+                    "decode step failed AFTER pool donation; KV storage "
+                    "lost, all live sequences must restart: %r" % (exc,))
+                err.pool_lost = True
+                raise err from exc
+            raise
+
+    def prefill(self, seq):
+        """Run one sequence's prompt through its prefill bucket; writes
+        the prompt's K/V into the sequence's reserved pages and returns
+        ``(first_token, nonfinite_count)``."""
+        c = self.page_config
+        prompt = seq.req.prompt
+        t_bucket = self.prefill_bucket(len(prompt))
+        tokens = _np.zeros((1, t_bucket), dtype=_np.int32)
+        tokens[0, :len(prompt)] = prompt
+        tables = _np.full((1, c.pages_per_seq), self.pool.null_page,
+                          dtype=_np.int32)
+        tables[0, :len(seq.pages)] = seq.pages
+        ctx_lens = _np.zeros((1,), dtype=_np.int32)
+        chunk_lens = _np.array([len(prompt)], dtype=_np.int32)
+        with self._run_lock:
+            prog = self._programs.get(("prefill", t_bucket)) or \
+                self._build(("prefill", t_bucket))
+            next_tok, bad = self._dispatch(
+                prog, (tokens, tables, ctx_lens, chunk_lens))
+        return int(next_tok[0]), int(bad[0])
+
+    def decode_step(self, seqs):
+        """One iteration over ``seqs`` (the live set or a bisected
+        subset): each sequence's pending token is written at its next
+        position and its next token sampled.  Returns aligned
+        ``(next_tokens, nonfinite_counts)`` numpy arrays."""
+        c = self.page_config
+        bucket = self.decode_bucket(len(seqs))
+        tokens = _np.zeros((bucket, 1), dtype=_np.int32)
+        tables = _np.full((bucket, c.pages_per_seq), self.pool.null_page,
+                          dtype=_np.int32)
+        ctx_lens = _np.zeros((bucket,), dtype=_np.int32)
+        chunk_lens = _np.ones((bucket,), dtype=_np.int32)
+        for i, seq in enumerate(seqs):
+            tokens[i, 0] = seq.last_token
+            tables[i, :len(seq.pages)] = seq.pages
+            ctx_lens[i] = seq.length
+        with self._run_lock:
+            prog = self._programs.get(("decode", bucket)) or \
+                self._build(("decode", bucket))
+            next_tok, bad = self._dispatch(
+                prog, (tokens, tables, ctx_lens, chunk_lens))
+        return next_tok[:len(seqs)], bad[:len(seqs)]
+
+    def stats(self):
+        return {
+            "step": self.step, "root": self.root, "warmed": self._warmed,
+            "model": type(self._block).__name__,
+            "geometry": {"num_layers": self._block.num_layers,
+                         "num_kv_heads": self._block.num_kv_heads,
+                         "head_dim": self._block.head_dim,
+                         "vocab_size": self._block.vocab_size},
+            "pool": self.pool.stats(),
+            "buckets": self.provenance(),
+            "config": self.config.as_dict(),
+        }
+
+
+class DecodeScheduler:
+    """The continuous-batching loop (module doc).
+
+    One daemon thread owns the model, the pool and every live
+    sequence; admission (``submit``) only validates, reserves nothing,
+    and enqueues — page reservation, prefill, decode, eviction and
+    reclamation all happen on the loop so there is exactly one writer
+    of serving state.  ``breakers`` (a ``breaker.BreakerBoard``, shared
+    with the owning Server) quarantines repeatedly-failing decode /
+    prefill buckets: blocked decode buckets are skipped by the bucket
+    chooser (a smaller non-blocked bucket chunks the live set), and a
+    blocked prefill bucket fast-rejects its admissions."""
+
+    def __init__(self, runner, breakers=None, start=True):
+        self._runner = runner
+        self.config = runner.config
+        self._breakers = breakers
+        self._cond = threading.Condition()
+        self._waiting = deque()
+        self._live = {}               # sid -> _Seq, insertion-ordered
+        self._next_sid = 0
+        self._closed = False
+        self._drain = True
+        self._pending_runner = None
+        self.steps = 0
+        self.admitted_total = 0
+        self.evictions = {}
+        self._recent = deque(maxlen=64)
+        self._thread = None
+        if start:
+            self.start()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return
+        import weakref
+
+        self._thread = threading.Thread(
+            target=self._run, args=(weakref.ref(self),), daemon=True,
+            name="mx-serve-decode")
+        self._thread.start()
+
+    @property
+    def alive(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    @property
+    def runner(self):
+        return self._runner
+
+    def stop(self, drain=True, timeout=None):
+        """Stop intake; with ``drain`` (default) live sequences finish
+        their generation and waiting ones are admitted/served first,
+        otherwise everything fails fast with ``ServerClosed``."""
+        with self._cond:
+            self._closed = True
+            self._drain = bool(drain)
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        return not self.alive
+
+    def swap(self, new_runner):
+        """Repoint decoding at a new runner/checkpoint.  Live sequences
+        FINISH on the old runner (their KV state is its pool); new
+        admissions wait and start on the new one once the old batch
+        drains.  Returns immediately."""
+        if not isinstance(new_runner, DecodeRunner):
+            raise ValueError("swap needs a DecodeRunner")
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("decode scheduler is shut down")
+            self._pending_runner = new_runner
+            self._cond.notify_all()
+
+    # -- admission ----------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=None, eos_id=None,
+               timeout_ms=None, request_id=None, on_token=None):
+        """Enqueue one generation request; returns its
+        ``concurrent.futures.Future``.  Validation is all up-front and
+        fast: static shape limits raise ``DecodeError``, an impossible
+        page reservation raises ``PagePoolExhausted``, a full waiting
+        queue rejects with ``ServerOverloaded``, a quarantined prefill
+        bucket with ``BucketQuarantined`` — a request that enqueues can
+        always be admitted once capacity frees."""
+        cfg = self.config
+        prompt = [int(t) for t in (prompt or ())]
+        if not prompt:
+            raise DecodeError("decode needs a non-empty prompt "
+                              "(list of int token ids)")
+        vocab = self._runner.block.vocab_size
+        if min(prompt) < 0 or max(prompt) >= vocab:
+            raise DecodeError("prompt token ids must be in [0, %d)"
+                              % vocab)
+        mnt = cfg.max_new_tokens if max_new_tokens is None \
+            else int(max_new_tokens)
+        if mnt < 1:
+            raise DecodeError("max_new_tokens must be >= 1")
+        mnt = min(mnt, cfg.max_new_tokens)
+        total = len(prompt) + mnt
+        if total > cfg.max_context:
+            raise DecodeError(
+                "prompt (%d) + max_new_tokens (%d) exceeds "
+                "max_context=%d" % (len(prompt), mnt, cfg.max_context))
+        t_bucket = self._runner.prefill_bucket(len(prompt))
+        need = self._runner.page_config.pages_for(total)
+        if need > self._runner.pool.capacity:
+            raise PagePoolExhausted(
+                "request needs %d KV pages but the pool only has %d"
+                % (need, self._runner.pool.capacity))
+        if self._breakers is not None and \
+                self._breakers.blocked(("prefill", t_bucket)):
+            if telemetry.ENABLED:
+                telemetry.SERVE_REQUESTS.labels(
+                    result="quarantined").inc()
+            raise self._breakers.quarantine_error(("prefill", t_bucket))
+        timeout_ms = cfg.timeout_ms if timeout_ms is None else timeout_ms
+        deadline = None if timeout_ms is None \
+            else time.perf_counter() + float(timeout_ms) / 1e3
+        req = DecodeRequest(
+            prompt, mnt,
+            eos_id=self._runner.eos_id if eos_id is None else eos_id,
+            deadline=deadline, request_id=request_id, on_token=on_token)
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("decode scheduler is shut down")
+            if len(self._waiting) >= cfg.queue_depth:
+                if telemetry.ENABLED:
+                    telemetry.SERVE_REQUESTS.labels(
+                        result="rejected").inc()
+                raise ServerOverloaded(
+                    "decode admission queue full (%d waiting, depth=%d)"
+                    % (len(self._waiting), cfg.queue_depth))
+            self._waiting.append(req)
+            if telemetry.ENABLED:
+                telemetry.SERVE_DECODE_WAITING.set(len(self._waiting))
+            self._cond.notify_all()
+        return req.future
+
+    # -- introspection ------------------------------------------------------
+    def stats(self):
+        with self._cond:
+            waiting = len(self._waiting)
+            live = [{"request_id": s.req.request_id,
+                     "prompt_tokens": len(s.req.prompt),
+                     "generated": len(s.tokens),
+                     "max_new_tokens": s.req.max_new_tokens,
+                     "length": s.length,
+                     "pages": len(s.pages or ()),
+                     "joined_step": s.joined_step}
+                    for s in self._live.values()]
+        board = {}
+        if self._breakers is not None:
+            board = {k: v for k, v in self._breakers.snapshot().items()
+                     if k.startswith("('decode'") or
+                     k.startswith("('prefill'")}
+        return {
+            "alive": self.alive,
+            "waiting": waiting,
+            "live": live,
+            "steps": self.steps,
+            "admitted": self.admitted_total,
+            "evictions": dict(self.evictions),
+            "runner": self._runner.stats(),
+            "breakers": board,
+            "recent": list(self._recent)[-16:],
+        }
+
+    def recent(self):
+        return list(self._recent)
+
+    # -- the loop -----------------------------------------------------------
+    @staticmethod
+    def _run(ref):
+        """Thread body.  Holds the scheduler (and through it the
+        runner + device-resident KV pool) only WEAKLY between
+        iterations — a Server/scheduler dropped without shutdown()
+        must become collectable, not be pinned forever by its own
+        daemon thread (same contract as the vision Scheduler's
+        weak runner ref)."""
+        while True:
+            sched = ref()
+            if sched is None:
+                return            # owner collected: wind down
+            try:
+                more = sched._loop_once()
+            finally:
+                del sched         # drop the strong ref before sleeping
+            if not more:
+                return
+
+    def _loop_once(self):
+        """One scheduling iteration; False means the loop must exit."""
+        with self._cond:
+            if self._closed:
+                if not self._drain:
+                    self._abort_locked()
+                    return False
+                if not self._waiting and not self._live:
+                    return False
+            if not self._waiting and not self._live:
+                self._cond.wait(0.25)
+                return True
+        try:
+            self._expire()
+            self._maybe_install_runner()
+            self._admit()
+            if self._live:
+                self._step()
+            elif self._waiting:
+                # waiting but nothing admissible yet (slots/pages held
+                # by a draining swap, or breakers cooling): don't spin
+                time.sleep(0.005)
+        except BaseException:  # noqa: BLE001 - loop must survive
+            trace.instant("serve_decode_loop_error", cat="serve")
+            time.sleep(0.01)
+        return True
+
+    def _abort_locked(self):
+        items, self._waiting = list(self._waiting), deque()
+        live, self._live = list(self._live.values()), {}
+        for req in items:
+            fail_request(req, ServerClosed(
+                "server shut down before admission"), "cancelled")
+            self._bump("cancelled")
+        for seq in live:
+            self._release(seq)
+            fail_request(seq.req, ServerClosed(
+                "server shut down mid-generation after %d token(s)"
+                % len(seq.tokens)), "cancelled")
+            self._bump("cancelled")
+            self._record(seq, "cancelled")
+        self._gauges()
+
+    def _bump(self, reason):
+        self.evictions[reason] = self.evictions.get(reason, 0) + 1
+        if telemetry.ENABLED:
+            telemetry.SERVE_DECODE_EVICTIONS.labels(reason=reason).inc()
+
+    def _release(self, seq):
+        if seq.pages is not None:
+            self._runner.pool.release(seq.sid)
+            seq.pages = None
+
+    def _record(self, seq, reason):
+        self._recent.append({
+            "request_id": seq.req.request_id,
+            "joined_step": seq.joined_step,
+            "left_step": self.steps,
+            "prompt_tokens": len(seq.req.prompt),
+            "generated": len(seq.tokens),
+            "reason": reason,
+        })
+
+    def _gauges(self):
+        if telemetry.ENABLED:
+            telemetry.SERVE_DECODE_LIVE.set(len(self._live))
+            with self._cond:
+                telemetry.SERVE_DECODE_WAITING.set(len(self._waiting))
+            pool = self._runner.pool
+            telemetry.SERVE_KV_PAGES_IN_USE.set(pool.in_use)
+            telemetry.SERVE_KV_PAGES_HIGH_WATER.set(pool.high_water)
+
+    def _expire(self):
+        now = time.perf_counter()
+        with self._cond:
+            keep = deque()
+            for req in self._waiting:
+                if req.expired(now):
+                    fail_request(req, RequestTimeout(
+                        "deadline expired after %.1f ms waiting for "
+                        "admission" % ((now - req.enqueued) * 1e3)),
+                        "timeout")
+                    self._bump("timeout")
+                else:
+                    keep.append(req)
+            self._waiting = keep
+        with self._cond:
+            dead = [s for s, q in self._live.items()
+                    if q.req.expired(now)]
+        for sid in dead:
+            with self._cond:
+                seq = self._live.pop(sid)
+            self._release(seq)
+            fail_request(seq.req, RequestTimeout(
+                "deadline expired mid-generation after %d token(s)"
+                % len(seq.tokens)), "timeout")
+            self._bump("timeout")
+            self._record(seq, "timeout")
+        self._gauges()
+
+    def _maybe_install_runner(self):
+        with self._cond:
+            if self._pending_runner is None or self._live:
+                return
+            old, self._runner = self._runner, self._pending_runner
+            self._pending_runner = None
+            self.config = self._runner.config
+        old.pool.check()          # every page must have come home
+        if telemetry.ENABLED:
+            telemetry.SERVE_SWAPS.inc()
+        trace.instant("serve_decode_swap", cat="serve",
+                      args={"step": self._runner.step})
+
+    def _evict_poisoned(self, seqs):
+        """mx.resilience poison drill: sequences whose request id the
+        armed ``MXNET_FAULTS`` plan marks (``serve_poison@<rid>``) are
+        evicted ALONE — pages reclaimed, batch-mates untouched."""
+        out = []
+        for seq in seqs:
+            if _inject.poisoned(seq.req.request_id):
+                _inject.record_firing("serve_poison",
+                                      seq.req.request_id, consume=True)
+                with self._cond:
+                    self._live.pop(seq.sid, None)
+                self._release(seq)
+                exc = InjectedFault(
+                    "injected poison request %s" % seq.req.request_id,
+                    site="serve_poison")
+                if telemetry.ENABLED:
+                    telemetry.SERVE_POISON.inc()
+                fail_request(seq.req, exc, "poisoned")
+                self._bump("poisoned")
+                self._record(seq, "poisoned")
+            else:
+                out.append(seq)
+        return out
+
+    def _admit(self):
+        """Fill free slots from the waiting queue (FIFO): reserve the
+        whole worst-case page count, prefill through the bucket path,
+        emit the first token.  Stops at the first request the pool
+        cannot hold yet — admission order is arrival order."""
+        while len(self._live) < self.config.max_live:
+            with self._cond:
+                if not self._waiting or self._pending_runner is not None:
+                    return
+                req = self._waiting[0]
+                pool = self._runner.pool
+                need = self._runner.page_config.pages_for(
+                    len(req.prompt) + req.max_new_tokens)
+                if need > pool.capacity:
+                    # submit() validated against the runner of its day;
+                    # a hot swap may have shrunk the pool since.  Fail
+                    # the request rather than head-of-line-block the
+                    # queue waiting for pages that can never exist
+                    self._waiting.popleft()
+                    fail_request(req, PagePoolExhausted(
+                        "request needs %d KV pages but the (swapped) "
+                        "pool only has %d" % (need, pool.capacity)),
+                        "error")
+                    self._bump("error")
+                    continue
+                if not pool.can_alloc(need):
+                    return            # wait for evictions to free pages
+                self._waiting.popleft()
+                if telemetry.ENABLED:
+                    telemetry.SERVE_DECODE_WAITING.set(len(self._waiting))
+                sid = self._next_sid
+                self._next_sid += 1
+            seq = _Seq(req, sid)
+            if _inject.poisoned(req.request_id):
+                self._evict_poisoned([seq])
+                continue
+            try:
+                t_bucket = self._runner.prefill_bucket(len(req.prompt))
+            except DecodeError as exc:
+                # same swap skew: the new runner's bucket table may not
+                # cover a prompt the old one admitted — resolve the
+                # future, never drop it on the floor
+                fail_request(req, exc, "error")
+                self._bump("error")
+                continue
+            bclass = ("prefill", t_bucket)
+            if self._breakers is not None and \
+                    not self._breakers.allow(bclass):
+                fail_request(req, self._breakers.quarantine_error(bclass),
+                             "quarantined")
+                self._bump("quarantined")
+                continue
+            seq.pages = self._runner.pool.alloc(sid, need)
+            t0 = time.perf_counter()
+            try:
+                with trace.use(req.trace), \
+                        trace.span("serve_decode_prefill", hist=False,
+                                   cat="serve",
+                                   args={"bucket": "prefill:t%d" % t_bucket,
+                                         "request_id": req.request_id}):
+                    tok, bad = self._runner.prefill(seq)
+            except BaseException as exc:  # noqa: BLE001 - per-request
+                self._release(seq)
+                if self._breakers is not None:
+                    self._breakers.failure(bclass)
+                if getattr(exc, "pool_lost", False):
+                    self._evict_all_live(exc)
+                fail_request(req, exc, "error")
+                self._bump("error")
+                continue
+            if self._breakers is not None:
+                self._breakers.success(bclass)
+            seq.length = len(req.prompt)
+            seq.joined_step = self.steps
+            seq.t_prefill = time.perf_counter() - t0
+            if telemetry.ENABLED:
+                telemetry.SERVE_DECODE_PREFILLS.inc()
+            with self._cond:
+                self._live[sid] = seq
+            self.admitted_total += 1
+            if bad:
+                self._evict_nonfinite(seq, bad)
+                continue
+            self._emit(seq, int(tok), t0)
+            self._finish_if_done(seq)
+            self._gauges()
+
+    def _evict_nonfinite(self, seq, bad):
+        """The per-token output guard tripped: this sequence's logits
+        went NaN/Inf.  Greedy-sampling a NaN row returns garbage, so
+        the sequence fails alone instead of streaming poison."""
+        with self._cond:
+            self._live.pop(seq.sid, None)
+        self._release(seq)
+        if telemetry.ENABLED:
+            telemetry.SERVE_NONFINITE_OUTPUTS.inc(int(bad))
+            telemetry.SERVE_NONFINITE_BATCHES.inc()
+            telemetry.SERVE_POISON.inc()
+        trace.instant("serve_decode_nonfinite", cat="serve",
+                      ctx=seq.req.trace,
+                      args={"request_id": seq.req.request_id,
+                            "elements": int(bad)})
+        fail_request(seq.req, DecodeError(
+            "sequence evicted: %d nonfinite logit element(s) at token "
+            "%d (output guard)" % (int(bad), len(seq.tokens))),
+            "poisoned")
+        self._bump("poisoned")
+        self._record(seq, "nonfinite")
+
+    def _evict_all_live(self, exc):
+        """KV storage was lost (donated pool consumed by a failed
+        dispatch): no sequence's context survives."""
+        with self._cond:
+            doomed, self._live = list(self._live.values()), {}
+        for seq in doomed:
+            self._release(seq)
+            fail_request(seq.req, exc, "error")
+            self._bump("error")
+            self._record(seq, "pool_lost")
+        self._gauges()
+
+    def _emit(self, seq, token, t_start):
+        """One generated token: bookkeeping, telemetry, the per-token
+        trace span on the request's own trace id, and the streaming
+        callback."""
+        now = time.perf_counter()
+        seq.tokens.append(token)
+        seq.last_token = token
+        if seq.first_token_t is None:
+            seq.first_token_t = now
+            if telemetry.ENABLED:
+                telemetry.SERVE_DECODE_TTFT_SECONDS.observe(
+                    now - seq.req.enqueued)
+        if telemetry.ENABLED:
+            telemetry.SERVE_DECODE_TOKENS.inc()
+        if trace.ENABLED and seq.req.trace is not None:
+            trace.record_span(
+                "serve_decode_token", t_start, now - t_start,
+                ctx=seq.req.trace, cat="serve",
+                args={"index": len(seq.tokens) - 1, "token": token,
+                      "request_id": seq.req.request_id})
+        cb = seq.req.on_token
+        if cb is not None:
+            try:
+                cb(token, len(seq.tokens) - 1)
+            except Exception:     # a sick consumer must not stall decode
+                seq.req.on_token = None
+
+    def _finish_if_done(self, seq):
+        reason = seq.done_reason
+        if reason is None:
+            return False
+        with self._cond:
+            self._live.pop(seq.sid, None)
+        self._release(seq)
+        self._bump("finished")
+        self._record(seq, reason)
+        done_t = time.perf_counter()
+        try:
+            seq.req.future.set_result(
+                {"tokens": list(seq.tokens), "finish_reason": reason})
+        except InvalidStateError:
+            return True
+        if telemetry.ENABLED:
+            telemetry.SERVE_REQUESTS.labels(result="ok").inc()
+            telemetry.SERVE_REQUEST_SECONDS.observe(
+                done_t - seq.req.enqueued)
+        if trace.ENABLED and seq.req.trace is not None:
+            trace.record_span(
+                "serve_request", seq.req.enqueued,
+                done_t - seq.req.enqueued, ctx=seq.req.trace, root=True,
+                cat="serve",
+                args={"result": "ok", "request_id": seq.req.request_id,
+                      "tokens": len(seq.tokens),
+                      "finish_reason": reason})
+        return True
+
+    def _pick_bucket(self, n):
+        """Smallest non-quarantined decode bucket covering ``n`` live
+        sequences; falls back to the largest non-blocked smaller bucket
+        (the live set steps in chunks while a bucket cools down).
+        Returns None when every bucket is quarantined."""
+        blocked = (lambda b: self._breakers is not None and
+                   self._breakers.blocked(("decode", b)))
+        for b in self.config.batch_sizes:
+            if b >= n and not blocked(b):
+                return b
+        for b in reversed(self.config.batch_sizes):
+            if b <= n and not blocked(b):
+                return b
+        return None
+
+    def _step(self):
+        """One continuous-batching iteration over the live set."""
+        live = self._evict_poisoned(list(self._live.values()))
+        if not live:
+            self._gauges()
+            return
+        bucket = self._pick_bucket(len(live))
+        if bucket is None:
+            time.sleep(0.005)     # every decode bucket cooling down
+            return
+        seqs = live[:bucket]
+        bclass = ("decode", bucket)
+        if self._breakers is not None and not self._breakers.allow(bclass):
+            time.sleep(0.005)
+            return
+        t0 = time.perf_counter()
+        head = seqs[0]
+        try:
+            _inject.fire("serve_dispatch")
+        except (InjectedFault, InjectedIOError):
+            # a transient injected dispatch fault: one breaker strike,
+            # nobody evicted — sequences retry next iteration
+            if self._breakers is not None:
+                self._breakers.failure(bclass)
+            return
+        with trace.use(head.req.trace), \
+                trace.span("serve_decode_step", hist=False, cat="serve",
+                           args={"bucket": "decode:b%d" % bucket,
+                                 "live": len(seqs)}), \
+                trace.watchdog.watch("serve_dispatch"):
+            pairs = self._step_split(seqs)
+        self.steps += 1
+        dt = time.perf_counter() - t0
+        if telemetry.ENABLED:
+            telemetry.SERVE_DECODE_STEPS.inc()
+            telemetry.SERVE_DECODE_BATCH.observe(len(seqs))
+            telemetry.SERVE_DECODE_TOKEN_SECONDS.observe(dt)
+        failed = [p for p in pairs if p[3] is not None]
+        if self._breakers is not None:
+            (self._breakers.failure if failed
+             else self._breakers.success)(bclass)
+        any_ok = any(p[3] is None for p in pairs)
+        pool_lost = next((p[3] for p in pairs
+                          if getattr(p[3], "pool_lost", False)), None)
+        if pool_lost is not None:
+            self._evict_all_live(pool_lost)
+            return
+        for seq, tok, bad, exc, isolated in pairs:
+            if exc is not None:
+                poisoned = isolated and any_ok
+                with self._cond:
+                    self._live.pop(seq.sid, None)
+                self._release(seq)
+                if poisoned and telemetry.ENABLED:
+                    telemetry.SERVE_POISON.inc()
+                fail_request(seq.req, exc,
+                             "poisoned" if poisoned else "error")
+                self._bump("poisoned" if poisoned else "error")
+                self._record(seq, "poisoned" if poisoned else "error")
+                continue
+            if bad:
+                self._evict_nonfinite(seq, bad)
+                continue
+            seq.length += 1
+            self._emit(seq, int(tok), t0)
+            self._finish_if_done(seq)
+        if len(seqs) < len(self._live):
+            # chunked iteration (a larger bucket is cooling down):
+            # rotate the just-stepped sequences behind the un-stepped
+            # tail so every live sequence keeps making progress —
+            # without this, live[:bucket] would starve the tail for
+            # the whole breaker cooldown
+            with self._cond:
+                for seq in seqs:
+                    if seq.sid in self._live:
+                        self._live[seq.sid] = self._live.pop(seq.sid)
+        self._gauges()
+
+    def _step_split(self, seqs, depth=0):
+        """Run one decode iteration for ``seqs``; on failure retry
+        bisected down to single sequences so a poisoned sequence fails
+        alone.  Returns ``[(seq, token, bad, exc, isolated)]``.
+        Re-execution of a half is safe: a decode step writes each
+        sequence's K/V at the same (page, slot) address it would have
+        written the first time (idempotent), and sampling is greedy."""
+        try:
+            toks, bads = self._runner.decode_step(seqs)
+        except BaseException as exc:  # noqa: BLE001 - contained
+            if getattr(exc, "pool_lost", False) or len(seqs) == 1:
+                isolated = depth > 0 or \
+                    getattr(exc, "site", None) == "serve_poison"
+                return [(seqs[0], None, None, exc, isolated)]
+            if telemetry.ENABLED:
+                telemetry.SERVE_BISECT_SPLITS.inc()
+            trace.instant("serve_decode_bisect", cat="serve",
+                          args={"sequences": len(seqs), "depth": depth,
+                                "error": type(exc).__name__})
+            mid = len(seqs) // 2
+            return self._step_split(seqs[:mid], depth + 1) + \
+                self._step_split(seqs[mid:], depth + 1)
+        return [(s, int(toks[i]), int(bads[i]), None, False)
+                for i, s in enumerate(seqs)]
+
+
+# ---------------------------------------------------------------------------
+# TinyDecoder — the reference decoder model (contract documentation)
+# ---------------------------------------------------------------------------
+
+from ..gluon import nn as _nn  # noqa: E402
+from ..gluon.block import HybridBlock as _HybridBlock  # noqa: E402
+
+
+class TinyDecoder(_HybridBlock):
+    """A small, real transformer decoder implementing the decode-path
+    model contract (module doc): pre-norm-free 2-layer MHA + MLP,
+    sinusoidal absolute positions, causal chunk attention over a
+    gathered paged context.  Reference model for tests / the smoke
+    drill / the bench row — and executable documentation for bringing
+    a real decoder onto ``mx.serve.decode``."""
+
+    def __init__(self, vocab_size=64, num_layers=2, num_heads=2,
+                 head_dim=8, hidden=None, eos_id=None, **kwargs):
+        super().__init__(**kwargs)
+        self.vocab_size = int(vocab_size)
+        self.num_layers = int(num_layers)
+        self.num_kv_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.eos_id = eos_id
+        units = self.num_kv_heads * self.head_dim
+        self.units = units
+        hidden = hidden or units * 2
+        self.embed = _nn.Embedding(self.vocab_size, units)
+        for layer in range(self.num_layers):
+            for name in ("q", "k", "v", "o"):
+                setattr(self, "%s%d" % (name, layer),
+                        _nn.Dense(units, flatten=False, in_units=units))
+            setattr(self, "up%d" % layer,
+                    _nn.Dense(hidden, flatten=False, in_units=units))
+            setattr(self, "down%d" % layer,
+                    _nn.Dense(units, flatten=False, in_units=hidden))
+        self.unembed = _nn.Dense(self.vocab_size, flatten=False,
+                                 in_units=units)
+
+    def _positional(self, positions):
+        """Sinusoidal encoding of absolute positions [B, T] ->
+        [B, T, units] (even dims sin, odd dims cos)."""
+        from .. import ndarray as nd
+
+        half = self.units // 2
+        inv = nd.array(_np.asarray(
+            1.0 / (10000.0 ** (_np.arange(half) / max(1, half))),
+            dtype="float32"))
+        ang = positions.expand_dims(2) * inv.reshape((1, 1, half))
+        return nd.concat(nd.sin(ang), nd.cos(ang), dim=2)
+
+    def forward(self, tokens, k_ctx, v_ctx, ctx_lengths, chunk_lengths):
+        from .. import ndarray as nd
+
+        B, T = tokens.shape
+        S = k_ctx.shape[2]
+        H, Dh, C = self.num_kv_heads, self.head_dim, self.units
+        ctx_f = ctx_lengths.astype("float32").expand_dims(1)     # [B,1]
+        steps = nd.arange(T, dtype="float32").expand_dims(0)     # [1,T]
+        q_pos = ctx_f + steps                                    # [B,T]
+        x = self.embed(tokens) + self._positional(q_pos)
+
+        # one [B, T, S+T] additive attention bias shared by all layers:
+        # context keys are valid while their position < ctx_length;
+        # chunk keys are causal (key j attends-from query i when j <= i
+        # — queries past chunk_length produce garbage that is never
+        # read: their K/V scatter is dropped and the last-logit
+        # selector picks index chunk_length-1)
+        key_ctx_pos = nd.arange(S, dtype="float32").expand_dims(0)
+        ctx_valid = (key_ctx_pos < ctx_f).astype("float32")       # [B,S]
+        # invalid context keys take position +1e9 so they FAIL the
+        # causal test below (key_pos <= q_pos) and are masked out; a
+        # negative sentinel would pass it and dilute every softmax
+        # with the scrubbed zero-K/V tail
+        key_pos = nd.concat(
+            ctx_valid * key_ctx_pos + (1.0 - ctx_valid) * 1e9,
+            ctx_f + steps, dim=1) if S else (ctx_f + steps)       # [B,S+T]
+        causal = (key_pos.expand_dims(1) <=
+                  q_pos.expand_dims(2)).astype("float32")    # [B,T,S+T]
+        bias = (1.0 - causal) * -1e9
+
+        k_chunks, v_chunks = [], []
+        for layer in range(self.num_layers):
+            q = getattr(self, "q%d" % layer)(x).reshape((B, T, H, Dh))
+            k = getattr(self, "k%d" % layer)(x).reshape((B, T, H, Dh))
+            v = getattr(self, "v%d" % layer)(x).reshape((B, T, H, Dh))
+            k_chunks.append(k.expand_dims(2))
+            v_chunks.append(v.expand_dims(2))
+            k_all = nd.concat(k_ctx[:, layer], k, dim=1) if S else k
+            v_all = nd.concat(v_ctx[:, layer], v, dim=1) if S else v
+            q2 = q.transpose((0, 2, 1, 3)).reshape((B * H, T, Dh))
+            k2 = k_all.transpose((0, 2, 1, 3)).reshape((B * H, S + T, Dh))
+            v2 = v_all.transpose((0, 2, 1, 3)).reshape((B * H, S + T, Dh))
+            scores = nd.batch_dot(q2, k2, transpose_b=True) \
+                / float(_np.sqrt(Dh))
+            scores = (scores.reshape((B, H, T, S + T)) +
+                      bias.expand_dims(1)).reshape((B * H, T, S + T))
+            probs = nd.softmax(scores, axis=-1)
+            att = nd.batch_dot(probs, v2).reshape((B, H, T, Dh)) \
+                .transpose((0, 2, 1, 3)).reshape((B, T, C))
+            x = x + getattr(self, "o%d" % layer)(att)
+            x = x + getattr(self, "down%d" % layer)(
+                nd.relu(getattr(self, "up%d" % layer)(x)))
+
+        logits = self.unembed(x)                          # [B, T, V]
+        sel = nd.one_hot((chunk_lengths - 1).astype("int32"), T) \
+            .astype("float32")                            # [B, T]
+        last = nd.sum(logits * sel.expand_dims(2), axis=1)  # [B, V]
+        k_new = nd.concat(*k_chunks, dim=2) if self.num_layers > 1 \
+            else k_chunks[0]
+        v_new = nd.concat(*v_chunks, dim=2) if self.num_layers > 1 \
+            else v_chunks[0]
+        return last, k_new, v_new

@@ -35,9 +35,33 @@ from .. import autograd, telemetry, trace
 from ..gluon.block import Block, HybridBlock
 from .batching import NoBucketError
 
-__all__ = ["ModelRunner", "DEFAULT_BATCH_SIZES"]
+__all__ = ["ModelRunner", "DEFAULT_BATCH_SIZES", "resolve_block",
+           "count_nonfinite"]
 
 DEFAULT_BATCH_SIZES = (1, 2, 4, 8)
+
+
+def resolve_block(block, cls=Block, who="ModelRunner"):
+    """Unwrap a zero-arg block factory and type-check the result — the
+    shared front door of both serving runners (``ModelRunner`` and
+    ``decode.DecodeRunner``)."""
+    if not isinstance(block, Block) and callable(block):
+        block = block()
+    if not isinstance(block, cls):
+        raise ValueError("%s needs a %s or a zero-arg factory returning "
+                         "one, got %r" % (who, cls.__name__, block))
+    return block
+
+
+def count_nonfinite(arrays):
+    """NaN/Inf elements across host float arrays (the mx.monitor serve
+    output guard's scan; the decode plane computes the same count
+    in-program per logits row)."""
+    bad = 0
+    for a in arrays:
+        if getattr(a.dtype, "kind", "") == "f":
+            bad += int(a.size) - int(_np.isfinite(a).sum())
+    return bad
 
 
 def _normalize_sample_shapes(sample_shapes):
@@ -81,11 +105,7 @@ class ModelRunner:
     def __init__(self, block, root=None, step=None, ctx=None,
                  batch_sizes=DEFAULT_BATCH_SIZES, sample_shapes=None,
                  dtype="float32", warm=True, unpad=True):
-        if not isinstance(block, Block) and callable(block):
-            block = block()
-        if not isinstance(block, Block):
-            raise ValueError("ModelRunner needs a Block or a zero-arg "
-                             "factory returning one, got %r" % (block,))
+        block = resolve_block(block)
         self._block = block
         self._ctx = ctx
         self._dtype = dtype
@@ -247,10 +267,7 @@ class ModelRunner:
 
         if not _monitor.core.ENABLED:
             return
-        bad = 0
-        for o in outs_np:
-            if getattr(o.dtype, "kind", "") == "f":
-                bad += int(o.size) - int(_np.isfinite(o).sum())
+        bad = count_nonfinite(outs_np)
         if not bad:
             return
         if telemetry.ENABLED:

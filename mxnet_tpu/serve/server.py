@@ -18,6 +18,15 @@ Thread-safe ``submit()`` / ``submit_async()`` (futures) over one
   text), ``/statz`` (JSON: scheduler config, bucket table, queue
   depth, serve_* totals, nonfinite-output health block — what
   ``tools/diagnose.py --serve`` reads).
+- **autoregressive decode plane** (``decode=`` a ``DecodeRunner`` or
+  decoder block): ``submit_decode()`` futures over the paged-KV
+  continuous-batching loop, ``{"tokens": [...]}`` payloads on
+  ``/predict`` (collect mode), and chunked per-token streaming on
+  ``/predict?stream=1`` — the streamed token sequence is bit-identical
+  to the collect-mode result, and ``X-Request-Id`` is echoed on the
+  streaming response headers too.  A server may carry either plane or
+  both; ``/statz`` grows a ``decode`` block (live sequences, page-pool
+  occupancy, per-bucket compile provenance).
 """
 from __future__ import annotations
 
@@ -37,6 +46,8 @@ from .batching import (BatchQueue, BucketQuarantined, NoBucketError,
                        Request, RequestTimeout, Scheduler, ServeError,
                        ServerClosed, ServerOverloaded)
 from .breaker import BreakerBoard
+from .decode import DecodeError
+from .kvcache import PagePoolExhausted
 from .runner import DEFAULT_BATCH_SIZES, ModelRunner
 
 __all__ = ["ServeConfig", "Server"]
@@ -114,10 +125,15 @@ class ServeConfig:
 
 
 class Server:
-    """Dynamic-batching inference server over one ModelRunner."""
+    """Dynamic-batching inference server over one ModelRunner and/or a
+    continuous-batching decode plane (``decode=`` a ``DecodeRunner`` or
+    a decoder block following the ``serve/decode.py`` contract)."""
 
     def __init__(self, block=None, root=None, step=None, ctx=None,
-                 config=None, runner=None):
+                 config=None, runner=None, decode=None,
+                 decode_config=None):
+        from .decode import DecodeRunner, DecodeScheduler
+
         self._config = config or ServeConfig()
         self._ctx = ctx
         # keep the factory (not just the instance) so swap() can build
@@ -125,22 +141,37 @@ class Server:
         # be observable mid-load
         self._block_factory = block if block is not None and \
             not isinstance(block, Block) and callable(block) else None
-        if runner is None:
-            if block is None:
-                raise ValueError("Server needs a block (or factory) or a "
-                                 "pre-built runner")
+        if runner is None and block is not None:
             runner = ModelRunner(
                 block, root=root, step=step, ctx=ctx,
                 batch_sizes=self._config.batch_sizes,
                 sample_shapes=self._config.sample_shapes,
                 dtype=self._config.dtype)
+        if runner is None and decode is None:
+            raise ValueError("Server needs a block (or factory), a "
+                             "pre-built runner, or a decode= plane")
         self._runner = runner
-        self._root = root if root is not None else runner.root
-        self._queue = BatchQueue(self._config.queue_depth)
+        self._root = root if root is not None else \
+            (runner.root if runner is not None else None)
         self._breakers = BreakerBoard(
             self._config.breaker_threshold,
             self._config.breaker_cooldown_s) \
             if self._config.breaker_threshold > 0 else None
+        # -- decode plane (serve/decode.py) ---------------------------------
+        if decode is not None and not isinstance(decode, DecodeRunner):
+            decode = DecodeRunner(decode, root=root, step=step, ctx=ctx,
+                                  config=decode_config)
+        elif decode_config is not None and decode is not None:
+            raise ValueError(
+                "decode_config= only applies when decode= is a raw "
+                "decoder block; a pre-built DecodeRunner already "
+                "carries its own config — pass it there instead of "
+                "having this one silently ignored")
+        self._decode = DecodeScheduler(decode, breakers=self._breakers) \
+            if decode is not None else None
+        # -- micro-batch plane ----------------------------------------------
+        self._queue = None
+        self._scheduler = None
         # the scheduler (and its daemon thread) hold the server WEAKLY:
         # a Server dropped without shutdown() must become collectable —
         # its dispatch loop sees the dead ref and winds itself down —
@@ -150,17 +181,19 @@ class Server:
         import weakref
 
         ref = weakref.ref(self)
+        if runner is not None:
+            self._queue = BatchQueue(self._config.queue_depth)
 
-        def _current_runner():
-            srv = ref()
-            return None if srv is None else srv._runner
+            def _current_runner():
+                srv = ref()
+                return None if srv is None else srv._runner
 
-        self._scheduler = Scheduler(
-            self._queue, _current_runner,
-            max_batch_size=self._config.max_batch_size,
-            max_wait_us=self._config.max_wait_us,
-            breakers=self._breakers)
-        self._scheduler.start()
+            self._scheduler = Scheduler(
+                self._queue, _current_runner,
+                max_batch_size=self._config.max_batch_size,
+                max_wait_us=self._config.max_wait_us,
+                breakers=self._breakers)
+            self._scheduler.start()
         self._swap_lock = threading.Lock()
         self._httpd = None
         self._closed = False
@@ -191,14 +224,29 @@ class Server:
         return self._runner
 
     @property
+    def decode(self):
+        """The decode plane's ``DecodeScheduler`` (None without one)."""
+        return self._decode
+
+    @property
     def step(self):
-        return self._runner.step
+        if self._runner is not None:
+            return self._runner.step
+        return self._decode.runner.step if self._decode is not None \
+            else None
 
     def healthy(self):
-        """Liveness: the dispatch loop is running.  (An open circuit
-        breaker does NOT make the process unhealthy — other buckets
-        still serve; breaker state rides in the /healthz body.)"""
-        return not self._closed and self._scheduler.alive
+        """Liveness: every configured dispatch loop is running.  (An
+        open circuit breaker does NOT make the process unhealthy —
+        other buckets still serve; breaker state rides in the /healthz
+        body.)"""
+        if self._closed:
+            return False
+        if self._scheduler is not None and not self._scheduler.alive:
+            return False
+        if self._decode is not None and not self._decode.alive:
+            return False
+        return True
 
     def breakers(self):
         """{bucket_label: breaker state} — open breakers mean that
@@ -208,13 +256,19 @@ class Server:
             if self._breakers is not None else {}
 
     def ready(self):
-        """Readiness: healthy AND the current runner finished warm-up
-        (every bucket compiled) — traffic sent now will not hit a
-        cold-compile stall."""
-        return self.healthy() and self._runner.warmed
+        """Readiness: healthy AND every configured plane finished
+        warm-up (each bucket compiled) — traffic sent now will not hit
+        a cold-compile stall."""
+        if not self.healthy():
+            return False
+        if self._runner is not None and not self._runner.warmed:
+            return False
+        if self._decode is not None and not self._decode.runner.warmed:
+            return False
+        return True
 
     def queue_depth(self):
-        return len(self._queue)
+        return len(self._queue) if self._queue is not None else 0
 
     def stats(self):
         serve_totals = {k: v for k, v in telemetry.totals().items()
@@ -232,7 +286,13 @@ class Server:
             "healthy": self.healthy(),
             "queue_depth": self.queue_depth(),
             "config": self._config.as_dict(),
-            "runner": self._runner.stats(),
+            "runner": self._runner.stats()
+            if self._runner is not None else None,
+            # the decode plane: live sequences, page-pool occupancy /
+            # high water, per-bucket compile provenance, evictions —
+            # what tools/diagnose.py --serve renders as the decode table
+            "decode": self._decode.stats()
+            if self._decode is not None else None,
             "requests": by_result,
             "totals": serve_totals,
             # mx.resilience serve degradation: per-bucket circuit
@@ -274,6 +334,9 @@ class Server:
         record."""
         if self._closed:
             raise ServerClosed("server is shut down")
+        if self._scheduler is None:
+            raise ServeError("this server has no micro-batch plane "
+                             "(decode-only); use submit_decode()")
         arrays, single = self._normalize(inputs)
         cls = self._runner.bucket_for(tuple(a.shape for a in arrays))
         if self._breakers is not None and self._breakers.blocked(cls):
@@ -301,6 +364,32 @@ class Server:
         this cannot hang on a dead deadline)."""
         return self.submit_async(inputs, timeout_ms=timeout_ms,
                                  request_id=request_id).result()
+
+    # -- decode plane -------------------------------------------------------
+    def submit_decode(self, tokens, max_new_tokens=None, eos_id=None,
+                      timeout_ms=None, request_id=None, on_token=None):
+        """Enqueue one autoregressive generation request on the decode
+        plane; returns a future resolving to ``{"tokens": [...],
+        "finish_reason": ...}``.  ``on_token(token_id, index)`` streams
+        each token as it is emitted (bit-identical to the future's
+        ``tokens``).  Raises ``ServeError`` without a decode plane."""
+        if self._closed:
+            raise ServerClosed("server is shut down")
+        if self._decode is None:
+            raise ServeError("this server has no decode plane "
+                             "(construct with decode=DecodeRunner(...))")
+        return self._decode.submit(
+            tokens, max_new_tokens=max_new_tokens, eos_id=eos_id,
+            timeout_ms=timeout_ms, request_id=request_id,
+            on_token=on_token)
+
+    def swap_decode(self, new_runner):
+        """Repoint the decode plane at a new ``DecodeRunner``: live
+        sequences finish on the old runner's pool, new admissions start
+        on the new one once the running batch drains."""
+        if self._decode is None:
+            raise ServeError("this server has no decode plane")
+        self._decode.swap(new_runner)
 
     # -- hot swap -----------------------------------------------------------
     def swap(self, root=None, step=None, block=None):
@@ -346,7 +435,12 @@ class Server:
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd = None
-        return self._scheduler.stop(drain=drain, timeout=timeout)
+        ok = True
+        if self._decode is not None:
+            ok = self._decode.stop(drain=drain, timeout=timeout) and ok
+        if self._scheduler is not None:
+            ok = self._scheduler.stop(drain=drain, timeout=timeout) and ok
+        return ok
 
     def __enter__(self):
         return self
@@ -421,10 +515,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, {"error": "unknown path %s" % self.path})
 
     def do_POST(self):  # noqa: N802
+        import urllib.parse
+
         srv = self.server.mx_server
-        if self.path != "/predict":
+        parts = urllib.parse.urlsplit(self.path)
+        if parts.path != "/predict":
             self._send(404, {"error": "unknown path %s" % self.path})
             return
+        query = urllib.parse.parse_qs(parts.query)
         # X-Request-Id: accepted, attached to the request as its trace
         # id, and ECHOED on every /predict response (success or error)
         # so clients and the flight record agree on the correlation id.
@@ -444,6 +542,9 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             n = int(self.headers.get("Content-Length", 0))
             payload = json.loads(self.rfile.read(n) or b"{}")
+            if "tokens" in payload:
+                self._do_decode(srv, payload, query, rid, echo, send)
+                return
             inputs = payload["inputs"]
             if payload.get("multi"):
                 inputs = tuple(inputs)
@@ -475,7 +576,87 @@ class _Handler(BaseHTTPRequestHandler):
             send(504, {"error": str(exc)})
         except ServerClosed as exc:
             send(503, {"error": str(exc)})
+        except (DecodeError, PagePoolExhausted) as exc:
+            # static decode-plane limits (context/prompt/vocab bounds,
+            # a reservation that can never fit the pool): client error,
+            # not server pressure — retrying identical input cannot
+            # win.  EXCEPT pool_lost: the server's KV storage died
+            # under the sequence (a transient device fault) — that is
+            # a 500 a retry may well win
+            send(500 if getattr(exc, "pool_lost", False) else 400,
+                 {"error": str(exc)})
         except (KeyError, ValueError, NoBucketError) as exc:
             send(400, {"error": str(exc)})
         except Exception as exc:  # noqa: BLE001 - surfaced to the client
             send(500, {"error": str(exc)})
+
+    def _do_decode(self, srv, payload, query, rid, echo, send):
+        """POST /predict with a ``tokens`` payload: route to the decode
+        plane.  ``?stream=1`` (or ``"stream": true``) streams each
+        token as a chunked NDJSON event — same engine, same greedy
+        sampling, so the streamed ids are bit-identical to collect
+        mode — ending with a ``done`` summary (or an ``error`` event
+        if the sequence failed mid-generation).  Pre-admission errors
+        (overload, quarantine, validation) raise into ``do_POST``'s
+        normal status-code mapping before any response bytes go out."""
+        if srv.decode is None:
+            send(400, {"error": "this server has no decode plane"})
+            return
+        stream = payload.get("stream")
+        if stream is None:
+            stream = query.get("stream", ["0"])[0] \
+                not in ("", "0", "false")
+        kwargs = dict(max_new_tokens=payload.get("max_new_tokens"),
+                      eos_id=payload.get("eos_id"),
+                      timeout_ms=payload.get("timeout_ms"),
+                      request_id=rid)
+        # provenance of generated tokens is the DECODE runner's
+        # checkpoint step (a dual-plane server's vision runner may sit
+        # at a different step)
+        dstep = srv.decode.runner.step
+        if not stream or not srv.decode.config.stream:
+            res = srv.submit_decode(payload["tokens"], **kwargs).result()
+            send(200, {"tokens": res["tokens"],
+                       "finish_reason": res["finish_reason"],
+                       "step": dstep})
+            return
+        import queue as _queue
+
+        events = _queue.Queue()
+        fut = srv.submit_decode(
+            payload["tokens"],
+            on_token=lambda tok, i: events.put((tok, i)), **kwargs)
+        fut.add_done_callback(lambda _f: events.put(None))
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        for k, v in echo:
+            self.send_header(k, v)
+        try:
+            self.end_headers()
+
+            def chunk(obj):
+                data = json.dumps(obj).encode() + b"\n"
+                self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+
+            while True:
+                item = events.get()
+                if item is None:
+                    break
+                chunk({"token": item[0], "index": item[1]})
+            try:
+                res = fut.result()
+                chunk({"done": True, "tokens": res["tokens"],
+                       "finish_reason": res["finish_reason"],
+                       "step": dstep})
+            except Exception as exc:  # noqa: BLE001 - surfaced in-stream
+                chunk({"error": str(exc), "type": type(exc).__name__})
+            self.wfile.write(b"0\r\n\r\n")
+        except Exception:  # noqa: BLE001 - client gone mid-stream
+            # the 200 + chunked headers are already on the wire: do NOT
+            # fall back into do_POST's error mapping (a second status
+            # line inside a chunked body is protocol corruption on a
+            # half-open socket) — just drop the connection; the decode
+            # engine finishes the sequence regardless (callbacks feed a
+            # queue, never this socket)
+            self.close_connection = True

@@ -934,6 +934,52 @@ SERVE_BREAKER_TRIPS = counter(
     "serve_breaker_trips_total",
     "circuit breaker openings (bucket quarantined after repeated "
     "dispatch failures)", ("bucket",))
+# mx.serve.decode (serve/decode.py + kvcache.py): paged KV-cache +
+# continuous batching for autoregressive serving.  One decode-step
+# program per (batch-bucket, page-config) runs every iteration over
+# whichever sequences are live; buckets label compiles like the
+# vision path's serve_compile_total.
+SERVE_DECODE_TOKENS = counter(
+    "serve_decode_tokens_total", "tokens generated by the decode loop")
+SERVE_DECODE_STEPS = counter(
+    "serve_decode_steps_total",
+    "continuous-batching decode iterations dispatched")
+SERVE_DECODE_PREFILLS = counter(
+    "serve_decode_prefills_total",
+    "sequences prefilled through the prompt bucket path")
+SERVE_DECODE_BATCH = histogram(
+    "serve_decode_batch_size",
+    "live sequences per decode iteration (varies step to step as "
+    "sequences join and leave the running batch)",
+    buckets=(1, 2, 4, 8, 16, 32, 64))
+SERVE_DECODE_LIVE = gauge(
+    "serve_decode_live_sequences",
+    "sequences currently decoding in the running batch")
+SERVE_DECODE_WAITING = gauge(
+    "serve_decode_waiting_sequences",
+    "sequences queued for admission (slots or KV pages exhausted)")
+SERVE_DECODE_TTFT_SECONDS = histogram(
+    "serve_decode_ttft_seconds",
+    "time to first token: submit -> the prefill-produced token")
+SERVE_DECODE_TOKEN_SECONDS = histogram(
+    "serve_decode_token_seconds",
+    "per-token decode latency (one continuous-batching iteration)")
+SERVE_DECODE_COMPILES = counter(
+    "serve_decode_compile_total",
+    "decode/prefill program builds by bucket (steady state: at most "
+    "one per bucket, all during warm-up; mx.compile restores count 0)",
+    ("bucket",))
+SERVE_DECODE_EVICTIONS = counter(
+    "serve_decode_evictions_total",
+    "sequences evicted from the running batch, by reason (finished / "
+    "timeout / poisoned / error / quarantined / cancelled)",
+    ("reason",))
+SERVE_KV_PAGES_IN_USE = gauge(
+    "serve_kv_pages_in_use",
+    "KV-cache pool pages currently reserved by live sequences")
+SERVE_KV_PAGES_HIGH_WATER = gauge(
+    "serve_kv_pages_high_water",
+    "high-water mark of reserved KV-cache pool pages")
 # mx.dist (dist/): coordinated multi-host fault tolerance —
 # collective deadlines, membership, pod-consistent checkpoints.
 DIST_COLLECTIVE_TIMEOUTS = counter(

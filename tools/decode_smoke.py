@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""mx.serve.decode end-to-end smoke (the `make decode-smoke` target).
+
+Drills the autoregressive serving contract in one shot, on CPU:
+
+1. train-side: save a tiny decoder into an mx.checkpoint root, restore
+   it into a DecodeRunner; warm-up must compile each (bucket,
+   page-config) program AT MOST once;
+2. concurrent mixed prefill/decode traffic over HTTP — staggered
+   clients across two prompt buckets, streaming AND collect mode:
+   every request completes, sequences verifiably JOIN and LEAVE the
+   running decode batch mid-flight (asserted from the scheduler's step
+   ledger, not just exercised), ZERO compiles land on the hot path,
+   streamed token ids echo bit-identically against collect mode, and
+   the chunked response carries the client's X-Request-Id;
+3. poison drill via the MXNET_FAULTS site: a poisoned request id is
+   evicted ALONE (counted in serve_poison_requests_total), its pages
+   reclaimed, batch-mates complete;
+4. clean drain: shutdown with sequences in flight serves everything,
+   and the page pool audits to ZERO pages in use;
+5. the Prometheus export carries the serve_decode_* families.
+
+Exits non-zero (and prints the failing stage) on any violation.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import serve, telemetry
+    from mxnet_tpu.resilience import inject
+    from mxnet_tpu.resilience.inject import InjectedFault
+
+    mx.random.seed(0)
+
+    def factory():
+        return serve.TinyDecoder(vocab_size=64, num_layers=2,
+                                 num_heads=2, head_dim=8)
+
+    # stage 1: a committed checkpoint to serve from
+    blk = factory()
+    blk.initialize()
+    root = tempfile.mkdtemp(prefix="mx-decode-smoke-")
+    blk.save_checkpoint(root, step=1)
+    cfg = serve.DecodeConfig(page_size=4, pool_pages=64, max_live=4,
+                             max_new_tokens=24, max_context=48,
+                             prefill_lengths=(8, 16),
+                             batch_sizes=(1, 2, 4))
+    runner = serve.DecodeRunner(factory, root=root, config=cfg)
+    assert runner.step == 1, "stage 1: checkpoint step not restored"
+    print("checkpoint   : step 1 restored from %s" % root)
+
+    buckets = sorted(runner.provenance())
+    assert buckets == ["decode:b1", "decode:b2", "decode:b4",
+                       "prefill:t16", "prefill:t8"], \
+        "stage 1: unexpected bucket table %r" % buckets
+    for b in buckets:
+        n = telemetry.value("serve_decode_compile_total",
+                            labels={"bucket": b})
+        assert n <= 1, "stage 1: bucket %s compiled %d times" % (b, n)
+    print("warm-up      : %d buckets, <=1 compile each (%s)"
+          % (len(buckets), runner.provenance()))
+
+    srv = serve.Server(decode=runner)
+    assert srv.ready(), "stage 2: server not ready after warm-up"
+    host, port = srv.start_http()
+    base = "http://%s:%d" % (host, port)
+
+    # stage 2: concurrent mixed traffic — short and long prompts
+    # (both prefill buckets), short and long generations (sequences
+    # leave at different steps), staggered arrivals (sequences join a
+    # RUNNING batch), streaming and collect clients interleaved
+    compiles0 = telemetry.value("serve_decode_compile_total")
+    jobs = [
+        # (request_id, prompt, max_new, stream)
+        ("s-0", [1, 2, 3], 16, False),
+        ("s-1", [4, 5, 6, 7, 8, 9, 10, 11, 12], 12, True),
+        ("s-2", [13, 14], 20, False),
+        ("s-3", [15] * 12, 8, True),
+        ("s-4", [16, 17, 18], 6, False),
+        ("s-5", [19, 20], 18, True),
+        ("s-6", [21, 22, 23, 24], 10, False),
+        ("s-7", [25], 14, True),
+    ]
+    results, errors = {}, []
+
+    def client(rid, prompt, max_new, stream, delay):
+        time.sleep(delay)
+        try:
+            url = base + "/predict" + ("?stream=1" if stream else "")
+            req = urllib.request.Request(
+                url, data=json.dumps(
+                    {"tokens": prompt, "max_new_tokens": max_new}
+                ).encode(), headers={"X-Request-Id": rid})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                echoed = resp.headers.get("X-Request-Id")
+                if stream:
+                    events = [json.loads(line)
+                              for line in resp.read().splitlines()]
+                    toks = [e["token"] for e in events if "token" in e]
+                    done = events[-1]
+                    assert done.get("done") and done["tokens"] == toks, \
+                        "streamed ids disagree with the done summary"
+                    results[rid] = (toks, echoed)
+                else:
+                    body = json.load(resp)
+                    results[rid] = (body["tokens"], echoed)
+        except Exception as exc:  # noqa: BLE001
+            errors.append((rid, exc))
+
+    # near-simultaneous arrivals: max_live=4 admits the first four,
+    # later sequences join the RUNNING batch as finishers free slots
+    threads = [threading.Thread(target=client,
+                                args=(rid, p, n, st, 0.002 * i))
+               for i, (rid, p, n, st) in enumerate(jobs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, "stage 2: client failures: %r" % errors
+    for rid, prompt, max_new, _stream in jobs:
+        toks, echoed = results[rid]
+        assert len(toks) == max_new, \
+            "stage 2: %s got %d tokens, wanted %d" % (rid, len(toks),
+                                                      max_new)
+        assert echoed == rid, \
+            "stage 2: X-Request-Id not echoed on %s (%r)" % (rid, echoed)
+    new_compiles = telemetry.value("serve_decode_compile_total") \
+        - compiles0
+    assert new_compiles == 0, \
+        "stage 2: %d compile(s) escaped onto the decode hot path" \
+        % new_compiles
+
+    # streamed must be bit-identical to collect mode for the SAME
+    # prompt — rerun s-1's prompt in collect mode and compare
+    req = urllib.request.Request(
+        base + "/predict", data=json.dumps(
+            {"tokens": jobs[1][1], "max_new_tokens": jobs[1][2]}
+        ).encode())
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        again = json.load(resp)["tokens"]
+    assert again == results["s-1"][0], \
+        "stage 2: streamed tokens != collect-mode tokens"
+
+    # join/leave mid-batch, from the scheduler's own step ledger: some
+    # sequence must have JOINED after another joined and BEFORE it left
+    rec = {r["request_id"]: r for r in srv.decode.recent()}
+    overlaps = [
+        (a, b) for a in rec.values() for b in rec.values()
+        if a is not b
+        and a["joined_step"] < b["joined_step"] < a["left_step"]]
+    assert overlaps, \
+        "stage 2: no sequence joined a running batch (ledger: %r)" % rec
+    leaves_mid = [(a, b) for a, b in overlaps
+                  if b["left_step"] < a["left_step"]]
+    assert leaves_mid, "stage 2: no sequence left mid-batch"
+    print("traffic      : %d mixed clients (2 prefill buckets, stream+"
+          "collect), 0 hot-path compiles, %d join-overlaps, streamed =="
+          " collect" % (len(jobs), len(overlaps)))
+
+    # stage 3: poison drill — the MXNET_FAULTS serve_poison site
+    inject.plan("serve_poison@smoke-poison")
+    poison0 = telemetry.value("serve_poison_requests_total")
+    bad = srv.submit_decode([3, 4, 5], max_new_tokens=16,
+                            request_id="smoke-poison")
+    good = srv.submit_decode([6, 7], max_new_tokens=16,
+                             request_id="smoke-clean")
+    try:
+        bad.result(timeout=120)
+        raise AssertionError("stage 3: poisoned sequence served")
+    except InjectedFault:
+        pass
+    toks = good.result(timeout=120)["tokens"]
+    assert len(toks) == 16, "stage 3: clean batch-mate lost tokens"
+    assert telemetry.value("serve_poison_requests_total") == poison0 + 1
+    inject.clear()
+    pool = srv.decode.runner.pool
+    assert pool.in_use == 0, \
+        "stage 3: %d page(s) leaked after poison" % pool.in_use
+    pool.check()
+    print("poison       : smoke-poison evicted alone, pages reclaimed, "
+          "batch-mate served %d tokens" % len(toks))
+
+    # stage 4: clean drain with sequences in flight
+    futs = [srv.submit_decode([8 + i, 9], max_new_tokens=12)
+            for i in range(4)]
+    ok = srv.shutdown(drain=True, timeout=120)
+    assert ok, "stage 4: shutdown did not complete"
+    for f in futs:
+        assert len(f.result(timeout=1)["tokens"]) == 12, \
+            "stage 4: drain dropped an in-flight sequence"
+    assert pool.in_use == 0, "stage 4: drain leaked pages"
+    pool.check()
+    print("drain        : 4 in-flight sequences served through "
+          "shutdown, 0 pages in use (high water %d/%d)"
+          % (pool.high_water, pool.capacity))
+
+    # stage 5: decode families in the Prometheus export
+    prom = telemetry.prometheus()
+    for fam in ("serve_decode_tokens_total", "serve_decode_steps_total",
+                "serve_decode_batch_size", "serve_decode_ttft_seconds",
+                "serve_decode_token_seconds",
+                "serve_decode_compile_total",
+                "serve_decode_evictions_total", "serve_kv_pages_in_use"):
+        assert "# TYPE %s" % fam in prom, \
+            "stage 5: %s missing from Prometheus export" % fam
+    tot = {k: v for k, v in telemetry.totals(nonzero=True).items()
+           if k.startswith(("serve_decode", "serve_kv", "serve_poison"))}
+    print("telemetry    : %s" % tot)
+    print("decode-smoke PASS")
+
+
+if __name__ == "__main__":
+    main()

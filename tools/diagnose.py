@@ -217,6 +217,51 @@ def checkpoints_info(root):
                  "  <- latest restorable" if step == latest else ""))
 
 
+def _serve_decode_table(dec, breakers=None):
+    """The decode plane's operator table: live sequences, page-pool
+    occupancy/high-water, per-bucket compile provenance and breaker
+    state (the /statz ``decode`` block)."""
+    if not dec:
+        return
+    print("decode plane :")
+    runner = dec.get("runner", {})
+    pool = runner.get("pool", {})
+    pc = pool.get("config", {})
+    print("  pool       : %d/%d pages in use (high water %d, %.1f%% "
+          "occupied)  page_size=%s  max_context=%s"
+          % (pool.get("in_use_pages", 0), pool.get("capacity_pages", 0),
+             pool.get("high_water_pages", 0),
+             100.0 * pool.get("occupancy", 0.0),
+             pc.get("page_size"), pc.get("max_context")))
+    print("  traffic    : %d live  %d waiting  %d admitted  %d steps  "
+          "oom_rejects=%d"
+          % (len(dec.get("live", [])), dec.get("waiting", 0),
+             dec.get("admitted", 0), dec.get("steps", 0),
+             pool.get("oom_rejects", 0)))
+    for seq in dec.get("live", []):
+        print("    seq %-16s prompt=%-4d generated=%d/%d  pages=%d  "
+              "joined@%s"
+              % (seq.get("request_id") or "(anon)",
+                 seq.get("prompt_tokens", 0), seq.get("generated", 0),
+                 seq.get("max_new_tokens", 0), seq.get("pages", 0),
+                 seq.get("joined_step")))
+    board = dict(dec.get("breakers") or {})
+    if breakers:
+        board.update({k: v for k, v in breakers.items()
+                      if "decode" in k or "prefill" in k})
+    print("  buckets    :")
+    for label, prov in sorted(runner.get("buckets", {}).items()):
+        kind, _, size = label.partition(":")
+        key = str((kind, int(size.lstrip("bt") or 0)))
+        state = (board.get(key) or {}).get("state", "closed")
+        print("    %-14s compile=%-10s breaker=%s"
+              % (label, prov, state))
+    ev = dec.get("evictions", {})
+    if ev:
+        print("  evictions  : %s" % ", ".join(
+            "%s=%d" % kv for kv in sorted(ev.items())))
+
+
 def serve_info(src):
     """Dump the serving plane: scheduler config, bucket table, queue
     depth and rejection/outcome counters.  ``src`` is either a RUNNING
@@ -240,12 +285,15 @@ def serve_info(src):
                   "timeout_ms", "batch_sizes", "dtype"):
             print("%-12s : %r" % (k, cfg.get(k)))
         runner = stats.get("runner", {})
+        runner = runner or {}
         print("model        : step=%r root=%r warmed=%r compiled=%r"
               % (runner.get("step"), runner.get("root"),
                  runner.get("warmed"), runner.get("compiled_signatures")))
         print("buckets      : %s"
               % (", ".join(runner.get("buckets", [])) or "(exact shapes)"))
         print("queue depth  : %r" % stats.get("queue_depth"))
+        _serve_decode_table(stats.get("decode"),
+                            stats.get("breakers", {}))
         totals = dict(stats.get("totals", {}))
         totals.pop("serve_requests_total", None)
         for result, v in sorted(stats.get("requests", {}).items()):
