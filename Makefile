@@ -12,7 +12,7 @@ SO := build/libmxtpu_native.so
 .PHONY: native test cpptest telemetry-smoke checkpoint-smoke serve-smoke \
 	decode-smoke compile-cache-smoke trainer-smoke step-smoke \
 	trace-smoke monitor-smoke faults-smoke dist-faults-smoke \
-	zero-smoke smoke-all clean
+	zero-smoke autotune-smoke smoke-all clean
 
 native: $(SO)
 
@@ -156,11 +156,24 @@ dist-faults-smoke:
 	JAX_PLATFORMS=cpu python -m pytest \
 	  tests/python/unittest/test_dist_ft.py -q -m 'not slow'
 
+# mx.autotune smoke: search-tune two sites on CPU (winner measured
+# under the bitwise numerics guard and durably committed) -> a fresh
+# interpreter serves the tuned configs with ZERO re-measurement
+# (telemetry-asserted) and bit-identical outputs -> a corrupted record
+# is quarantined and degrades to the hand-set default with
+# autotune_fallback_total counted -> the store dir removed entirely
+# still runs clean; then the subsystem's pytest suite
+autotune-smoke:
+	JAX_PLATFORMS=cpu python tools/autotune_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/python/unittest/test_autotune.py -q -m 'not slow'
+
 # every subsystem smoke in sequence — the one-command pre-flight before
 # a tunnel window (each target is independent; failures stop the chain)
 smoke-all: telemetry-smoke checkpoint-smoke serve-smoke decode-smoke \
 	compile-cache-smoke trainer-smoke step-smoke trace-smoke \
-	monitor-smoke faults-smoke zero-smoke dist-faults-smoke
+	monitor-smoke faults-smoke zero-smoke autotune-smoke \
+	dist-faults-smoke
 
 # suite summary artifact (TESTS_r{N}.json) — round-2 advisor contract
 test-report:

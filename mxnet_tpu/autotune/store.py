@@ -1,0 +1,302 @@
+"""TuningStore — the durable record store behind mx.autotune.
+
+One record per (environment fingerprint, site, key): the measured
+winner config for one tunable site at one workload key, persisted next
+to the mx.compile cache with the same durability discipline
+(write-to-temp + fsync + COMMITTED marker + atomic rename, CRC
+manifest, corrupt records quarantined to ``*.corrupt``, benign
+concurrent commits with last-rename-wins).
+
+Record layout (``<root>/<envfp[:16]>/<site>/<keyhash>/``)::
+
+    RECORD.json    # the winner: config, timings, candidate audit trail
+    COMMITTED      # two-phase marker, written LAST: {crc32, nbytes}
+
+The environment fingerprint is the SAME one the compile cache keys
+executables by (platform / device topology / jax + jaxlib + framework
+versions / XLA flags — ``compile.cache.CompileCache.env_fingerprint``),
+so ANY environment drift is a clean miss back to the hand-set defaults:
+a winner measured on one topology can never be served on another.
+
+Every method is exception-safe: store I/O failure degrades to a miss
+(or a no-op), never an error on a lookup path.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import shutil
+import threading
+import time
+import zlib
+
+from .. import telemetry
+from ..base import get_env
+from ..checkpoint import layout as _layout
+
+__all__ = ["TuningStore", "default_store_dir", "key_hash", "FORMAT"]
+
+FORMAT = "mx-autotune-store-v1"
+RECORD = "RECORD.json"
+COMMITTED = "COMMITTED"
+
+_LOGGER = logging.getLogger("mxnet_tpu.autotune")
+
+# hex chars of the env fingerprint used as the store partition dir
+_ENV_PREFIX = 16
+
+
+def default_store_dir():
+    """MXNET_AUTOTUNE_DIR, else ``<MXNET_HOME>/autotune`` — the sibling
+    of the compile cache's default home, so tuned configs and compiled
+    executables live (and ship) together."""
+    d = get_env("MXNET_AUTOTUNE_DIR", str, None)
+    if not d:
+        home = get_env("MXNET_HOME", str, "~/.mxnet")
+        d = os.path.join(home, "autotune")
+    return os.path.expanduser(d)
+
+
+def key_hash(key):
+    """Stable hex identity of a site key (any JSON-able structure)."""
+    blob = json.dumps(key, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+
+class TuningStore:
+    """Persistent winner store (see module docstring)."""
+
+    def __init__(self, root=None, env_fingerprint=None):
+        self._root = os.path.abspath(root or default_store_dir())
+        self._env_fp = env_fingerprint  # lazy: touches jax.devices()
+
+    # -- identity -----------------------------------------------------------
+    @property
+    def root(self):
+        return self._root
+
+    def env_fingerprint(self):
+        """The compile-cache environment fingerprint (platform,
+        topology, versions, XLA flags) — computed lazily because it
+        probes the device backend."""
+        if self._env_fp is None:
+            from ..compile.cache import CompileCache
+
+            self._env_fp = CompileCache(root=self._root).env_fingerprint()
+        return self._env_fp
+
+    def _env_dir(self):
+        return os.path.join(self._root, self.env_fingerprint()[:_ENV_PREFIX])
+
+    def _record_dir(self, site, kh):
+        return os.path.join(self._env_dir(), site, kh)
+
+    # -- read ---------------------------------------------------------------
+    def get(self, site, key):
+        """The committed record for (env, site, key), else None."""
+        rec, _status = self.get_status(site, key)
+        return rec
+
+    def get_status(self, site, key):
+        """``(record, status)`` with status in ``hit`` / ``miss`` /
+        ``corrupt`` (record quarantined) / ``error`` (store I/O failed;
+        may succeed next time).  Never raises."""
+        try:
+            d = self._record_dir(site, key_hash(key))
+        except Exception:
+            # env fingerprinting itself failed (no backend): a lookup
+            # must still degrade to the default
+            return None, "error"
+        try:
+            marker = os.path.join(d, COMMITTED)
+            if not os.path.isfile(marker):
+                if os.path.isdir(d):
+                    # marker-less dir = torn remains of an interrupted
+                    # commit: park it so a future commit can land
+                    self._quarantine(d, reason="torn record (no marker)")
+                    return None, "corrupt"
+                return None, "miss"
+            with open(marker) as f:
+                manifest = json.load(f)
+            with open(os.path.join(d, RECORD), "rb") as f:
+                raw = f.read()
+            if len(raw) != manifest.get("nbytes") or \
+                    (zlib.crc32(raw) & 0xFFFFFFFF) != manifest.get("crc32"):
+                self._quarantine(d, reason="checksum mismatch")
+                return None, "corrupt"
+            rec = json.loads(raw.decode())
+        except (ValueError, KeyError):
+            self._quarantine(d, reason="record undecodable")
+            return None, "corrupt"
+        except FileNotFoundError:
+            # marker present but RECORD gone: genuinely torn
+            self._quarantine(d, reason="record incomplete")
+            return None, "corrupt"
+        except OSError:
+            # transient I/O (EACCES, EIO, fd exhaustion): a plain miss,
+            # never a quarantine of a possibly-healthy record
+            return None, "error"
+        if not isinstance(rec, dict):
+            self._quarantine(d, reason="record not a mapping")
+            return None, "corrupt"
+        return rec, "hit"
+
+    # -- write --------------------------------------------------------------
+    def put(self, site, key, record):
+        """Durably publish one record; concurrent writers race benignly
+        with last-rename-wins (the satellite contract: whoever renames
+        last owns the slot, and readers only ever see a complete
+        committed dir either way).  Returns the record dir, or None on
+        any I/O failure (counted; tuning degrades to in-memory)."""
+        import tempfile
+
+        try:
+            kh = key_hash(key)
+            final = self._record_dir(site, kh)
+            parent = os.path.dirname(final)
+            os.makedirs(parent, exist_ok=True)
+            tmp = tempfile.mkdtemp(dir=parent, prefix=".committing-")
+        except Exception:
+            return None
+        try:
+            rec = dict(record)
+            rec.setdefault("format", FORMAT)
+            rec.setdefault("site", site)
+            rec.setdefault("key", key)
+            rec.setdefault("created", time.time())
+            raw = json.dumps(rec, sort_keys=True, default=str).encode()
+            crc, n = _layout.write_file_durable(
+                os.path.join(tmp, RECORD), raw)
+            _layout.write_file_durable(
+                os.path.join(tmp, COMMITTED),
+                json.dumps({"format": FORMAT, "crc32": crc,
+                            "nbytes": n}).encode())
+            _layout.fsync_dir(tmp)
+            # slot occupied (racing writer or a stale record): last
+            # wins — park the incumbent, take the slot, drop the
+            # parked dir.  Concurrent parkers race benignly (a failed
+            # park means someone else moved the incumbent; just retry
+            # the publish); readers never see a torn state because
+            # every dir involved is complete at every instant.
+            published = False
+            for attempt in range(16):
+                try:
+                    os.rename(tmp, final)
+                    published = True
+                    break
+                except OSError:
+                    park = "%s.prev-%d-%d-%d" % (
+                        final, os.getpid(), threading.get_ident(),
+                        attempt)
+                    try:
+                        os.rename(final, park)
+                    except OSError:
+                        continue  # another parker got there first
+                    shutil.rmtree(park, ignore_errors=True)
+            if not published:
+                shutil.rmtree(tmp, ignore_errors=True)
+                return None
+            _layout.fsync_dir(parent)
+        except (OSError, TypeError, ValueError):
+            shutil.rmtree(tmp, ignore_errors=True)
+            return None
+        if telemetry.ENABLED:
+            telemetry.AUTOTUNE_STORE_COMMITS.inc()
+        return final
+
+    # -- quarantine ---------------------------------------------------------
+    def _quarantine(self, d, reason=""):
+        q = d + ".corrupt"
+        n = 0
+        while os.path.exists(q):
+            n += 1
+            q = "%s.corrupt.%d" % (d, n)
+        try:
+            os.rename(d, q)
+        except OSError:
+            return None
+        _LOGGER.warning("autotune record %s quarantined (%s)",
+                        os.path.basename(d), reason or "corrupt")
+        if telemetry.ENABLED:
+            telemetry.AUTOTUNE_STORE_QUARANTINE.inc()
+        return q
+
+    # -- enumeration --------------------------------------------------------
+    def records(self):
+        """[(site, keyhash, record)] committed under THIS environment
+        fingerprint (other environments' partitions are invisible — the
+        clean-miss contract)."""
+        out = []
+        try:
+            env_dir = self._env_dir()
+            sites = os.listdir(env_dir)
+        except Exception:
+            return out
+        for site in sorted(sites):
+            sd = os.path.join(env_dir, site)
+            if not os.path.isdir(sd):
+                continue
+            try:
+                names = os.listdir(sd)
+            except OSError:
+                continue
+            for kh in sorted(names):
+                d = os.path.join(sd, kh)
+                if ".corrupt" in kh or ".prev-" in kh or \
+                        kh.startswith(".committing-") or \
+                        not os.path.isdir(d):
+                    continue
+                try:
+                    if not os.path.isfile(os.path.join(d, COMMITTED)):
+                        continue
+                    with open(os.path.join(d, RECORD)) as f:
+                        rec = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                out.append((site, kh, rec))
+        return out
+
+    def quarantined(self):
+        """Paths of quarantined (``*.corrupt``) record dirs across ALL
+        environment partitions (a corrupt record from an old env still
+        deserves an audit line)."""
+        out = []
+        try:
+            envs = os.listdir(self._root)
+        except OSError:
+            return out
+        for env in envs:
+            ed = os.path.join(self._root, env)
+            if not os.path.isdir(ed):
+                continue
+            for dirpath, dirnames, _files in os.walk(ed):
+                for name in list(dirnames):
+                    if ".corrupt" in name:
+                        out.append(os.path.join(dirpath, name))
+                        dirnames.remove(name)
+        return sorted(out)
+
+    def stats(self):
+        recs = self.records()
+        return {"dir": self._root,
+                "env_fingerprint": self._safe_env_fp(),
+                "records": len(recs),
+                "sites": sorted({s for s, _k, _r in recs}),
+                "quarantined": self.quarantined()}
+
+    def _safe_env_fp(self):
+        try:
+            return self.env_fingerprint()[:_ENV_PREFIX]
+        except Exception:
+            return None
+
+    def clear(self):
+        """Remove every record (all environments + quarantined remains)."""
+        try:
+            for name in os.listdir(self._root):
+                shutil.rmtree(os.path.join(self._root, name),
+                              ignore_errors=True)
+        except OSError:
+            pass

@@ -304,6 +304,45 @@ ENV_VARS = {
         "Serve chunked per-token streaming on /predict?stream=1; 0 "
         "forces collect mode (the streamed and collected token "
         "sequences are bit-identical either way)."),
+    "MXNET_AUTOTUNE": (
+        str, "0",
+        "mx.autotune mode: 0 (default) = hand-set literals everywhere, "
+        "zero store I/O; 1 = consumers look tuned configs up in the "
+        "persistent TuningStore at build time (a miss or ANY store "
+        "failure degrades to the default, counted in "
+        "autotune_fallback_total); search = additionally run the "
+        "measured search where it is safe (serve/decode warm-up idle "
+        "tuners, tools/autotune_smoke.py, bench sweep rows, explicit "
+        "autotune.tune()).  A tuned winner is always bit-identical to "
+        "the default — the measure harness rejects candidates that "
+        "change numerics (autotune/)."),
+    "MXNET_AUTOTUNE_DIR": (
+        str, None,
+        "TuningStore directory (default <MXNET_HOME>/autotune — next "
+        "to the mx.compile cache).  Records are partitioned by the "
+        "compile cache's environment fingerprint, so platform/"
+        "topology/version/XLA-flag drift is a clean miss back to "
+        "defaults."),
+    "MXNET_AUTOTUNE_BUDGET_MS": (
+        float, 2000.0,
+        "Wall-clock budget per tune() search and per idle-tuning "
+        "pass; unmeasured candidates are recorded as skipped and the "
+        "default stays in force for them."),
+    "MXNET_AUTOTUNE_REPEATS": (
+        int, 5,
+        "Timed repeats per measured candidate (trimmed mean: min and "
+        "max dropped at >=4)."),
+    "MXNET_AUTOTUNE_WARMUP": (
+        int, 2,
+        "Discarded warm-up runs per measured candidate (after the "
+        "compile/correctness run)."),
+    "MXNET_AUTOTUNE_PRUNE": (
+        int, 0,
+        "When > 0, the table cost model (autotune/model.py) prunes "
+        "each search grid to the top-N predicted candidates before "
+        "measuring; a cold model (no stored measurements for the "
+        "site) always falls back to exhaustive measurement.  0 "
+        "disables pruning."),
     "MXNET_TELEMETRY_DISABLE": (
         bool, False,
         "Disable the runtime telemetry registry (mx.telemetry); hooks "

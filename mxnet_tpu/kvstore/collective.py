@@ -15,7 +15,6 @@ synchronous all-reduce over the ICI mesh:
 """
 from __future__ import annotations
 
-import os
 import time as _time
 
 import jax
@@ -25,17 +24,49 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import telemetry as _tel
 from .. import trace as _trace
-from ..base import MXNetError
+from ..base import MXNetError, get_env
 from ..ndarray.ndarray import NDArray
 from ..resilience import inject as _inject
 from .base import KVStoreBase
 from .kvstore import _pair, _reduce
 
-# fuse keys into ~this many bytes per collective program (reference:
-# MXNET_KVSTORE_BIGARRAY_BOUND splits big arrays; here the knob bounds how
-# many small keys fuse into one psum launch)
-_BUCKET_BYTES = int(os.environ.get("MXNET_KVSTORE_BUCKET_BYTES",
-                                   4 << 20))
+
+def default_bucket_bytes():
+    """The hand-set gradient-fusion bucket size: how many bytes of
+    keys fuse into one collective program (reference:
+    MXNET_KVSTORE_BIGARRAY_BOUND splits big arrays; here the knob
+    bounds how many small keys fuse into one psum launch).  Re-read
+    from the environment per call — mx.autotune varies the effective
+    bucket size at plan time, so nothing may cache this at import."""
+    return int(get_env("MXNET_KVSTORE_BUCKET_BYTES", int, 4 << 20))
+
+
+def tuned_bucket_bytes(sizes_dtypes, world=None):
+    """``(bucket_bytes, provenance)`` for one gradient list: the
+    mx.autotune ``allreduce_bucket`` winner for this workload key —
+    (n_arrays, total_bytes, world) — else the hand-set default.
+    Provenance is ``tuned`` or ``default`` (consumed by the step
+    capture report and diagnose)."""
+    base = default_bucket_bytes()
+    from .. import autotune as _at
+
+    if not _at.is_enabled():
+        return base, "default"
+    if world is None:
+        world = jax.process_count()
+    total = int(sum(int(s) for s, _d in sizes_dtypes))
+    cfg, prov = _at.lookup_info(
+        "allreduce_bucket", (len(sizes_dtypes), total, int(world)), base)
+    if prov != "tuned":
+        return base, "default"
+    try:
+        bb = int(cfg)
+    except (TypeError, ValueError):
+        bb = 0
+    if bb <= 0:
+        _at.fallback("invalid_config")
+        return base, "default"
+    return bb, "tuned"
 
 
 def plan_buckets(sizes_dtypes, bucket_bytes=None):
@@ -51,7 +82,7 @@ def plan_buckets(sizes_dtypes, bucket_bytes=None):
     its own bucket.  Total program count is therefore at most
     ``ceil(total_bytes / bucket_bytes)`` plus one per dtype switch."""
     if bucket_bytes is None:
-        bucket_bytes = _BUCKET_BYTES
+        bucket_bytes = default_bucket_bytes()
     plan, bucket, nbytes, last_dtype = [], [], 0, None
     for i, (size, dtype) in enumerate(sizes_dtypes):
         if bucket and last_dtype != dtype:
@@ -68,23 +99,30 @@ def plan_buckets(sizes_dtypes, bucket_bytes=None):
     return plan
 
 
-def observe_bucket_fill(bucket_nbytes, op=None):
+def observe_bucket_fill(bucket_nbytes, op=None, bucket_bytes=None):
     """Feed the ``allreduce_bucket_fill`` histogram from a precomputed
     bucket plan (``[payload bytes per bucket]``).  The per-call bucketed
     path observes fill inline in ``_allreduce_many``; a captured step
     program (mx.step) reduces inside ONE whole-step XLA program where
     that observation point never runs, so it feeds its static plan
     through here each dispatch — keeping the two paths comparable in
-    telemetry.  ``op`` additionally accounts the collective itself
-    (one call per bucket, PAYLOAD bytes — the same semantics the
-    eager ``_allreduce_many`` path feeds) under the given label:
-    ``allreduce`` (the eager path's series), or ``reduce_scatter``
-    for a ZeRO-2/3 sharded step.  Priced WIRE bytes live in the
-    capture report / bench rows, not here."""
+    telemetry.  ``bucket_bytes`` is the bucket size the plan was
+    ACTUALLY built with (a custom ``plan_buckets(bucket_bytes=...)`` or
+    an autotuned winner); normalizing against anything else would lie
+    about fill the moment the size varies, so callers with a plan must
+    pass theirs — None falls back to the current env default.  ``op``
+    additionally accounts the collective itself (one call per bucket,
+    PAYLOAD bytes — the same semantics the eager ``_allreduce_many``
+    path feeds) under the given label: ``allreduce`` (the eager path's
+    series), or ``reduce_scatter`` for a ZeRO-2/3 sharded step.
+    Priced WIRE bytes live in the capture report / bench rows, not
+    here."""
     if not _tel.ENABLED:
         return
+    denom = float(bucket_bytes if bucket_bytes else
+                  default_bucket_bytes())
     for nbytes in bucket_nbytes:
-        _tel.ALLREDUCE_BUCKET_FILL.observe(nbytes / float(_BUCKET_BYTES))
+        _tel.ALLREDUCE_BUCKET_FILL.observe(nbytes / denom)
     if op is not None:
         _tel.COLLECTIVE_CALLS.labels(op=op).inc(len(bucket_nbytes))
         _tel.COLLECTIVE_BYTES.labels(op=op).inc(
@@ -198,8 +236,13 @@ class CollectiveKVStore(KVStoreBase):
             return list(datas)
         datas = [jnp.asarray(d) for d in datas]
         out = [None] * len(datas)
-        plan = plan_buckets([(d.size * d.dtype.itemsize, str(d.dtype))
-                             for d in datas])
+        sizes = [(d.size * d.dtype.itemsize, str(d.dtype))
+                 for d in datas]
+        # the plan's ACTUAL bucket size (autotuned winner or env
+        # default) — threaded through to the fill observation below so
+        # fill numbers stay truthful when the size varies
+        bucket_bytes, _prov = tuned_bucket_bytes(sizes)
+        plan = plan_buckets(sizes, bucket_bytes=bucket_bytes)
         for b, idxs in enumerate(plan):
             bucket = [(i, datas[i]) for i in idxs]
             nbytes = sum(a.size * a.dtype.itemsize for _, a in bucket)
@@ -243,7 +286,7 @@ class CollectiveKVStore(KVStoreBase):
                 _tel.COLLECTIVE_BYTES.labels(op="allreduce").inc(nbytes)
                 _tel.COLLECTIVE_SECONDS.observe(_time.perf_counter() - t0)
                 _tel.ALLREDUCE_BUCKET_FILL.observe(
-                    nbytes / float(_BUCKET_BYTES))
+                    nbytes / float(bucket_bytes))
         return out
 
     def init(self, key, value):

@@ -29,11 +29,78 @@ from jax.experimental import pallas as pl
 
 _NEG_INF = -1e30
 
+# registered hand-set defaults — the mx.autotune sites' reference
+# configs.  MXNET_AUTOTUNE=0 resolves to exactly these literals, so
+# the untuned stack is bit-and-perf identical to the pre-autotune one.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+DEFAULT_BLOCKWISE_K = 256
+
+
+def _tuned_flash_blocks(q, k, causal, block_q, block_k, dropout_p=0.0):
+    """Resolve (block_q, block_k): explicit caller values win, else
+    the mx.autotune ``flash_attention`` winner for this workload key,
+    else the hand-set defaults.  A malformed stored config degrades to
+    the defaults with a counted fallback — never an error.
+
+    Dropout pins the defaults: the in-kernel keep mask is seeded per
+    (q-block, k-block) TILE, so different block sizes draw different
+    masks — a tuned winner measured bit-identical on the dropout-free
+    path would still change dropout numerics.  Only explicit block
+    arguments override blocks under dropout."""
+    if block_q is not None and block_k is not None:
+        return int(block_q), int(block_k)
+    from .. import autotune as _at
+
+    bq, bk = DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+    if dropout_p > 0.0:
+        return (int(block_q) if block_q is not None else bq,
+                int(block_k) if block_k is not None else bk)
+    if _at.is_enabled():
+        B, H, Tq, D = q.shape
+        cfg = _at.lookup(
+            "flash_attention",
+            (B, H, Tq, k.shape[2], D, str(q.dtype), bool(causal)),
+            (bq, bk))
+        try:
+            bq, bk = int(cfg[0]), int(cfg[1])
+        except (TypeError, ValueError, IndexError):
+            _at.fallback("invalid_config")
+            bq, bk = DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+    return (int(block_q) if block_q is not None else bq,
+            int(block_k) if block_k is not None else bk)
+
+
+def _tuned_blockwise_k(q, k, causal, block_k, dropout_p=0.0):
+    """``block_k`` for ``blockwise_attention``: explicit value, tuned
+    winner, or today's literal 256.  Dropout pins the default — the
+    per-block threefry mask is folded by k-block index, so a different
+    block_k draws different masks (same contract as the flash
+    kernel)."""
+    if block_k is not None:
+        return int(block_k)
+    from .. import autotune as _at
+
+    bk = DEFAULT_BLOCKWISE_K
+    if dropout_p > 0.0:
+        return bk
+    if _at.is_enabled():
+        B, H, Tq, D = q.shape
+        cfg = _at.lookup(
+            "blockwise_attention",
+            (B, H, Tq, k.shape[2], D, str(q.dtype), bool(causal)), bk)
+        try:
+            bk = int(cfg)
+        except (TypeError, ValueError):
+            _at.fallback("invalid_config")
+            bk = DEFAULT_BLOCKWISE_K
+    return bk
+
 
 # ---------------------------------------------------------------------------
 # blockwise (pure JAX) — the reference semantics + the backward path
 # ---------------------------------------------------------------------------
-def blockwise_attention(q, k, v, causal=False, sm_scale=None, block_k=256,
+def blockwise_attention(q, k, v, causal=False, sm_scale=None, block_k=None,
                         dropout_p=0.0, dropout_key=None):
     """Memory-efficient attention via lax.scan over K/V blocks.
 
@@ -44,7 +111,14 @@ def blockwise_attention(q, k, v, causal=False, sm_scale=None, block_k=256,
     accumulates the undropped mass while the numerator applies a
     per-block threefry mask — exactly dropout(softmax(s)) @ v, computed
     online.  Deterministic per ``dropout_key``, so the vjp recomputation
-    sees the same mask."""
+    sees the same mask.
+
+    ``block_k=None`` (default) resolves through the mx.autotune
+    ``blockwise_attention`` site: the hand-set literal 256 when
+    autotune is off or cold (and always under dropout — the per-block
+    mask partition must not move with a tuned block size)."""
+    block_k = _tuned_blockwise_k(q, k, causal, block_k,
+                                 dropout_p=float(dropout_p))
     if dropout_p > 0.0 and dropout_key is None:
         raise ValueError(
             "blockwise_attention: dropout_p > 0 requires dropout_key "
@@ -525,10 +599,14 @@ def _flash_lse_bwd(causal, sm_scale, block_q, block_k, interpret, res,
 flash_attention_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
-def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=512,
-                    block_k=512, interpret=None, dropout_p=0.0,
+def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=None,
+                    block_k=None, interpret=None, dropout_p=0.0,
                     dropout_key=None):
     """Flash attention, (B, H, T, D) layout.
+
+    ``block_q``/``block_k`` default to the mx.autotune
+    ``flash_attention`` winner for this workload (the hand-set 512/512
+    literals when autotune is off or cold); explicit values always win.
 
     Forward AND backward run Pallas kernels (interpret mode off-TPU): the
     backward recomputes per-block probabilities from the saved logsumexp —
@@ -538,6 +616,8 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=512,
     Attention-probability dropout runs IN-kernel from the TPU PRNG: the
     per-tile mask is regenerated — never stored — in fwd, dq and dkv
     passes, seeded by (key, bh, q-block, k-block)."""
+    block_q, block_k = _tuned_flash_blocks(q, k, causal, block_q, block_k,
+                                           dropout_p=float(dropout_p))
     interpret = _default_interpret() if interpret is None else interpret
     if dropout_p > 0.0:
         if dropout_key is None:

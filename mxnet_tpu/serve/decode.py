@@ -152,7 +152,8 @@ class DecodeConfig:
             raise ValueError("no prefill bucket <= max_context=%d"
                              % self.max_context)
         if batch_sizes is None:
-            batch_sizes = _pow2_up_to(1, max(1, self.max_live))
+            default_set = _pow2_up_to(1, max(1, self.max_live))
+            batch_sizes = self._tuned_batch_sizes(default_set)
         self.batch_sizes = tuple(sorted(set(int(b) for b in batch_sizes)))
         if self.batch_sizes[-1] < self.max_live:
             raise ValueError(
@@ -163,6 +164,31 @@ class DecodeConfig:
         self.timeout_ms = timeout_ms
         self.eos_id = eos_id
         self.dtype = dtype
+
+    def _tuned_batch_sizes(self, default_set):
+        """The mx.autotune ``decode_bucket`` winner for this
+        ``max_live`` (committed by the decode runner's idle tuner in a
+        previous process), validated — every tuned set must still
+        cover ``max_live`` — else the power-of-two default.  Decode
+        outputs are bucket-table-invariant by the padding design, so a
+        tuned table changes compile count and step latency, never
+        tokens."""
+        from .. import autotune as _at
+
+        if not _at.is_enabled():
+            return default_set
+        cfg, prov = _at.lookup_info("decode_bucket", (self.max_live,),
+                                    list(default_set))
+        if prov != "tuned":
+            return default_set
+        try:
+            buckets = sorted(set(int(b) for b in cfg))
+        except (TypeError, ValueError):
+            buckets = []
+        if not buckets or buckets[0] < 1 or buckets[-1] < self.max_live:
+            _at.fallback("invalid_config")
+            return default_set
+        return buckets
 
     def as_dict(self):
         return {
@@ -463,6 +489,19 @@ class DecodeRunner:
                 chunk = 1 if kind == "decode" else n
                 self._dispatch(prog, self._null_inputs(batch, chunk))
         self._warmed = True
+        # mx.autotune idle-time tuning (MXNET_AUTOTUNE=search): every
+        # decode bucket program is warm and idempotent against null
+        # inputs (drop-mode page tables leave the pool untouched), so
+        # measure each one and commit the cheapest candidate bucket
+        # SET — the next process's DecodeConfig looks it up at build
+        # time.  Budget-bounded; failures degrade to the untuned table
+        from .. import autotune as _autotune
+
+        if _autotune.search_enabled():
+            try:
+                _autotune.measure.decode_idle_tune(self)
+            except Exception:
+                _autotune.fallback("serve_idle")
         return fresh
 
     def _null_inputs(self, batch, chunk):

@@ -62,7 +62,8 @@ from .. import telemetry as _tel
 from .. import trace as _trace
 from ..base import MXNetError, get_env
 from ..kvstore.collective import (observe_bucket_fill,
-                                  observe_collective, plan_buckets)
+                                  observe_collective, plan_buckets,
+                                  tuned_bucket_bytes)
 from ..ndarray.ndarray import NDArray
 from ..optimizer import multi_tensor as _mt
 from ..resilience import inject as _inject
@@ -234,6 +235,7 @@ class _Captured:
 
     __slots__ = ("sig", "train_idx", "train_names", "other_names",
                  "group_list", "labels", "pos_of", "bucket_plan",
+                 "bucket_bytes", "bucket_prov",
                  "bucket_nbytes", "n_slots", "slot_fns", "jfn", "cfn",
                  "cfn_ok", "fingerprint", "provenance", "gate",
                  "monitor", "remat", "segments", "donation",
@@ -241,6 +243,8 @@ class _Captured:
                  "state_shardings", "replicated", "wire")
 
     def __init__(self):
+        self.bucket_bytes = 0
+        self.bucket_prov = "default"
         self.slot_fns = None
         self.jfn = None
         self.cfn = None
@@ -537,6 +541,8 @@ class StepProgram:
                 "segments": list(cap.segments),
                 "donation": dict(cap.donation),
                 "bucket_plan": [list(b) for b in cap.bucket_plan],
+                "bucket_bytes": int(cap.bucket_bytes),
+                "bucket_bytes_provenance": cap.bucket_prov,
             } for cap in self._programs.values()],
             "fallbacks": list(self._fallbacks),
         }
@@ -725,9 +731,15 @@ class StepProgram:
         cap.gate = bool(sig[3])
         cap.remat = sig[5]
         grad_arrs = [g._data for _, _, g in items]
+        grad_sizes = [(a.size * a.dtype.itemsize, str(a.dtype))
+                      for a in grad_arrs]
+        # mx.autotune: the plan's bucket size may be a tuned winner —
+        # recorded (with provenance) in report() and threaded through
+        # every fill observation this program feeds
+        cap.bucket_bytes, cap.bucket_prov = tuned_bucket_bytes(
+            grad_sizes, world=self._world)
         cap.bucket_plan = plan_buckets(
-            [(a.size * a.dtype.itemsize, str(a.dtype))
-             for a in grad_arrs])
+            grad_sizes, bucket_bytes=cap.bucket_bytes)
         cap.bucket_nbytes = [
             sum(grad_arrs[j].size * grad_arrs[j].dtype.itemsize
                 for j in bucket)
@@ -1085,7 +1097,8 @@ class StepProgram:
                         cap.bucket_nbytes,
                         op="reduce_scatter" if (
                             mesh_reduces and cap.level >= 2)
-                        else "allreduce")
+                        else "allreduce",
+                        bucket_bytes=cap.bucket_bytes)
                     if mesh_reduces and cap.level >= 1:
                         observe_collective(
                             "all_gather",

@@ -1,0 +1,593 @@
+"""mx.autotune tests: TuningStore durability (torn-commit recovery,
+corrupt-record quarantine, concurrent-writer last-wins, environment-
+fingerprint rotation, store-unavailable degradation), the measured
+search harness's bitwise numerics guard, the table cost model's
+prune-or-exhaustive contract, the off-by-default bit-and-perf-identity
+of every consumer hook (attention block sizes, collective bucket
+bytes, conv layout, BN stat dtype, decode bucket table), and the
+tuned-lookup plumbing through kvstore / step capture / serve."""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autotune, telemetry
+from mxnet_tpu.autotune import measure as measure_mod
+from mxnet_tpu.autotune.model import CostModel
+from mxnet_tpu.autotune.store import COMMITTED, RECORD, TuningStore
+from mxnet_tpu.base import MXNetError
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(tmp_path, monkeypatch):
+    """Every test gets a private store dir, autotune OFF (tests opt in
+    per case), and a reset telemetry registry."""
+    monkeypatch.delenv("MXNET_AUTOTUNE", raising=False)
+    monkeypatch.delenv("MXNET_AUTOTUNE_DIR", raising=False)
+    telemetry.enable()
+    telemetry.reset()
+    autotune.disable()
+    yield
+    autotune.disable()
+    telemetry.enable()
+    telemetry.reset()
+
+
+def _store(tmp_path):
+    return TuningStore(root=str(tmp_path / "store"))
+
+
+def _rec_dir(st, site, key):
+    return st._record_dir(site, autotune.key_hash(list(key)))
+
+
+# ---------------------------------------------------------------------------
+# store durability
+# ---------------------------------------------------------------------------
+
+def test_store_roundtrip(tmp_path):
+    st = _store(tmp_path)
+    key = [4, 1024, 1]
+    assert st.get("allreduce_bucket", key) is None
+    d = st.put("allreduce_bucket", key, {"config": 1 << 20, "ms": 1.0})
+    assert d is not None and os.path.isfile(os.path.join(d, COMMITTED))
+    rec = st.get("allreduce_bucket", key)
+    assert rec["config"] == 1 << 20 and rec["site"] == "allreduce_bucket"
+    assert [("allreduce_bucket", autotune.key_hash(key))] == \
+        [(s, k) for s, k, _r in st.records()]
+
+
+def test_store_torn_commit_recovery(tmp_path):
+    """A marker-less record dir (writer died before COMMITTED) is
+    quarantined on sight and a later commit of the same key lands."""
+    st = _store(tmp_path)
+    key = [1, 2, 3]
+    d = _rec_dir(st, "allreduce_bucket", key)
+    os.makedirs(d)
+    with open(os.path.join(d, RECORD), "w") as f:
+        f.write('{"config": 99}')  # no COMMITTED marker: torn
+    rec, status = st.get_status("allreduce_bucket", key)
+    assert rec is None and status == "corrupt"
+    assert len(st.quarantined()) == 1
+    assert telemetry.value("autotune_store_quarantine_total") == 1
+    # the slot is free again: a fresh commit lands and reads back
+    assert st.put("allreduce_bucket", key, {"config": 7}) is not None
+    assert st.get("allreduce_bucket", key)["config"] == 7
+
+
+def test_store_corrupt_record_quarantined(tmp_path):
+    st = _store(tmp_path)
+    key = [9]
+    st.put("blockwise_attention", key, {"config": 128})
+    d = _rec_dir(st, "blockwise_attention", key)
+    with open(os.path.join(d, RECORD), "r+b") as f:
+        f.seek(2)
+        f.write(b"\xde\xad")
+    rec, status = st.get_status("blockwise_attention", key)
+    assert rec is None and status == "corrupt"
+    assert len(st.quarantined()) == 1
+    # quarantined, not deleted: never trusted again, still auditable
+    assert ".corrupt" in st.quarantined()[0]
+    assert st.get("blockwise_attention", key) is None
+
+
+def test_store_undecodable_record_quarantined(tmp_path):
+    st = _store(tmp_path)
+    key = [3]
+    st.put("blockwise_attention", key, {"config": 128})
+    d = _rec_dir(st, "blockwise_attention", key)
+    raw = b"not json at all"
+    with open(os.path.join(d, RECORD), "wb") as f:
+        f.write(raw)
+    # keep the CRC manifest consistent so the JSON decode is what fails
+    import zlib
+
+    with open(os.path.join(d, COMMITTED), "w") as f:
+        json.dump({"crc32": zlib.crc32(raw) & 0xFFFFFFFF,
+                   "nbytes": len(raw)}, f)
+    rec, status = st.get_status("blockwise_attention", key)
+    assert rec is None and status == "corrupt"
+
+
+def test_store_concurrent_writers_last_wins(tmp_path):
+    """N racing writers to ONE key: no exception, and the final state
+    is one intact committed record from one of the writers."""
+    st = _store(tmp_path)
+    st.env_fingerprint()  # resolve once before threading
+    key = [10, 20]
+    errs = []
+
+    def write(i):
+        try:
+            for _ in range(5):
+                assert st.put("allreduce_bucket", key,
+                              {"config": (i + 1) << 20}) is not None
+        except Exception as exc:  # pragma: no cover
+            errs.append(exc)
+
+    threads = [threading.Thread(target=write, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    rec = st.get("allreduce_bucket", key)
+    assert rec is not None and rec["config"] in {(i + 1) << 20
+                                                 for i in range(4)}
+    # exactly one live record; any parked .prev remains were cleaned
+    assert len(st.records()) == 1
+
+
+def test_store_env_fingerprint_rotation(tmp_path, monkeypatch):
+    """A record committed under one environment fingerprint is a clean
+    miss under another (the XLA_FLAGS component drifts here)."""
+    root = str(tmp_path / "store")
+    st = TuningStore(root=root)
+    key = [5]
+    st.put("blockwise_attention", key, {"config": 512})
+    assert st.get("blockwise_attention", key)["config"] == 512
+    # same root, different env: fingerprint differs -> different
+    # partition -> miss (simulated by forcing the fp rather than
+    # re-probing jax under mutated XLA_FLAGS)
+    st2 = TuningStore(root=root, env_fingerprint="f" * 64)
+    assert st2.env_fingerprint() != st.env_fingerprint()
+    rec, status = st2.get_status("blockwise_attention", key)
+    assert rec is None and status == "miss"
+
+
+def test_store_unavailable_degrades(tmp_path, monkeypatch):
+    """A store rooted somewhere unusable degrades every lookup to the
+    default without raising, and the fallback is counted."""
+    blocked = tmp_path / "blocked"
+    blocked.write_text("a file, not a directory")
+    autotune.enable("on", root=str(blocked))
+    v, prov = autotune.lookup_info("blockwise_attention",
+                                   (1, 1, 64, 64, 8, "float32", False),
+                                   256)
+    assert v == 256 and prov == "default"
+    # put() must be a counted no-op too
+    st = autotune.get_store()
+    assert st.put("blockwise_attention", [1], {"config": 1}) is None
+
+
+# ---------------------------------------------------------------------------
+# lookup semantics
+# ---------------------------------------------------------------------------
+
+def test_lookup_off_is_default_and_free(tmp_path):
+    assert autotune.mode() == "off"
+    v, prov = autotune.lookup_info("blockwise_attention", (1,), 256)
+    assert (v, prov) == (256, "default")
+    assert telemetry.value("autotune_lookup_total",
+                           {"site": "blockwise_attention",
+                            "result": "default"}) == 0  # off: unmetered
+
+
+def test_lookup_tuned_and_invalid_config(tmp_path):
+    autotune.enable("on", root=str(tmp_path / "store"))
+    st = autotune.get_store()
+    key = (1, 2, 256, 256, 32, "float32", False)
+    st.put("blockwise_attention", list(key), {"config": 128})
+    assert autotune.lookup("blockwise_attention", key, 256) == 128
+    assert telemetry.value("autotune_lookup_total",
+                           {"site": "blockwise_attention",
+                            "result": "tuned"}) == 1
+    # a malformed stored config fails site validation -> default +
+    # counted fallback
+    key2 = (9, 9, 9, 9, 9, "float32", False)
+    st.put("blockwise_attention", list(key2), {"config": "banana"})
+    assert autotune.lookup("blockwise_attention", key2, 256) == 256
+    assert telemetry.value("autotune_fallback_total",
+                           {"reason": "invalid_config"}) == 1
+
+
+def test_lookup_corrupt_record_counts_fallback(tmp_path):
+    autotune.enable("on", root=str(tmp_path / "store"))
+    st = autotune.get_store()
+    key = [1, 1024, 1]
+    st.put("allreduce_bucket", key, {"config": 1 << 20})
+    d = _rec_dir(st, "allreduce_bucket", key)
+    with open(os.path.join(d, RECORD), "r+b") as f:
+        f.write(b"\x00\x00")
+    assert autotune.lookup("allreduce_bucket", tuple(key),
+                           4 << 20) == 4 << 20
+    assert telemetry.value("autotune_fallback_total",
+                           {"reason": "store_corrupt"}) == 1
+    assert telemetry.value("autotune_store_quarantine_total") == 1
+
+
+def test_lookup_memoized_per_process(tmp_path):
+    autotune.enable("on", root=str(tmp_path / "store"))
+    st = autotune.get_store()
+    key = (2, 2048, 1)
+    st.put("allreduce_bucket", list(key), {"config": 2 << 20})
+    assert autotune.lookup("allreduce_bucket", key, 4 << 20) == 2 << 20
+    # a second lookup never touches the store (memo) — prove it by
+    # wrecking the record on disk
+    import shutil
+
+    shutil.rmtree(_rec_dir(st, "allreduce_bucket", list(key)))
+    assert autotune.lookup("allreduce_bucket", key, 4 << 20) == 2 << 20
+    autotune.invalidate_cache("allreduce_bucket", list(key))
+    assert autotune.lookup("allreduce_bucket", key, 4 << 20) == 4 << 20
+
+
+# ---------------------------------------------------------------------------
+# measured search + numerics guard
+# ---------------------------------------------------------------------------
+
+def test_tune_allreduce_bucket_persists_winner(tmp_path):
+    autotune.enable("search", root=str(tmp_path / "store"))
+    key = (16, 4 << 20, 1)
+    res = autotune.tune("allreduce_bucket", key, budget_ms=30000,
+                        repeats=3, warmup=1)
+    assert res.committed
+    assert res.winner_ms <= res.default_ms
+    assert any(c["status"] == "ok" for c in res.candidates)
+    # the consumer hook sees the winner
+    from mxnet_tpu.kvstore import collective
+
+    sizes = [(4 << 20 >> 4, "float32")] * 16
+    bb, prov = collective.tuned_bucket_bytes(sizes, world=1)
+    assert prov == "tuned" and bb == res.winner
+
+
+def test_tune_numerics_guard_rejects(tmp_path):
+    """blockwise_attention block_k candidates change the online-softmax
+    accumulation partition: the guard must reject them (counted), and
+    the winner stays the default."""
+    autotune.enable("search", root=str(tmp_path / "store"))
+    key = (1, 2, 256, 256, 16, "float32", False)
+    res = autotune.tune("blockwise_attention", key, budget_ms=60000,
+                        repeats=2, warmup=1)
+    assert res.winner == res.default_config == 256
+    rejected = [c for c in res.candidates
+                if c["status"] == "rejected_numerics"]
+    assert rejected, res.candidates
+    assert telemetry.value(
+        "autotune_reject_total",
+        {"site": "blockwise_attention", "reason": "numerics"}) \
+        == len(rejected)
+
+
+def test_tune_budget_skips_candidates(tmp_path):
+    autotune.enable("search", root=str(tmp_path / "store"))
+    res = autotune.tune("allreduce_bucket", (16, 4 << 20, 1),
+                        budget_ms=0.0, repeats=1, warmup=0)
+    # default always measured; every candidate skipped
+    assert res.default_ms is not None
+    assert res.winner == res.default_config
+    assert res.budget_exhausted
+    assert all(c["status"] == "skipped" for c in res.candidates)
+
+
+def test_tune_structural_site_refused(tmp_path):
+    autotune.enable("search", root=str(tmp_path / "store"))
+    with pytest.raises(MXNetError, match="structural"):
+        autotune.tune("decode_bucket", (4,))
+    with pytest.raises(MXNetError, match="unknown autotune site"):
+        autotune.tune("not_a_site", (1,))
+
+
+def test_measure_trimmed_mean():
+    assert measure_mod._trimmed_mean([5.0]) == 5.0
+    assert measure_mod._trimmed_mean([1.0, 100.0, 2.0, 3.0]) == 2.5
+
+
+# ---------------------------------------------------------------------------
+# cost model
+# ---------------------------------------------------------------------------
+
+def test_cost_model_cold_is_exhaustive(tmp_path):
+    st = _store(tmp_path)
+    from mxnet_tpu.autotune.space import get_site
+
+    site = get_site("allreduce_bucket")
+    cands = [1 << 20, 2 << 20, 4 << 20, 8 << 20]
+    kept = CostModel(st).prune(site, (8, 4 << 20, 1), cands, keep=2)
+    assert kept == cands  # cold model never narrows the grid
+
+
+def test_cost_model_prunes_when_warm(tmp_path):
+    st = _store(tmp_path)
+    from mxnet_tpu.autotune.space import get_site
+
+    site = get_site("allreduce_bucket")
+    st.put("allreduce_bucket", [8, 4 << 20, 1], {
+        "config": 8 << 20, "ms": 1.0,
+        "default_config": 4 << 20, "default_ms": 2.0,
+        "candidates": [
+            {"config": 1 << 20, "ms": 9.0, "status": "ok"},
+            {"config": 2 << 20, "ms": 5.0, "status": "ok"},
+            {"config": 8 << 20, "ms": 1.0, "status": "ok"},
+        ]})
+    model = CostModel(st)
+    assert model.records_for("allreduce_bucket") == 1
+    # same workload family, 2x the bytes: predictions order the grid
+    cands = [1 << 20, 2 << 20, 8 << 20]
+    kept = model.prune(site, (8, 8 << 20, 1), cands, keep=2)
+    assert kept == [8 << 20, 2 << 20]
+    p = model.predict(site, (8, 8 << 20, 1), 8 << 20)
+    assert p is not None and p > 0
+    assert model.predict(site, (8, 8 << 20, 1), 3 << 20) is None
+
+
+# ---------------------------------------------------------------------------
+# consumer hooks: off = bit-and-perf identical to the literals
+# ---------------------------------------------------------------------------
+
+def test_registered_defaults_are_todays_literals():
+    from mxnet_tpu.autotune.space import get_site
+    from mxnet_tpu.ops import pallas_attention as pa
+
+    assert pa.DEFAULT_BLOCK_Q == 512 and pa.DEFAULT_BLOCK_K == 512
+    assert pa.DEFAULT_BLOCKWISE_K == 256
+    key = (1, 2, 1024, 1024, 64, "float32", False)
+    assert get_site("flash_attention").default_config(key) == [512, 512]
+    assert get_site("blockwise_attention").default_config(key) == 256
+    assert get_site("conv_layout").default_config(
+        (1, 3, 8, 8, 4, 3, 3, 1, "float32")) == "NCHW"
+    assert get_site("bn_stat_dtype").default_config(
+        (2, 3, 4, 4, 1, "float32")) == "float32"
+
+
+def test_attention_off_bit_identical_to_explicit_blocks():
+    """MXNET_AUTOTUNE=0: block_q/block_k=None must resolve to exactly
+    the old literals — outputs bitwise equal to explicitly passing
+    them."""
+    from mxnet_tpu.ops import pallas_attention as pa
+
+    rng = np.random.default_rng(0)
+    q = rng.standard_normal((1, 2, 128, 16)).astype("float32")
+    k = rng.standard_normal((1, 2, 128, 16)).astype("float32")
+    v = rng.standard_normal((1, 2, 128, 16)).astype("float32")
+    assert autotune.mode() == "off"
+    out_default = np.asarray(pa.blockwise_attention(q, k, v))
+    out_explicit = np.asarray(pa.blockwise_attention(q, k, v,
+                                                     block_k=256))
+    assert out_default.tobytes() == out_explicit.tobytes()
+    f_default = np.asarray(pa.flash_attention(q, k, v))
+    f_explicit = np.asarray(pa.flash_attention(q, k, v, block_q=512,
+                                               block_k=512))
+    assert f_default.tobytes() == f_explicit.tobytes()
+
+
+def test_attention_tuned_lookup_consumed(tmp_path):
+    """A stored flash winner is picked up by the None-default call and
+    still bit-matches (the guard guarantees winners preserve
+    numerics; here the winner is the default's clamped twin)."""
+    autotune.enable("on", root=str(tmp_path / "store"))
+    st = autotune.get_store()
+    key = [1, 2, 128, 128, 16, "float32", False]
+    st.put("flash_attention", key, {"config": [128, 128]})
+    st.put("blockwise_attention", key, {"config": 128})
+    from mxnet_tpu.ops import pallas_attention as pa
+
+    rng = np.random.default_rng(0)
+    q = rng.standard_normal((1, 2, 128, 16)).astype("float32")
+    k = rng.standard_normal((1, 2, 128, 16)).astype("float32")
+    v = rng.standard_normal((1, 2, 128, 16)).astype("float32")
+    tuned = np.asarray(pa.flash_attention(q, k, v))
+    explicit = np.asarray(pa.flash_attention(q, k, v, block_q=128,
+                                             block_k=128))
+    assert tuned.tobytes() == explicit.tobytes()
+    bw_tuned = np.asarray(pa.blockwise_attention(q, k, v))
+    bw_explicit = np.asarray(pa.blockwise_attention(q, k, v,
+                                                    block_k=128))
+    assert bw_tuned.tobytes() == bw_explicit.tobytes()
+    assert telemetry.value("autotune_lookup_total",
+                           {"site": "flash_attention",
+                            "result": "tuned"}) >= 1
+
+
+def test_conv_and_bn_hooks_default_identity(tmp_path):
+    """conv_layout / bn_stat_dtype: autotune ON with an empty store
+    must still produce byte-identical outputs to autotune OFF."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.nn import batch_norm, convolution
+
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((2, 3, 8, 8)).astype("float32")
+    w = rng.standard_normal((4, 3, 3, 3)).astype("float32")
+    gamma = rng.standard_normal((3,)).astype("float32")
+    beta = rng.standard_normal((3,)).astype("float32")
+    mean = np.zeros((3,), "float32")
+    var = np.ones((3,), "float32")
+    off_conv = np.asarray(convolution(x, w))
+    off_bn = [np.asarray(a) for a in batch_norm(
+        x, gamma, beta, mean, var, training=True)]
+    autotune.enable("on", root=str(tmp_path / "store"))
+    on_conv = np.asarray(convolution(x, w))
+    on_bn = [np.asarray(a) for a in batch_norm(
+        x, gamma, beta, mean, var, training=True)]
+    assert off_conv.tobytes() == on_conv.tobytes()
+    for a, b in zip(off_bn, on_bn):
+        assert a.tobytes() == b.tobytes()
+    # a tuned NHWC winner changes the internal layout, not the math
+    st = autotune.get_store()
+    st.put("conv_layout", [2, 3, 8, 8, 4, 3, 3, 1, "float32"],
+           {"config": "NHWC"})
+    autotune.invalidate_cache()
+    nhwc = np.asarray(convolution(x, w))
+    assert nhwc.shape == off_conv.shape
+    np.testing.assert_allclose(nhwc, off_conv, rtol=1e-5, atol=1e-5)
+    # bf16 stat dtype visibly changes stats (why the guard rejects it)
+    st.put("bn_stat_dtype", [2, 3, 8, 8, 1, "float32"],
+           {"config": "bfloat16"})
+    autotune.invalidate_cache()
+    bf = [np.asarray(a) for a in batch_norm(
+        x, gamma, beta, mean, var, training=True)]
+    assert bf[0].shape == off_bn[0].shape
+    assert jnp.isfinite(jnp.asarray(bf[0])).all()
+
+
+# ---------------------------------------------------------------------------
+# bucket-size plumbing (satellite: truthful fill normalization)
+# ---------------------------------------------------------------------------
+
+def test_observe_bucket_fill_uses_plan_bucket_bytes():
+    """The fill histogram must normalize against the plan's ACTUAL
+    bucket size, not the env default."""
+    from mxnet_tpu.kvstore import collective
+
+    telemetry.reset()
+    # one 1 MiB bucket against a 1 MiB plan = fill 1.0 (not the 0.25
+    # that normalizing against the 4 MiB env default would report)
+    collective.observe_bucket_fill([1 << 20], bucket_bytes=1 << 20)
+    tot = telemetry.totals()
+    assert tot["allreduce_bucket_fill_count"] == 1
+    assert abs(tot["allreduce_bucket_fill_sum"] - 1.0) < 1e-9
+
+
+def test_observe_bucket_fill_env_not_cached(monkeypatch):
+    from mxnet_tpu.kvstore import collective
+
+    monkeypatch.setenv("MXNET_KVSTORE_BUCKET_BYTES", str(1 << 20))
+    assert collective.default_bucket_bytes() == 1 << 20
+    telemetry.reset()
+    collective.observe_bucket_fill([1 << 20])  # denom from env NOW
+    tot = telemetry.totals()
+    assert abs(tot["allreduce_bucket_fill_sum"] - 1.0) < 1e-9
+    monkeypatch.setenv("MXNET_KVSTORE_BUCKET_BYTES", str(4 << 20))
+    assert collective.default_bucket_bytes() == 4 << 20
+
+
+def test_plan_buckets_tuned_bucket_bytes(tmp_path):
+    from mxnet_tpu.kvstore import collective
+
+    sizes = [(1 << 20, "float32")] * 8
+    bb, prov = collective.tuned_bucket_bytes(sizes, world=1)
+    assert prov == "default" and bb == collective.default_bucket_bytes()
+    autotune.enable("on", root=str(tmp_path / "store"))
+    autotune.get_store().put("allreduce_bucket", [8, 8 << 20, 1],
+                             {"config": 2 << 20})
+    bb, prov = collective.tuned_bucket_bytes(sizes, world=1)
+    assert (bb, prov) == (2 << 20, "tuned")
+    plan = collective.plan_buckets(sizes, bucket_bytes=bb)
+    assert len(plan) == 4  # 8 MiB at 2 MiB buckets
+
+
+def test_step_capture_reports_tuned_plan(tmp_path):
+    """The captured step's report carries the plan's bucket size and
+    its provenance; a tuned winner reshapes the plan."""
+    from mxnet_tpu.gluon import nn
+
+    def build():
+        mx.random.seed(0)
+        net = nn.Dense(8, in_units=8)
+        net.initialize()
+        net.hybridize()
+        trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                                   {"learning_rate": 0.1})
+        return net, trainer
+
+    def loss_fn(out, label):
+        return ((out - label) ** 2).mean()
+
+    x = mx.nd.ones((2, 8))
+    y = mx.nd.zeros((2, 8))
+
+    net, trainer = build()
+    prog = trainer.capture(net, loss_fn)
+    prog(x, y)
+    rep = prog.report()["programs"][0]
+    assert rep["bucket_bytes_provenance"] == "default"
+    from mxnet_tpu.kvstore import collective
+
+    assert rep["bucket_bytes"] == collective.default_bucket_bytes()
+
+    autotune.enable("on", root=str(tmp_path / "store"))
+    total = sum(p.data().size * p.data().dtype.itemsize
+                for p in net.collect_params().values())
+    autotune.get_store().put("allreduce_bucket", [2, int(total), 1],
+                             {"config": 1 << 10})
+    net2, trainer2 = build()
+    prog2 = trainer2.capture(net2, loss_fn)
+    prog2(x, y)
+    rep2 = prog2.report()["programs"][0]
+    assert rep2["bucket_bytes_provenance"] == "tuned"
+    assert rep2["bucket_bytes"] == 1 << 10
+
+
+# ---------------------------------------------------------------------------
+# decode bucket site
+# ---------------------------------------------------------------------------
+
+def test_decode_config_tuned_bucket_table(tmp_path):
+    from mxnet_tpu import serve
+
+    cfg = serve.DecodeConfig(max_live=4, max_context=16,
+                             prefill_lengths=(8,))
+    assert cfg.batch_sizes == (1, 2, 4)  # untuned default
+    autotune.enable("on", root=str(tmp_path / "store"))
+    autotune.get_store().put("decode_bucket", [4], {"config": [4]})
+    cfg2 = serve.DecodeConfig(max_live=4, max_context=16,
+                              prefill_lengths=(8,))
+    assert cfg2.batch_sizes == (4,)
+    # an invalid tuned set (doesn't cover max_live) degrades + counts
+    autotune.get_store().put("decode_bucket", [8], {"config": [2, 4]})
+    autotune.invalidate_cache()
+    cfg3 = serve.DecodeConfig(max_live=8, max_context=16,
+                              prefill_lengths=(8,))
+    assert cfg3.batch_sizes == (1, 2, 4, 8)
+    assert telemetry.value("autotune_fallback_total",
+                           {"reason": "invalid_config"}) == 1
+
+
+def test_decode_bucket_site_candidates_cover_max_live():
+    from mxnet_tpu.autotune.space import get_site
+
+    site = get_site("decode_bucket")
+    for key in [(1,), (4,), (6,), (8,)]:
+        for cand in site.candidates(key):
+            assert site.validate(key, cand), (key, cand)
+        assert site.validate(key, site.default_config(key))
+    assert not site.validate((8,), [1, 2])
+    assert not site.validate((8,), [])
+    assert not site.validate((8,), "nope")
+
+
+# ---------------------------------------------------------------------------
+# winners table (diagnose surface)
+# ---------------------------------------------------------------------------
+
+def test_winners_table(tmp_path):
+    autotune.enable("on", root=str(tmp_path / "store"))
+    st = autotune.get_store()
+    st.put("allreduce_bucket", [4, 1 << 20, 1],
+           {"config": 2 << 20, "ms": 1.0, "default_config": 4 << 20,
+            "default_ms": 2.0})
+    # one corrupt record -> quarantined row
+    st.put("blockwise_attention", [7], {"config": 128})
+    d = _rec_dir(st, "blockwise_attention", [7])
+    with open(os.path.join(d, RECORD), "r+b") as f:
+        f.write(b"\x00")
+    st.get("blockwise_attention", [7])  # triggers quarantine
+    rows = autotune.winners()
+    provs = sorted(r["provenance"] for r in rows)
+    assert provs == ["quarantined", "tuned"]

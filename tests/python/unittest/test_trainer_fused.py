@@ -416,7 +416,7 @@ def test_acceptance_50_param_model_program_counts():
     total = sum(n for n, _ in grads)
     plan = collective.plan_buckets(grads)
     assert len(plan) <= max(1, math.ceil(
-        total / float(collective._BUCKET_BYTES)))
+        total / float(collective.default_bucket_bytes())))
     # fused-vs-eager parity on the same 50-param model
     w_fused = {k: p.data().asnumpy() for k, p in
                net.collect_params().items()}

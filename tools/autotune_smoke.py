@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""mx.autotune end-to-end smoke (the `make autotune-smoke` target).
+
+Exercises the cross-process tuned-config contract in one shot:
+
+1. process A (MXNET_AUTOTUNE=search) tunes the ``allreduce_bucket``
+   and ``blockwise_attention`` sites on CPU: winners measured (with
+   the bitwise numerics guard rejecting any candidate that changes
+   results) and durably committed to the TuningStore;
+2. process B (fresh interpreter, MXNET_AUTOTUNE=1) picks the winners
+   up with ZERO re-measurement (``autotune_measure_total`` == 0,
+   ``autotune_lookup_total{result=tuned}`` >= 1) and its consumer
+   outputs are bit-identical to the untuned defaults';
+3. one record is corrupted on disk: process C quarantines it and
+   degrades to the hand-set default with ``autotune_fallback_total``
+   counted — never an error;
+4. the store dir is removed entirely: the same run still completes on
+   defaults.
+
+Exits non-zero (and prints the failing stage) on any violation.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# ~1 MiB of gradients over 16 arrays — small enough that the whole
+# sweep takes a couple of seconds on CPU, big enough that bucket-size
+# deltas are real
+AR_KEY = "[16, %d, 1]" % (1 << 20)
+BW_KEY = '[1, 2, 256, 256, 16, "float32", false]'
+
+WORKER = r"""
+import json, sys
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autotune, telemetry
+from mxnet_tpu.ops import pallas_attention as pa
+
+do_tune = len(sys.argv) > 1 and sys.argv[1] == "tune"
+ar_key = tuple(json.loads(%(ar_key)r))
+bw_key = tuple(json.loads(%(bw_key)r))
+
+report = {"mode": autotune.mode()}
+if do_tune:
+    ar = autotune.tune("allreduce_bucket", ar_key, budget_ms=30000,
+                       repeats=3, warmup=1)
+    bw = autotune.tune("blockwise_attention", bw_key, budget_ms=60000,
+                       repeats=2, warmup=1)
+    report["ar"] = ar.as_dict()
+    report["bw"] = bw.as_dict()
+
+# the consumer path: blockwise_attention resolves block_k through the
+# lookup; the explicit hand-set literal is the reference
+rng = np.random.default_rng(0)
+q = rng.standard_normal((1, 2, 256, 16)).astype("float32")
+k = rng.standard_normal((1, 2, 256, 16)).astype("float32")
+v = rng.standard_normal((1, 2, 256, 16)).astype("float32")
+tuned_out = np.asarray(pa.blockwise_attention(q, k, v))
+default_out = np.asarray(pa.blockwise_attention(q, k, v, block_k=256))
+report["bit_identical"] = tuned_out.tobytes() == default_out.tobytes()
+
+# and the bucket-size consumer
+from mxnet_tpu.kvstore import collective
+sizes = [((1 << 20) // 16, "float32")] * 16
+bb, prov = collective.tuned_bucket_bytes(sizes, world=1)
+report["bucket_bytes"] = bb
+report["bucket_prov"] = prov
+
+tot = telemetry.totals()
+report.update({
+    "measured": tot.get("autotune_measure_total", 0),
+    "lookups_tuned": telemetry.value(
+        "autotune_lookup_total", {"result": "tuned"}),
+    "lookups_default": telemetry.value(
+        "autotune_lookup_total", {"result": "default"}),
+    "fallbacks": tot.get("autotune_fallback_total", 0),
+    "quarantined": tot.get("autotune_store_quarantine_total", 0),
+    "commits": tot.get("autotune_store_commits_total", 0),
+})
+st = autotune.get_store()
+report["records"] = sorted(s for s, _k, _r in (st.records() if st
+                                               else []))
+print(json.dumps(report))
+""" % {"ar_key": AR_KEY, "bw_key": BW_KEY}
+
+
+def run_worker(store_dir, mode, tune=False):
+    env = dict(os.environ, MXNET_AUTOTUNE=mode,
+               MXNET_AUTOTUNE_DIR=store_dir,
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+               PYTHONPATH=REPO)
+    argv = [sys.executable, "-c", WORKER] + (["tune"] if tune else [])
+    out = subprocess.run(argv, env=env, capture_output=True, text=True,
+                         timeout=900)
+    if out.returncode != 0:
+        print(out.stdout)
+        print(out.stderr)
+        raise AssertionError("worker process failed (mode=%s)" % mode)
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main():
+    store_dir = tempfile.mkdtemp(prefix="mx-autotune-smoke-")
+
+    a = run_worker(store_dir, "search", tune=True)
+    assert a["measured"] >= 2, \
+        "stage 1: the search measured nothing: %r" % (a,)
+    assert a["records"] == ["allreduce_bucket", "blockwise_attention"], \
+        "stage 1: winners not persisted: %r" % (a["records"],)
+    assert a["bit_identical"], \
+        "stage 1: tuned consumer output != untuned default"
+    assert a["bw"]["config"] == 256, \
+        "stage 1: blockwise winner %r should stay the default (every " \
+        "block_k candidate changes the softmax accumulation " \
+        "partition -> numerics guard)" % (a["bw"]["config"],)
+    rejected = [c for c in a["bw"]["candidates"]
+                if c["status"] == "rejected_numerics"]
+    print("process A    : tuned 2 sites — allreduce_bucket winner "
+          "%d KiB (default %d KiB, %.2fms -> %.2fms), blockwise "
+          "guard rejected %d candidate(s), %d records committed"
+          % (a["ar"]["config"] >> 10, a["ar"]["default_config"] >> 10,
+             a["ar"]["default_ms"], a["ar"]["ms"], len(rejected),
+             a["commits"]))
+
+    b = run_worker(store_dir, "1")
+    assert b["measured"] == 0, \
+        "stage 2: a fresh process re-measured (%r) instead of " \
+        "loading the store" % (b["measured"],)
+    assert b["lookups_tuned"] >= 1, \
+        "stage 2: no tuned lookup served: %r" % (b,)
+    assert b["bucket_prov"] == "tuned" and \
+        b["bucket_bytes"] == a["ar"]["config"], \
+        "stage 2: bucket consumer got %r/%r, wanted tuned %r" \
+        % (b["bucket_bytes"], b["bucket_prov"], a["ar"]["config"])
+    assert b["bit_identical"], \
+        "stage 2: tuned consumer output != untuned default"
+    assert b["fallbacks"] == 0 and b["quarantined"] == 0
+    print("process B    : fresh interpreter served tuned configs with "
+          "0 re-measurements, outputs bit-identical to defaults")
+
+    records = []
+    for root, _dirs, files in os.walk(store_dir):
+        records.extend(os.path.join(root, f) for f in files
+                       if f == "RECORD.json")
+    assert records, "no RECORD.json found to corrupt"
+    with open(sorted(records)[0], "r+b") as f:
+        f.seek(2)
+        f.write(b"\xde\xad\xbe\xef")
+    print("corrupt      : flipped 4 bytes in %s"
+          % os.path.relpath(sorted(records)[0], store_dir))
+
+    c = run_worker(store_dir, "1")
+    assert c["quarantined"] >= 1, \
+        "stage 3: corrupt record was not quarantined: %r" % (c,)
+    assert c["fallbacks"] >= 1, \
+        "stage 3: degrade-to-default was not counted in " \
+        "autotune_fallback_total: %r" % (c,)
+    assert c["bit_identical"], \
+        "stage 3: degraded run produced wrong outputs"
+    print("process C    : corrupt record quarantined, fallback "
+          "counted (%d), run completed on defaults"
+          % c["fallbacks"])
+
+    shutil.rmtree(store_dir)
+    d = run_worker(store_dir, "1")
+    assert d["bit_identical"] and d["measured"] == 0, \
+        "stage 4: store-less run misbehaved: %r" % (d,)
+    assert d["bucket_prov"] == "default"
+    print("process D    : store dir removed — clean run on hand-set "
+          "defaults")
+
+    shutil.rmtree(store_dir, ignore_errors=True)
+    print("autotune-smoke OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -394,12 +394,12 @@ def trainer_info():
     print("bucket plan  : %d collective program(s) for %.1f KiB grads "
           "(bucket=%.1f MiB)"
           % (len(plan), total / 1024.0,
-             collective._BUCKET_BYTES / 1048576.0))
+             collective.default_bucket_bytes() / 1048576.0))
     for b, idxs in enumerate(plan):
         nbytes = sum(grads[i][0] for i in idxs)
         print("  bucket %d   : %3d key(s)  %10.1f KiB  fill %5.1f%%"
               % (b, len(idxs), nbytes / 1024.0,
-                 100.0 * nbytes / collective._BUCKET_BYTES))
+                 100.0 * nbytes / collective.default_bucket_bytes()))
     tot = {k: v for k, v in telemetry.totals(nonzero=True).items()
            if k.startswith("trainer_")}
     print("telemetry    : %s" % (tot or "(telemetry disabled)"))
@@ -471,9 +471,12 @@ def step_info():
         print("  donation   :")
         for name, d in prog["donation"].items():
             print("    %-20s %s" % (name, d))
-        print("  bucket plan: %d bucket(s) %s"
+        print("  bucket plan: %d bucket(s) %s  bucket_bytes=%.1f MiB "
+              "(%s)"
               % (len(prog["bucket_plan"]),
-                 [len(b) for b in prog["bucket_plan"]]))
+                 [len(b) for b in prog["bucket_plan"]],
+                 prog.get("bucket_bytes", 0) / 1048576.0,
+                 prog.get("bucket_bytes_provenance", "default")))
     if rep["fallbacks"]:
         print("fallbacks    :")
         for f in rep["fallbacks"]:
@@ -663,6 +666,60 @@ def monitor_info(src):
     print("telemetry    : %s" % (tot or "(no monitor_* activity)"))
 
 
+def autotune_info():
+    """Audit mx.autotune: mode, store location/health, and the
+    per-site winner table with provenance (tuned / default /
+    quarantined) plus this process's lookup/fallback telemetry."""
+    section("Autotune")
+    from mxnet_tpu import autotune, telemetry
+    from mxnet_tpu.base import get_env
+
+    print("mode         :", autotune.mode(),
+          "" if autotune.is_enabled() else
+          "(set MXNET_AUTOTUNE=1|search)")
+    print("dir          :", get_env("MXNET_AUTOTUNE_DIR", str, None)
+          or autotune.default_store_dir())
+    st = autotune.get_store() if autotune.is_enabled() else None
+    if st is None and not autotune.is_enabled():
+        # a read-only audit should work even with the feature off
+        try:
+            st = autotune.TuningStore()
+        except Exception:
+            st = None
+    stats = st.stats() if st is not None else {}
+    print("env fp       :", stats.get("env_fingerprint") or "(unavailable)")
+    rows = []
+    if st is not None:
+        for site_name, kh, rec in st.records():
+            rows.append((site_name, "tuned", rec.get("key"),
+                         rec.get("config"), rec.get("ms"),
+                         rec.get("default_ms")))
+    tuned_sites = {r[0] for r in rows}
+    for name, site in sorted(autotune.sites().items()):
+        if name not in tuned_sites:
+            rows.append((name, "default", None, None, None, None))
+    if st is not None:
+        for q in st.quarantined():
+            parts = q.split(os.sep)
+            rows.append((parts[-2] if len(parts) >= 2 else "?",
+                         "quarantined", None, None, None, None))
+    print("winners      : %d tuned record(s), %d site(s) registered"
+          % (len(tuned_sites), len(autotune.sites())))
+    print("  %-20s %-12s %-10s %-10s %s"
+          % ("site", "provenance", "ms", "default", "config / key"))
+    for site_name, prov, key, cfg, ms, dms in sorted(rows):
+        print("  %-20s %-12s %-10s %-10s %s"
+              % (site_name, prov,
+                 "%.3f" % ms if isinstance(ms, (int, float)) else "-",
+                 "%.3f" % dms if isinstance(dms, (int, float)) else "-",
+                 "%s @ %s" % (cfg, key) if cfg is not None else
+                 "(hand-set literal)"))
+    tot = {k: v for k, v in telemetry.totals(nonzero=True).items()
+           if k.startswith("autotune_")}
+    print("telemetry    : %s" % (tot or "(no autotune activity "
+                                 "this process)"))
+
+
 def compile_cache_info():
     """Audit the mx.compile persistent compilation cache: directory,
     entry count, total bytes, per-entry age/size, quarantined entries,
@@ -844,6 +901,10 @@ def main():
                     help="audit the imperative Trainer's multi-tensor "
                          "update engine: group table, programs/step, "
                          "collective bucket fill")
+    ap.add_argument("--autotune", action="store_true",
+                    help="audit mx.autotune: mode, TuningStore "
+                         "health, and the per-site winner table with "
+                         "provenance (tuned/default/quarantined)")
     ap.add_argument("--step", action="store_true",
                     help="audit mx.step whole-step capture: capture a "
                          "representative program and print segments, "
@@ -875,9 +936,11 @@ def main():
     # (each skips the environment dump, all honor --telemetry)
     if args.compile_cache or args.serve or args.checkpoints or \
             args.trainer or args.step or args.trace or args.monitor or \
-            args.resilience or args.dist is not None:
+            args.resilience or args.autotune or args.dist is not None:
         if args.compile_cache:
             compile_cache_info()
+        if args.autotune:
+            autotune_info()
         if args.resilience:
             resilience_info()
         if args.dist is not None:

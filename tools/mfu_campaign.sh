@@ -39,6 +39,16 @@ while true; do
       > "$OUT/loader_scaling.txt" 2>&1
     timeout 900 python benchmark/data_bench.py --train \
       > "$OUT/loader_train.txt" 2>&1
+    # mx.autotune hypothesis capture: tune every measurable site at
+    # TPU keys into a persistent store, then print the winner table
+    # (PERF_PLAN section 4 TPU columns)
+    MXNET_AUTOTUNE=search MXNET_AUTOTUNE_DIR="$OUT/autotune" \
+      timeout 1200 python tools/autotune_capture.py \
+      > "$OUT/autotune.txt" 2>&1
+    echo "$(date -u +%FT%TZ) autotune capture rc=$?" >> "$LOG"
+    MXNET_AUTOTUNE=1 MXNET_AUTOTUNE_DIR="$OUT/autotune" \
+      timeout 300 python tools/diagnose.py --autotune \
+      >> "$OUT/autotune.txt" 2>&1
     echo "$(date -u +%FT%TZ) campaign COMPLETE" >> "$LOG"
     touch "$OUT/DONE"
     exit 0

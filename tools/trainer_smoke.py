@@ -123,10 +123,10 @@ def main():
               str(p.grad().dtype)) for p in trainer._params]
     total = sum(n for n, _ in grads)
     plan = collective.plan_buckets(grads)
-    bound = max(1, math.ceil(total / float(collective._BUCKET_BYTES)))
+    bound = max(1, math.ceil(total / float(collective.default_bucket_bytes())))
     assert len(plan) <= bound, \
         "bucket plan %d exceeds ceil(%d/%d)=%d programs" \
-        % (len(plan), total, collective._BUCKET_BYTES, bound)
+        % (len(plan), total, collective.default_bucket_bytes(), bound)
     print("[trainer-smoke] bucket plan: %d program(s) for %.1f KiB "
           "(bound %d)" % (len(plan), total / 1024.0, bound))
     print("[trainer-smoke] OK")
