@@ -12,7 +12,7 @@ SO := build/libmxtpu_native.so
 .PHONY: native test cpptest telemetry-smoke checkpoint-smoke serve-smoke \
 	decode-smoke compile-cache-smoke trainer-smoke step-smoke \
 	trace-smoke monitor-smoke faults-smoke dist-faults-smoke \
-	zero-smoke autotune-smoke smoke-all clean
+	zero-smoke autotune-smoke data-smoke smoke-all clean
 
 native: $(SO)
 
@@ -143,6 +143,21 @@ zero-smoke:
 	JAX_PLATFORMS=cpu python -m pytest \
 	  tests/python/unittest/test_shard.py -q -m 'not slow'
 
+# mx.data streaming input pipeline drills: loader-fed captured-step
+# loop with the prefetch ring armed runs within 5% of the pre-staged
+# reference (batch-wait p99 <= 5% of step, telemetry-asserted — the
+# PERF_PLAN H3 bound); mid-epoch trainer-checkpoint resume replays
+# the exact remaining sample order; injected data_read io fault
+# retried with the stream intact; preemption drain reaps loader
+# threads AND gluon worker processes; 2-rank launch.py world killed
+# mid-epoch relaunches and resumes the stream bit-identically from
+# the max-common-committed pod step; then the subsystem's pytest
+# suite
+data-smoke:
+	JAX_PLATFORMS=cpu python tools/data_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/python/unittest/test_data_stream.py -q -m 'not slow'
+
 # mx.dist coordinated fault drills (2 local CPU processes over
 # tools/launch.py): rank SIGKILLed mid-step -> DistTimeout within the
 # deadline -> whole-world restart resumes bit-identically from the max
@@ -173,7 +188,7 @@ autotune-smoke:
 smoke-all: telemetry-smoke checkpoint-smoke serve-smoke decode-smoke \
 	compile-cache-smoke trainer-smoke step-smoke trace-smoke \
 	monitor-smoke faults-smoke zero-smoke autotune-smoke \
-	dist-faults-smoke
+	data-smoke dist-faults-smoke
 
 # suite summary artifact (TESTS_r{N}.json) — round-2 advisor contract
 test-report:

@@ -89,6 +89,7 @@ from . import resilience  # noqa: E402  (fault injection + preempt + supervisor)
 from . import dist  # noqa: E402  (multi-host membership + pod checkpoints)
 from . import shard  # noqa: E402  (global mesh + ZeRO weight-update sharding)
 from . import step  # noqa: E402  (whole-program training-step capture)
+from . import data  # noqa: E402  (sharded streaming input pipeline)
 from . import elastic  # noqa: E402  (failure detection + auto-resume)
 from . import config  # noqa: E402  (env-var registry, reference env_var.md)
 from . import subgraph  # noqa: E402  (SubgraphProperty partitioner hooks)

@@ -21,6 +21,9 @@ Sites (PERF_PLAN hypothesis in parens):
 - ``serve_bucket``        — serve bucket latency table (structural:
                             recorded by ModelRunner's idle tuner; cost
                             model / diagnose data, not a lookup knob)
+- ``data_prefetch``       — mx.data ring depth + reader workers
+                            (structural: order-preserving by
+                            construction, measured end-to-end)
 
 Measurable sites benchmark with DETERMINISTIC seeded inputs and return
 host numpy outputs so the measure harness can enforce the numerics
@@ -491,6 +494,58 @@ class _DecodeBucket(TuningSite):
             "decode_bucket is a structural site: it is measured by the "
             "decode runner's idle tuner (warm_up under "
             "MXNET_AUTOTUNE=search), not by measure.tune()")
+
+
+@register_site
+class _DataPrefetch(TuningSite):
+    """mx.data prefetch ring depth + reader worker count.
+    key = (local_batch, approx_record_bytes).  Order-preserving by
+    construction — depth and worker count change WHEN batches are
+    read/staged, never WHICH samples ride which batch (the epoch
+    order is a pure function of (seed, epoch)) — so the numerics
+    guard is trivially satisfied and parity is structural, like
+    ``decode_bucket``.  Winners are committed by the bench sweep /
+    an explicit store put; ``StreamLoader`` consumes them whenever
+    ``num_workers``/``prefetch`` are left unset."""
+
+    name = "data_prefetch"
+    doc = "streaming loader ring depth + reader workers (structural)"
+    parity = "structural"
+
+    def default_config(self, key):
+        # the ONE source of truth for both knobs lives in mx.data
+        from ..data.loader import default_workers
+        from ..data.ring import default_depth
+
+        return {"depth": default_depth(), "workers": default_workers()}
+
+    def candidates(self, key):
+        out = []
+        for depth in (2, 3, 4, 8):
+            for workers in (1, 2, 4):
+                out.append({"depth": depth, "workers": workers})
+        return out
+
+    def validate(self, key, config):
+        try:
+            return int(config["depth"]) >= 1 and \
+                int(config["workers"]) >= 1
+        except (TypeError, KeyError, ValueError):
+            return False
+
+    def features(self, key):
+        import math
+
+        return [math.log2(max(1, int(key[0]))),
+                math.log2(max(1, int(key[1])))]
+
+    def make_bench(self, key, config):
+        from ..base import MXNetError
+
+        raise MXNetError(
+            "data_prefetch is a structural site: ring depth/worker "
+            "count are measured end-to-end (benchmark/data_bench.py "
+            "--train, tools/data_smoke.py), not by measure.tune()")
 
 
 @register_site

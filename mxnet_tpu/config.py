@@ -188,7 +188,8 @@ ENV_VARS = {
         "separated site@key[:kind][*count] entries (sites: "
         "trainer_step / collective / checkpoint_commit / "
         "checkpoint_marker / compile_commit / serve_dispatch / "
-        "serve_poison / step_capture; kinds: transient / io / fatal / "
+        "serve_poison / step_capture / data_read; kinds: transient / "
+        "io / fatal / "
         "abort).  Faults fire by (site, sequence), so every drill "
         "replays identically (resilience/inject.py).  The "
         "serve_dispatch and serve_poison sites also fire on the "
@@ -219,6 +220,25 @@ ENV_VARS = {
         "each replica's slice feeds its devices; 'replicate' gives "
         "every replica the whole batch (drill/debug mode).  A batch "
         "not divisible by dp falls back to replicate."),
+    "MXNET_DATA_PREFETCH": (
+        int, 2,
+        "mx.data prefetch ring depth: batches asynchronously staged "
+        "onto their device/mesh shardings ahead of the training loop "
+        "(data/ring.py; the PERF_PLAN H3 fix).  >= 2 keeps captured-"
+        "step dispatch off the H2D critical path; also tunable via "
+        "the data_prefetch autotune site."),
+    "MXNET_DATA_WORKERS": (
+        int, 2,
+        "Reader worker threads per host in mx.data.StreamLoader "
+        "(shard read + decode + batchify; data/reader.py).  Raise it "
+        "when data_ring_stalls_total climbs."),
+    "MXNET_DATA_ALLOW_UNSHARDED": (
+        bool, False,
+        "Allow legacy whole-dataset iterators (io.ImageRecordIter, "
+        "contrib.io.DataLoaderIter) in a multi-host world, where each "
+        "host would read the FULL dataset and silently duplicate "
+        "every sample world-times per epoch.  Off by default: those "
+        "iterators raise and name mx.data.StreamLoader instead."),
     "MXNET_STEP_CAPTURE": (
         bool, True,
         "Kill switch for mx.step whole-program training-step capture: "

@@ -13,6 +13,13 @@ class DataLoaderIter(DataIter):
 
     def __init__(self, loader, data_name="data", label_name="softmax_label",
                  dtype="float32"):
+        from ..data import require_sharded
+
+        # a gluon DataLoader iterates the WHOLE dataset: in a multi-
+        # host world that silently bypasses sharding (every host sees
+        # every sample) — refuse, naming the sharded replacement
+        require_sharded("contrib.io.DataLoaderIter over a gluon "
+                        "DataLoader")
         sampler = getattr(loader, "_batch_sampler", None)
         batch_size = getattr(loader, "_batch_size",
                              getattr(sampler, "_batch_size", 0))

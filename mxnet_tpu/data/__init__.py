@@ -1,0 +1,63 @@
+"""mx.data — sharded streaming input pipeline (ISSUE 15 / ROADMAP 5).
+
+The compute plane is captured and sharded (mx.step + mx.shard), but a
+``gluon.data.DataLoader`` over local files still serialized a blocking
+``device_put`` in front of every captured step — the PERF_PLAN H3
+host-gap.  This package is the production input path that keeps the
+pipeline ahead of the program (Relay's whole-pipeline argument: e2e
+throughput is set by the slowest stitched stage):
+
+- :class:`ShardSet` + :class:`ReaderPool` (reader.py) — per-host
+  reader workers over sharded RecordIO sources, shard assignment
+  derived from the ``(process_index, dp_rank)`` world coordinates so
+  each host reads only its slice;
+- :class:`PrefetchRing` (ring.py) — a device-resident ring that
+  asynchronously stages the next ``MXNET_DATA_PREFETCH`` batches onto
+  their ``GlobalMesh.batch_sharding`` placements while the current
+  step runs, so captured-program dispatch never waits on H2D;
+- :class:`StreamLoader` (loader.py) — the front-end tying them
+  together, with a **deterministic mid-epoch cursor** that rides
+  ``Trainer.state_dict()`` into the ``PodCheckpointManager``: a
+  whole-world restart resumes the exact remaining sample order
+  bit-identically.
+
+``data_*`` telemetry (ring occupancy/stalls, read/decode/stage
+histograms) + ``data_stage``/``data_read_batch`` trace spans make the
+pipeline observable; ``make data-smoke`` drills the H3 bound and the
+mid-epoch world-restart resume on CPU.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError, get_env
+from .loader import StreamLoader, default_workers, live_loaders
+from .reader import (ReaderPool, ShardSet, default_decode, world_coords)
+from .ring import PrefetchRing, default_depth
+
+__all__ = ["StreamLoader", "ShardSet", "ReaderPool", "PrefetchRing",
+           "default_decode", "default_depth", "default_workers",
+           "world_coords", "live_loaders", "require_sharded", "state"]
+
+
+def require_sharded(what):
+    """Guard for legacy whole-dataset iterators: in a multi-host world
+    every host feeding itself the FULL dataset silently breaks
+    data-parallel semantics (each global batch is seen world times).
+    Raises a clear ``MXNetError`` naming the replacement; set
+    ``MXNET_DATA_ALLOW_UNSHARDED=1`` to accept the duplication
+    knowingly (debug/replicated-eval runs)."""
+    num_hosts, _host = world_coords()
+    if num_hosts <= 1:
+        return
+    if get_env("MXNET_DATA_ALLOW_UNSHARDED", bool, False):
+        return
+    raise MXNetError(
+        "%s reads the whole dataset on every host — in this %d-host "
+        "world each sample would be trained %d times per epoch.  Use "
+        "mx.data.StreamLoader (sharded streaming + prefetch ring + "
+        "checkpointed cursor), or set MXNET_DATA_ALLOW_UNSHARDED=1 to "
+        "bypass this check deliberately." % (what, num_hosts, num_hosts))
+
+
+def state():
+    """Snapshot of every live loader for ``tools/diagnose.py --data``."""
+    return [ldr.stats() for ldr in live_loaders()]

@@ -1,0 +1,210 @@
+"""Device-resident prefetch ring — the H3 fix, generalized.
+
+PERF_PLAN hypothesis H3: the captured step program (mx.step) never
+waits on the device, but the loop feeding it did — a blocking
+``device_put`` of every host batch sat between steps, so the bench's
+"pre-staged tensors" mode was faster than any real loader.  The
+:class:`PrefetchRing` closes that gap for streaming input: a stager
+thread pulls host batches from the reader pool and ``device_put``\\ s
+the next K of them onto their TARGET shardings (the same
+``GlobalMesh.batch_sharding`` placement ``step/capture.py`` pins, so
+the captured program's dispatch consumes them without a second copy)
+while the current step runs.  PJRT transfers are asynchronous — the
+ring holds arrays whose copies are still in flight, and the XLA
+program dispatch orders after them on-device, never on the host.
+
+Occupancy/stall gauges prove the ring is doing its job: steady state
+is ``data_ring_occupancy ~ depth`` and a flat
+``data_ring_stalls_total``; a stall means reads or decode (not H2D)
+are the bottleneck — raise ``MXNET_DATA_WORKERS``, not the depth.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time as _time
+
+import numpy as _np
+
+from .. import telemetry as _tel
+from .. import trace as _trace
+from ..base import MXNetError, get_env
+
+__all__ = ["PrefetchRing", "default_depth", "make_placer"]
+
+
+def default_depth():
+    """``MXNET_DATA_PREFETCH`` ring depth (batches staged ahead)."""
+    return max(1, get_env("MXNET_DATA_PREFETCH", int, 2))
+
+
+def make_placer(mesh=None):
+    """Build the stage function host-batch-tuple -> device arrays.
+
+    With a ``GlobalMesh``, every array lands on its
+    ``batch_sharding`` — dp-sharded along axis 0 when the shape
+    divides — via ``device_put`` (single process) or
+    ``make_array_from_process_local_data`` (each host contributes its
+    local slice of the global batch).  Without a mesh, arrays go to
+    the default device.  Either way the result is wrapped in NDArray
+    so downstream code (captured or stitched) is oblivious."""
+    from ..ndarray.ndarray import NDArray
+
+    def place(host_batch):
+        import jax
+
+        out = []
+        nbytes = 0
+        for a in host_batch:
+            a = _np.asarray(a)
+            nbytes += a.nbytes
+            if mesh is None:
+                import jax.numpy as jnp
+
+                out.append(NDArray(jnp.asarray(a)))
+                continue
+            if mesh.processes > 1:
+                sharding = mesh.batch_sharding(
+                    (a.shape[0] * mesh.processes,) + a.shape[1:])
+                arr = jax.make_array_from_process_local_data(sharding, a)
+            else:
+                sharding = mesh.batch_sharding(a.shape)
+                arr = jax.device_put(a, sharding)
+            out.append(NDArray(arr))
+        if _tel.ENABLED and nbytes:
+            _tel.TRANSFER_H2D.inc(nbytes)
+        return tuple(out)
+
+    return place
+
+
+class PrefetchRing:
+    """Bounded ring of device-staged batches ahead of the consumer.
+
+    ``source`` is a zero-arg callable returning ``(index, host_batch,
+    ids)`` or None at end of stream (``ReaderPool.next_batch``);
+    ``placer`` stages one host batch onto the device/mesh.  ``next()``
+    pops in order; the stall time (consumer arrived, ring empty) feeds
+    ``dataloader_batch_wait_seconds`` — the histogram the acceptance
+    criterion bounds."""
+
+    def __init__(self, source, placer, depth=None, name="ring"):
+        self._source = source
+        self._placer = placer
+        self._depth = int(depth) if depth else default_depth()
+        if self._depth < 1:
+            raise MXNetError("prefetch ring depth must be >= 1")
+        self._name = name
+        self._buf = collections.deque()
+        self._cond = threading.Condition()
+        self._stop = False
+        self._exhausted = False
+        self._error = None
+        self._ctx = None          # consumer trace ctx for stage spans
+        self.staged = 0
+        self.stalls = 0
+        self._thread = threading.Thread(
+            target=self._stage_loop, name="mx-data-stager", daemon=True)
+        self._thread.start()
+
+    # -- stager thread -----------------------------------------------------------
+    def _stage_loop(self):
+        try:
+            while True:
+                with self._cond:
+                    while not self._stop and len(self._buf) >= self._depth:
+                        self._cond.wait(0.2)
+                    if self._stop:
+                        return
+                    ctx = self._ctx
+                item = self._source()
+                if item is None:
+                    break
+                idx, host_batch, ids = item
+                t0 = _time.perf_counter()
+                # adopt the consumer's trace ctx so data_stage spans
+                # land under the train_step trace that will eat this
+                # batch (ISSUE 15: loader spans on the step timeline)
+                with _trace.use(ctx):
+                    with _trace.span("data_stage", hist=False, cat="data",
+                                     args={"batch": int(idx)}):
+                        staged = self._placer(host_batch)
+                if _tel.ENABLED:
+                    _tel.DATA_STAGE_SECONDS.observe(
+                        _time.perf_counter() - t0)
+                with self._cond:
+                    if self._stop:
+                        return
+                    self._buf.append((idx, staged, ids))
+                    self.staged += 1
+                    if _tel.ENABLED:
+                        _tel.DATA_RING_OCCUPANCY.set(len(self._buf))
+                        _tel.DATA_BATCHES.inc()
+                    self._cond.notify_all()
+        except Exception as exc:  # noqa: BLE001 — surfaced at next()
+            with self._cond:
+                self._error = exc
+                self._cond.notify_all()
+        finally:
+            with self._cond:
+                self._exhausted = True
+                self._cond.notify_all()
+
+    # -- consumer -----------------------------------------------------------
+    def next(self, timeout=120.0):
+        """Pop the next staged ``(index, device_batch, ids)`` or None
+        at end of stream.  Blocks (counted as a stall) when the ring
+        is empty but the stream is not done."""
+        tel_on = _tel.ENABLED
+        t0 = _time.perf_counter()
+        with self._cond:
+            self._ctx = _trace.current()
+            stalled = not self._buf and not self._exhausted \
+                and self._error is None
+            deadline = _time.monotonic() + timeout
+            while not self._buf and not self._exhausted \
+                    and self._error is None:
+                if not self._cond.wait(0.2) and \
+                        _time.monotonic() > deadline:
+                    raise MXNetError(
+                        "prefetch ring %r starved for %.0fs (readers "
+                        "wedged?)" % (self._name, timeout))
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            if stalled:
+                self.stalls += 1
+                if tel_on:
+                    _tel.DATA_RING_STALLS.inc()
+            if not self._buf:
+                if tel_on:
+                    _tel.DATALOADER_WAIT_SECONDS.observe(
+                        _time.perf_counter() - t0)
+                return None
+            item = self._buf.popleft()
+            if tel_on:
+                _tel.DATA_RING_OCCUPANCY.set(len(self._buf))
+            self._cond.notify_all()
+        if tel_on:
+            # the time the training loop actually blocked on data —
+            # ~0 when the ring stayed ahead (the H3 acceptance bound)
+            _tel.DATALOADER_WAIT_SECONDS.observe(
+                _time.perf_counter() - t0)
+        return item
+
+    def occupancy(self):
+        with self._cond:
+            return len(self._buf)
+
+    @property
+    def depth(self):
+        return self._depth
+
+    def stop(self):
+        with self._cond:
+            self._stop = True
+            self._buf.clear()
+            self._cond.notify_all()
+        self._thread.join(timeout=2.0)
+        if _tel.ENABLED:
+            _tel.DATA_RING_OCCUPANCY.set(0)

@@ -222,6 +222,23 @@ class _MultiWorkerIter:
         self._sent = 0
         self._rcvd = 0
         self._reorder = {}
+        # SIGTERM mid-epoch (resilience.preempt) must not leak worker
+        # processes: register a drain hook like serve.Server does.
+        # Held weakly — the hook must not keep a finished iterator
+        # (and its workers) alive until process exit.
+        import weakref
+
+        from ...resilience import preempt as _preempt
+
+        self._hook_name = "gluon_dataloader-%d" % id(self)
+        ref = weakref.ref(self)
+
+        def _drain():
+            it = ref()
+            if it is not None:
+                it.shutdown()
+
+        _preempt.add_shutdown_hook(self._hook_name, _drain)
         for _ in range(prefetch):
             self._issue()
 
@@ -281,6 +298,11 @@ class _MultiWorkerIter:
         if self._shutdown:
             return
         self._shutdown = True
+        if getattr(self, "_hook_name", None) is not None:
+            from ...resilience import preempt as _preempt
+
+            _preempt.remove_shutdown_hook(self._hook_name)
+            self._hook_name = None
         try:
             # release segments of batches already reordered but unconsumed
             for payload, _err in self._reorder.values():

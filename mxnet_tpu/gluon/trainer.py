@@ -66,6 +66,8 @@ class Trainer:
         self._mt_groups = {}   # multi-tensor fused update programs
         self._step_programs = []  # weakrefs to mx.step StepPrograms
         self._monitor_kv_warned = False
+        self._data_loader = None   # weakref to an attached StreamLoader
+        self._pending_data_state = None  # cursor restored pre-attach
         from .. import shard as _shard
 
         self._zero = _shard.normalize_level(zero)
@@ -384,6 +386,30 @@ class Trainer:
             self._states = {k: self._shard_state(v)
                             for k, v in self._states.items()}
 
+    # ---- mx.data integration ----------------------------------------------
+    def attach_loader(self, loader):
+        """Attach an ``mx.data.StreamLoader`` so its reader cursor
+        (epoch, batch position, seed) rides ``state_dict()`` into
+        every checkpoint — weights and stream position commit as ONE
+        unit (pod-consistent under ``PodCheckpointManager``), and a
+        restore resumes the exact remaining sample order.  Attach
+        BEFORE the first save/restore so the checkpoint tree structure
+        is stable across the run.  A cursor restored before the
+        loader was attached is applied here."""
+        import weakref
+
+        self._data_loader = None if loader is None \
+            else weakref.ref(loader)
+        if loader is not None and self._pending_data_state is not None:
+            loader.load_state_dict(self._pending_data_state)
+            self._pending_data_state = None
+        return loader
+
+    def _attached_loader(self):
+        ref = self._data_loader
+        ldr = ref() if ref is not None else None
+        return ldr
+
     # ---- mx.checkpoint integration ----------------------------------------
     @property
     def step_count(self):
@@ -414,7 +440,7 @@ class Trainer:
                 "when update_on_kvstore=True; use save_states/load_states")
         opt = self._optimizer
         names = [str(n) for n in self._param_names]
-        return {"params": {names[i]: p.data()
+        tree = {"params": {names[i]: p.data()
                            for i, p in enumerate(self._params)
                            if p._data is not None},
                 "states": {names[i]: _state_np(s)
@@ -428,6 +454,12 @@ class Trainer:
                 # the TRUE update counter, independent of the caller's
                 # directory tag (do_checkpoint tags by epoch)
                 "step": self._step_count}
+        loader = self._attached_loader()
+        if loader is not None:
+            # the input-stream cursor commits WITH the weights: a
+            # restore resumes the exact remaining sample order
+            tree["data"] = loader.state_dict()
+        return tree
 
     def save_checkpoint(self, root, step=None, **manager_kwargs):
         """Save parameters + optimizer state + step counter as ONE
@@ -513,3 +545,12 @@ class Trainer:
             self._states = {k: self._shard_state(v)
                             for k, v in self._states.items()}
         self._step_count = int(tree["step"])
+        data = tree.get("data")
+        if data is not None:
+            loader = self._attached_loader()
+            if loader is not None:
+                loader.load_state_dict(data)
+            else:
+                # checkpoint carries a stream cursor but no loader is
+                # attached yet — hold it for attach_loader()
+                self._pending_data_state = data

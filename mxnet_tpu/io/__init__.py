@@ -332,6 +332,12 @@ class ImageRecordIter(DataIter):
                  label_width=1, mean_r=0, mean_g=0, mean_b=0, scale=1.0,
                  rand_crop=False, rand_mirror=False, preprocess_threads=4,
                  seed=0, **kwargs):
+        from ..data import require_sharded
+
+        # this iterator reads the whole RecordIO pack on every host —
+        # in a multi-host world that silently bypasses sharding; the
+        # sharded streaming path is mx.data.StreamLoader
+        require_sharded("io.ImageRecordIter over %r" % (path_imgrec,))
         super().__init__(batch_size)
         self._shape = tuple(data_shape)
         self._native = None

@@ -12,8 +12,10 @@ driven on CPU, deterministically, from a *fault plan*:
   (``trainer_step``), collective ``pushpull_all`` (``collective``),
   checkpoint writer IO (``checkpoint_commit`` at commit entry,
   ``checkpoint_marker`` just before the COMMITTED marker lands),
-  compile-cache commit (``compile_commit``), and serve batch dispatch
-  (``serve_dispatch``; ``serve_poison`` marks individual request ids);
+  compile-cache commit (``compile_commit``), serve batch dispatch
+  (``serve_dispatch``; ``serve_poison`` marks individual request ids),
+  and streaming reader IO (``data_read``, keyed by batch index —
+  ``io`` kind engages the reader's bounded retry loop);
 - a fault fires **iff** the plan holds a matching entry for that
   (site, sequence) pair — so every drill replays identically, run
   after run, and an empty plan costs one dict probe per site.
@@ -58,7 +60,7 @@ __all__ = ["InjectedFault", "InjectedIOError", "FaultPlan", "SITES",
 # a site added later — but these are the ones wired into the stack)
 SITES = ("trainer_step", "collective", "checkpoint_commit",
          "checkpoint_marker", "compile_commit", "serve_dispatch",
-         "serve_poison")
+         "serve_poison", "data_read")
 KINDS = ("transient", "io", "fatal", "abort")
 
 # distinct from any real exit status the drills assert on (SIGKILL

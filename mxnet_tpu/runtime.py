@@ -99,6 +99,7 @@ def _detect():
         "TRACE": True,
         "CHECKPOINT": True,
         "SERVE": True,
+        "DATA": True,
         "RESILIENCE": True,
         "OPENMP": True,
         "SSE": False,

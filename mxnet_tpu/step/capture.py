@@ -501,6 +501,15 @@ class StepProgram:
         jax = _jax()
 
         def put_batch(a):
+            arr = getattr(a, "_data", a)
+            if isinstance(arr, jax.Array) and \
+                    getattr(arr, "sharding", None) is not None and \
+                    arr.sharding == cap.gmesh.batch_sharding(arr.shape):
+                # already mesh-placed — the mx.data prefetch ring
+                # staged it onto this exact sharding while the
+                # previous step ran (the H3 contract: dispatch never
+                # pays the H2D here)
+                return arr
             sharding = cap.gmesh.batch_sharding(a.shape)
             if cap.gmesh.processes > 1:
                 return jax.make_array_from_process_local_data(

@@ -1034,5 +1034,43 @@ AUTOTUNE_TUNE_SECONDS = histogram(
     "autotune_tune_seconds",
     "wall time of one tune() search (default + all candidates)",
     buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0))
+# mx.data (data/): sharded streaming input pipeline.  The ring gauges
+# are the H3 health signal: steady state is occupancy ~ depth and a
+# flat stall counter — a climbing stall count means reads/decode (not
+# H2D) bound the pipeline, so raise MXNET_DATA_WORKERS first.  The
+# loop-blocked time itself lands in dataloader_batch_wait_seconds,
+# shared with the classic DataLoader.
+DATA_RING_DEPTH = gauge(
+    "data_ring_depth",
+    "configured prefetch ring depth (batches staged ahead; "
+    "MXNET_DATA_PREFETCH or the data_prefetch autotune site)")
+DATA_RING_OCCUPANCY = gauge(
+    "data_ring_occupancy",
+    "device-staged batches currently waiting in the prefetch ring")
+DATA_RING_STALLS = counter(
+    "data_ring_stalls_total",
+    "times the training loop arrived at an EMPTY prefetch ring "
+    "(the reader/decode stage fell behind the step program)")
+DATA_READ_SECONDS = histogram(
+    "data_read_seconds",
+    "shard record-read time per batch (worker-side, after retries)")
+DATA_DECODE_SECONDS = histogram(
+    "data_decode_seconds",
+    "decode + batchify time per batch (worker-side)")
+DATA_STAGE_SECONDS = histogram(
+    "data_stage_seconds",
+    "host batch -> device/mesh staging dispatch time (the transfer "
+    "itself runs async under PJRT)")
+DATA_BATCHES = counter(
+    "data_batches_total", "batches staged through the prefetch ring")
+DATA_RECORDS = counter(
+    "data_records_total", "records read + decoded by reader workers")
+DATA_READ_RETRIES = counter(
+    "data_read_retries_total",
+    "reader IO attempts retried after an OSError (incl. injected "
+    "data_read io faults)")
+DATA_RESUMES = counter(
+    "data_resumes_total",
+    "mid-epoch cursor restores (checkpoint resume of the stream)")
 
 start_logger()

@@ -1,0 +1,134 @@
+"""Multi-process mx.data mid-epoch resume drill worker (ISSUE 15).
+
+One rank of the ``make data-smoke`` world drill, launched 2-wide by
+``tools/launch.py --rendezvous none``.  Each rank feeds a tiny trainer
+from a ``StreamLoader`` over a SHARED shard directory — shard
+assignment derives from the launcher's ``(rank, world)`` coordinates,
+so the two ranks read disjoint slices — and pod-commits
+``Trainer.state_dict()`` (weights + the attached loader's cursor)
+every ``--commit-every`` consumed batches through
+``PodCheckpointManager``.
+
+Fault: ``--die-at K --die-rank R`` SIGKILLs rank R right before it
+would consume batch K (attempt 0 only) — a mid-epoch hard death.  The
+surviving rank discovers the torn world at its next pod commit (the
+marker never publishes) or via the launcher's SIGTERM reap, and exits
+non-zero; ``launch.py --restarts 1`` relaunches the world, every rank
+restores the max-common-committed pod step, and the resumed batch
+stream must be BIT-identical to the uninterrupted reference — the
+driver (tools/data_smoke.py) asserts it from the printed ledger::
+
+    rank 0 resume_from 3
+    rank 1 batch 7 ids=12,40,3,55
+    rank 0 DONE batches=12
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import data as mxdata
+from mxnet_tpu import gluon, resilience
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.resilience import preempt
+
+SEED = 23
+DIM = 8
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt", required=True)
+    ap.add_argument("--shards", required=True,
+                    help="shared shard-glob pattern")
+    ap.add_argument("--batch-size", type=int, default=8,
+                    help="GLOBAL batch size")
+    ap.add_argument("--commit-every", type=int, default=3)
+    ap.add_argument("--die-at", type=int, default=None)
+    ap.add_argument("--die-rank", type=int, default=1)
+    args = ap.parse_args()
+
+    attempt = int(os.environ.get("MXNET_DIST_ATTEMPT", "0"))
+    membership = mx.dist.join()
+    rank, world = membership.rank, membership.world_size
+
+    mx.random.seed(SEED)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=DIM),
+            nn.Dense(4, in_units=16))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    loader = mxdata.StreamLoader(
+        args.shards, batch_size=args.batch_size, seed=SEED,
+        num_hosts=world, host=rank, num_workers=1, prefetch=2)
+    trainer.attach_loader(loader)
+    pod = mx.dist.PodCheckpointManager(args.ckpt, membership=membership)
+
+    assert resilience.install()   # SIGTERM (launcher reap) -> clean 85
+    resumed = pod.latest_step()
+    if resumed is not None:
+        _step, tree = pod.restore(step=resumed)
+        trainer.load_state_dict(tree)
+    print("rank %d resume_from %s" % (rank, resumed))
+    sys.stdout.flush()
+
+    from mxnet_tpu import autograd
+
+    consumed = loader.state_dict()["batch"]
+    total = loader.batches_per_epoch
+    it = iter(loader)
+    while consumed < total:
+        if preempt.requested():
+            # torn world (peer dead, launcher reaping): exit with the
+            # preempt code; the cursor lives at the last POD commit
+            print("rank %d PREEMPT batch=%d" % (rank, consumed))
+            sys.stdout.flush()
+            sys.exit(preempt.exit_code())
+        if args.die_at is not None and attempt == 0 \
+                and rank == args.die_rank and consumed == args.die_at:
+            sys.stdout.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
+        try:
+            x, y = next(it)
+        except StopIteration:
+            break
+        print("rank %d batch %d ids=%s"
+              % (rank, consumed,
+                 ",".join(str(i) for i in loader.last_ids.tolist())))
+        sys.stdout.flush()
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(x.shape[0])
+        consumed += 1
+        if consumed % args.commit_every == 0 and consumed < total:
+            pod.save(consumed, trainer.state_dict())
+            ok = pod.last_pod_commit == (consumed, True)
+            if not ok:
+                # the pod barrier timed out: a peer never acked its
+                # shard — the world is torn, stop and let launch.py
+                # relaunch everyone from the max common committed step
+                print("rank %d STOP torn_commit batch=%d" % (rank,
+                                                             consumed))
+                sys.stdout.flush()
+                sys.exit(3)
+
+    sums = [float(p.data().asnumpy().sum())
+            for _n, p in sorted(net.collect_params().items())]
+    loader.close()
+    membership.leave("done")
+    print("rank %d DONE batches=%d final=%.8f"
+          % (rank, consumed, float(np.asarray(sums).sum())))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

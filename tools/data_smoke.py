@@ -1,0 +1,371 @@
+#!/usr/bin/env python
+"""mx.data smoke — the ISSUE 15 acceptance drills on CPU.
+
+1. **H3 ring acceptance**: a loader-fed captured-step loop with the
+   prefetch ring armed (depth >= 2) runs within 5% of the SAME
+   program fed pre-staged device tensors, and the batch-wait p99 the
+   loop actually observed is <= 5% of the mean step time — asserted
+   from ``dataloader_batch_wait_seconds`` telemetry (best of 3
+   attempts; CPU wall clocks are noisy, the bound is not).
+2. **Mid-epoch cursor resume (single process)**: consume part of an
+   epoch, checkpoint through ``Trainer.save_checkpoint`` (the cursor
+   rides ``state_dict``), restore into a FRESH loader+trainer, and
+   the remaining sample-id stream is bit-identical to an
+   uninterrupted reference; epoch 2 reshuffles.
+3. **Reader faults + preemption drain**: an injected ``data_read`` io
+   fault is retried with the stream intact (and counted); SIGTERM-
+   style ``graceful_shutdown`` quiesces a live StreamLoader AND reaps
+   a gluon DataLoader's worker PROCESSES (no leaks past the drain).
+4. **2-rank world drill** (tools/launch.py --rendezvous none): rank 1
+   SIGKILLed mid-epoch; the world relaunches (--restarts 1), every
+   rank resumes the stream from the max-common-committed pod step,
+   and the resumed per-rank batch ledger is bit-identical to the
+   uninterrupted 2-rank reference.
+5. ``tools/diagnose.py --data`` renders the pipeline audit.
+"""
+from __future__ import annotations
+
+import io as _bio
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+LAUNCH = os.path.join(REPO, "tools", "launch.py")
+WORKER = os.path.join(REPO, "tests", "nightly", "data_stream_drill.py")
+
+
+def _write_shards(td, n_shards, per_shard, dim, name="smoke"):
+    from mxnet_tpu import recordio
+
+    os.makedirs(td, exist_ok=True)
+    rs = np.random.RandomState(7)
+    for s in range(n_shards):
+        w = recordio.MXIndexedRecordIO(
+            os.path.join(td, "%s-%d.idx" % (name, s)),
+            os.path.join(td, "%s-%d.rec" % (name, s)), "w")
+        for i in range(per_shard):
+            buf = _bio.BytesIO()
+            np.save(buf, rs.rand(dim).astype(np.float32))
+            gid = s * per_shard + i
+            w.write_idx(i, recordio.pack(
+                recordio.IRHeader(0, float(gid % 10), gid, 0),
+                buf.getvalue()))
+        w.close()
+    return os.path.join(td, "%s-*.rec" % name)
+
+
+def _mlp(dim, hidden=1024, depth=3, out=10, seed=0):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    last = dim
+    for _ in range(depth):
+        net.add(nn.Dense(hidden, activation="relu", in_units=last))
+        last = hidden
+    net.add(nn.Dense(out, in_units=last))
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    return net, trainer
+
+
+def stage_ring_acceptance(tmp):
+    """Loader-fed vs pre-staged captured steps: the H3 bound."""
+    from mxnet_tpu import data as mxdata
+    from mxnet_tpu import gluon, nd, telemetry
+
+    dim, batch, n_batches = 256, 64, 120
+    pat = _write_shards(os.path.join(tmp, "ring"), 2,
+                        batch * n_batches // 2, dim, name="ring")
+
+    def build():
+        # sized so one captured step (~15-20ms CPU) dominates one
+        # batch's read+decode (~2ms): the realistic regime the ring
+        # exists for (a ResNet-50 step is 100ms+ against the same
+        # decode cost)
+        net, trainer = _mlp(dim, hidden=2048, depth=3)
+        prog = trainer.capture(net, gluon.loss.SoftmaxCrossEntropyLoss())
+        return net, trainer, prog
+
+    def run_prestaged(prog, batches):
+        # warm the program + device
+        prog(batches[0][0], batches[0][1])
+        t0 = time.perf_counter()
+        for x, y in batches[1:]:
+            loss = prog(x, y)
+        float(loss.asnumpy().sum())
+        return (time.perf_counter() - t0) / (len(batches) - 1)
+
+    def run_loader_fed(prog, loader):
+        it = iter(loader)
+        x, y = next(it)          # ring spin-up outside the clock
+        prog(x, y)
+        telemetry.reset()
+        n = 0
+        t0 = time.perf_counter()
+        for x, y in it:
+            loss = prog(x, y)
+            n += 1
+        float(loss.asnumpy().sum())
+        return (time.perf_counter() - t0) / n
+
+    best = None
+    for attempt in range(3):
+        # pre-staged reference: every batch already a device array
+        net, trainer, prog = build()
+        ldr = mxdata.StreamLoader(pat, batch_size=batch, seed=1,
+                                  num_workers=3, prefetch=3)
+        host = []
+        it = iter(ldr)
+        for x, y in it:
+            host.append((x, y))          # staged NDArrays, kept live
+            if len(host) >= 40:
+                break
+        ldr.close()
+        pre_s = run_prestaged(prog, host)
+
+        net2, trainer2, prog2 = build()
+        ldr2 = mxdata.StreamLoader(pat, batch_size=batch, seed=2,
+                                   num_workers=3, prefetch=3)
+        fed_s = run_loader_fed(prog2, ldr2)
+        qs = telemetry.histogram_quantiles(
+            "dataloader_batch_wait_seconds")
+        p99 = qs.get(0.99, 0.0)
+        stats = ldr2.stats()
+        ldr2.close()
+        gap = (fed_s - pre_s) / pre_s
+        wait_frac = p99 / fed_s if fed_s else 0.0
+        row = {"prestaged_ms": pre_s * 1e3, "loader_fed_ms": fed_s * 1e3,
+               "gap_pct": gap * 100.0, "batch_wait_p99_ms": p99 * 1e3,
+               "wait_frac_pct": wait_frac * 100.0,
+               "ring_stalls": stats["ring_stalls"],
+               "ring_staged": stats["ring_staged"]}
+        if best is None or row["gap_pct"] < best["gap_pct"]:
+            best = row
+        if gap <= 0.05 and wait_frac <= 0.05:
+            break
+    print("stage 1: prestaged %.3fms/step, loader-fed %.3fms/step "
+          "(gap %+.1f%%), batch-wait p99 %.3fms (%.2f%% of step), "
+          "ring stalls %d/%d staged"
+          % (best["prestaged_ms"], best["loader_fed_ms"],
+             best["gap_pct"], best["batch_wait_p99_ms"],
+             best["wait_frac_pct"], best["ring_stalls"],
+             best["ring_staged"]))
+    assert best["gap_pct"] <= 5.0, (
+        "loader-fed captured steps %.1f%% slower than pre-staged "
+        "(H3 bound is 5%%)" % best["gap_pct"])
+    assert best["wait_frac_pct"] <= 5.0, (
+        "batch-wait p99 is %.1f%% of the step (H3 bound is 5%%)"
+        % best["wait_frac_pct"])
+    print("stage 1 OK: ring >= 2 keeps the captured step off the H2D "
+          "critical path")
+    return best
+
+
+def stage_mid_epoch_resume(tmp):
+    from mxnet_tpu import data as mxdata
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    pat = _write_shards(os.path.join(tmp, "resume"), 3, 24, 8,
+                        name="resume")
+
+    def drain(ldr):
+        out = []
+        for _ in ldr:
+            out.append(ldr.last_ids.tolist())
+        return out
+
+    ref = mxdata.StreamLoader(pat, batch_size=6, seed=5)
+    ref_epoch0 = drain(ref)
+    ref_epoch1 = drain(ref)
+    ref.close()
+
+    def tiny():
+        net = nn.Dense(4, in_units=8)
+        net.initialize()
+        return net, gluon.Trainer(net.collect_params(), "sgd",
+                                  {"learning_rate": 0.1})
+
+    _net, tr = tiny()
+    ldr = mxdata.StreamLoader(pat, batch_size=6, seed=5)
+    tr.attach_loader(ldr)
+    it = iter(ldr)
+    got = []
+    for _ in range(5):
+        next(it)
+        got.append(ldr.last_ids.tolist())
+    root = os.path.join(tmp, "resume-ck")
+    tr.save_checkpoint(root)
+    ldr.close()
+
+    _net2, tr2 = tiny()
+    ldr2 = mxdata.StreamLoader(pat, batch_size=6, seed=5)
+    tr2.attach_loader(ldr2)
+    tr2.load_checkpoint(root)
+    rest = drain(ldr2)
+    assert got + rest == ref_epoch0, "resumed stream diverged"
+    assert drain(ldr2) == ref_epoch1, "epoch-2 order diverged"
+    assert ref_epoch1 != ref_epoch0, "epochs must reshuffle"
+    ldr2.close()
+    print("stage 2 OK: mid-epoch trainer-checkpoint resume replays the "
+          "exact remaining sample order (and epoch 2 reshuffles)")
+
+
+def stage_faults_and_drain(tmp):
+    from mxnet_tpu import data as mxdata
+    from mxnet_tpu import resilience, telemetry
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.dataset import ArrayDataset
+    from mxnet_tpu.resilience import preempt
+
+    pat = _write_shards(os.path.join(tmp, "faults"), 2, 18, 8,
+                        name="faults")
+
+    def drain(ldr):
+        out = []
+        for _ in ldr:
+            out.append(ldr.last_ids.tolist())
+        return out
+
+    telemetry.reset()
+    resilience.plan("data_read@1:io")
+    faulted = mxdata.StreamLoader(pat, batch_size=6, seed=9,
+                                  num_workers=1)
+    with_fault = drain(faulted)
+    resilience.clear()
+    clean = mxdata.StreamLoader(pat, batch_size=6, seed=9,
+                                num_workers=1)
+    assert with_fault == drain(clean), "io fault changed the stream"
+    retries = telemetry.totals().get("data_read_retries_total", 0)
+    assert retries >= 1, "injected io fault never hit the retry loop"
+    faulted.close(), clean.close()
+
+    # preemption drain: StreamLoader threads + gluon worker processes
+    ldr = mxdata.StreamLoader(pat, batch_size=6, seed=0, num_workers=2)
+    next(iter(ldr))
+    ds = ArrayDataset(np.arange(64, dtype=np.float32).reshape(32, 2),
+                      np.arange(32, dtype=np.float32))
+    gl = DataLoader(ds, batch_size=4, num_workers=2)
+    git = iter(gl)
+    next(git)
+    import multiprocessing as _mp
+
+    workers = [p for p in _mp.active_children()
+               if p.name.startswith(("Process", "ForkServerProcess",
+                                     "SpawnProcess"))]
+    assert workers and all(w.is_alive() for w in workers), workers
+    results = preempt.graceful_shutdown()
+    bad = {k: v for k, v in results.items() if v != "ok"}
+    assert not bad, "drain hooks failed: %s" % bad
+    deadline = time.time() + 10
+    while any(w.is_alive() for w in workers) and time.time() < deadline:
+        time.sleep(0.05)
+    assert not any(w.is_alive() for w in workers), \
+        "gluon DataLoader leaked worker processes past the drain"
+    assert ldr.stats()["ring_occupancy"] == 0
+    ldr.close()
+    print("stage 3 OK: data_read io fault retried (%d) with the stream "
+          "intact; preemption drain reaped loader threads AND gluon "
+          "worker processes" % retries)
+
+
+def _parse_ledger(out):
+    """{rank: {batch: ids_string}} last-wins + per-line entries."""
+    ledger = {0: {}, 1: {}}
+    entries = []
+    for rank, batch, ids in re.findall(
+            r"rank (\d) batch (\d+) ids=([\d,]+)", out):
+        ledger[int(rank)][int(batch)] = ids
+        entries.append((int(rank), int(batch), ids))
+    return ledger, entries
+
+
+def stage_world_drill(tmp):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "MXNET_DIST_BARRIER_TIMEOUT": "5",
+        "MXNET_DIST_HEARTBEAT_SECONDS": "0.5",
+        "MXNET_DIST_DEAD_AFTER_SECONDS": "3",
+    })
+    shards = _write_shards(os.path.join(tmp, "world"), 4, 24, 8,
+                           name="world")
+
+    def launch(ckpt, extra=(), launch_args=()):
+        return subprocess.run(
+            [sys.executable, LAUNCH, "-n", "2", "--backend", "cpu",
+             "--rendezvous", "none", "--term-grace", "20",
+             *launch_args, sys.executable, WORKER,
+             "--ckpt", ckpt, "--shards", shards, "--batch-size", "8",
+             "--commit-every", "3", *extra],
+            env=env, capture_output=True, text=True, timeout=600)
+
+    ref = launch(os.path.join(tmp, "world-ref"))
+    assert ref.returncode == 0, (ref.returncode, ref.stdout,
+                                 ref.stderr[-3000:])
+    ref_ledger, _ = _parse_ledger(ref.stdout)
+    per_rank = {r: len(b) for r, b in ref_ledger.items()}
+    assert per_rank == {0: 12, 1: 12}, per_rank
+
+    proc = launch(os.path.join(tmp, "world-kill"),
+                  extra=["--die-at", "5", "--die-rank", "1"],
+                  launch_args=["--restarts", "1"])
+    assert proc.returncode == 0, (proc.returncode, proc.stdout,
+                                  proc.stderr[-3000:])
+    assert "coordinated restart 1/1" in proc.stderr, proc.stderr[-2000:]
+    assert proc.stdout.count("resume_from 3") == 2, proc.stdout
+    ledger, entries = _parse_ledger(proc.stdout)
+    # EVERY printed batch — first attempt, overshoot past the commit,
+    # and the resumed replay — must match the reference bit-identically
+    for rank, batch, ids in entries:
+        assert ref_ledger[rank][batch] == ids, (
+            "rank %d batch %d diverged:\n  drill %s\n  ref   %s"
+            % (rank, batch, ids, ref_ledger[rank][batch]))
+    assert ledger == ref_ledger, "drill coverage != reference"
+    print("stage 4 OK: rank 1 SIGKILLed at batch 5; world relaunched, "
+          "both ranks resumed the stream from pod step 3 and the "
+          "ledger is bit-identical to the uninterrupted reference")
+
+
+def stage_diagnose():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "diagnose.py"),
+         "--data"],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "Data Pipeline" in proc.stdout, proc.stdout
+    print("stage 5 OK: diagnose --data renders")
+
+
+def main():
+    t0 = time.time()
+    tmp = tempfile.mkdtemp(prefix="mxnet_data_smoke_")
+    row = stage_ring_acceptance(tmp)
+    stage_mid_epoch_resume(tmp)
+    stage_faults_and_drain(tmp)
+    stage_world_drill(tmp)
+    stage_diagnose()
+    print("data smoke OK (5 stages, %.1fs) — H3 verdict: loader-fed "
+          "%+.1f%% vs pre-staged, batch-wait p99 %.2f%% of step"
+          % (time.time() - t0, row["gap_pct"], row["wait_frac_pct"]))
+
+
+if __name__ == "__main__":
+    main()

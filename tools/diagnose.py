@@ -666,6 +666,61 @@ def monitor_info(src):
     print("telemetry    : %s" % (tot or "(no monitor_* activity)"))
 
 
+def data_info():
+    """Audit the mx.data streaming input plane: live loaders (shard
+    assignment, ring depth/occupancy/stalls, per-worker read rates,
+    cursor position) plus this process's data_* telemetry — the H3
+    health check (steady state: occupancy ~ depth, flat stalls)."""
+    section("Data Pipeline")
+    from mxnet_tpu import data as mxdata
+    from mxnet_tpu import telemetry
+
+    print("ring depth   :", mxdata.default_depth(),
+          "(MXNET_DATA_PREFETCH / data_prefetch autotune site)")
+    print("workers      :", mxdata.default_workers(),
+          "(MXNET_DATA_WORKERS)")
+    num_hosts, host = mxdata.world_coords()
+    print("world        : host %d/%d" % (host, num_hosts))
+    loaders = mxdata.state()
+    print("live loaders : %d" % len(loaders))
+    for i, st in enumerate(loaders):
+        cur = st["cursor"]
+        print("  [%d] %s shards=%d records=%d/%d local_batch=%d "
+              "batches/epoch=%d" % (i, st["assignment"], st["shards"],
+                                    st["records_local"],
+                                    st["records_total"],
+                                    st["local_batch"],
+                                    st["batches_per_epoch"]))
+        print("      ring depth=%d occupancy=%d staged=%d stalls=%d"
+              % (st["ring_depth"], st["ring_occupancy"],
+                 st["ring_staged"], st["ring_stalls"]))
+        print("      cursor epoch=%d batch=%d shard=%d offset=%d "
+              "samples_seen=%d" % (cur["epoch"], cur["batch"],
+                                   cur["shard_index"],
+                                   cur["record_offset"],
+                                   cur["samples_seen"]))
+        if st["worker_records"]:
+            print("      worker records:",
+                  " ".join("w%d=%d" % (w, n) for w, n in
+                           sorted(st["worker_records"].items())))
+        if st["mesh"]:
+            print("      mesh:", st["mesh"])
+    tot = {k: v for k, v in telemetry.totals(nonzero=True).items()
+           if k.startswith(("data_", "dataloader_"))}
+    print("telemetry    : %s" % (tot or "(no data-plane activity "
+                                 "this process)"))
+    for name in ("data_read_seconds", "data_decode_seconds",
+                 "data_stage_seconds", "dataloader_batch_wait_seconds"):
+        try:
+            qs = telemetry.histogram_quantiles(name)
+        except Exception:
+            qs = None
+        if qs:
+            print("  %-32s p50=%.6f p95=%.6f p99=%.6f"
+                  % (name, qs.get(0.5, 0.0), qs.get(0.95, 0.0),
+                     qs.get(0.99, 0.0)))
+
+
 def autotune_info():
     """Audit mx.autotune: mode, store location/health, and the
     per-site winner table with provenance (tuned / default /
@@ -926,6 +981,11 @@ def main():
                          "plan, preemption handler state, recent "
                          "supervisor restarts, serve breaker states, "
                          "injected-fault counters")
+    ap.add_argument("--data", action="store_true",
+                    help="audit the mx.data streaming input plane: "
+                         "live loaders, ring depth/occupancy/stalls, "
+                         "per-worker read rates, cursor state, data_* "
+                         "telemetry")
     ap.add_argument("--dist", nargs="?", const="", metavar="CKPT_ROOT",
                     help="dump the mx.dist plane: membership/world "
                          "view, collective deadline, world-stop flag, "
@@ -936,11 +996,14 @@ def main():
     # (each skips the environment dump, all honor --telemetry)
     if args.compile_cache or args.serve or args.checkpoints or \
             args.trainer or args.step or args.trace or args.monitor or \
-            args.resilience or args.autotune or args.dist is not None:
+            args.resilience or args.autotune or args.data or \
+            args.dist is not None:
         if args.compile_cache:
             compile_cache_info()
         if args.autotune:
             autotune_info()
+        if args.data:
+            data_info()
         if args.resilience:
             resilience_info()
         if args.dist is not None:
