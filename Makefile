@@ -12,7 +12,7 @@ SO := build/libmxtpu_native.so
 .PHONY: native test cpptest telemetry-smoke checkpoint-smoke serve-smoke \
 	decode-smoke compile-cache-smoke trainer-smoke step-smoke \
 	trace-smoke monitor-smoke faults-smoke dist-faults-smoke \
-	zero-smoke autotune-smoke data-smoke smoke-all clean
+	zero-smoke autotune-smoke data-smoke obs-smoke smoke-all clean
 
 native: $(SO)
 
@@ -183,12 +183,51 @@ autotune-smoke:
 	JAX_PLATFORMS=cpu python -m pytest \
 	  tests/python/unittest/test_autotune.py -q -m 'not slow'
 
+# mx.obs observability-plane smoke: 2-rank fleet drill (cross-rank
+# aggregation merged on BOTH ranks + seeded slow rank fires exactly one
+# straggler episode), serve SLO burn-rate OK -> PAGE -> OK round trip
+# (/healthz degraded + /statz + /fleetz + gauge agree), captured-step
+# attribution JSONL schema check (span shares + FLOPs + MFU), and the
+# bench_gate regression drill (fails a seeded 30% slowdown, passes an
+# unchanged run); then the subsystem's pytest suite
+obs-smoke:
+	JAX_PLATFORMS=cpu python tools/obs_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/python/unittest/test_obs.py -q -m 'not slow'
+
 # every subsystem smoke in sequence — the one-command pre-flight before
-# a tunnel window (each target is independent; failures stop the chain)
-smoke-all: telemetry-smoke checkpoint-smoke serve-smoke decode-smoke \
-	compile-cache-smoke trainer-smoke step-smoke trace-smoke \
-	monitor-smoke faults-smoke zero-smoke autotune-smoke \
-	data-smoke dist-faults-smoke
+# a tunnel window.  Ordered CHEAP-FIRST (approx wall time on the CPU
+# container in the comment column) so a broken build fails in seconds,
+# not after the multi-process drills.  Runs as ONE shell loop so the
+# first failing smoke's exit code propagates even under `make -k`
+# (prerequisite-list smoke-all + -k used to keep going and could mask
+# an earlier failure behind a later green target).
+SMOKES := \
+	telemetry-smoke \
+	trace-smoke \
+	compile-cache-smoke \
+	trainer-smoke \
+	monitor-smoke \
+	checkpoint-smoke \
+	step-smoke \
+	autotune-smoke \
+	serve-smoke \
+	obs-smoke \
+	zero-smoke \
+	decode-smoke \
+	faults-smoke \
+	data-smoke \
+	dist-faults-smoke
+# approx wall time:        telemetry ~15s, trace ~25s, compile-cache
+# ~35s, trainer ~35s, monitor ~40s, checkpoint ~45s, step ~45s,
+# autotune ~50s, serve ~60s, obs ~75s, zero ~90s, decode ~100s,
+# faults ~2min, data ~3min, dist-faults ~4min (multi-process drills
+# last; total ~15min cold)
+smoke-all:
+	@set -e; for t in $(SMOKES); do \
+	  echo "== $$t =="; \
+	  $(MAKE) --no-print-directory $$t || exit $$?; \
+	done; echo "smoke-all OK ($(words $(SMOKES)) smokes)"
 
 # suite summary artifact (TESTS_r{N}.json) — round-2 advisor contract
 test-report:

@@ -171,6 +171,24 @@ def _monitor_summary(reset_peak=False):
         return {}
 
 
+def _obs_summary():
+    """mx.obs fleet block (ranks seen, straggler flags, SLO states),
+    or {} when the obs plane is off / unimportable — the same
+    fail-soft contract as _monitor_summary."""
+    import sys
+
+    if "mxnet_tpu" not in sys.modules:
+        return {}
+    try:
+        from mxnet_tpu import obs
+
+        if not obs.is_enabled():
+            return {}
+        return obs.fleet_summary()
+    except Exception:  # noqa: BLE001 - diagnostics are best-effort
+        return {}
+
+
 def _attach_telemetry(row, before, mon_before=None):
     """Attach the per-row delta of telemetry totals (and, when
     MXNET_MONITOR=1, the numeric-health columns) to a bench row."""
@@ -197,6 +215,9 @@ def _attach_telemetry(row, before, mon_before=None):
                       - mb.get("skipped_steps", 0))
         if skipped:
             row["skipped_steps"] = skipped
+    fleet = _obs_summary()
+    if isinstance(row, dict) and fleet:
+        row["fleet"] = fleet
     return row
 
 

@@ -118,7 +118,12 @@ ENV_VARS = {
     "MXNET_TRACE_DUMP_MIN_SECONDS": (
         float, 30.0,
         "Rate limit between anomaly-triggered dumps of the same reason "
-        "(slow_step / deadline_burst / hang)."),
+        "(slow_step / deadline_burst / hang / straggler)."),
+    "MXNET_TRACE_DUMP_MAX_EVENTS": (
+        int, 0,
+        "Cap chrome-trace dumps at the newest N ring events (0 = the "
+        "full ring); a clipped dump records truncated_events in its "
+        "mx.trace.dump metadata block."),
     "MXNET_TRACE_SLOW_STEP_FACTOR": (
         float, 3.0,
         "Dump the flight record when a trainer step exceeds this "
@@ -140,6 +145,47 @@ ENV_VARS = {
     "MXNET_TRACE_WATCHDOG_SECONDS": (
         float, 120.0,
         "Default no-progress timeout per watched scope."),
+    "MXNET_OBS": (
+        bool, False,
+        "Arm mx.obs, the fleet-wide observability plane: per-rank "
+        "telemetry snapshots published into the membership KV "
+        "(heartbeat-piggybacked), merged fleet views (/fleetz, "
+        "diagnose --fleet), straggler detection, SLO burn rates, and "
+        "per-step attribution (obs/).  Off = one cached flag check "
+        "per hook."),
+    "MXNET_OBS_PUBLISH_SECONDS": (
+        float, 5.0,
+        "Minimum interval between per-rank obs payload publishes into "
+        "the membership KV."),
+    "MXNET_OBS_STRAGGLER_FACTOR": (
+        float, 2.0,
+        "Flag a rank as a straggler when its step p50 exceeds this "
+        "factor x the median p50 of its peers (needs >= 2 ranks; one "
+        "obs_stragglers_total count + one rate-limited "
+        "reason=straggler flight-record dump per episode; 0 "
+        "disables)."),
+    "MXNET_OBS_SLO_FAST_SECONDS": (
+        float, 300.0,
+        "Fast burn-rate window for SLO evaluation (the standard SRE "
+        "multi-window formulation; PAGE/WARN require BOTH windows "
+        "over threshold)."),
+    "MXNET_OBS_SLO_SLOW_SECONDS": (
+        float, 3600.0,
+        "Slow burn-rate window for SLO evaluation."),
+    "MXNET_OBS_ATTRIBUTION": (
+        str, None,
+        "Append one JSON line of per-step time attribution (phase "
+        "shares, data-wait, MFU estimate) to this path."),
+    "MXNET_OBS_PEAK_TFLOPS": (
+        float, None,
+        "Per-chip peak TFLOP/s for the attribution MFU estimate, "
+        "overriding the built-in device-kind table (unknown kinds "
+        "report mfu null)."),
+    "MXNET_OBS_REGRESSION_PCT": (
+        float, 10.0,
+        "tools/bench_gate.py failure threshold: fresh bench metrics "
+        "worse than baseline by more than this percentage (trimmed "
+        "mean) exit non-zero."),
     "MXNET_MONITOR": (
         bool, False,
         "Arm mx.monitor training-health numerics: one fused stat "

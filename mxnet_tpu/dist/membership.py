@@ -41,7 +41,27 @@ from ..base import MXNetError, get_env
 _LOG = logging.getLogger("mxnet_tpu.dist")
 
 __all__ = ["FileKV", "CoordKV", "MemKV", "Membership",
-           "default_backend", "member_dir"]
+           "default_backend", "member_dir", "on_beat",
+           "remove_beat_listener"]
+
+# callbacks invoked (fail-soft) after every heartbeat write, with the
+# Membership as the argument — how mx.obs piggybacks its per-rank
+# payload publishing on the heartbeat thread without adding one
+_BEAT_LISTENERS = []
+
+
+def on_beat(cb):
+    """Register ``cb(membership)`` to run after each heartbeat write.
+    Listener exceptions are swallowed — the heartbeat must survive."""
+    if cb not in _BEAT_LISTENERS:
+        _BEAT_LISTENERS.append(cb)
+
+
+def remove_beat_listener(cb):
+    try:
+        _BEAT_LISTENERS.remove(cb)
+    except ValueError:
+        pass
 
 
 def member_dir():
@@ -398,6 +418,11 @@ class Membership:
                 "status": "left" if self._left else "alive"})
         except Exception as exc:  # noqa: BLE001 - see docstring
             _LOG.warning("membership heartbeat write failed: %s", exc)
+        for cb in list(_BEAT_LISTENERS):
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 - listeners ride the
+                pass           # heartbeat; they must never break it
 
     def note_step(self, step):
         """Record training progress cheaply: the step lands in the

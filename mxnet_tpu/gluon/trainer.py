@@ -15,6 +15,7 @@ collectives (no parameter servers).
 from __future__ import annotations
 
 import pickle
+import time as _time
 
 from .. import optimizer as opt_mod
 from .. import trace
@@ -239,6 +240,9 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        from .. import obs as _obs
+
+        t0 = _time.perf_counter() if _obs.core.ENABLED else 0.0
         with trace.span("trainer_step", hist=False, anomaly=True,
                         args={"step": self._step_count}), \
                 trace.watchdog.watch("trainer_step"):
@@ -249,6 +253,10 @@ class Trainer:
             with trace.span("trainer_allreduce", hist=False):
                 self._allreduce_grads()
             self._update(ignore_stale_grad)
+        if _obs.core.ENABLED:
+            # per-rank step cadence (the fleet straggler detector's
+            # feed); the captured path notes its own steps
+            _obs.core.note_step(_time.perf_counter() - t0)
 
     def allreduce_grads(self):
         if not self._kv_initialized:

@@ -41,7 +41,8 @@ from . import divergence, sentinel, stats
 
 __all__ = ["ENABLED", "enable", "disable", "is_enabled",
            "observe_update", "observe_captured", "gate_and_publish",
-           "flush", "summary", "reset", "stream_path", "group_values"]
+           "flush", "summary", "health", "reset", "stream_path",
+           "group_values"]
 
 _LOGGER = logging.getLogger("mxnet_tpu.monitor")
 
@@ -417,6 +418,18 @@ def group_values():
     diagnose --monitor table)."""
     with _SUM_LOCK:
         return {k: dict(v) for k, v in _LAST_GROUPS.items()}
+
+
+def health():
+    """Compact numerics-health dict for the mx.obs per-rank payload:
+    the summary() fields that matter across a fleet, plus the enabled
+    flag (so the fleet table can say WHICH ranks are monitored)."""
+    s = summary()
+    return {"enabled": ENABLED,
+            "steps": s["steps"],
+            "nonfinite_steps": s["nonfinite_steps"],
+            "skipped_steps": s["skipped_steps"],
+            "grad_global_norm_last": s["grad_global_norm_last"]}
 
 
 def reset(clear_programs=False):

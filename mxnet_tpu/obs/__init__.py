@@ -1,0 +1,71 @@
+"""mx.obs — the fleet-wide observability plane.
+
+The fourth observability layer (README "Observability"): ``telemetry``
+aggregates one process, ``trace`` records one process's timeline,
+``monitor`` watches one process's numerics — ``obs`` is the first
+layer that sees the *fleet*.  Four coupled pieces, all riding existing
+machinery rather than inventing transport:
+
+- **cross-rank aggregation** (``core.attach`` + :class:`FleetView`):
+  every process periodically publishes its ``telemetry.snapshot()``
+  (plus step cadence and monitor health) into the mx.dist membership
+  KV, piggybacked on the heartbeat thread; any rank merges the
+  per-rank payloads into one pod-level snapshot (counter sums,
+  histogram bucket merges, a per-rank table) — exported as Prometheus
+  text with a ``rank`` label, ``tools/diagnose.py --fleet``, and the
+  ``/fleetz`` endpoint on ``serve.Server``;
+- **straggler detection** (``FleetView.check_stragglers``): a rank
+  whose step p50 drifts past ``MXNET_OBS_STRAGGLER_FACTOR`` x the
+  fleet median fires one rate-limited flight-record dump
+  (``reason="straggler"``) and an ``obs_stragglers_total{rank}``
+  count — the classic slow-host/slow-chip failure, caught from
+  metrics instead of a human eyeballing per-rank logs;
+- **SLO engine** (``slo_engine.py``): declarative objectives over
+  live telemetry (``obs.slo("serve_p99", histogram=
+  "serve_request_seconds", q=0.99, target=0.2)``) evaluated with
+  multi-window burn rates (fast/slow windows, the standard SRE
+  formulation); states OK/WARN/PAGE surface in ``/statz``,
+  ``/healthz`` (degraded), telemetry gauges, and the periodic log
+  line — the load/health signal contract a fleet router consumes;
+- **step-time attribution** (``attribution.py``): a rolling per-step
+  breakdown (data-wait / dispatch / writeback / publish shares from
+  the existing ``train_step`` child phases, plus an MFU estimate from
+  captured-program FLOP accounting) written as a compact JSONL
+  stream (``MXNET_OBS_ATTRIBUTION``) — the feature source for a
+  learned performance model over real traces.
+
+Everything is fail-soft and cheap: with ``MXNET_OBS=0`` (the default)
+every hook costs one cached flag check; a dead/partitioned KV degrades
+to local-only snapshots with ``obs_publish_failures_total`` counted;
+no obs failure can ever raise into ``Trainer.step`` or the serve
+dispatch loop.  Enable with ``MXNET_OBS=1`` or ``mx.obs.enable()``.
+
+Env knobs: ``MXNET_OBS``, ``MXNET_OBS_PUBLISH_SECONDS``,
+``MXNET_OBS_STRAGGLER_FACTOR``, ``MXNET_OBS_SLO_FAST_SECONDS`` /
+``_SLOW_SECONDS``, ``MXNET_OBS_ATTRIBUTION``,
+``MXNET_OBS_PEAK_TFLOPS``, ``MXNET_OBS_REGRESSION_PCT``
+(``tools/bench_gate.py``).
+"""
+from __future__ import annotations
+
+from . import attribution, core, fleet, slo_engine
+from .core import (attach, detach, disable, enable, is_enabled,
+                   local_payload, note_step, publisher)
+from .fleet import FleetView, fleet_summary, fleetz, merge_metrics
+from .slo_engine import slo  # obs.slo(...) registers an objective
+
+__all__ = [
+    "core", "fleet", "slo_engine", "attribution",
+    "enable", "disable", "is_enabled",
+    "attach", "detach", "publisher", "note_step", "local_payload",
+    "FleetView", "fleetz", "fleet_summary", "merge_metrics", "slo",
+]
+
+
+def __getattr__(name):
+    # obs.ENABLED mirrors core.ENABLED (a mutable module flag —
+    # re-exporting the value at import would freeze it)
+    if name == "ENABLED":
+        return core.ENABLED
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))

@@ -1,0 +1,355 @@
+"""Fleet view: merge per-rank obs payloads into one pod-level picture.
+
+:class:`FleetView` reads every ``obs/<gen>/<rank>`` record of the
+current generation from the membership KV and merges the telemetry
+snapshots: counters/gauges sum across ranks, histograms merge bucket
+counts (every rank registers the same families with the same edges —
+they are code constants), and the per-rank header fields become the
+rank table diagnose/``/fleetz`` render.
+
+A dead or partitioned KV (or a world that never published) degrades
+to a LOCAL-ONLY view — this process's own payload under its own rank
+— flagged ``local_only`` so a dashboard can tell "fleet of one" from
+"fleet unreachable".
+
+Straggler detection lives here because it is a *fleet* property: a
+rank whose step p50 exceeds ``MXNET_OBS_STRAGGLER_FACTOR`` x the
+median p50 of its peers fires one ``obs_stragglers_total{rank}`` count and
+one rate-limited flight-record dump (``reason="straggler"``, the PR 6
+anomaly path).  A flagged rank re-fires only after recovering below
+the threshold first — repeated checks of a persistently slow rank
+produce exactly one event per episode.
+"""
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+
+from .. import telemetry as _tel
+from ..base import get_env
+from . import core
+
+__all__ = ["FleetView", "merge_metrics", "fleetz", "fleet_summary"]
+
+
+def merge_metrics(snapshots):
+    """Merge per-rank ``telemetry.snapshot()`` dicts into one
+    pod-level snapshot of the same shape: counter/gauge samples sum
+    per label set, histogram samples sum count/sum/bucket counts."""
+    merged = {}
+    for snap in snapshots:
+        for name, fam in (snap or {}).items():
+            dst = merged.setdefault(
+                name, {"type": fam.get("type"),
+                       "help": fam.get("help", ""), "samples": {}})
+            for s in fam.get("samples", ()):
+                key = tuple(sorted((s.get("labels") or {}).items()))
+                if fam.get("type") == "histogram":
+                    d = dst["samples"].get(key)
+                    if d is None:
+                        dst["samples"][key] = {
+                            "labels": dict(s.get("labels") or {}),
+                            "count": s.get("count", 0),
+                            "sum": s.get("sum", 0.0),
+                            "buckets": dict(s.get("buckets") or {})}
+                    else:
+                        d["count"] += s.get("count", 0)
+                        d["sum"] += s.get("sum", 0.0)
+                        for le, c in (s.get("buckets") or {}).items():
+                            d["buckets"][le] = d["buckets"].get(le, 0) + c
+                else:
+                    d = dst["samples"].get(key)
+                    if d is None:
+                        dst["samples"][key] = {
+                            "labels": dict(s.get("labels") or {}),
+                            "value": s.get("value", 0)}
+                    else:
+                        d["value"] += s.get("value", 0)
+    return {name: {"type": fam["type"], "help": fam["help"],
+                   "samples": list(fam["samples"].values())}
+            for name, fam in merged.items()}
+
+
+# ranks already flagged as stragglers (cleared on recovery), shared
+# across FleetView instances so periodic re-checks fire once/episode
+_FLAG_LOCK = threading.Lock()
+_FLAGGED = set()
+
+
+def _reset_flags():
+    with _FLAG_LOCK:
+        _FLAGGED.clear()
+
+
+class FleetView:
+    """One rank's merged view of every rank's published payload.
+
+    Construct from a joined ``Membership`` (the normal path) or a raw
+    ``(kv, generation, rank)`` triple (tests, offline snapshots)."""
+
+    def __init__(self, membership=None, kv=None, generation=None,
+                 rank=None):
+        if membership is not None:
+            kv = membership.kv
+            generation = membership.generation
+            rank = membership.rank
+        self.kv = kv
+        self.generation = generation
+        self.rank = int(rank or 0)
+        self.local_only = False
+        self._payloads = {}
+
+    # -- collection ----------------------------------------------------------
+    def refresh(self):
+        """Re-read every rank's payload.  An unreachable KV (or an
+        empty prefix) degrades to this process's OWN payload — the
+        fleet view never raises and never goes blank."""
+        payloads = {}
+        if self.kv is not None and self.generation is not None:
+            try:
+                prefix = "obs/%d" % int(self.generation)
+                for name in self.kv.list(prefix):
+                    try:
+                        r = int(name)
+                    except ValueError:
+                        continue
+                    rec = self.kv.get(core.obs_key(self.generation, r))
+                    if rec is not None:
+                        payloads[r] = rec
+            except Exception:  # noqa: BLE001 - degrade to local-only
+                payloads = {}
+        self.local_only = not payloads
+        if self.local_only:
+            payloads = {self.rank: core.local_payload(rank=self.rank)}
+        self._payloads = payloads
+        if _tel.ENABLED:
+            _tel.OBS_FLEET_RANKS.set(len(payloads))
+        return payloads
+
+    def payloads(self):
+        if not self._payloads:
+            self.refresh()
+        return self._payloads
+
+    @property
+    def ranks(self):
+        return sorted(self.payloads())
+
+    # -- merged snapshot -----------------------------------------------------
+    def merged(self):
+        """Pod-level telemetry snapshot (counter sums, histogram
+        bucket merges) across every published rank."""
+        return merge_metrics(
+            p.get("metrics") for p in self.payloads().values())
+
+    def totals(self, nonzero=True):
+        """Flat {name: fleet-summed value} from the merged snapshot
+        (histograms contribute _count/_sum) — the compact form bench
+        rows and ``/fleetz`` carry."""
+        out = {}
+        for name, fam in self.merged().items():
+            if fam["type"] == "histogram":
+                out[name + "_count"] = sum(
+                    s["count"] for s in fam["samples"])
+                out[name + "_sum"] = round(
+                    sum(s["sum"] for s in fam["samples"]), 6)
+            else:
+                out[name] = sum(s["value"] for s in fam["samples"])
+        if nonzero:
+            out = {k: v for k, v in out.items() if v}
+        return out
+
+    def table(self, now=None):
+        """Per-rank rows for diagnose/``/fleetz``: publish age, step,
+        cadence, collective wait, straggler flag."""
+        now = time.time() if now is None else now
+        flagged = self.stragglers()
+        rows = []
+        for r in self.ranks:
+            p = self._payloads[r]
+            rows.append({
+                "rank": r,
+                "pid": p.get("pid"),
+                "age_s": round(max(0.0, now - float(p.get("wall", now))),
+                               3),
+                "step": p.get("step"),
+                "steps_observed": p.get("steps_observed", 0),
+                "step_p50_s": p.get("step_p50_s"),
+                "collective_wait_p50_s": p.get("collective_wait_p50_s"),
+                "monitor": (p.get("monitor") or {}).get("enabled"),
+                "straggler": r in flagged,
+            })
+        return rows
+
+    # -- straggler detection -------------------------------------------------
+    def stragglers(self, factor=None):
+        """Ranks whose step p50 exceeds ``factor`` x the median p50 of
+        their PEERS (leave-one-out median; needs >= 2 ranks reporting
+        cadence).  Excluding the candidate itself matters in small
+        fleets: with 2 ranks an all-rank median averages the slow rank
+        in, so a 50x straggler would never clear a 2x factor."""
+        if factor is None:
+            factor = get_env("MXNET_OBS_STRAGGLER_FACTOR", float, 2.0)
+        if factor <= 0:
+            return []
+        p50s = {r: p.get("step_p50_s")
+                for r, p in self.payloads().items()
+                if p.get("step_p50_s")}
+        if len(p50s) < 2:
+            return []
+        out = []
+        for r, v in p50s.items():
+            peers = [x for rr, x in p50s.items() if rr != r]
+            peer_median = statistics.median(peers)
+            if peer_median > 0 and v > factor * peer_median:
+                out.append(r)
+        return sorted(out)
+
+    def check_stragglers(self, factor=None, fire=True):
+        """Detect stragglers and fire the anomaly path for NEWLY
+        flagged ranks: one ``obs_stragglers_total{rank}`` count + one
+        rate-limited flight-record dump (``reason="straggler"``) per
+        episode.  Recovered ranks unflag and may fire again later.
+        Returns the currently-flagged rank list.  Never raises."""
+        try:
+            slow = set(self.stragglers(factor=factor))
+            p50s = {r: p.get("step_p50_s")
+                    for r, p in self.payloads().items()}
+            with _FLAG_LOCK:
+                fresh = slow - _FLAGGED
+                _FLAGGED.difference_update(
+                    r for r in list(_FLAGGED)
+                    if r in p50s and r not in slow)
+                _FLAGGED.update(fresh)
+            if fire:
+                for r in sorted(fresh):
+                    if _tel.ENABLED:
+                        _tel.OBS_STRAGGLERS.labels(rank=str(r)).inc()
+                    from ..trace import anomaly
+
+                    anomaly.straggler(extra={
+                        "rank": r,
+                        "step_p50_s": p50s.get(r),
+                        "fleet_median_p50_s": statistics.median(
+                            v for v in p50s.values() if v),
+                        "factor": factor if factor is not None else
+                        get_env("MXNET_OBS_STRAGGLER_FACTOR",
+                                float, 2.0),
+                        "detected_by_rank": self.rank})
+            return sorted(slow)
+        except Exception:  # noqa: BLE001 - detector must never raise
+            return []
+
+    # -- prometheus export ---------------------------------------------------
+    def prometheus(self):
+        """Prometheus text exposition of every rank's samples with a
+        ``rank`` label appended (aggregation across ranks belongs to
+        the TSDB; HELP/TYPE once per family)."""
+        fams = {}
+        payloads = self.payloads()
+        for r in sorted(payloads):
+            for name, fam in (payloads[r].get("metrics") or {}).items():
+                fams.setdefault(name, (fam.get("type", "counter"),
+                                       fam.get("help", "")))
+        lines = []
+        for name in sorted(fams):
+            kind, help_ = fams[name]
+            lines.append("# HELP %s %s"
+                         % (name, _tel._esc_help(help_ or name)))
+            lines.append("# TYPE %s %s" % (name, kind))
+            for r in sorted(payloads):
+                fam = (payloads[r].get("metrics") or {}).get(name)
+                if fam is None:
+                    continue
+                for s in fam.get("samples", ()):
+                    labels = dict(s.get("labels") or {})
+                    labels["rank"] = str(r)
+                    if kind == "histogram":
+                        for le, c in (s.get("buckets") or {}).items():
+                            lines.append("%s_bucket%s %d" % (
+                                name,
+                                _labelstr(dict(labels, le=le)), c))
+                        lines.append("%s_sum%s %s" % (
+                            name, _labelstr(labels),
+                            repr(float(s.get("sum", 0.0)))))
+                        lines.append("%s_count%s %d" % (
+                            name, _labelstr(labels),
+                            s.get("count", 0)))
+                    else:
+                        lines.append("%s%s %s" % (
+                            name, _labelstr(labels),
+                            repr(float(s.get("value", 0.0)))))
+        return "\n".join(lines) + "\n"
+
+
+def _labelstr(labels):
+    if not labels:
+        return ""
+    return "{%s}" % ",".join(
+        '%s="%s"' % (k, _tel._esc(v)) for k, v in sorted(labels.items()))
+
+
+# ---------------------------------------------------------------------------
+# module-level conveniences (serve /fleetz, bench rows, diagnose)
+# ---------------------------------------------------------------------------
+
+def _attached_view():
+    pub = core.publisher()
+    if pub is not None and pub.membership is not None \
+            and pub.membership.generation is not None:
+        return FleetView(membership=pub.membership)
+    return FleetView(rank=0)  # local-only world of one
+
+
+def fleetz():
+    """The ``/fleetz`` JSON document: enabled flag, rank table, fleet
+    totals, straggler flags, SLO states.  Fail-soft: always returns a
+    dict, degraded sections omitted."""
+    if not core.ENABLED:
+        return {"enabled": False}
+    try:
+        view = _attached_view()
+        view.refresh()
+        doc = {
+            "enabled": True,
+            "generation": view.generation,
+            "rank": view.rank,
+            "local_only": view.local_only,
+            "ranks": view.table(),
+            "stragglers": view.stragglers(),
+            "totals": view.totals(),
+        }
+        try:
+            from . import slo_engine
+
+            if slo_engine.registered():
+                doc["slo"] = slo_engine.states()
+        except Exception:  # noqa: BLE001
+            pass
+        return doc
+    except Exception as exc:  # noqa: BLE001 - endpoint must not 500
+        return {"enabled": True, "error": str(exc)[:200]}
+
+
+def fleet_summary():
+    """Compact fleet block for bench rows (fail-soft like bench's
+    ``_monitor_summary``): ranks seen, straggler flags, SLO states."""
+    if not core.ENABLED:
+        return {}
+    try:
+        view = _attached_view()
+        view.refresh()
+        out = {"ranks_seen": len(view.ranks),
+               "local_only": view.local_only,
+               "stragglers": view.stragglers()}
+        try:
+            from . import slo_engine
+
+            if slo_engine.registered():
+                out["slo"] = slo_engine.states()
+        except Exception:  # noqa: BLE001
+            pass
+        return out
+    except Exception:  # noqa: BLE001
+        return {}

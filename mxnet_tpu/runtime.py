@@ -36,6 +36,17 @@ def _monitor_enabled():
         return False
 
 
+def _obs_enabled():
+    """mx.obs fleet observability: built in, but OFF unless armed
+    (MXNET_OBS=1 or mxnet_tpu.obs.enable())."""
+    try:
+        from . import obs as _obs
+
+        return _obs.is_enabled()
+    except Exception:
+        return False
+
+
 def _autotune_enabled():
     """mx.autotune self-tuning: built in, but OFF unless armed
     (MXNET_AUTOTUNE=1|search or mxnet_tpu.autotune.enable())."""
@@ -114,6 +125,7 @@ def _detect():
     out["STEP_CAPTURE"] = _DynamicFeature("STEP_CAPTURE",
                                           _step_capture_enabled)
     out["AUTOTUNE"] = _DynamicFeature("AUTOTUNE", _autotune_enabled)
+    out["OBS"] = _DynamicFeature("OBS", _obs_enabled)
     return out
 
 

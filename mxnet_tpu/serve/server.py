@@ -308,7 +308,24 @@ class Server:
                 "nonfinite_batches": telemetry.value(
                     "serve_nonfinite_batches_total"),
             },
+            # mx.obs SLO engine: per-objective OK/WARN/PAGE + burn
+            # rates (None when no objectives are registered)
+            "slo": self._slo_states(),
         }
+
+    @staticmethod
+    def _slo_states():
+        """Evaluated SLO results for /statz and /healthz, or None
+        when the obs plane is off / nothing registered.  Fail-soft:
+        a sick SLO engine must not take the stats endpoint down."""
+        try:
+            from ..obs import slo_engine
+
+            if not slo_engine.registered():
+                return None
+            return slo_engine.evaluate()
+        except Exception:  # noqa: BLE001
+            return None
 
     # -- submission ---------------------------------------------------------
     def _normalize(self, inputs):
@@ -496,9 +513,21 @@ class _Handler(BaseHTTPRequestHandler):
                 breakers = srv.breakers()
                 degraded = any(b["state"] != "closed"
                                for b in breakers.values())
-                self._send(200, {
-                    "status": "degraded" if degraded else "ok",
-                    "breakers": breakers})
+                body = {"status": "degraded" if degraded else "ok",
+                        "breakers": breakers}
+                # an SLO past WARN degrades liveness the same way an
+                # open breaker does: alive, but tell the router
+                slo = srv._slo_states()
+                if slo is not None:
+                    worst = max((s.get("state", "OK") for s in
+                                 slo.values()),
+                                key=lambda st: {"OK": 0, "WARN": 1,
+                                                "PAGE": 2}.get(st, 0))
+                    body["slo"] = {k: s.get("state", "OK")
+                                   for k, s in slo.items()}
+                    if worst != "OK":
+                        body["status"] = "degraded"
+                self._send(200, body)
             else:
                 self._send(503, {"status": "down",
                                  "breakers": srv.breakers()})
@@ -511,6 +540,10 @@ class _Handler(BaseHTTPRequestHandler):
                        content_type="text/plain; version=0.0.4")
         elif self.path == "/statz":
             self._send(200, srv.stats())
+        elif self.path == "/fleetz":
+            from .. import obs as _obs
+
+            self._send(200, _obs.fleetz())
         else:
             self._send(404, {"error": "unknown path %s" % self.path})
 

@@ -58,6 +58,7 @@ import time as _time
 
 import numpy as _np
 
+from .. import obs as _obs
 from .. import telemetry as _tel
 from .. import trace as _trace
 from ..base import MXNetError, get_env
@@ -240,7 +241,7 @@ class _Captured:
                  "cfn_ok", "fingerprint", "provenance", "gate",
                  "monitor", "remat", "segments", "donation",
                  "gmesh", "level", "param_shardings", "grad_shardings",
-                 "state_shardings", "replicated", "wire")
+                 "state_shardings", "replicated", "wire", "flops")
 
     def __init__(self):
         self.bucket_bytes = 0
@@ -253,6 +254,7 @@ class _Captured:
         self.provenance = "fresh"
         self.gmesh = None
         self.level = 0
+        self.flops = None
 
     def call(self, *args):
         with _mt._quiet_donation():
@@ -547,6 +549,7 @@ class StepProgram:
                 else cap.gmesh.describe(),
                 "wire": None if cap.wire is None else dict(cap.wire),
                 "host_scalar_slots": len(cap.slot_fns or ()),
+                "flops": cap.flops,
                 "segments": list(cap.segments),
                 "donation": dict(cap.donation),
                 "bucket_plan": [list(b) for b in cap.bucket_plan],
@@ -571,14 +574,28 @@ class StepProgram:
         self._path_counts["stitched"] += 1
         if _tel.ENABLED:
             _tel.STEP_CAPTURE_STEPS.labels(path="stitched").inc()
+        obs_on = _obs.core.ENABLED
+        step = self._trainer._step_count
+        t0 = _time.perf_counter() if obs_on else 0.0
         with _trace.span("train_step", hist=False, args={"captured": 0}):
             with _trace.span("forward", hist=False):
                 with autograd.record():
                     out = self._block(*datas)
                     loss = self._loss_fn(out, *labels)
+            t1 = _time.perf_counter() if obs_on else 0.0
             with _trace.span("backward", hist=False):
                 loss.backward()
+            t2 = _time.perf_counter() if obs_on else 0.0
             self._trainer.step(batch_size)
+        if obs_on:
+            # note_step already fired inside trainer.step; attribution
+            # is this path's responsibility (never raises)
+            t3 = _time.perf_counter()
+            _obs.attribution.observe_step(
+                step, t3 - t0,
+                parts={"forward": t1 - t0, "backward": t2 - t1,
+                       "update": t3 - t2},
+                path="stitched")
         return loss
 
     def _note_fallback(self, reason, detail=""):
@@ -849,6 +866,16 @@ class StepProgram:
                 raise CaptureError("trace_error",
                                    "no host state recorded")
             if lowered is not None:
+                try:
+                    # XLA's own FLOP count for the whole-step program
+                    # — the numerator of the mx.obs MFU estimate
+                    cost = lowered.cost_analysis()
+                    if isinstance(cost, (list, tuple)):
+                        cost = cost[0] if cost else {}
+                    cap.flops = float(cost.get("flops")) \
+                        if cost.get("flops") else None
+                except Exception:  # noqa: BLE001 - optional metadata
+                    cap.flops = None
                 from ..compile.aot import attach_lowered
 
                 with _trace.span("step_compile", hist=False):
@@ -1008,7 +1035,9 @@ class StepProgram:
         trainer = self._trainer
         opt = trainer._optimizer
         step = trainer._step_count
-        t0 = _time.perf_counter() if _tel.ENABLED else 0.0
+        obs_on = _obs.core.ENABLED
+        t0 = _time.perf_counter() if (_tel.ENABLED or obs_on) else 0.0
+        _m = [0.0] * 6  # obs phase marks: slots/stage/dispatch/wb/pub
         with _trace.span("train_step", hist=False, anomaly=True,
                          args={"step": step, "captured": 1}), \
                 _trace.watchdog.watch("train_step"):
@@ -1033,19 +1062,27 @@ class StepProgram:
                 # mx.resilience drill site, AFTER the count bump: a
                 # transient here exercises the supervisor rewind path
                 _inject.fire("step_capture", seq=step)
+                if obs_on:
+                    _m[0] = _time.perf_counter()
                 with _trace.span("step_slots", hist=False):
                     vals = _np.zeros((cap.n_slots,), _np.float32)
                     for k, f in enumerate(cap.slot_fns):
                         vals[k] = f()
+                if obs_on:
+                    _m[1] = _time.perf_counter()
                 inputs, lbls, vals, rng = self._stage(
                     cap, [x._data for x in datas],
                     [y._data for y in labels], vals, rng)
+                if obs_on:
+                    _m[2] = _time.perf_counter()
                 with _trace.span("step_dispatch", hist=False,
                                  args={"groups": len(cap.group_list),
                                        "buckets": len(cap.bucket_plan)}):
                     out = self._dispatch(
                         cap, train_datas, state_trees, other_datas,
                         vals, rng, inputs, lbls)
+                if obs_on:
+                    _m[3] = _time.perf_counter()
             except Exception:
                 self._rewind(prev_counts, prev_num_update)
                 raise
@@ -1070,6 +1107,8 @@ class StepProgram:
                         p = named.get(pkey)
                         if p is not None:
                             p._data._data = val
+                if obs_on:
+                    _m[4] = _time.perf_counter()
                 applied = True
                 if cap.monitor:
                     entries = list(zip(cap.labels, statvecs))
@@ -1083,6 +1122,8 @@ class StepProgram:
                             # counters before surfacing
                             self._rewind(prev_counts, prev_num_update)
                             raise
+                    if obs_on:
+                        _m[5] = _time.perf_counter()
                     if verdict == "skip":
                         self._rewind(prev_counts, prev_num_update)
                         self._skipped += 1
@@ -1116,6 +1157,21 @@ class StepProgram:
                     _tel.STEP_CAPTURE_STEPS.labels(path="captured").inc()
                     _tel.STEP_PROGRAM_SECONDS.observe(
                         _time.perf_counter() - t0)
+                if obs_on:
+                    try:
+                        total = _time.perf_counter() - t0
+                        parts = {"slots": _m[1] - _m[0],
+                                 "stage": _m[2] - _m[1],
+                                 "dispatch": _m[3] - _m[2],
+                                 "writeback": _m[4] - _m[3]}
+                        if _m[5]:
+                            parts["host_publish"] = _m[5] - _m[4]
+                        _obs.core.note_step(total)
+                        _obs.attribution.observe_step(
+                            step, total, parts=parts,
+                            flops=cap.flops, path="captured")
+                    except Exception:  # noqa: BLE001 - obs never
+                        pass            # raises into the step
             except Exception as exc:
                 exc.mx_step_no_fallback = True
                 raise

@@ -536,6 +536,12 @@ def _esc(v):
         .replace("\n", r"\n")
 
 
+def _esc_help(v):
+    # HELP text escapes only backslash and newline (the exposition
+    # format spec) — quotes stay literal, unlike label values
+    return str(v).replace("\\", r"\\").replace("\n", r"\n")
+
+
 def _labelstr(metric, values, extra=()):
     pairs = list(zip(metric.labelnames, values)) + list(extra)
     if not pairs:
@@ -548,8 +554,7 @@ def prometheus():
     sample lines per registered metric)."""
     lines = []
     for name, m in list(_REGISTRY.items()):
-        if m.help:
-            lines.append("# HELP %s %s" % (name, _esc(m.help)))
+        lines.append("# HELP %s %s" % (name, _esc_help(m.help or name)))
         lines.append("# TYPE %s %s" % (name, m.kind))
         for values, child in m._samples():
             if m.kind == "histogram":
@@ -599,7 +604,16 @@ _logger_started = False
 
 def log_line():
     """One compact 'telemetry k=v ...' line over the nonzero totals
-    (histograms carry their bucket-estimated p50/p95/p99)."""
+    (histograms carry their bucket-estimated p50/p95/p99).  Registered
+    SLOs are evaluated first so their state/burn gauges are fresh in
+    the same line."""
+    try:
+        from .obs import slo_engine as _slo
+
+        if _slo.registered():
+            _slo.evaluate()
+    except Exception:  # noqa: BLE001 - the log line must never fail
+        pass
     tot = totals(nonzero=True, quantiles=True)
     body = " ".join(
         "%s=%s" % (k, ("%d" % v) if float(v).is_integer() else
@@ -1072,5 +1086,41 @@ DATA_READ_RETRIES = counter(
 DATA_RESUMES = counter(
     "data_resumes_total",
     "mid-epoch cursor restores (checkpoint resume of the stream)")
+# mx.obs (obs/): the fleet-wide observability plane — cross-rank
+# snapshot publishing over the membership KV, straggler detection,
+# SLO burn rates, and per-step attribution.  Publish failures are the
+# "fleet view degraded to local-only" signal.
+OBS_PUBLISHES = counter(
+    "obs_publish_total",
+    "per-rank obs payloads published into the membership KV")
+OBS_PUBLISH_FAILURES = counter(
+    "obs_publish_failures_total",
+    "obs payload publishes that failed (dead/partitioned KV; the "
+    "fleet view degrades to local-only until it recovers)")
+OBS_STRAGGLERS = counter(
+    "obs_stragglers_total",
+    "straggler episodes flagged per rank (step p50 above "
+    "MXNET_OBS_STRAGGLER_FACTOR x the fleet median)", ("rank",))
+OBS_SLO_STATE = gauge(
+    "obs_slo_state",
+    "per-objective SLO state (0=OK 1=WARN 2=PAGE, multi-window "
+    "burn-rate evaluation)", ("slo",))
+OBS_SLO_BURN = gauge(
+    "obs_slo_burn_rate",
+    "error-budget burn rate per objective and window (1.0 = burning "
+    "exactly the budget)", ("slo", "window"))
+OBS_STEP_SECONDS = histogram(
+    "obs_step_seconds",
+    "training-step wall time as seen by the obs cadence hook",
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0, 60.0))
+OBS_ATTRIB_RECORDS = counter(
+    "obs_attribution_records_total",
+    "per-step attribution records written (JSONL stream when "
+    "MXNET_OBS_ATTRIBUTION is set)")
+OBS_FLEET_RANKS = gauge(
+    "obs_fleet_ranks",
+    "ranks visible in the last fleet-view refresh (1 + local_only "
+    "means the membership KV is unreachable)")
 
 start_logger()

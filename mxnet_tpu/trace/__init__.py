@@ -20,6 +20,7 @@ Surface::
 Env knobs: ``MXNET_TRACE_DISABLE``, ``MXNET_TRACE_RING_EVENTS``,
 ``MXNET_TRACE_DUMP_DIR``, ``MXNET_TRACE_DUMP_ON_CRASH``,
 ``MXNET_TRACE_DUMP_AT_EXIT``, ``MXNET_TRACE_DUMP_MIN_SECONDS``,
+``MXNET_TRACE_DUMP_MAX_EVENTS``,
 ``MXNET_TRACE_SLOW_STEP_FACTOR``, ``MXNET_TRACE_DEADLINE_BURST`` /
 ``_WINDOW``, ``MXNET_TRACE_WATCHDOG`` / ``_SECONDS``.
 """

@@ -30,7 +30,7 @@ from ..base import get_env
 from . import core, export
 
 __all__ = ["SlowStepDetector", "DeadlineMissMonitor", "observe_step",
-           "deadline_miss", "divergence", "on_divergence",
+           "deadline_miss", "divergence", "straggler", "on_divergence",
            "remove_divergence_listener", "STEP_DETECTOR",
            "DEADLINE_MONITOR"]
 
@@ -189,3 +189,16 @@ def divergence(extra=None):
     if not core.ENABLED:
         return None
     return export.dump_async("divergence", extra=extra)
+
+
+def straggler(extra=None):
+    """Dump the flight record for a fleet straggler event (mx.obs:
+    a rank's step p50 drifted past MXNET_OBS_STRAGGLER_FACTOR x the
+    fleet median).  ``extra`` names the rank, its p50, and the fleet
+    median so the dump is self-describing.  Async + rate-limited per
+    ``MXNET_TRACE_DUMP_MIN_SECONDS`` like every anomaly reason — a
+    persistently slow rank produces one dump per window, not one per
+    fleet-view refresh."""
+    if not core.ENABLED:
+        return None
+    return export.dump_async("straggler", extra=extra)

@@ -114,6 +114,20 @@ def _default_path(reason):
                         % (os.getpid(), reason, next(_SEQ)))
 
 
+def _cap_events(events, extra):
+    """Apply ``MXNET_TRACE_DUMP_MAX_EVENTS`` (0/unset = the full
+    ring): keep the NEWEST events — the anomaly moment is at the tail
+    — and record the truncation in the doc's ``extra`` block so a
+    reader knows the window was clipped."""
+    cap = get_env("MXNET_TRACE_DUMP_MAX_EVENTS", int, 0)
+    if cap <= 0 or len(events) <= cap:
+        return events, extra
+    extra = dict(extra or {})
+    extra["truncated_events"] = len(events) - cap
+    extra["dump_max_events"] = cap
+    return events[-cap:], extra
+
+
 def _write_doc(path, reason, events, extra, rollback):
     """The shared dump tail: build the document, write it ATOMICALLY
     (tmp + rename — the advertised path is logged/returned before or
@@ -160,6 +174,7 @@ def dump(path=None, reason="manual", events=None, extra=None):
         return None
     if path is None:
         path = _default_path(reason)
+    events, extra = _cap_events(events, extra)
     return _write_doc(path, reason, events, extra, rollback)
 
 
@@ -179,6 +194,7 @@ def dump_async(reason, extra=None):
     if rollback is None:
         return None
     path = _default_path(reason)
+    events, extra = _cap_events(events, extra)
     threading.Thread(
         target=_write_doc, args=(path, reason, events, extra, rollback),
         daemon=True, name="mx-trace-dump").start()

@@ -1,0 +1,117 @@
+"""Multi-process mx.obs fleet drill worker (ISSUE-16 acceptance).
+
+One rank of a 2-process fleet-observability drill, launched by
+``tools/launch.py`` (which exports ``MXNET_DIST_RANK`` /
+``MXNET_DIST_NUM_WORKERS`` / ``MXNET_DIST_MEMBER_DIR``).  Each rank:
+
+1. joins membership and attaches the obs publisher (payloads ride the
+   heartbeat thread from then on);
+2. trains a few REAL imperative steps — the ``Trainer.step`` cadence
+   hook feeds ``note_step`` on the live path;
+3. seeds the cadence window deterministically (``--slow-rank`` gets
+   ``--slow-s`` steps, everyone else ``--fast-s``) so the straggler
+   math is exact regardless of host jitter;
+4. force-publishes, barriers, and refreshes a :class:`FleetView` —
+   asserting it merged EVERY rank's payload (the cross-rank
+   aggregation acceptance);
+5. rank 0 runs ``check_stragglers`` twice and reports the flagged
+   ranks, the ``obs_stragglers_total`` counter, and how many
+   ``reason="straggler"`` flight-record dumps were written — the
+   driver asserts exactly ONE episode fired despite repeated checks.
+
+Machine-checkable lines the driver asserts on::
+
+    rank 0 FLEET ranks=0,1 local_only=False publishes=2
+    rank 0 STRAGGLERS flagged=[1] counter=1 dumps=1
+    rank 1 FINAL OK
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, obs, telemetry, trace
+from mxnet_tpu.gluon import nn
+
+
+def train_steps(n=2):
+    """A few real imperative steps so the live Trainer.step cadence
+    hook runs (the seeded window below makes the p50s deterministic)."""
+    mx.random.seed(7)
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.L2Loss()
+    rs = np.random.RandomState(11)
+    for _ in range(n):
+        x = mx.nd.array(rs.rand(4, 8).astype(np.float32))
+        y = mx.nd.array(rs.rand(4, 4).astype(np.float32))
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(4)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=2)
+    ap.add_argument("--seed-steps", type=int, default=24)
+    ap.add_argument("--slow-rank", type=int, default=1)
+    ap.add_argument("--slow-s", type=float, default=0.5)
+    ap.add_argument("--fast-s", type=float, default=0.01)
+    args = ap.parse_args()
+
+    obs.enable()
+    membership = mx.dist.join()
+    rank = membership.rank
+    pub = obs.attach(membership)
+
+    train_steps(args.steps)
+    assert obs.core.step_stats()["steps_observed"] >= args.steps, \
+        "Trainer.step cadence hook did not observe the live steps"
+
+    # deterministic cadence: the straggler math must not depend on
+    # host jitter in a CPU container
+    obs.core.reset_steps()
+    dur = args.slow_s if rank == args.slow_rank else args.fast_s
+    for _ in range(args.seed_steps):
+        obs.core.note_step(dur)
+
+    assert pub.publish(), "forced obs publish failed"
+    membership.barrier("published")
+
+    view = obs.FleetView(membership=membership)
+    view.refresh()
+    merged = view.totals()
+    print("rank %d FLEET ranks=%s local_only=%s publishes=%d"
+          % (rank, ",".join(str(r) for r in view.ranks),
+             view.local_only, int(merged.get("obs_publish_total", 0))))
+    sys.stdout.flush()
+
+    if rank == 0:
+        flagged = view.check_stragglers()
+        # a second check of the same episode must NOT re-fire
+        view.refresh()
+        again = view.check_stragglers()
+        assert flagged == again, (flagged, again)
+        time.sleep(0.3)  # let the async dump thread land
+        counter = telemetry.value("obs_stragglers_total")
+        dumps = [p for r, p in trace.last_dumps() if r == "straggler"]
+        print("rank 0 STRAGGLERS flagged=%s counter=%d dumps=%d"
+              % (flagged, int(counter), len(dumps)))
+        sys.stdout.flush()
+
+    membership.barrier("checked")
+    membership.leave("done")
+    print("rank %d FINAL OK" % rank)
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -129,6 +129,45 @@ def test_prometheus_parses_line_by_line():
     assert "t_promh_seconds_count 1" in text
 
 
+def test_prometheus_hostile_labels_round_trip():
+    """Label values containing the three characters the exposition
+    format escapes (backslash, double-quote, newline) must survive an
+    export -> parse round trip bit-identically."""
+    hostile = 'a\\b"c\nd'
+    telemetry.counter("t_evil_total", "h", ("k",)).labels(
+        k=hostile).inc(5)
+    text = telemetry.prometheus()
+    line = next(l for l in text.splitlines()
+                if l.startswith("t_evil_total{"))
+    m = re.match(r't_evil_total\{k="((?:[^"\\]|\\.)*)"\} 5\.0$', line)
+    assert m, line
+    unescaped = m.group(1).replace("\\\\", "\0").replace(
+        '\\"', '"').replace("\\n", "\n").replace("\0", "\\")
+    assert unescaped == hostile
+    # the raw control characters must NOT leak into the exposition
+    assert "\n" not in line
+
+
+def test_prometheus_help_and_type_every_family():
+    """Every exported family carries BOTH a # HELP and a # TYPE line
+    (unconditionally — even families registered with empty help), and
+    HELP text escapes backslash/newline per the exposition spec."""
+    telemetry.counter("t_nohelp_total", "").inc()
+    telemetry.gauge("t_helped", "multi\nline \\ help").set(1)
+    text = telemetry.prometheus()
+    helped = set()
+    typed = set()
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            helped.add(line.split()[2])
+        elif line.startswith("# TYPE "):
+            typed.add(line.split()[2])
+    for name in telemetry.snapshot():
+        assert name in helped, "missing # HELP for %s" % name
+        assert name in typed, "missing # TYPE for %s" % name
+    assert "# HELP t_helped multi\\nline \\\\ help" in text
+
+
 # ---------------------------------------------------------------------------
 # timers + profiler bridge
 # ---------------------------------------------------------------------------

@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Perf-regression gate: diff a fresh bench run against the committed
+baselines and exit non-zero on a regression.
+
+The committed baselines are the ``BENCH_*.json`` wrappers at the repo
+root ({n, cmd, rc, tail, parsed}); a fresh run is whatever
+``python bench.py`` just printed (JSON-lines on stdout, or a file in
+any of the accepted shapes).  The gate is noise-aware:
+
+- every baseline observation of a metric is pooled and reduced by a
+  **trimmed mean** (drop the single min and max when >= 3 samples) —
+  one anomalous historical row cannot move the bar;
+- the comparison direction comes from the metric's **unit**:
+  throughput units (img/s, tok/s, req/s, /s, MB/s) regress when the
+  fresh value is LOWER; latency units (ms, s, us) regress when it is
+  HIGHER;
+- the threshold is ``MXNET_OBS_REGRESSION_PCT`` (default 10%): a
+  fresh value worse than the trimmed baseline mean by more than the
+  threshold fails the gate;
+- rows with ``value: null`` or an ``error`` field (backend
+  unavailable) are skipped on BOTH sides — a CPU container must pass
+  against TPU baselines by comparing nothing, loudly;
+- nothing comparable at all exits 0 with a warning: an empty gate is
+  a visible no-op, never a fake green with teeth.
+
+Usage:
+    python tools/bench_gate.py --fresh fresh.jsonl [--baseline-dir .]
+    python bench.py | python tools/bench_gate.py
+    python tools/bench_gate.py --fresh fresh.jsonl --json
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+THROUGHPUT_UNITS = ("img/s", "tok/s", "req/s", "mb/s", "gb/s", "/s",
+                    "items/s", "steps/s")
+LATENCY_UNITS = ("us", "ms", "s", "seconds")
+
+
+def parse_rows(text):
+    """Bench rows from any accepted shape: a BENCH_*.json wrapper
+    (rows are JSON lines inside "tail" + the "parsed" dict), a JSON
+    list of rows, a single row dict, or plain JSON-lines text.
+    Returns [dict] with at least {metric, value, unit}."""
+    rows = []
+    text = text.strip()
+    if not text:
+        return rows
+    doc = None
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        pass
+    if isinstance(doc, dict) and "tail" in doc:
+        rows.extend(_jsonl_rows(doc.get("tail") or ""))
+        if not rows and isinstance(doc.get("parsed"), dict):
+            rows.append(doc["parsed"])
+        return [r for r in rows if _usable(r)]
+    if isinstance(doc, list):
+        return [r for r in doc if _usable(r)]
+    if isinstance(doc, dict):
+        return [doc] if _usable(doc) else []
+    return [r for r in _jsonl_rows(text) if _usable(r)]
+
+
+def _jsonl_rows(text):
+    rows = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            rows.append(rec)
+    return rows
+
+
+def _usable(row):
+    return (isinstance(row, dict) and row.get("metric")
+            and row.get("value") is not None
+            and not row.get("error"))
+
+
+def trimmed_mean(values):
+    """Mean after dropping the single min and max (>= 3 samples);
+    plain mean otherwise."""
+    vals = sorted(float(v) for v in values)
+    if len(vals) >= 3:
+        vals = vals[1:-1]
+    return sum(vals) / len(vals)
+
+
+def direction(unit):
+    """'higher' / 'lower' = which side is BETTER, from the unit."""
+    u = str(unit or "").strip().lower()
+    if u in LATENCY_UNITS:
+        return "lower"
+    if u in THROUGHPUT_UNITS or u.endswith("/s"):
+        return "higher"
+    return "higher"  # unit-less scores: bigger is better
+
+
+def load_baselines(baseline_dir, pattern="BENCH_*.json"):
+    """{metric: {"values": [...], "unit": u, "files": n}} pooled over
+    every readable baseline wrapper."""
+    pools = {}
+    for path in sorted(glob.glob(os.path.join(baseline_dir, pattern))):
+        try:
+            with open(path) as f:
+                rows = parse_rows(f.read())
+        except (OSError, ValueError):
+            continue
+        for r in rows:
+            p = pools.setdefault(r["metric"],
+                                 {"values": [], "unit": r.get("unit"),
+                                  "files": 0})
+            p["values"].append(float(r["value"]))
+            p["files"] += 1
+    return pools
+
+
+def gate(fresh_rows, pools, threshold_pct):
+    """-> (verdicts, regressed?).  One verdict per fresh metric:
+    {metric, fresh, baseline, delta_pct, direction, status}."""
+    verdicts = []
+    regressed = False
+    for r in fresh_rows:
+        name = r["metric"]
+        pool = pools.get(name)
+        if not pool or not pool["values"]:
+            verdicts.append({"metric": name, "status": "no_baseline",
+                             "fresh": r["value"]})
+            continue
+        base = trimmed_mean(pool["values"])
+        fresh = float(r["value"])
+        better = direction(r.get("unit") or pool.get("unit"))
+        if base == 0:
+            verdicts.append({"metric": name, "status": "zero_baseline",
+                             "fresh": fresh})
+            continue
+        # positive delta = worse, regardless of direction
+        delta = (base - fresh) / abs(base) if better == "higher" \
+            else (fresh - base) / abs(base)
+        delta_pct = round(delta * 100.0, 3)
+        status = "ok"
+        if delta_pct > threshold_pct:
+            status = "regression"
+            regressed = True
+        verdicts.append({"metric": name, "status": status,
+                         "fresh": fresh, "baseline": round(base, 4),
+                         "samples": len(pool["values"]),
+                         "direction": better,
+                         "delta_pct": delta_pct,
+                         "threshold_pct": threshold_pct})
+    return verdicts, regressed
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="fail CI when a fresh bench run regressed vs the "
+        "committed BENCH_*.json baselines")
+    ap.add_argument("--fresh", default="-",
+                    help="fresh bench output (JSONL / wrapper / list); "
+                    "'-' = stdin")
+    ap.add_argument("--baseline-dir",
+                    default=os.path.join(os.path.dirname(
+                        os.path.abspath(__file__)), ".."),
+                    help="directory holding BENCH_*.json (repo root)")
+    ap.add_argument("--pattern", default="BENCH_*.json")
+    ap.add_argument("--threshold-pct", type=float, default=None,
+                    help="override MXNET_OBS_REGRESSION_PCT")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the verdict list as JSON")
+    args = ap.parse_args(argv)
+
+    threshold = args.threshold_pct
+    if threshold is None:
+        try:
+            threshold = float(
+                os.environ.get("MXNET_OBS_REGRESSION_PCT", "") or 10.0)
+        except ValueError:
+            threshold = 10.0
+
+    if args.fresh == "-":
+        text = sys.stdin.read()
+    else:
+        with open(args.fresh) as f:
+            text = f.read()
+    fresh_rows = parse_rows(text)
+    pools = load_baselines(args.baseline_dir, args.pattern)
+    verdicts, regressed = gate(fresh_rows, pools, threshold)
+
+    compared = [v for v in verdicts if "delta_pct" in v]
+    if args.json:
+        print(json.dumps({"threshold_pct": threshold,
+                          "verdicts": verdicts,
+                          "regressed": regressed}, indent=2))
+    else:
+        for v in verdicts:
+            if "delta_pct" in v:
+                print("%-12s %s fresh=%s baseline=%s (%+0.2f%% worse, "
+                      "limit %g%%, %s-is-better, n=%d)"
+                      % (v["status"].upper(), v["metric"], v["fresh"],
+                         v["baseline"], v["delta_pct"],
+                         v["threshold_pct"], v["direction"],
+                         v["samples"]))
+            else:
+                print("%-12s %s fresh=%s"
+                      % (v["status"].upper(), v["metric"],
+                         v.get("fresh")))
+    if not compared:
+        print("bench_gate: WARNING nothing comparable (%d fresh rows, "
+              "%d baseline metrics) — gate is a no-op"
+              % (len(fresh_rows), len(pools)), file=sys.stderr)
+        return 0
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -111,6 +111,39 @@ def framework_info(device_check=True):
               "retry with JAX_PLATFORMS=cpu")
 
 
+def _snapshot_quantiles(fam, qs=(0.5, 0.95, 0.99)):
+    """Bucket-estimated quantiles computed FROM a snapshot family dict
+    (merging its label children) — works on synthetic/offline
+    snapshots, not just the live registry."""
+    from mxnet_tpu.telemetry import _bucket_quantile
+
+    count = sum(s.get("count", 0) for s in fam.get("samples", ()))
+    if not count:
+        return {}
+    merged = {}
+    for s in fam.get("samples", ()):
+        for le, c in (s.get("buckets") or {}).items():
+            merged[le] = merged.get(le, 0) + c
+    cum = sorted((float("inf") if le == "+Inf" else float(le), c)
+                 for le, c in merged.items())
+    return {q: _bucket_quantile(cum, count, q) for q in qs}
+
+
+def _quantile_lines(snap):
+    """The quantile-table lines for a snapshot dict (pure — golden
+    tests feed a synthetic snapshot and compare output verbatim)."""
+    lines = []
+    for name, m in sorted(snap.items()):
+        if m.get("type") != "histogram":
+            continue
+        qs = _snapshot_quantiles(m)
+        if not qs:
+            continue
+        lines.append("  %-38s p50=%.6g p95=%.6g p99=%.6g"
+                     % (name, qs[0.5], qs[0.95], qs[0.99]))
+    return lines
+
+
 def telemetry_info():
     """Live mx.telemetry snapshot (counters accumulated by this process —
     the matmul smoke and import path already populate transfer/engine
@@ -126,20 +159,75 @@ def telemetry_info():
     print("enabled      :", telemetry.ENABLED)
     print(json.dumps(snap, indent=2, sort_keys=True))
     print("totals       :", telemetry.totals(nonzero=True))
-    shown = False
-    for name, m in sorted(snap.items()):
-        if m["type"] != "histogram":
-            continue
-        qs = telemetry.histogram_quantiles(name)
-        if not qs:
-            continue
-        if not shown:
-            print("quantiles (bucket-estimated, seconds):")
-            shown = True
-        print("  %-38s p50=%.6g p95=%.6g p99=%.6g"
-              % (name, qs[0.5], qs[0.95], qs[0.99]))
-    if not shown:
+    lines = _quantile_lines(snap)
+    if lines:
+        print("quantiles (bucket-estimated, seconds):")
+        for line in lines:
+            print(line)
+    else:
         print("quantiles    : (no histogram observations)")
+
+
+def _fleet_lines(doc):
+    """The --fleet section lines for a ``/fleetz``-shaped doc (pure —
+    golden tests feed a synthetic doc and compare output verbatim)."""
+    lines = ["enabled      : %s" % doc.get("enabled")]
+    if not doc.get("enabled"):
+        lines.append("(set MXNET_OBS=1 or mxnet_tpu.obs.enable())")
+        return lines
+    if doc.get("error"):
+        lines.append("error        : %s" % doc["error"])
+        return lines
+    lines.append("generation   : %s" % doc.get("generation"))
+    lines.append("view rank    : %s%s" % (
+        doc.get("rank"),
+        "  (LOCAL-ONLY: KV unreachable or nothing published)"
+        if doc.get("local_only") else ""))
+    rows = doc.get("ranks") or []
+    if rows:
+        lines.append("%-5s %-8s %-7s %-8s %-10s %-12s %-9s %s"
+                     % ("rank", "pid", "age_s", "step", "steps_seen",
+                        "step_p50_s", "monitor", "straggler"))
+        for r in rows:
+            p50 = r.get("step_p50_s")
+            lines.append("%-5s %-8s %-7s %-8s %-10s %-12s %-9s %s"
+                         % (r.get("rank"), r.get("pid"),
+                            r.get("age_s"), r.get("step"),
+                            r.get("steps_observed"),
+                            "-" if p50 is None else "%.6g" % p50,
+                            r.get("monitor"),
+                            "YES" if r.get("straggler") else "-"))
+    stragglers = doc.get("stragglers") or []
+    lines.append("stragglers   : %s"
+                 % (", ".join(str(r) for r in stragglers)
+                    if stragglers else "(none)"))
+    for name, state in sorted((doc.get("slo") or {}).items()):
+        lines.append("slo          : %-24s %s" % (name, state))
+    totals = doc.get("totals") or {}
+    if totals:
+        lines.append("fleet totals (nonzero):")
+        for k in sorted(totals):
+            lines.append("  %-40s %s" % (k, totals[k]))
+    return lines
+
+
+def fleet_info(src="live"):
+    """mx.obs fleet view: the merged per-rank table, straggler flags,
+    SLO states, and fleet-summed totals.  ``src`` is "live" (the
+    attached membership / local-only world) or a path to a saved
+    ``/fleetz`` JSON document."""
+    section("Fleet (mx.obs)")
+    import json
+
+    if src and src != "live":
+        with open(src) as f:
+            doc = json.load(f)
+    else:
+        from mxnet_tpu import obs
+
+        doc = obs.fleetz()
+    for line in _fleet_lines(doc):
+        print(line)
 
 
 def trace_info():
@@ -991,13 +1079,20 @@ def main():
                          "view, collective deadline, world-stop flag, "
                          "and (with a root) pod-committed checkpoint "
                          "steps")
+    ap.add_argument("--fleet", nargs="?", const="live", metavar="SRC",
+                    help="mx.obs fleet view: per-rank table (publish "
+                         "age, step cadence, straggler flags), SLO "
+                         "states, fleet-summed totals — live (the "
+                         "attached membership or a local-only world; "
+                         "the default), or from a saved /fleetz JSON "
+                         "document")
     args = ap.parse_args()
     # section flags compose: --compile-cache --serve URL prints both
     # (each skips the environment dump, all honor --telemetry)
     if args.compile_cache or args.serve or args.checkpoints or \
             args.trainer or args.step or args.trace or args.monitor or \
             args.resilience or args.autotune or args.data or \
-            args.dist is not None:
+            args.dist is not None or args.fleet:
         if args.compile_cache:
             compile_cache_info()
         if args.autotune:
@@ -1008,6 +1103,8 @@ def main():
             resilience_info()
         if args.dist is not None:
             dist_info(args.dist or None)
+        if args.fleet:
+            fleet_info(args.fleet)
         if args.trainer:
             trainer_info()
         if args.step:

@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""mx.obs observability-plane smoke (make obs-smoke, CPU-only).
+
+Four stages, each an ISSUE-16 acceptance check:
+
+1. **fleet + straggler drill** — a real 2-process fleet over
+   ``tools/launch.py`` + ``tests/nightly/obs_fleet_drill.py``: every
+   rank publishes its payload into the membership KV (heartbeat-
+   piggybacked) and merges the OTHER rank's snapshot into its fleet
+   view; a seeded slow rank fires exactly ONE straggler episode (one
+   ``obs_stragglers_total`` count + one rate-limited
+   ``reason="straggler"`` flight-record dump) despite repeated checks.
+2. **SLO burn-rate engine** — a live ``serve.Server`` with a
+   registered latency objective: clean traffic evaluates OK; injected
+   slow observations trip BOTH burn windows to PAGE (visible in
+   ``/statz``, ``/healthz`` degraded, and the ``obs_slo_state``
+   gauge); once the windows pass with good-only traffic the state
+   recovers to OK and ``/healthz`` is clean again.  ``/fleetz``
+   answers on the same server.
+3. **step-time attribution** — a captured-step training run streams
+   one JSONL record per step (span-derived phase shares + FLOPs +
+   MFU against the env-pinned peak), schema-checked.
+4. **perf-regression gate** — ``tools/bench_gate.py`` fails (exit
+   non-zero) on a seeded 30% slowdown against synthetic committed
+   baselines and passes an unchanged fresh run.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# tiny SLO windows so the PAGE->OK round trip fits in a smoke
+os.environ["MXNET_OBS_SLO_FAST_SECONDS"] = "0.4"
+os.environ["MXNET_OBS_SLO_SLOW_SECONDS"] = "0.8"
+
+LAUNCH = os.path.join(REPO, "tools", "launch.py")
+WORKER = os.path.join(REPO, "tests", "nightly", "obs_fleet_drill.py")
+
+
+def stage1_fleet_drill(tmp):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "MXNET_OBS": "1",
+        "MXNET_OBS_PUBLISH_SECONDS": "0.1",
+        "MXNET_OBS_STRAGGLER_FACTOR": "3",
+        "MXNET_DIST_HEARTBEAT_SECONDS": "0.5",
+        "MXNET_DIST_DEAD_AFTER_SECONDS": "5",
+        "MXNET_DIST_BARRIER_TIMEOUT": "60",
+        "MXNET_TRACE_DUMP_DIR": os.path.join(tmp, "dumps"),
+    })
+    proc = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", "--backend", "cpu",
+         "--rendezvous", "none", "--term-grace", "25",
+         sys.executable, WORKER],
+        env=env, capture_output=True, text=True, timeout=300)
+    out = proc.stdout
+    assert proc.returncode == 0, (proc.returncode, out,
+                                  proc.stderr[-3000:])
+    # cross-rank aggregation: BOTH ranks merged the full fleet
+    fleets = re.findall(
+        r"rank (\d) FLEET ranks=0,1 local_only=False publishes=(\d+)",
+        out)
+    assert len(fleets) == 2, out
+    assert all(int(p) >= 2 for _r, p in fleets), out
+    # straggler: exactly one episode (counter=1, one dump) for rank 1
+    m = re.search(r"rank 0 STRAGGLERS flagged=\[1\] counter=1 dumps=1",
+                  out)
+    assert m, out
+    assert out.count("FINAL OK") == 2, out
+    print("stage 1 OK: 2-rank fleet merged on both ranks "
+          "(publishes=%s); seeded slow rank fired exactly one "
+          "straggler episode (counter=1, one reason=straggler dump)"
+          % fleets[0][1])
+
+
+def _http_get(host, port, path):
+    import urllib.request
+
+    with urllib.request.urlopen(
+            "http://%s:%d%s" % (host, port, path), timeout=10) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def stage2_slo_engine(tmp):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import obs, serve, telemetry
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.obs import slo_engine
+
+    obs.enable()
+
+    blk = nn.Dense(4, flatten=False, in_units=16)
+    blk.initialize()
+    blk(mx.nd.zeros((1, 2, 16)))
+    root = os.path.join(tmp, "serve-ckpt")
+    blk.save_checkpoint(root, step=1)
+    cfg = serve.ServeConfig(max_batch_size=4, max_wait_us=2000,
+                            batch_sizes=(4,), sample_shapes=[(4, 16)])
+    runner = serve.ModelRunner(
+        lambda: nn.Dense(4, flatten=False, in_units=16), root=root,
+        batch_sizes=cfg.batch_sizes, sample_shapes=cfg.sample_shapes,
+        dtype=cfg.dtype)
+    with serve.Server(runner=runner, config=cfg) as srv:
+        host, port = srv.start_http()
+        obs.slo("serve_p99_ms", histogram="serve_request_seconds",
+                q=0.99, target=0.05)
+        try:
+            # clean traffic -> OK everywhere
+            x = np.random.RandomState(0).rand(4, 16).astype("float32")
+            for _ in range(8):
+                srv.submit(x)
+            base = slo_engine.evaluate()
+            assert base["serve_p99_ms"]["state"] == "OK", base
+            status, body = _http_get(host, port, "/healthz")
+            assert status == 200 and body["status"] == "ok", body
+            assert body["slo"] == {"serve_p99_ms": "OK"}, body
+
+            # injected latency: every request 10x over target -> both
+            # burn windows saturate -> PAGE
+            for _ in range(40):
+                telemetry.SERVE_REQUEST_SECONDS.observe(0.5)
+            time.sleep(0.05)
+            paged = slo_engine.evaluate()
+            assert paged["serve_p99_ms"]["state"] == "PAGE", paged
+            assert paged["serve_p99_ms"]["burn_fast"] > 14.4, paged
+            assert telemetry.value("obs_slo_state",
+                                   labels={"slo": "serve_p99_ms"}) == 2
+            status, body = _http_get(host, port, "/healthz")
+            assert status == 200 and body["status"] == "degraded", body
+            _status, statz = _http_get(host, port, "/statz")
+            assert statz["slo"]["serve_p99_ms"]["state"] == "PAGE"
+
+            # /fleetz on the same server (local-only world of one)
+            _status, fleetz = _http_get(host, port, "/fleetz")
+            assert fleetz["enabled"] and fleetz["local_only"], fleetz
+            assert fleetz["slo"] == {"serve_p99_ms": "PAGE"}, fleetz
+
+            # recovery: let BOTH windows pass, then good-only traffic
+            time.sleep(1.0)
+            slo_engine.evaluate()
+            for _ in range(40):
+                telemetry.SERVE_REQUEST_SECONDS.observe(0.001)
+            time.sleep(0.05)
+            ok = slo_engine.evaluate()
+            assert ok["serve_p99_ms"]["state"] == "OK", ok
+            status, body = _http_get(host, port, "/healthz")
+            assert status == 200 and body["status"] == "ok", body
+        finally:
+            slo_engine.clear()
+    print("stage 2 OK: serve SLO OK -> PAGE (injected 10x latency; "
+          "/healthz degraded, /statz + /fleetz + gauge agree) -> OK "
+          "after the burn windows passed")
+
+
+def stage3_attribution(tmp):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, obs
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.obs import attribution
+
+    obs.enable()
+    stream = os.path.join(tmp, "attribution.jsonl")
+    os.environ["MXNET_OBS_ATTRIBUTION"] = stream
+    os.environ["MXNET_OBS_PEAK_TFLOPS"] = "0.001"
+    attribution.reset()
+    try:
+        net = nn.Dense(8, in_units=16)
+        net.initialize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.01})
+        program = trainer.capture(net, gluon.loss.L2Loss())
+        rs = np.random.RandomState(3)
+        for _ in range(5):
+            program(mx.nd.array(rs.rand(4, 16).astype("float32")),
+                    mx.nd.array(rs.rand(4, 8).astype("float32")))
+        with open(stream) as f:
+            recs = [json.loads(line) for line in f]
+        assert len(recs) == 5, len(recs)
+        for rec in recs:
+            assert set(attribution.SCHEMA_KEYS) <= set(rec), rec
+            assert rec["path"] == "captured" and rec["total_s"] > 0
+            assert abs(sum(rec["shares"].values()) - 1.0) < 1e-3, rec
+            assert {"slots", "stage", "dispatch", "writeback",
+                    "other"} <= set(rec["shares"]), rec
+            assert rec["flops"] and rec["flops"] > 0, rec
+            assert rec["mfu"] is not None and rec["mfu"] > 0, rec
+    finally:
+        os.environ.pop("MXNET_OBS_ATTRIBUTION", None)
+        os.environ.pop("MXNET_OBS_PEAK_TFLOPS", None)
+        attribution.reset()
+    print("stage 3 OK: 5 captured steps streamed schema-valid "
+          "attribution records (span-derived shares sum to 1, "
+          "flops=%.0f, mfu=%.4g)" % (recs[-1]["flops"],
+                                     recs[-1]["mfu"]))
+
+
+def stage4_bench_gate(tmp):
+    import bench_gate
+
+    basedir = os.path.join(tmp, "baselines")
+    os.makedirs(basedir, exist_ok=True)
+    row = {"metric": "toy_train_imgs_per_sec", "value": 100.0,
+           "unit": "img/s", "vs_baseline": 1.0}
+    for n, val in ((1, 100.0), (2, 102.0)):
+        with open(os.path.join(basedir, "BENCH_r%02d.json" % n),
+                  "w") as f:
+            json.dump({"n": n, "cmd": "bench", "rc": 0,
+                       "tail": json.dumps(dict(row, value=val)) + "\n",
+                       "parsed": dict(row, value=val)}, f)
+
+    def run(value):
+        fresh = os.path.join(tmp, "fresh.jsonl")
+        with open(fresh, "w") as f:
+            f.write(json.dumps(dict(row, value=value)) + "\n")
+        return bench_gate.main(["--fresh", fresh,
+                                "--baseline-dir", basedir])
+
+    rc_slow = run(70.0)    # seeded 30% slowdown
+    assert rc_slow != 0, "gate passed a 30% regression"
+    rc_same = run(100.5)   # unchanged baseline
+    assert rc_same == 0, "gate failed an unchanged run"
+    print("stage 4 OK: bench_gate failed the seeded 30%% slowdown "
+          "(rc=%d) and passed the unchanged baseline" % rc_slow)
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="mxnet_obs_smoke_")
+    stage1_fleet_drill(tmp)
+    stage2_slo_engine(tmp)
+    stage3_attribution(tmp)
+    stage4_bench_gate(tmp)
+    print("obs smoke OK: fleet aggregation, straggler episode, SLO "
+          "burn-rate round trip, attribution stream, regression gate")
+
+
+if __name__ == "__main__":
+    main()
