@@ -12,7 +12,8 @@ SO := build/libmxtpu_native.so
 .PHONY: native test cpptest telemetry-smoke checkpoint-smoke serve-smoke \
 	decode-smoke compile-cache-smoke trainer-smoke step-smoke \
 	trace-smoke monitor-smoke faults-smoke dist-faults-smoke \
-	zero-smoke autotune-smoke data-smoke obs-smoke smoke-all clean
+	zero-smoke autotune-smoke data-smoke obs-smoke fleet-smoke \
+	smoke-all clean
 
 native: $(SO)
 
@@ -195,6 +196,19 @@ obs-smoke:
 	JAX_PLATFORMS=cpu python -m pytest \
 	  tests/python/unittest/test_obs.py -q -m 'not slow'
 
+# mx.fleet smoke: disaggregated prefill/decode handoff round-trip
+# (byte-identical two-hop stream, corrupt blob rejected by checksum,
+# pools empty + scrub-clean after), then a 3-replica CPU world under
+# tools/launch.py: fleet.rollout() drains every replica in turn under
+# client load with ZERO rejects, and a replica SIGKILLed mid-stream
+# still yields a byte-identical client stream (router re-prefills on a
+# survivor, splices at the emitted-token cursor); then the subsystem's
+# pytest suite
+fleet-smoke:
+	JAX_PLATFORMS=cpu python tools/fleet_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/python/unittest/test_fleet.py -q -m 'not slow'
+
 # every subsystem smoke in sequence — the one-command pre-flight before
 # a tunnel window.  Ordered CHEAP-FIRST (approx wall time on the CPU
 # container in the comment column) so a broken build fails in seconds,
@@ -217,12 +231,13 @@ SMOKES := \
 	decode-smoke \
 	faults-smoke \
 	data-smoke \
+	fleet-smoke \
 	dist-faults-smoke
 # approx wall time:        telemetry ~15s, trace ~25s, compile-cache
 # ~35s, trainer ~35s, monitor ~40s, checkpoint ~45s, step ~45s,
 # autotune ~50s, serve ~60s, obs ~75s, zero ~90s, decode ~100s,
-# faults ~2min, data ~3min, dist-faults ~4min (multi-process drills
-# last; total ~15min cold)
+# faults ~2min, data ~3min, fleet ~3min, dist-faults ~4min
+# (multi-process drills last; total ~18min cold)
 smoke-all:
 	@set -e; for t in $(SMOKES); do \
 	  echo "== $$t =="; \

@@ -549,6 +549,72 @@ def _bench_serve_decode(clients=24, max_new=32):
     }
 
 
+def _bench_fleet(requests=32, max_new=16):
+    """mx.fleet row: what the router front-end costs on top of a
+    replica — per-request routing overhead (refresh + p2c pick, the
+    fleet_router_overhead_seconds histogram) and end-to-end request
+    latency through discovery + dispatch + NDJSON streaming, plus the
+    packed prefill->decode handoff blob size for one sequence.  Two
+    in-process replicas over a MemKV, so the number prices the fleet
+    plane itself, not the network."""
+    from types import SimpleNamespace
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import fleet, serve, telemetry
+    from mxnet_tpu.dist.membership import MemKV
+
+    mx.random.seed(0)
+    kv = MemKV()
+    servers = []
+    for rank in range(2):
+        blk = serve.TinyDecoder(vocab_size=64, num_layers=2,
+                                num_heads=2, head_dim=8)
+        blk.initialize()
+        cfg = serve.DecodeConfig(page_size=8, pool_pages=64,
+                                 max_live=4, max_new_tokens=max_new,
+                                 max_context=64, prefill_lengths=(8,),
+                                 batch_sizes=(1, 2, 4))
+        srv = mx.serve.Server(decode=serve.DecodeRunner(blk,
+                                                        config=cfg))
+        srv.start_http()
+        srv.register_fleet(
+            SimpleNamespace(kv=kv, generation=1, rank=rank),
+            role="both")
+        servers.append(srv)
+    try:
+        router = fleet.Router(kv=kv, generation=1, seed=0)
+        t0 = time.perf_counter()
+        ok = 0
+        for i in range(requests):
+            ev = router.run_decode(
+                {"tokens": [1, 2, 3], "max_new_tokens": max_new},
+                request_id="bench-fleet-%d" % i)
+            ok += 1 if "done" in ev else 0
+        dt_s = time.perf_counter() - t0
+        assert ok == requests, (ok, requests)
+        blob = fleet.pack(servers[0].submit_decode_export(
+            [1, 2, 3], max_new_tokens=max_new).result())
+        router.shutdown()
+    finally:
+        for srv in servers:
+            srv.shutdown(drain=False)
+    over = telemetry.histogram_quantiles(
+        "fleet_router_overhead_seconds", qs=(0.5, 0.99))
+    req = telemetry.histogram_quantiles(
+        "fleet_router_request_seconds", qs=(0.5, 0.99))
+    return {
+        "requests_per_sec": round(requests / dt_s, 2),
+        "requests": requests,
+        "replicas": len(servers),
+        "router_overhead_us_p50": round(1e6 * over.get(0.5, 0.0), 1),
+        "router_overhead_us_p99": round(1e6 * over.get(0.99, 0.0), 1),
+        "request_ms_p50": round(1e3 * req.get(0.5, 0.0), 3),
+        "request_ms_p99": round(1e3 * req.get(0.99, 0.0), 3),
+        "handoff_blob_bytes": len(blob),
+        "failovers": telemetry.value("fleet_failover_total"),
+    }
+
+
 def _bench_imperative_trainer(batch=64, iters=10, dtype="bfloat16"):
     """Imperative (gluon.Trainer) ResNet-50 training — the default
     MXNet-parity path: hybridized fwd+bwd under autograd.record, then
@@ -1023,6 +1089,10 @@ def main():
             # per-token p50/p99, page-pool occupancy
             ("serve_decode", _bench_serve_decode,
              "serve_decode_continuous_batching"),
+            # mx.fleet router front-end: per-request routing overhead
+            # (refresh + p2c pick) + e2e latency through two local
+            # replicas, and the prefill->decode handoff blob size
+            ("fleet", _bench_fleet, "fleet_router"),
             # mx.autotune tuned-vs-default sweeps: allreduce bucket
             # size on a ResNet-50 gradient profile + flash-attention
             # block grid at BERT's T=512 — the committed numbers for

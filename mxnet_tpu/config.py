@@ -370,6 +370,44 @@ ENV_VARS = {
         "Serve chunked per-token streaming on /predict?stream=1; 0 "
         "forces collect mode (the streamed and collected token "
         "sequences are bit-identical either way)."),
+    "MXNET_FLEET_PUBLISH_SECONDS": (
+        float, 1.0,
+        "Min seconds between a replica's discovery-record publishes "
+        "into the membership KV (fleet/discovery.py; the publish "
+        "rides the membership heartbeat thread)."),
+    "MXNET_FLEET_DEAD_AFTER_SECONDS": (
+        float, 10.0,
+        "Discovery-record age beyond which the fleet router stops "
+        "routing to a replica (mirrors the membership liveness rule "
+        "MXNET_DIST_DEAD_AFTER_SECONDS)."),
+    "MXNET_FLEET_REFRESH_SECONDS": (
+        float, 0.5,
+        "Min seconds between the fleet router's discovery refreshes "
+        "(replica records + draining flags + poison verdicts are "
+        "re-read from the KV at most this often)."),
+    "MXNET_FLEET_RETRIES": (
+        int, 2,
+        "Max mid-request re-routes (zero-drop failover replays) the "
+        "fleet router attempts after replica deaths before failing "
+        "the request."),
+    "MXNET_FLEET_SATURATION": (
+        float, 1.0,
+        "Queue-fill fraction at which a replica counts as saturated; "
+        "when EVERY routable replica is saturated the router "
+        "rejects early with 503 + Retry-After instead of queueing."),
+    "MXNET_FLEET_UPSTREAM_TIMEOUT": (
+        float, 30.0,
+        "Socket timeout in seconds for router->replica upstream "
+        "requests (connect and per-read)."),
+    "MXNET_FLEET_SLO_TARGET_S": (
+        float, 0.25,
+        "Latency target (seconds) of the fleet_router_p99_ms SLO the "
+        "router registers with mx.obs when the obs plane is armed."),
+    "MXNET_FLEET_ROLE": (
+        str, "both",
+        "Pool role a serve replica registers under when none is "
+        "passed explicitly: both | prefill | decode (disaggregated "
+        "prefill/decode pools; fleet/pools.py)."),
     "MXNET_AUTOTUNE": (
         str, "0",
         "mx.autotune mode: 0 (default) = hand-set literals everywhere, "

@@ -1,0 +1,64 @@
+"""mx.fleet — the multi-replica serving fleet.
+
+One process serving one model is a demo; a fleet is a service.  This
+package turns N independent ``serve.Server`` replicas into one front
+door, built entirely on machinery the stack already has:
+
+- ``discovery`` — KV-backed service discovery: every replica's
+  endpoint + pool role + live load digest rides the mx.dist
+  membership heartbeat (``Membership.on_beat``) under
+  ``fleet/<gen>/<replica-id>``; liveness inherits the heartbeat
+  generation rules (a SIGKILLed replica just ages out).
+- ``router`` — the load-aware front-end: queue-age-weighted
+  power-of-two-choices dispatch, breaker-aware failover ordering,
+  reject-early on whole-fleet saturation, zero-drop streaming
+  failover (prompt + emitted-token cursor held at the router;
+  re-prefill on a survivor, byte-identical stream), fleet-wide poison
+  verdicts (first-writer-wins in the KV), and ``rollout()`` — the
+  drain-aware rolling hot-swap.
+- ``pools`` — disaggregated prefill/decode pool arithmetic over
+  replica roles.
+- ``handoff`` — the prefill→decode KV-page transfer: pages + cursor +
+  sampler state as one sha256-checksummed blob; the decode side
+  re-runs admission reservation math so the serve scrub/poison safety
+  story survives the hop.
+
+Quick start (each replica)::
+
+    srv = mx.serve.Server(decode=runner)
+    srv.start_http()
+    srv.register_fleet(mx.dist.join(), role="both")
+
+and one router anywhere with KV access::
+
+    router = mx.fleet.Router(membership=mx.dist.join())
+    host, port = router.start_http()
+
+Drill: ``make fleet-smoke`` (3 CPU replicas under launch.py, one
+SIGKILLed mid-stream, zero dropped requests); deep-dive:
+``tests/nightly/fleet_drill.py``, ``tools/diagnose.py --fleet-router``.
+"""
+from __future__ import annotations
+
+from . import discovery, handoff, pools, router
+from .discovery import (Registrar, draining_ids, latest_generation,
+                        poison_ids, poison_verdict, publish_poison,
+                        register, replicas, set_draining)
+from .handoff import HandoffError, pack, unpack
+from .pools import classify, disaggregated, pool_stats
+from .router import FleetSaturated, Router, RouterConfig, kv_doc, rollout
+
+__all__ = [
+    # submodules
+    "discovery", "router", "pools", "handoff",
+    # discovery
+    "Registrar", "register", "replicas", "latest_generation",
+    "set_draining", "draining_ids", "publish_poison", "poison_verdict",
+    "poison_ids",
+    # router
+    "Router", "RouterConfig", "FleetSaturated", "rollout", "kv_doc",
+    # pools
+    "classify", "disaggregated", "pool_stats",
+    # handoff
+    "HandoffError", "pack", "unpack",
+]

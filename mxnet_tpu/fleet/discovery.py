@@ -1,0 +1,296 @@
+"""mx.fleet service discovery — replica records in the membership KV.
+
+Every ``serve.Server`` replica registers under ``fleet/<gen>/<id>`` in
+the SAME KV backend mx.dist membership heartbeats through (FileKV /
+CoordKV / MemKV): endpoint, pool role (``both`` / ``prefill`` /
+``decode``), and a live load digest distilled from the server's
+``/statz`` surface (queue depth + age, breaker states, page-pool
+residency).  Publishing rides the membership heartbeat thread via
+``Membership.on_beat`` — discovery adds ZERO threads — rate-limited to
+``MXNET_FLEET_PUBLISH_SECONDS``, and liveness is inherited from the
+heartbeat generation rules: records carry their own wall clock, and a
+replica whose record ages past ``MXNET_FLEET_DEAD_AFTER_SECONDS``
+simply drops out of the router's view (no deregistration protocol; a
+SIGKILLed replica needs none).
+
+Two auxiliary namespaces share the generation prefix (their names are
+reserved, never valid replica ids):
+
+- ``fleet/<gen>/draining/<id>`` — rollout drain flags: the router
+  stops NEW dispatches to a draining replica while its in-flight
+  streams finish (``fleet.rollout()`` writes these).
+- ``fleet/<gen>/poison/<request-id>`` — poison verdicts, published
+  first-writer-wins (the ``os.link`` stop-flag semantics): once any
+  router condemns a sequence, every router stops retrying it
+  fleet-wide.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+from .. import telemetry as _tel
+from ..base import get_env
+
+_LOG = logging.getLogger("mxnet_tpu.fleet")
+
+__all__ = ["ROLES", "RESERVED", "SCHEMA_VERSION", "fleet_key",
+           "drain_key", "poison_key", "Registrar", "register",
+           "replicas", "latest_generation", "set_draining",
+           "draining_ids", "publish_poison", "poison_verdict",
+           "poison_ids"]
+
+SCHEMA_VERSION = 1
+ROLES = ("both", "prefill", "decode")
+# key names under fleet/<gen>/ that are NOT replica records
+RESERVED = frozenset({"draining", "poison"})
+
+
+def fleet_key(generation, replica_id):
+    return "fleet/%d/%s" % (int(generation), replica_id)
+
+
+def drain_key(generation, replica_id):
+    return "fleet/%d/draining/%s" % (int(generation), replica_id)
+
+
+def poison_key(generation, request_id):
+    return "fleet/%d/poison/%s" % (int(generation), request_id)
+
+
+def _check_replica_id(replica_id):
+    rid = str(replica_id)
+    if not rid or rid in RESERVED or "/" in rid:
+        raise ValueError(
+            "invalid replica id %r (reserved names: %s; no '/')"
+            % (replica_id, sorted(RESERVED)))
+    return rid
+
+
+class Registrar:
+    """Publishes one replica's discovery record, heartbeat-piggybacked
+    (same transport discipline as the mx.obs publisher: rate-limited,
+    fail-soft — a dead KV must never take the heartbeat down)."""
+
+    def __init__(self, server, membership, endpoint, role=None,
+                 replica_id=None, interval=None):
+        role = get_env("MXNET_FLEET_ROLE", str, "both") \
+            if role is None else str(role)
+        if role not in ROLES:
+            raise ValueError("role must be one of %s, got %r"
+                             % (list(ROLES), role))
+        self.server = server
+        self.membership = membership
+        self.endpoint = str(endpoint)
+        self.role = role
+        self.replica_id = _check_replica_id(
+            str(membership.rank) if replica_id is None else replica_id)
+        self.interval = get_env(
+            "MXNET_FLEET_PUBLISH_SECONDS", float, 1.0) \
+            if interval is None else float(interval)
+        self._last = None
+        self._lock = threading.Lock()
+        self._beat_cb = None
+        self.publishes = 0
+        self.failures = 0
+
+    # -- record --------------------------------------------------------------
+    def record(self):
+        """This replica's publishable discovery record."""
+        srv = self.server
+        rec = {
+            "schema_version": SCHEMA_VERSION,
+            "replica_id": self.replica_id,
+            "rank": int(self.membership.rank or 0),
+            "pid": os.getpid(),
+            "wall": time.time(),
+            "endpoint": self.endpoint,
+            "role": self.role,
+            "draining": bool(getattr(srv, "draining", False)),
+            "ready": bool(srv.ready()),
+            "healthy": bool(srv.healthy()),
+            "load": srv.load_digest(),
+        }
+        return rec
+
+    # -- publishing ----------------------------------------------------------
+    def maybe_publish(self):
+        """Rate-limited publish; the on_beat entry point."""
+        now = time.monotonic()
+        with self._lock:
+            if self._last is not None and \
+                    now - self._last < self.interval:
+                return False
+            self._last = now
+        return self.publish()
+
+    def publish(self):
+        """Publish NOW (drain-flag flips and tests force it).  Returns
+        True on success; failures count
+        ``fleet_publish_failures_total`` and the replica ages out of
+        the router's view — never raises."""
+        m = self.membership
+        if m is None or m.generation is None:
+            return False
+        try:
+            m.kv.set(fleet_key(m.generation, self.replica_id),
+                     self.record())
+            self.publishes += 1
+            if _tel.ENABLED:
+                _tel.FLEET_PUBLISHES.inc()
+            return True
+        except Exception as exc:  # noqa: BLE001 - degrade, never raise
+            self.failures += 1
+            if _tel.ENABLED:
+                _tel.FLEET_PUBLISH_FAILURES.inc()
+            _LOG.warning("fleet discovery publish failed (replica ages "
+                         "out of the router view until the KV "
+                         "recovers): %s", exc)
+            return False
+
+    # -- lifecycle -----------------------------------------------------------
+    def attach(self):
+        """Hook the membership heartbeat and force one publish."""
+        if self._beat_cb is not None:
+            return self
+        reg = self
+
+        def _on_beat(mem):
+            if mem is reg.membership:
+                reg.maybe_publish()
+
+        try:
+            from ..dist import membership as _mm
+
+            _mm.on_beat(_on_beat)
+            self._beat_cb = _on_beat
+        except Exception:  # noqa: BLE001 - registrar still usable
+            self._beat_cb = None
+        self.publish()
+        return self
+
+    def close(self, deregister=True):
+        """Unhook the heartbeat and (by default) delete the record —
+        graceful leave; a SIGKILLed replica relies on aging out."""
+        cb = self._beat_cb
+        if cb is not None:
+            try:
+                from ..dist import membership as _mm
+
+                _mm.remove_beat_listener(cb)
+            except Exception:  # noqa: BLE001
+                pass
+            self._beat_cb = None
+        if deregister:
+            m = self.membership
+            try:
+                if m is not None and m.generation is not None:
+                    m.kv.delete(fleet_key(m.generation, self.replica_id))
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def register(server, membership, endpoint, role=None, replica_id=None,
+             interval=None):
+    """Register a ``serve.Server`` replica in the fleet: returns an
+    attached :class:`Registrar` (its record now rides every heartbeat).
+    The normal entry point is ``Server.register_fleet()``."""
+    return Registrar(server, membership, endpoint, role=role,
+                     replica_id=replica_id, interval=interval).attach()
+
+
+# ---------------------------------------------------------------------------
+# the reader side (router / diagnose)
+# ---------------------------------------------------------------------------
+
+def latest_generation(kv):
+    """Newest generation with any fleet records, or None."""
+    try:
+        gens = [int(g) for g in kv.list("fleet") if str(g).isdigit()]
+    except Exception:  # noqa: BLE001
+        return None
+    return max(gens) if gens else None
+
+
+def replicas(kv, generation, max_age=None, now=None):
+    """{replica_id: record} for one generation, each record annotated
+    with ``age_s``.  ``max_age`` (default
+    ``MXNET_FLEET_DEAD_AFTER_SECONDS``) drops stale records — the
+    liveness rule; pass ``max_age=0`` or negative to keep everything.
+    Fail-soft: an unreachable KV reads as an empty fleet."""
+    if max_age is None:
+        max_age = get_env("MXNET_FLEET_DEAD_AFTER_SECONDS", float, 10.0)
+    now = time.time() if now is None else now
+    out = {}
+    try:
+        prefix = "fleet/%d" % int(generation)
+        for name in kv.list(prefix):
+            if name in RESERVED:
+                continue
+            rec = kv.get("%s/%s" % (prefix, name))
+            if not isinstance(rec, dict):
+                continue
+            age = max(0.0, now - float(rec.get("wall") or 0.0))
+            if max_age and max_age > 0 and age > max_age:
+                continue
+            rec = dict(rec)
+            rec["age_s"] = round(age, 3)
+            out[name] = rec
+    except Exception:  # noqa: BLE001 - empty fleet beats a crash
+        return {}
+    return out
+
+
+def set_draining(kv, generation, replica_id, flag):
+    """Publish (or clear) the rollout drain flag for one replica: the
+    router stops NEW dispatches while the flag stands; in-flight
+    streams ride the replica's own graceful drain."""
+    rid = _check_replica_id(replica_id)
+    key = drain_key(generation, rid)
+    if flag:
+        kv.set(key, {"replica_id": rid, "wall": time.time()})
+    else:
+        kv.delete(key)
+
+
+def draining_ids(kv, generation):
+    """Replica ids currently flagged draining (fail-soft: empty)."""
+    try:
+        return set(kv.list("fleet/%d/draining" % int(generation)))
+    except Exception:  # noqa: BLE001
+        return set()
+
+
+def publish_poison(kv, generation, request_id, reason, by=None):
+    """Publish a poison verdict for one request id, FIRST WRITER WINS
+    (``overwrite=False`` — two routers condemning the same sequence
+    race safely).  Returns True when this call won the publish."""
+    try:
+        won = kv.set(poison_key(generation, request_id),
+                     {"request_id": str(request_id),
+                      "reason": str(reason)[:500],
+                      "by": by, "wall": time.time()},
+                     overwrite=False)
+    except Exception:  # noqa: BLE001 - verdicts are best-effort
+        return False
+    if won and _tel.ENABLED:
+        _tel.FLEET_POISON_VERDICTS.inc()
+    return bool(won)
+
+
+def poison_verdict(kv, generation, request_id):
+    """The standing verdict record for ``request_id``, or None."""
+    try:
+        return kv.get(poison_key(generation, request_id))
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def poison_ids(kv, generation):
+    """Every condemned request id of this generation (fail-soft)."""
+    try:
+        return sorted(kv.list("fleet/%d/poison" % int(generation)))
+    except Exception:  # noqa: BLE001
+        return []

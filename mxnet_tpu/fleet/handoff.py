@@ -1,0 +1,215 @@
+"""mx.fleet prefill→decode page handoff — KV pages as one checksummed blob.
+
+Disaggregated serving splits a sequence's life across two processes:
+a **prefill** replica runs the prompt (compute-bound, batch-friendly)
+and a **decode** replica generates tokens (memory-bandwidth-bound,
+latency-critical).  The state that crosses the wire is exactly what
+the PR 12 decode plane keeps per sequence: the prompt's KV-cache page
+contents, the resident length (the cursor), and the sampler state —
+for greedy sampling, the first token the prefill emitted.  This module
+serializes that state as ONE self-describing blob:
+
+    MXFH1\\n
+    <header JSON, one line>\\n
+    <raw K rows>  [L, pages, page_size, H, D]  row-major
+    <raw V rows>  (same shape)
+    <sha256 of everything above, 32 raw bytes>
+
+The digest covers header AND tensor bytes — a bit flip anywhere
+(truncated POST body, proxy mangling, version skew) is a hard
+``HandoffError`` on the decode side, never silently-corrupt context.
+Rows at positions ``>= length`` are scrubbed to zero before packing:
+freed pages are reallocated without zeroing on the prefill side, so
+without the scrub the blob would leak a previous owner's values (and
+the checksum would be nondeterministic for identical sequences).
+
+The decode side re-runs the PR 12 admission-reservation math on
+import (``DecodeScheduler.submit_handoff``): the full worst case
+(``pages_for(length + max_new_tokens)``) is reserved before any page
+content lands, the imported rows occupy the first ``pages`` entries of
+that reservation, and the in-program scrub guard masks positions
+``>= ctx_len`` exactly as if the prefill had run locally — the
+scrub/poison safety story survives the hop by construction.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+
+import numpy as _np
+
+from ..serve.batching import ServeError
+
+__all__ = ["HandoffError", "HANDOFF_VERSION", "MAGIC", "export_seq",
+           "pack", "unpack", "install_seq", "validate_geometry"]
+
+HANDOFF_VERSION = 1
+MAGIC = b"MXFH1\n"
+
+# header fields a well-formed blob must carry (the geometry quintet is
+# additionally cross-checked against the importing runner's PageConfig)
+_REQUIRED = ("version", "prompt", "max_new_tokens", "first_token",
+             "length", "pages", "page_size", "num_layers",
+             "num_kv_heads", "head_dim", "dtype")
+
+
+class HandoffError(ServeError):
+    """Malformed / corrupt / geometry-incompatible handoff blob."""
+
+
+def export_seq(runner, seq, first_token):
+    """Snapshot one prefilled sequence's cross-replica state from
+    ``runner``'s pool: header fields + the K/V rows of its pages,
+    positions ``>= seq.length`` scrubbed to zero.  Returns the state
+    dict ``pack`` serializes (numpy arrays under "k"/"v")."""
+    c = runner.page_config
+    pages = _np.asarray(seq.pages, dtype=_np.int64)
+    # [L, n, page_size, H, D] — host copies of just this sequence's
+    # pages (np.array, not asarray: the device transfer can surface a
+    # read-only buffer and the scrub below writes in place)
+    k = _np.array(runner.pool.k[:, pages], dtype=c.dtype)
+    v = _np.array(runner.pool.v[:, pages], dtype=c.dtype)
+    n = len(seq.pages)
+    flat_len = n * c.page_size
+    if seq.length < flat_len:
+        # scrub the unwritten tail: reallocated pages carry the
+        # previous owner's rows (possibly the NaNs it died of)
+        shape = k.shape
+        k = k.reshape(c.num_layers, flat_len, c.num_kv_heads, c.head_dim)
+        v = v.reshape(c.num_layers, flat_len, c.num_kv_heads, c.head_dim)
+        k[:, seq.length:] = 0
+        v[:, seq.length:] = 0
+        k = k.reshape(shape)
+        v = v.reshape(shape)
+    req = seq.req
+    return {
+        "version": HANDOFF_VERSION,
+        "request_id": req.request_id,
+        "prompt": list(req.prompt),
+        "max_new_tokens": int(req.max_new_tokens),
+        "eos_id": req.eos_id,
+        "first_token": int(first_token),      # the sampler state: greedy
+        "length": int(seq.length),            # the cursor
+        "pages": n,
+        "page_size": c.page_size,
+        "num_layers": c.num_layers,
+        "num_kv_heads": c.num_kv_heads,
+        "head_dim": c.head_dim,
+        "dtype": str(_np.dtype(c.dtype).name),
+        "k": k,
+        "v": v,
+    }
+
+
+def pack(state):
+    """State dict -> one checksummed wire blob (module doc layout)."""
+    header = {k: v for k, v in state.items() if k not in ("k", "v")}
+    k = _np.ascontiguousarray(state["k"])
+    v = _np.ascontiguousarray(state["v"])
+    head = json.dumps(header, separators=(",", ":")).encode() + b"\n"
+    body = MAGIC + head + k.tobytes() + v.tobytes()
+    return body + hashlib.sha256(body).digest()
+
+
+def unpack(blob):
+    """Wire blob -> state dict; every malformation is a
+    ``HandoffError`` (bad magic, truncation, size mismatch, checksum
+    mismatch, missing header fields) — corrupt context must never
+    reach a decode pool."""
+    if not isinstance(blob, (bytes, bytearray, memoryview)):
+        raise HandoffError("handoff blob must be bytes")
+    blob = bytes(blob)
+    if len(blob) < len(MAGIC) + 32 + 2:
+        raise HandoffError("handoff blob truncated (%d bytes)"
+                           % len(blob))
+    if not blob.startswith(MAGIC):
+        raise HandoffError("bad handoff magic %r (expected %r)"
+                           % (blob[:len(MAGIC)], MAGIC))
+    body, digest = blob[:-32], blob[-32:]
+    if hashlib.sha256(body).digest() != digest:
+        raise HandoffError(
+            "handoff checksum mismatch: blob corrupted in transit "
+            "(%d bytes)" % len(blob))
+    nl = body.index(b"\n", len(MAGIC))
+    try:
+        header = json.loads(body[len(MAGIC):nl])
+    except ValueError as exc:
+        raise HandoffError("unparseable handoff header: %s" % exc) \
+            from exc
+    missing = [f for f in _REQUIRED if f not in header]
+    if missing:
+        raise HandoffError("handoff header missing field(s): %s"
+                           % missing)
+    if int(header["version"]) != HANDOFF_VERSION:
+        raise HandoffError("handoff version %r != %d (replica version "
+                           "skew — finish the rollout)"
+                           % (header["version"], HANDOFF_VERSION))
+    try:
+        dtype = _np.dtype(header["dtype"])
+    except TypeError as exc:
+        raise HandoffError("bad handoff dtype %r" % (header["dtype"],)) \
+            from exc
+    shape = (int(header["num_layers"]), int(header["pages"]),
+             int(header["page_size"]), int(header["num_kv_heads"]),
+             int(header["head_dim"]))
+    if min(shape) < 1:
+        raise HandoffError("degenerate handoff geometry %r" % (shape,))
+    nbytes = int(_np.prod(shape)) * dtype.itemsize
+    tensors = body[nl + 1:]
+    if len(tensors) != 2 * nbytes:
+        raise HandoffError(
+            "handoff tensor section is %d bytes, header geometry %r "
+            "needs %d" % (len(tensors), shape, 2 * nbytes))
+    state = dict(header)
+    state["k"] = _np.frombuffer(tensors[:nbytes],
+                                dtype=dtype).reshape(shape)
+    state["v"] = _np.frombuffer(tensors[nbytes:],
+                                dtype=dtype).reshape(shape)
+    return state
+
+
+def validate_geometry(state, page_config):
+    """Cross-check a blob's geometry against the importing runner's
+    ``PageConfig`` — pages only splice into a pool of identical page
+    shape.  Raises ``HandoffError`` on any mismatch."""
+    c = page_config
+    for field, want in (("page_size", c.page_size),
+                        ("num_layers", c.num_layers),
+                        ("num_kv_heads", c.num_kv_heads),
+                        ("head_dim", c.head_dim)):
+        if int(state[field]) != int(want):
+            raise HandoffError(
+                "handoff geometry mismatch: %s=%s but this pool has %s "
+                "— prefill and decode replicas must serve the same "
+                "model geometry" % (field, state[field], want))
+    if _np.dtype(state["dtype"]) != _np.dtype(c.dtype):
+        raise HandoffError(
+            "handoff dtype %s != pool dtype %s"
+            % (state["dtype"], _np.dtype(c.dtype).name))
+    if int(state["length"]) != len(state["prompt"]):
+        raise HandoffError(
+            "handoff cursor %s != prompt length %d"
+            % (state["length"], len(state["prompt"])))
+    need_src = c.pages_for(int(state["length"]))
+    if int(state["pages"]) < need_src:
+        raise HandoffError(
+            "handoff carries %s page(s) but length=%s needs %d"
+            % (state["pages"], state["length"], need_src))
+
+
+def install_seq(runner, seq, state):
+    """Splice imported K/V rows into the first ``state['pages']``
+    entries of ``seq``'s (already reserved, strictly larger or equal)
+    page allocation on ``runner``'s pool.  Geometry must have been
+    validated; runs outside the jitted step (a one-time .at[].set per
+    import, not a per-token cost)."""
+    n = int(state["pages"])
+    if len(seq.pages) < n:
+        raise HandoffError(
+            "reservation of %d page(s) cannot hold %d imported page(s)"
+            % (len(seq.pages), n))
+    pages = _np.asarray(seq.pages[:n], dtype=_np.int64)
+    runner.pool.k = runner.pool.k.at[:, pages].set(
+        _np.asarray(state["k"], dtype=runner.page_config.dtype))
+    runner.pool.v = runner.pool.v.at[:, pages].set(
+        _np.asarray(state["v"], dtype=runner.page_config.dtype))

@@ -1,0 +1,82 @@
+"""mx.fleet pool arithmetic — role classification over replica records.
+
+Replicas declare a role at registration: ``both`` (prefill + decode on
+one process — the classic colocated server), ``prefill`` (prompt
+ingestion only: runs the prompt, ships the resulting KV pages), or
+``decode`` (token generation only: imports handed-off pages and
+streams).  The router consults these pure helpers to decide whether
+the fleet is running **disaggregated** (at least one dedicated replica
+on each side — then /predict traffic takes the two-hop
+export→import path) and which replicas are eligible for which plane.
+
+Everything here is a pure function of the discovery record dict —
+no KV, no HTTP, no clocks — so the unit tests drive them with
+hand-built records.
+"""
+from __future__ import annotations
+
+from .discovery import ROLES
+
+__all__ = ["ROLES", "classify", "prefill_pool", "decode_pool",
+           "micro_pool", "disaggregated", "pool_stats"]
+
+
+def classify(records):
+    """{role: [replica_id, ...]} over discovery records (roles sorted
+    stably; unknown roles bucket under their own name so a newer
+    replica's novel role is visible, not silently dropped)."""
+    out = {r: [] for r in ROLES}
+    for rid in sorted(records):
+        role = str(records[rid].get("role") or "both")
+        out.setdefault(role, []).append(rid)
+    return out
+
+
+def prefill_pool(records):
+    """Replica ids eligible to run a prompt (role prefill or both)."""
+    return [rid for rid in sorted(records)
+            if records[rid].get("role", "both") in ("prefill", "both")]
+
+
+def decode_pool(records):
+    """Replica ids eligible to generate tokens (decode or both)."""
+    return [rid for rid in sorted(records)
+            if records[rid].get("role", "both") in ("decode", "both")]
+
+
+def micro_pool(records):
+    """Replica ids eligible for micro-batch (vision) requests — only
+    colocated ``both`` replicas carry that plane's full surface."""
+    return [rid for rid in sorted(records)
+            if records[rid].get("role", "both") == "both"]
+
+
+def disaggregated(records):
+    """True when the fleet runs split prefill/decode pools: at least
+    one DEDICATED prefill replica and one DEDICATED decode replica.
+    A fleet of ``both`` replicas is colocated — single-hop dispatch."""
+    roles = set(str(r.get("role") or "both") for r in records.values())
+    return "prefill" in roles and "decode" in roles
+
+
+def pool_stats(records):
+    """Per-pool aggregate depth for /statz and the diagnose renderer:
+    {pool: {replicas, queue_depth, decode_waiting, decode_live,
+    pages_free, pages_total}} summed over the pool's members (a
+    replica with role ``both`` counts in both pools — it serves
+    both planes)."""
+    out = {}
+    for pool, members in (("prefill", prefill_pool(records)),
+                          ("decode", decode_pool(records))):
+        agg = {"replicas": len(members), "queue_depth": 0,
+               "decode_waiting": 0, "decode_live": 0,
+               "pages_free": 0, "pages_total": 0}
+        for rid in members:
+            load = records[rid].get("load") or {}
+            agg["queue_depth"] += int(load.get("queue_depth") or 0)
+            agg["decode_waiting"] += int(load.get("decode_waiting") or 0)
+            agg["decode_live"] += int(load.get("decode_live") or 0)
+            agg["pages_free"] += int(load.get("pages_free") or 0)
+            agg["pages_total"] += int(load.get("pages_total") or 0)
+        out[pool] = agg
+    return out

@@ -1,0 +1,848 @@
+"""mx.fleet router — load-aware dispatch over live serve replicas.
+
+The front door of a multi-replica fleet, same stdlib-HTTP discipline
+as ``serve.Server``: one ``ThreadingHTTPServer``, POST ``/predict``
+(micro-batch AND decode payloads, streaming included), GET health /
+stats / metrics.  Between the client and the replicas it adds exactly
+four behaviors:
+
+- **load-aware dispatch** — queue-age-weighted power-of-two-choices:
+  sample two live candidates, send to the lower-scored one.  Score is
+  the replica's published queue age plus its queue fill fractions (a
+  stuck queue reads old even when shallow; two idle replicas tie and
+  the RNG spreads them).  P2C gives near-best-of-N balance on stale
+  load signals without the herd behavior of always-pick-least.
+- **breaker-aware failover** — when a dispatch fails, survivors are
+  tried in ``(breaker pressure, score)`` order, so a replica whose
+  buckets are quarantined is the LAST resort, not the retry target.
+- **reject-early** — when every routable replica is saturated
+  (published waiting depth at capacity), the router answers 503 +
+  Retry-After immediately instead of queueing onto a full fleet.
+- **zero-drop streaming failover** — the router holds every live
+  sequence's prompt and emitted-token cursor.  A replica death
+  mid-stream re-prefills the SAME prompt on a survivor and fast
+  forwards past the already-emitted tokens (greedy sampling on
+  identical weights replays an identical prefix — enforced by a
+  mismatch guard); the client stream continues byte-identical, no
+  dropped request.  A sequence that keeps failing for its own sake
+  (poison) is condemned fleet-wide: the verdict is published to the
+  KV first-writer-wins and every router stops retrying it.
+
+With a disaggregated fleet (dedicated ``prefill`` + ``decode``
+replicas), ``/predict`` decode traffic takes the two-hop path:
+export the prompt's KV pages from a prefill replica
+(``/fleet/handoff/export``), import the checksummed blob on a decode
+replica (``/fleet/handoff/import``), stream from there.
+
+``rollout()`` is the drain-aware hot-swap: one replica at a time is
+flagged draining in the KV (routers stop NEW dispatches), drained /
+swapped through the caller's hook, and waited back to readiness
+before the next one — a whole-fleet model swap with zero rejects.
+"""
+from __future__ import annotations
+
+import http.client
+import itertools
+import json
+import logging
+import random
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .. import telemetry
+from ..base import get_env
+from . import discovery, pools
+
+_LOG = logging.getLogger("mxnet_tpu.fleet")
+
+__all__ = ["RouterConfig", "Router", "FleetSaturated", "rollout",
+           "kv_doc"]
+
+ROUTER_STATZ_SCHEMA_VERSION = 1
+
+
+class FleetSaturated(Exception):
+    """Every routable replica is saturated: reject-early."""
+
+
+class RouterConfig:
+    """Fleet-router knobs (README "Serving fleet").
+
+    refresh_s : discovery re-read interval (``MXNET_FLEET_REFRESH_SECONDS``).
+    dead_after_s : record age beyond which a replica is dead to the
+        router (``MXNET_FLEET_DEAD_AFTER_SECONDS``) — inherits the
+        membership heartbeat liveness story.
+    retries : failover attempts after the first dispatch
+        (``MXNET_FLEET_RETRIES``).
+    saturation : fraction of a replica's published queue capacity at
+        which it stops being a dispatch candidate
+        (``MXNET_FLEET_SATURATION``; 1.0 = full).
+    upstream_timeout_s : per-hop HTTP timeout
+        (``MXNET_FLEET_UPSTREAM_TIMEOUT``).
+    retry_after_s : the Retry-After on fleet-saturated 503s.
+    slo_target_s : the p99 router-request SLO registered with mx.obs
+        (``MXNET_FLEET_SLO_TARGET_S``).
+    """
+
+    def __init__(self, refresh_s=None, dead_after_s=None, retries=None,
+                 saturation=None, upstream_timeout_s=None,
+                 retry_after_s=None, slo_target_s=None):
+        self.refresh_s = get_env("MXNET_FLEET_REFRESH_SECONDS", float,
+                                 0.5) \
+            if refresh_s is None else float(refresh_s)
+        self.dead_after_s = get_env("MXNET_FLEET_DEAD_AFTER_SECONDS",
+                                    float, 10.0) \
+            if dead_after_s is None else float(dead_after_s)
+        self.retries = get_env("MXNET_FLEET_RETRIES", int, 2) \
+            if retries is None else int(retries)
+        self.saturation = get_env("MXNET_FLEET_SATURATION", float, 1.0) \
+            if saturation is None else float(saturation)
+        self.upstream_timeout_s = get_env(
+            "MXNET_FLEET_UPSTREAM_TIMEOUT", float, 30.0) \
+            if upstream_timeout_s is None else float(upstream_timeout_s)
+        self.retry_after_s = get_env("MXNET_SERVE_RETRY_AFTER", float,
+                                     1.0) \
+            if retry_after_s is None else float(retry_after_s)
+        self.slo_target_s = get_env("MXNET_FLEET_SLO_TARGET_S", float,
+                                    0.25) \
+            if slo_target_s is None else float(slo_target_s)
+
+    def as_dict(self):
+        return {"refresh_s": self.refresh_s,
+                "dead_after_s": self.dead_after_s,
+                "retries": self.retries,
+                "saturation": self.saturation,
+                "upstream_timeout_s": self.upstream_timeout_s,
+                "retry_after_s": self.retry_after_s,
+                "slo_target_s": self.slo_target_s}
+
+
+class Router:
+    """The fleet front-end (module doc).  Construct over a membership
+    (``Router(membership=mx.dist.join())``) or a raw KV + generation;
+    ``generation=None`` auto-resolves to the newest generation with
+    fleet records on every refresh (a restarted fleet moves the
+    router along with it)."""
+
+    def __init__(self, kv=None, generation=None, membership=None,
+                 config=None, seed=None):
+        if membership is not None:
+            kv = membership.kv if kv is None else kv
+            generation = membership.generation \
+                if generation is None else generation
+        if kv is None:
+            raise ValueError("Router needs a kv= backend or a "
+                             "membership=")
+        self.kv = kv
+        self.generation = generation
+        self.config = config or RouterConfig()
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._records = {}
+        self._last_refresh = None
+        self._httpd = None
+        self._closed = False
+        self._rid_counter = itertools.count()
+        self.requests = {}            # result -> count (local mirror)
+        self.failovers = 0
+        self.handoffs = 0
+        self._inflight = {}           # replica_id -> live dispatches
+
+    # -- discovery view ------------------------------------------------------
+    def refresh(self, force=False):
+        """Re-read the fleet view (rate-limited to ``refresh_s``):
+        live replica records + drain flags, merged.  Returns the
+        record dict (replica_id -> record, ``draining`` folded in)."""
+        now = time.monotonic()
+        with self._lock:
+            if not force and self._last_refresh is not None and \
+                    now - self._last_refresh < self.config.refresh_s:
+                return dict(self._records)
+            self._last_refresh = now
+        gen = self.generation
+        if gen is None:
+            gen = discovery.latest_generation(self.kv)
+            if gen is None:
+                with self._lock:
+                    self._records = {}
+                return {}
+        recs = discovery.replicas(self.kv, gen,
+                                  max_age=self.config.dead_after_s)
+        drains = discovery.draining_ids(self.kv, gen)
+        for rid, rec in recs.items():
+            if rid in drains:
+                rec["draining"] = True
+        with self._lock:
+            self._records = recs
+        if telemetry.ENABLED:
+            telemetry.FLEET_REPLICAS.set(len(recs))
+        return dict(recs)
+
+    def records(self):
+        with self._lock:
+            return dict(self._records)
+
+    def _resolved_generation(self):
+        return self.generation if self.generation is not None \
+            else discovery.latest_generation(self.kv)
+
+    # -- scoring (pure; unit-tested directly) --------------------------------
+    @staticmethod
+    def score(rec):
+        """Lower = better dispatch target: published queue age plus
+        both planes' fill fractions.  Age leads — a shallow-but-stuck
+        queue must lose to a deep-but-moving one."""
+        load = rec.get("load") or {}
+        s = float(load.get("queue_age_s") or 0.0)
+        cap = int(load.get("queue_capacity") or 0)
+        if cap > 0:
+            s += int(load.get("queue_depth") or 0) / cap
+        dcap = int(load.get("decode_queue_depth") or 0)
+        if dcap > 0:
+            s += int(load.get("decode_waiting") or 0) / dcap
+        return s
+
+    def saturated(self, rec, plane="decode"):
+        """This replica's admission queue for ``plane`` is at (or
+        past) the saturation fraction of its published capacity —
+        dispatching would only queue, so it is no candidate."""
+        load = rec.get("load") or {}
+        frac = self.config.saturation
+        if plane == "micro":
+            cap = int(load.get("queue_capacity") or 0)
+            return cap > 0 and \
+                int(load.get("queue_depth") or 0) >= frac * cap
+        cap = int(load.get("decode_queue_depth") or 0)
+        return cap > 0 and \
+            int(load.get("decode_waiting") or 0) >= frac * cap
+
+    @staticmethod
+    def breaker_rank(rec):
+        """Failover ordering pressure: 0 all-closed, 1 half-open
+        trials pending, 2 open breakers — quarantined replicas are
+        the last resort, never the retry target."""
+        load = rec.get("load") or {}
+        if int(load.get("breakers_open") or 0) > 0:
+            return 2
+        if int(load.get("breakers_half_open") or 0) > 0:
+            return 1
+        return 0
+
+    @staticmethod
+    def routable(records, plane):
+        """Replica ids eligible for ``plane`` ("micro" / "prefill" /
+        "decode"): ready, healthy, not draining, role matches."""
+        eligible = {"micro": pools.micro_pool,
+                    "prefill": pools.prefill_pool,
+                    "decode": pools.decode_pool}[plane](records)
+        return [rid for rid in eligible
+                if records[rid].get("ready")
+                and records[rid].get("healthy")
+                and not records[rid].get("draining")]
+
+    def pick(self, records, plane, exclude=()):
+        """Power-of-two-choices over non-saturated routable replicas:
+        sample two, dispatch to the lower score.  Returns a replica
+        id; None when nothing is routable; raises ``FleetSaturated``
+        when routable replicas exist but every one is saturated (the
+        reject-early signal)."""
+        routable = [r for r in self.routable(records, plane)
+                    if r not in exclude]
+        if not routable:
+            return None
+        ok = [r for r in routable if not self.saturated(records[r],
+                                                        plane)]
+        if not ok:
+            raise FleetSaturated(
+                "all %d routable %s replica(s) saturated"
+                % (len(routable), plane))
+        if len(ok) == 1:
+            return ok[0]
+        a, b = self._rng.sample(ok, 2)
+        sa, sb = self.score(records[a]), self.score(records[b])
+        if sa != sb:
+            return a if sa < sb else b
+        return min(a, b)
+
+    def failover_order(self, records, plane, exclude=()):
+        """Surviving candidates for a retry, best first: sorted by
+        (breaker pressure, score, id); saturated survivors are kept —
+        at failover time a queued retry beats a dropped stream —
+        but sort after their saturation-free peers."""
+        out = [r for r in self.routable(records, plane)
+               if r not in exclude]
+        return sorted(out, key=lambda r: (
+            self.saturated(records[r], plane),
+            self.breaker_rank(records[r]),
+            self.score(records[r]), r))
+
+    # -- upstream plumbing ---------------------------------------------------
+    def _connect(self, endpoint):
+        host, _, port = endpoint.rpartition(":")
+        return http.client.HTTPConnection(
+            host, int(port), timeout=self.config.upstream_timeout_s)
+
+    def _post(self, endpoint, path, body, content_type, request_id):
+        """One upstream POST; returns (conn, response).  Caller closes
+        the conn (streaming readers hold it open)."""
+        conn = self._connect(endpoint)
+        headers = {"Content-Type": content_type}
+        if request_id:
+            headers["X-Request-Id"] = request_id
+        conn.request("POST", path, body=body, headers=headers)
+        return conn, conn.getresponse()
+
+    def _bump(self, result):
+        self.requests[result] = self.requests.get(result, 0) + 1
+        if telemetry.ENABLED:
+            telemetry.FLEET_REQUESTS.labels(result=result).inc()
+
+    def _enter(self, rid):
+        with self._lock:
+            self._inflight[rid] = self._inflight.get(rid, 0) + 1
+
+    def _leave(self, rid):
+        with self._lock:
+            n = self._inflight.get(rid, 0) - 1
+            if n <= 0:
+                self._inflight.pop(rid, None)
+            else:
+                self._inflight[rid] = n
+
+    # -- decode dispatch (the zero-drop core) --------------------------------
+    def run_decode(self, payload, request_id=None, emit=None):
+        """Run one decode request over the fleet.  ``emit(event)``
+        receives every client-visible NDJSON event in order —
+        ``{"token", "index"}`` per token, then exactly one terminal
+        ``{"done", ...}`` or ``{"error", ...}`` — identical whether
+        the sequence survived zero or N failovers.  Returns the
+        terminal event.  Collect-mode callers pass ``emit=None``."""
+        t_start = time.perf_counter()
+        events = []
+
+        def push(ev):
+            events.append(ev)
+            if emit is not None:
+                emit(ev)
+
+        gen = self._resolved_generation()
+        if request_id and gen is not None:
+            verdict = discovery.poison_verdict(self.kv, gen, request_id)
+            if verdict is not None:
+                # condemned fleet-wide: fail fast, no replica touched
+                self._bump("poisoned")
+                ev = {"error": "request %s is poisoned fleet-wide: %s"
+                      % (request_id, verdict.get("reason")),
+                      "type": "PoisonedRequest"}
+                push(ev)
+                return ev
+        emitted = []          # the cursor: tokens already sent out
+        tried = set()
+        attempts = 0
+        last_err = None
+        while attempts <= self.config.retries:
+            t_pick = time.perf_counter()
+            records = self.refresh(force=attempts > 0)
+            disagg = pools.disaggregated(records)
+            try:
+                if attempts == 0:
+                    plane = "prefill" if disagg else "decode"
+                    rid = self.pick(records, plane)
+                else:
+                    order = self.failover_order(
+                        records, "prefill" if disagg else "decode",
+                        exclude=tried)
+                    rid = order[0] if order else None
+            except FleetSaturated as exc:
+                if not emitted:
+                    self._bump("rejected")
+                    ev = {"error": str(exc), "type": "FleetSaturated",
+                          "retry_after": self.config.retry_after_s}
+                    push(ev)
+                    return ev
+                # mid-stream saturation: a queued retry beats a drop
+                order = self.failover_order(
+                    records, "prefill" if disagg else "decode",
+                    exclude=tried)
+                rid = order[0] if order else None
+                last_err = exc
+            if rid is None:
+                break
+            if telemetry.ENABLED:
+                telemetry.FLEET_ROUTER_OVERHEAD_SECONDS.observe(
+                    time.perf_counter() - t_pick)
+                telemetry.FLEET_DISPATCHES.labels(
+                    plane="prefill" if disagg else "decode").inc()
+            tried.add(rid)
+            try:
+                if disagg:
+                    done = self._stream_disaggregated(
+                        records, rid, payload, request_id, emitted,
+                        push, tried)
+                else:
+                    done = self._stream_from(
+                        records[rid], rid, "/predict?stream=1",
+                        json.dumps(payload).encode(),
+                        "application/json", request_id, emitted, push)
+            except _Poisoned as exc:
+                self._condemn(request_id, exc)
+                self._bump("poisoned")
+                ev = {"error": str(exc), "type": exc.kind}
+                push(ev)
+                return ev
+            except Exception as exc:  # noqa: BLE001 - replica failure
+                last_err = exc
+                attempts += 1
+                self.failovers += 1
+                if telemetry.ENABLED:
+                    telemetry.FLEET_FAILOVERS.inc()
+                _LOG.warning(
+                    "fleet failover #%d for request %s off replica %s "
+                    "after %d emitted token(s): %s", attempts,
+                    request_id, rid, len(emitted), exc)
+                continue
+            self._bump("ok")
+            if telemetry.ENABLED:
+                telemetry.FLEET_ROUTER_REQUEST_SECONDS.observe(
+                    time.perf_counter() - t_start)
+            return done
+        self._bump("failed")
+        ev = {"error": "no routable replica for request %s after %d "
+              "attempt(s): %s" % (request_id, attempts,
+                                  last_err), "type": "FleetExhausted"}
+        push(ev)
+        return ev
+
+    def _stream_from(self, rec, rid, path, body, ctype, request_id,
+                     emitted, push):
+        """Proxy one upstream streaming response, advancing the
+        emitted-token cursor.  Replayed tokens (index < cursor, from a
+        post-failover re-prefill) are verified against the cursor and
+        swallowed; fresh tokens are pushed.  Raises on transport
+        failure / premature EOF (the failover triggers); raises
+        ``_Poisoned`` for sequence-own errors that must not retry."""
+        self._enter(rid)
+        conn = None
+        try:
+            conn, resp = self._post(rec["endpoint"], path, body, ctype,
+                                    request_id)
+            if resp.status != 200:
+                err = resp.read().decode(errors="replace")
+                if resp.status in (503, 504):
+                    raise ConnectionError(
+                        "replica %s: HTTP %d %s" % (rid, resp.status,
+                                                    err))
+                raise _Poisoned("replica %s rejected the request: "
+                                "HTTP %d %s" % (rid, resp.status, err),
+                                kind="UpstreamRejected")
+            saw_terminal = False
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line:
+                    continue
+                ev = json.loads(line)
+                if "token" in ev:
+                    idx = int(ev["index"])
+                    if idx < len(emitted):
+                        if emitted[idx] != ev["token"]:
+                            raise _Poisoned(
+                                "failover replay diverged at index %d "
+                                "(%r != %r): replicas disagree — "
+                                "refusing to splice streams"
+                                % (idx, ev["token"], emitted[idx]),
+                                kind="ReplayMismatch")
+                        continue      # replayed prefix: already sent
+                    emitted.append(ev["token"])
+                    push(ev)
+                elif "done" in ev:
+                    saw_terminal = True
+                    push(ev)
+                    return ev
+                elif "error" in ev:
+                    saw_terminal = True
+                    if ev.get("type") in ("ServerClosed",
+                                          "ConnectionError"):
+                        # the replica is going away, not the sequence:
+                        # this is a failover, not a verdict
+                        raise ConnectionError(
+                            "replica %s closed mid-stream: %s"
+                            % (rid, ev["error"]))
+                    raise _Poisoned(
+                        "sequence failed on replica %s: %s"
+                        % (rid, ev["error"]),
+                        kind=ev.get("type") or "UpstreamError")
+            if not saw_terminal:
+                raise ConnectionError(
+                    "replica %s stream ended without a terminal event "
+                    "(%d token(s) so far)" % (rid, len(emitted)))
+        finally:
+            self._leave(rid)
+            if conn is not None:
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _stream_disaggregated(self, records, prefill_rid, payload,
+                              request_id, emitted, push, tried):
+        """The two-hop path: export the prompt's KV pages from
+        ``prefill_rid``, import the blob on a decode replica, stream
+        from there.  Any hop failure raises (the caller retries the
+        whole pipeline — handoff blobs are cheap relative to a
+        dropped stream)."""
+        rec = records[prefill_rid]
+        self._enter(prefill_rid)
+        try:
+            conn, resp = self._post(
+                rec["endpoint"], "/fleet/handoff/export",
+                json.dumps({k: payload[k] for k in
+                            ("tokens", "max_new_tokens", "eos_id",
+                             "timeout_ms") if k in payload}).encode(),
+                "application/json", request_id)
+            try:
+                if resp.status != 200:
+                    raise ConnectionError(
+                        "prefill replica %s export failed: HTTP %d %s"
+                        % (prefill_rid, resp.status,
+                           resp.read(200).decode(errors="replace")))
+                blob = resp.read()
+            finally:
+                conn.close()
+        finally:
+            self._leave(prefill_rid)
+        self.handoffs += 1
+        if telemetry.ENABLED:
+            telemetry.FLEET_HANDOFF_BYTES.observe(len(blob))
+        try:
+            decode_rid = self.pick(records, "decode", exclude=tried)
+        except FleetSaturated:
+            order = self.failover_order(records, "decode",
+                                        exclude=tried)
+            decode_rid = order[0] if order else None
+        if decode_rid is None:
+            raise ConnectionError("no routable decode replica for the "
+                                  "handoff")
+        tried.add(decode_rid)
+        if telemetry.ENABLED:
+            telemetry.FLEET_DISPATCHES.labels(plane="decode").inc()
+        return self._stream_from(
+            records[decode_rid], decode_rid,
+            "/fleet/handoff/import?stream=1", blob,
+            "application/octet-stream", request_id, emitted, push)
+
+    def _condemn(self, request_id, exc):
+        """Publish the fleet-wide poison verdict (first writer wins)."""
+        gen = self._resolved_generation()
+        if request_id and gen is not None:
+            discovery.publish_poison(self.kv, gen, request_id,
+                                     str(exc), by="router")
+
+    # -- micro-batch dispatch ------------------------------------------------
+    def run_micro(self, payload, request_id=None):
+        """Dispatch one micro-batch (``inputs``) request to a
+        colocated replica; retries connection failures on survivors.
+        Returns ``(status_code, body_dict, extra_headers)``."""
+        tried = set()
+        last_err = None
+        for attempt in range(self.config.retries + 1):
+            records = self.refresh(force=attempt > 0)
+            t_pick = time.perf_counter()
+            try:
+                rid = self.pick(records, "micro", exclude=tried) \
+                    if attempt == 0 else None
+                if rid is None:
+                    order = self.failover_order(records, "micro",
+                                                exclude=tried)
+                    rid = order[0] if order else None
+            except FleetSaturated as exc:
+                self._bump("rejected")
+                return (503, {"error": str(exc)},
+                        (("Retry-After", "%d" % max(1, round(
+                            self.config.retry_after_s))),))
+            if rid is None:
+                break
+            tried.add(rid)
+            if telemetry.ENABLED:
+                telemetry.FLEET_ROUTER_OVERHEAD_SECONDS.observe(
+                    time.perf_counter() - t_pick)
+                telemetry.FLEET_DISPATCHES.labels(plane="micro").inc()
+            self._enter(rid)
+            try:
+                conn, resp = self._post(
+                    records[rid]["endpoint"], "/predict",
+                    json.dumps(payload).encode(), "application/json",
+                    request_id)
+                try:
+                    body = json.loads(resp.read() or b"{}")
+                    if resp.status in (503, 504):
+                        raise ConnectionError(
+                            "replica %s: HTTP %d" % (rid, resp.status))
+                    self._bump("ok" if resp.status == 200 else "failed")
+                    return resp.status, body, ()
+                finally:
+                    conn.close()
+            except (ConnectionError, OSError,
+                    http.client.HTTPException) as exc:
+                last_err = exc
+                self.failovers += 1
+                if telemetry.ENABLED:
+                    telemetry.FLEET_FAILOVERS.inc()
+            finally:
+                self._leave(rid)
+        self._bump("failed")
+        return (503, {"error": "no routable replica: %s" % last_err},
+                (("Retry-After", "%d" % max(1, round(
+                    self.config.retry_after_s))),))
+
+    # -- introspection -------------------------------------------------------
+    def stats(self):
+        records = self.refresh()
+        doc = {
+            "schema_version": ROUTER_STATZ_SCHEMA_VERSION,
+            "generation": self._resolved_generation(),
+            "config": self.config.as_dict(),
+            "replicas": records,
+            "pools": pools.pool_stats(records),
+            "disaggregated": pools.disaggregated(records),
+            "requests": dict(self.requests),
+            "failovers": self.failovers,
+            "handoffs": self.handoffs,
+        }
+        with self._lock:
+            doc["inflight"] = sum(self._inflight.values())
+            doc["inflight_by_replica"] = dict(self._inflight)
+        gen = doc["generation"]
+        doc["poison"] = discovery.poison_ids(self.kv, gen) \
+            if gen is not None else []
+        doc["draining"] = sorted(discovery.draining_ids(self.kv, gen)) \
+            if gen is not None else []
+        return doc
+
+    def healthy(self):
+        return not self._closed
+
+    def ready(self):
+        """Ready when at least one replica is routable on any plane."""
+        records = self.refresh()
+        return any(self.routable(records, plane)
+                   for plane in ("micro", "prefill", "decode"))
+
+    # -- HTTP surface --------------------------------------------------------
+    def start_http(self, host="127.0.0.1", port=0):
+        """Start the router endpoint (same daemon-thread stdlib
+        discipline as ``serve.Server``); registers the router p99 SLO
+        with mx.obs when the obs plane is armed.  Returns
+        ``(host, port)``."""
+        if self._httpd is not None:
+            return self._httpd.server_address[:2]
+        httpd = ThreadingHTTPServer((host, port), _RouterHandler)
+        httpd.daemon_threads = True
+        httpd.mx_router = self
+        self._httpd = httpd
+        t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                             name="mx-fleet-router")
+        t.start()
+        try:
+            from .. import obs as _obs
+
+            if _obs.is_enabled():
+                _obs.slo("fleet_router_p99_ms",
+                         histogram="fleet_router_request_seconds",
+                         q=0.99, target=self.config.slo_target_s)
+        except Exception:  # noqa: BLE001 - obs is optional
+            pass
+        return httpd.server_address[:2]
+
+    def shutdown(self):
+        self._closed = True
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    def next_request_id(self):
+        return "fleet-%d" % next(self._rid_counter)
+
+
+class _Poisoned(Exception):
+    """A sequence-own failure: condemn fleet-wide, do not retry."""
+
+    def __init__(self, msg, kind="UpstreamError"):
+        super().__init__(msg)
+        self.kind = kind
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    server_version = "mx-fleet-router/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        logging.getLogger("mxnet_tpu.fleet.http").debug(fmt, *args)
+
+    def _send(self, code, body, content_type="application/json",
+              headers=()):
+        data = body if isinstance(body, bytes) else \
+            json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802
+        rt = self.server.mx_router
+        if self.path == "/healthz":
+            self._send(200 if rt.healthy() else 503,
+                       {"status": "ok" if rt.healthy() else "down"})
+        elif self.path == "/readyz":
+            ready = rt.ready()
+            self._send(200 if ready else 503, {"ready": ready})
+        elif self.path == "/metrics":
+            self._send(200, telemetry.prometheus().encode(),
+                       content_type="text/plain; version=0.0.4")
+        elif self.path == "/statz":
+            self._send(200, rt.stats())
+        else:
+            self._send(404, {"error": "unknown path %s" % self.path})
+
+    def do_POST(self):  # noqa: N802
+        import urllib.parse
+
+        rt = self.server.mx_router
+        parts = urllib.parse.urlsplit(self.path)
+        if parts.path != "/predict":
+            self._send(404, {"error": "unknown path %s" % self.path})
+            return
+        query = urllib.parse.parse_qs(parts.query)
+        from .. import trace
+
+        rid = trace.sanitize_request_id(
+            self.headers.get("X-Request-Id")) or rt.next_request_id()
+        echo = (("X-Request-Id", rid),)
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n) or b"{}")
+        except ValueError as exc:
+            self._send(400, {"error": str(exc)}, headers=echo)
+            return
+        if "tokens" not in payload:
+            status, body, extra = rt.run_micro(payload, request_id=rid)
+            self._send(status, body, headers=echo + tuple(extra))
+            return
+        stream = payload.get("stream")
+        if stream is None:
+            stream = query.get("stream", ["0"])[0] \
+                not in ("", "0", "false")
+        if not stream:
+            done = rt.run_decode(payload, request_id=rid)
+            if "error" in done:
+                code = 503 if done.get("type") in (
+                    "FleetSaturated", "FleetExhausted",
+                    "PoisonedRequest") else 500
+                extra = (("Retry-After", "%d" % max(1, round(
+                    done["retry_after"]))),) \
+                    if "retry_after" in done else ()
+                self._send(code, done, headers=echo + extra)
+            else:
+                self._send(200, done, headers=echo)
+            return
+        # streaming: chunked NDJSON, same wire format as serve.Server
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        for k, v in echo:
+            self.send_header(k, v)
+        try:
+            self.end_headers()
+
+            def emit(ev):
+                data = json.dumps(ev).encode() + b"\n"
+                self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+
+            rt.run_decode(payload, request_id=rid, emit=emit)
+            self.wfile.write(b"0\r\n\r\n")
+        except Exception:  # noqa: BLE001 - client gone mid-stream
+            self.close_connection = True
+
+
+# ---------------------------------------------------------------------------
+# rollout — drain-aware rolling hot-swap
+# ---------------------------------------------------------------------------
+
+def rollout(replica_ids, kv, generation, drain, wait_ready=True,
+            poll_s=0.1, timeout=60.0):
+    """Roll a change across ``replica_ids`` ONE AT A TIME with zero
+    rejects: flag the replica draining in the KV (routers stop new
+    dispatches on their next refresh), call ``drain(replica_id)`` —
+    the caller's hook that actually drains/swaps/restarts it — then
+    wait until its discovery record reads ready again before clearing
+    the flag and moving on.  Returns the list of rolled replica ids;
+    raises ``TimeoutError`` if a replica never comes back (its drain
+    flag is cleared regardless — a stuck rollout must not black-hole
+    the replica forever)."""
+    rolled = []
+    for rid in replica_ids:
+        discovery.set_draining(kv, generation, rid, True)
+        try:
+            drain(rid)
+            if wait_ready:
+                deadline = time.monotonic() + timeout
+                while True:
+                    recs = discovery.replicas(kv, generation)
+                    rec = recs.get(rid)
+                    if rec is not None and rec.get("ready") and \
+                            not rec.get("draining"):
+                        break
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            "rollout: replica %s not ready %.0fs after "
+                            "drain" % (rid, timeout))
+                    time.sleep(poll_s)
+        finally:
+            discovery.set_draining(kv, generation, rid, False)
+        rolled.append(rid)
+        if telemetry.ENABLED:
+            telemetry.FLEET_ROLLOUTS.inc()
+    return rolled
+
+
+def kv_doc(kv, generation=None):
+    """A router-/statz/-shaped document straight from the KV (no
+    router process needed): what ``tools/diagnose.py --fleet-router``
+    renders when given a KV root instead of a router URL."""
+    if generation is None:
+        generation = discovery.latest_generation(kv)
+    if generation is None:
+        return {"schema_version": ROUTER_STATZ_SCHEMA_VERSION,
+                "generation": None, "replicas": {}, "pools":
+                pools.pool_stats({}), "disaggregated": False,
+                "requests": {}, "failovers": 0, "handoffs": 0,
+                "inflight": 0, "inflight_by_replica": {}, "poison": [],
+                "draining": [], "config": None}
+    records = discovery.replicas(kv, generation)
+    drains = discovery.draining_ids(kv, generation)
+    for rid, rec in records.items():
+        if rid in drains:
+            rec["draining"] = True
+    return {"schema_version": ROUTER_STATZ_SCHEMA_VERSION,
+            "generation": generation,
+            "config": None,
+            "replicas": records,
+            "pools": pools.pool_stats(records),
+            "disaggregated": pools.disaggregated(records),
+            "requests": {}, "failovers": 0, "handoffs": 0,
+            "inflight": 0, "inflight_by_replica": {},
+            "poison": discovery.poison_ids(kv, generation),
+            "draining": sorted(drains)}

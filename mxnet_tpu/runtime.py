@@ -110,6 +110,7 @@ def _detect():
         "TRACE": True,
         "CHECKPOINT": True,
         "SERVE": True,
+        "FLEET": True,
         "DATA": True,
         "RESILIENCE": True,
         "OPENMP": True,

@@ -144,6 +144,15 @@ class BatchQueue:
     def __len__(self):
         return len(self._items)
 
+    def oldest_age(self):
+        """Seconds the head-of-line request has waited (0.0 when
+        empty) — the queue-age signal the fleet router's load-aware
+        dispatch weighs (depth alone hides a stuck scheduler)."""
+        with self._cond:
+            if not self._items:
+                return 0.0
+            return max(0.0, time.perf_counter() - self._items[0].enqueued)
+
     @property
     def closed(self):
         return self._closed

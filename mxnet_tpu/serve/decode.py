@@ -217,14 +217,22 @@ class DecodeRequest:
     is bit-identical to the future's ``tokens``."""
 
     __slots__ = ("prompt", "max_new_tokens", "eos_id", "on_token",
-                 "future", "enqueued", "deadline", "request_id", "trace")
+                 "future", "enqueued", "deadline", "request_id", "trace",
+                 "export_only", "handoff")
 
     def __init__(self, prompt, max_new_tokens, eos_id=None, deadline=None,
-                 request_id=None, on_token=None):
+                 request_id=None, on_token=None, export_only=False,
+                 handoff=None):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
         self.on_token = on_token
+        # mx.fleet disaggregation: export_only sequences stop after
+        # prefill (future resolves to the handoff state dict); handoff
+        # carries an unpacked fleet.handoff state to install instead of
+        # prefilling locally
+        self.export_only = bool(export_only)
+        self.handoff = handoff
         self.future = Future()
         self.enqueued = time.perf_counter()
         self.deadline = deadline
@@ -747,6 +755,127 @@ class DecodeScheduler:
             self._cond.notify_all()
         return req.future
 
+    # -- fleet disaggregation (mxnet_tpu/fleet/handoff.py) -------------------
+    def submit_export(self, prompt, max_new_tokens=None, eos_id=None,
+                      timeout_ms=None, request_id=None):
+        """Prefill-only admission for a disaggregated PREFILL replica:
+        the sequence runs its prompt, then its future resolves to the
+        ``fleet.handoff`` state dict (pages + cursor + first token)
+        instead of decoding — the decode happens on whichever replica
+        imports the blob.  Validation mirrors ``submit`` but the page
+        reservation is prompt-only (no generation happens here)."""
+        cfg = self.config
+        prompt = [int(t) for t in (prompt or ())]
+        if not prompt:
+            raise DecodeError("export needs a non-empty prompt")
+        vocab = self._runner.block.vocab_size
+        if min(prompt) < 0 or max(prompt) >= vocab:
+            raise DecodeError("prompt token ids must be in [0, %d)"
+                              % vocab)
+        mnt = cfg.max_new_tokens if max_new_tokens is None \
+            else int(max_new_tokens)
+        if mnt < 1:
+            raise DecodeError("max_new_tokens must be >= 1")
+        mnt = min(mnt, cfg.max_new_tokens)
+        t_bucket = self._runner.prefill_bucket(len(prompt))
+        need = self._runner.page_config.pages_for(len(prompt))
+        if need > self._runner.pool.capacity:
+            raise PagePoolExhausted(
+                "export needs %d KV pages but the pool only has %d"
+                % (need, self._runner.pool.capacity))
+        if self._breakers is not None and \
+                self._breakers.blocked(("prefill", t_bucket)):
+            if telemetry.ENABLED:
+                telemetry.SERVE_REQUESTS.labels(
+                    result="quarantined").inc()
+            raise self._breakers.quarantine_error(("prefill", t_bucket))
+        timeout_ms = cfg.timeout_ms if timeout_ms is None else timeout_ms
+        deadline = None if timeout_ms is None \
+            else time.perf_counter() + float(timeout_ms) / 1e3
+        req = DecodeRequest(
+            prompt, mnt,
+            eos_id=self._runner.eos_id if eos_id is None else eos_id,
+            deadline=deadline, request_id=request_id, export_only=True)
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("decode scheduler is shut down")
+            if len(self._waiting) >= cfg.queue_depth:
+                if telemetry.ENABLED:
+                    telemetry.SERVE_REQUESTS.labels(
+                        result="rejected").inc()
+                raise ServerOverloaded(
+                    "decode admission queue full (%d waiting, depth=%d)"
+                    % (len(self._waiting), cfg.queue_depth))
+            self._waiting.append(req)
+            if telemetry.ENABLED:
+                telemetry.SERVE_DECODE_WAITING.set(len(self._waiting))
+            self._cond.notify_all()
+        return req.future
+
+    def submit_handoff(self, state, timeout_ms=None, request_id=None,
+                       on_token=None):
+        """Import admission for a disaggregated DECODE replica: the
+        PR 12 reservation math re-runs HERE against this pool — full
+        worst case (``pages_for(length + max_new_tokens)``) reserved up
+        front, geometry cross-checked — so an imported sequence carries
+        exactly the admission guarantees of a local one (no mid-decode
+        allocation failure, scrub guard over positions >= cursor).
+        ``state`` is an unpacked ``fleet.handoff`` blob."""
+        from ..fleet import handoff as _handoff
+
+        cfg = self.config
+        prompt = [int(t) for t in (state.get("prompt") or ())]
+        if not prompt:
+            raise DecodeError("handoff carries an empty prompt")
+        vocab = self._runner.block.vocab_size
+        first = int(state["first_token"])
+        if min(prompt) < 0 or max(prompt) >= vocab or \
+                not 0 <= first < vocab:
+            raise DecodeError(
+                "handoff token ids must be in [0, %d)" % vocab)
+        mnt = int(state["max_new_tokens"])
+        if mnt < 1:
+            raise DecodeError("max_new_tokens must be >= 1")
+        mnt = min(mnt, cfg.max_new_tokens)
+        _handoff.validate_geometry(state, self._runner.page_config)
+        total = int(state["length"]) + mnt
+        if total > cfg.max_context:
+            raise DecodeError(
+                "handoff cursor (%d) + max_new_tokens (%d) exceeds "
+                "max_context=%d" % (state["length"], mnt,
+                                    cfg.max_context))
+        need = self._runner.page_config.pages_for(total)
+        if need > self._runner.pool.capacity:
+            raise PagePoolExhausted(
+                "handoff needs %d KV pages but the pool only has %d"
+                % (need, self._runner.pool.capacity))
+        timeout_ms = cfg.timeout_ms if timeout_ms is None else timeout_ms
+        deadline = None if timeout_ms is None \
+            else time.perf_counter() + float(timeout_ms) / 1e3
+        eos = state.get("eos_id")
+        req = DecodeRequest(
+            prompt, mnt,
+            eos_id=self._runner.eos_id if eos is None else eos,
+            deadline=deadline,
+            request_id=request_id if request_id is not None
+            else state.get("request_id"),
+            on_token=on_token, handoff=state)
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("decode scheduler is shut down")
+            if len(self._waiting) >= cfg.queue_depth:
+                if telemetry.ENABLED:
+                    telemetry.SERVE_REQUESTS.labels(
+                        result="rejected").inc()
+                raise ServerOverloaded(
+                    "decode admission queue full (%d waiting, depth=%d)"
+                    % (len(self._waiting), cfg.queue_depth))
+            self._waiting.append(req)
+            if telemetry.ENABLED:
+                telemetry.SERVE_DECODE_WAITING.set(len(self._waiting))
+            self._cond.notify_all()
+        return req.future
+
     # -- introspection ------------------------------------------------------
     def stats(self):
         with self._cond:
@@ -778,6 +907,16 @@ class DecodeScheduler:
 
     def recent(self):
         return list(self._recent)
+
+    def oldest_waiting_age(self):
+        """Seconds the head-of-line waiting request has queued (0.0
+        when empty) — the decode-plane half of the fleet router's
+        queue-age load signal."""
+        with self._cond:
+            if not self._waiting:
+                return 0.0
+            return max(0.0,
+                       time.perf_counter() - self._waiting[0].enqueued)
 
     # -- the loop -----------------------------------------------------------
     @staticmethod
@@ -936,19 +1075,32 @@ class DecodeScheduler:
                 out.append(seq)
         return out
 
+    def _pages_needed(self, req):
+        """The reservation one request admits with: full worst case
+        (prompt + generation) normally; prompt-only for an export
+        (generation happens on the importing replica); imported cursor
+        + generation for a handoff."""
+        if req.export_only:
+            total = len(req.prompt)
+        elif req.handoff is not None:
+            total = int(req.handoff["length"]) + req.max_new_tokens
+        else:
+            total = len(req.prompt) + req.max_new_tokens
+        return self._runner.page_config.pages_for(total)
+
     def _admit(self):
         """Fill free slots from the waiting queue (FIFO): reserve the
-        whole worst-case page count, prefill through the bucket path,
-        emit the first token.  Stops at the first request the pool
-        cannot hold yet — admission order is arrival order."""
+        whole worst-case page count, prefill through the bucket path
+        (or install a handed-off prefill), emit the first token.
+        Stops at the first request the pool cannot hold yet —
+        admission order is arrival order."""
         while len(self._live) < self.config.max_live:
             with self._cond:
                 if not self._waiting or self._pending_runner is not None:
                     return
                 req = self._waiting[0]
                 pool = self._runner.pool
-                need = self._runner.page_config.pages_for(
-                    len(req.prompt) + req.max_new_tokens)
+                need = self._pages_needed(req)
                 if need > pool.capacity:
                     # submit() validated against the runner of its day;
                     # a hot swap may have shrunk the pool since.  Fail
@@ -971,6 +1123,9 @@ class DecodeScheduler:
             seq = _Seq(req, sid)
             if _inject.poisoned(req.request_id):
                 self._evict_poisoned([seq])
+                continue
+            if req.handoff is not None:
+                self._admit_handoff(seq, need)
                 continue
             try:
                 t_bucket = self._runner.prefill_bucket(len(req.prompt))
@@ -1019,9 +1174,77 @@ class DecodeScheduler:
             if bad:
                 self._evict_nonfinite(seq, bad)
                 continue
+            if req.export_only:
+                self._finish_export(seq, int(tok))
+                self._gauges()
+                continue
             self._emit(seq, int(tok), t0)
             self._finish_if_done(seq)
             self._gauges()
+
+    def _admit_handoff(self, seq, need):
+        """Admit one imported sequence: reserve the (already
+        re-validated) worst case, splice the blob's pages into the
+        reservation, and emit the prefill replica's first token so the
+        client stream is byte-identical to a colocated run."""
+        from ..fleet import handoff as _handoff
+
+        req = seq.req
+        state = req.handoff
+        seq.pages = self._runner.pool.alloc(seq.sid, need)
+        t0 = time.perf_counter()
+        try:
+            with trace.use(req.trace), \
+                    trace.span("serve_decode_handoff_install", hist=False,
+                               cat="serve",
+                               args={"pages": int(state["pages"]),
+                                     "request_id": req.request_id}):
+                _handoff.install_seq(self._runner, seq, state)
+        except BaseException as exc:  # noqa: BLE001 - per-request
+            self._release(seq)
+            if getattr(exc, "pool_lost", False):
+                self._evict_all_live(exc)
+            fail_request(req, exc, "error")
+            self._bump("error")
+            return
+        seq.length = int(state["length"])
+        seq.joined_step = self.steps
+        seq.t_prefill = time.perf_counter() - t0
+        with self._cond:
+            self._live[seq.sid] = seq
+        self.admitted_total += 1
+        self._emit(seq, int(state["first_token"]), t0)
+        self._finish_if_done(seq)
+        self._gauges()
+
+    def _finish_export(self, seq, first_token):
+        """Resolve an export_only sequence: snapshot its pages +
+        cursor + first token as the handoff state, reclaim the pages,
+        resolve the future with the state dict."""
+        from ..fleet import handoff as _handoff
+
+        with self._cond:
+            self._live.pop(seq.sid, None)
+        try:
+            state = _handoff.export_seq(self._runner, seq, first_token)
+        except BaseException as exc:  # noqa: BLE001 - per-request
+            self._release(seq)
+            fail_request(seq.req, exc, "error")
+            self._bump("error")
+            self._record(seq, "error")
+            return
+        self._release(seq)
+        self._bump("exported")
+        self._record(seq, "exported")
+        done_t = time.perf_counter()
+        try:
+            seq.req.future.set_result(state)
+        except InvalidStateError:
+            return
+        if telemetry.ENABLED:
+            telemetry.SERVE_REQUESTS.labels(result="ok").inc()
+            telemetry.SERVE_REQUEST_SECONDS.observe(
+                done_t - seq.req.enqueued)
 
     def _evict_nonfinite(self, seq, bad):
         """The per-token output guard tripped: this sequence's logits

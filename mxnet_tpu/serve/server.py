@@ -50,7 +50,13 @@ from .decode import DecodeError
 from .kvcache import PagePoolExhausted
 from .runner import DEFAULT_BATCH_SIZES, ModelRunner
 
-__all__ = ["ServeConfig", "Server"]
+__all__ = ["ServeConfig", "Server", "SERVE_STATZ_SCHEMA_VERSION"]
+
+# /statz top-level schema version: the mx.fleet router's load-digest
+# parser and external scrapers key on this.  Bump it when the TOP-LEVEL
+# key set changes (tests/python/unittest/test_serve.py locks the set);
+# adding nested detail under existing keys does not bump it.
+SERVE_STATZ_SCHEMA_VERSION = 1
 
 
 class ServeConfig:
@@ -197,6 +203,14 @@ class Server:
         self._swap_lock = threading.Lock()
         self._httpd = None
         self._closed = False
+        # mx.fleet: discovery registrar, rollout drain flag, and the
+        # live-stream counter graceful drain waits on (streaming
+        # handler threads are daemon threads; without the count a
+        # drain could close the listener under a half-written stream)
+        self._registrar = None
+        self._draining = False
+        self._streams = 0
+        self._stream_cv = threading.Condition()
         # preemption (mx.resilience): SIGTERM drains this server's
         # queue before the process exits — in-flight answers beat a
         # dropped queue every time.  Weak for the same reason as the
@@ -270,6 +284,67 @@ class Server:
     def queue_depth(self):
         return len(self._queue) if self._queue is not None else 0
 
+    def queue_age_s(self):
+        """Seconds the oldest queued request (either plane) has
+        waited — the router's primary load signal: depth alone reads
+        the same for a fast-draining and a stuck queue."""
+        age = 0.0
+        if self._queue is not None:
+            age = self._queue.oldest_age()
+        if self._decode is not None:
+            age = max(age, self._decode.oldest_waiting_age())
+        return age
+
+    @property
+    def draining(self):
+        """True while a fleet rollout is draining this replica: the
+        router stops NEW dispatches; in-flight work finishes."""
+        return self._draining
+
+    def set_draining(self, flag=True):
+        """Flip the rollout drain flag and push it to discovery
+        immediately (a rollout must not wait a publish interval for
+        routers to notice)."""
+        self._draining = bool(flag)
+        if self._registrar is not None:
+            self._registrar.publish()
+        return self._draining
+
+    def load_digest(self):
+        """The compact load digest the fleet registrar publishes on
+        every heartbeat (all derivable from /statz, but /statz is a
+        full stats walk — this is the cheap per-beat subset the
+        router's power-of-two-choices scoring reads)."""
+        digest = {
+            "queue_depth": self.queue_depth(),
+            "queue_capacity": self._config.queue_depth,
+            "queue_age_s": round(self.queue_age_s(), 4),
+            "decode_waiting": 0,
+            "decode_live": 0,
+            "decode_queue_depth": 0,
+            "decode_max_live": 0,
+            "pages_free": 0,
+            "pages_total": 0,
+            "breakers_open": 0,
+            "breakers_half_open": 0,
+        }
+        if self._decode is not None:
+            pool = self._decode.runner.pool
+            with self._decode._cond:
+                digest["decode_waiting"] = len(self._decode._waiting)
+                digest["decode_live"] = len(self._decode._live)
+            digest["decode_queue_depth"] = \
+                self._decode.config.queue_depth
+            digest["decode_max_live"] = self._decode.config.max_live
+            digest["pages_free"] = pool.available
+            digest["pages_total"] = pool.capacity
+        for b in self.breakers().values():
+            if b["state"] == "open":
+                digest["breakers_open"] += 1
+            elif b["state"] == "half_open":
+                digest["breakers_half_open"] += 1
+        return digest
+
     def stats(self):
         serve_totals = {k: v for k, v in telemetry.totals().items()
                         if k.startswith("serve_")}
@@ -282,9 +357,15 @@ class Server:
         from .. import monitor as _monitor
 
         return {
+            # the stable schema contract external parsers key on (the
+            # fleet router's digest, scrapers): top-level keys are
+            # locked by test_serve.py against this version
+            "schema_version": SERVE_STATZ_SCHEMA_VERSION,
             "ready": self.ready(),
             "healthy": self.healthy(),
+            "draining": self.draining,
             "queue_depth": self.queue_depth(),
+            "queue_age_s": round(self.queue_age_s(), 4),
             "config": self._config.as_dict(),
             "runner": self._runner.stats()
             if self._runner is not None else None,
@@ -400,6 +481,54 @@ class Server:
             timeout_ms=timeout_ms, request_id=request_id,
             on_token=on_token)
 
+    def submit_decode_export(self, tokens, max_new_tokens=None,
+                             eos_id=None, timeout_ms=None,
+                             request_id=None):
+        """Prefill-only submission (mx.fleet disaggregation): the
+        future resolves to the ``fleet.handoff`` state dict the
+        ``/fleet/handoff/export`` endpoint packs onto the wire."""
+        if self._closed:
+            raise ServerClosed("server is shut down")
+        if self._decode is None:
+            raise ServeError("this server has no decode plane")
+        return self._decode.submit_export(
+            tokens, max_new_tokens=max_new_tokens, eos_id=eos_id,
+            timeout_ms=timeout_ms, request_id=request_id)
+
+    def submit_decode_handoff(self, state, timeout_ms=None,
+                              request_id=None, on_token=None):
+        """Import a handed-off prefill (mx.fleet disaggregation):
+        admission reservation math re-runs against THIS pool before
+        any page content lands."""
+        if self._closed:
+            raise ServerClosed("server is shut down")
+        if self._decode is None:
+            raise ServeError("this server has no decode plane")
+        return self._decode.submit_handoff(
+            state, timeout_ms=timeout_ms, request_id=request_id,
+            on_token=on_token)
+
+    # -- fleet registration -------------------------------------------------
+    def register_fleet(self, membership, role=None, replica_id=None,
+                       interval=None):
+        """Register this replica in the mx.fleet discovery plane: its
+        endpoint + role + live load digest ride every membership
+        heartbeat under ``fleet/<gen>/<replica-id>``.  Requires
+        ``start_http()`` first (the record is an endpoint).  Returns
+        the attached ``fleet.discovery.Registrar``."""
+        if self._httpd is None:
+            raise ServeError("register_fleet needs start_http() first "
+                             "(the discovery record is an endpoint)")
+        if self._registrar is not None:
+            return self._registrar
+        from ..fleet import discovery as _discovery
+
+        host, port = self._httpd.server_address[:2]
+        self._registrar = _discovery.register(
+            self, membership, "%s:%d" % (host, port), role=role,
+            replica_id=replica_id, interval=interval)
+        return self._registrar
+
     def swap_decode(self, new_runner):
         """Repoint the decode plane at a new ``DecodeRunner``: live
         sequences finish on the old runner's pool, new admissions start
@@ -443,13 +572,45 @@ class Server:
             return new_runner.step
 
     # -- lifecycle ----------------------------------------------------------
+    def _stream_begin(self):
+        with self._stream_cv:
+            self._streams += 1
+
+    def _stream_end(self):
+        with self._stream_cv:
+            self._streams -= 1
+            self._stream_cv.notify_all()
+
+    def _wait_streams(self, timeout):
+        """Block until every in-flight streaming response has written
+        its terminator (bounded).  Returns True when none remain."""
+        deadline = time.monotonic() + (30.0 if timeout is None
+                                       else float(timeout))
+        with self._stream_cv:
+            while self._streams > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._stream_cv.wait(left)
+        return True
+
     def shutdown(self, drain=True, timeout=None):
         """Stop intake and join the scheduler.  With ``drain`` (the
-        default) queued requests are served first; with
-        ``drain=False`` they fail fast with ``ServerClosed``."""
+        default) queued requests are served first AND in-flight
+        streaming responses finish before the listener closes — the
+        planes drain first (resolving every future feeding a stream),
+        then the stream count reaches zero, then the socket goes away.
+        ``drain=False`` fails queued requests fast with
+        ``ServerClosed`` and tears the listener down immediately."""
         self._closed = True
         _preempt.remove_shutdown_hook(self._preempt_hook)
-        if self._httpd is not None:
+        if self._registrar is not None:
+            try:
+                self._registrar.close()
+            except Exception:  # noqa: BLE001 - discovery is best-effort
+                pass
+            self._registrar = None
+        if not drain and self._httpd is not None:
             self._httpd.shutdown()
             self._httpd = None
         ok = True
@@ -457,6 +618,11 @@ class Server:
             ok = self._decode.stop(drain=drain, timeout=timeout) and ok
         if self._scheduler is not None:
             ok = self._scheduler.stop(drain=drain, timeout=timeout) and ok
+        if drain:
+            ok = self._wait_streams(timeout) and ok
+            if self._httpd is not None:
+                self._httpd.shutdown()
+                self._httpd = None
         return ok
 
     def __enter__(self):
@@ -552,7 +718,9 @@ class _Handler(BaseHTTPRequestHandler):
 
         srv = self.server.mx_server
         parts = urllib.parse.urlsplit(self.path)
-        if parts.path != "/predict":
+        if parts.path not in ("/predict", "/drainz",
+                              "/fleet/handoff/export",
+                              "/fleet/handoff/import"):
             self._send(404, {"error": "unknown path %s" % self.path})
             return
         query = urllib.parse.parse_qs(parts.query)
@@ -572,9 +740,28 @@ class _Handler(BaseHTTPRequestHandler):
             # X-Request-Id rides on EVERY response — success, 503, 504
             self._send(code, body, headers=echo + tuple(extra))
 
+        from ..fleet.handoff import HandoffError
+
         try:
             n = int(self.headers.get("Content-Length", 0))
-            payload = json.loads(self.rfile.read(n) or b"{}")
+            raw = self.rfile.read(n)
+            if parts.path == "/drainz":
+                # mx.fleet rollout: flip the drain flag (body
+                # {"draining": bool}, default true) — discovery
+                # republishes immediately so routers stop dispatching
+                flag = srv.set_draining(
+                    json.loads(raw or b"{}").get("draining", True))
+                send(200, {"draining": flag})
+                return
+            if parts.path == "/fleet/handoff/export":
+                self._do_handoff_export(srv, json.loads(raw or b"{}"),
+                                        rid, echo)
+                return
+            if parts.path == "/fleet/handoff/import":
+                self._do_handoff_import(srv, raw, query, rid, echo,
+                                        send)
+                return
+            payload = json.loads(raw or b"{}")
             if "tokens" in payload:
                 self._do_decode(srv, payload, query, rid, echo, send)
                 return
@@ -609,6 +796,14 @@ class _Handler(BaseHTTPRequestHandler):
             send(504, {"error": str(exc)})
         except ServerClosed as exc:
             send(503, {"error": str(exc)})
+        except HandoffError as exc:
+            # a corrupt / geometry-skewed handoff blob: the sender's
+            # problem (router retries on a different replica or fails
+            # the request) — never a reason to poison this pool
+            if telemetry.ENABLED:
+                telemetry.FLEET_HANDOFFS.labels(
+                    result="checksum_mismatch").inc()
+            send(400, {"error": str(exc), "type": "HandoffError"})
         except (DecodeError, PagePoolExhausted) as exc:
             # static decode-plane limits (context/prompt/vocab bounds,
             # a reservation that can never fit the pool): client error,
@@ -656,10 +851,29 @@ class _Handler(BaseHTTPRequestHandler):
         import queue as _queue
 
         events = _queue.Queue()
-        fut = srv.submit_decode(
-            payload["tokens"],
-            on_token=lambda tok, i: events.put((tok, i)), **kwargs)
+        # count the stream BEFORE submitting: a drain racing this
+        # request must either see the stream (and wait for its
+        # terminator) or reject the submit — never close the listener
+        # between admission and the first header byte
+        srv._stream_begin()
+        try:
+            fut = srv.submit_decode(
+                payload["tokens"],
+                on_token=lambda tok, i: events.put((tok, i)), **kwargs)
+        except BaseException:
+            srv._stream_end()
+            raise
         fut.add_done_callback(lambda _f: events.put(None))
+        try:
+            self._stream_events(fut, events, dstep, echo)
+        finally:
+            srv._stream_end()
+
+    def _stream_events(self, fut, events, dstep, echo):
+        """Write one chunked NDJSON token stream: per-token events from
+        ``events`` (None = future resolved), then the ``done`` summary
+        (or in-stream ``error``), then the chunked terminator.  Shared
+        by ``/predict?stream=1`` and ``/fleet/handoff/import?stream=1``."""
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Transfer-Encoding", "chunked")
@@ -693,3 +907,63 @@ class _Handler(BaseHTTPRequestHandler):
             # engine finishes the sequence regardless (callbacks feed a
             # queue, never this socket)
             self.close_connection = True
+
+    def _do_handoff_export(self, srv, payload, rid, echo):
+        """POST /fleet/handoff/export (mx.fleet disaggregation): run
+        the prompt on this PREFILL replica and return the sequence's
+        pages + cursor + first token as one checksummed blob.
+        Pre-admission errors raise into do_POST's status mapping."""
+        from ..fleet import handoff as _handoff
+
+        state = srv.submit_decode_export(
+            payload["tokens"],
+            max_new_tokens=payload.get("max_new_tokens"),
+            eos_id=payload.get("eos_id"),
+            timeout_ms=payload.get("timeout_ms"),
+            request_id=rid).result()
+        blob = _handoff.pack(state)
+        if telemetry.ENABLED:
+            telemetry.FLEET_HANDOFFS.labels(result="ok").inc()
+            telemetry.FLEET_HANDOFF_BYTES.observe(len(blob))
+        self._send(200, blob, content_type="application/octet-stream",
+                   headers=echo)
+
+    def _do_handoff_import(self, srv, raw, query, rid, echo, send):
+        """POST /fleet/handoff/import: unpack (checksum + geometry
+        verified), re-run admission reservation on THIS pool, decode.
+        ``?stream=1`` streams tokens exactly like /predict?stream=1 —
+        the first event is the prefill replica's token 0, so the
+        client-visible stream is byte-identical to a colocated run."""
+        from ..fleet import handoff as _handoff
+
+        state = _handoff.unpack(raw)      # HandoffError -> 400 ladder
+        stream = query.get("stream", ["0"])[0] not in ("", "0", "false")
+        dstep = srv.decode.runner.step if srv.decode is not None else None
+        if not stream or srv.decode is None or \
+                not srv.decode.config.stream:
+            res = srv.submit_decode_handoff(state, request_id=rid) \
+                .result()
+            if telemetry.ENABLED:
+                telemetry.FLEET_HANDOFFS.labels(result="ok").inc()
+            send(200, {"tokens": res["tokens"],
+                       "finish_reason": res["finish_reason"],
+                       "step": dstep})
+            return
+        import queue as _queue
+
+        events = _queue.Queue()
+        srv._stream_begin()
+        try:
+            fut = srv.submit_decode_handoff(
+                state, request_id=rid,
+                on_token=lambda tok, i: events.put((tok, i)))
+        except BaseException:
+            srv._stream_end()
+            raise
+        fut.add_done_callback(lambda _f: events.put(None))
+        if telemetry.ENABLED:
+            telemetry.FLEET_HANDOFFS.labels(result="ok").inc()
+        try:
+            self._stream_events(fut, events, dstep, echo)
+        finally:
+            srv._stream_end()

@@ -1122,5 +1122,62 @@ OBS_FLEET_RANKS = gauge(
     "obs_fleet_ranks",
     "ranks visible in the last fleet-view refresh (1 + local_only "
     "means the membership KV is unreachable)")
+# mx.fleet (fleet/): the multi-replica serving fleet — KV-backed
+# service discovery, the load-aware router front-end, prefill/decode
+# page handoff, and zero-drop failover.
+FLEET_PUBLISHES = counter(
+    "fleet_publish_total",
+    "replica discovery records published into the membership KV "
+    "(heartbeat-piggybacked, rate-limited)")
+FLEET_PUBLISH_FAILURES = counter(
+    "fleet_publish_failures_total",
+    "discovery record publishes that failed (dead/partitioned KV; "
+    "the replica ages out of the router's view until it recovers)")
+FLEET_REQUESTS = counter(
+    "fleet_router_requests_total",
+    "router-fronted requests by outcome (ok / rejected = whole-fleet "
+    "saturation or no routable replica / failed / poisoned)",
+    ("result",))
+FLEET_DISPATCHES = counter(
+    "fleet_router_dispatch_total",
+    "upstream dispatch attempts by pool plane (micro / prefill / "
+    "decode; retries count again)", ("plane",))
+FLEET_FAILOVERS = counter(
+    "fleet_failover_total",
+    "mid-request re-routes after a replica death or connection "
+    "failure (the zero-drop replay path)")
+FLEET_HANDOFFS = counter(
+    "fleet_handoff_total",
+    "prefill->decode KV page handoffs by result (ok / "
+    "checksum_mismatch / error)", ("result",))
+FLEET_HANDOFF_BYTES = histogram(
+    "fleet_handoff_bytes",
+    "serialized page-handoff blob size (pages + cursor + sampler "
+    "state, one checksummed blob)",
+    buckets=(1024, 4096, 16384, 65536, 262144, 1048576, 4194304,
+             16777216))
+FLEET_ROUTER_OVERHEAD_SECONDS = histogram(
+    "fleet_router_overhead_seconds",
+    "router-added time per request (refresh + replica pick + "
+    "bookkeeping, excluding upstream serving time)",
+    buckets=(1e-5, 3e-5, 1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 0.1))
+FLEET_ROUTER_REQUEST_SECONDS = histogram(
+    "fleet_router_request_seconds",
+    "end-to-end latency of router-fronted requests (the fleet SLO "
+    "objective's feed)",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5, 5.0, 10.0, 30.0))
+FLEET_REPLICAS = gauge(
+    "fleet_replicas_live",
+    "fresh, non-draining replicas in the router's last discovery "
+    "refresh")
+FLEET_ROLLOUTS = counter(
+    "fleet_rollout_replicas_total",
+    "replicas drained and swapped by fleet.rollout() (one at a time, "
+    "riding Server's graceful drain)")
+FLEET_POISON_VERDICTS = counter(
+    "fleet_poison_verdicts_total",
+    "poison verdicts published to the KV (first writer wins; every "
+    "router stops retrying the sequence fleet-wide)")
 
 start_logger()

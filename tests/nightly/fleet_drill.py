@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""One mx.fleet serving replica for the CPU fleet drill.
+
+Run N of these under the world supervisor (no jax.distributed — the
+fleet plane only needs the shared membership directory)::
+
+    python tools/launch.py -n 3 --backend cpu --rendezvous none \
+        --member-dir /tmp/fleet --term-grace 120 \
+        python tests/nightly/fleet_drill.py serve
+
+Each rank builds the SAME seed-0 TinyDecoder (identical weights +
+greedy sampling is what makes zero-drop failover byte-identical),
+serves it over HTTP on a free port, and registers in the fleet via
+``Server.register_fleet`` — endpoint, role, and live load digest ride
+the membership heartbeat under ``fleet/<gen>/<rank>``.
+
+The drill harness (tools/fleet_smoke.py) drives a Router in ITS
+process over the same FileKV and SIGKILLs one replica mid-stream.
+The launcher reaps a world when any rank dies, so survivors treat the
+forwarded SIGTERM as "the drill is ending soon", not "exit now": they
+keep serving until the harness drops a ``stop`` file in the member
+dir, then drain gracefully and exit 0.  ``--term-grace`` bounds how
+long the launcher waits for that.
+
+Knobs (set by the harness, read from the environment):
+
+- ``MXNET_FLEET_DRILL_STEP_DELAY`` — seconds to sleep per decode step
+  (slows streams so a SIGKILL reliably lands mid-stream).
+- ``MXNET_FLEET_ROLE`` — this replica's pool role (the disaggregated
+  stage runs dedicated ``prefill`` / ``decode`` replicas).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+
+def build_runner(step_delay=0.0):
+    """The drill's deterministic decode plane: seed-0 TinyDecoder (same
+    weights on every replica) over a small paged pool."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.serve.decode import (DecodeConfig, DecodeRunner,
+                                        TinyDecoder)
+
+    mx.random.seed(0)
+    dec = TinyDecoder(vocab_size=32, num_layers=2, num_heads=2,
+                      head_dim=4)
+    dec.initialize()
+    cfg = DecodeConfig(page_size=4, pool_pages=32, max_live=2,
+                       max_new_tokens=10, max_context=24,
+                       prefill_lengths=(8,), batch_sizes=(1, 2))
+    runner = DecodeRunner(dec, config=cfg)
+    if step_delay > 0:
+        # slow decode per STEP (not per request): the kill lands while
+        # tokens are still streaming, which is the whole drill
+        orig = runner.decode_step
+
+        def _slow(seqs):
+            time.sleep(step_delay)
+            return orig(seqs)
+
+        runner.decode_step = _slow
+    return runner
+
+
+def cmd_serve(args):
+    import mxnet_tpu as mx
+
+    rank = int(os.environ.get("MXNET_DIST_RANK", "0"))
+    member_dir = args.dir or os.environ.get("MXNET_DIST_MEMBER_DIR")
+    if not member_dir:
+        print("fleet_drill: no member dir (--dir or "
+              "MXNET_DIST_MEMBER_DIR)", file=sys.stderr)
+        return 2
+    delay = float(os.environ.get("MXNET_FLEET_DRILL_STEP_DELAY",
+                                 "0") or 0)
+
+    runner = build_runner(step_delay=delay)
+    srv = mx.serve.Server(decode=runner)
+    host, port = srv.start_http()
+    membership = mx.dist.join()
+    srv.register_fleet(membership, role=args.role)
+
+    # the launcher forwards SIGTERM to the WHOLE world the moment any
+    # rank dies — exactly when the failover drill needs survivors to
+    # keep serving.  Defer: note it, keep going until the stop file.
+    sigterm_at = {"t": None}
+
+    def _on_term(_sig, _frm):
+        sigterm_at["t"] = time.monotonic()
+
+    signal.signal(signal.SIGTERM, _on_term)
+
+    # startup beacon for the harness (pid is what the kill stage needs)
+    with open(os.path.join(member_dir, "replica-%d.json" % rank),
+              "w") as f:
+        json.dump({"rank": rank, "pid": os.getpid(),
+                   "host": host, "port": port,
+                   "role": args.role or "both"}, f)
+    print("fleet_drill rank %d serving %s:%d pid %d"
+          % (rank, host, port, os.getpid()), flush=True)
+
+    stop_path = os.path.join(member_dir, "stop")
+    deadline = time.monotonic() + args.max_seconds
+    while time.monotonic() < deadline:
+        if os.path.exists(stop_path):
+            break
+        time.sleep(0.1)
+    else:
+        print("fleet_drill rank %d TIMEOUT" % rank, file=sys.stderr)
+        srv.shutdown(drain=False)
+        return 3
+
+    srv.shutdown(drain=True)
+    membership.leave()
+    print("fleet_drill rank %d FINAL OK" % rank, flush=True)
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    serve = sub.add_parser("serve", help="run one fleet replica")
+    serve.add_argument("--dir", default=None,
+                       help="member dir (default: "
+                            "MXNET_DIST_MEMBER_DIR)")
+    serve.add_argument("--role", default=None,
+                       choices=[None, "both", "prefill", "decode"],
+                       help="pool role (default: MXNET_FLEET_ROLE or "
+                            "'both')")
+    serve.add_argument("--max-seconds", type=float, default=300.0,
+                       help="hard wall clock bound (default 300)")
+    args = ap.parse_args(argv)
+    if args.cmd == "serve":
+        return cmd_serve(args)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,703 @@
+"""mx.fleet tests: KV discovery records (heartbeat-ridden publish,
+liveness aging, reserved-id rejection, first-writer-wins poison,
+drain flags), pool role arithmetic, handoff pack/unpack (checksum,
+truncation, geometry validation) + scheduler-level export->import
+parity, router scoring (p2c skew, saturation reject-early, failover
+ordering, routable filtering), end-to-end HTTP dispatch (stream ==
+collect == local, dead-replica zero-drop failover, disaggregated
+two-hop, poison stops retries, drain exclusion, rollout), and the
+``tools/diagnose.py --fleet-router`` golden renderer."""
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import fleet, serve, telemetry
+from mxnet_tpu.dist.membership import MemKV
+from mxnet_tpu.fleet import discovery, handoff, pools
+from mxnet_tpu.fleet.router import Router, RouterConfig
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    "..", "..", ".."))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.enable()
+    telemetry.reset()
+    yield
+    telemetry.enable()
+    telemetry.reset()
+
+
+def _membership(kv=None, gen=1, rank=0):
+    return SimpleNamespace(kv=kv if kv is not None else MemKV(),
+                           generation=gen, rank=rank)
+
+
+def _load(**kw):
+    d = {"queue_depth": 0, "queue_capacity": 64, "queue_age_s": 0.0,
+         "decode_waiting": 0, "decode_live": 0,
+         "decode_queue_depth": 32, "decode_max_live": 2,
+         "pages_free": 32, "pages_total": 32, "breakers_open": 0,
+         "breakers_half_open": 0}
+    d.update(kw)
+    return d
+
+
+def _fake_server(**load_kw):
+    return SimpleNamespace(ready=lambda: True, healthy=lambda: True,
+                           draining=False,
+                           load_digest=lambda: _load(**load_kw))
+
+
+def _rec(role="both", ready=True, healthy=True, draining=False,
+         endpoint="127.0.0.1:1", **load_kw):
+    return {"schema_version": discovery.SCHEMA_VERSION, "role": role,
+            "ready": ready, "healthy": healthy, "draining": draining,
+            "endpoint": endpoint, "load": _load(**load_kw)}
+
+
+# ---------------------------------------------------------------------------
+# discovery
+# ---------------------------------------------------------------------------
+
+def test_registrar_publish_and_replicas():
+    m = _membership()
+    reg = discovery.Registrar(_fake_server(), m, "127.0.0.1:9999",
+                              role="both", replica_id="a").attach()
+    try:
+        recs = discovery.replicas(m.kv, 1)
+        assert set(recs) == {"a"}
+        rec = recs["a"]
+        assert rec["endpoint"] == "127.0.0.1:9999"
+        assert rec["role"] == "both" and rec["ready"]
+        assert rec["age_s"] < 5.0
+        assert rec["schema_version"] == discovery.SCHEMA_VERSION
+        assert rec["load"]["queue_capacity"] == 64
+    finally:
+        reg.close()
+    # close(deregister=True) removes the record
+    assert discovery.replicas(m.kv, 1) == {}
+
+
+def test_replicas_liveness_aging():
+    m = _membership()
+    reg = discovery.Registrar(_fake_server(), m, "h:1",
+                              replica_id="a").attach()
+    try:
+        wall = discovery.replicas(m.kv, 1)["a"]["wall"]
+        # 20s in the future: past the 10s default deadness bound
+        assert discovery.replicas(m.kv, 1, now=wall + 20) == {}
+        # max_age<=0 keeps everything (the diagnose "show me anyway")
+        assert set(discovery.replicas(m.kv, 1, max_age=0,
+                                      now=wall + 20)) == {"a"}
+    finally:
+        reg.close()
+
+
+def test_reserved_and_bad_replica_ids():
+    m = _membership()
+    for bad in ("poison", "draining", "", "a/b"):
+        with pytest.raises(ValueError):
+            discovery.Registrar(_fake_server(), m, "h:1",
+                                replica_id=bad)
+
+
+def test_poison_first_writer_wins():
+    kv = MemKV()
+    assert discovery.publish_poison(kv, 1, "r1", "NaN logits",
+                                    by="router-a")
+    # the race loser must NOT overwrite the original verdict
+    assert not discovery.publish_poison(kv, 1, "r1", "other", by="b")
+    v = discovery.poison_verdict(kv, 1, "r1")
+    assert v["reason"] == "NaN logits" and v["by"] == "router-a"
+    assert discovery.poison_ids(kv, 1) == ["r1"]
+    assert discovery.poison_verdict(kv, 1, "r2") is None
+
+
+def test_draining_flags_roundtrip():
+    kv = MemKV()
+    discovery.set_draining(kv, 1, "a", True)
+    discovery.set_draining(kv, 1, "b", True)
+    assert discovery.draining_ids(kv, 1) == {"a", "b"}
+    discovery.set_draining(kv, 1, "a", False)
+    assert discovery.draining_ids(kv, 1) == {"b"}
+    # reserved names never show up as replicas
+    assert discovery.replicas(kv, 1) == {}
+
+
+def test_latest_generation():
+    kv = MemKV()
+    assert discovery.latest_generation(kv) is None
+    kv.set(discovery.fleet_key(3, "a"), {"wall": time.time()})
+    kv.set(discovery.fleet_key(11, "a"), {"wall": time.time()})
+    assert discovery.latest_generation(kv) == 11
+
+
+def test_registrar_rate_limit_and_force_publish():
+    m = _membership()
+    srv = _fake_server()
+    reg = discovery.Registrar(srv, m, "h:1", replica_id="a",
+                              interval=3600).attach()
+    try:
+        assert reg.maybe_publish()      # starts the interval clock
+        srv.draining = True
+        assert not reg.maybe_publish()  # inside it: no re-publish
+        assert not discovery.replicas(m.kv, 1)["a"]["draining"]
+        reg.publish()         # forced: the new state lands
+        assert discovery.replicas(m.kv, 1)["a"]["draining"]
+    finally:
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# pools
+# ---------------------------------------------------------------------------
+
+def test_pools_classify_and_disaggregated():
+    recs = {"a": _rec(role="both"), "b": _rec(role="prefill"),
+            "c": _rec(role="decode")}
+    assert pools.prefill_pool(recs) == ["a", "b"]
+    assert pools.decode_pool(recs) == ["a", "c"]
+    assert pools.micro_pool(recs) == ["a"]
+    assert pools.disaggregated(recs)
+    assert not pools.disaggregated({"a": _rec(role="both")})
+    assert not pools.disaggregated({"b": _rec(role="prefill")})
+
+
+def test_pool_stats_sums():
+    recs = {"a": _rec(role="both", decode_waiting=2, pages_free=10),
+            "c": _rec(role="decode", decode_waiting=3, pages_free=20)}
+    stats = pools.pool_stats(recs)
+    assert stats["decode"]["replicas"] == 2
+    assert stats["decode"]["decode_waiting"] == 5
+    assert stats["decode"]["pages_free"] == 30
+    assert stats["prefill"]["replicas"] == 1
+    assert stats["prefill"]["decode_waiting"] == 2
+
+
+# ---------------------------------------------------------------------------
+# router scoring (pure)
+# ---------------------------------------------------------------------------
+
+def test_score_age_leads_fill():
+    # a shallow-but-stuck queue loses to a deep-but-moving one
+    stuck = _rec(queue_age_s=5.0, decode_waiting=1)
+    moving = _rec(queue_age_s=0.0, decode_waiting=30)
+    assert Router.score(stuck) > Router.score(moving)
+
+
+def test_p2c_skew_prefers_light_replica():
+    recs = {"light": _rec(), "heavy1": _rec(queue_age_s=4.0,
+                                            decode_waiting=20),
+            "heavy2": _rec(queue_age_s=4.0, decode_waiting=20)}
+    router = Router(kv=MemKV(), generation=1, seed=0)
+    picks = [router.pick(recs, "decode") for _ in range(300)]
+    counts = {r: picks.count(r) for r in recs}
+    # light wins every sample it appears in: 2 of 3 pairs -> ~2/3 of
+    # dispatches; each heavy only wins the heavy-heavy pair
+    assert counts["light"] >= 150, counts
+    assert counts["light"] > counts["heavy1"], counts
+    assert counts["light"] > counts["heavy2"], counts
+    assert counts["heavy1"] + counts["heavy2"] > 0, counts
+
+
+def test_pick_saturation_reject_early():
+    router = Router(kv=MemKV(), generation=1, seed=0)
+    recs = {"a": _rec(decode_waiting=32), "b": _rec(decode_waiting=40)}
+    with pytest.raises(fleet.FleetSaturated):
+        router.pick(recs, "decode")
+    # one unsaturated replica: picked outright, no sampling needed
+    recs["c"] = _rec()
+    assert router.pick(recs, "decode") == "c"
+    # nothing routable at all is None (distinct from saturated)
+    assert router.pick({}, "decode") is None
+    assert router.pick(recs, "decode", exclude=("c", "a", "b")) is None
+
+
+def test_failover_order_breakers_then_score_saturated_last():
+    recs = {
+        "open": _rec(breakers_open=1),
+        "half": _rec(breakers_half_open=1),
+        "slow": _rec(queue_age_s=2.0),
+        "fast": _rec(),
+        "sat": _rec(decode_waiting=32),
+    }
+    router = Router(kv=MemKV(), generation=1, seed=0)
+    order = router.failover_order(recs, "decode")
+    assert order == ["fast", "slow", "half", "open", "sat"]
+    assert router.failover_order(recs, "decode",
+                                 exclude=("fast",))[0] == "slow"
+
+
+def test_routable_filters_role_ready_draining():
+    recs = {"a": _rec(), "down": _rec(ready=False),
+            "sick": _rec(healthy=False), "drain": _rec(draining=True),
+            "pf": _rec(role="prefill")}
+    assert Router.routable(recs, "decode") == ["a"]
+    assert Router.routable(recs, "prefill") == ["a", "pf"]
+    assert Router.routable(recs, "micro") == ["a"]
+
+
+def test_router_refresh_merges_drain_flags():
+    m = _membership()
+    reg = discovery.Registrar(_fake_server(), m, "h:1",
+                              replica_id="a").attach()
+    try:
+        router = Router(kv=m.kv, generation=1, seed=0)
+        assert Router.routable(router.refresh(force=True),
+                               "decode") == ["a"]
+        discovery.set_draining(m.kv, 1, "a", True)
+        recs = router.refresh(force=True)
+        assert recs["a"]["draining"]
+        assert Router.routable(recs, "decode") == []
+    finally:
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# handoff
+# ---------------------------------------------------------------------------
+
+def _runner(max_new_tokens=6, seed=0):
+    mx.random.seed(seed)
+    blk = serve.TinyDecoder(vocab_size=32, num_layers=2, num_heads=2,
+                            head_dim=4)
+    blk.initialize()
+    cfg = serve.DecodeConfig(page_size=4, pool_pages=32, max_live=2,
+                             max_new_tokens=max_new_tokens,
+                             max_context=24, prefill_lengths=(8,),
+                             batch_sizes=(1, 2))
+    return serve.DecodeRunner(blk, config=cfg)
+
+
+def test_handoff_pack_unpack_roundtrip():
+    runner = _runner()
+    sched = serve.DecodeScheduler(runner)
+    try:
+        state = sched.submit_export([1, 2, 3], max_new_tokens=5,
+                                    request_id="h1").result(timeout=60)
+        blob = handoff.pack(state)
+        back = handoff.unpack(blob)
+        assert back["prompt"] == [1, 2, 3]
+        assert back["length"] == state["length"]
+        assert back["first_token"] == state["first_token"]
+        np.testing.assert_array_equal(back["k"], state["k"])
+        np.testing.assert_array_equal(back["v"], state["v"])
+    finally:
+        sched.stop()
+    assert runner.pool.in_use == 0
+    runner.pool.check()
+
+
+def test_handoff_rejects_corruption_truncation_and_bad_magic():
+    runner = _runner()
+    sched = serve.DecodeScheduler(runner)
+    try:
+        state = sched.submit_export([1, 2, 3], max_new_tokens=5,
+                                    request_id="h2").result(timeout=60)
+    finally:
+        sched.stop()
+    blob = handoff.pack(state)
+    with pytest.raises(handoff.HandoffError, match="checksum"):
+        handoff.unpack(blob[:-5] + b"XXXXX")
+    with pytest.raises(handoff.HandoffError):
+        handoff.unpack(blob[:40])
+    with pytest.raises(handoff.HandoffError):
+        handoff.unpack(b"BOGUS\n" + blob[6:])
+    with pytest.raises(handoff.HandoffError):
+        handoff.unpack(b"")
+
+
+def test_handoff_geometry_validation():
+    runner = _runner()
+    sched = serve.DecodeScheduler(runner)
+    try:
+        state = sched.submit_export([1, 2, 3], max_new_tokens=5,
+                                    request_id="h3").result(timeout=60)
+    finally:
+        sched.stop()
+    handoff.validate_geometry(state, runner.page_config)
+    from mxnet_tpu.serve.kvcache import PageConfig
+
+    other = PageConfig(page_size=8, num_pages=32, num_layers=2,
+                       num_kv_heads=2, head_dim=4, max_context=24)
+    with pytest.raises(handoff.HandoffError, match="page_size"):
+        handoff.validate_geometry(state, other)
+    short = dict(state, length=99)
+    with pytest.raises(handoff.HandoffError):
+        handoff.validate_geometry(short, runner.page_config)
+
+
+def test_scheduler_export_import_parity():
+    # the disaggregation contract: prefill on A + decode on B must be
+    # bit-identical to decoding entirely on one replica
+    ra, rb = _runner(), _runner()
+    sa, sb = serve.DecodeScheduler(ra), serve.DecodeScheduler(rb)
+    try:
+        ref = sb.submit([1, 2, 3], max_new_tokens=5,
+                        request_id="ref").result(timeout=60)
+        state = sa.submit_export([1, 2, 3], max_new_tokens=5,
+                                 request_id="x").result(timeout=60)
+        streamed = []
+        out = sb.submit_handoff(
+            handoff.unpack(handoff.pack(state)), request_id="x",
+            on_token=lambda t, i: streamed.append(t)).result(timeout=60)
+        assert out["tokens"] == ref["tokens"]
+        assert streamed == ref["tokens"]
+    finally:
+        sa.stop()
+        sb.stop()
+    for r in (ra, rb):
+        assert r.pool.in_use == 0
+        r.pool.check()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end HTTP fleet
+# ---------------------------------------------------------------------------
+
+def _replica(kv, rid, rank, role="both", step_delay=0.0,
+             max_new_tokens=6):
+    runner = _runner(max_new_tokens=max_new_tokens)
+    if step_delay > 0:
+        orig = runner.decode_step
+
+        def _slow(seqs):
+            time.sleep(step_delay)
+            return orig(seqs)
+
+        runner.decode_step = _slow
+    srv = serve.Server(decode=runner)
+    srv.start_http()
+    srv.register_fleet(_membership(kv=kv, rank=rank), role=role,
+                       replica_id=rid)
+    return srv
+
+
+def _router(kv, **kw):
+    kw.setdefault("refresh_s", 0.0)
+    kw.setdefault("retry_after_s", 1.0)
+    return Router(kv=kv, generation=1, seed=0,
+                  config=RouterConfig(**kw))
+
+
+def test_router_e2e_stream_collect_and_local_parity():
+    kv = MemKV()
+    a, b = _replica(kv, "a", 0), _replica(kv, "b", 1)
+    try:
+        ref = a.submit_decode([1, 2, 3],
+                              max_new_tokens=5).result(timeout=60)
+        router = _router(kv)
+        events = []
+        done = router.run_decode({"tokens": [1, 2, 3],
+                                  "max_new_tokens": 5},
+                                 request_id="r1", emit=events.append)
+        assert "done" in done
+        toks = [ev["token"] for ev in events if "token" in ev]
+        assert toks == ref["tokens"]
+        assert [ev["index"] for ev in events if "token" in ev] \
+            == list(range(len(toks)))
+        collected = router.run_decode({"tokens": [1, 2, 3],
+                                       "max_new_tokens": 5},
+                                      request_id="r2")
+        assert collected["tokens"] == ref["tokens"]
+        assert router.requests.get("ok") == 2
+        router.shutdown()
+    finally:
+        a.shutdown(drain=False)
+        b.shutdown(drain=False)
+
+
+def test_router_failover_dead_replica_zero_drop():
+    kv = MemKV()
+    # tie-break picks the lexicographically smaller id -> "a" is the
+    # guaranteed first target; kill its listener but leave its record
+    a, b = _replica(kv, "a", 0), _replica(kv, "b", 1)
+    try:
+        ref = b.submit_decode([1, 2, 3],
+                              max_new_tokens=5).result(timeout=60)
+        a._httpd.shutdown()
+        a._httpd.server_close()
+        router = _router(kv)
+        events = []
+        done = router.run_decode({"tokens": [1, 2, 3],
+                                  "max_new_tokens": 5},
+                                 request_id="r1", emit=events.append)
+        assert "done" in done, done
+        toks = [ev["token"] for ev in events if "token" in ev]
+        assert toks == ref["tokens"]
+        assert router.failovers >= 1
+        assert telemetry.value("fleet_failover_total") >= 1
+        router.shutdown()
+    finally:
+        a.shutdown(drain=False)
+        b.shutdown(drain=False)
+
+
+def test_router_midstream_kill_byte_identical():
+    kv = MemKV()
+    a = _replica(kv, "a", 0, step_delay=0.1, max_new_tokens=8)
+    b = _replica(kv, "b", 1, step_delay=0.1, max_new_tokens=8)
+    try:
+        ref = b.submit_decode([1, 2, 3],
+                              max_new_tokens=8).result(timeout=120)
+        router = _router(kv)
+        events = []
+        result = {}
+
+        def client():
+            result["done"] = router.run_decode(
+                {"tokens": [1, 2, 3], "max_new_tokens": 8},
+                request_id="kill", emit=events.append)
+
+        t = threading.Thread(target=client)
+        t.start()
+        # wait for tokens to flow, then kill the serving replica
+        # mid-stream (tie-break pins the first target to "a");
+        # drain=False is the ungraceful path — the live stream's
+        # socket dies under the router
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if sum(1 for ev in list(events) if "token" in ev) >= 2:
+                break
+            time.sleep(0.01)
+        a.shutdown(drain=False)
+        t.join(timeout=120)
+        assert not t.is_alive()
+        assert "done" in result["done"], result
+        toks = [ev["token"] for ev in events if "token" in ev]
+        assert toks == ref["tokens"], (toks, ref["tokens"])
+        assert router.failovers >= 1
+        router.shutdown()
+    finally:
+        a.shutdown(drain=False)
+        b.shutdown(drain=False)
+
+
+def test_router_disaggregated_two_hop():
+    kv = MemKV()
+    p = _replica(kv, "p", 0, role="prefill")
+    d = _replica(kv, "d", 1, role="decode")
+    try:
+        ref = d.submit_decode([1, 2, 3],
+                              max_new_tokens=5).result(timeout=60)
+        router = _router(kv)
+        events = []
+        done = router.run_decode({"tokens": [1, 2, 3],
+                                  "max_new_tokens": 5},
+                                 request_id="dg", emit=events.append)
+        assert "done" in done, done
+        toks = [ev["token"] for ev in events if "token" in ev]
+        assert toks == ref["tokens"]
+        assert router.handoffs == 1
+        assert telemetry.value("fleet_handoff_total",
+                               labels={"result": "ok"}) >= 2
+        router.shutdown()
+    finally:
+        p.shutdown(drain=False)
+        d.shutdown(drain=False)
+
+
+def test_router_poison_stops_retries():
+    kv = MemKV()
+    a, b = _replica(kv, "a", 0), _replica(kv, "b", 1)
+    try:
+        router = _router(kv)
+        # vocab is 32: an out-of-range prompt token is a deterministic
+        # upstream 400 on EVERY replica — retrying cannot help, so the
+        # router must condemn, not burn the fleet down
+        bad = {"tokens": [1, 2, 999], "max_new_tokens": 5}
+        ev = router.run_decode(bad, request_id="cursed")
+        assert "error" in ev, ev
+        assert router.failovers == 0
+        verdict = discovery.poison_verdict(kv, 1, "cursed")
+        assert verdict is not None
+        # the verdict is fleet-wide: a retry (any router) fails fast
+        # without touching a replica
+        ev2 = router.run_decode(bad, request_id="cursed")
+        assert ev2.get("type") == "PoisonedRequest", ev2
+        assert router.requests.get("poisoned") == 2
+        router.shutdown()
+    finally:
+        a.shutdown(drain=False)
+        b.shutdown(drain=False)
+
+
+def test_router_saturation_rejects_with_retry_after():
+    router = Router(kv=MemKV(), generation=1, seed=0,
+                    config=RouterConfig(refresh_s=0.0,
+                                        retry_after_s=7.0))
+    m = _membership(kv=router.kv)
+    reg = discovery.Registrar(_fake_server(decode_waiting=32), m,
+                              "h:1", replica_id="a").attach()
+    try:
+        ev = router.run_decode({"tokens": [1], "max_new_tokens": 2},
+                               request_id="r")
+        assert ev["type"] == "FleetSaturated"
+        assert ev["retry_after"] == 7.0
+        assert router.requests.get("rejected") == 1
+    finally:
+        reg.close()
+
+
+def test_router_http_surface_and_statz_schema():
+    kv = MemKV()
+    a = _replica(kv, "a", 0)
+    try:
+        router = _router(kv)
+        host, port = router.start_http()
+        base = "http://%s:%d" % (host, port)
+        with urllib.request.urlopen(base + "/healthz",
+                                    timeout=10) as r:
+            assert r.status == 200
+        with urllib.request.urlopen(base + "/statz", timeout=10) as r:
+            doc = json.load(r)
+        assert doc["schema_version"] == 1
+        assert set(doc["replicas"]) == {"a"}
+        assert doc["pools"]["decode"]["replicas"] == 1
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"tokens": [1, 2, 3],
+                             "max_new_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "http-1"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.load(r)
+        assert len(out["tokens"]) == 4
+        # streaming: chunked NDJSON, one terminal done event
+        sreq = urllib.request.Request(
+            base + "/predict?stream=1",
+            data=json.dumps({"tokens": [1, 2, 3],
+                             "max_new_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(sreq, timeout=60) as r:
+            lines = [json.loads(ln) for ln in r.read().splitlines()
+                     if ln.strip()]
+        assert [ev["token"] for ev in lines if "token" in ev] \
+            == out["tokens"]
+        assert "done" in lines[-1]
+        router.shutdown()
+    finally:
+        a.shutdown(drain=False)
+
+
+def test_rollout_drains_one_at_a_time():
+    kv = MemKV()
+    a, b = _replica(kv, "a", 0), _replica(kv, "b", 1)
+    servers = {"a": a, "b": b}
+    seen = []
+    try:
+        router = _router(kv)
+
+        def drain(rid):
+            # while rid drains, the router must still have somewhere
+            # to route — and must not route to rid
+            recs = router.refresh(force=True)
+            assert recs[rid]["draining"]
+            assert rid not in Router.routable(recs, "decode")
+            assert len(Router.routable(recs, "decode")) == 1
+            ev = router.run_decode({"tokens": [1, 2, 3],
+                                    "max_new_tokens": 3},
+                                   request_id="roll-%s" % rid)
+            assert "done" in ev, ev
+            servers[rid].set_draining(True)
+            servers[rid].set_draining(False)
+            seen.append(rid)
+
+        rolled = fleet.rollout(["a", "b"], kv, 1, drain, timeout=30.0)
+        assert rolled == seen == ["a", "b"]
+        assert discovery.draining_ids(kv, 1) == set()
+        assert router.requests.get("rejected", 0) == 0
+        router.shutdown()
+    finally:
+        a.shutdown(drain=False)
+        b.shutdown(drain=False)
+
+
+def test_kv_doc_shape_without_router():
+    kv = MemKV()
+    m = _membership(kv=kv)
+    reg = discovery.Registrar(_fake_server(), m, "h:1",
+                              replica_id="a").attach()
+    try:
+        discovery.publish_poison(kv, 1, "r9", "bad")
+        doc = fleet.kv_doc(kv)
+        assert doc["generation"] == 1
+        assert set(doc["replicas"]) == {"a"}
+        assert doc["poison"] == ["r9"]
+        assert not doc["disaggregated"]
+    finally:
+        reg.close()
+    assert fleet.kv_doc(MemKV())["generation"] is None
+
+
+# ---------------------------------------------------------------------------
+# tools/diagnose.py --fleet-router golden
+# ---------------------------------------------------------------------------
+
+def _diag_doc():
+    return {
+        "generation": 4, "disaggregated": True,
+        "replicas": {
+            "a": {"role": "prefill", "ready": True, "draining": False,
+                  "age_s": 0.5, "endpoint": "127.0.0.1:9001",
+                  "load": _load(queue_age_s=0.01, decode_waiting=2,
+                                pages_free=20)},
+            "b": {"role": "decode", "ready": False, "draining": True,
+                  "age_s": 1.25, "endpoint": "127.0.0.1:9002",
+                  "load": _load(breakers_open=1)},
+        },
+        "pools": {"prefill": {"replicas": 1, "decode_waiting": 2,
+                              "decode_live": 0, "pages_free": 20,
+                              "pages_total": 32},
+                  "decode": {"replicas": 1, "decode_waiting": 0,
+                             "decode_live": 0, "pages_free": 32,
+                             "pages_total": 32}},
+        "requests": {"ok": 7, "rejected": 1},
+        "failovers": 2, "handoffs": 3, "inflight": 1,
+        "draining": ["b"], "poison": ["r1"],
+    }
+
+
+def test_diagnose_fleet_router_lines_golden():
+    import diagnose
+
+    assert diagnose._fleet_router_lines(_diag_doc()) == [
+        "generation   : 4",
+        "disaggregated: True",
+        "replica    role     ready  drain  age_s   q_age_s  waiting  "
+        "pages     breaker endpoint",
+        "a          prefill  yes    -      0.5     0.01     2        "
+        "20/32     closed  127.0.0.1:9001",
+        "b          decode   NO     YES    1.25    0.0      0        "
+        "32/32     open    127.0.0.1:9002",
+        "pool prefill : replicas=1 waiting=2 live=0 pages=20/32",
+        "pool decode  : replicas=1 waiting=0 live=0 pages=32/32",
+        "requests     : ok=7, rejected=1",
+        "failovers    : 2   handoffs: 3   inflight: 1",
+        "draining     : b",
+        "poison       : r1",
+    ]
+
+
+def test_diagnose_fleet_router_lines_empty():
+    import diagnose
+
+    lines = diagnose._fleet_router_lines(
+        {"generation": None, "replicas": {}, "pools": {},
+         "requests": {}, "failovers": 0, "handoffs": 0,
+         "inflight": 0, "draining": [], "poison": []})
+    assert lines[0] == "generation   : None"
+    assert "(no live replicas)" in lines
+    assert "requests     : (none)" in lines
+    assert "poison       : (none)" in lines

@@ -427,3 +427,28 @@ def test_http_predict_health_ready_statz_metrics(tmp_path):
         with pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(bad, timeout=10)
         assert ei.value.code == 400
+
+
+def test_statz_schema_version_and_locked_key_set(tmp_path):
+    # /statz is the stable schema external parsers key on (the fleet
+    # router's load digest source, scrapers, diagnose): its TOP-LEVEL
+    # key set is locked against schema_version 1.  Adding a key means
+    # extending this set AND bumping SERVE_STATZ_SCHEMA_VERSION.
+    from mxnet_tpu.serve.server import SERVE_STATZ_SCHEMA_VERSION
+
+    make, blk, root = _checkpointed_model(tmp_path)
+    with _server(make, root) as srv:
+        doc = srv.stats()
+        assert SERVE_STATZ_SCHEMA_VERSION == 1
+        assert doc["schema_version"] == SERVE_STATZ_SCHEMA_VERSION
+        assert set(doc) == {
+            "schema_version", "ready", "healthy", "draining",
+            "queue_depth", "queue_age_s", "config", "runner",
+            "decode", "requests", "totals", "breakers", "health",
+            "slo",
+        }
+        # the HTTP face serves the same document shape
+        host, port = srv.start_http()
+        _, http_doc = _get("http://%s:%d/statz" % (host, port))
+        assert set(http_doc) == set(doc)
+        assert http_doc["schema_version"] == SERVE_STATZ_SCHEMA_VERSION

@@ -758,3 +758,54 @@ def test_decode_telemetry_families_in_prometheus_export():
         assert "# TYPE %s" % fam in prom, fam
     assert telemetry.value("serve_decode_tokens_total") == 4
     assert telemetry.value("serve_decode_prefills_total") == 1
+
+
+def test_shutdown_drain_finishes_inflight_stream():
+    # regression: shutdown(drain=True) used to close the HTTP listener
+    # before the daemon stream threads finished writing, so a client
+    # mid-stream saw its socket die with tokens still owed.  Drain must
+    # hold the listener open until every in-flight stream has written
+    # its terminal event.
+    runner = serve.DecodeRunner(_decoder(),
+                                config=_config(max_new_tokens=8,
+                                               max_context=24))
+    slow = runner.decode_step
+
+    def _slow(seqs):
+        time.sleep(0.1)
+        return slow(seqs)
+
+    runner.decode_step = _slow
+    srv = serve.Server(decode=runner)
+    ref = srv.submit_decode([1, 2, 3], max_new_tokens=8).result(60)
+    host, port = srv.start_http()
+    got = {}
+
+    def client():
+        req = urllib.request.Request(
+            "http://%s:%d/predict?stream=1" % (host, port),
+            data=json.dumps({"tokens": [1, 2, 3],
+                             "max_new_tokens": 8}).encode())
+        with urllib.request.urlopen(req, timeout=60) as r:
+            got["events"] = [json.loads(line)
+                             for line in r.read().splitlines()]
+
+    t = threading.Thread(target=client)
+    t.start()
+    # wait until the stream is genuinely in flight, then drain
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not srv._streams:
+        time.sleep(0.01)
+    assert srv._streams, "stream never started"
+    t0 = time.monotonic()
+    srv.shutdown(drain=True)
+    t.join(timeout=60)
+    assert not t.is_alive(), "client still blocked after drain"
+    events = got.get("events")
+    assert events, "client saw no events (socket closed under it)"
+    tokens = [e["token"] for e in events if "token" in e]
+    assert events[-1].get("done"), events[-1]
+    assert tokens == ref["tokens"], (tokens, ref["tokens"])
+    # and the drain actually waited for the stream, not just raced it
+    assert srv._streams == 0
+    assert time.monotonic() - t0 >= 0.0

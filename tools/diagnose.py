@@ -230,6 +230,91 @@ def fleet_info(src="live"):
         print(line)
 
 
+def _fleet_router_lines(doc):
+    """The --fleet-router section lines for a router-``/statz``-shaped
+    doc (pure — golden tests feed a synthetic doc and compare output
+    verbatim)."""
+    lines = ["generation   : %s" % doc.get("generation"),
+             "disaggregated: %s" % bool(doc.get("disaggregated"))]
+    reps = doc.get("replicas") or {}
+    if reps:
+        lines.append("%-10s %-8s %-6s %-6s %-7s %-8s %-8s %-9s %-7s %s"
+                     % ("replica", "role", "ready", "drain", "age_s",
+                        "q_age_s", "waiting", "pages", "breaker",
+                        "endpoint"))
+        for rid in sorted(reps):
+            r = reps[rid]
+            load = r.get("load") or {}
+            br_open = int(load.get("breakers_open") or 0)
+            br_half = int(load.get("breakers_half_open") or 0)
+            breaker = "open" if br_open else (
+                "half" if br_half else "closed")
+            lines.append(
+                "%-10s %-8s %-6s %-6s %-7s %-8s %-8s %-9s %-7s %s"
+                % (rid, r.get("role"),
+                   "yes" if r.get("ready") else "NO",
+                   "YES" if r.get("draining") else "-",
+                   r.get("age_s"),
+                   load.get("queue_age_s"),
+                   load.get("decode_waiting"),
+                   "%s/%s" % (load.get("pages_free"),
+                              load.get("pages_total")),
+                   breaker, r.get("endpoint")))
+    else:
+        lines.append("(no live replicas)")
+    for pool in ("prefill", "decode"):
+        p = (doc.get("pools") or {}).get(pool) or {}
+        lines.append("pool %-8s: replicas=%s waiting=%s live=%s "
+                     "pages=%s/%s"
+                     % (pool, p.get("replicas"),
+                        p.get("decode_waiting"), p.get("decode_live"),
+                        p.get("pages_free"), p.get("pages_total")))
+    req = doc.get("requests") or {}
+    lines.append("requests     : %s"
+                 % (", ".join("%s=%s" % (k, req[k])
+                              for k in sorted(req)) or "(none)"))
+    lines.append("failovers    : %s   handoffs: %s   inflight: %s"
+                 % (doc.get("failovers"), doc.get("handoffs"),
+                    doc.get("inflight")))
+    draining = doc.get("draining") or []
+    lines.append("draining     : %s"
+                 % (", ".join(str(r) for r in draining)
+                    if draining else "(none)"))
+    poison = doc.get("poison") or []
+    lines.append("poison       : %s"
+                 % (", ".join(str(p) for p in poison)
+                    if poison else "(none)"))
+    return lines
+
+
+def fleet_router_info(src):
+    """mx.fleet router view: the live replica table (role / load /
+    breaker / drain), per-pool depth, request + failover + handoff
+    counters, poison verdicts.  ``src`` is a router URL
+    (http://host:port — reads its /statz), a KV root directory (the
+    discovery records are rendered straight from the KV, no router
+    process needed), or a saved router-/statz/ JSON file."""
+    section("Fleet router (mx.fleet)")
+    import json
+
+    if src.startswith("http://") or src.startswith("https://"):
+        import urllib.request
+
+        with urllib.request.urlopen(src.rstrip("/") + "/statz",
+                                    timeout=10) as resp:
+            doc = json.loads(resp.read())
+    elif os.path.isdir(src):
+        from mxnet_tpu.dist.membership import FileKV
+        from mxnet_tpu.fleet import kv_doc
+
+        doc = kv_doc(FileKV(src))
+    else:
+        with open(src) as f:
+            doc = json.load(f)
+    for line in _fleet_router_lines(doc):
+        print(line)
+
+
 def trace_info():
     """Dump the mx.trace plane: flag, ring occupancy, watchdog state,
     dump destinations, and the dumps this process has written."""
@@ -1086,13 +1171,20 @@ def main():
                          "attached membership or a local-only world; "
                          "the default), or from a saved /fleetz JSON "
                          "document")
+    ap.add_argument("--fleet-router", metavar="SRC",
+                    help="mx.fleet router view: live replica table "
+                         "(role, load, breaker, drain), per-pool "
+                         "depth, request/failover/handoff counters, "
+                         "poison verdicts — SRC is a router URL "
+                         "(reads its /statz), a membership KV root "
+                         "directory, or a saved /statz JSON document")
     args = ap.parse_args()
     # section flags compose: --compile-cache --serve URL prints both
     # (each skips the environment dump, all honor --telemetry)
     if args.compile_cache or args.serve or args.checkpoints or \
             args.trainer or args.step or args.trace or args.monitor or \
             args.resilience or args.autotune or args.data or \
-            args.dist is not None or args.fleet:
+            args.dist is not None or args.fleet or args.fleet_router:
         if args.compile_cache:
             compile_cache_info()
         if args.autotune:
@@ -1105,6 +1197,8 @@ def main():
             dist_info(args.dist or None)
         if args.fleet:
             fleet_info(args.fleet)
+        if args.fleet_router:
+            fleet_router_info(args.fleet_router)
         if args.trainer:
             trainer_info()
         if args.step:

@@ -1,0 +1,293 @@
+#!/usr/bin/env python
+"""mx.fleet smoke (make fleet-smoke, CPU).
+
+Three stages, each asserting an ISSUE-17 acceptance contract:
+
+1. **Disaggregated handoff round-trip (in-process)** — a dedicated
+   prefill replica and a dedicated decode replica (same seed-0
+   TinyDecoder weights) behind one Router: the stream crosses the
+   /fleet/handoff wire (prefill exports its KV pages as a checksummed
+   blob, decode re-runs admission reservation math before installing
+   them) and must be byte-identical to the decode replica's own local
+   generation.  A corrupted blob must be REJECTED by checksum, and
+   both page pools must end the stage empty and scrub-clean.
+
+2. **Rolling hot-swap, zero rejects** — 3 live replicas under
+   ``tools/launch.py --rendezvous none``; ``fleet.rollout()`` drains
+   each one in turn (KV drain flag -> /drainz -> ready again) while a
+   client hammers the router: every request must succeed — zero
+   rejects, zero errors.
+
+3. **SIGKILL mid-stream, zero drop** — a streaming request is pinned
+   mid-generation (per-step decode delay), the replica serving it is
+   SIGKILLed, and the CLIENT-visible stream must still complete
+   byte-identical to the pre-kill reference: the router re-prefills on
+   a survivor and splices at the emitted-token cursor.
+
+The launcher reaps the whole world when the victim dies, so stage 3
+doubles as the drain drill: survivors finish the failed-over stream
+under the launcher's forwarded SIGTERM before exiting 0.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from types import SimpleNamespace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# records published once at registration must not age out mid-stage;
+# liveness in this smoke comes from connection failure, not record age
+os.environ["MXNET_FLEET_DEAD_AFTER_SECONDS"] = "120"
+os.environ["MXNET_FLEET_REFRESH_SECONDS"] = "0.05"
+
+LAUNCH = os.path.join(REPO, "tools", "launch.py")
+WORKER = os.path.join(REPO, "tests", "nightly", "fleet_drill.py")
+PROMPT = [1, 2, 3]
+
+
+def banner(msg):
+    print("\n=== %s ===" % msg, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# stage 1: disaggregated handoff round-trip (in-process)
+# ---------------------------------------------------------------------------
+
+def stage_handoff():
+    banner("stage 1: disaggregated prefill/decode handoff")
+    import mxnet_tpu as mx
+    from mxnet_tpu import fleet
+    from mxnet_tpu.dist.membership import MemKV
+
+    sys.path.insert(0, os.path.join(REPO, "tests", "nightly"))
+    from fleet_drill import build_runner
+
+    kv = MemKV()
+
+    def replica(role, rid, rank):
+        runner = build_runner()
+        srv = mx.serve.Server(decode=runner)
+        srv.start_http()
+        srv.register_fleet(
+            SimpleNamespace(kv=kv, generation=1, rank=rank),
+            role=role, replica_id=rid)
+        return runner, srv
+
+    run_p, srv_p = replica("prefill", "p0", 0)
+    run_d, srv_d = replica("decode", "d0", 1)
+    try:
+        ref = srv_d.submit_decode(PROMPT, max_new_tokens=5).result()
+        assert ref["finish_reason"] in ("length", "eos"), ref
+
+        router = fleet.Router(kv=kv, generation=1, seed=0)
+        events = []
+        done = router.run_decode(
+            {"tokens": PROMPT, "max_new_tokens": 5},
+            request_id="smoke-handoff", emit=events.append)
+        toks = [ev["token"] for ev in events if "token" in ev]
+        assert "done" in done, done
+        assert toks == ref["tokens"], (toks, ref["tokens"])
+        assert router.handoffs == 1, router.handoffs
+        print("two-hop stream == local decode: %s" % toks)
+
+        # checksum guard: flip the blob's tail, unpack must refuse
+        state = srv_p.submit_decode_export(
+            PROMPT, max_new_tokens=5).result()
+        blob = fleet.pack(state)
+        try:
+            fleet.unpack(blob[:-5] + b"XXXXX")
+        except fleet.HandoffError as exc:
+            print("corrupt blob rejected: %s" % exc)
+        else:
+            raise AssertionError("corrupted handoff blob accepted")
+        # the reservation math must have returned every page, and the
+        # scrub guard means no page carries stale rows past the cursor
+        for name, runner in (("prefill", run_p), ("decode", run_d)):
+            assert runner.pool.in_use == 0, (name, runner.pool.in_use)
+            runner.pool.check()
+        print("pools empty + scrub-clean after handoff round-trip")
+        router.shutdown()
+    finally:
+        srv_p.shutdown(drain=False)
+        srv_d.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# stages 2+3: a real 3-replica world under launch.py
+# ---------------------------------------------------------------------------
+
+def _wait_fleet(kv, n, timeout=90.0):
+    from mxnet_tpu import fleet
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        gen = fleet.latest_generation(kv)
+        if gen is not None:
+            recs = fleet.replicas(kv, gen)
+            if len(recs) >= n and all(
+                    r.get("ready") for r in recs.values()):
+                return gen, recs
+        time.sleep(0.2)
+    raise AssertionError("fleet never reached %d ready replicas" % n)
+
+
+def _drainz(endpoint, flag):
+    import urllib.request
+
+    req = urllib.request.Request(
+        "http://%s/drainz" % endpoint,
+        data=json.dumps({"draining": flag}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def stage_world():
+    from mxnet_tpu import fleet
+    from mxnet_tpu.dist.membership import FileKV
+
+    member_dir = tempfile.mkdtemp(prefix="mxfleet-")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "MXNET_DIST_HEARTBEAT_SECONDS": "0.5",
+        "MXNET_FLEET_PUBLISH_SECONDS": "0.25",
+        # pin streams mid-generation so the SIGKILL lands mid-stream
+        "MXNET_FLEET_DRILL_STEP_DELAY": "0.15",
+    })
+    proc = subprocess.Popen(
+        [sys.executable, LAUNCH, "-n", "3", "--backend", "cpu",
+         "--rendezvous", "none", "--term-grace", "60",
+         "--member-dir", member_dir,
+         sys.executable, WORKER, "serve"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        kv = FileKV(member_dir)
+        gen, recs = _wait_fleet(kv, 3)
+        print("fleet up: gen=%d replicas=%s" % (gen, sorted(recs)))
+
+        router = fleet.Router(kv=kv, generation=gen, seed=0)
+        payload = {"tokens": PROMPT, "max_new_tokens": 8}
+
+        # reference stream (healthy fleet) — the byte-identity anchor
+        ref_events = []
+        done = router.run_decode(payload, request_id="smoke-ref",
+                                 emit=ref_events.append)
+        ref_tokens = [ev["token"] for ev in ref_events if "token" in ev]
+        assert "done" in done and len(ref_tokens) == 8, (done,
+                                                         ref_tokens)
+        print("reference stream: %s" % ref_tokens)
+
+        # ---- stage 2: rolling hot-swap, zero rejects ------------------
+        banner("stage 2: rolling hot-swap under load")
+        stop = threading.Event()
+        tally = {"ok": 0, "bad": []}
+
+        def hammer():
+            i = 0
+            while not stop.is_set():
+                ev = router.run_decode(payload,
+                                       request_id="swap-%d" % i)
+                i += 1
+                if "done" in ev:
+                    tally["ok"] += 1
+                else:
+                    tally["bad"].append(ev)
+
+        client = threading.Thread(target=hammer, daemon=True)
+        client.start()
+
+        def drain(rid):
+            endpoint = router.refresh(force=True)[rid]["endpoint"]
+            _drainz(endpoint, True)
+            time.sleep(0.5)          # the simulated in-place swap
+            _drainz(endpoint, False)
+
+        rolled = fleet.rollout(sorted(recs), kv, gen, drain,
+                               timeout=30.0)
+        # keep the load going briefly past the last drain: the rolled
+        # replicas must be taking traffic again, not just flagged ready
+        settle = time.monotonic() + 30
+        while tally["ok"] + len(tally["bad"]) < 4 and \
+                time.monotonic() < settle:
+            time.sleep(0.1)
+        stop.set()
+        client.join(timeout=60)
+        assert rolled == sorted(recs), rolled
+        assert not tally["bad"], tally["bad"]
+        assert tally["ok"] >= 3, tally
+        assert router.requests.get("rejected", 0) == 0, router.requests
+        print("rolled %s with %d requests, 0 rejects"
+              % (rolled, tally["ok"]))
+
+        # ---- stage 3: SIGKILL mid-stream, zero drop -------------------
+        banner("stage 3: SIGKILL mid-stream")
+        events = []
+        result = {}
+
+        def streamer():
+            result["done"] = router.run_decode(
+                payload, request_id="smoke-kill", emit=events.append)
+
+        t = threading.Thread(target=streamer, daemon=True)
+        t.start()
+        victim = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            inflight = router.stats()["inflight_by_replica"]
+            ntok = sum(1 for ev in list(events) if "token" in ev)
+            if inflight and 2 <= ntok < 6:
+                victim = next(iter(inflight))
+                break
+            time.sleep(0.01)
+        assert victim is not None, "stream never went inflight"
+        pid = router.refresh(force=True)[victim]["pid"]
+        os.kill(int(pid), signal.SIGKILL)
+        print("SIGKILLed replica %s (pid %d) mid-stream"
+              % (victim, pid))
+
+        t.join(timeout=120)
+        assert not t.is_alive(), "stream never completed after kill"
+        toks = [ev["token"] for ev in events if "token" in ev]
+        assert "done" in result.get("done", {}), result
+        assert toks == ref_tokens, (toks, ref_tokens)
+        assert router.failovers >= 1, router.failovers
+        print("failover stream byte-identical after %d failover(s): %s"
+              % (router.failovers, toks))
+        router.shutdown()
+    finally:
+        # tell survivors the drill is over; the launcher reaps the rest
+        with open(os.path.join(member_dir, "stop"), "w") as f:
+            f.write("done")
+        try:
+            out = proc.communicate(timeout=120)[0]
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out = proc.communicate()[0]
+    finals = out.count("FINAL OK")
+    assert finals >= 2, "want >=2 surviving FINAL OK, got %d:\n%s" % (
+        finals, out[-3000:])
+    print("survivors drained cleanly: %d/3 FINAL OK" % finals)
+
+
+def main():
+    t0 = time.monotonic()
+    stage_handoff()
+    stage_world()
+    print("\nfleet-smoke OK in %.1fs" % (time.monotonic() - t0))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
