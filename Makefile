@@ -13,7 +13,7 @@ SO := build/libmxtpu_native.so
 	decode-smoke compile-cache-smoke trainer-smoke step-smoke \
 	trace-smoke monitor-smoke faults-smoke dist-faults-smoke \
 	zero-smoke autotune-smoke data-smoke obs-smoke fleet-smoke \
-	smoke-all clean
+	cache-smoke smoke-all clean
 
 native: $(SO)
 
@@ -209,6 +209,20 @@ fleet-smoke:
 	JAX_PLATFORMS=cpu python -m pytest \
 	  tests/python/unittest/test_fleet.py -q -m 'not slow'
 
+# mx.serve.cache smoke: per-token-cost plane — cached-prefix decode
+# bit-identical to cold and speculative decode bit-identical to
+# single-step with ZERO compiles as sessions churn; serve_cache /
+# spec_verify drills degrade one sequence alone; then a 2-replica CPU
+# world shares one 2k-token system prompt that prefills exactly ONCE
+# fleet-wide (router prefix affinity, telemetry-asserted), the hot
+# replica is SIGKILLed mid-stream and the survivor repopulates its own
+# cache with a byte-identical client stream; then the subsystem's
+# pytest suite
+cache-smoke:
+	JAX_PLATFORMS=cpu python tools/cache_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/python/unittest/test_serve_cache.py -q -m 'not slow'
+
 # every subsystem smoke in sequence — the one-command pre-flight before
 # a tunnel window.  Ordered CHEAP-FIRST (approx wall time on the CPU
 # container in the comment column) so a broken build fails in seconds,
@@ -229,6 +243,7 @@ SMOKES := \
 	obs-smoke \
 	zero-smoke \
 	decode-smoke \
+	cache-smoke \
 	faults-smoke \
 	data-smoke \
 	fleet-smoke \
@@ -236,8 +251,8 @@ SMOKES := \
 # approx wall time:        telemetry ~15s, trace ~25s, compile-cache
 # ~35s, trainer ~35s, monitor ~40s, checkpoint ~45s, step ~45s,
 # autotune ~50s, serve ~60s, obs ~75s, zero ~90s, decode ~100s,
-# faults ~2min, data ~3min, fleet ~3min, dist-faults ~4min
-# (multi-process drills last; total ~18min cold)
+# cache ~2min, faults ~2min, data ~3min, fleet ~3min, dist-faults
+# ~4min (multi-process drills last; total ~20min cold)
 smoke-all:
 	@set -e; for t in $(SMOKES); do \
 	  echo "== $$t =="; \

@@ -549,6 +549,104 @@ def _bench_serve_decode(clients=24, max_new=32):
     }
 
 
+def _bench_serve_cache(sessions=8, max_new=16):
+    """mx.serve.cache row: the per-token-cost plane.  N sessions share
+    one 2000-token system prompt (each with its own user suffix): the
+    first prefills cold, every later one rides the radix prefix cache
+    and charges only its suffix — the row reports the prefill-token
+    reduction, measured TTFT cold vs hit, and that session churn adds
+    ZERO compiles.  A second phase prices speculative decoding:
+    accepted-tokens-per-target-step with a perfect (same-weights)
+    draft — the structural upper bound K+1 — vs single-step decode."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serve, telemetry
+
+    mx.random.seed(0)
+    blk = serve.TinyDecoder(vocab_size=256, num_layers=4, num_heads=4,
+                            head_dim=16)
+    blk.initialize()
+    cfg = serve.DecodeConfig(page_size=16, pool_pages=384, max_live=2,
+                             max_new_tokens=max_new, max_context=2112,
+                             prefill_lengths=(64, 2048),
+                             batch_sizes=(1, 2), prefix_cache=True)
+    runner = serve.DecodeRunner(blk, config=cfg)
+    sched = serve.DecodeScheduler(runner)
+    rs = np.random.RandomState(0)
+    system = rs.randint(0, 256, size=2000).tolist()
+    compiles0 = telemetry.value("serve_decode_compile_total")
+    ttfts = []
+    try:
+        for i in range(sessions):
+            user = rs.randint(0, 256, size=32).tolist()
+            t0 = time.perf_counter()
+            first = []
+            fut = sched.submit(
+                system + user, max_new_tokens=max_new,
+                request_id="cache-bench-%d" % i,
+                on_token=lambda tok, idx, t=t0: first.append(
+                    time.perf_counter() - t) if not first else None)
+            fut.result(timeout=600)
+            ttfts.append(first[0])
+    finally:
+        sched.stop()
+    cache = runner.cache.stats()
+    compile_delta = telemetry.value("serve_decode_compile_total") \
+        - compiles0
+    hit_ttft = sum(ttfts[1:]) / max(1, len(ttfts) - 1)
+
+    # speculative decoding: perfect-draft acceptance upper bound
+    mx.random.seed(0)
+    blk2 = serve.TinyDecoder(vocab_size=256, num_layers=4, num_heads=4,
+                             head_dim=16)
+    blk2.initialize()
+    scfg = serve.DecodeConfig(page_size=16, pool_pages=64, max_live=2,
+                              max_new_tokens=max_new, max_context=128,
+                              prefill_lengths=(64,), batch_sizes=(1, 2))
+    prompt = rs.randint(0, 256, size=24).tolist()
+
+    def timed(r):
+        s = serve.DecodeScheduler(r)
+        try:
+            t0 = time.perf_counter()
+            toks = s.submit(list(prompt), max_new_tokens=max_new) \
+                .result(timeout=600)["tokens"]
+            return toks, time.perf_counter() - t0
+        finally:
+            s.stop()
+
+    single = serve.DecodeRunner(blk2, config=scfg)
+    ref, dt_single = timed(single)
+    spec = serve.DecodeRunner(blk2, config=scfg, draft=blk2)
+    out, dt_spec = timed(spec)
+    assert out == ref, "speculative decode diverged from single-step"
+    sp = spec.spec.stats()
+    return {
+        "sessions": sessions,
+        "system_tokens": len(system),
+        "prefill_tokens_cold": len(system) + 32,
+        "prefill_tokens_hit": 32,
+        "prefill_token_reduction_x": round((len(system) + 32) / 32.0,
+                                           1),
+        "ttft_cold_ms": round(1e3 * ttfts[0], 1),
+        "ttft_hit_ms": round(1e3 * hit_ttft, 1),
+        "ttft_speedup_x": round(ttfts[0] / hit_ttft, 1),
+        # warm sessions match the 125 shared system blocks but not
+        # their own final (user-suffix) block -> class "partial"
+        "cache_warm_sessions": cache["hits"] + cache["partials"],
+        "cache_hit_tokens_total": cache["hit_tokens_total"],
+        "cache_nodes": cache["nodes"],
+        "compile_delta_during_churn": compile_delta,
+        "spec_k": sp["k"],
+        "spec_accepted_per_step": round(sp["accepted_per_step"], 2),
+        "spec_acceptance_rate": round(sp["acceptance_rate"], 3),
+        "spec_verify_steps": sp["verify_steps"],
+        "tokens_per_sec_single_step": round(len(ref) / dt_single, 2),
+        "tokens_per_sec_speculative": round(len(out) / dt_spec, 2),
+    }
+
+
 def _bench_fleet(requests=32, max_new=16):
     """mx.fleet row: what the router front-end costs on top of a
     replica — per-request routing overhead (refresh + p2c pick, the
@@ -1093,6 +1191,12 @@ def main():
             # (refresh + p2c pick) + e2e latency through two local
             # replicas, and the prefill->decode handoff blob size
             ("fleet", _bench_fleet, "fleet_router"),
+            # mx.serve.cache per-token-cost plane: radix prefix-cache
+            # prefill savings on a shared 2k system prompt (TTFT cold
+            # vs hit, zero compiles under session churn) + speculative
+            # decoding accepted-tokens-per-target-step
+            ("serve_cache", _bench_serve_cache,
+             "serve_cache_per_token_cost"),
             # mx.autotune tuned-vs-default sweeps: allreduce bucket
             # size on a ResNet-50 gradient profile + flash-attention
             # block grid at BERT's T=512 — the committed numbers for

@@ -497,6 +497,43 @@ class _DecodeBucket(TuningSite):
 
 
 @register_site
+class _SpecK(TuningSite):
+    """Speculative-decoding draft proposal count K.  key =
+    (max_live,).  Greedy acceptance makes the emitted stream
+    bit-identical to single-step decode for EVERY K (the acceptance
+    proof in serve/spec.py), so parity is structural like
+    ``decode_bucket`` — K trades draft work against accepted tokens
+    per target step and can never change tokens.  Winners are
+    committed by the bench sweep / an explicit store put;
+    ``SpecPlane`` consumes them whenever ``spec_k`` is left unset."""
+
+    name = "spec_k"
+    doc = "speculative draft proposal count per round (structural)"
+    parity = "structural"
+
+    def default_config(self, key):
+        return 4
+
+    def candidates(self, key):
+        return [2, 3, 4, 6, 8]
+
+    def validate(self, key, config):
+        try:
+            k = int(config)
+        except (TypeError, ValueError):
+            return False
+        return 1 <= k <= 16
+
+    def make_bench(self, key, config):
+        from ..base import MXNetError
+
+        raise MXNetError(
+            "spec_k is a structural site: it is measured by the serve "
+            "bench's acceptance sweep (tools/bench.py --serve), not by "
+            "measure.tune()")
+
+
+@register_site
 class _DataPrefetch(TuningSite):
     """mx.data prefetch ring depth + reader worker count.
     key = (local_batch, approx_record_bytes).  Order-preserving by

@@ -370,6 +370,19 @@ ENV_VARS = {
         "Serve chunked per-token streaming on /predict?stream=1; 0 "
         "forces collect mode (the streamed and collected token "
         "sequences are bit-identical either way)."),
+    "MXNET_SERVE_PREFIX_CACHE": (
+        bool, False,
+        "Enable the radix prefix cache (serve/cache.py): identical "
+        "prompt prefixes prefill once per replica and admission "
+        "charges only the uncached suffix; cached-prefix output is "
+        "bit-identical to cold decode."),
+    "MXNET_SERVE_SPEC_K": (
+        int, 0,
+        "Speculative decoding draft proposal count per round "
+        "(serve/spec.py; needs DecodeRunner(draft=...)); 0 resolves "
+        "the 'spec_k' autotune site / the built-in default.  Greedy "
+        "acceptance keeps output bit-identical to single-step "
+        "decode."),
     "MXNET_FLEET_PUBLISH_SECONDS": (
         float, 1.0,
         "Min seconds between a replica's discovery-record publishes "

@@ -12,6 +12,13 @@ four behaviors:
   stuck queue reads old even when shallow; two idle replicas tie and
   the RNG spreads them).  P2C gives near-best-of-N balance on stale
   load signals without the herd behavior of always-pick-least.
+  Decode dispatch first consults **prefix affinity**: replicas
+  publish their prefix-cache root digests in the load digest, and a
+  prompt whose first block is already cached on a non-saturated
+  replica goes there (lowest score among holders) — a cache hit
+  saves an entire prefill, which outweighs a small load delta.
+  No holder → plain P2C; affinity never overrides saturation and
+  applies to the first attempt only (failover order is unchanged).
 - **breaker-aware failover** — when a dispatch fails, survivors are
   tried in ``(breaker pressure, score)`` order, so a replica whose
   buckets are quarantined is the LAST resort, not the retry target.
@@ -147,6 +154,7 @@ class Router:
         self.requests = {}            # result -> count (local mirror)
         self.failovers = 0
         self.handoffs = 0
+        self.affinity_hits = 0
         self._inflight = {}           # replica_id -> live dispatches
 
     # -- discovery view ------------------------------------------------------
@@ -265,6 +273,40 @@ class Router:
             return a if sa < sb else b
         return min(a, b)
 
+    def affinity(self, records, plane, tokens, exclude=()):
+        """Prefix-affinity pick (first attempt only): among
+        non-saturated routable replicas whose published prefix-cache
+        root digests contain this prompt's first block, return the
+        lowest-score holder — the cache hit saves a whole prefill.
+        Returns None when no replica holds the prefix (or none
+        publish a cache): the caller falls back to P2C.  Each record
+        is matched at ITS OWN block size — mixed-config fleets keep
+        working, a replica just never gets traffic it can't match."""
+        if not tokens:
+            return None
+        from ..serve.cache import prefix_digest
+
+        holders = []
+        for rid in self.routable(records, plane):
+            if rid in exclude:
+                continue
+            rec = records[rid]
+            pc = (rec.get("load") or {}).get("prefix_cache") or {}
+            roots = pc.get("roots") or []
+            bt = int(pc.get("block_tokens") or 0)
+            if not roots or bt <= 0 or len(tokens) < bt:
+                continue
+            if self.saturated(rec, plane):
+                continue
+            if prefix_digest(list(tokens)[:bt]) in roots:
+                holders.append(rid)
+        if not holders:
+            return None
+        self.affinity_hits += 1
+        if telemetry.ENABLED:
+            telemetry.FLEET_AFFINITY_HITS.inc()
+        return min(holders, key=lambda r: (self.score(records[r]), r))
+
     def failover_order(self, records, plane, exclude=()):
         """Surviving candidates for a retry, best first: sorted by
         (breaker pressure, score, id); saturated survivors are kept —
@@ -348,7 +390,10 @@ class Router:
             try:
                 if attempts == 0:
                     plane = "prefill" if disagg else "decode"
-                    rid = self.pick(records, plane)
+                    rid = self.affinity(records, plane,
+                                        payload.get("tokens"))
+                    if rid is None:
+                        rid = self.pick(records, plane)
                 else:
                     order = self.failover_order(
                         records, "prefill" if disagg else "decode",
@@ -611,6 +656,7 @@ class Router:
             "requests": dict(self.requests),
             "failovers": self.failovers,
             "handoffs": self.handoffs,
+            "affinity_hits": self.affinity_hits,
         }
         with self._lock:
             doc["inflight"] = sum(self._inflight.values())
@@ -829,6 +875,7 @@ def kv_doc(kv, generation=None):
                 "generation": None, "replicas": {}, "pools":
                 pools.pool_stats({}), "disaggregated": False,
                 "requests": {}, "failovers": 0, "handoffs": 0,
+                "affinity_hits": 0,
                 "inflight": 0, "inflight_by_replica": {}, "poison": [],
                 "draining": [], "config": None}
     records = discovery.replicas(kv, generation)
@@ -843,6 +890,7 @@ def kv_doc(kv, generation=None):
             "pools": pools.pool_stats(records),
             "disaggregated": pools.disaggregated(records),
             "requests": {}, "failovers": 0, "handoffs": 0,
+            "affinity_hits": 0,
             "inflight": 0, "inflight_by_replica": {},
             "poison": discovery.poison_ids(kv, generation),
             "draining": sorted(drains)}

@@ -60,7 +60,7 @@ __all__ = ["InjectedFault", "InjectedIOError", "FaultPlan", "SITES",
 # a site added later — but these are the ones wired into the stack)
 SITES = ("trainer_step", "collective", "checkpoint_commit",
          "checkpoint_marker", "compile_commit", "serve_dispatch",
-         "serve_poison", "data_read")
+         "serve_poison", "serve_cache", "spec_verify", "data_read")
 KINDS = ("transient", "io", "fatal", "abort")
 
 # distinct from any real exit status the drills assert on (SIGKILL

@@ -47,6 +47,18 @@ def _obs_enabled():
         return False
 
 
+def _serve_cache_enabled():
+    """mx.serve.cache radix prefix cache: built in, but OFF unless
+    armed (MXNET_SERVE_PREFIX_CACHE=1 or DecodeConfig(
+    prefix_cache=True)) — the env default is what this reports."""
+    try:
+        from .base import get_env
+
+        return bool(get_env("MXNET_SERVE_PREFIX_CACHE", bool, False))
+    except Exception:
+        return False
+
+
 def _autotune_enabled():
     """mx.autotune self-tuning: built in, but OFF unless armed
     (MXNET_AUTOTUNE=1|search or mxnet_tpu.autotune.enable())."""
@@ -127,6 +139,8 @@ def _detect():
                                           _step_capture_enabled)
     out["AUTOTUNE"] = _DynamicFeature("AUTOTUNE", _autotune_enabled)
     out["OBS"] = _DynamicFeature("OBS", _obs_enabled)
+    out["SERVE_CACHE"] = _DynamicFeature("SERVE_CACHE",
+                                         _serve_cache_enabled)
     return out
 
 

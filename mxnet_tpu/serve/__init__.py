@@ -40,6 +40,17 @@ checkpoint): compile-once/run-many execution behind a request queue.
   in-program output guard, sequence-granular poison isolation and
   per-bucket breakers.
 
+- **per-token-cost plane** (``cache.py`` + ``spec.py``): a radix
+  prefix cache over PagePool pages (identical prompt prefixes prefill
+  once per replica; admission charges only the uncached suffix;
+  LRU-by-last-hit eviction under pool pressure; cached output
+  bit-identical to cold) and speculative decoding (a draft decoder
+  proposes K tokens, the target verifies all K in one batched
+  dispatch; greedy acceptance keeps output bit-identical to
+  single-step decode).  Both opt-in: ``DecodeConfig(
+  prefix_cache=True)`` / ``MXNET_SERVE_PREFIX_CACHE=1`` and
+  ``DecodeRunner(draft=...)``.
+
 Every stage is metered through ``mx.telemetry`` (``serve_*`` queue
 wait, batch size, pad waste, compile count, latency, rejections, and
 the ``serve_decode_*`` / ``serve_kv_*`` decode-plane families) and
@@ -52,9 +63,11 @@ from .batching import (BatchQueue, BucketQuarantined, NoBucketError,
                        Request, RequestTimeout, Scheduler, ServeError,
                        ServerClosed, ServerOverloaded, fail_request)
 from .breaker import BreakerBoard, CircuitBreaker
+from .cache import PrefixCache, prefix_digest
 from .decode import (DecodeConfig, DecodeError, DecodeRequest,
                      DecodeRunner, DecodeScheduler, TinyDecoder)
 from .kvcache import PageConfig, PagePool, PagePoolExhausted
+from .spec import SpecPlane
 from .runner import DEFAULT_BATCH_SIZES, ModelRunner
 from .server import ServeConfig, Server
 
@@ -68,4 +81,6 @@ __all__ = [
     "DecodeConfig", "DecodeError", "DecodeRequest", "DecodeRunner",
     "DecodeScheduler", "TinyDecoder", "PageConfig", "PagePool",
     "PagePoolExhausted",
+    # per-token-cost plane (prefix cache + speculative decoding)
+    "PrefixCache", "prefix_digest", "SpecPlane",
 ]

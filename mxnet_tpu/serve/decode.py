@@ -123,13 +123,19 @@ class DecodeConfig:
     stream : whether the HTTP front-end advertises/serves chunked
         token streaming (``MXNET_SERVE_DECODE_STREAM``).
     eos_id : default stop token (None = length-only stopping).
+    prefix_cache : enable the radix prefix cache (serve/cache.py;
+        ``MXNET_SERVE_PREFIX_CACHE``, default OFF — opt-in so the
+        warm-up program table is unchanged for existing deployments).
+    spec_k : speculative draft proposal count when a draft model is
+        given (``MXNET_SERVE_SPEC_K``; 0 = resolve via the ``spec_k``
+        autotune site / the built-in default).
     """
 
     def __init__(self, page_size=None, pool_pages=None, max_live=None,
                  max_new_tokens=None, max_context=128,
                  prefill_lengths=None, batch_sizes=None, queue_depth=64,
                  timeout_ms=None, stream=None, eos_id=None,
-                 dtype="float32"):
+                 dtype="float32", prefix_cache=None, spec_k=None):
         self.page_size = get_env("MXNET_SERVE_DECODE_PAGE_SIZE", int, 16) \
             if page_size is None else int(page_size)
         self.pool_pages = get_env("MXNET_SERVE_DECODE_POOL_PAGES", int,
@@ -164,6 +170,11 @@ class DecodeConfig:
         self.timeout_ms = timeout_ms
         self.eos_id = eos_id
         self.dtype = dtype
+        self.prefix_cache = get_env("MXNET_SERVE_PREFIX_CACHE", bool,
+                                    False) \
+            if prefix_cache is None else bool(prefix_cache)
+        self.spec_k = get_env("MXNET_SERVE_SPEC_K", int, 0) \
+            if spec_k is None else int(spec_k)
 
     def _tuned_batch_sizes(self, default_set):
         """The mx.autotune ``decode_bucket`` winner for this
@@ -201,6 +212,7 @@ class DecodeConfig:
             "queue_depth": self.queue_depth,
             "timeout_ms": self.timeout_ms, "stream": self.stream,
             "eos_id": self.eos_id, "dtype": self.dtype,
+            "prefix_cache": self.prefix_cache, "spec_k": self.spec_k,
         }
 
 
@@ -253,7 +265,9 @@ class _Seq:
     """Decode-loop bookkeeping for one live sequence."""
 
     __slots__ = ("req", "sid", "tokens", "length", "pages", "joined_step",
-                 "t_prefill", "first_token_t", "last_token")
+                 "t_prefill", "first_token_t", "last_token",
+                 "cache_class", "prefix_len", "shared",
+                 "spec", "dlen", "dpages", "depoch")
 
     def __init__(self, req, sid):
         self.req = req
@@ -265,6 +279,19 @@ class _Seq:
         self.t_prefill = None
         self.first_token_t = None
         self.last_token = None    # next decode-step input token
+        # serve/cache.py: TTFT class, shared-prefix floor (the scrub
+        # guard's write boundary) and the shared pages this sequence
+        # holds references on (a prefix of ``pages``)
+        self.cache_class = None
+        self.prefix_len = 0
+        self.shared = []
+        # serve/spec.py: None = not yet offered to the plane, True =
+        # speculating, False = detached/ineligible; dlen is the draft
+        # cache cursor, dpages the draft pool reservation
+        self.spec = None
+        self.dlen = 0
+        self.dpages = None
+        self.depoch = None
 
     @property
     def done_reason(self):
@@ -298,7 +325,7 @@ class DecodeRunner:
     server can reach readiness with zero fresh XLA compiles."""
 
     def __init__(self, block, root=None, step=None, ctx=None, config=None,
-                 warm=True):
+                 warm=True, draft=None):
         from ..gluon.block import HybridBlock
         from .runner import resolve_block
 
@@ -333,8 +360,20 @@ class DecodeRunner:
         self._programs = {}
         self._run_lock = threading.RLock()
         self._warmed = False
+        self.cache = None
+        if self.config.prefix_cache:
+            from .cache import PrefixCache
+
+            self.cache = PrefixCache(self.pool)
+        self.spec = None
         if warm:
             self.warm_up()
+        if draft is not None:
+            from .spec import SpecPlane
+
+            self.spec = SpecPlane(self, draft,
+                                  k=self.config.spec_k or None,
+                                  warm=self._warmed)
 
     # -- setup --------------------------------------------------------------
     def _resolve_params(self):
@@ -377,10 +416,12 @@ class DecodeRunner:
     @staticmethod
     def bucket_key_label(key):
         kind, n = key
-        return "%s%d" % ("decode:b" if kind == "decode" else "prefill:t",
-                         n)
+        if kind == "verify":
+            return "verify:b%dk%d" % n
+        return "%s%d" % ({"decode": "decode:b", "prefill": "prefill:t",
+                          "chunk": "chunk:t"}[kind], n)
 
-    def _make_step_fn(self, batch, chunk, with_ctx):
+    def _make_step_fn(self, batch, chunk, with_ctx, with_floors=False):
         """The pure (params, k_pool, v_pool, tokens, tables, ctx_lens,
         chunk_lens) -> (k_pool, v_pool, next_tokens, nonfinite) function
         one (bucket, page-config) jit-compiles.  Sampling (greedy
@@ -394,7 +435,8 @@ class DecodeRunner:
                                  blk.head_dim)
         dtype = self.page_config.dtype
 
-        def step(params, kp, vp, tokens, tables, ctx_lens, chunk_lens):
+        def core(params, kp, vp, tokens, tables, ctx_lens, chunk_lens,
+                 floors):
             if with_ctx:
                 k_ctx = gather_pages(kp, tables)
                 v_ctx = gather_pages(vp, tables)
@@ -421,6 +463,11 @@ class DecodeRunner:
             pos = ctx_lens[:, None] + jnp.arange(chunk, dtype=jnp.int32)
             valid = jnp.arange(chunk, dtype=jnp.int32)[None, :] \
                 < chunk_lens[:, None]
+            if floors is not None:
+                # COW scrub guard (serve/cache.py): a shared prefix
+                # page is NEVER writable — scatter below the floor is
+                # dropped even if a caller miscomputes ctx_lens
+                valid = valid & (pos >= floors[:, None])
             kp = scatter_pages(kp, tables, pos, valid, k_new)
             vp = scatter_pages(vp, tables, pos, valid, v_new)
             next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -428,18 +475,93 @@ class DecodeRunner:
                           dtype=jnp.int32)
             return kp, vp, next_tok, bad
 
+        if with_floors:
+            def step(params, kp, vp, tokens, tables, ctx_lens,
+                     chunk_lens, floors):
+                return core(params, kp, vp, tokens, tables, ctx_lens,
+                            chunk_lens, floors)
+        else:
+            def step(params, kp, vp, tokens, tables, ctx_lens,
+                     chunk_lens):
+                return core(params, kp, vp, tokens, tables, ctx_lens,
+                            chunk_lens, None)
+        return step
+
+    def _make_verify_fn(self, batch, k):
+        """The speculative verify program (serve/spec.py): judge a
+        K-token draft chunk with ONE dispatch.  The model contract
+        only exposes the LAST valid chunk logit, so each sequence is
+        replicated K+1 times with chunk lengths ``1..K+1`` — row j of
+        a group yields the target's argmax after the chunk's first
+        j+1 tokens.  K/V is scattered once per sequence from the
+        full-chunk replica (causal attention makes per-position rows
+        identical across replicas); positions past the eventual
+        acceptance point hold draft-conditioned garbage that the
+        decode-path scrub guard hides until it is overwritten in
+        place."""
+        import jax.numpy as jnp
+
+        apply_fn = self._apply_fn
+        T = k + 1
+
+        def step(params, kp, vp, tokens, tables, ctx_lens, chunk_lens,
+                 floors):
+            k_ctx = gather_pages(kp, tables)
+            v_ctx = gather_pages(vp, tables)
+            live = (jnp.arange(k_ctx.shape[2])[None, None, :, None,
+                                               None]
+                    < ctx_lens[:, None, None, None, None])
+            k_ctx = jnp.where(live, k_ctx, 0)
+            v_ctx = jnp.where(live, v_ctx, 0)
+            rep = lambda a: jnp.repeat(a, T, axis=0)  # noqa: E731
+            rj = jnp.tile(jnp.arange(1, T + 1, dtype=jnp.int32), batch)
+            # replicas past a sequence's real chunk length would be
+            # conditioned on padding garbage; clamp them to the full
+            # chunk (their outputs are never read)
+            rep_chunk = jnp.minimum(
+                rj, jnp.repeat(jnp.maximum(chunk_lens, 1), T))
+            outs, _states = apply_fn(params, None, rep(tokens),
+                                     rep(k_ctx), rep(v_ctx),
+                                     rep(ctx_lens), rep_chunk)
+            logits, k_new, v_new = outs
+            y = jnp.argmax(logits, axis=-1).astype(jnp.int32) \
+                .reshape(batch, T)
+            mask = (jnp.arange(T, dtype=jnp.int32)[None, :]
+                    < chunk_lens[:, None])
+            badrow = jnp.sum(~jnp.isfinite(logits), axis=-1,
+                             dtype=jnp.int32).reshape(batch, T)
+            bad = jnp.sum(jnp.where(mask, badrow, 0), axis=1)
+            k_full = k_new.reshape((batch, T) + k_new.shape[1:])[:, T - 1]
+            v_full = v_new.reshape((batch, T) + v_new.shape[1:])[:, T - 1]
+            pos = ctx_lens[:, None] + jnp.arange(T, dtype=jnp.int32)
+            valid = mask & (pos >= floors[:, None])
+            kp = scatter_pages(kp, tables, pos, valid, k_full)
+            vp = scatter_pages(vp, tables, pos, valid, v_full)
+            return kp, vp, y, bad
+
         return step
 
     def _build(self, key):
         """Build (or restore from the mx.compile persistent cache) the
-        program for ``key`` = ("decode", B) | ("prefill", T)."""
+        program for ``key`` = ("decode", B) | ("prefill", T) |
+        ("chunk", T) cached-suffix prefill | ("verify", (B, K))
+        speculative verify."""
         import jax
 
         kind, n = key
-        batch = n if kind == "decode" else 1
-        chunk = 1 if kind == "decode" else n
+        if kind == "verify":
+            vb, vk = n
+            batch, chunk = vb, vk + 1
+            with_floors = True
+            fn = self._make_verify_fn(vb, vk)
+        else:
+            batch = n if kind == "decode" else 1
+            chunk = 1 if kind == "decode" else n
+            with_floors = kind == "chunk"
+            fn = self._make_step_fn(
+                batch, chunk, with_ctx=kind in ("decode", "chunk"),
+                with_floors=with_floors)
         label = self.bucket_key_label(key)
-        fn = self._make_step_fn(batch, chunk, with_ctx=(kind == "decode"))
         jitted = jax.jit(fn, donate_argnums=(1, 2))
         provenance = "fresh"
         compiled = None
@@ -451,12 +573,14 @@ class DecodeRunner:
                 (c.num_layers, c.num_pages, c.page_size, c.num_kv_heads,
                  c.head_dim), _np.dtype(c.dtype))
             i32 = _np.dtype("int32")
-            lowered = jitted.lower(
-                params_avals, pool_aval, pool_aval,
-                jax.ShapeDtypeStruct((batch, chunk), i32),
-                jax.ShapeDtypeStruct((batch, c.pages_per_seq), i32),
-                jax.ShapeDtypeStruct((batch,), i32),
-                jax.ShapeDtypeStruct((batch,), i32))
+            avals = [params_avals, pool_aval, pool_aval,
+                     jax.ShapeDtypeStruct((batch, chunk), i32),
+                     jax.ShapeDtypeStruct((batch, c.pages_per_seq), i32),
+                     jax.ShapeDtypeStruct((batch,), i32),
+                     jax.ShapeDtypeStruct((batch,), i32)]
+            if with_floors:
+                avals.append(jax.ShapeDtypeStruct((batch,), i32))
+            lowered = jitted.lower(*avals)
             from ..compile.aot import attach_lowered
 
             compiled, _fp, provenance = attach_lowered(
@@ -479,6 +603,11 @@ class DecodeRunner:
         fresh = 0
         keys = [("decode", b) for b in self.config.batch_sizes] + \
             [("prefill", t) for t in self.config.prefill_lengths]
+        if self.config.prefix_cache:
+            # cached-suffix prefill programs (serve/cache.py), one per
+            # prefill bucket — opt-in, so deployments without the
+            # prefix cache keep an identical program table
+            keys += [("chunk", t) for t in self.config.prefill_lengths]
         for key in keys:
             if key in self._programs:
                 continue
@@ -495,7 +624,8 @@ class DecodeRunner:
                 kind, n = key
                 batch = n if kind == "decode" else 1
                 chunk = 1 if kind == "decode" else n
-                self._dispatch(prog, self._null_inputs(batch, chunk))
+                self._dispatch(prog, self._null_inputs(
+                    batch, chunk, floors=(kind == "chunk")))
         self._warmed = True
         # mx.autotune idle-time tuning (MXNET_AUTOTUNE=search): every
         # decode bucket program is warm and idempotent against null
@@ -510,15 +640,21 @@ class DecodeRunner:
                 _autotune.measure.decode_idle_tune(self)
             except Exception:
                 _autotune.fallback("serve_idle")
+        spec = getattr(self, "spec", None)
+        if spec is not None and not spec.warmed:
+            fresh += spec.warm_up()
         return fresh
 
-    def _null_inputs(self, batch, chunk):
+    def _null_inputs(self, batch, chunk, floors=False):
         c = self.page_config
-        return (_np.zeros((batch, chunk), dtype=_np.int32),
-                _np.full((batch, c.pages_per_seq), self.pool.null_page,
-                         dtype=_np.int32),
-                _np.zeros((batch,), dtype=_np.int32),
-                _np.ones((batch,), dtype=_np.int32))
+        inputs = (_np.zeros((batch, chunk), dtype=_np.int32),
+                  _np.full((batch, c.pages_per_seq), self.pool.null_page,
+                           dtype=_np.int32),
+                  _np.zeros((batch,), dtype=_np.int32),
+                  _np.ones((batch,), dtype=_np.int32))
+        if floors:
+            inputs += (_np.zeros((batch,), dtype=_np.int32),)
+        return inputs
 
     def provenance(self):
         return {p.label: p.provenance for p in self._programs.values()}
@@ -530,11 +666,9 @@ class DecodeRunner:
         can leave the pool consumed — detected and surfaced as a
         ``pool_lost`` DecodeError (the scheduler evicts everything;
         per-sequence containment is impossible without storage)."""
-        tokens, tables, ctx_lens, chunk_lens = inputs
         kp, vp = self.pool.k, self.pool.v
         try:
-            out = prog.fn(self._params, kp, vp, tokens, tables,
-                          ctx_lens, chunk_lens)
+            out = prog.fn(self._params, kp, vp, *inputs)
             next_tok = _np.asarray(out[2])   # hard sync: errors land here
             bad = _np.asarray(out[3])
             self.pool.k, self.pool.v = out[0], out[1]
@@ -578,6 +712,60 @@ class DecodeRunner:
                 prog, (tokens, tables, ctx_lens, chunk_lens))
         return int(next_tok[0]), int(bad[0])
 
+    def prefill_cached(self, seq, hit_tokens):
+        """Cached-suffix prefill (serve/cache.py): the first
+        ``hit_tokens`` positions of the prompt are already resident in
+        shared pages, so only the suffix runs — through the
+        ``("chunk", T)`` program, which attends over the shared
+        context and scatters strictly above the ``hit_tokens`` floor
+        (a shared page is never writable)."""
+        c = self.page_config
+        prompt = seq.req.prompt
+        suffix = prompt[hit_tokens:]
+        t_bucket = self.prefill_bucket(len(suffix))
+        tokens = _np.zeros((1, t_bucket), dtype=_np.int32)
+        tokens[0, :len(suffix)] = suffix
+        tables = _np.full((1, c.pages_per_seq), self.pool.null_page,
+                          dtype=_np.int32)
+        tables[0, :len(seq.pages)] = seq.pages
+        ctx_lens = _np.array([hit_tokens], dtype=_np.int32)
+        chunk_lens = _np.array([len(suffix)], dtype=_np.int32)
+        floors = _np.array([hit_tokens], dtype=_np.int32)
+        with self._run_lock:
+            prog = self._programs.get(("chunk", t_bucket)) or \
+                self._build(("chunk", t_bucket))
+            next_tok, bad = self._dispatch(
+                prog, (tokens, tables, ctx_lens, chunk_lens, floors))
+        return int(next_tok[0]), int(bad[0])
+
+    def verify_step(self, seqs, chunks, k):
+        """One speculative verify dispatch (serve/spec.py): judge each
+        sequence's draft chunk (``chunks[i]``, 1..K+1 tokens starting
+        at its last committed token) in a single program run.  Returns
+        ``(y, bad)`` — ``y[i][j]`` is the target's argmax after
+        ``chunks[i][:j+1]``, aligned with ``seqs``."""
+        c = self.page_config
+        bucket = self.decode_bucket(len(seqs))
+        T = k + 1
+        tokens = _np.zeros((bucket, T), dtype=_np.int32)
+        tables = _np.full((bucket, c.pages_per_seq), self.pool.null_page,
+                          dtype=_np.int32)
+        ctx_lens = _np.zeros((bucket,), dtype=_np.int32)
+        chunk_lens = _np.zeros((bucket,), dtype=_np.int32)
+        floors = _np.zeros((bucket,), dtype=_np.int32)
+        for i, (seq, ch) in enumerate(zip(seqs, chunks)):
+            tokens[i, :len(ch)] = ch
+            tables[i, :len(seq.pages)] = seq.pages
+            ctx_lens[i] = seq.length
+            chunk_lens[i] = len(ch)
+            floors[i] = seq.prefix_len
+        with self._run_lock:
+            key = ("verify", (bucket, k))
+            prog = self._programs.get(key) or self._build(key)
+            y, bad = self._dispatch(
+                prog, (tokens, tables, ctx_lens, chunk_lens, floors))
+        return y[:len(seqs)], bad[:len(seqs)]
+
     def decode_step(self, seqs):
         """One iteration over ``seqs`` (the live set or a bisected
         subset): each sequence's pending token is written at its next
@@ -612,6 +800,10 @@ class DecodeRunner:
             "pool": self.pool.stats(),
             "buckets": self.provenance(),
             "config": self.config.as_dict(),
+            "cache": self.cache.stats() if self.cache is not None
+            else {"enabled": False},
+            "spec": self.spec.stats() if self.spec is not None
+            else {"enabled": False},
         }
 
 
@@ -892,7 +1084,9 @@ class DecodeScheduler:
         if self._breakers is not None:
             board = {k: v for k, v in self._breakers.snapshot().items()
                      if k.startswith("('decode'") or
-                     k.startswith("('prefill'")}
+                     k.startswith("('prefill'") or
+                     k.startswith("('spec'") or
+                     k.startswith("('draft'")}
         return {
             "alive": self.alive,
             "waiting": waiting,
@@ -987,9 +1181,21 @@ class DecodeScheduler:
             telemetry.SERVE_DECODE_EVICTIONS.labels(reason=reason).inc()
 
     def _release(self, seq):
+        runner = self._runner
+        if seq.shared:
+            # drop this sequence's references on its shared prefix
+            # pages BEFORE releasing the private ledger — the pages
+            # live in the pool's shared segment, not under the sid
+            if runner.cache is not None:
+                runner.cache.release(seq.shared)
+            else:
+                runner.pool.shared_unref(seq.shared)
+            seq.shared = []
         if seq.pages is not None:
-            self._runner.pool.release(seq.sid)
+            runner.pool.release(seq.sid)
             seq.pages = None
+        if runner.spec is not None and seq.dpages is not None:
+            runner.spec.release(seq)
 
     def _record(self, seq, reason):
         self._recent.append({
@@ -1045,6 +1251,8 @@ class DecodeScheduler:
             old, self._runner = self._runner, self._pending_runner
             self._pending_runner = None
             self.config = self._runner.config
+        if old.cache is not None:
+            old.cache.clear()     # trie refs were the last holders
         old.pool.check()          # every page must have come home
         if telemetry.ENABLED:
             telemetry.SERVE_SWAPS.inc()
@@ -1100,7 +1308,14 @@ class DecodeScheduler:
                     return
                 req = self._waiting[0]
                 pool = self._runner.pool
+                cache = self._runner.cache
                 need = self._pages_needed(req)
+                if cache is not None and not req.export_only and \
+                        req.handoff is None:
+                    # admission charges only the UNCACHED suffix: the
+                    # matched prefix pages are shared, not reserved
+                    _, hit_tok = cache.match(req.prompt)
+                    need -= hit_tok // self.config.page_size
                 if need > pool.capacity:
                     # submit() validated against the runner of its day;
                     # a hot swap may have shrunk the pool since.  Fail
@@ -1114,7 +1329,11 @@ class DecodeScheduler:
                     self._bump("error")
                     continue
                 if not pool.can_alloc(need):
-                    return            # wait for evictions to free pages
+                    # pool pressure: reclaim cold (LRU) cached
+                    # prefixes before giving up on this iteration
+                    if cache is None or cache.evict(need) == 0 or \
+                            not pool.can_alloc(need):
+                        return    # wait for evictions to free pages
                 self._waiting.popleft()
                 if telemetry.ENABLED:
                     telemetry.SERVE_DECODE_WAITING.set(len(self._waiting))
@@ -1127,31 +1346,63 @@ class DecodeScheduler:
             if req.handoff is not None:
                 self._admit_handoff(seq, need)
                 continue
+            hit_tok = 0
+            if cache is not None and not req.export_only:
+                try:
+                    _inject.fire("serve_cache", seq=req.request_id)
+                except (InjectedFault, InjectedIOError):
+                    # corrupt/evict-under-reader drill: the matched
+                    # prefix is declared poisoned — drop that subtree
+                    # (live readers keep their refs) and prefill cold
+                    cache.invalidate(req.prompt)
+                shared, hit_tok, cls = cache.acquire(req.prompt)
+                seq.cache_class = cls
+                seq.prefix_len = hit_tok
+                seq.shared = list(shared)
             try:
-                t_bucket = self._runner.prefill_bucket(len(req.prompt))
+                t_bucket = self._runner.prefill_bucket(
+                    len(req.prompt) - hit_tok)
             except DecodeError as exc:
                 # same swap skew: the new runner's bucket table may not
                 # cover a prompt the old one admitted — resolve the
                 # future, never drop it on the floor
+                self._release(seq)
                 fail_request(req, exc, "error")
                 self._bump("error")
                 continue
             bclass = ("prefill", t_bucket)
             if self._breakers is not None and \
                     not self._breakers.allow(bclass):
+                self._release(seq)
                 fail_request(req, self._breakers.quarantine_error(bclass),
                              "quarantined")
                 self._bump("quarantined")
                 continue
-            seq.pages = self._runner.pool.alloc(sid, need)
+            try:
+                own = self._pages_needed(req) - len(seq.shared)
+                seq.pages = list(seq.shared) + \
+                    list(self._runner.pool.alloc(sid, own))
+            except PagePoolExhausted as exc:
+                # only reachable when the serve_cache drill invalidated
+                # a prefix between reservation check and allocation
+                self._release(seq)
+                fail_request(req, exc, "error")
+                self._bump("error")
+                continue
             t0 = time.perf_counter()
+            blabel = ("chunk:t%d" if hit_tok else "prefill:t%d") \
+                % t_bucket
             try:
                 with trace.use(req.trace), \
                         trace.span("serve_decode_prefill", hist=False,
                                    cat="serve",
-                                   args={"bucket": "prefill:t%d" % t_bucket,
+                                   args={"bucket": blabel,
                                          "request_id": req.request_id}):
-                    tok, bad = self._runner.prefill(seq)
+                    if hit_tok:
+                        tok, bad = self._runner.prefill_cached(
+                            seq, hit_tok)
+                    else:
+                        tok, bad = self._runner.prefill(seq)
             except BaseException as exc:  # noqa: BLE001 - per-request
                 self._release(seq)
                 if self._breakers is not None:
@@ -1168,12 +1419,23 @@ class DecodeScheduler:
             seq.t_prefill = time.perf_counter() - t0
             if telemetry.ENABLED:
                 telemetry.SERVE_DECODE_PREFILLS.inc()
+                telemetry.SERVE_DECODE_PREFILL_TOKENS.inc(
+                    len(req.prompt) - hit_tok)
             with self._cond:
                 self._live[sid] = seq
             self.admitted_total += 1
             if bad:
                 self._evict_nonfinite(seq, bad)
                 continue
+            if cache is not None and not req.export_only:
+                # only a HEALTHY prefill populates the trie; newly
+                # adopted full-prompt pages move to the shared segment
+                # with refcount 2 (trie + this reader)
+                adopted = cache.insert(req.prompt, sid, seq.pages,
+                                       hit_tok)
+                if adopted:
+                    seq.shared = list(
+                        seq.pages[:len(seq.shared) + adopted])
             if req.export_only:
                 self._finish_export(seq, int(tok))
                 self._gauges()
@@ -1278,6 +1540,10 @@ class DecodeScheduler:
             fail_request(seq.req, exc, "error")
             self._bump("error")
             self._record(seq, "pool_lost")
+        if self._runner.cache is not None:
+            # the replacement pool arrays are zeros: every cached
+            # prefix's content is gone with the storage
+            self._runner.cache.clear()
         self._gauges()
 
     def _emit(self, seq, token, t_start):
@@ -1290,7 +1556,8 @@ class DecodeScheduler:
         if seq.first_token_t is None:
             seq.first_token_t = now
             if telemetry.ENABLED:
-                telemetry.SERVE_DECODE_TTFT_SECONDS.observe(
+                telemetry.SERVE_DECODE_TTFT_SECONDS.labels(
+                    cache=seq.cache_class or "miss").observe(
                     now - seq.req.enqueued)
         if telemetry.ENABLED:
             telemetry.SERVE_DECODE_TOKENS.inc()
@@ -1352,11 +1619,64 @@ class DecodeScheduler:
         return None
 
     def _step(self):
-        """One continuous-batching iteration over the live set."""
+        """One continuous-batching iteration over the live set:
+        speculative sequences advance K-at-a-time through the spec
+        plane, everything else (and every fallback) through the
+        normal one-token decode path."""
         live = self._evict_poisoned(list(self._live.values()))
         if not live:
             self._gauges()
             return
+        spec = self._runner.spec
+        if spec is not None:
+            live = self._spec_round(live, spec)
+        if live:
+            self._step_normal(live)
+        else:
+            self._gauges()
+
+    def _spec_round(self, live, spec):
+        """Drive one plane round over the speculative slice of the
+        live set; emits accepted tokens and returns the slice to step
+        normally this iteration."""
+        for seq in live:
+            if seq.spec is None:
+                # first sight of this sequence: offer it to the plane
+                # (attach failure just leaves it decoding normally)
+                if seq.req.export_only:
+                    seq.spec = False
+                else:
+                    spec.attach(seq)
+        normal = [s for s in live if not s.spec]
+        cand = [s for s in live if s.spec]
+        if not cand:
+            return normal
+        t0 = time.perf_counter()
+        try:
+            results, fallen = spec.round(cand, self._breakers)
+        except BaseException as exc:  # noqa: BLE001 - classified
+            if getattr(exc, "pool_lost", False):
+                self._evict_all_live(exc)
+                return []
+            trace.instant("serve_spec_round_error", cat="serve")
+            return normal + cand
+        if results:
+            self.steps += 1
+            if telemetry.ENABLED:
+                telemetry.SERVE_DECODE_STEPS.inc()
+        for seq, emitted, bad in results:
+            if bad:
+                self._evict_nonfinite(seq, bad)
+                continue
+            for tok in emitted:
+                seq.length += 1
+                self._emit(seq, int(tok), t0)
+                if self._finish_if_done(seq):
+                    break
+        self._gauges()
+        return normal + fallen
+
+    def _step_normal(self, live):
         bucket = self._pick_bucket(len(live))
         if bucket is None:
             time.sleep(0.005)     # every decode bucket cooling down

@@ -23,7 +23,16 @@ hundred tokens in.
 - exact occupancy accounting: ``alloc`` / ``release`` / ``reset`` with
   a free list, per-owner page ledger, in-use / high-water counters,
   and hard invariants (double-free and unknown-owner release raise —
-  a leaked page is a serving-capacity leak that compounds forever).
+  a leaked page is a serving-capacity leak that compounds forever);
+- the **shared segment** backing the mx.serve.cache radix prefix
+  cache: ``adopt_shared`` moves immutable prefix pages out of one
+  owner's ledger into a refcounted shared pool (``shared_ref`` /
+  ``shared_unref``), so identical prompt prefixes are stored once and
+  read by many sequences copy-on-write.  A shared page returns to the
+  free list only when its LAST reference drops — an evicted prefix
+  never yanks storage out from under a live reader — and ``check()``
+  audits ``free + owned + shared == capacity`` with the same
+  double-free-raises discipline.
 
 The jax-side page-table address arithmetic lives here too so the
 decode-step program and the pool agree on the layout by construction:
@@ -126,6 +135,7 @@ class PagePool:
         self._lock = threading.Lock()
         self._free = list(range(c.num_pages - 1, -1, -1))  # pop() -> 0,1,2..
         self._owned = {}                 # owner -> [page ids]
+        self._shared = {}                # page id -> refcount (>= 1)
         self.high_water = 0
         self.alloc_total = 0
         self.oom_rejects = 0
@@ -199,36 +209,114 @@ class PagePool:
         with self._lock:
             return {o: list(p) for o, p in self._owned.items()}
 
+    # -- shared segment (mx.serve.cache radix prefix cache) -----------------
+    def adopt_shared(self, owner, pages, readers=1):
+        """Move ``pages`` (a subset of ``owner``'s ledger) into the
+        shared segment as immutable prefix storage.  Each page's
+        refcount starts at ``1 + readers``: one structural reference
+        for the adopting cache plus one per live reader that already
+        holds the page in its table.  The owner keeps its remaining
+        (private) pages; totals are unchanged — adoption is a ledger
+        move, never an allocation."""
+        pages = [int(p) for p in pages]
+        with self._lock:
+            owned = self._owned.get(owner)
+            if owned is None:
+                raise ServeError(
+                    "adopt_shared from unknown page owner %r" % (owner,))
+            for p in pages:
+                if p not in owned:
+                    raise ServeError(
+                        "adopt_shared: page %d is not owned by %r"
+                        % (p, owner))
+                if p in self._shared:
+                    raise ServeError(
+                        "adopt_shared: page %d is already shared" % p)
+            for p in pages:
+                owned.remove(p)
+                self._shared[p] = 1 + int(readers)
+
+    def shared_ref(self, pages):
+        """Take one reference per page (a cache hit attaching a reader
+        to an existing prefix).  Unknown pages raise — referencing a
+        page that is not in the shared segment is the read half of a
+        use-after-free."""
+        pages = [int(p) for p in pages]
+        with self._lock:
+            for p in pages:
+                if p not in self._shared:
+                    raise ServeError(
+                        "shared_ref of non-shared page %d" % p)
+            for p in pages:
+                self._shared[p] += 1
+
+    def shared_unref(self, pages):
+        """Drop one reference per page; pages reaching refcount 0
+        return to the free list.  Over-release raises (the shared
+        segment's double-free guard).  Returns the number of pages
+        actually freed."""
+        freed = 0
+        with self._lock:
+            for p in [int(p) for p in pages]:
+                n = self._shared.get(p)
+                if not n:
+                    raise ServeError(
+                        "shared double-free of page %d" % p)
+                n -= 1
+                if n == 0:
+                    del self._shared[p]
+                    self._free.append(p)
+                    freed += 1
+                else:
+                    self._shared[p] = n
+        return freed
+
+    @property
+    def shared_pages(self):
+        with self._lock:
+            return len(self._shared)
+
+    def shared_refs(self):
+        with self._lock:
+            return dict(self._shared)
+
     def reset(self):
         """Free everything (scheduler teardown); storage is reused."""
         with self._lock:
             self._owned.clear()
+            self._shared.clear()
             self._free = list(range(self.config.num_pages - 1, -1, -1))
 
     def check(self):
-        """Invariant audit: free + owned == capacity, no duplicates.
-        Raises ``ServeError`` on violation; returns True."""
+        """Invariant audit: free + owned + shared == capacity, no
+        duplicates, every shared refcount >= 1.  Raises ``ServeError``
+        on violation; returns True."""
         with self._lock:
             owned = [p for pages in self._owned.values() for p in pages]
-            seen = self._free + owned
+            shared = list(self._shared)
+            seen = self._free + owned + shared
             if len(seen) != self.config.num_pages or \
                     len(set(seen)) != len(seen):
                 raise ServeError(
-                    "page accounting corrupt: %d free + %d owned != %d "
-                    "capacity (or duplicate ids)" % (
-                        len(self._free), len(owned),
+                    "page accounting corrupt: %d free + %d owned + %d "
+                    "shared != %d capacity (or duplicate ids)" % (
+                        len(self._free), len(owned), len(shared),
                         self.config.num_pages))
+            if any(n < 1 for n in self._shared.values()):
+                raise ServeError("shared page with refcount < 1")
         return True
 
     def stats(self):
         with self._lock:
             free = len(self._free)
             owners = len(self._owned)
+            shared = len(self._shared)
         cap = self.config.num_pages
         return {
             "capacity_pages": cap,
             "in_use_pages": cap - free,
             "free_pages": free,
+            "shared_pages": shared,
             "high_water_pages": self.high_water,
             "occupancy": round((cap - free) / cap, 4),
             "owners": owners,

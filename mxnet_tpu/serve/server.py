@@ -52,11 +52,14 @@ from .runner import DEFAULT_BATCH_SIZES, ModelRunner
 
 __all__ = ["ServeConfig", "Server", "SERVE_STATZ_SCHEMA_VERSION"]
 
-# /statz top-level schema version: the mx.fleet router's load-digest
-# parser and external scrapers key on this.  Bump it when the TOP-LEVEL
-# key set changes (tests/python/unittest/test_serve.py locks the set);
-# adding nested detail under existing keys does not bump it.
-SERVE_STATZ_SCHEMA_VERSION = 1
+# /statz top-level schema version with an ADDITIVE-KEYS policy (README
+# "Serving" / per-token cost): within a version, top-level keys may be
+# ADDED but never renamed, removed, or retyped — fleet/obs scrapers
+# must treat unknown keys as forward compatibility, and
+# test_serve.py locks the REQUIRED subset + this version.  Bump the
+# version only on a breaking change (rename/remove/retype).  v2 added
+# "cache" and "spec" (the per-token-cost plane).
+SERVE_STATZ_SCHEMA_VERSION = 2
 
 
 class ServeConfig:
@@ -338,6 +341,12 @@ class Server:
             digest["decode_max_live"] = self._decode.config.max_live
             digest["pages_free"] = pool.available
             digest["pages_total"] = pool.capacity
+            cache = self._decode.runner.cache
+            if cache is not None:
+                # prefix-affinity signal (fleet/router.py): the root
+                # block digests let the router route a session to the
+                # replica already holding its prefix
+                digest["prefix_cache"] = cache.summary(roots_cap=16)
         for b in self.breakers().values():
             if b["state"] == "open":
                 digest["breakers_open"] += 1
@@ -392,7 +401,25 @@ class Server:
             # mx.obs SLO engine: per-objective OK/WARN/PAGE + burn
             # rates (None when no objectives are registered)
             "slo": self._slo_states(),
+            # the per-token-cost plane (serve/cache.py + serve/spec.py;
+            # {"enabled": False} when not armed) — schema v2 additions
+            "cache": self._cache_stats(),
+            "spec": self._spec_stats(),
         }
+
+    def _cache_stats(self):
+        if self._decode is not None:
+            cache = self._decode.runner.cache
+            if cache is not None:
+                return cache.stats()
+        return {"enabled": False}
+
+    def _spec_stats(self):
+        if self._decode is not None:
+            spec = self._decode.runner.spec
+            if spec is not None:
+                return spec.stats()
+        return {"enabled": False}
 
     @staticmethod
     def _slo_states():

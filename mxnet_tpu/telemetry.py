@@ -974,7 +974,9 @@ SERVE_DECODE_WAITING = gauge(
     "sequences queued for admission (slots or KV pages exhausted)")
 SERVE_DECODE_TTFT_SECONDS = histogram(
     "serve_decode_ttft_seconds",
-    "time to first token: submit -> the prefill-produced token")
+    "time to first token: submit -> the prefill-produced token, by "
+    "prefix-cache outcome (hit / partial / miss)",
+    ("cache",))
 SERVE_DECODE_TOKEN_SECONDS = histogram(
     "serve_decode_token_seconds",
     "per-token decode latency (one continuous-batching iteration)")
@@ -994,6 +996,49 @@ SERVE_KV_PAGES_IN_USE = gauge(
 SERVE_KV_PAGES_HIGH_WATER = gauge(
     "serve_kv_pages_high_water",
     "high-water mark of reserved KV-cache pool pages")
+# mx.serve.cache (serve/cache.py): the radix prefix cache — identical
+# prompt prefixes prefill once per replica, not once per request.
+SERVE_PREFIX_LOOKUPS = counter(
+    "serve_prefix_lookups_total",
+    "prefix-cache admissions by outcome (hit = every cacheable prompt "
+    "block matched, partial = some, miss = none)",
+    ("result",))
+SERVE_PREFIX_HIT_TOKENS = counter(
+    "serve_prefix_hit_tokens_total",
+    "prompt tokens served from cached prefix pages (prefill work "
+    "avoided)")
+SERVE_PREFIX_SHARED_PAGES = gauge(
+    "serve_prefix_shared_pages",
+    "KV pool pages in the shared refcounted segment (prefix trie + "
+    "live readers)")
+SERVE_PREFIX_EVICTIONS = counter(
+    "serve_prefix_evictions_total",
+    "prefix trie nodes dropped (LRU pool pressure, corrupt-drill "
+    "invalidation, or clear)")
+SERVE_DECODE_PREFILL_TOKENS = counter(
+    "serve_decode_prefill_tokens_total",
+    "prompt tokens actually run through a prefill/chunk program (the "
+    "uncached suffix only; the fleet drill asserts one full prefill "
+    "per shared prompt fleet-wide)")
+# mx.serve.spec (serve/spec.py): speculative decoding — draft-propose,
+# target-verify, greedy acceptance (bit-identical to single-step).
+SERVE_SPEC_ROUNDS = counter(
+    "serve_spec_rounds_total",
+    "speculative rounds reaching the verify dispatch")
+SERVE_SPEC_PROPOSED = counter(
+    "serve_spec_proposed_total",
+    "draft tokens proposed to the target verifier")
+SERVE_SPEC_ACCEPTED = counter(
+    "serve_spec_accepted_total",
+    "draft tokens accepted by greedy verification (accepted/proposed "
+    "is the acceptance rate; accepted tokens cost no extra target "
+    "step)")
+SERVE_SPEC_FALLBACKS = counter(
+    "serve_spec_fallbacks_total",
+    "sequences degraded to non-speculative decode, by reason "
+    "(draft_pool / draft_prefill / draft_nonfinite / draft_error / "
+    "draft_lost / injected)",
+    ("reason",))
 # mx.dist (dist/): coordinated multi-host fault tolerance —
 # collective deadlines, membership, pod-consistent checkpoints.
 DIST_COLLECTIVE_TIMEOUTS = counter(
@@ -1142,6 +1187,10 @@ FLEET_DISPATCHES = counter(
     "fleet_router_dispatch_total",
     "upstream dispatch attempts by pool plane (micro / prefill / "
     "decode; retries count again)", ("plane",))
+FLEET_AFFINITY_HITS = counter(
+    "fleet_prefix_affinity_total",
+    "decode dispatches routed by prefix-cache affinity (the prompt's "
+    "first block was already cached on the chosen replica)")
 FLEET_FAILOVERS = counter(
     "fleet_failover_total",
     "mid-request re-routes after a replica death or connection "

@@ -28,6 +28,9 @@ Knobs (set by the harness, read from the environment):
   (slows streams so a SIGKILL reliably lands mid-stream).
 - ``MXNET_FLEET_ROLE`` — this replica's pool role (the disaggregated
   stage runs dedicated ``prefill`` / ``decode`` replicas).
+- ``MXNET_FLEET_DRILL_CACHE`` — build the long-context prefix-cache
+  config instead (2k prefill bucket, ``prefix_cache=True``) for
+  tools/cache_smoke.py's one-prefill-fleet-wide drill.
 """
 from __future__ import annotations
 
@@ -50,9 +53,18 @@ def build_runner(step_delay=0.0):
     dec = TinyDecoder(vocab_size=32, num_layers=2, num_heads=2,
                       head_dim=4)
     dec.initialize()
-    cfg = DecodeConfig(page_size=4, pool_pages=32, max_live=2,
-                       max_new_tokens=10, max_context=24,
-                       prefill_lengths=(8,), batch_sizes=(1, 2))
+    if os.environ.get("MXNET_FLEET_DRILL_CACHE", "") not in ("", "0"):
+        # tools/cache_smoke.py: a shared 2k-token system prompt must
+        # prefill ONCE fleet-wide — big prefill bucket for the cold
+        # populate, small one for the cached suffix, radix cache on
+        cfg = DecodeConfig(page_size=16, pool_pages=384, max_live=2,
+                           max_new_tokens=10, max_context=2112,
+                           prefill_lengths=(64, 2048),
+                           batch_sizes=(1, 2), prefix_cache=True)
+    else:
+        cfg = DecodeConfig(page_size=4, pool_pages=32, max_live=2,
+                           max_new_tokens=10, max_context=24,
+                           prefill_lengths=(8,), batch_sizes=(1, 2))
     runner = DecodeRunner(dec, config=cfg)
     if step_delay > 0:
         # slow decode per STEP (not per request): the kill lands while

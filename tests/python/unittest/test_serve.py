@@ -431,22 +431,28 @@ def test_http_predict_health_ready_statz_metrics(tmp_path):
 
 def test_statz_schema_version_and_locked_key_set(tmp_path):
     # /statz is the stable schema external parsers key on (the fleet
-    # router's load digest source, scrapers, diagnose): its TOP-LEVEL
-    # key set is locked against schema_version 1.  Adding a key means
-    # extending this set AND bumping SERVE_STATZ_SCHEMA_VERSION.
+    # router's load digest source, scrapers, diagnose).  Policy is
+    # ADDITIVE-KEYS: within one schema_version keys may be ADDED, so
+    # parsers assert required keys as a SUBSET (never the exact set);
+    # renaming, removing or retyping a key bumps
+    # SERVE_STATZ_SCHEMA_VERSION.  v2 added "cache" and "spec".
     from mxnet_tpu.serve.server import SERVE_STATZ_SCHEMA_VERSION
 
     make, blk, root = _checkpointed_model(tmp_path)
     with _server(make, root) as srv:
         doc = srv.stats()
-        assert SERVE_STATZ_SCHEMA_VERSION == 1
+        assert SERVE_STATZ_SCHEMA_VERSION == 2
         assert doc["schema_version"] == SERVE_STATZ_SCHEMA_VERSION
-        assert set(doc) == {
+        required = {
             "schema_version", "ready", "healthy", "draining",
             "queue_depth", "queue_age_s", "config", "runner",
             "decode", "requests", "totals", "breakers", "health",
-            "slo",
+            "slo", "cache", "spec",
         }
+        assert required <= set(doc)
+        # a micro-batch-only server reports both planes disabled
+        assert doc["cache"] == {"enabled": False}
+        assert doc["spec"] == {"enabled": False}
         # the HTTP face serves the same document shape
         host, port = srv.start_http()
         _, http_doc = _get("http://%s:%d/statz" % (host, port))

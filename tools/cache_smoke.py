@@ -1,0 +1,349 @@
+#!/usr/bin/env python
+"""mx.serve.cache smoke (make cache-smoke, CPU).
+
+Three stages, each asserting an ISSUE-18 acceptance contract:
+
+1. **Parity (in-process)** — cached-prefix decode must be
+   bit-identical to a cold prefill, and greedy speculative decode
+   bit-identical to single-step decode; ``serve_decode_compile_total``
+   must stay FLAT while sessions sharing a prefix churn (steady state
+   adds zero compiles).
+
+2. **Fault drills (in-process)** — a ``serve_cache`` fault invalidates
+   the poisoned prefix and the re-prefill repopulates it; a poisoned
+   draft (``spec_verify``) degrades that sequence ALONE to
+   non-speculative decode, batch-mates unaffected, tokens unchanged.
+
+3. **One prefill fleet-wide (2 replicas)** — two replicas under
+   ``tools/launch.py`` share a 2k-token system prompt: the first
+   request prefills it cold, the router's prefix affinity sends the
+   second to the SAME replica, and the fleet-wide
+   ``serve_decode_prefill_tokens_total`` proves the 2k prefix ran
+   exactly once.  The hot replica is then SIGKILLed mid-stream: the
+   survivor re-prefills, REPOPULATES its own cache, and the
+   client-visible stream completes byte-identical.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MXNET_FLEET_DEAD_AFTER_SECONDS"] = "120"
+os.environ["MXNET_FLEET_REFRESH_SECONDS"] = "0.05"
+
+LAUNCH = os.path.join(REPO, "tools", "launch.py")
+WORKER = os.path.join(REPO, "tests", "nightly", "fleet_drill.py")
+
+# the shared 2k-token "system prompt" + a short per-user suffix
+SYSTEM = [(i * 7 + 3) % 31 for i in range(2000)]
+USER = [(i * 11 + 5) % 31 for i in range(40)]
+
+
+def banner(msg):
+    print("\n=== %s ===" % msg, flush=True)
+
+
+def _decoder(seed=0):
+    import mxnet_tpu as mx
+    from mxnet_tpu import serve
+
+    mx.random.seed(seed)
+    blk = serve.TinyDecoder(vocab_size=32, num_layers=2, num_heads=2,
+                            head_dim=4)
+    blk.initialize()
+    return blk
+
+
+def _config(**kw):
+    from mxnet_tpu import serve
+
+    kw.setdefault("page_size", 4)
+    kw.setdefault("pool_pages", 48)
+    kw.setdefault("max_live", 2)
+    kw.setdefault("max_new_tokens", 6)
+    kw.setdefault("max_context", 32)
+    kw.setdefault("prefill_lengths", (8, 24))
+    kw.setdefault("batch_sizes", (1, 2))
+    return serve.DecodeConfig(**kw)
+
+
+def _run(runner, prompt, mnt=6, request_id=None):
+    from mxnet_tpu import serve
+
+    sched = serve.DecodeScheduler(runner)
+    try:
+        return sched.submit(list(prompt), max_new_tokens=mnt,
+                            request_id=request_id).result(timeout=120)
+    finally:
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# stage 1: parity + compile flatness (in-process)
+# ---------------------------------------------------------------------------
+
+def stage_parity():
+    banner("stage 1: cached / speculative parity, compile flatness")
+    from mxnet_tpu import serve, telemetry
+
+    prompt = [(i * 7 + 3) % 31 for i in range(17)]
+    cold = serve.DecodeRunner(_decoder(), config=_config())
+    ref = _run(cold, prompt)["tokens"]
+
+    runner = serve.DecodeRunner(_decoder(),
+                                config=_config(prefix_cache=True))
+    compiles0 = telemetry.value("serve_decode_compile_total")
+    sched = serve.DecodeScheduler(runner)
+    try:
+        outs = [sched.submit(list(prompt),
+                             max_new_tokens=6).result(timeout=120)
+                for _ in range(6)]      # session churn, shared prefix
+    finally:
+        sched.stop()
+    assert all(o["tokens"] == ref for o in outs), (outs[0], ref)
+    st = runner.cache.stats()
+    assert st["misses"] == 1 and st["hits"] == 5, st
+    assert telemetry.value("serve_decode_compile_total") == compiles0, \
+        "session churn compiled a fresh program"
+    runner.cache.check()
+    print("cached == cold over 6 sessions: %s (hits=%d, 0 new "
+          "compiles)" % (ref, st["hits"]))
+
+    spec = serve.DecodeRunner(_decoder(), config=_config(),
+                              draft=_decoder())
+    out = _run(spec, [7, 2, 9])
+    vanilla = serve.DecodeRunner(_decoder(), config=_config())
+    assert out["tokens"] == _run(vanilla, [7, 2, 9])["tokens"]
+    sp = spec.spec.stats()
+    assert sp["accepted_per_step"] > 1.0, sp
+    print("speculative == single-step: %s (%.2f tokens accepted per "
+          "target step, acceptance %.2f)"
+          % (out["tokens"], sp["accepted_per_step"],
+             sp["acceptance_rate"]))
+
+
+# ---------------------------------------------------------------------------
+# stage 2: fault drills (in-process)
+# ---------------------------------------------------------------------------
+
+def stage_drills():
+    banner("stage 2: serve_cache + spec_verify fault drills")
+    from mxnet_tpu import serve
+    from mxnet_tpu.resilience import inject
+
+    prompt = [(i * 3 + 2) % 31 for i in range(17)]
+    runner = serve.DecodeRunner(_decoder(),
+                                config=_config(prefix_cache=True))
+    sched = serve.DecodeScheduler(runner)
+    try:
+        warm = sched.submit(list(prompt),
+                            max_new_tokens=6).result(timeout=120)
+        inject.plan("serve_cache@drill-cache")
+        out = sched.submit(list(prompt), max_new_tokens=6,
+                           request_id="drill-cache").result(timeout=120)
+    finally:
+        sched.stop()
+        inject.clear()
+    assert out["tokens"] == warm["tokens"]
+    st = runner.cache.stats()
+    assert st["evictions"] >= 4 and st["nodes"] == 4, st
+    runner.cache.check()
+    print("serve_cache drill: prefix invalidated, re-prefill "
+          "repopulated %d nodes, tokens unchanged" % st["nodes"])
+
+    inject.plan("spec_verify@drill-spec")
+    try:
+        cfg = _config()
+        vanilla = serve.DecodeRunner(_decoder(), config=cfg)
+        ref_bad = _run(vanilla, [5, 6, 7])["tokens"]
+        ref_good = _run(vanilla, [8, 9, 10, 11])["tokens"]
+        spec = serve.DecodeRunner(_decoder(), config=cfg,
+                                  draft=_decoder())
+        sched = serve.DecodeScheduler(spec)
+        try:
+            fb = sched.submit([5, 6, 7], max_new_tokens=6,
+                              request_id="drill-spec")
+            fg = sched.submit([8, 9, 10, 11], max_new_tokens=6,
+                              request_id="ok-spec")
+            bad, good = fb.result(timeout=120), fg.result(timeout=120)
+        finally:
+            sched.stop()
+    finally:
+        inject.clear()
+    assert bad["tokens"] == ref_bad and good["tokens"] == ref_good
+    sp = spec.spec.stats()
+    assert sp["fallbacks"].get("injected") == 1, sp
+    assert sp["accepted"] > 0, sp
+    print("spec_verify drill: poisoned draft degraded 1 sequence "
+          "alone (fallbacks=%s), batch-mate kept speculating, both "
+          "streams exact" % sp["fallbacks"])
+
+
+# ---------------------------------------------------------------------------
+# stage 3: one prefill fleet-wide + SIGKILL repopulation
+# ---------------------------------------------------------------------------
+
+def _wait_fleet(kv, n, timeout=180.0):
+    from mxnet_tpu import fleet
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        gen = fleet.latest_generation(kv)
+        if gen is not None:
+            recs = fleet.replicas(kv, gen)
+            if len(recs) >= n and all(
+                    r.get("ready") for r in recs.values()):
+                return gen, recs
+        time.sleep(0.2)
+    raise AssertionError("fleet never reached %d ready replicas" % n)
+
+
+def _prefill_tokens(endpoint):
+    import urllib.request
+
+    with urllib.request.urlopen("http://%s/metrics" % endpoint,
+                                timeout=10) as resp:
+        prom = resp.read().decode()
+    m = re.search(r"^serve_decode_prefill_tokens_total (\S+)", prom,
+                  re.M)
+    return float(m.group(1)) if m else 0.0
+
+
+def stage_fleet():
+    banner("stage 3: one 2k prefill fleet-wide, SIGKILL repopulation")
+    from mxnet_tpu import fleet
+    from mxnet_tpu.dist.membership import FileKV
+
+    member_dir = tempfile.mkdtemp(prefix="mxcache-")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "MXNET_DIST_HEARTBEAT_SECONDS": "0.5",
+        "MXNET_FLEET_PUBLISH_SECONDS": "0.25",
+        "MXNET_FLEET_DRILL_CACHE": "1",
+        "MXNET_FLEET_DRILL_STEP_DELAY": "0.15",
+    })
+    proc = subprocess.Popen(
+        [sys.executable, LAUNCH, "-n", "2", "--backend", "cpu",
+         "--rendezvous", "none", "--term-grace", "120",
+         "--member-dir", member_dir,
+         sys.executable, WORKER, "serve"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        kv = FileKV(member_dir)
+        gen, recs = _wait_fleet(kv, 2)
+        print("fleet up: gen=%d replicas=%s" % (gen, sorted(recs)))
+        router = fleet.Router(kv=kv, generation=gen, seed=0)
+        payload = {"tokens": SYSTEM + USER, "max_new_tokens": 8}
+
+        # request 1: the cold populate — someone prefills all 2040
+        ev1 = []
+        done = router.run_decode(payload, request_id="cache-1",
+                                 emit=ev1.append)
+        ref = [ev["token"] for ev in ev1 if "token" in ev]
+        assert "done" in done and len(ref) == 8, (done, ref)
+        print("reference stream: %s" % ref)
+
+        # wait for the holder to publish its trie roots in the load
+        # digest, then request 2 must follow prefix affinity
+        deadline = time.monotonic() + 30
+        holder = None
+        while time.monotonic() < deadline and holder is None:
+            for rid, rec in router.refresh(force=True).items():
+                pc = (rec.get("load") or {}).get("prefix_cache") or {}
+                if pc.get("roots"):
+                    holder = rid
+            time.sleep(0.1)
+        assert holder is not None, "no replica published trie roots"
+
+        done2 = router.run_decode(payload, request_id="cache-2")
+        assert done2.get("tokens") == ref, (done2, ref)
+        assert router.affinity_hits >= 1, router.affinity_hits
+        records = router.refresh(force=True)
+        totals = {rid: _prefill_tokens(rec["endpoint"])
+                  for rid, rec in records.items()}
+        # one full 2040-token prefill + one 8-token cached suffix —
+        # the 2k system prompt ran ONCE across the whole fleet
+        assert sum(totals.values()) == 2048, totals
+        print("fleet-wide prefill tokens: %s == 2048 (one 2k "
+              "populate + one 8-token suffix, affinity_hits=%d)"
+              % (totals, router.affinity_hits))
+
+        # request 3: SIGKILL the holder mid-stream; the survivor
+        # re-prefills cold, repopulates ITS cache, stream identical
+        events, result = [], {}
+
+        def streamer():
+            result["done"] = router.run_decode(
+                payload, request_id="cache-3", emit=events.append)
+
+        t = threading.Thread(target=streamer, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            ntok = sum(1 for ev in list(events) if "token" in ev)
+            if 2 <= ntok < 6:
+                break
+            time.sleep(0.01)
+        pid = router.refresh(force=True)[holder]["pid"]
+        os.kill(int(pid), signal.SIGKILL)
+        print("SIGKILLed hot replica %s (pid %d) mid-stream"
+              % (holder, pid))
+        t.join(timeout=300)
+        assert not t.is_alive(), "stream never completed after kill"
+        toks = [ev["token"] for ev in events if "token" in ev]
+        assert "done" in result.get("done", {}), result
+        assert toks == ref, (toks, ref)
+        assert router.failovers >= 1, router.failovers
+
+        # the survivor repopulated its own trie
+        survivor = next(r for r in recs if r != holder)
+        deadline = time.monotonic() + 30
+        nodes = 0
+        while time.monotonic() < deadline and not nodes:
+            rec = router.refresh(force=True).get(survivor) or {}
+            pc = (rec.get("load") or {}).get("prefix_cache") or {}
+            nodes = int(pc.get("nodes") or 0)
+            time.sleep(0.1)
+        assert nodes > 0, "survivor never repopulated its cache"
+        print("failover stream byte-identical; survivor repopulated "
+              "%d trie nodes" % nodes)
+        router.shutdown()
+    finally:
+        with open(os.path.join(member_dir, "stop"), "w") as f:
+            f.write("done")
+        try:
+            out = proc.communicate(timeout=180)[0]
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out = proc.communicate()[0]
+    finals = out.count("FINAL OK")
+    assert finals >= 1, "want >=1 surviving FINAL OK, got %d:\n%s" % (
+        finals, out[-3000:])
+    print("survivor drained cleanly: %d/2 FINAL OK" % finals)
+
+
+def main():
+    t0 = time.monotonic()
+    stage_parity()
+    stage_drills()
+    stage_fleet()
+    print("\ncache-smoke OK in %.1fs" % (time.monotonic() - t0))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -512,6 +512,70 @@ def serve_info(src):
             print("  %-36s %g" % (k, totals[k]))
 
 
+def cache_info(src):
+    """Dump the per-token-cost plane (mx.serve.cache + mx.serve.spec):
+    prefix-trie size, hit/partial/miss counters, shared pages,
+    evictions, and the speculative plane's acceptance economics.
+    ``src`` is a running server's base URL (http://host:port — reads
+    its /statz v2 ``cache`` / ``spec`` blocks) or a saved /statz JSON
+    document."""
+    section("Prefix cache / speculative decode (mx.serve.cache)")
+    import json
+
+    if src.startswith("http://") or src.startswith("https://"):
+        import urllib.request
+
+        with urllib.request.urlopen(src.rstrip("/") + "/statz",
+                                    timeout=10) as resp:
+            doc = json.loads(resp.read())
+        print("source       : %s/statz (live)" % src.rstrip("/"))
+    else:
+        with open(src) as f:
+            doc = json.load(f)
+        print("source       : %s (saved /statz)" % src)
+    cache = doc.get("cache") or {"enabled": False}
+    if not cache.get("enabled"):
+        print("prefix cache : disabled (DecodeConfig(prefix_cache="
+              "True) or MXNET_SERVE_PREFIX_CACHE=1)")
+    else:
+        looks = (cache.get("hits", 0) + cache.get("partials", 0)
+                 + cache.get("misses", 0))
+        print("prefix cache : enabled, block=%d tokens"
+              % cache.get("block_tokens", 0))
+        print("  trie       : %d node(s), %d shared page(s)"
+              % (cache.get("nodes", 0), cache.get("shared_pages", 0)))
+        print("  lookups    : %d  (hit %d / partial %d / miss %d"
+              "%s)" % (looks, cache.get("hits", 0),
+                       cache.get("partials", 0), cache.get("misses", 0),
+                       ", %.0f%% hit" % (100.0 * cache["hits"] / looks)
+                       if looks else ""))
+        print("  hit tokens : %d total   inserted pages: %d   "
+              "evictions: %d" % (cache.get("hit_tokens_total", 0),
+                                 cache.get("inserted_pages", 0),
+                                 cache.get("evictions", 0)))
+    spec = doc.get("spec") or {"enabled": False}
+    if not spec.get("enabled"):
+        print("speculative  : disabled (DecodeRunner(draft=...))")
+    else:
+        print("speculative  : enabled, K=%d draft=%s epoch=%d"
+              % (spec.get("k", 0), spec.get("draft_model"),
+                 spec.get("epoch", 0)))
+        print("  rounds     : %d  verify steps: %d"
+              % (spec.get("rounds", 0), spec.get("verify_steps", 0)))
+        print("  acceptance : %.2f (%d / %d proposed)   accepted per "
+              "target step: %.2f"
+              % (spec.get("acceptance_rate", 0.0),
+                 spec.get("accepted", 0), spec.get("proposed", 0),
+                 spec.get("accepted_per_step", 0.0)))
+        fb = spec.get("fallbacks") or {}
+        print("  fallbacks  : %s"
+              % (", ".join("%s=%d" % kv for kv in sorted(fb.items()))
+                 or "(none)"))
+        dp = spec.get("draft_pool") or {}
+        print("  draft pool : %s/%s pages in use"
+              % (dp.get("in_use", "?"), dp.get("capacity", "?")))
+
+
 def trainer_info():
     """Audit the imperative Trainer's multi-tensor update engine by
     training a representative mixed-group model for 2 steps: group
@@ -1171,6 +1235,12 @@ def main():
                          "attached membership or a local-only world; "
                          "the default), or from a saved /fleetz JSON "
                          "document")
+    ap.add_argument("--cache", metavar="SRC",
+                    help="per-token-cost plane: prefix-trie size, "
+                         "hit/partial/miss, shared pages, evictions, "
+                         "speculative acceptance rate — SRC is a "
+                         "server URL (reads its /statz) or a saved "
+                         "/statz JSON document")
     ap.add_argument("--fleet-router", metavar="SRC",
                     help="mx.fleet router view: live replica table "
                          "(role, load, breaker, drain), per-pool "
@@ -1184,7 +1254,8 @@ def main():
     if args.compile_cache or args.serve or args.checkpoints or \
             args.trainer or args.step or args.trace or args.monitor or \
             args.resilience or args.autotune or args.data or \
-            args.dist is not None or args.fleet or args.fleet_router:
+            args.dist is not None or args.fleet or args.fleet_router \
+            or args.cache:
         if args.compile_cache:
             compile_cache_info()
         if args.autotune:
@@ -1207,6 +1278,8 @@ def main():
             monitor_info(args.monitor)
         if args.serve:
             serve_info(args.serve)
+        if args.cache:
+            cache_info(args.cache)
         if args.checkpoints:
             checkpoints_info(args.checkpoints)
         if args.trace:
