@@ -13,7 +13,7 @@ SO := build/libmxtpu_native.so
 	decode-smoke compile-cache-smoke trainer-smoke step-smoke \
 	trace-smoke monitor-smoke faults-smoke dist-faults-smoke \
 	zero-smoke autotune-smoke data-smoke obs-smoke fleet-smoke \
-	cache-smoke smoke-all clean
+	cache-smoke tenant-smoke smoke-all clean
 
 native: $(SO)
 
@@ -223,6 +223,18 @@ cache-smoke:
 	JAX_PLATFORMS=cpu python -m pytest \
 	  tests/python/unittest/test_serve_cache.py -q -m 'not slow'
 
+# mx.tenant smoke: multi-tenant serving plane — a mixed 8-adapter
+# batch decodes on the ONE program warm-up built (compile delta 0
+# across adapter hot add/remove), gathered-LoRA output bit-identical
+# to the dense-merged per-tenant reference, WFQ admission honours
+# weights exactly, and the isolation drill (NaN'ing adapter + quota
+# buster) degrades each offending tenant ALONE with batch-mate
+# streams byte-identical; then the subsystem's pytest suite
+tenant-smoke:
+	JAX_PLATFORMS=cpu python tools/tenant_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/python/unittest/test_tenant.py -q -m 'not slow'
+
 # every subsystem smoke in sequence — the one-command pre-flight before
 # a tunnel window.  Ordered CHEAP-FIRST (approx wall time on the CPU
 # container in the comment column) so a broken build fails in seconds,
@@ -243,6 +255,7 @@ SMOKES := \
 	obs-smoke \
 	zero-smoke \
 	decode-smoke \
+	tenant-smoke \
 	cache-smoke \
 	faults-smoke \
 	data-smoke \
@@ -251,8 +264,8 @@ SMOKES := \
 # approx wall time:        telemetry ~15s, trace ~25s, compile-cache
 # ~35s, trainer ~35s, monitor ~40s, checkpoint ~45s, step ~45s,
 # autotune ~50s, serve ~60s, obs ~75s, zero ~90s, decode ~100s,
-# cache ~2min, faults ~2min, data ~3min, fleet ~3min, dist-faults
-# ~4min (multi-process drills last; total ~20min cold)
+# tenant ~100s, cache ~2min, faults ~2min, data ~3min, fleet ~3min,
+# dist-faults ~4min (multi-process drills last; total ~20min cold)
 smoke-all:
 	@set -e; for t in $(SMOKES); do \
 	  echo "== $$t =="; \

@@ -89,6 +89,7 @@ from . import resilience  # noqa: E402  (fault injection + preempt + supervisor)
 from . import dist  # noqa: E402  (multi-host membership + pod checkpoints)
 from . import obs  # noqa: E402  (fleet-wide observability plane)
 from . import fleet  # noqa: E402  (multi-replica serving fleet)
+from . import tenant  # noqa: E402  (multi-tenant serving: LoRA banks + WFQ)
 from . import shard  # noqa: E402  (global mesh + ZeRO weight-update sharding)
 from . import step  # noqa: E402  (whole-program training-step capture)
 from . import data  # noqa: E402  (sharded streaming input pipeline)

@@ -24,6 +24,10 @@ Sites (PERF_PLAN hypothesis in parens):
 - ``data_prefetch``       — mx.data ring depth + reader workers
                             (structural: order-preserving by
                             construction, measured end-to-end)
+- ``adapter_slots``       — mx.tenant LoRA bank slot count
+                            (structural: per-slot math is masked out
+                            for absent adapters, measured by the
+                            tenant bench)
 
 Measurable sites benchmark with DETERMINISTIC seeded inputs and return
 host numpy outputs so the measure harness can enforce the numerics
@@ -583,6 +587,46 @@ class _DataPrefetch(TuningSite):
             "data_prefetch is a structural site: ring depth/worker "
             "count are measured end-to-end (benchmark/data_bench.py "
             "--train, tools/data_smoke.py), not by measure.tune()")
+
+
+@register_site
+class _AdapterSlots(TuningSite):
+    """mx.tenant LoRA adapter-bank slot count.  key = (default_slots,).
+    Every slot beyond the resident set is zero weights gathered by an
+    out-of-range-clamped index and masked to 0 contribution
+    (adapters.AdapterBank), so slot count can never change tokens —
+    parity is structural.  It trades per-step gather/einsum width (and
+    bank HBM) against how many tenants share ONE compiled decode
+    program; winners are committed by the tenant bench sweep and
+    consumed by ``TenantConfig`` whenever ``slots=`` is left unset."""
+
+    name = "adapter_slots"
+    doc = "tenant LoRA bank slot count (structural)"
+    parity = "structural"
+
+    def default_config(self, key):
+        try:
+            return int(key[0])
+        except (TypeError, ValueError, IndexError):
+            return 8
+
+    def candidates(self, key):
+        return [4, 8, 16, 32]
+
+    def validate(self, key, config):
+        try:
+            n = int(config)
+        except (TypeError, ValueError):
+            return False
+        return 1 <= n <= 256
+
+    def make_bench(self, key, config):
+        from ..base import MXNetError
+
+        raise MXNetError(
+            "adapter_slots is a structural site: it is measured by the "
+            "tenant mixed-batch bench (tools/tenant_smoke.py --bench), "
+            "not by measure.tune()")
 
 
 @register_site

@@ -421,6 +421,45 @@ ENV_VARS = {
         "Pool role a serve replica registers under when none is "
         "passed explicitly: both | prefill | decode (disaggregated "
         "prefill/decode pools; fleet/pools.py)."),
+    "MXNET_TENANT": (
+        bool, False,
+        "Arm the mx.tenant multi-tenant serving plane: batched LoRA "
+        "adapter multiplexing (one compiled decode program serves "
+        "mixed-adapter batches), virtual-time weighted fair queuing "
+        "before admission, and per-tenant quotas/isolation "
+        "(tenant/)."),
+    "MXNET_TENANT_SLOTS": (
+        int, 8,
+        "Adapter bank capacity: how many LoRA adapters are "
+        "device-resident per decode runner (tenant/adapters.py).  "
+        "Resolved through the 'adapter_slots' autotune site when "
+        "MXNET_AUTOTUNE is on; changing it re-specializes the decode "
+        "programs (one-time recompile, then hot add/remove swaps "
+        "slots with zero recompiles)."),
+    "MXNET_TENANT_MAX_RANK": (
+        int, 8,
+        "Max LoRA rank the adapter bank accepts; lower-rank adapters "
+        "are zero-padded into the bank (tenant/adapters.py)."),
+    "MXNET_TENANT_DEFAULT_WEIGHT": (
+        float, 1.0,
+        "WFQ weight assigned to tenants registered without an "
+        "explicit weight, and charged to un-tenanted (base-model) "
+        "traffic so it cannot starve tenants (tenant/fairsched.py)."),
+    "MXNET_TENANT_MAX_LIVE": (
+        int, 0,
+        "Default per-tenant cap on concurrently decoding sequences "
+        "(0 = unlimited); exceeding it is a per-tenant 503 + "
+        "Retry-After, never head-of-line blocking (tenant/quota.py)."),
+    "MXNET_TENANT_MAX_PAGES": (
+        int, 0,
+        "Default per-tenant cap on reserved KV-cache pages (0 = "
+        "unlimited), enforced against the PagePool reservation at "
+        "admission (tenant/quota.py)."),
+    "MXNET_TENANT_QUEUE_DEPTH": (
+        int, 16,
+        "Default per-tenant waiting-queue depth; a tenant whose "
+        "backlog reaches it gets 503 + Retry-After while other "
+        "tenants keep flowing (tenant/quota.py)."),
     "MXNET_AUTOTUNE": (
         str, "0",
         "mx.autotune mode: 0 (default) = hand-set literals everywhere, "

@@ -155,6 +155,7 @@ class Router:
         self.failovers = 0
         self.handoffs = 0
         self.affinity_hits = 0
+        self.adapter_affinity_hits = 0
         self._inflight = {}           # replica_id -> live dispatches
 
     # -- discovery view ------------------------------------------------------
@@ -307,6 +308,36 @@ class Router:
             telemetry.FLEET_AFFINITY_HITS.inc()
         return min(holders, key=lambda r: (self.score(records[r]), r))
 
+    def adapter_affinity(self, records, plane, tenant, exclude=()):
+        """Adapter-residency pick (mx.tenant, first attempt only):
+        among non-saturated routable replicas whose published
+        ``tenants.resident`` list already holds this tenant's adapter,
+        return the lowest-score holder — dispatching there skips an
+        adapter load/slot swap.  None when no replica publishes
+        residency for the tenant: the caller falls back to prefix
+        affinity / P2C (any replica can still serve the tenant, it
+        just loads the adapter first)."""
+        if not tenant:
+            return None
+        holders = []
+        for rid in self.routable(records, plane):
+            if rid in exclude:
+                continue
+            rec = records[rid]
+            res = ((rec.get("load") or {}).get("tenants") or {}) \
+                .get("resident") or []
+            if str(tenant) not in res:
+                continue
+            if self.saturated(rec, plane):
+                continue
+            holders.append(rid)
+        if not holders:
+            return None
+        self.adapter_affinity_hits += 1
+        if telemetry.ENABLED:
+            telemetry.FLEET_ADAPTER_AFFINITY.inc()
+        return min(holders, key=lambda r: (self.score(records[r]), r))
+
     def failover_order(self, records, plane, exclude=()):
         """Surviving candidates for a retry, best first: sorted by
         (breaker pressure, score, id); saturated survivors are kept —
@@ -390,8 +421,11 @@ class Router:
             try:
                 if attempts == 0:
                     plane = "prefill" if disagg else "decode"
-                    rid = self.affinity(records, plane,
-                                        payload.get("tokens"))
+                    rid = self.adapter_affinity(records, plane,
+                                                payload.get("tenant"))
+                    if rid is None:
+                        rid = self.affinity(records, plane,
+                                            payload.get("tokens"))
                     if rid is None:
                         rid = self.pick(records, plane)
                 else:
@@ -657,6 +691,7 @@ class Router:
             "failovers": self.failovers,
             "handoffs": self.handoffs,
             "affinity_hits": self.affinity_hits,
+            "adapter_affinity_hits": self.adapter_affinity_hits,
         }
         with self._lock:
             doc["inflight"] = sum(self._inflight.values())
@@ -875,7 +910,7 @@ def kv_doc(kv, generation=None):
                 "generation": None, "replicas": {}, "pools":
                 pools.pool_stats({}), "disaggregated": False,
                 "requests": {}, "failovers": 0, "handoffs": 0,
-                "affinity_hits": 0,
+                "affinity_hits": 0, "adapter_affinity_hits": 0,
                 "inflight": 0, "inflight_by_replica": {}, "poison": [],
                 "draining": [], "config": None}
     records = discovery.replicas(kv, generation)
@@ -890,7 +925,7 @@ def kv_doc(kv, generation=None):
             "pools": pools.pool_stats(records),
             "disaggregated": pools.disaggregated(records),
             "requests": {}, "failovers": 0, "handoffs": 0,
-            "affinity_hits": 0,
+            "affinity_hits": 0, "adapter_affinity_hits": 0,
             "inflight": 0, "inflight_by_replica": {},
             "poison": discovery.poison_ids(kv, generation),
             "draining": sorted(drains)}

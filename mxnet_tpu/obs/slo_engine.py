@@ -79,7 +79,7 @@ class SLObjective:
 
     def __init__(self, name, histogram=None, q=0.99, target=None,
                  counter=None, bad=None, objective=None,
-                 warn_burn=6.0, page_burn=14.4):
+                 warn_burn=6.0, page_burn=14.4, labels=None):
         if (histogram is None) == (counter is None):
             raise ValueError(
                 "slo %r: exactly one of histogram=/counter= required"
@@ -88,6 +88,7 @@ class SLObjective:
         self.histogram = histogram
         self.counter = counter
         self.bad_labels = dict(bad or {})
+        self.labels = dict(labels or {}) or None
         self.q = float(q)
         self.target = None if target is None else float(target)
         if histogram is not None:
@@ -111,7 +112,7 @@ class SLObjective:
             m = _tel.get_metric(self.histogram)
             if m is None or m.kind != "histogram":
                 return 0.0, 0.0
-            count, _total, cum = _tel._merged_read(m)
+            count, _total, cum = _tel._merged_read(m, match=self.labels)
             if not count:
                 return 0.0, 0.0
             good = _le_count(cum, self.target)
@@ -174,12 +175,16 @@ class SLObjective:
 # ---------------------------------------------------------------------------
 
 def slo(name, histogram=None, q=0.99, target=None, counter=None,
-        bad=None, objective=None, warn_burn=6.0, page_burn=14.4):
+        bad=None, objective=None, warn_burn=6.0, page_burn=14.4,
+        labels=None):
     """Register (or replace) a declarative objective; returns it.
-    See the module docstring for the two forms."""
+    See the module docstring for the two forms.  ``labels=`` scopes a
+    histogram objective to matching children only (e.g. per-tenant
+    TTFT: ``labels={"tenant": "acme"}``)."""
     obj = SLObjective(name, histogram=histogram, q=q, target=target,
                       counter=counter, bad=bad, objective=objective,
-                      warn_burn=warn_burn, page_burn=page_burn)
+                      warn_burn=warn_burn, page_burn=page_burn,
+                      labels=labels)
     with _LOCK:
         _REGISTRY[obj.name] = obj
     return obj

@@ -59,6 +59,17 @@ def _serve_cache_enabled():
         return False
 
 
+def _tenant_enabled():
+    """mx.tenant multi-tenant serving: built in, but OFF unless armed
+    (MXNET_TENANT=1; the LoRA bank/WFQ plane is opt-in per server)."""
+    try:
+        from . import tenant as _tenant
+
+        return _tenant.is_enabled()
+    except Exception:
+        return False
+
+
 def _autotune_enabled():
     """mx.autotune self-tuning: built in, but OFF unless armed
     (MXNET_AUTOTUNE=1|search or mxnet_tpu.autotune.enable())."""
@@ -141,6 +152,7 @@ def _detect():
     out["OBS"] = _DynamicFeature("OBS", _obs_enabled)
     out["SERVE_CACHE"] = _DynamicFeature("SERVE_CACHE",
                                          _serve_cache_enabled)
+    out["TENANT"] = _DynamicFeature("TENANT", _tenant_enabled)
     return out
 
 

@@ -80,6 +80,12 @@ def fail_request(req, exc, result):
         return
     if telemetry.ENABLED:
         telemetry.SERVE_REQUESTS.labels(result=result).inc()
+        tenant = getattr(req, "tenant", None)
+        if tenant is not None:
+            # mx.tenant: attribute the failure to the billing tenant
+            # (per-tenant error-rate SLOs read this family)
+            telemetry.TENANT_REQUESTS.labels(
+                tenant=tenant, result=result).inc()
     if trace.ENABLED and req.trace is not None:
         trace.record_span(
             "serve_request", req.enqueued,

@@ -230,11 +230,14 @@ class DecodeRequest:
 
     __slots__ = ("prompt", "max_new_tokens", "eos_id", "on_token",
                  "future", "enqueued", "deadline", "request_id", "trace",
-                 "export_only", "handoff")
+                 "export_only", "handoff", "tenant")
 
     def __init__(self, prompt, max_new_tokens, eos_id=None, deadline=None,
                  request_id=None, on_token=None, export_only=False,
-                 handoff=None):
+                 handoff=None, tenant=None):
+        # mx.tenant: the registered tenant this request bills to (None
+        # = base/anonymous traffic — no WFQ charge, no adapter)
+        self.tenant = None if tenant is None else str(tenant)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
@@ -267,9 +270,16 @@ class _Seq:
     __slots__ = ("req", "sid", "tokens", "length", "pages", "joined_step",
                  "t_prefill", "first_token_t", "last_token",
                  "cache_class", "prefix_len", "shared",
-                 "spec", "dlen", "dpages", "depoch")
+                 "spec", "dlen", "dpages", "depoch",
+                 "tenant", "adapter_slot", "quota_pages")
 
     def __init__(self, req, sid):
+        # mx.tenant: billing identity, the bank slot this sequence
+        # decodes with (-1 = base weights), and the pages charged to
+        # the tenant's quota ledger (None until admission reserves)
+        self.tenant = req.tenant
+        self.adapter_slot = -1
+        self.quota_pages = None
         self.req = req
         self.sid = sid
         self.tokens = []          # generated token ids
@@ -325,7 +335,7 @@ class DecodeRunner:
     server can reach readiness with zero fresh XLA compiles."""
 
     def __init__(self, block, root=None, step=None, ctx=None, config=None,
-                 warm=True, draft=None):
+                 warm=True, draft=None, tenant=None):
         from ..gluon.block import HybridBlock
         from .runner import resolve_block
 
@@ -351,6 +361,14 @@ class DecodeRunner:
             self.step = block.load_checkpoint(root, step=step, ctx=ctx)
         self._resolve_params()
         self._apply_fn, self._params = block.export_pure(training=False)
+        # mx.tenant: the adapter bank MUST exist before warm_up so
+        # every program compiles with the bank inputs in its signature
+        # — adapter churn afterwards is slot-content data, never a
+        # recompile.  Without a plane the program table (and its
+        # mx.compile fingerprints) is byte-identical to pre-tenant.
+        self.tenant = tenant
+        self.bank = tenant.build_bank(block) if tenant is not None \
+            else None
         c = self.config
         self.page_config = PageConfig(
             c.page_size, c.pool_pages, block.num_layers,
@@ -434,9 +452,10 @@ class DecodeRunner:
         nlayers, nheads, hdim = (blk.num_layers, blk.num_kv_heads,
                                  blk.head_dim)
         dtype = self.page_config.dtype
+        bank = self.bank
 
         def core(params, kp, vp, tokens, tables, ctx_lens, chunk_lens,
-                 floors):
+                 floors, aidx=None, bankf=None):
             if with_ctx:
                 k_ctx = gather_pages(kp, tables)
                 v_ctx = gather_pages(vp, tables)
@@ -457,8 +476,18 @@ class DecodeRunner:
                 k_ctx = jnp.zeros((batch, nlayers, 0, nheads, hdim),
                                   dtype=dtype)
                 v_ctx = k_ctx
-            outs, _states = apply_fn(params, None, tokens, k_ctx, v_ctx,
-                                     ctx_lens, chunk_lens)
+            if bank is not None:
+                # mx.tenant: bind the (traced) per-sequence adapter
+                # index + bank inputs; the instrumented Dense forwards
+                # add gather(A,idx)/gather(B,idx) deltas inline, so the
+                # mixed-tenant batch stays ONE program
+                with bank.applying(aidx, bankf):
+                    outs, _states = apply_fn(params, None, tokens,
+                                             k_ctx, v_ctx, ctx_lens,
+                                             chunk_lens)
+            else:
+                outs, _states = apply_fn(params, None, tokens, k_ctx,
+                                         v_ctx, ctx_lens, chunk_lens)
             logits, k_new, v_new = outs
             pos = ctx_lens[:, None] + jnp.arange(chunk, dtype=jnp.int32)
             valid = jnp.arange(chunk, dtype=jnp.int32)[None, :] \
@@ -475,11 +504,21 @@ class DecodeRunner:
                           dtype=jnp.int32)
             return kp, vp, next_tok, bad
 
-        if with_floors:
+        if with_floors and bank is not None:
+            def step(params, kp, vp, tokens, tables, ctx_lens,
+                     chunk_lens, floors, aidx, bankf):
+                return core(params, kp, vp, tokens, tables, ctx_lens,
+                            chunk_lens, floors, aidx, bankf)
+        elif with_floors:
             def step(params, kp, vp, tokens, tables, ctx_lens,
                      chunk_lens, floors):
                 return core(params, kp, vp, tokens, tables, ctx_lens,
                             chunk_lens, floors)
+        elif bank is not None:
+            def step(params, kp, vp, tokens, tables, ctx_lens,
+                     chunk_lens, aidx, bankf):
+                return core(params, kp, vp, tokens, tables, ctx_lens,
+                            chunk_lens, None, aidx, bankf)
         else:
             def step(params, kp, vp, tokens, tables, ctx_lens,
                      chunk_lens):
@@ -503,9 +542,10 @@ class DecodeRunner:
 
         apply_fn = self._apply_fn
         T = k + 1
+        bank = self.bank
 
-        def step(params, kp, vp, tokens, tables, ctx_lens, chunk_lens,
-                 floors):
+        def core(params, kp, vp, tokens, tables, ctx_lens, chunk_lens,
+                 floors, aidx=None, bankf=None):
             k_ctx = gather_pages(kp, tables)
             v_ctx = gather_pages(vp, tables)
             live = (jnp.arange(k_ctx.shape[2])[None, None, :, None,
@@ -520,9 +560,18 @@ class DecodeRunner:
             # chunk (their outputs are never read)
             rep_chunk = jnp.minimum(
                 rj, jnp.repeat(jnp.maximum(chunk_lens, 1), T))
-            outs, _states = apply_fn(params, None, rep(tokens),
-                                     rep(k_ctx), rep(v_ctx),
-                                     rep(ctx_lens), rep_chunk)
+            if bank is not None:
+                # the adapter index replicates with its sequence: every
+                # verify replica of a row applies the SAME adapter the
+                # decode path would (bit-parity with single-step)
+                with bank.applying(rep(aidx), bankf):
+                    outs, _states = apply_fn(params, None, rep(tokens),
+                                             rep(k_ctx), rep(v_ctx),
+                                             rep(ctx_lens), rep_chunk)
+            else:
+                outs, _states = apply_fn(params, None, rep(tokens),
+                                         rep(k_ctx), rep(v_ctx),
+                                         rep(ctx_lens), rep_chunk)
             logits, k_new, v_new = outs
             y = jnp.argmax(logits, axis=-1).astype(jnp.int32) \
                 .reshape(batch, T)
@@ -539,6 +588,16 @@ class DecodeRunner:
             vp = scatter_pages(vp, tables, pos, valid, v_full)
             return kp, vp, y, bad
 
+        if bank is not None:
+            def step(params, kp, vp, tokens, tables, ctx_lens,
+                     chunk_lens, floors, aidx, bankf):
+                return core(params, kp, vp, tokens, tables, ctx_lens,
+                            chunk_lens, floors, aidx, bankf)
+        else:
+            def step(params, kp, vp, tokens, tables, ctx_lens,
+                     chunk_lens, floors):
+                return core(params, kp, vp, tokens, tables, ctx_lens,
+                            chunk_lens, floors)
         return step
 
     def _build(self, key):
@@ -580,6 +639,13 @@ class DecodeRunner:
                      jax.ShapeDtypeStruct((batch,), i32)]
             if with_floors:
                 avals.append(jax.ShapeDtypeStruct((batch,), i32))
+            if self.bank is not None:
+                # adapter index + flat bank tuple (mx.tenant): bank
+                # shapes are part of the program fingerprint, so a
+                # restored cache entry matches only an identically
+                # shaped bank
+                avals.append(jax.ShapeDtypeStruct((batch,), i32))
+                avals.append(tuple(self.bank.avals()))
             lowered = jitted.lower(*avals)
             from ..compile.aot import attach_lowered
 
@@ -654,6 +720,9 @@ class DecodeRunner:
                   _np.ones((batch,), dtype=_np.int32))
         if floors:
             inputs += (_np.zeros((batch,), dtype=_np.int32),)
+        if self.bank is not None:
+            inputs += (self.bank.null_index(batch),
+                       self.bank.flat_arrays())
         return inputs
 
     def provenance(self):
@@ -705,11 +774,14 @@ class DecodeRunner:
         tables[0, :len(seq.pages)] = seq.pages
         ctx_lens = _np.zeros((1,), dtype=_np.int32)
         chunk_lens = _np.array([len(prompt)], dtype=_np.int32)
+        inputs = (tokens, tables, ctx_lens, chunk_lens)
+        if self.bank is not None:
+            inputs += (_np.array([seq.adapter_slot], dtype=_np.int32),
+                       self.bank.flat_arrays())
         with self._run_lock:
             prog = self._programs.get(("prefill", t_bucket)) or \
                 self._build(("prefill", t_bucket))
-            next_tok, bad = self._dispatch(
-                prog, (tokens, tables, ctx_lens, chunk_lens))
+            next_tok, bad = self._dispatch(prog, inputs)
         return int(next_tok[0]), int(bad[0])
 
     def prefill_cached(self, seq, hit_tokens):
@@ -731,11 +803,14 @@ class DecodeRunner:
         ctx_lens = _np.array([hit_tokens], dtype=_np.int32)
         chunk_lens = _np.array([len(suffix)], dtype=_np.int32)
         floors = _np.array([hit_tokens], dtype=_np.int32)
+        inputs = (tokens, tables, ctx_lens, chunk_lens, floors)
+        if self.bank is not None:
+            inputs += (_np.array([seq.adapter_slot], dtype=_np.int32),
+                       self.bank.flat_arrays())
         with self._run_lock:
             prog = self._programs.get(("chunk", t_bucket)) or \
                 self._build(("chunk", t_bucket))
-            next_tok, bad = self._dispatch(
-                prog, (tokens, tables, ctx_lens, chunk_lens, floors))
+            next_tok, bad = self._dispatch(prog, inputs)
         return int(next_tok[0]), int(bad[0])
 
     def verify_step(self, seqs, chunks, k):
@@ -759,11 +834,16 @@ class DecodeRunner:
             ctx_lens[i] = seq.length
             chunk_lens[i] = len(ch)
             floors[i] = seq.prefix_len
+        inputs = (tokens, tables, ctx_lens, chunk_lens, floors)
+        if self.bank is not None:
+            aidx = _np.full((bucket,), -1, dtype=_np.int32)
+            for i, seq in enumerate(seqs):
+                aidx[i] = seq.adapter_slot
+            inputs += (aidx, self.bank.flat_arrays())
         with self._run_lock:
             key = ("verify", (bucket, k))
             prog = self._programs.get(key) or self._build(key)
-            y, bad = self._dispatch(
-                prog, (tokens, tables, ctx_lens, chunk_lens, floors))
+            y, bad = self._dispatch(prog, inputs)
         return y[:len(seqs)], bad[:len(seqs)]
 
     def decode_step(self, seqs):
@@ -782,11 +862,18 @@ class DecodeRunner:
             tokens[i, 0] = seq.last_token
             tables[i, :len(seq.pages)] = seq.pages
             ctx_lens[i] = seq.length
+        inputs = (tokens, tables, ctx_lens, chunk_lens)
+        if self.bank is not None:
+            # padding rows stay -1 (base weights, zero delta): a mixed
+            # 8-tenant batch is ONE dispatch of the bucket's program
+            aidx = _np.full((bucket,), -1, dtype=_np.int32)
+            for i, seq in enumerate(seqs):
+                aidx[i] = seq.adapter_slot
+            inputs += (aidx, self.bank.flat_arrays())
         with self._run_lock:
             prog = self._programs.get(("decode", bucket)) or \
                 self._build(("decode", bucket))
-            next_tok, bad = self._dispatch(
-                prog, (tokens, tables, ctx_lens, chunk_lens))
+            next_tok, bad = self._dispatch(prog, inputs)
         return next_tok[:len(seqs)], bad[:len(seqs)]
 
     def stats(self):
@@ -804,6 +891,8 @@ class DecodeRunner:
             else {"enabled": False},
             "spec": self.spec.stats() if self.spec is not None
             else {"enabled": False},
+            "bank": self.bank.stats() if self.bank is not None
+            else {"enabled": False},
         }
 
 
@@ -820,10 +909,15 @@ class DecodeScheduler:
     chooser (a smaller non-blocked bucket chunks the live set), and a
     blocked prefill bucket fast-rejects its admissions."""
 
-    def __init__(self, runner, breakers=None, start=True):
+    def __init__(self, runner, breakers=None, start=True, tenant=None):
         self._runner = runner
         self.config = runner.config
         self._breakers = breakers
+        # mx.tenant plane (registry.TenantPlane): WFQ admission order,
+        # per-tenant quota ledger, adapter bank.  Defaults to the
+        # runner's plane so Server wiring stays one argument.
+        self._tenant = tenant if tenant is not None \
+            else getattr(runner, "tenant", None)
         self._cond = threading.Condition()
         self._waiting = deque()
         self._live = {}               # sid -> _Seq, insertion-ordered
@@ -885,14 +979,18 @@ class DecodeScheduler:
 
     # -- admission ----------------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
-               timeout_ms=None, request_id=None, on_token=None):
+               timeout_ms=None, request_id=None, on_token=None,
+               tenant=None):
         """Enqueue one generation request; returns its
         ``concurrent.futures.Future``.  Validation is all up-front and
         fast: static shape limits raise ``DecodeError``, an impossible
         page reservation raises ``PagePoolExhausted``, a full waiting
         queue rejects with ``ServerOverloaded``, a quarantined prefill
         bucket with ``BucketQuarantined`` — a request that enqueues can
-        always be admitted once capacity frees."""
+        always be admitted once capacity frees.  ``tenant`` bills the
+        request to a registered tenant (mx.tenant): its quota gates
+        here (``TenantQuotaExceeded`` -> per-tenant 503), its WFQ
+        weight orders admission, its adapter applies in-program."""
         cfg = self.config
         prompt = [int(t) for t in (prompt or ())]
         if not prompt:
@@ -924,17 +1022,55 @@ class DecodeScheduler:
                 telemetry.SERVE_REQUESTS.labels(
                     result="quarantined").inc()
             raise self._breakers.quarantine_error(("prefill", t_bucket))
+        plane = self._tenant
+        if tenant is not None:
+            if plane is None:
+                raise DecodeError(
+                    "request names tenant %r but this server has no "
+                    "tenant plane (build with tenant=TenantPlane())"
+                    % (tenant,))
+            # a quarantined (NaN'ing) adapter fast-rejects ITS tenant's
+            # submissions while the half-open probe cools — batch-mates
+            # are untouched
+            aclass = ("adapter", str(tenant))
+            if self._breakers is not None and \
+                    self._breakers.blocked(aclass):
+                if telemetry.ENABLED:
+                    telemetry.SERVE_REQUESTS.labels(
+                        result="quarantined").inc()
+                    telemetry.TENANT_REQUESTS.labels(
+                        tenant=str(tenant), result="quarantined").inc()
+                raise self._breakers.quarantine_error(aclass)
+            from ..tenant.quota import TenantQuotaExceeded
+            from ..tenant.registry import UnknownTenant
+
+            try:
+                plane.check_submit(tenant, need)
+            except UnknownTenant as exc:
+                raise DecodeError(str(exc))
+            except TenantQuotaExceeded:
+                if telemetry.ENABLED:
+                    telemetry.SERVE_REQUESTS.labels(
+                        result="rejected").inc()
+                    telemetry.TENANT_REQUESTS.labels(
+                        tenant=str(tenant), result="rejected").inc()
+                raise
         timeout_ms = cfg.timeout_ms if timeout_ms is None else timeout_ms
         deadline = None if timeout_ms is None \
             else time.perf_counter() + float(timeout_ms) / 1e3
         req = DecodeRequest(
             prompt, mnt,
             eos_id=self._runner.eos_id if eos_id is None else eos_id,
-            deadline=deadline, request_id=request_id, on_token=on_token)
+            deadline=deadline, request_id=request_id, on_token=on_token,
+            tenant=tenant)
         with self._cond:
             if self._closed:
+                if tenant is not None:
+                    plane.note_dequeue(tenant)
                 raise ServerClosed("decode scheduler is shut down")
             if len(self._waiting) >= cfg.queue_depth:
+                if tenant is not None:
+                    plane.note_dequeue(tenant)
                 if telemetry.ENABLED:
                     telemetry.SERVE_REQUESTS.labels(
                         result="rejected").inc()
@@ -1086,7 +1222,8 @@ class DecodeScheduler:
                      if k.startswith("('decode'") or
                      k.startswith("('prefill'") or
                      k.startswith("('spec'") or
-                     k.startswith("('draft'")}
+                     k.startswith("('draft'") or
+                     k.startswith("('adapter'")}
         return {
             "alive": self.alive,
             "waiting": waiting,
@@ -1163,6 +1300,8 @@ class DecodeScheduler:
         items, self._waiting = list(self._waiting), deque()
         live, self._live = list(self._live.values()), {}
         for req in items:
+            if self._tenant is not None:
+                self._tenant.note_dequeue(req.tenant)
             fail_request(req, ServerClosed(
                 "server shut down before admission"), "cancelled")
             self._bump("cancelled")
@@ -1182,6 +1321,10 @@ class DecodeScheduler:
 
     def _release(self, seq):
         runner = self._runner
+        if seq.quota_pages is not None and self._tenant is not None:
+            # return the tenant's quota share exactly once
+            self._tenant.on_release(seq.tenant, seq.quota_pages)
+            seq.quota_pages = None
         if seq.shared:
             # drop this sequence's references on its shared prefix
             # pages BEFORE releasing the private ledger — the pages
@@ -1222,6 +1365,8 @@ class DecodeScheduler:
             keep = deque()
             for req in self._waiting:
                 if req.expired(now):
+                    if self._tenant is not None:
+                        self._tenant.note_dequeue(req.tenant)
                     fail_request(req, RequestTimeout(
                         "deadline expired after %.1f ms waiting for "
                         "admission" % ((now - req.enqueued) * 1e3)),
@@ -1297,16 +1442,25 @@ class DecodeScheduler:
         return self._runner.page_config.pages_for(total)
 
     def _admit(self):
-        """Fill free slots from the waiting queue (FIFO): reserve the
-        whole worst-case page count, prefill through the bucket path
-        (or install a handed-off prefill), emit the first token.
-        Stops at the first request the pool cannot hold yet —
-        admission order is arrival order."""
+        """Fill free slots from the waiting queue: reserve the whole
+        worst-case page count, prefill through the bucket path (or
+        install a handed-off prefill), emit the first token.  Stops at
+        the first request the pool cannot hold yet.  Admission order is
+        arrival order (FIFO) without a tenant plane; with one, the WFQ
+        picker chooses the backlogged tenant with the smallest virtual
+        finish time whose quota admits — a tenant at quota is SKIPPED,
+        never a head-of-line block."""
+        plane = self._tenant
         while len(self._live) < self.config.max_live:
             with self._cond:
                 if not self._waiting or self._pending_runner is not None:
                     return
-                req = self._waiting[0]
+                if plane is not None:
+                    req = plane.select(self._waiting, self._pages_needed)
+                    if req is None:
+                        return    # every backlogged tenant is at quota
+                else:
+                    req = self._waiting[0]
                 pool = self._runner.pool
                 cache = self._runner.cache
                 need = self._pages_needed(req)
@@ -1321,7 +1475,9 @@ class DecodeScheduler:
                     # a hot swap may have shrunk the pool since.  Fail
                     # the request rather than head-of-line-block the
                     # queue waiting for pages that can never exist
-                    self._waiting.popleft()
+                    self._waiting.remove(req)
+                    if plane is not None:
+                        plane.note_dequeue(req.tenant)
                     fail_request(req, PagePoolExhausted(
                         "request needs %d KV pages but the (swapped) "
                         "pool only has %d" % (need, pool.capacity)),
@@ -1334,7 +1490,9 @@ class DecodeScheduler:
                     if cache is None or cache.evict(need) == 0 or \
                             not pool.can_alloc(need):
                         return    # wait for evictions to free pages
-                self._waiting.popleft()
+                self._waiting.remove(req)
+                if plane is not None:
+                    plane.note_dequeue(req.tenant)
                 if telemetry.ENABLED:
                     telemetry.SERVE_DECODE_WAITING.set(len(self._waiting))
                 sid = self._next_sid
@@ -1378,6 +1536,23 @@ class DecodeScheduler:
                              "quarantined")
                 self._bump("quarantined")
                 continue
+            if req.tenant is not None and plane is not None:
+                # per-adapter breaker gate (half-open probes admit one)
+                # + the bank slot the sequence will decode with
+                seq.adapter_slot = plane.slot_for(req.tenant)
+                aclass = ("adapter", req.tenant)
+                if seq.adapter_slot >= 0 and self._breakers is not None \
+                        and not self._breakers.allow(aclass):
+                    self._release(seq)
+                    fail_request(req,
+                                 self._breakers.quarantine_error(aclass),
+                                 "quarantined")
+                    self._bump("quarantined")
+                    if telemetry.ENABLED:
+                        telemetry.TENANT_REQUESTS.labels(
+                            tenant=req.tenant,
+                            result="quarantined").inc()
+                    continue
             try:
                 own = self._pages_needed(req) - len(seq.shared)
                 seq.pages = list(seq.shared) + \
@@ -1389,6 +1564,15 @@ class DecodeScheduler:
                 fail_request(req, exc, "error")
                 self._bump("error")
                 continue
+            if plane is not None:
+                # WFQ charge + quota ledger reservation (mirrors the
+                # pool pages this sid really holds)
+                plane.admit_granted(
+                    req.tenant,
+                    plane.cost_of(len(req.prompt), req.max_new_tokens),
+                    own)
+                if req.tenant is not None:
+                    seq.quota_pages = own
             t0 = time.perf_counter()
             blabel = ("chunk:t%d" if hit_tok else "prefill:t%d") \
                 % t_bucket
@@ -1515,6 +1699,15 @@ class DecodeScheduler:
         with self._cond:
             self._live.pop(seq.sid, None)
         self._release(seq)
+        if seq.tenant is not None and seq.adapter_slot >= 0:
+            # attribute the poison to the tenant's ADAPTER: repeated
+            # trips open the ("adapter", tenant) breaker and quarantine
+            # that slot's traffic alone — batch-mates keep decoding
+            if self._breakers is not None:
+                self._breakers.failure(("adapter", seq.tenant))
+            if telemetry.ENABLED:
+                telemetry.TENANT_ADAPTER_POISON.labels(
+                    tenant=seq.tenant).inc()
         if telemetry.ENABLED:
             telemetry.SERVE_NONFINITE_OUTPUTS.inc(int(bad))
             telemetry.SERVE_NONFINITE_BATCHES.inc()
@@ -1559,8 +1752,16 @@ class DecodeScheduler:
                 telemetry.SERVE_DECODE_TTFT_SECONDS.labels(
                     cache=seq.cache_class or "miss").observe(
                     now - seq.req.enqueued)
+                if seq.tenant is not None:
+                    telemetry.TENANT_TTFT_SECONDS.labels(
+                        tenant=seq.tenant).observe(
+                        now - seq.req.enqueued)
         if telemetry.ENABLED:
             telemetry.SERVE_DECODE_TOKENS.inc()
+            if seq.tenant is not None:
+                telemetry.TENANT_TOKENS.labels(tenant=seq.tenant).inc()
+        if seq.tenant is not None and self._tenant is not None:
+            self._tenant.note_tokens(seq.tenant)
         if trace.ENABLED and seq.req.trace is not None:
             trace.record_span(
                 "serve_decode_token", t_start, now - t_start,
@@ -1581,6 +1782,11 @@ class DecodeScheduler:
         with self._cond:
             self._live.pop(seq.sid, None)
         self._release(seq)
+        if seq.tenant is not None and seq.adapter_slot >= 0 and \
+                self._breakers is not None:
+            # a healthy adapter-applied completion closes the breaker's
+            # failure window (and recovers a half-open quarantine)
+            self._breakers.success(("adapter", seq.tenant))
         self._bump("finished")
         self._record(seq, reason)
         done_t = time.perf_counter()
@@ -1591,6 +1797,9 @@ class DecodeScheduler:
             return True
         if telemetry.ENABLED:
             telemetry.SERVE_REQUESTS.labels(result="ok").inc()
+            if seq.tenant is not None:
+                telemetry.TENANT_REQUESTS.labels(
+                    tenant=seq.tenant, result="ok").inc()
             telemetry.SERVE_REQUEST_SECONDS.observe(
                 done_t - seq.req.enqueued)
         if trace.ENABLED and seq.req.trace is not None:

@@ -209,6 +209,20 @@ class PagePool:
         with self._lock:
             return {o: list(p) for o, p in self._owned.items()}
 
+    def pages_by_group(self, group_of):
+        """Live private-page counts rolled up by ``group_of(owner)``
+        (e.g. the owning tenant) — how mx.tenant audits per-tenant KV
+        residency against its quota ledger.  ``group_of`` returning
+        None buckets the owner under ``None`` (base traffic); shared
+        prefix pages are global, not attributed."""
+        out = {}
+        with self._lock:
+            items = [(o, len(p)) for o, p in self._owned.items()]
+        for owner, n in items:
+            key = group_of(owner)
+            out[key] = out.get(key, 0) + n
+        return out
+
     # -- shared segment (mx.serve.cache radix prefix cache) -----------------
     def adopt_shared(self, owner, pages, readers=1):
         """Move ``pages`` (a subset of ``owner``'s ledger) into the

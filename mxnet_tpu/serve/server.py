@@ -140,7 +140,7 @@ class Server:
 
     def __init__(self, block=None, root=None, step=None, ctx=None,
                  config=None, runner=None, decode=None,
-                 decode_config=None):
+                 decode_config=None, tenant=None):
         from .decode import DecodeRunner, DecodeScheduler
 
         self._config = config or ServeConfig()
@@ -169,14 +169,19 @@ class Server:
         # -- decode plane (serve/decode.py) ---------------------------------
         if decode is not None and not isinstance(decode, DecodeRunner):
             decode = DecodeRunner(decode, root=root, step=step, ctx=ctx,
-                                  config=decode_config)
+                                  config=decode_config, tenant=tenant)
         elif decode_config is not None and decode is not None:
             raise ValueError(
                 "decode_config= only applies when decode= is a raw "
                 "decoder block; a pre-built DecodeRunner already "
                 "carries its own config — pass it there instead of "
                 "having this one silently ignored")
-        self._decode = DecodeScheduler(decode, breakers=self._breakers) \
+        # mx.tenant plane: explicit tenant= wins, else a pre-built
+        # DecodeRunner's own plane (built with tenant=) carries through
+        self._tenant = tenant if tenant is not None else \
+            getattr(decode, "tenant", None)
+        self._decode = DecodeScheduler(decode, breakers=self._breakers,
+                                       tenant=self._tenant) \
             if decode is not None else None
         # -- micro-batch plane ----------------------------------------------
         self._queue = None
@@ -244,6 +249,12 @@ class Server:
     def decode(self):
         """The decode plane's ``DecodeScheduler`` (None without one)."""
         return self._decode
+
+    @property
+    def tenant(self):
+        """The multi-tenant plane (``tenant.TenantPlane``; None when
+        this server is single-tenant)."""
+        return self._tenant
 
     @property
     def step(self):
@@ -347,6 +358,10 @@ class Server:
                 # block digests let the router route a session to the
                 # replica already holding its prefix
                 digest["prefix_cache"] = cache.summary(roots_cap=16)
+        if self._tenant is not None:
+            # adapter-residency signal (fleet/router.py): which
+            # tenants' adapters this replica already holds resident
+            digest["tenants"] = self._tenant.residency()
         for b in self.breakers().values():
             if b["state"] == "open":
                 digest["breakers_open"] += 1
@@ -405,7 +420,15 @@ class Server:
             # {"enabled": False} when not armed) — schema v2 additions
             "cache": self._cache_stats(),
             "spec": self._spec_stats(),
+            # mx.tenant multi-tenant plane ({"enabled": False} when
+            # single-tenant) — schema v2 additive-keys addition
+            "tenants": self._tenant_stats(),
         }
+
+    def _tenant_stats(self):
+        if self._tenant is not None:
+            return self._tenant.stats()
+        return {"enabled": False}
 
     def _cache_stats(self):
         if self._decode is not None:
@@ -492,12 +515,15 @@ class Server:
 
     # -- decode plane -------------------------------------------------------
     def submit_decode(self, tokens, max_new_tokens=None, eos_id=None,
-                      timeout_ms=None, request_id=None, on_token=None):
+                      timeout_ms=None, request_id=None, on_token=None,
+                      tenant=None):
         """Enqueue one autoregressive generation request on the decode
         plane; returns a future resolving to ``{"tokens": [...],
         "finish_reason": ...}``.  ``on_token(token_id, index)`` streams
         each token as it is emitted (bit-identical to the future's
-        ``tokens``).  Raises ``ServeError`` without a decode plane."""
+        ``tokens``).  ``tenant`` bills the request to a registered
+        tenant (mx.tenant: WFQ weight, quota, adapter).  Raises
+        ``ServeError`` without a decode plane."""
         if self._closed:
             raise ServerClosed("server is shut down")
         if self._decode is None:
@@ -506,7 +532,7 @@ class Server:
         return self._decode.submit(
             tokens, max_new_tokens=max_new_tokens, eos_id=eos_id,
             timeout_ms=timeout_ms, request_id=request_id,
-            on_token=on_token)
+            on_token=on_token, tenant=tenant)
 
     def submit_decode_export(self, tokens, max_new_tokens=None,
                              eos_id=None, timeout_ms=None,
@@ -864,7 +890,8 @@ class _Handler(BaseHTTPRequestHandler):
         kwargs = dict(max_new_tokens=payload.get("max_new_tokens"),
                       eos_id=payload.get("eos_id"),
                       timeout_ms=payload.get("timeout_ms"),
-                      request_id=rid)
+                      request_id=rid,
+                      tenant=payload.get("tenant"))
         # provenance of generated tokens is the DECODE runner's
         # checkpoint step (a dual-plane server's vision runner may sit
         # at a different step)

@@ -456,11 +456,16 @@ def _bucket_quantile(cum, count, q):
     return last_finite
 
 
-def _merged_read(metric):
-    """(count, sum, merged cumulative buckets) across every label child
-    of a histogram family (all children share the family's bucket
-    edges)."""
-    reads = [c.read() for _, c in metric._samples()]
+def _merged_read(metric, match=None):
+    """(count, sum, merged cumulative buckets) across the label
+    children of a histogram family (all children share the family's
+    bucket edges).  ``match`` restricts the merge to children whose
+    labels contain it — the per-tenant SLO view reads one tenant's
+    samples out of a shared histogram."""
+    want = {k: str(v) for k, v in (match or {}).items()}
+    reads = [c.read() for values, c in metric._samples()
+             if all(_labels_dict(metric, values).get(k) == v
+                    for k, v in want.items())]
     count = sum(r[0] for r in reads)
     total = sum(r[1] for r in reads)
     cum = [(ub, sum(r[2][i][1] for r in reads))
@@ -1020,6 +1025,45 @@ SERVE_DECODE_PREFILL_TOKENS = counter(
     "prompt tokens actually run through a prefill/chunk program (the "
     "uncached suffix only; the fleet drill asserts one full prefill "
     "per shared prompt fleet-wide)")
+# mx.tenant (tenant/): multi-tenant serving — batched LoRA adapter
+# multiplexing, WFQ admission, per-tenant quotas/isolation.  The
+# tenant label is the registered tenant name; base (un-tenanted)
+# traffic never touches these families.
+TENANT_REQUESTS = counter(
+    "tenant_requests_total",
+    "tenant-attributed serving requests by outcome "
+    "(ok/rejected/timeout/error/cancelled/quarantined/poisoned)",
+    ("tenant", "result"))
+TENANT_TTFT_SECONDS = histogram(
+    "tenant_ttft_seconds",
+    "time to first token per tenant (the per-tenant SLO feed)",
+    ("tenant",))
+TENANT_TOKENS = counter(
+    "tenant_tokens_total", "tokens emitted per tenant", ("tenant",))
+TENANT_QUOTA_REJECTS = counter(
+    "tenant_quota_rejects_total",
+    "submissions rejected by a per-tenant quota, by reason "
+    "(queue / pages) — per-tenant 503s, never head-of-line blocking",
+    ("tenant", "reason"))
+TENANT_WFQ_PICKS = counter(
+    "tenant_wfq_picks_total",
+    "admissions granted by the weighted-fair-queueing picker",
+    ("tenant",))
+TENANT_ADAPTER_SWAPS = counter(
+    "tenant_adapter_swaps_total",
+    "adapter bank slot swaps (hot load/unload; compile count stays "
+    "flat — slot content is data, not program)")
+TENANT_ADAPTER_POISON = counter(
+    "tenant_adapter_poison_total",
+    "nonfinite evictions attributed to a tenant's adapter (feeds the "
+    "per-adapter breaker that quarantines ONLY that slot)",
+    ("tenant",))
+TENANT_SLOTS = gauge(
+    "tenant_adapter_slots",
+    "adapter bank capacity of the serving process")
+TENANT_ADAPTERS_RESIDENT = gauge(
+    "tenant_adapters_resident",
+    "adapter slots currently holding a loaded adapter")
 # mx.serve.spec (serve/spec.py): speculative decoding — draft-propose,
 # target-verify, greedy acceptance (bit-identical to single-step).
 SERVE_SPEC_ROUNDS = counter(
@@ -1191,6 +1235,10 @@ FLEET_AFFINITY_HITS = counter(
     "fleet_prefix_affinity_total",
     "decode dispatches routed by prefix-cache affinity (the prompt's "
     "first block was already cached on the chosen replica)")
+FLEET_ADAPTER_AFFINITY = counter(
+    "fleet_adapter_affinity_total",
+    "decode dispatches routed by tenant-adapter residency (the "
+    "tenant's adapter was already resident on the chosen replica)")
 FLEET_FAILOVERS = counter(
     "fleet_failover_total",
     "mid-request re-routes after a replica death or connection "

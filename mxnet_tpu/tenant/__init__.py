@@ -1,0 +1,47 @@
+"""mx.tenant — multi-tenant serving on one set of base weights.
+
+Thousands of tenants share ONE serving process and ONE compiled decode
+program per bucket; everything tenant-specific is *state*, never a
+recompile:
+
+- **adapters.py** — LoRA adapters as first-class serving state:
+  checkpoint-rooted load/validate/reshard, stacked into device-resident
+  ``[n_slots, ...]`` A/B banks, hot add/remove by slot swap.  The
+  decode/verify programs take a per-sequence adapter index and apply
+  ``base(x) + gather(B, idx) @ (gather(A, idx) @ x)`` inline — a mixed
+  8-adapter batch (with idx=-1 base-only rows) is one dispatch.
+- **fairsched.py** — virtual-time weighted fair queueing in front of
+  admission: per-tenant weight, deficit-style token accounting.
+- **quota.py** — per-tenant admission quotas (live sequences / KV
+  pages / queue depth) riding the existing PagePool reservation math;
+  backpressure is per-tenant 503 + Retry-After, never head-of-line
+  blocking.
+- **registry.py** — the ``TenantPlane`` facade the serve stack holds.
+
+Enable with ``MXNET_TENANT=1`` (the ``TENANT`` runtime feature) and
+pass a ``TenantPlane`` to ``mx.serve.Server`` / ``DecodeRunner`` via
+``tenant=``.  Isolation: a NaN'ing adapter quarantines only its slot
+(per-adapter breaker class), a quota-busting tenant rejects alone, and
+batch-mates' token streams are untouched either way.
+"""
+from __future__ import annotations
+
+from ..base import get_env
+from .adapters import (AdapterBank, AdapterError, AdapterSpec,
+                       default_targets, load_adapter, save_adapter)
+from .fairsched import FairQueue
+from .quota import QuotaLedger, TenantQuota, TenantQuotaExceeded
+from .registry import Tenant, TenantConfig, TenantPlane, UnknownTenant
+
+__all__ = [
+    "AdapterBank", "AdapterError", "AdapterSpec", "FairQueue",
+    "QuotaLedger", "Tenant", "TenantConfig", "TenantPlane",
+    "TenantQuota", "TenantQuotaExceeded", "UnknownTenant",
+    "default_targets", "is_enabled", "load_adapter", "save_adapter",
+]
+
+
+def is_enabled():
+    """True when the multi-tenant serving plane is switched on
+    (``MXNET_TENANT=1``)."""
+    return get_env("MXNET_TENANT", bool, False)

@@ -1,0 +1,389 @@
+"""Batched multi-adapter LoRA banks (mx.tenant).
+
+The serving discipline is the training side's weight-update-sharding
+discipline applied to tenants: keep ONE compiled program and move all
+per-tenant variation into gathered STATE.  Adapters live in
+device-resident ``[n_slots, ...]`` A/B banks that every decode /
+prefill / verify program takes as ordinary inputs next to a
+per-sequence ``adapter_idx``; inside the program each row computes
+
+    base(x) + (x @ gather(A, idx)) @ gather(B, idx) * scale[idx]
+
+with ``idx = -1`` rows (base-only traffic, empty slots) contributing
+exactly zero.  Loading, swapping or unloading an adapter changes bank
+CONTENTS, never bank shapes — so adapter churn is a device store, not
+a recompile, and ``serve_decode_compile_total`` stays flat while a
+mixed 8-tenant batch runs on the very program warm-up built.
+
+Adapters are first-class serving state: ``load_adapter`` restores an
+``mx.checkpoint`` root (restore-with-resharding onto the serving ctx)
+and validates rank / alpha / target-matrix shapes against the bank's
+base model before any slot is touched.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["AdapterError", "AdapterSpec", "AdapterBank",
+           "load_adapter", "save_adapter", "default_targets"]
+
+# adapter checkpoint tree layout: one "<target>.A" / "<target>.B" pair
+# per targeted Dense plus the scalar metadata leaves below
+_META_ALPHA = "lora.alpha"
+_META_RANK = "lora.rank"
+
+
+class AdapterError(MXNetError):
+    """Adapter validation / bank management error."""
+
+
+def default_targets(block):
+    """The conventional LoRA target set for a decode-contract block:
+    every per-layer q/v projection (attention-only, the LoRA paper's
+    default)."""
+    out = []
+    for layer in range(int(block.num_layers)):
+        for name in ("q", "v"):
+            attr = "%s%d" % (name, layer)
+            if getattr(block, attr, None) is not None:
+                out.append(attr)
+    if not out:
+        raise AdapterError(
+            "default_targets: block %s exposes no q%%d/v%%d Dense "
+            "children; pass targets= explicitly"
+            % type(block).__name__)
+    return out
+
+
+class AdapterSpec:
+    """One validated adapter: ``targets`` maps a Dense child name to
+    its ``(A [in, r], B [r, out])`` float32 pair; ``scale`` is the
+    standard ``alpha / rank``."""
+
+    __slots__ = ("name", "rank", "alpha", "targets")
+
+    def __init__(self, name, rank, alpha, targets):
+        self.name = str(name)
+        self.rank = int(rank)
+        self.alpha = float(alpha)
+        self.targets = {}
+        if self.rank < 1:
+            raise AdapterError("adapter %r: rank must be >= 1 (got %d)"
+                               % (name, self.rank))
+        if not targets:
+            raise AdapterError("adapter %r targets no matrices" % name)
+        for tname, (a, b) in targets.items():
+            a = _np.asarray(a, dtype=_np.float32)
+            b = _np.asarray(b, dtype=_np.float32)
+            if a.ndim != 2 or b.ndim != 2:
+                raise AdapterError(
+                    "adapter %r target %r: A/B must be 2-D (got %s/%s)"
+                    % (name, tname, a.shape, b.shape))
+            if a.shape[1] != self.rank or b.shape[0] != self.rank:
+                raise AdapterError(
+                    "adapter %r target %r: rank mismatch — A %s / B %s "
+                    "vs declared rank %d"
+                    % (name, tname, a.shape, b.shape, self.rank))
+            self.targets[str(tname)] = (a, b)
+
+    @property
+    def scale(self):
+        return self.alpha / float(self.rank)
+
+
+def save_adapter(root, spec, step=0):
+    """Persist ``spec`` as a sharded ``mx.checkpoint`` step under
+    ``root`` (manifest + checksums + COMMITTED marker): the adapter
+    contract is the checkpoint contract."""
+    from ..checkpoint import CheckpointManager
+
+    tree = {_META_ALPHA: _np.float32(spec.alpha),
+            _META_RANK: _np.int32(spec.rank)}
+    for tname, (a, b) in spec.targets.items():
+        tree[tname + ".A"] = a
+        tree[tname + ".B"] = b
+    return CheckpointManager(root).save(int(step), tree)
+
+
+def load_adapter(root, name=None, step=None, ctx=None):
+    """Restore an adapter from an ``mx.checkpoint`` root (default:
+    latest committed step) onto the serving ctx and return the
+    validated ``AdapterSpec``."""
+    from ..checkpoint import CheckpointManager
+
+    step, tree = CheckpointManager(root).restore(step=step, ctx=ctx)
+    if _META_ALPHA not in tree or _META_RANK not in tree:
+        raise AdapterError(
+            "checkpoint %s step %s is not an adapter root: missing "
+            "%s/%s metadata leaves" % (root, step, _META_ALPHA,
+                                       _META_RANK))
+    alpha = float(_np.asarray(tree[_META_ALPHA]))
+    rank = int(_np.asarray(tree[_META_RANK]))
+    targets = {}
+    for key, val in tree.items():
+        if key.endswith(".A"):
+            tname = key[:-2]
+            bkey = tname + ".B"
+            if bkey not in tree:
+                raise AdapterError(
+                    "adapter root %s: %s has no matching %s"
+                    % (root, key, bkey))
+            targets[tname] = (_np.asarray(val), _np.asarray(tree[bkey]))
+    return AdapterSpec(name if name is not None else str(root),
+                       rank, alpha, targets)
+
+
+# ---------------------------------------------------------------------------
+# trace-time application context
+# ---------------------------------------------------------------------------
+# The decode step functions enter ``applying`` with the program's
+# adapter-index / bank-array TRACERS before calling the exported pure
+# model function; the instrumented Dense forwards read them here.  A
+# thread-local because tracing may happen on the decode loop and a
+# warm-up thread of different runners at once.
+_ACTIVE = threading.local()
+
+
+def _active():
+    return getattr(_ACTIVE, "ctx", None)
+
+
+class AdapterBank:
+    """Device-resident stacked LoRA banks for one base block.
+
+    Built BEFORE ``DecodeRunner.warm_up`` so every program compiles
+    with the bank inputs in its signature; slot loads/swaps afterwards
+    are pure data updates (``.at[slot].set``) under the same avals —
+    shape-stable by construction, zero recompiles."""
+
+    def __init__(self, block, n_slots, max_rank, targets=None):
+        import jax.numpy as jnp
+
+        self.n_slots = int(n_slots)
+        self.max_rank = int(max_rank)
+        if self.n_slots < 1:
+            raise AdapterError("AdapterBank needs n_slots >= 1")
+        if self.max_rank < 1:
+            raise AdapterError("AdapterBank needs max_rank >= 1")
+        self._block = block
+        self.targets = list(targets) if targets is not None \
+            else default_targets(block)
+        self._dims = {}           # name -> (in_units, out_units)
+        self._denses = {}
+        for tname in self.targets:
+            dense = getattr(block, tname, None)
+            w = getattr(dense, "weight", None)
+            if w is None or not w.shape or len(w.shape) != 2:
+                raise AdapterError(
+                    "bank target %r is not a resolved Dense child of "
+                    "%s (run one forward first)"
+                    % (tname, type(block).__name__))
+            units, in_units = w.shape           # Dense layout (out, in)
+            self._dims[tname] = (int(in_units), int(units))
+            self._denses[tname] = dense
+        # slot-content state (the only mutable serving state):
+        self.a = {t: jnp.zeros((self.n_slots, d[0], self.max_rank),
+                               dtype=jnp.float32)
+                  for t, d in self._dims.items()}
+        self.b = {t: jnp.zeros((self.n_slots, self.max_rank, d[1]),
+                               dtype=jnp.float32)
+                  for t, d in self._dims.items()}
+        self.scales = jnp.zeros((self.n_slots,), dtype=jnp.float32)
+        self.slots = [None] * self.n_slots    # slot -> adapter name
+        self.swaps = 0
+        self._lock = threading.Lock()
+        self._instrument()
+
+    # -- program-facing surface ---------------------------------------------
+    def flat_arrays(self):
+        """The bank as a flat input tuple in deterministic order:
+        ``(scales, A_t0..A_tn, B_t0..B_tn)`` — what every dispatch
+        appends after ``adapter_idx``."""
+        return (self.scales,) + \
+            tuple(self.a[t] for t in self.targets) + \
+            tuple(self.b[t] for t in self.targets)
+
+    def avals(self):
+        import jax
+
+        out = [jax.ShapeDtypeStruct((self.n_slots,),
+                                    _np.dtype("float32"))]
+        for t in self.targets:
+            d = self._dims[t]
+            out.append(jax.ShapeDtypeStruct(
+                (self.n_slots, d[0], self.max_rank),
+                _np.dtype("float32")))
+        for t in self.targets:
+            d = self._dims[t]
+            out.append(jax.ShapeDtypeStruct(
+                (self.n_slots, self.max_rank, d[1]),
+                _np.dtype("float32")))
+        return out
+
+    def null_index(self, batch):
+        return _np.full((batch,), -1, dtype=_np.int32)
+
+    @contextlib.contextmanager
+    def applying(self, idx, flat):
+        """Bind the (traced) adapter-index + flat bank inputs for the
+        instrumented Dense forwards; active only while the step
+        function body traces the model."""
+        n = len(self.targets)
+        scales = flat[0]
+        banks = {}
+        for i, t in enumerate(self.targets):
+            banks[t] = (flat[1 + i], flat[1 + n + i])
+        _ACTIVE.ctx = (idx, scales, banks)
+        try:
+            yield
+        finally:
+            _ACTIVE.ctx = None
+
+    def _instrument(self):
+        """Wrap each targeted Dense instance's forward: outside an
+        ``applying`` context (plain training/eval calls,
+        ``_resolve_params``) the wrapper is a passthrough."""
+        for tname, dense in self._denses.items():
+            orig = dense.forward
+
+            def wrapped(x, _orig=orig, _name=tname):
+                y = _orig(x)
+                ctx = _active()
+                if ctx is None:
+                    return y
+                idx, scales, banks = ctx
+                ab = banks.get(_name)
+                if ab is None:
+                    return y
+                import jax.numpy as jnp
+
+                a_bank, b_bank = ab
+                i = jnp.clip(idx, 0, a_bank.shape[0] - 1)
+                a = jnp.take(a_bank, i, axis=0)      # [B, in, r]
+                b = jnp.take(b_bank, i, axis=0)      # [B, r, out]
+                s = jnp.take(scales, i, axis=0)      # [B]
+                xd = x._data                          # [B, T, in]
+                d = jnp.einsum("btc,bcr->btr", xd, a)
+                d = jnp.einsum("btr,bro->bto", d, b)
+                d = d * s[:, None, None]
+                d = jnp.where((idx >= 0)[:, None, None], d, 0.0)
+                return y + type(x)(d.astype(xd.dtype))
+
+            dense.forward = wrapped
+
+    # -- slot management -----------------------------------------------------
+    def _validate(self, spec):
+        if spec.rank > self.max_rank:
+            raise AdapterError(
+                "adapter %r rank %d exceeds the bank's max_rank %d"
+                % (spec.name, spec.rank, self.max_rank))
+        extra = set(spec.targets) - set(self.targets)
+        if extra:
+            raise AdapterError(
+                "adapter %r targets %s are not bank targets %s"
+                % (spec.name, sorted(extra), self.targets))
+        for tname, (a, b) in spec.targets.items():
+            want = self._dims[tname]
+            if a.shape[0] != want[0] or b.shape[1] != want[1]:
+                raise AdapterError(
+                    "adapter %r target %r: A %s / B %s do not match "
+                    "the base weight (in=%d, out=%d)"
+                    % (spec.name, tname, a.shape, b.shape,
+                       want[0], want[1]))
+
+    def load(self, slot, spec):
+        """Install ``spec`` into ``slot`` (hot: a running batch keeps
+        decoding — in-flight dispatches saw the previous contents,
+        the next dispatch sees these).  Returns the slot index."""
+        import jax.numpy as jnp
+
+        slot = int(slot)
+        if not 0 <= slot < self.n_slots:
+            raise AdapterError("slot %d out of range [0, %d)"
+                               % (slot, self.n_slots))
+        self._validate(spec)
+        with self._lock:
+            for tname in self.targets:
+                d = self._dims[tname]
+                a_pad = _np.zeros((d[0], self.max_rank),
+                                  dtype=_np.float32)
+                b_pad = _np.zeros((self.max_rank, d[1]),
+                                  dtype=_np.float32)
+                pair = spec.targets.get(tname)
+                if pair is not None:
+                    a_pad[:, :spec.rank] = pair[0]
+                    b_pad[:spec.rank, :] = pair[1]
+                self.a[tname] = self.a[tname].at[slot].set(
+                    jnp.asarray(a_pad))
+                self.b[tname] = self.b[tname].at[slot].set(
+                    jnp.asarray(b_pad))
+            self.scales = self.scales.at[slot].set(spec.scale)
+            self.slots[slot] = spec.name
+            self.swaps += 1
+        return slot
+
+    def unload(self, slot):
+        """Zero ``slot`` (hot remove: same shapes, no recompile)."""
+        import jax.numpy as jnp
+
+        slot = int(slot)
+        if not 0 <= slot < self.n_slots:
+            raise AdapterError("slot %d out of range [0, %d)"
+                               % (slot, self.n_slots))
+        with self._lock:
+            for tname in self.targets:
+                d = self._dims[tname]
+                self.a[tname] = self.a[tname].at[slot].set(
+                    jnp.zeros((d[0], self.max_rank), dtype=jnp.float32))
+                self.b[tname] = self.b[tname].at[slot].set(
+                    jnp.zeros((self.max_rank, d[1]), dtype=jnp.float32))
+            self.scales = self.scales.at[slot].set(0.0)
+            self.slots[slot] = None
+            self.swaps += 1
+
+    def slot_of(self, name):
+        """The slot holding adapter ``name`` (-1 when not resident)."""
+        try:
+            return self.slots.index(name)
+        except ValueError:
+            return -1
+
+    def free_slot(self):
+        try:
+            return self.slots.index(None)
+        except ValueError:
+            return -1
+
+    # -- reference / introspection ------------------------------------------
+    @staticmethod
+    def merge_into(block, spec):
+        """Dense-merge ``spec`` into ``block``'s weights in place
+        (``W += scale * (A @ B).T``): the per-tenant merged-weights
+        REFERENCE the batched gather path is parity-tested against."""
+        from .. import ndarray as nd
+
+        for tname, (a, b) in spec.targets.items():
+            dense = getattr(block, tname, None)
+            w = getattr(dense, "weight", None)
+            if w is None:
+                raise AdapterError(
+                    "merge_into: block has no Dense child %r" % tname)
+            delta = (spec.scale * (a @ b)).T.astype(_np.float32)
+            w.set_data(w.data() + nd.array(delta))
+        return block
+
+    def stats(self):
+        with self._lock:
+            return {
+                "n_slots": self.n_slots,
+                "max_rank": self.max_rank,
+                "targets": list(self.targets),
+                "slots": list(self.slots),
+                "resident": sum(1 for s in self.slots if s is not None),
+                "swaps": self.swaps,
+            }

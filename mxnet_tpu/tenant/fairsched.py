@@ -1,0 +1,89 @@
+"""Virtual-time weighted fair queueing over tenants (mx.tenant).
+
+The admission queue stays ONE physical deque (serve/decode.py owns
+it); this module only decides WHICH waiting request is admitted next.
+Classic WFQ virtual-time accounting, deficit-style, over token cost:
+
+- every tenant carries a virtual finish time ``vtime``;
+- admitting a request charges ``cost / weight`` where ``cost`` is the
+  request's token footprint (prompt + max_new_tokens — the same
+  worst case the page reservation uses), so a weight-2 tenant drains
+  twice the tokens of a weight-1 tenant under contention;
+- the picker takes the BACKLOGGED tenant with the smallest vtime whose
+  quota admits one more sequence, skipping (never waiting on) tenants
+  at quota — per-tenant backpressure cannot head-of-line block;
+- an idle tenant's vtime is clamped forward to the global virtual
+  clock on its next arrival, so sleeping never banks unbounded credit
+  (the standard WFQ anti-starvation clamp).
+
+Pure bookkeeping, no locks: the decode loop (single writer) calls
+``pick``; ``observe_arrival`` runs under the scheduler's condition
+lock like the deque append it accompanies.
+"""
+from __future__ import annotations
+
+__all__ = ["FairQueue"]
+
+
+class FairQueue:
+    def __init__(self):
+        self._vtime = {}          # tenant -> virtual finish time
+        self._clock = 0.0         # global virtual clock (max admitted)
+        self.picks = {}           # tenant -> admissions granted
+        self.charged = {}         # tenant -> virtual cost charged
+
+    # -- accounting ---------------------------------------------------------
+    def observe_arrival(self, tenant):
+        """First sight of a backlogged tenant (or return from idle):
+        clamp its vtime forward to the clock so idle time is not
+        credit."""
+        v = self._vtime.get(tenant, 0.0)
+        if v < self._clock:
+            self._vtime[tenant] = self._clock
+
+    def charge(self, tenant, cost, weight):
+        """Admit-side charge: advance the tenant's virtual finish time
+        by ``cost / weight`` and the global clock to its (pre-charge)
+        vtime."""
+        w = max(1e-9, float(weight))
+        v = max(self._vtime.get(tenant, 0.0), self._clock)
+        self._clock = v
+        self._vtime[tenant] = v + float(cost) / w
+        self.picks[tenant] = self.picks.get(tenant, 0) + 1
+        self.charged[tenant] = self.charged.get(tenant, 0.0) \
+            + float(cost) / w
+
+    # -- selection ----------------------------------------------------------
+    def pick(self, waiting, tenant_of, admit_ok):
+        """The next request to admit from ``waiting`` (an ordered
+        iterable), or None when nothing is admissible.
+
+        ``tenant_of(req)`` maps a request to its tenant key (None =
+        the base/anonymous tenant); ``admit_ok(tenant, req)`` is the
+        quota gate.  Selection: per-tenant order stays FIFO (a
+        tenant's own earlier request always beats its later one);
+        across tenants the smallest virtual finish time wins, ties
+        broken by arrival order."""
+        heads = {}                # tenant -> (pos, req), earliest only
+        for pos, req in enumerate(waiting):
+            t = tenant_of(req)
+            if t not in heads:
+                heads[t] = (pos, req)
+        best = None
+        for t, (pos, req) in heads.items():
+            if not admit_ok(t, req):
+                continue
+            key = (max(self._vtime.get(t, 0.0), self._clock), pos)
+            if best is None or key < best[0]:
+                best = (key, t, req)
+        return None if best is None else (best[1], best[2])
+
+    # -- introspection ------------------------------------------------------
+    def snapshot(self):
+        return {
+            "clock": round(self._clock, 3),
+            "vtime": {t: round(v, 3) for t, v in self._vtime.items()},
+            "picks": dict(self.picks),
+            "charged": {t: round(c, 3)
+                        for t, c in self.charged.items()},
+        }

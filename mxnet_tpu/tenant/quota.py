@@ -1,0 +1,128 @@
+"""Per-tenant admission quotas (mx.tenant).
+
+Quotas ride the SAME reservation math admission already runs
+(``PageConfig.pages_for`` worst case, serve/kvcache.py): a tenant's
+ledger counts the live sequences and the KV pages those sequences have
+reserved, and the WFQ picker (fairsched.py) simply skips a tenant at
+quota instead of admitting — so a quota-busting tenant queues/rejects
+ALONE and never head-of-line-blocks its neighbours.
+
+Backpressure surfaces as ``TenantQuotaExceeded``, a subclass of
+``ServerOverloaded``: the HTTP front-end's existing error ladder maps
+it to 503 + ``Retry-After`` with no new handler.
+"""
+from __future__ import annotations
+
+from ..serve.batching import ServerOverloaded
+
+__all__ = ["TenantQuota", "QuotaLedger", "TenantQuotaExceeded"]
+
+
+class TenantQuotaExceeded(ServerOverloaded):
+    """One tenant's quota (queue depth / live sequences / KV pages) is
+    exhausted.  A server state for THAT tenant only — other tenants'
+    traffic is unaffected (HTTP surface: 503 + ``Retry-After``)."""
+
+    def __init__(self, msg, tenant=None, reason=None):
+        super().__init__(msg)
+        self.tenant = tenant
+        self.reason = reason
+
+
+class TenantQuota:
+    """Static per-tenant admission limits.
+
+    max_live : concurrent live (decoding) sequences; 0 = unlimited.
+    max_pages : KV pool pages the tenant's live sequences may hold
+        reserved at once (worst-case reservation, the PR 12 math);
+        0 = unlimited.
+    queue_depth : admission-waiting sequences; beyond it submissions
+        reject with ``TenantQuotaExceeded`` (never queue-block).
+    """
+
+    __slots__ = ("max_live", "max_pages", "queue_depth")
+
+    def __init__(self, max_live=0, max_pages=0, queue_depth=16):
+        self.max_live = max(0, int(max_live))
+        self.max_pages = max(0, int(max_pages))
+        self.queue_depth = max(1, int(queue_depth))
+
+    def as_dict(self):
+        return {"max_live": self.max_live, "max_pages": self.max_pages,
+                "queue_depth": self.queue_depth}
+
+
+class QuotaLedger:
+    """Live-usage ledger, one row per tenant.
+
+    The decode loop is the single writer (reserve on admission,
+    release on eviction/finish); ``waiting`` is charged at submit time
+    under the scheduler's condition lock.  All checks are advisory
+    reads the loop re-validates — the ledger never allocates pages
+    itself, it mirrors the reservations the PagePool really made."""
+
+    def __init__(self):
+        self._rows = {}     # tenant -> {"live", "pages", "waiting"}
+
+    def _row(self, tenant):
+        row = self._rows.get(tenant)
+        if row is None:
+            row = {"live": 0, "pages": 0, "waiting": 0}
+            self._rows[tenant] = row
+        return row
+
+    # -- submit-time (queue share) ------------------------------------------
+    def check_queue(self, tenant, quota):
+        row = self._row(tenant)
+        if row["waiting"] >= quota.queue_depth:
+            raise TenantQuotaExceeded(
+                "tenant %r admission queue full (%d waiting, "
+                "queue_depth=%d)" % (tenant, row["waiting"],
+                                     quota.queue_depth),
+                tenant=tenant, reason="queue")
+
+    def check_request(self, tenant, quota, pages_needed):
+        """A single request larger than the tenant's whole page quota
+        can never be admitted — reject now, not after queueing."""
+        if quota.max_pages and pages_needed > quota.max_pages:
+            raise TenantQuotaExceeded(
+                "tenant %r request needs %d KV pages but the tenant "
+                "quota is %d" % (tenant, pages_needed, quota.max_pages),
+                tenant=tenant, reason="pages")
+
+    def enqueue(self, tenant):
+        self._row(tenant)["waiting"] += 1
+
+    def dequeue(self, tenant):
+        row = self._row(tenant)
+        row["waiting"] = max(0, row["waiting"] - 1)
+
+    # -- admission-time (live share) ----------------------------------------
+    def admissible(self, tenant, quota, pages_needed):
+        """Would admitting one more sequence keep the tenant inside
+        its live quotas?  (The WFQ picker skips inadmissible tenants —
+        their backlog waits without blocking anyone else.)"""
+        row = self._row(tenant)
+        if quota.max_live and row["live"] >= quota.max_live:
+            return False
+        if quota.max_pages and \
+                row["pages"] + pages_needed > quota.max_pages:
+            return False
+        return True
+
+    def reserve(self, tenant, pages):
+        row = self._row(tenant)
+        row["live"] += 1
+        row["pages"] += int(pages)
+
+    def release(self, tenant, pages):
+        row = self._row(tenant)
+        row["live"] = max(0, row["live"] - 1)
+        row["pages"] = max(0, row["pages"] - int(pages))
+
+    # -- introspection ------------------------------------------------------
+    def row(self, tenant):
+        return dict(self._row(tenant))
+
+    def snapshot(self):
+        return {t: dict(r) for t, r in self._rows.items()}

@@ -410,16 +410,19 @@ def test_preempt_during_failure_recovery(tmp_path):
     the long backoff, restore from the checkpoint (the failed step may
     have half-mutated memory), and only then emergency-save; with NO
     checkpoint the suspect state must not be persisted at all."""
-    import threading
 
     def run_one(root, every):
         mx.random.seed(19)
         tr = _trainer(19)
         mgr = mx.checkpoint.CheckpointManager(root)
         inject.plan("trainer_step@3")
+        # preempt exactly when the injected failure fires (the
+        # on_failure observer runs before the backoff sleep) — a
+        # wall-clock Timer here raced the step loop and flaked
         sup = Supervisor(tr, mgr, checkpoint_every=every,
-                         backoff=Backoff(base=30.0, jitter=0.0))
-        threading.Timer(0.3, lambda: preempt.request(grace=30.0)).start()
+                         backoff=Backoff(base=30.0, jitter=0.0),
+                         on_failure=lambda step, exc:
+                         preempt.request(grace=30.0))
         t0 = time.perf_counter()
         sup.run(_batches, 10)
         assert time.perf_counter() - t0 < 15.0   # never slept 30s

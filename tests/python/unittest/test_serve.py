@@ -435,7 +435,8 @@ def test_statz_schema_version_and_locked_key_set(tmp_path):
     # ADDITIVE-KEYS: within one schema_version keys may be ADDED, so
     # parsers assert required keys as a SUBSET (never the exact set);
     # renaming, removing or retyping a key bumps
-    # SERVE_STATZ_SCHEMA_VERSION.  v2 added "cache" and "spec".
+    # SERVE_STATZ_SCHEMA_VERSION.  v2 added "cache", "spec" and
+    # "tenants".
     from mxnet_tpu.serve.server import SERVE_STATZ_SCHEMA_VERSION
 
     make, blk, root = _checkpointed_model(tmp_path)
@@ -447,12 +448,13 @@ def test_statz_schema_version_and_locked_key_set(tmp_path):
             "schema_version", "ready", "healthy", "draining",
             "queue_depth", "queue_age_s", "config", "runner",
             "decode", "requests", "totals", "breakers", "health",
-            "slo", "cache", "spec",
+            "slo", "cache", "spec", "tenants",
         }
         assert required <= set(doc)
-        # a micro-batch-only server reports both planes disabled
+        # a micro-batch-only server reports the opt-in planes disabled
         assert doc["cache"] == {"enabled": False}
         assert doc["spec"] == {"enabled": False}
+        assert doc["tenants"] == {"enabled": False}
         # the HTTP face serves the same document shape
         host, port = srv.start_http()
         _, http_doc = _get("http://%s:%d/statz" % (host, port))

@@ -576,6 +576,66 @@ def cache_info(src):
               % (dp.get("in_use", "?"), dp.get("capacity", "?")))
 
 
+def tenant_info(src):
+    """Dump the multi-tenant serving plane (mx.tenant): adapter bank
+    residency, per-tenant weights / quotas / live usage, WFQ virtual
+    clock, and quota-reject counters.  ``src`` is a running server's
+    base URL (reads its /statz v2 ``tenants`` block) or a saved /statz
+    JSON document."""
+    section("Multi-tenant serving (mx.tenant)")
+    import json
+
+    if src.startswith("http://") or src.startswith("https://"):
+        import urllib.request
+
+        with urllib.request.urlopen(src.rstrip("/") + "/statz",
+                                    timeout=10) as resp:
+            doc = json.loads(resp.read())
+        print("source       : %s/statz (live)" % src.rstrip("/"))
+    else:
+        with open(src) as f:
+            doc = json.load(f)
+        print("source       : %s (saved /statz)" % src)
+    ten = doc.get("tenants") or {"enabled": False}
+    if not ten.get("enabled"):
+        print("tenant plane : disabled (DecodeRunner(tenant="
+              "TenantPlane()); arm with MXNET_TENANT=1)")
+        return
+    cfg = ten.get("config") or {}
+    bank = ten.get("bank") or {}
+    print("tenant plane : enabled, %d adapter slot(s) x max_rank %d"
+          % (cfg.get("slots", 0), cfg.get("max_rank", 0)))
+    print("  bank       : %d/%d resident, %d swap(s), targets=%s"
+          % (bank.get("resident", 0), bank.get("n_slots", 0),
+             bank.get("swaps", 0),
+             ",".join(bank.get("targets") or []) or "(none)"))
+    wfq = ten.get("wfq") or {}
+    print("  wfq clock  : %.3f  picks: %s"
+          % (wfq.get("clock", 0.0),
+             ", ".join("%s=%d" % kv
+                       for kv in sorted((wfq.get("picks") or {})
+                                        .items())) or "(none)"))
+    rejects = ten.get("rejects") or {}
+    print("  rejects    : %s"
+          % (", ".join("%s=%d" % kv for kv in sorted(rejects.items()))
+             or "(none)"))
+    tenants = ten.get("tenants") or {}
+    if not tenants:
+        print("  tenants    : (none registered)")
+    for name in sorted(tenants):
+        t = tenants[name]
+        usage = t.get("usage") or {}
+        quota = t.get("quota") or {}
+        print("  - %-12s w=%-5g adapter=%-14s live %d/%s  pages %d/%s"
+              "  waiting %d/%s  served %d tok"
+              % (name, t.get("weight", 1.0),
+                 t.get("adapter") or "(base)",
+                 usage.get("live", 0), quota.get("max_live") or "inf",
+                 usage.get("pages", 0), quota.get("max_pages") or "inf",
+                 usage.get("waiting", 0), quota.get("queue_depth", "?"),
+                 t.get("served_tokens", 0)))
+
+
 def trainer_info():
     """Audit the imperative Trainer's multi-tensor update engine by
     training a representative mixed-group model for 2 steps: group
@@ -1248,6 +1308,12 @@ def main():
                          "poison verdicts — SRC is a router URL "
                          "(reads its /statz), a membership KV root "
                          "directory, or a saved /statz JSON document")
+    ap.add_argument("--tenant", metavar="SRC",
+                    help="multi-tenant serving plane: adapter bank "
+                         "residency, per-tenant weights / quotas / "
+                         "live usage, WFQ clock, quota rejects — SRC "
+                         "is a server URL (reads its /statz) or a "
+                         "saved /statz JSON document")
     args = ap.parse_args()
     # section flags compose: --compile-cache --serve URL prints both
     # (each skips the environment dump, all honor --telemetry)
@@ -1255,7 +1321,7 @@ def main():
             args.trainer or args.step or args.trace or args.monitor or \
             args.resilience or args.autotune or args.data or \
             args.dist is not None or args.fleet or args.fleet_router \
-            or args.cache:
+            or args.cache or args.tenant:
         if args.compile_cache:
             compile_cache_info()
         if args.autotune:
@@ -1280,6 +1346,8 @@ def main():
             serve_info(args.serve)
         if args.cache:
             cache_info(args.cache)
+        if args.tenant:
+            tenant_info(args.tenant)
         if args.checkpoints:
             checkpoints_info(args.checkpoints)
         if args.trace:

@@ -1,0 +1,343 @@
+#!/usr/bin/env python
+"""mx.tenant smoke (make tenant-smoke, CPU).
+
+Three stages, each asserting an ISSUE-19 acceptance contract:
+
+1. **One program, eight adapters** — a mixed 8-adapter batch decodes
+   on the ONE program warm-up built: ``serve_decode_compile_total``
+   deltas are 0 across adapter hot-add/remove, and every tenant's
+   stream completes.
+
+2. **Parity + fairness** — adapter-applied output is bit-identical to
+   the dense-merged per-tenant reference (base rows in the same batch
+   match the unmerged model); WFQ admission order honours weights and
+   the virtual-clock charge ratios match exactly.
+
+3. **Isolation drill** — a NaN'ing adapter and a quota-busting tenant
+   each degrade ONLY their own tenant: the poisoned tenant's breaker
+   opens and its batch-mates' streams stay byte-identical to an
+   undisturbed run; the quota-buster rejects per-tenant (503-shaped)
+   while its neighbour sails past the held backlog.
+
+``--bench`` appends a mixed-batch overhead measurement (the PERF_PLAN
+"8-adapter mixed batch" row): per-token decode cost with 8 resident
+adapters vs the same model base-only.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def banner(msg):
+    print("\n=== %s ===" % msg, flush=True)
+
+
+def _decoder(seed=0):
+    import mxnet_tpu as mx
+    from mxnet_tpu import serve
+
+    mx.random.seed(seed)
+    blk = serve.TinyDecoder(vocab_size=32, num_layers=2, num_heads=2,
+                            head_dim=4)
+    blk.initialize()
+    return blk
+
+
+def _config(**kw):
+    from mxnet_tpu import serve
+
+    kw.setdefault("page_size", 4)
+    kw.setdefault("pool_pages", 64)
+    kw.setdefault("max_live", 8)
+    kw.setdefault("max_new_tokens", 6)
+    kw.setdefault("max_context", 16)
+    kw.setdefault("prefill_lengths", (8,))
+    kw.setdefault("batch_sizes", (8,))
+    return serve.DecodeConfig(**kw)
+
+
+def _spec(name, rank=2, alpha=4.0, seed=0, units=8):
+    from mxnet_tpu.tenant import AdapterSpec
+
+    rs = np.random.RandomState(seed)
+    targets = {t: (rs.randn(units, rank).astype(np.float32) * 0.5,
+                   rs.randn(rank, units).astype(np.float32) * 0.5)
+               for t in ("q0", "v0", "q1", "v1")}
+    return AdapterSpec(name, rank, alpha, targets)
+
+
+def _plane(slots=8):
+    from mxnet_tpu.tenant import TenantConfig, TenantPlane
+
+    return TenantPlane(TenantConfig(slots=slots, max_rank=4))
+
+
+# ---------------------------------------------------------------------------
+# stage 1: one program, eight adapters, zero recompiles across churn
+# ---------------------------------------------------------------------------
+
+def stage_bank():
+    banner("stage 1: mixed 8-adapter batch on ONE program, hot swap")
+    from mxnet_tpu import serve, telemetry
+
+    plane = _plane()
+    runner = serve.DecodeRunner(_decoder(), tenant=plane,
+                                config=_config())
+    table = sorted(runner.provenance())
+    assert table == ["decode:b8", "prefill:t8"], table
+    names = ["tenant%d" % i for i in range(8)]
+    for i, name in enumerate(names):
+        plane.register(name)
+        plane.load_adapter(name, spec=_spec("a-%s" % name, seed=i))
+    compiles0 = telemetry.value("serve_decode_compile_total")
+    sched = serve.DecodeScheduler(runner)
+    try:
+        futs = [sched.submit([1 + i, 2], max_new_tokens=6, tenant=n)
+                for i, n in enumerate(names)]
+        outs = [f.result(timeout=120) for f in futs]
+        assert all(len(o["tokens"]) == 6 for o in outs)
+        plane.unload_adapter("tenant0")                 # hot remove
+        plane.load_adapter("tenant0",                   # hot add
+                           spec=_spec("a-tenant0-v2", seed=42))
+        futs = [sched.submit([3, 4], max_new_tokens=6,
+                             tenant="tenant0"),
+                sched.submit([5, 6], max_new_tokens=6)]  # base row
+        for f in futs:
+            assert len(f.result(timeout=120)["tokens"]) == 6
+    finally:
+        sched.stop()
+    delta = telemetry.value("serve_decode_compile_total") - compiles0
+    assert delta == 0, "adapter churn compiled %d programs" % delta
+    assert runner.pool.in_use == 0
+    runner.pool.check()
+    st = plane.bank.stats()
+    print("8 tenants + base on %s: compile delta=0 across hot "
+          "add/remove (bank swaps=%d, resident=%d)"
+          % (table[0], st["swaps"], st["resident"]))
+    return runner, plane
+
+
+# ---------------------------------------------------------------------------
+# stage 2: dense-merged parity + WFQ fairness
+# ---------------------------------------------------------------------------
+
+def stage_parity_fairness():
+    banner("stage 2: dense-merged parity + WFQ weights")
+    from mxnet_tpu import serve
+    from mxnet_tpu.tenant import AdapterBank
+
+    spec = _spec("acme-a", rank=4, alpha=8.0, seed=11)
+    prompt = [1, 2, 3]
+
+    def run(runner, tenant=None):
+        sched = serve.DecodeScheduler(runner)
+        try:
+            return sched.submit(prompt, max_new_tokens=6,
+                                tenant=tenant).result(120)["tokens"]
+        finally:
+            sched.stop()
+
+    plane = _plane(slots=4)
+    plane.register("acme")
+    tr = serve.DecodeRunner(_decoder(seed=7), tenant=plane,
+                            config=_config(max_live=2,
+                                           batch_sizes=(2,)))
+    plane.load_adapter("acme", spec=spec)
+    got = run(tr, tenant="acme")
+    base = run(tr)
+    merged = AdapterBank.merge_into(_decoder(seed=7), spec)
+    ref = run(serve.DecodeRunner(merged, config=_config(
+        max_live=2, batch_sizes=(2,))))
+    plain = run(serve.DecodeRunner(_decoder(seed=7), config=_config(
+        max_live=2, batch_sizes=(2,))))
+    assert got == ref, (got, ref)
+    assert base == plain, (base, plain)
+    assert got != plain, "adapter changed nothing; parity is vacuous"
+    print("gathered-LoRA == dense-merged: %s (base row == unmerged)"
+          % got)
+
+    plane = _plane(slots=2)
+    plane.register("small", weight=1.0)
+    plane.register("big", weight=3.0)
+    runner = serve.DecodeRunner(_decoder(), tenant=plane,
+                                config=_config(max_live=1,
+                                               batch_sizes=(1,)))
+    sched = serve.DecodeScheduler(runner, start=False)
+    try:
+        futs = [sched.submit([1, 2], max_new_tokens=2, tenant=tn)
+                for tn in ("small", "small", "small",
+                           "big", "big", "big")]
+        sched.start()       # the whole backlog is WFQ-ordered at once
+        for f in futs:
+            f.result(timeout=120)
+    finally:
+        sched.stop()
+    snap = plane.fair.snapshot()
+    assert snap["picks"] == {"small": 3, "big": 3}, snap
+    ratio = snap["charged"]["small"] / snap["charged"]["big"]
+    assert abs(ratio - 3.0) < 1e-6, snap
+    print("WFQ: equal token cost, 3x weight -> 1/3 the virtual "
+          "charge (ratio %.3f); vtime %s" % (ratio, snap["vtime"]))
+
+
+# ---------------------------------------------------------------------------
+# stage 3: isolation drill (poisoned adapter + quota buster)
+# ---------------------------------------------------------------------------
+
+def stage_isolation():
+    banner("stage 3: poisoned adapter + quota buster isolation")
+    from mxnet_tpu import serve, telemetry
+    from mxnet_tpu.serve.breaker import BreakerBoard
+    from mxnet_tpu.tenant import TenantQuotaExceeded
+
+    good_spec = _spec("good-a", seed=21)
+    prompt = [1, 2]
+
+    def build(with_evil):
+        plane = _plane(slots=4)
+        plane.register("good")
+        runner = serve.DecodeRunner(_decoder(seed=13), tenant=plane,
+                                    config=_config(max_live=2,
+                                                   batch_sizes=(2,)))
+        plane.load_adapter("good", spec=good_spec)
+        if with_evil:
+            bad = _spec("evil-a", seed=22)
+            for t in bad.targets:
+                bad.targets[t][0][0, 0] = np.nan
+            plane.register("evil")
+            plane.load_adapter("evil", spec=bad)
+        return plane, runner
+
+    _p, runner = build(False)
+    sched = serve.DecodeScheduler(runner)
+    try:
+        ref = sched.submit(prompt, max_new_tokens=6,
+                           tenant="good").result(120)["tokens"]
+    finally:
+        sched.stop()
+
+    plane, runner = build(True)
+    board = BreakerBoard(threshold=1, cooldown=60.0)
+    sched = serve.DecodeScheduler(runner, breakers=board, start=False)
+    try:
+        evil = sched.submit(prompt, max_new_tokens=6, tenant="evil")
+        good = sched.submit(prompt, max_new_tokens=6, tenant="good")
+        sched.start()
+        try:
+            evil.result(timeout=120)
+            raise AssertionError("poisoned adapter decoded fine?")
+        except serve.DecodeError:
+            pass
+        assert good.result(timeout=120)["tokens"] == ref
+        try:
+            sched.submit(prompt, max_new_tokens=6, tenant="evil")
+            raise AssertionError("open adapter breaker admitted evil")
+        except serve.BucketQuarantined:
+            pass
+        again = sched.submit(prompt, max_new_tokens=6,
+                             tenant="good").result(120)["tokens"]
+        assert again == ref
+    finally:
+        sched.stop()
+    assert runner.pool.in_use == 0
+    runner.pool.check()
+    poisons = telemetry.value("tenant_adapter_poison_total",
+                              labels={"tenant": "evil"})
+    assert poisons >= 1
+    print("NaN adapter quarantined alone (poison=%d); batch-mate "
+          "stream byte-identical: %s" % (poisons, ref))
+
+    plane = _plane(slots=2)
+    plane.register("buster", quota={"max_live": 1, "queue_depth": 2})
+    plane.register("calm")
+    runner = serve.DecodeRunner(_decoder(), tenant=plane,
+                                config=_config(max_live=2,
+                                               batch_sizes=(1, 2)))
+    sched = serve.DecodeScheduler(runner, start=False)
+    order = []
+    try:
+        for name, tn in (("b1", "buster"), ("b2", "buster"),
+                         ("c1", "calm")):
+            f = sched.submit([1, 2], max_new_tokens=6, tenant=tn)
+            f.add_done_callback(lambda _f, n=name: order.append(n))
+        try:
+            sched.submit([1, 2], max_new_tokens=6, tenant="buster")
+            raise AssertionError("over-quota submit was accepted")
+        except TenantQuotaExceeded as exc:
+            assert exc.reason == "queue" and exc.tenant == "buster"
+        sched.start()
+        deadline = time.time() + 120
+        while len(order) < 3 and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        sched.stop()
+    assert order.index("c1") < order.index("b2"), order
+    print("quota buster rejected per-tenant (503-shaped) and held its "
+          "backlog without blocking its neighbour: order=%s" % order)
+
+
+# ---------------------------------------------------------------------------
+# --bench: mixed-batch overhead (PERF_PLAN row)
+# ---------------------------------------------------------------------------
+
+def bench():
+    banner("bench: 8-adapter mixed batch vs base-only")
+    from mxnet_tpu import serve
+
+    def run_batch(runner, tenants, rounds=3):
+        best = None
+        for _ in range(rounds):
+            sched = serve.DecodeScheduler(runner, start=False)
+            futs = [sched.submit([1 + i, 2], max_new_tokens=6,
+                                 tenant=t)
+                    for i, t in enumerate(tenants)]
+            t0 = time.perf_counter()
+            sched.start()
+            toks = sum(len(f.result(timeout=120)["tokens"])
+                       for f in futs)
+            dt = time.perf_counter() - t0
+            sched.stop()
+            rate = toks / dt
+            best = rate if best is None else max(best, rate)
+        return best
+
+    plane = _plane()
+    runner = serve.DecodeRunner(_decoder(), tenant=plane,
+                                config=_config())
+    names = ["tenant%d" % i for i in range(8)]
+    for i, n in enumerate(names):
+        plane.register(n)
+        plane.load_adapter(n, spec=_spec("a-%s" % n, seed=i))
+    mixed = run_batch(runner, names)
+    base = run_batch(runner, [None] * 8)
+    print("mixed 8-adapter batch: %.1f tok/s | base-only batch on the "
+          "same bank program: %.1f tok/s | overhead %.1f%%"
+          % (mixed, base, (base / mixed - 1.0) * 100.0))
+
+
+def main(argv):
+    from mxnet_tpu import telemetry
+
+    telemetry.enable()
+    telemetry.reset()
+    t0 = time.monotonic()
+    stage_bank()
+    stage_parity_fairness()
+    stage_isolation()
+    if "--bench" in argv:
+        bench()
+    print("\ntenant-smoke OK in %.1fs" % (time.monotonic() - t0))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
