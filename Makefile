@@ -12,8 +12,8 @@ SO := build/libmxtpu_native.so
 .PHONY: native test cpptest telemetry-smoke checkpoint-smoke serve-smoke \
 	decode-smoke compile-cache-smoke trainer-smoke step-smoke \
 	trace-smoke monitor-smoke faults-smoke dist-faults-smoke \
-	zero-smoke autotune-smoke data-smoke obs-smoke fleet-smoke \
-	cache-smoke tenant-smoke smoke-all clean
+	zero-smoke shard-smoke autotune-smoke data-smoke obs-smoke \
+	fleet-smoke cache-smoke tenant-smoke smoke-all clean
 
 native: $(SO)
 
@@ -144,6 +144,20 @@ zero-smoke:
 	JAX_PLATFORMS=cpu python -m pytest \
 	  tests/python/unittest/test_shard.py -q -m 'not slow'
 
+# mx.shard phase 2 model-parallel drills (single process, 8 virtual
+# CPU devices): dp=2 x mdl=2 gather-mode captured step = ONE program
+# with 10-step bit parity vs the mdl=1 mesh reference and ~1/2 (x
+# zero3: ~1/4) per-device param residency + priced mdl all-gather;
+# mid-run stage kill fences the 1F1B pipeline step at the membership
+# envelope before any donated buffer is consumed; mdl=2 sharded
+# decode emits the byte-identical token stream with half-resident KV
+# pages and zero compiles after warm_up; then the subsystem's pytest
+# suite
+shard-smoke:
+	JAX_PLATFORMS=cpu python tools/shard_smoke.py
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/python/unittest/test_shard_mp.py -q -m 'not slow'
+
 # mx.data streaming input pipeline drills: loader-fed captured-step
 # loop with the prefetch ring armed runs within 5% of the pre-staged
 # reference (batch-wait p99 <= 5% of step, telemetry-asserted — the
@@ -254,6 +268,7 @@ SMOKES := \
 	serve-smoke \
 	obs-smoke \
 	zero-smoke \
+	shard-smoke \
 	decode-smoke \
 	tenant-smoke \
 	cache-smoke \
@@ -263,9 +278,10 @@ SMOKES := \
 	dist-faults-smoke
 # approx wall time:        telemetry ~15s, trace ~25s, compile-cache
 # ~35s, trainer ~35s, monitor ~40s, checkpoint ~45s, step ~45s,
-# autotune ~50s, serve ~60s, obs ~75s, zero ~90s, decode ~100s,
-# tenant ~100s, cache ~2min, faults ~2min, data ~3min, fleet ~3min,
-# dist-faults ~4min (multi-process drills last; total ~20min cold)
+# autotune ~50s, serve ~60s, obs ~75s, zero ~90s, shard ~90s,
+# decode ~100s, tenant ~100s, cache ~2min, faults ~2min, data ~3min,
+# fleet ~3min, dist-faults ~4min (multi-process drills last; total
+# ~21min cold)
 smoke-all:
 	@set -e; for t in $(SMOKES); do \
 	  echo "== $$t =="; \

@@ -1025,6 +1025,229 @@ def _bench_zero3_captured(batch=64, iters=10, dtype="bfloat16"):
     }
 
 
+def _bench_shard_tp(batch=64, iters=10):
+    """mx.shard phase 2 tensor-parallel rows on a dp=2 x mdl=2 mesh
+    (4 devices, virtual on the CPU drill): the gather-mode captured
+    step vs the mdl=1 captured reference at the same dp — step-time
+    delta, per-device param+state residency (the ISSUE bar:
+    < 60% of unsharded), a 3-step parity bit (gather mode must be
+    bitwise), the priced mdl all-gather wire bytes, the tp x zero
+    interaction row (ZeRO-3 composed with mdl=2 -> ~1/(dp*mdl)
+    storage), and a sharded-decode block proving the per-bucket
+    program table compiles once (serve_decode_compile_total delta 0)
+    while KV pages live head-sharded at 1/mdl."""
+    import numpy as np
+
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, serve, shard, telemetry
+    from mxnet_tpu.gluon import nn
+
+    PARITY_STEPS = 3
+    DIN, HID, DOUT = 256, 512, 64
+    devs = jax.devices()
+    if len(devs) < 4:
+        return {"error": "needs >= 4 devices for the dp=2 x mdl=2 "
+                         "mesh (have %d)" % len(devs)}
+
+    def build(mdl, zero=0, seed=0):
+        mx.random.seed(seed)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(HID, activation="relu", in_units=DIN),
+                nn.Dense(HID, activation="relu", in_units=HID),
+                nn.Dense(HID, activation="relu", in_units=HID),
+                nn.Dense(DOUT, in_units=HID))
+        net.initialize()
+        net.hybridize()
+        gm = shard.GlobalMesh(dp=2, mdl=mdl,
+                              devices=devs[:2 * mdl])
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": 1e-3},
+                                zero=zero, mesh=gm)
+        prog = trainer.capture(net, gluon.loss.L2Loss())
+        return net, trainer, prog
+
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.rand(batch, DIN).astype(np.float32))
+    y = nd.array(rs.rand(batch, DOUT).astype(np.float32))
+
+    def time_loop(prog):
+        for _ in range(WARMUP):
+            loss = prog(x, y)
+        float(loss.mean().asnumpy())
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = prog(x, y)
+        float(loss.mean().asnumpy())
+        return iters / (time.perf_counter() - t0)
+
+    def residency(net, trainer):
+        return {"params": shard.device_bytes(
+                    [p.data() for p in net.collect_params().values()]),
+                "state": shard.device_bytes(
+                    [trainer._states[i] for i in trainer._states])}
+
+    _log("shard_tp: mdl=1 captured reference")
+    net_r, tr_r, prog_r = build(1)
+    ref_sps = time_loop(prog_r)
+    bytes_r = residency(net_r, tr_r)
+
+    _log("shard_tp: mdl=2 gather-mode timing")
+    net_t, tr_t, prog_t = build(2)
+    tp_sps = time_loop(prog_t)
+    rep = prog_t.report()
+    if rep["paths"]["captured"] == 0:
+        return {"error": "tp capture degraded: %s"
+                % rep["fallbacks"][:1], "report": rep}
+    bytes_t = residency(net_t, tr_t)
+    tp_ratio = (bytes_t["params"] + bytes_t["state"]) \
+        / max(1, bytes_r["params"] + bytes_r["state"])
+
+    _log("shard_tp: parity block (%d steps)" % PARITY_STEPS)
+    net_a, _, prog_a = build(2, seed=1)
+    net_b, _, prog_b = build(1, seed=1)
+    for _ in range(PARITY_STEPS):
+        prog_a(x, y)
+        prog_b(x, y)
+    bitwise = all(
+        np.array_equal(net_a.collect_params()[k].data().asnumpy(),
+                       net_b.collect_params()[k].data().asnumpy())
+        for k in net_a.collect_params())
+
+    _log("shard_tp: zero3 x mdl=2 interaction row")
+    net_z, tr_z, prog_z = build(2, zero=3)
+    z_sps = time_loop(prog_z)
+    bytes_z = residency(net_z, tr_z)
+
+    _log("shard_tp: sharded decode block")
+    decode = {}
+    try:
+        mx.random.seed(0)
+        blk = serve.TinyDecoder(vocab_size=64, num_layers=2,
+                                num_heads=2, head_dim=8)
+        blk.initialize()
+        gm1 = shard.GlobalMesh(dp=1, mdl=2, devices=devs[:2])
+        runner = serve.DecodeRunner(
+            blk, config=serve.DecodeConfig(
+                page_size=4, pool_pages=32, max_live=2,
+                max_new_tokens=8, max_context=16,
+                prefill_lengths=(8,), batch_sizes=(1, 2)),
+            mesh=gm1)
+        runner.warm_up()
+        before = telemetry.value("serve_decode_compile_total")
+        sched = serve.DecodeScheduler(runner)
+        try:
+            futs = [sched.submit(p, max_new_tokens=8)
+                    for p in ([1, 2, 3], [4, 5], [6, 7, 8, 9])]
+            toks = [f.result(timeout=120)["tokens"] for f in futs]
+        finally:
+            sched.stop()
+        total_kv = runner.pool.k.nbytes + runner.pool.v.nbytes
+        decode = {
+            "tokens_emitted": sum(len(t) for t in toks),
+            "compile_delta_after_warmup": telemetry.value(
+                "serve_decode_compile_total") - before,
+            "kv_sharding": runner.pool.stats()["kv_sharding"],
+            "kv_device_bytes_vs_unsharded": round(
+                runner.pool.device_bytes() / max(1, total_kv), 4),
+        }
+    except Exception as exc:  # noqa: BLE001 - keep the train rows alive
+        decode = {"error": repr(exc)}
+
+    prog_row = rep["programs"][0]
+    return {
+        "steps_per_sec": round(tp_sps, 2),
+        "unsharded_steps_per_sec": round(ref_sps, 2),
+        "step_time_vs_unsharded": round(ref_sps / tp_sps, 3),
+        "batch": batch, "dp": 2, "mdl": 2,
+        "tp_mode": prog_row["tp_mode"],
+        "device_bytes": {"unsharded": bytes_r, "tp": bytes_t,
+                         "tp_zero3": bytes_z},
+        "residency_vs_unsharded": round(tp_ratio, 4),
+        "residency_bar_060": tp_ratio < 0.60,
+        "bit_parity": {"steps": PARITY_STEPS, "bitwise": bitwise},
+        "wire_bytes_per_step": prog_row["wire"],
+        "tp_x_zero3": {
+            "steps_per_sec": round(z_sps, 2),
+            "residency_vs_unsharded": round(
+                (bytes_z["params"] + bytes_z["state"])
+                / max(1, bytes_r["params"] + bytes_r["state"]), 4)},
+        "sharded_decode": decode,
+        "capture": {"paths": rep["paths"],
+                    "fallbacks": rep["fallbacks"]},
+    }
+
+
+def _bench_shard_pipeline(iters=8):
+    """mx.shard phase 2 pipeline row: 1F1B with per-stage CAPTURED
+    programs (AOT-attached, donated dead buffers) on a pp=2 mesh vs
+    the single-program FusedTrainer — step time, the schedule's
+    simulated bubble fraction vs the measured peak in-flight bound,
+    per-stage program provenance, and a loss-trajectory parity
+    check."""
+    import numpy as np
+
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, parallel
+    from mxnet_tpu.gluon import nn
+
+    if len(jax.devices()) < 2:
+        return {"error": "needs >= 2 devices for the pp=2 mesh"}
+    mesh = parallel.make_mesh({"pp": 2})
+    np.random.seed(0)
+    X = np.random.rand(32, 64).astype(np.float32)
+    Y = np.random.randint(0, 16, 32).astype(np.int32)
+
+    def net(seed):
+        mx.random.seed(seed)
+        n = nn.HybridSequential()
+        n.add(nn.Dense(128, activation="relu"),
+              nn.Dense(128, activation="relu"),
+              nn.Dense(128, activation="relu"), nn.Dense(16))
+        n.initialize()
+        return n
+
+    pipe = parallel.PipelineTrainer(
+        net(11), loss="softmax_ce", optimizer="sgd",
+        optimizer_params={"learning_rate": 0.05},
+        mesh=mesh, num_microbatches=8, schedule="1f1b")
+    ref = parallel.FusedTrainer(
+        net(11), loss="softmax_ce", optimizer="sgd",
+        optimizer_params={"learning_rate": 0.05})
+
+    def time_loop(step):
+        for _ in range(WARMUP):
+            loss = step(X, Y)
+        float(loss.asscalar())
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = step(X, Y)
+        float(loss.asscalar())
+        return iters / (time.perf_counter() - t0), float(loss.asscalar())
+
+    _log("shard_pipeline: 1f1b captured stages")
+    pipe_sps, pipe_loss = time_loop(pipe.step)
+    _log("shard_pipeline: fused single-program reference")
+    ref_sps, ref_loss = time_loop(ref.step)
+    rep = pipe.report()
+    return {
+        "steps_per_sec": round(pipe_sps, 2),
+        "fused_steps_per_sec": round(ref_sps, 2),
+        "step_time_vs_fused": round(ref_sps / pipe_sps, 3),
+        "stages": rep["stages"], "microbatches": rep["microbatches"],
+        "schedule": rep["schedule"],
+        "bubble_fraction_sim": round(rep["bubble_fraction"], 4),
+        "peak_inflight": rep["peak_inflight"],
+        "stage_provenance": rep["provenance"],
+        "donation": rep["donation"],
+        "loss_rel_diff": round(abs(pipe_loss - ref_loss)
+                               / max(1e-8, abs(ref_loss)), 6),
+    }
+
+
 def _bench_autotune():
     """mx.autotune sweep rows: tuned-vs-default deltas for the
     allreduce bucket-size sweep (ResNet-50-shaped gradient profile)
@@ -1182,6 +1405,13 @@ def main():
             # unsharded captured reference on the same mesh
             ("resnet50_zero3_captured", _bench_zero3_captured,
              "resnet50_zero3_captured_vdev"),
+            # mx.shard phase 2: gather-mode tensor parallelism on a
+            # dp=2 x mdl=2 mesh (step time + residency vs unsharded,
+            # bitwise parity, tp x zero3 interaction, sharded-decode
+            # compile flatness) and 1F1B captured pipeline stages
+            ("shard_tp_step", _bench_shard_tp, "shard_tp_step"),
+            ("shard_pipeline_step", _bench_shard_pipeline,
+             "shard_pipeline_step"),
             # mx.serve.decode: paged KV-cache + continuous batching
             # under concurrent mixed load — tokens/s, TTFT and
             # per-token p50/p99, page-pool occupancy

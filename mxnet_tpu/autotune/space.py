@@ -28,6 +28,10 @@ Sites (PERF_PLAN hypothesis in parens):
                             (structural: per-slot math is masked out
                             for absent adapters, measured by the
                             tenant bench)
+- ``shard_layout``        — mx.shard tensor-parallel layout-rule
+                            table (structural: gather mode only moves
+                            storage, measured by the committed
+                            shard_tp_step bench row)
 
 Measurable sites benchmark with DETERMINISTIC seeded inputs and return
 host numpy outputs so the measure harness can enforce the numerics
@@ -535,6 +539,68 @@ class _SpecK(TuningSite):
             "spec_k is a structural site: it is measured by the serve "
             "bench's acceptance sweep (tools/bench.py --serve), not by "
             "measure.tune()")
+
+
+@register_site
+class _ShardLayout(TuningSite):
+    """mx.shard tensor-parallel layout-rule table.  key = (mdl,).
+    Candidates are rule tables for ``shard.configure_layout`` — glob
+    ``(pattern, kind[, dim])`` tuples choosing which parameters shard
+    on the ``mdl`` axis and how (column / row / replicate / auto).
+    In the default gather mode the table only moves STORAGE (the
+    in-program constraint re-gathers weights, bit-identity-tested in
+    test_shard_mp), so parity is structural like ``decode_bucket``:
+    a layout can change residency and wire bytes, never tokens or
+    weights.  Winners come from committed bench rows (bench.py
+    ``shard_tp_step``) or an mfu_campaign sweep — layout changes
+    recapture the step program (the table is part of the capture
+    signature), which is exactly the cost measure.tune() must not
+    pay per candidate."""
+
+    name = "shard_layout"
+    doc = "tensor-parallel per-parameter layout table (structural)"
+    parity = "structural"
+
+    def default_config(self, key):
+        return []                    # the implicit '* -> auto' tail
+
+    def candidates(self, key):
+        return [
+            [],                                        # auto everywhere
+            [("*weight*", "column"), ("*", "replicate")],
+            [("*weight*", "row"), ("*", "replicate")],
+            # Megatron pairing: column first half, row second half of
+            # each Dense pair — glob names are model-specific, so this
+            # candidate is a TEMPLATE a campaign rewrites per model
+            [("*0*weight*", "column"), ("*1*weight*", "row"),
+             ("*", "replicate")],
+            [("*", "replicate")],                      # mdl storage off
+        ]
+
+    def validate(self, key, config):
+        from ..shard.policy import KINDS
+
+        if not isinstance(config, (list, tuple)):
+            return False
+        for rule in config:
+            if not isinstance(rule, (list, tuple)) or \
+                    len(rule) not in (2, 3):
+                return False
+            if not isinstance(rule[0], str) or rule[1] not in KINDS:
+                return False
+            if len(rule) == 3 and not isinstance(rule[2], int):
+                return False
+        return True
+
+    def make_bench(self, key, config):
+        from ..base import MXNetError
+
+        raise MXNetError(
+            "shard_layout is a structural site: a layout change "
+            "recaptures the step program, so it is measured by the "
+            "committed bench rows (bench.py shard_tp_step / "
+            "tools/mfu_campaign.sh --shard) and drilled by make "
+            "shard-smoke, not by measure.tune()")
 
 
 @register_site

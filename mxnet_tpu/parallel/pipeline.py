@@ -316,47 +316,73 @@ class PipelineTrainer:
         batch_axes = ("dp",) if has_dp else ()
         perm = [(i, i + 1) for i in range(S - 1)]
 
-        def pipe_loss(stacked, rng, xm, ym):
-            # xm: (M, mb_loc, ...) local; ym: (M, mb_loc, ...) local
-            flat = stacked.reshape(stacked.shape[-1])  # (1, Lmax) -> (Lmax,)
+        def pipe_step(stacked, rng, xm, ym):
+            # xm: (M, mb_loc, ...) local; ym: (M, mb_loc, ...) local.
+            # value_and_grad runs INSIDE the shard_map body: transposing
+            # a shard_map whose scan carries rank-0 loop-invariant
+            # residuals trips _check_names on this jax (out_names
+            # reference axis 0 of a scalar), so the grad must be taken
+            # per-shard — ppermute transposes to the inverse ring, and
+            # the explicit psum below restores the dp-summed gradient
+            # the outer transpose used to produce.
             stage = lax.axis_index("pp")
 
-            def tick(carry, t):
-                buf, acc = carry
-                mi = jnp.clip(t, 0, M - 1)
-                x_t = lax.dynamic_index_in_dim(xm, mi, 0, keepdims=False)
-                x_flat = x_t.reshape(mb_loc, -1).astype(jnp.float32)
-                pad = Amax - x_flat.shape[1]
-                if pad:
-                    x_flat = jnp.pad(x_flat, ((0, 0), (0, pad)))
-                # stage 0 ingests microbatch t (zeros during drain);
-                # everyone else consumes what ppermute delivered
-                feed = jnp.where(t < M, x_flat, jnp.zeros_like(x_flat))
-                inp = jnp.where(stage == 0, feed, buf)
-                li = jnp.clip(t - (S - 1), 0, M - 1)
-                label = lax.dynamic_index_in_dim(ym, li, 0, keepdims=False)
-                rng_t = jax.random.fold_in(rng, t)
-                out, loss = lax.switch(stage, branches, flat, inp, label,
-                                       rng_t)
-                acc = acc + jnp.where(t >= S - 1, loss, 0.0)
-                buf = lax.ppermute(out, "pp", perm)
-                return (buf, acc), None
+            def loss_of(w):
+                flat = w.reshape(w.shape[-1])  # (1, Lmax) -> (Lmax,)
 
-            buf0 = jnp.zeros((mb_loc, Amax), jnp.float32)
-            (_, acc), _ = lax.scan(tick, (buf0, jnp.float32(0)),
-                                   jnp.arange(M + S - 1))
-            axes = ("pp",) + batch_axes
-            return lax.psum(acc, axes) / (M * dp)
+                def tick(carry, t):
+                    buf, acc = carry
+                    mi = jnp.clip(t, 0, M - 1)
+                    x_t = lax.dynamic_index_in_dim(xm, mi, 0,
+                                                   keepdims=False)
+                    x_flat = x_t.reshape(mb_loc, -1).astype(jnp.float32)
+                    pad = Amax - x_flat.shape[1]
+                    if pad:
+                        x_flat = jnp.pad(x_flat, ((0, 0), (0, pad)))
+                    # stage 0 ingests microbatch t (zeros during drain);
+                    # everyone else consumes what ppermute delivered
+                    feed = jnp.where(t < M, x_flat,
+                                     jnp.zeros_like(x_flat))
+                    inp = jnp.where(stage == 0, feed, buf)
+                    li = jnp.clip(t - (S - 1), 0, M - 1)
+                    label = lax.dynamic_index_in_dim(ym, li, 0,
+                                                     keepdims=False)
+                    rng_t = jax.random.fold_in(rng, t)
+                    out, loss = lax.switch(stage, branches, flat, inp,
+                                           label, rng_t)
+                    acc = acc + jnp.where(t >= S - 1, loss, 0.0)
+                    buf = lax.ppermute(out, "pp", perm)
+                    return (buf, acc), None
+
+                buf0 = jnp.zeros((mb_loc, Amax), jnp.float32)
+                (_, acc), _ = lax.scan(tick, (buf0, jnp.float32(0)),
+                                       jnp.arange(M + S - 1))
+                return acc / (M * dp)
+
+            # differentiate the LOCAL loss share — no psum inside the
+            # differentiated graph (psum's transpose re-psums the
+            # cotangent, inflating every grad by the axis size).  The
+            # ppermute transpose still routes each stage's cotangents
+            # to the device that produced the activation, so cross-
+            # stage weight grads land on the right shard.
+            lloss, g = jax.value_and_grad(loss_of)(stacked)
+            loss = lax.psum(lloss, ("pp",) + batch_axes)
+            if batch_axes:
+                # each dp replica saw only its local microbatches; the
+                # weights are dp-replicated so their grad must be the
+                # dp-sum (the outer-transpose psum, made explicit)
+                g = lax.psum(g, batch_axes)
+            return loss, g
 
         in_specs = (self._pspec, P(),
                     P(None, *batch_axes) if batch_axes else P(),
                     P(None, *batch_axes) if batch_axes else P())
-        smapped = shard_map_compat(pipe_loss, mesh=mesh,
-                                   in_specs=in_specs, out_specs=P())
+        smapped = shard_map_compat(pipe_step, mesh=mesh,
+                                   in_specs=in_specs,
+                                   out_specs=(P(), self._pspec))
 
         def train_step(stacked, opt_state, step_i, lr_t, rng, xm, ym):
-            loss, g = jax.value_and_grad(
-                lambda w: smapped(w, rng, xm, ym))(stacked)
+            loss, g = smapped(stacked, rng, xm, ym)
             new_p, new_opt = opt_update(step_i, {"stacked": stacked},
                                         {"stacked": g}, opt_state, lr_t)
             return new_p["stacked"], new_opt, loss

@@ -27,6 +27,20 @@ and the TPU-native equivalent of the reference's planned pipeline work
   instead of GPipe's M.
 - The last stage fuses F and B of each microbatch into one program
   (loss + grads), which is exactly the 1F1B steady state.
+
+mx.shard phase 2 hardening: every stage program is CAPTURED — lowered
+once and compiled through the persistent compile cache
+(``compile.aot.attach_lowered``, the same backend the whole-step
+captured program uses), with the dead buffers of each backward DONATED
+(the saved stage input and the arriving cotangent die inside ``bwd``;
+donation lets XLA reuse them, bounding in-flight memory at the 1F1B
+envelope instead of 2x it).  The step dispatch rides the PR 9 control
+plane: a posted membership world-stop fences the step BEFORE any
+donated buffer is consumed, and when a collective deadline is armed
+(``MXNET_DIST_COLLECTIVE_TIMEOUT``) the whole issue loop runs under
+``run_with_deadline`` — a hung stage surfaces as ``DistTimeout`` with
+the state marked suspect (donated buffers may be gone) exactly like
+the captured single-program step.
 """
 from __future__ import annotations
 
@@ -213,6 +227,31 @@ def _pipeline_trainer_cls():
     return PipelineTrainer
 
 
+class _StageCall:
+    """One captured stage program: the cache-compiled executable with
+    the lazy jit as the placement-drift fallback (the per-stage
+    rendering of ``_Captured.call`` in step/capture.py)."""
+
+    __slots__ = ("cfn", "jfn", "served")
+
+    def __init__(self, cfn, jfn):
+        self.cfn = cfn
+        self.jfn = jfn
+        self.served = False
+
+    def __call__(self, *args):
+        if self.cfn is not None:
+            try:
+                out = self.cfn(*args)
+                self.served = True
+                return out
+            except Exception:
+                if self.served:
+                    raise  # served before: surface the real error
+                self.cfn = None  # aval/placement drift: lazy jit
+        return self.jfn(*args)
+
+
 class OneFOneBTrainer(_pipeline_trainer_cls()):
     """MPMD 1F1B pipeline trainer (constructed via
     ``PipelineTrainer(..., schedule='1f1b')``)."""
@@ -245,6 +284,51 @@ class OneFOneBTrainer(_pipeline_trainer_cls()):
         self._built = False
         self._pending_state = None
         self.last_peak_inflight = None   # introspection for tests
+
+    # -- capture -------------------------------------------------------------
+    def _aot(self, jfn, kind, si, *args):
+        """Capture one stage program: lower it now and compile through
+        the persistent compile cache (a disk hit costs zero fresh XLA
+        compiles); a backend that cannot lower ahead of time keeps the
+        lazy jit.  Returns (callable, provenance)."""
+        from ..compile.aot import attach_lowered
+        from ..optimizer import multi_tensor as _mt
+
+        try:
+            with _mt._quiet_donation():
+                lowered = jfn.lower(*args)
+                cfn, _fp, prov = attach_lowered(
+                    lowered, "_PipeStage",
+                    "pipe1f1b:%s:%d:dp%d" % (kind, si, self._dp))
+        except Exception:  # noqa: BLE001 - AOT is best-effort
+            return jfn, "lazy"
+        if cfn is None:
+            return jfn, "lazy"
+        return _StageCall(cfn, jfn), prov
+
+    # -- PR 9 control-plane envelope -----------------------------------------
+    def _fence(self):
+        """A posted membership world-stop fences the step BEFORE any
+        stage program consumes a donated buffer, so the trainer state
+        is still whole (checkpointable) at the step boundary — the
+        stage-failure contract: a dead rank's supervisor posts the
+        stop, every peer's next step raises here instead of hanging in
+        a cross-stage transfer."""
+        from .. import dist as _dist
+
+        m = _dist.current()
+        if m is None:
+            return
+        try:
+            flag = m.poll_stop()
+        except MXNetError:
+            return  # not joined: nothing to fence on
+        if flag:
+            raise MXNetError(
+                "pipeline step fenced by membership stop "
+                "(reason=%s, rank=%s, step=%s)"
+                % (flag.get("reason"), flag.get("rank"),
+                   flag.get("step")))
 
     # -- setup ---------------------------------------------------------------
     def _stage_meshes(self):
@@ -284,8 +368,10 @@ class OneFOneBTrainer(_pipeline_trainer_cls()):
         self._applies, self._named, self._params = [], [], []
         self._fwd, self._bwd, self._opt_apply = [], [], []
         self._opt_states = []
+        self._provenance = []
         rng0 = jax.random.PRNGKey(0)
         abstract = jax.ShapeDtypeStruct((mb,) + x.shape[1:], x.dtype)
+        y_aval = jax.ShapeDtypeStruct((mb,) + tuple(y.shape[1:]), y.dtype)
         self._in_avals = []
         loss_fn, user_loss = self._loss_fn, self._user_loss
 
@@ -352,10 +438,15 @@ class OneFOneBTrainer(_pipeline_trainer_cls()):
                     pg, xg = vjp(ct.astype(out.dtype))
                     return pg, xg
 
+                # the saved input and the arriving cotangent DIE here:
+                # donating them lets XLA reuse the buffers (the input
+                # slot becomes the input-grad), keeping in-flight bytes
+                # at the 1F1B envelope instead of doubling it
                 bwd = jax.jit(
                     bwd,
                     in_shardings=(repl, shard0, None, None, shard0),
-                    out_shardings=(repl, shard0))
+                    out_shardings=(repl, shard0),
+                    donate_argnums=(1, 4))
             else:
                 def last_fb(p, xin, ylab, rng, m, _so=stage_out):
                     def lossf(pp, xx):
@@ -374,16 +465,43 @@ class OneFOneBTrainer(_pipeline_trainer_cls()):
                 bwd = jax.jit(
                     last_fb,
                     in_shardings=(repl, shard0, shard0, None, None),
-                    out_shardings=(None, repl, shard0))
+                    out_shardings=(None, repl, shard0),
+                    donate_argnums=(1, 2))
 
             def opt_apply(step_i, p, g, st, lr, _upd=self._opt_update):
                 return _upd(step_i, p, g, st, lr)
 
-            self._opt_apply.append(jax.jit(
+            oa = jax.jit(
                 opt_apply,
                 in_shardings=(None, repl, repl, repl, None),
                 out_shardings=(repl, repl),
-                donate_argnums=(1, 3)))
+                donate_argnums=(1, 3))
+            # capture: lower every stage program NOW and compile through
+            # the persistent cache — a warm process re-trains with zero
+            # fresh XLA compiles, and provenance lands in report()
+            p_aval = jax.tree_util.tree_map(
+                lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), params)
+            st_aval = jax.tree_util.tree_map(
+                lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype),
+                self._opt_states[-1])
+            ct_aval = jax.ShapeDtypeStruct(out_aval.shape, out_aval.dtype)
+            prov = {}
+            if fwd is not None:
+                fwd, prov["fwd"] = self._aot(
+                    fwd, "fwd", si, p_aval, abstract, rng0, jnp.uint32(0))
+            if last:
+                bwd, prov["bwd"] = self._aot(
+                    bwd, "lastfb", si, p_aval, abstract, y_aval, rng0,
+                    jnp.uint32(0))
+            else:
+                bwd, prov["bwd"] = self._aot(
+                    bwd, "bwd", si, p_aval, abstract, rng0, jnp.uint32(0),
+                    ct_aval)
+            oa, prov["opt"] = self._aot(
+                oa, "opt", si, jnp.uint32(0), p_aval, p_aval, st_aval,
+                jnp.float32(0))
+            self._provenance.append(prov)
+            self._opt_apply.append(oa)
             self._fwd.append(fwd)
             self._bwd.append(bwd)
             abstract = jax.ShapeDtypeStruct(out_aval.shape,
@@ -426,67 +544,115 @@ class OneFOneBTrainer(_pipeline_trainer_cls()):
                 "batch %d does not match the compiled pipeline step "
                 "(%d microbatches x %d); keep the batch size fixed or "
                 "drop the epoch tail" % (x.shape[0], M, mb))
+        # the PR 9 envelope: fence on a posted world-stop BEFORE any
+        # donated buffer is consumed ...
+        self._fence()
         rng = mxrandom.take_key()
-        xm = [jax.device_put(x[m * mb:(m + 1) * mb], self._shard_x0)
-              for m in range(M)]
-        ym = [jax.device_put(y[m * mb:(m + 1) * mb], self._shard_y)
-              for m in range(M)]
 
-        acts = [{} for _ in range(C)]     # (chunk) -> {m: saved input}
-        cts = [{} for _ in range(C)]      # cotangents arriving at chunk
-        gacc = [None] * C
-        losses = []
-        # executed-forwards minus executed-backwards per chunk: the
-        # activation-memory bound 1F1B exists to cap
-        outstanding = [0] * C
-        peak = [0] * C
+        def issue():
+            xm = [jax.device_put(x[m * mb:(m + 1) * mb], self._shard_x0)
+                  for m in range(M)]
+            ym = [jax.device_put(y[m * mb:(m + 1) * mb], self._shard_y)
+                  for m in range(M)]
 
-        def add_grads(c, pg):
-            gacc[c] = pg if gacc[c] is None else jax.tree_util.tree_map(
-                jnp.add, gacc[c], pg)
+            acts = [{} for _ in range(C)]  # (chunk) -> {m: saved input}
+            cts = [{} for _ in range(C)]   # cotangents arriving at chunk
+            gacc = [None] * C
+            losses = []
+            # executed-forwards minus executed-backwards per chunk: the
+            # activation-memory bound 1F1B exists to cap
+            outstanding = [0] * C
+            peak = [0] * C
 
-        for c, kind, m in self._order:
-            if kind == "F" and c < C - 1:
-                xin = xm[m] if c == 0 else acts[c][m]
-                if c == 0:
-                    acts[c][m] = xin
-                out = self._fwd[c](self._params[c], xin, rng,
-                                   jnp.uint32(m))
-                acts[c + 1][m] = jax.device_put(out, self._xfer_in[c + 1])
-                outstanding[c] += 1
-                peak[c] = max(peak[c], outstanding[c])
-            elif kind == "F":            # last chunk: fused into B
-                outstanding[c] += 1
-                peak[c] = max(peak[c], outstanding[c])
-            else:
-                if c == C - 1:
-                    loss, pg, xg = self._bwd[c](
-                        self._params[c], acts[c].pop(m), ym[m], rng,
-                        jnp.uint32(m))
-                    losses.append(loss)
+            def add_grads(c, pg):
+                gacc[c] = pg if gacc[c] is None else \
+                    jax.tree_util.tree_map(jnp.add, gacc[c], pg)
+
+            for c, kind, m in self._order:
+                if kind == "F" and c < C - 1:
+                    xin = xm[m] if c == 0 else acts[c][m]
+                    if c == 0:
+                        acts[c][m] = xin
+                    out = self._fwd[c](self._params[c], xin, rng,
+                                       jnp.uint32(m))
+                    acts[c + 1][m] = jax.device_put(out,
+                                                    self._xfer_in[c + 1])
+                    outstanding[c] += 1
+                    peak[c] = max(peak[c], outstanding[c])
+                elif kind == "F":        # last chunk: fused into B
+                    outstanding[c] += 1
+                    peak[c] = max(peak[c], outstanding[c])
                 else:
-                    pg, xg = self._bwd[c](
-                        self._params[c], acts[c].pop(m), rng,
-                        jnp.uint32(m), cts[c].pop(m))
-                add_grads(c, pg)
-                outstanding[c] -= 1
-                if c > 0:
-                    cts[c - 1][m] = jax.device_put(xg, self._xfer_ct[c])
+                    if c == C - 1:
+                        loss, pg, xg = self._bwd[c](
+                            self._params[c], acts[c].pop(m), ym[m], rng,
+                            jnp.uint32(m))
+                        losses.append(loss)
+                    else:
+                        pg, xg = self._bwd[c](
+                            self._params[c], acts[c].pop(m), rng,
+                            jnp.uint32(m), cts[c].pop(m))
+                    add_grads(c, pg)
+                    outstanding[c] -= 1
+                    if c > 0:
+                        cts[c - 1][m] = jax.device_put(xg,
+                                                       self._xfer_ct[c])
 
-        self.last_peak_inflight = peak
-        lr_t = (self._lr_scheduler(self._step_count + 1)
-                if self._lr_scheduler is not None else self._lr)
-        scale = 1.0 / M
-        for c in range(C):
-            g = jax.tree_util.tree_map(lambda v: v * scale, gacc[c])
-            self._params[c], self._opt_states[c] = self._opt_apply[c](
-                jnp.uint32(self._step_count), self._params[c], g,
-                self._opt_states[c], jnp.float32(lr_t))
-        self._step_count += 1
-        total = losses[0]
-        for l in losses[1:]:
-            total = total + jax.device_put(l, total.sharding)
+            self.last_peak_inflight = peak
+            lr_t = (self._lr_scheduler(self._step_count + 1)
+                    if self._lr_scheduler is not None else self._lr)
+            scale = 1.0 / M
+            for c in range(C):
+                g = jax.tree_util.tree_map(lambda v: v * scale, gacc[c])
+                self._params[c], self._opt_states[c] = \
+                    self._opt_apply[c](
+                        jnp.uint32(self._step_count), self._params[c], g,
+                        self._opt_states[c], jnp.float32(lr_t))
+            self._step_count += 1
+            total = losses[0]
+            for l in losses[1:]:
+                total = total + jax.device_put(l, total.sharding)
+            return total
+
+        # ... and run the whole issue loop under the collective
+        # deadline when one is armed: a hung stage surfaces as
+        # DistTimeout instead of wedging the host in a transfer
+        from ..dist import timeouts as _dt
+
+        timeout = _dt.collective_timeout()
+        if not timeout or timeout <= 0:
+            total = issue()
+        else:
+            try:
+                total = _dt.run_with_deadline(issue,
+                                              site="pipeline_1f1b",
+                                              timeout=timeout)
+            except _dt.DistTimeout as exc:
+                # stage programs may have consumed donated buffers
+                # mid-flight: the state is suspect, never emergency-save
+                exc.mx_state_clean = False
+                raise
         return NDArray(total / M)
+
+    def report(self):
+        """Capture/schedule report for ``tools/diagnose.py --shard``
+        and tests: per-stage program provenance (cache vs fresh vs
+        lazy), the simulated bubble fraction, the donation map and the
+        last step's per-chunk peak in-flight forwards."""
+        out = {"built": self._built, "stages": self._S,
+               "chunks": self._C, "virtual": self._V,
+               "microbatches": self._M, "dp": self._dp,
+               "schedule": "1f1b" if self._V == 1 else "interleaved"}
+        stats = (schedule_stats(self._S, self._M) if self._V == 1
+                 else interleaved_stats(self._S, self._V, self._M))
+        out["bubble_fraction"] = stats["bubble_fraction"]
+        if self._built:
+            out["provenance"] = [dict(p) for p in self._provenance]
+            out["peak_inflight"] = self.last_peak_inflight
+            out["donation"] = {
+                "bwd_saved_input": True, "bwd_cotangent": True,
+                "last_stage_labels": True, "optimizer_state": True}
+        return out
 
     # -- checkpoint/resume (mxnet_tpu.elastic contract) ----------------------
     def state_dict(self):

@@ -335,7 +335,7 @@ class DecodeRunner:
     server can reach readiness with zero fresh XLA compiles."""
 
     def __init__(self, block, root=None, step=None, ctx=None, config=None,
-                 warm=True, draft=None, tenant=None):
+                 warm=True, draft=None, tenant=None, mesh=None):
         from ..gluon.block import HybridBlock
         from .runner import resolve_block
 
@@ -361,6 +361,38 @@ class DecodeRunner:
             self.step = block.load_checkpoint(root, step=step, ctx=ctx)
         self._resolve_params()
         self._apply_fn, self._params = block.export_pure(training=False)
+        # mx.shard phase 2: a model sharded over the mesh's mdl axis.
+        # Parameters are STORED per the layout table (1/mdl per device)
+        # and each program constrains them in-program: gather mode
+        # re-materializes replicated weights (the decode math — and
+        # therefore the greedy token stream — is byte-identical to the
+        # single-chip program), compute mode keeps them sharded and
+        # lets GSPMD shard the matmuls.  dp must be 1: replica fan-out
+        # is mx.fleet's job, one runner serves one model instance.
+        self.mesh = None
+        self._fwd_shardings = None
+        if mesh is not None:
+            from .. import shard as _shard
+
+            gm = _shard.as_global(mesh)
+            if gm.dp != 1:
+                raise ValueError(
+                    "DecodeRunner(mesh=...) needs dp=1 (got dp=%d): "
+                    "one runner serves one model instance; use "
+                    "mx.fleet for replicas" % gm.dp)
+            if gm.mdl > 1:
+                import jax
+
+                self.mesh = gm
+                policy = _shard.ShardPolicy(0, gm)
+                self._params = {
+                    n: jax.device_put(v, policy.param_sharding(
+                        v.shape, name=n))
+                    for n, v in self._params.items()}
+                self._fwd_shardings = {
+                    n: policy.forward_sharding(v.shape, name=n)
+                    for n, v in self._params.items()}
+                self._tp_mode = policy.mode
         # mx.tenant: the adapter bank MUST exist before warm_up so
         # every program compiles with the bank inputs in its signature
         # — adapter churn afterwards is slot-content data, never a
@@ -374,7 +406,7 @@ class DecodeRunner:
             c.page_size, c.pool_pages, block.num_layers,
             block.num_kv_heads, block.head_dim, c.max_context,
             dtype=c.dtype)
-        self.pool = PagePool(self.page_config)
+        self.pool = PagePool(self.page_config, mesh=self.mesh)
         self._programs = {}
         self._run_lock = threading.RLock()
         self._warmed = False
@@ -600,6 +632,35 @@ class DecodeRunner:
                             chunk_lens, floors)
         return step
 
+    def _mesh_wrap(self, fn):
+        """Pin in-program layouts for a ``mdl > 1`` mesh (mx.shard
+        phase 2).  Weights are constrained per the ShardPolicy —
+        replicated in gather mode, so the decode math and the greedy
+        argmax stay byte-identical to single-chip, or their Megatron
+        layout in compute mode.  The KV pool is gathered at entry for
+        the math and the OUTPUT pool is pinned back onto its
+        head-sharded storage layout, so the donated re-bind keeps
+        per-device KV residency at 1/mdl between steps."""
+        import jax
+
+        fs = self._fwd_shardings
+        store = self.pool.sharding
+        entry = self.mesh.replicated() if self._tp_mode == "gather" \
+            else store
+
+        def wrapped(params, kp, vp, *rest, _fn=fn):
+            wsc = jax.lax.with_sharding_constraint
+            params = {n: wsc(v, fs[n]) for n, v in params.items()}
+            if store is not None:
+                kp, vp = wsc(kp, entry), wsc(vp, entry)
+            out = _fn(params, kp, vp, *rest)
+            if store is not None:
+                out = (wsc(out[0], store), wsc(out[1], store)) \
+                    + tuple(out[2:])
+            return out
+
+        return wrapped
+
     def _build(self, key):
         """Build (or restore from the mx.compile persistent cache) the
         program for ``key`` = ("decode", B) | ("prefill", T) |
@@ -621,16 +682,27 @@ class DecodeRunner:
                 batch, chunk, with_ctx=kind in ("decode", "chunk"),
                 with_floors=with_floors)
         label = self.bucket_key_label(key)
+        if self.mesh is not None:
+            fn = self._mesh_wrap(fn)
         jitted = jax.jit(fn, donate_argnums=(1, 2))
         provenance = "fresh"
         compiled = None
         try:
-            aval = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)  # noqa: E731
+            if self.mesh is None:
+                aval = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)  # noqa: E731
+            else:
+                # committed mesh layouts are part of the program
+                # signature: the compiled executable must expect the
+                # sharded params/pool it will be fed
+                aval = lambda a: jax.ShapeDtypeStruct(  # noqa: E731
+                    a.shape, a.dtype, sharding=getattr(a, "sharding",
+                                                       None))
             params_avals = jax.tree_util.tree_map(aval, self._params)
             c = self.page_config
             pool_aval = jax.ShapeDtypeStruct(
                 (c.num_layers, c.num_pages, c.page_size, c.num_kv_heads,
-                 c.head_dim), _np.dtype(c.dtype))
+                 c.head_dim), _np.dtype(c.dtype),
+                sharding=self.pool.sharding)
             i32 = _np.dtype("int32")
             avals = [params_avals, pool_aval, pool_aval,
                      jax.ShapeDtypeStruct((batch, chunk), i32),
@@ -753,6 +825,13 @@ class DecodeRunner:
                          c.num_kv_heads, c.head_dim)
                 self.pool.k = jnp.zeros(shape, dtype=c.dtype)
                 self.pool.v = jnp.zeros(shape, dtype=c.dtype)
+                if self.pool.sharding is not None:
+                    import jax
+
+                    self.pool.k = jax.device_put(self.pool.k,
+                                                 self.pool.sharding)
+                    self.pool.v = jax.device_put(self.pool.v,
+                                                 self.pool.sharding)
                 err = DecodeError(
                     "decode step failed AFTER pool donation; KV storage "
                     "lost, all live sequences must restart: %r" % (exc,))

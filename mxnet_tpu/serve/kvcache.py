@@ -123,15 +123,37 @@ class PagePool:
     host-side and lock-protected (admission runs on submitter threads,
     release on the decode loop)."""
 
-    def __init__(self, config):
+    def __init__(self, config, mesh=None):
         import jax.numpy as jnp
 
         self.config = config
         c = config
         shape = (c.num_layers, c.num_pages, c.page_size,
                  c.num_kv_heads, c.head_dim)
-        self.k = jnp.zeros(shape, dtype=c.dtype)
-        self.v = jnp.zeros(shape, dtype=c.dtype)
+        # mx.shard phase 2: on a mesh with an mdl axis the pool shards
+        # over the KV-HEAD axis (per-head attention state is
+        # independent, so a head split never slices a page row) — each
+        # device holds 1/mdl of the cache, which is what makes
+        # multi-chip decode residency real.  Indivisible head counts
+        # stay replicated (correct, just not smaller).
+        self.sharding = None
+        if mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            raw = getattr(mesh, "mesh", mesh)   # GlobalMesh or raw Mesh
+            axes = dict(getattr(raw, "shape", {}) or {})
+            mdl = int(axes.get("mdl", 1))
+            spec = P(None, None, None, "mdl", None) \
+                if mdl > 1 and c.num_kv_heads % mdl == 0 else P()
+            self.sharding = NamedSharding(raw, spec)
+            self.k = jax.device_put(jnp.zeros(shape, dtype=c.dtype),
+                                    self.sharding)
+            self.v = jax.device_put(jnp.zeros(shape, dtype=c.dtype),
+                                    self.sharding)
+        else:
+            self.k = jnp.zeros(shape, dtype=c.dtype)
+            self.v = jnp.zeros(shape, dtype=c.dtype)
         self._lock = threading.Lock()
         self._free = list(range(c.num_pages - 1, -1, -1))  # pop() -> 0,1,2..
         self._owned = {}                 # owner -> [page ids]
@@ -320,6 +342,14 @@ class PagePool:
                 raise ServeError("shared page with refcount < 1")
         return True
 
+    def device_bytes(self):
+        """Bytes of the K+V arrays resident on ONE device — the number
+        the sharded-decode residency bound asserts (1/mdl of the pool
+        when head-sharded, the full pool otherwise)."""
+        from ..shard import device_bytes as _db
+
+        return _db([self.k, self.v])
+
     def stats(self):
         with self._lock:
             free = len(self._free)
@@ -327,6 +357,10 @@ class PagePool:
             shared = len(self._shared)
         cap = self.config.num_pages
         return {
+            "kv_sharding": None if self.sharding is None
+            else str(self.sharding.spec),
+            "kv_device_bytes": self.device_bytes()
+            if self.sharding is not None else None,
             "capacity_pages": cap,
             "in_use_pages": cap - free,
             "free_pages": free,

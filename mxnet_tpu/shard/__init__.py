@@ -36,6 +36,9 @@ from __future__ import annotations
 
 from .mesh import (GlobalMesh, as_global, auto_mesh, configure, current,
                    ensure_distributed, reset)
+from .policy import (TP_MODES, LayoutRule, LayoutTable, ShardPolicy,
+                     configure_layout, current_layout, layout_signature,
+                     reset_layout, tp_mode)
 from .zero import (LEVELS, ZeroPolicy, device_bytes, normalize_level,
                    placement_label, tree_bytes)
 
@@ -44,10 +47,15 @@ __all__ = [
     "ensure_distributed", "reset",
     "ZeroPolicy", "LEVELS", "normalize_level", "device_bytes",
     "tree_bytes", "placement_label",
+    "ShardPolicy", "LayoutRule", "LayoutTable", "TP_MODES",
+    "configure_layout", "current_layout", "reset_layout",
+    "layout_signature", "tp_mode",
 ]
 
 
 def state():
     """Snapshot for ``tools/diagnose.py``."""
     gm = current()
-    return {"mesh": None if gm is None else gm.describe()}
+    return {"mesh": None if gm is None else gm.describe(),
+            "tp_mode": tp_mode(),
+            "layout": current_layout().describe()}

@@ -1,0 +1,334 @@
+"""Per-parameter model-parallel layout rules on the ``mdl`` axis.
+
+PR 11 built the ``dp`` story: :class:`~.zero.ZeroPolicy` shards the
+*weight update* (arXiv 2004.13336) and the captured step re-gathers
+parameters just in time, so the math stays bit-identical.  This module
+is phase 2 — the ``mdl`` axis of :class:`~.mesh.GlobalMesh` finally
+carries tensor-parallel layouts: a :class:`LayoutTable` maps parameter
+NAMES (glob patterns) to Megatron-style kinds (arXiv 1909.08053) —
+
+- ``column``: shard the output-features dim (dim 0 of a ``(out, in)``
+  Dense weight, the head dim of fused attention projections),
+- ``row``: shard the input-features/contraction dim (the Megatron
+  pair's second half; its matmul PARTIAL-SUMS across ``mdl``),
+- ``replicate``: keep the full copy per ``mdl`` coordinate,
+- ``auto``: column when dim 0 divides ``mdl``, else replicate —
+  the default rule, safe for every shape.
+
+and :class:`ShardPolicy` (a :class:`ZeroPolicy` subclass) composes the
+resolved ``mdl`` placement with the ZeRO ``dp`` placement into one
+``PartitionSpec`` per parameter/gradient/state leaf.
+
+Two tensor-parallel execution modes (``MXNET_SHARD_TP_MODE``):
+
+- ``gather`` (default): layouts govern STORAGE — between steps every
+  parameter and optimizer-state leaf lives 1/(mdl·dp')-sharded — and
+  the captured forward constrains weights back to replicated, exactly
+  the ZeRO-3 just-in-time gather generalized to both axes.  The
+  compute graph is the unsharded program, so the step stays
+  BIT-IDENTICAL to the single-chip reference (the acceptance bar), at
+  the price of un-sharded activations.
+- ``compute``: weights stay ``mdl``-sharded inside forward/backward
+  (``with_sharding_constraint`` pins the layout; GSPMD shards the
+  matmuls and activations and inserts the all-gather/reduce-scatter
+  collectives).  This is real Megatron TP — activations shrink ~1/mdl
+  — but XLA's re-blocked local matmuls and the backward's cross-shard
+  contraction split reassociate float sums: parity is TOLERANCE, not
+  bitwise (measured drift ~1e-6 rel on CPU f32; the test suite pins
+  it).  Opt in per run, never silently.
+"""
+from __future__ import annotations
+
+import fnmatch
+import logging
+
+from ..base import MXNetError, get_env
+from .zero import ZeroPolicy
+
+__all__ = ["LayoutRule", "LayoutTable", "ShardPolicy", "TP_MODES",
+           "configure_layout", "current_layout", "reset_layout",
+           "layout_signature", "tp_mode"]
+
+_LOGGER = logging.getLogger("mxnet_tpu.shard")
+
+KINDS = ("column", "row", "replicate", "auto")
+TP_MODES = ("gather", "compute")
+
+
+def tp_mode():
+    """The tensor-parallel execution mode for this process —
+    ``gather`` (bit-exact storage sharding, the default) or
+    ``compute`` (Megatron sharded matmuls, tolerance parity)."""
+    mode = str(get_env("MXNET_SHARD_TP_MODE", str, "gather")
+               or "gather").lower()
+    if mode not in TP_MODES:
+        raise MXNetError("MXNET_SHARD_TP_MODE=%r is not a TP mode %s"
+                         % (mode, list(TP_MODES)))
+    return mode
+
+
+class LayoutRule:
+    """One ``pattern -> kind`` entry.  ``dim`` overrides the kind's
+    default sharded dimension (column: 0, row: last)."""
+
+    __slots__ = ("pattern", "kind", "dim")
+
+    def __init__(self, pattern, kind, dim=None):
+        if kind not in KINDS:
+            raise MXNetError("layout kind %r is not one of %s"
+                             % (kind, list(KINDS)))
+        self.pattern = str(pattern)
+        self.kind = kind
+        self.dim = None if dim is None else int(dim)
+
+    def matches(self, name):
+        return name is not None and fnmatch.fnmatchcase(name, self.pattern)
+
+    def key(self):
+        return (self.pattern, self.kind, self.dim)
+
+    def __repr__(self):
+        d = "" if self.dim is None else ":%d" % self.dim
+        return "LayoutRule(%r -> %s%s)" % (self.pattern, self.kind, d)
+
+
+class LayoutTable:
+    """Ordered first-match rules; the implicit tail rule is
+    ``* -> auto``."""
+
+    def __init__(self, rules=()):
+        self.rules = []
+        for r in rules:
+            if isinstance(r, LayoutRule):
+                self.rules.append(r)
+            else:
+                self.rules.append(LayoutRule(*r))
+
+    @classmethod
+    def from_env(cls):
+        """``MXNET_SHARD_LAYOUT=pat=kind[:dim],pat=kind,...`` — the
+        launch-script spelling.  Empty/unset -> the all-auto table."""
+        raw = get_env("MXNET_SHARD_LAYOUT", str, "") or ""
+        rules = []
+        for entry in raw.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            if "=" not in entry:
+                raise MXNetError(
+                    "MXNET_SHARD_LAYOUT entry %r is not pat=kind[:dim]"
+                    % entry)
+            pat, kind = entry.split("=", 1)
+            dim = None
+            if ":" in kind:
+                kind, dim = kind.split(":", 1)
+            rules.append(LayoutRule(pat.strip(), kind.strip().lower(),
+                                    dim))
+        return cls(rules)
+
+    def resolve(self, name, shape, mdl):
+        """The concrete ``mdl`` placement for one named array: the
+        sharded dimension index, or None (replicated along ``mdl``).
+        Divisibility is checked HERE — a rule naming an indivisible
+        dim degrades to replicate (logged once per table) rather than
+        producing an invalid spec."""
+        if mdl <= 1 or not shape:
+            return None
+        kind, dim = "auto", None
+        for r in self.rules:
+            if r.matches(name):
+                kind, dim = r.kind, r.dim
+                break
+        if kind == "replicate":
+            return None
+        if kind == "column" or kind == "auto":
+            dim = 0 if dim is None else dim
+        elif kind == "row":
+            dim = len(shape) - 1 if dim is None else dim
+        if dim < 0:
+            dim += len(shape)
+        if dim < 0 or dim >= len(shape) or shape[dim] <= 0 \
+                or shape[dim] % mdl:
+            if kind != "auto":
+                _LOGGER.debug(
+                    "mx.shard: layout %s:%s for %r does not divide "
+                    "shape %s by mdl=%d; replicating", kind, dim, name,
+                    tuple(shape), mdl)
+            return None
+        return dim
+
+    def kind_of(self, name):
+        """The matched kind label (tests / diagnose)."""
+        for r in self.rules:
+            if r.matches(name):
+                return r.kind
+        return "auto"
+
+    def signature(self):
+        return tuple(r.key() for r in self.rules)
+
+    def describe(self):
+        return [{"pattern": r.pattern, "kind": r.kind, "dim": r.dim}
+                for r in self.rules]
+
+    def __repr__(self):
+        return "LayoutTable(%d rules)" % len(self.rules)
+
+
+# the process-global table (configure_layout()/current_layout()); one
+# per process so capture signatures and diagnose agree
+_TABLE = None
+
+
+def configure_layout(table):
+    """Install ``table`` (LayoutTable or an iterable of rule tuples)
+    as the process-global layout table.  Returns it."""
+    global _TABLE
+    _TABLE = table if isinstance(table, LayoutTable) \
+        else LayoutTable(table or ())
+    return _TABLE
+
+
+def current_layout():
+    """The configured table, else one built from
+    ``MXNET_SHARD_LAYOUT`` (cached: env is read once per process until
+    :func:`reset_layout`)."""
+    global _TABLE
+    if _TABLE is None:
+        _TABLE = LayoutTable.from_env()
+    return _TABLE
+
+
+def reset_layout():
+    """Tests only: drop the process-global layout table."""
+    global _TABLE
+    _TABLE = None
+
+
+def layout_signature():
+    """Hashable (mode, rules) identity for capture signatures — a
+    program traced under one layout/mode must never serve another."""
+    return (tp_mode(), current_layout().signature())
+
+
+class ShardPolicy(ZeroPolicy):
+    """ZeRO ``dp`` sharding x tensor-parallel ``mdl`` layouts.
+
+    Every ``*_sharding`` hook takes an optional ``name=`` so the
+    captured step can resolve per-parameter rules; with ``mdl == 1``
+    (or no name match) each hook degenerates EXACTLY to the
+    :class:`ZeroPolicy` placement, so pure-dp behavior is unchanged.
+    """
+
+    def __init__(self, level, gmesh, table=None, mode=None):
+        super().__init__(level, gmesh)
+        self.table = table if table is not None else current_layout()
+        self.mode = mode or tp_mode()
+        if self.mode not in TP_MODES:
+            raise MXNetError("ShardPolicy mode %r is not one of %s"
+                             % (self.mode, list(TP_MODES)))
+
+    # -- spec composition ----------------------------------------------------
+    def mdl_dim(self, shape, name=None):
+        return self.table.resolve(name, tuple(shape), self.gmesh.mdl)
+
+    def _spec(self, shape, name, dp_on):
+        """One PartitionSpec: the ``mdl`` layout dim from the rule
+        table, plus ``dp`` on the first OTHER dp-divisible dim when
+        the ZeRO level shards this role — or stacked onto the same dim
+        (``(mdl, dp)``) when no other dim divides but that one splits
+        both ways.  Mirrors ``GlobalMesh.spec_for`` when mdl is
+        out of the picture."""
+        from jax.sharding import PartitionSpec as P
+
+        spec = [None] * len(shape)
+        md = self.mdl_dim(shape, name)
+        if md is not None:
+            spec[md] = "mdl"
+        if dp_on and self.gmesh.dp > 1:
+            placed = False
+            for ax, dim in enumerate(shape):
+                if spec[ax] is None and dim > 0 and dim % self.gmesh.dp \
+                        == 0:
+                    spec[ax] = "dp"
+                    placed = True
+                    break
+            if not placed and md is not None and \
+                    shape[md] % (self.gmesh.mdl * self.gmesh.dp) == 0:
+                spec[md] = ("mdl", "dp")
+        return P(*spec)
+
+    def _named(self, spec):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.gmesh.mesh, spec)
+
+    # -- role shardings (capture consumes these) -----------------------------
+    def param_sharding(self, shape, name=None):
+        return self._named(self._spec(shape, name, self.level >= 3))
+
+    def grad_sharding(self, shape, name=None):
+        return self._named(self._spec(shape, name, self.level >= 2))
+
+    def state_sharding(self, shape, name=None):
+        return self._named(self._spec(shape, name, self.level >= 1))
+
+    def forward_sharding(self, shape, name=None):
+        """What a weight is constrained to INSIDE forward/backward.
+        ``gather`` mode: replicated — the just-in-time all-gather that
+        keeps the compute graph bit-identical to the unsharded
+        program.  ``compute`` mode: the bare ``mdl`` layout — GSPMD
+        shards the consuming matmul instead of gathering."""
+        if self.mode == "compute":
+            return self._named(self._spec(shape, name, False))
+        return self.gmesh.replicated()
+
+    @property
+    def needs_forward_constraint(self):
+        """Whether fwd() must pin weight layouts at all: yes when
+        parameters are stored away from replicated (ZeRO-3 or any
+        ``mdl`` sharding)."""
+        return self.level >= 3 or self.gmesh.mdl > 1
+
+    # -- introspection -------------------------------------------------------
+    def layout_of(self, name, shape):
+        md = self.mdl_dim(shape, name)
+        return {"name": name, "kind": self.table.kind_of(name),
+                "mdl_dim": md,
+                "spec": str(self._spec(shape, name, self.level >= 3))}
+
+    def signature(self):
+        return (self.mode, self.table.signature())
+
+    def describe(self):
+        d = super().describe()
+        d["mdl"] = self.gmesh.mdl
+        d["tp_mode"] = self.mode
+        d["layout_rules"] = len(self.table.rules)
+        return d
+
+    # -- collective pricing (PERF_PLAN / bench / telemetry) ------------------
+    def mdl_param_bytes(self, payload_bytes):
+        """Wire bytes per step to re-materialize ``mdl``-sharded
+        weights in ``gather`` mode: a ring all-gather moves
+        (mdl-1)/mdl * B, paid in forward AND backward (remat replays
+        it) — the ZeRO-3 formula on the other axis.  ``compute`` mode
+        gathers no weights (activations pay instead, priced per
+        dispatch from the batch geometry)."""
+        if self.gmesh.mdl <= 1 or self.mode != "gather":
+            return 0
+        from ..kvstore.collective import reduce_scatter_wire_bytes
+
+        return 2 * reduce_scatter_wire_bytes(payload_bytes,
+                                             self.gmesh.mdl)
+
+    def mdl_activation_bytes(self, act_bytes):
+        """Wire bytes per step to all-gather ``mdl``-sharded
+        activations back to replicated consumers in ``compute`` mode
+        (per column-parallel boundary; ``act_bytes`` is the summed
+        boundary payload)."""
+        if self.gmesh.mdl <= 1 or self.mode != "compute":
+            return 0
+        from ..kvstore.collective import reduce_scatter_wire_bytes
+
+        return 2 * reduce_scatter_wire_bytes(act_bytes, self.gmesh.mdl)

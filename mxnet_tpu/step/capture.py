@@ -241,7 +241,8 @@ class _Captured:
                  "cfn_ok", "fingerprint", "provenance", "gate",
                  "monitor", "remat", "segments", "donation",
                  "gmesh", "level", "param_shardings", "grad_shardings",
-                 "state_shardings", "replicated", "wire", "flops")
+                 "state_shardings", "forward_shardings", "tp_mode",
+                 "replicated", "wire", "flops")
 
     def __init__(self):
         self.bucket_bytes = 0
@@ -430,20 +431,27 @@ class StepProgram:
                         is_leaf=_mt._is_nd)
             self._homes = homes
         train_ids = {id(p) for _, p, _ in items}
+        name_of = {}
+        for n, p in named.items():
+            name_of.setdefault(id(p), n)
         for _, p, _ in items:
             h = p.data()
-            h._data = jax.device_put(h._data,
-                                     policy.param_sharding(h.shape))
+            h._data = jax.device_put(
+                h._data, policy.param_sharding(
+                    h.shape, name=name_of.get(id(p))))
         for n, p in named.items():
             if p._data is not None and id(p) not in train_ids:
                 p._data._data = jax.device_put(p._data._data,
                                                policy.gmesh.replicated())
-        for i, _, _ in items:
+        for i, p, _ in items:
             st = trainer._states.get(i)
             if st is not None:
-                def put(leaf):
+                pname = name_of.get(id(p))
+
+                def put(leaf, pname=pname):
                     leaf._data = jax.device_put(
-                        leaf._data, policy.state_sharding(leaf.shape))
+                        leaf._data, policy.state_sharding(
+                            leaf.shape, name=pname))
                     return leaf
                 jax.tree_util.tree_map(put, st, is_leaf=_mt._is_nd)
         self._placed = True
@@ -458,6 +466,9 @@ class StepProgram:
                                      if trainer._states.get(i)
                                      is not None]))
             _tel.SHARD_ZERO_LEVEL.set(policy.level)
+            _tel.SHARD_TP_MODE.set(
+                1 if getattr(policy, "mode", "gather") == "compute"
+                else 0)
 
     def _gather_home(self):
         """Undo ``_place``: device_put every placed array back to its
@@ -545,6 +556,7 @@ class StepProgram:
                 "monitor_fused": cap.monitor,
                 "gate": cap.gate,
                 "zero": cap.level,
+                "tp_mode": cap.tp_mode,
                 "mesh": None if cap.gmesh is None
                 else cap.gmesh.describe(),
                 "wire": None if cap.wire is None else dict(cap.wire),
@@ -625,6 +637,8 @@ class StepProgram:
         from ..contrib import amp as _amp
         from ..monitor import sentinel as _sentinel
 
+        from .. import shard as _shard
+
         mon_on = _mon.core.ENABLED
         gate = mon_on and _sentinel.policy() in _sentinel.SYNC_POLICIES
         remat = self._remat_override or remat_mode()
@@ -635,7 +649,10 @@ class StepProgram:
                 remat, _amp.is_active(), _amp.target_dtype(),
                 None if gm is None else gm.signature(),
                 int(getattr(self._trainer, "_zero", 0) or 0),
-                str(get_env("MXNET_SHARD_DATA", str, "dp") or "dp"))
+                str(get_env("MXNET_SHARD_DATA", str, "dp") or "dp"),
+                # layout rules + TP mode are part of a mesh program's
+                # identity: retrace when either changes mid-process
+                None if gm is None else _shard.layout_signature())
 
     def _get_program(self, datas, labels):
         sig = self._sig(datas, labels)  # typo'd env values fail loud
@@ -736,7 +753,7 @@ class StepProgram:
         if gmesh is not None:
             from .. import shard as _shard
 
-            policy = _shard.ZeroPolicy(level, gmesh)
+            policy = _shard.ShardPolicy(level, gmesh)
             self._place(items, named, policy)
 
         cap = _Captured()
@@ -775,19 +792,36 @@ class StepProgram:
             cap.param_shardings = None
             cap.grad_shardings = None
             cap.state_shardings = None
+            cap.forward_shardings = None
             cap.replicated = None
             cap.wire = None
+            cap.tp_mode = None
         else:
             cap.param_shardings = [
-                policy.param_sharding(p.data().shape) for _, p, _ in items]
+                policy.param_sharding(p.data().shape,
+                                      name=name_of[id(p)])
+                for _, p, _ in items]
             cap.grad_shardings = [
-                policy.grad_sharding(g.shape) for _, _, g in items]
+                policy.grad_sharding(g.shape, name=name_of[id(p)])
+                for _, p, g in items]
             cap.state_shardings = [
                 jax.tree_util.tree_map(
-                    lambda a: policy.state_sharding(a.shape),
+                    lambda a, n=name_of[id(p)]:
+                    policy.state_sharding(a.shape, name=n),
                     _mt._unwrap_state(trainer._states[i]))
-                for i in cap.train_idx]
+                for i, p, _ in items]
             cap.replicated = gmesh.replicated()
+            cap.tp_mode = policy.mode
+            # what each weight is constrained to INSIDE fwd/bwd:
+            # replicated (gather mode / ZeRO-3 jit gather) or its mdl
+            # layout (compute mode — GSPMD shards the matmuls).  None
+            # when params are stored replicated anyway: no constraint,
+            # the classic level<3 pure-dp program.
+            cap.forward_shardings = [
+                policy.forward_sharding(p.data().shape,
+                                        name=name_of[id(p)])
+                for _, p, _ in items] \
+                if policy.needs_forward_constraint else None
         w_bytes = sum(p.data()._data.size * p.data()._data.dtype.itemsize
                       for _, p, _ in items)
         s_leaves = [leaf for i in cap.train_idx
@@ -801,6 +835,7 @@ class StepProgram:
                 "grads": policy.grad_collective_bytes(
                     int(sum(cap.bucket_nbytes))),
                 "param_gather": policy.param_gather_bytes(int(w_bytes)),
+                "mdl_gather": policy.mdl_param_bytes(int(w_bytes)),
             }
         cap.donation = {
             "params": {"arrays": len(items), "bytes": int(w_bytes),
@@ -827,6 +862,11 @@ class StepProgram:
              else int(cap.wire["grads"]),
              "axis": self._axis_name},
         ]
+        if gmesh is not None and gmesh.mdl > 1:
+            cap.segments.append({
+                "segment": "tensor_parallel", "mdl": gmesh.mdl,
+                "mode": cap.tp_mode,
+                "wire_bytes": int(cap.wire["mdl_gather"])})
         if cap.monitor:
             cap.segments.append({"segment": "stats",
                                  "groups": len(cap.group_list)})
@@ -922,6 +962,7 @@ class StepProgram:
         param_shardings = cap.param_shardings
         grad_shardings = cap.grad_shardings
         state_shardings = cap.state_shardings
+        forward_shardings = cap.forward_shardings
         replicated = cap.replicated
 
         def step_fn(train_datas, state_trees, other_datas, hscal, rng,
@@ -929,20 +970,25 @@ class StepProgram:
             base = dict(zip(other_names, other_datas))
 
             def fwd(tds):
-                if gmesh is not None and level >= 3:
-                    # ZeRO-3 just-in-time gather: each weight is
-                    # re-materialized (one all-gather per array,
-                    # scheduled by XLA right before first use and
-                    # freed after) INSIDE forward+backward.  The
-                    # explicit constraint also pins the fwd/bwd math
-                    # to the replicated program's exact contraction
-                    # order — sharded params must change layout, not
-                    # bits — and its transpose hands the cotangent
-                    # back toward the reduce-scattered shard layout.
+                if forward_shardings is not None:
+                    # Pin each weight's IN-PROGRAM layout.  Gather
+                    # mode (and ZeRO-3): the constraint is replicated
+                    # — each weight is re-materialized (one
+                    # all-gather per array, scheduled by XLA right
+                    # before first use and freed after) INSIDE
+                    # forward+backward, which also pins the fwd/bwd
+                    # math to the replicated program's exact
+                    # contraction order — sharded params change
+                    # layout, not bits — and its transpose hands the
+                    # cotangent back toward the sharded layout.
                     # Under remat the gathers replay in backward, so
-                    # peak parameter memory stays ~1/dp + live layer.
-                    tds = [jax.lax.with_sharding_constraint(t, replicated)
-                           for t in tds]
+                    # peak parameter memory stays ~1/(dp*mdl) + live
+                    # layer.  Compute mode: the constraint is the mdl
+                    # layout itself — GSPMD shards the consuming
+                    # matmuls (Megatron TP) and activation parity
+                    # becomes tolerance, not bitwise.
+                    tds = [jax.lax.with_sharding_constraint(t, s)
+                           for t, s in zip(tds, forward_shardings)]
                 pd = dict(base)
                 pd.update(zip(train_names, tds))
                 ctx = contextlib.nullcontext() if remat != "blocks" \
@@ -1153,6 +1199,22 @@ class StepProgram:
                         observe_collective(
                             "all_gather",
                             cap.donation["params"]["bytes"])
+                if _tel.ENABLED and cap.wire is not None:
+                    # per-axis priced wire bytes: what the first live
+                    # TPU window compares against measured step time
+                    if mesh_reduces:
+                        _tel.SHARD_COLLECTIVE_BYTES.labels(
+                            axis="dp",
+                            op="reduce_scatter" if cap.level >= 2
+                            else "all_reduce").inc(
+                            int(cap.wire["grads"]))
+                        _tel.SHARD_COLLECTIVE_BYTES.labels(
+                            axis="dp", op="all_gather").inc(
+                            int(cap.wire["param_gather"]))
+                    if cap.gmesh is not None and cap.gmesh.mdl > 1:
+                        _tel.SHARD_COLLECTIVE_BYTES.labels(
+                            axis="mdl", op="all_gather").inc(
+                            int(cap.wire.get("mdl_gather", 0) or 0))
                 if _tel.ENABLED:
                     _tel.STEP_CAPTURE_STEPS.labels(path="captured").inc()
                     _tel.STEP_PROGRAM_SECONDS.observe(

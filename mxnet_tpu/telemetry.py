@@ -918,6 +918,17 @@ SHARD_ZERO_LEVEL = gauge(
     "shard_zero_level",
     "ZeRO weight-update sharding level of the most recently placed "
     "captured step program (0 = replicated data-parallel)")
+SHARD_COLLECTIVE_BYTES = counter(
+    "shard_collective_bytes_total",
+    "priced wire bytes of mesh collectives issued by captured "
+    "programs, by mesh axis (dp / mdl) and collective op — the "
+    "per-axis comms bill the first live TPU window calibrates "
+    "against measured step time", ("axis", "op"))
+SHARD_TP_MODE = gauge(
+    "shard_tp_mode",
+    "tensor-parallel execution mode of the most recently placed "
+    "captured step program (0 = gather [bit-exact storage sharding], "
+    "1 = compute [Megatron sharded matmuls])")
 # mx.resilience (resilience/): deterministic fault injection,
 # preemption handling, and the hardened restart supervisor — plus the
 # serve-side graceful-degradation counters (bisect/poison/breakers).

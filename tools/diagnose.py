@@ -1163,6 +1163,71 @@ def resilience_info():
                                         "this process)"))
 
 
+def shard_info():
+    """mx.shard phase 2 state: the configured mesh, tensor-parallel
+    mode, layout-rule table, a per-parameter layout resolution for a
+    representative MLP on a dp=2 x mdl=2 mesh (virtual devices are
+    fine — same specs as a pod), and the per-axis collective-byte
+    counters."""
+    section("Shard (model parallelism)")
+    import jax
+
+    from mxnet_tpu import shard, telemetry
+    from mxnet_tpu.shard.policy import ShardPolicy
+
+    st = shard.state()
+    print("mesh         : %s" % (st["mesh"] or "(none configured — "
+                                 "set MXNET_SHARD_DP/MXNET_SHARD_MDL "
+                                 "or pass mesh= to the Trainer)"))
+    print("tp mode      : %s %s"
+          % (st["tp_mode"],
+             "(bit-exact storage sharding; weights re-gathered "
+             "in-program)" if st["tp_mode"] == "gather"
+             else "(Megatron sharded matmuls; tolerance parity)"))
+    rules = st["layout"]
+    if not rules:
+        print("layout table : (empty — every array resolves via the "
+              "implicit '* -> auto' tail rule)")
+    else:
+        print("layout table : %d rule(s), first match wins" % len(rules))
+        for r in rules:
+            print("  %-24s -> %s%s"
+                  % (r["pattern"], r["kind"],
+                     "" if r["dim"] is None else ":%d" % r["dim"]))
+    devs = jax.devices()
+    if len(devs) >= 4:
+        gm = shard.GlobalMesh(dp=2, mdl=2, devices=devs[:4])
+        pol = ShardPolicy(3, gm)
+        print("resolution   : dp=2 x mdl=2, zero=3 (representative "
+              "MLP shapes)")
+        for name, shape in (("dense0.weight", (16, 12)),
+                            ("dense0.bias", (16,)),
+                            ("dense1.weight", (4, 16)),
+                            ("dense1.bias", (4,))):
+            lo = pol.layout_of(name, shape)
+            print("  %-14s %-9s kind=%-9s mdl_dim=%-4s %s"
+                  % (name, "x".join(map(str, shape)), lo["kind"],
+                     lo["mdl_dim"], lo["spec"]))
+    else:
+        print("resolution   : skipped (%d device(s); need >= 4 for "
+              "the dp=2 x mdl=2 sample mesh)" % len(devs))
+    mode_gauge = telemetry.value("shard_tp_mode")
+    print("telemetry    : shard_tp_mode=%s zero_level=%s"
+          % (mode_gauge, telemetry.value("shard_zero_level")))
+    total = 0
+    for axis in ("dp", "mdl"):
+        for op in ("reduce_scatter", "all_reduce", "all_gather"):
+            v = telemetry.value("shard_collective_bytes_total",
+                                {"axis": axis, "op": op})
+            total += v
+            if v:
+                print("  wire       : axis=%-3s %-14s %d B" % (axis, op,
+                                                               v))
+    if not total:
+        print("  wire       : no collective bytes counted this "
+              "process (counters fill as captured sharded steps run)")
+
+
 def dist_info(root=None):
     """mx.dist state: membership backend + world view, collective
     deadline, pod-checkpoint discovery for an optional ROOT."""
@@ -1283,6 +1348,12 @@ def main():
                          "live loaders, ring depth/occupancy/stalls, "
                          "per-worker read rates, cursor state, data_* "
                          "telemetry")
+    ap.add_argument("--shard", action="store_true",
+                    help="mx.shard model-parallel plane: configured "
+                         "mesh, tp mode (gather/compute), layout-rule "
+                         "table, per-parameter spec resolution on a "
+                         "sample dp=2 x mdl=2 mesh, per-axis "
+                         "collective-byte counters")
     ap.add_argument("--dist", nargs="?", const="", metavar="CKPT_ROOT",
                     help="dump the mx.dist plane: membership/world "
                          "view, collective deadline, world-stop flag, "
@@ -1321,7 +1392,7 @@ def main():
             args.trainer or args.step or args.trace or args.monitor or \
             args.resilience or args.autotune or args.data or \
             args.dist is not None or args.fleet or args.fleet_router \
-            or args.cache or args.tenant:
+            or args.cache or args.tenant or args.shard:
         if args.compile_cache:
             compile_cache_info()
         if args.autotune:
@@ -1330,6 +1401,8 @@ def main():
             data_info()
         if args.resilience:
             resilience_info()
+        if args.shard:
+            shard_info()
         if args.dist is not None:
             dist_info(args.dist or None)
         if args.fleet:

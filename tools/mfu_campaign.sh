@@ -39,6 +39,16 @@ while true; do
       > "$OUT/loader_scaling.txt" 2>&1
     timeout 900 python benchmark/data_bench.py --train \
       > "$OUT/loader_train.txt" 2>&1
+    # mx.shard phase 2 on real chips: the gather-mode mdl=2 captured
+    # step + tp x zero3 interaction + sharded-decode compile flatness
+    # (bench rows shard_tp_step / shard_pipeline_step run inside
+    # bench.py above; these drills assert the parity/residency bars
+    # and dump the layout-resolution table for PERF_PLAN's tp rows)
+    timeout 900 python tools/shard_smoke.py \
+      > "$OUT/shard_smoke.txt" 2>&1
+    echo "$(date -u +%FT%TZ) shard smoke rc=$?" >> "$LOG"
+    timeout 300 python tools/diagnose.py --shard \
+      > "$OUT/shard_diag.txt" 2>&1
     # mx.autotune hypothesis capture: tune every measurable site at
     # TPU keys into a persistent store, then print the winner table
     # (PERF_PLAN section 4 TPU columns)

@@ -1,0 +1,267 @@
+#!/usr/bin/env python
+"""mx.shard phase 2 smoke (make shard-smoke, CPU, 8 virtual devices).
+
+Drills tensor + pipeline model parallelism of the captured step on the
+``mdl`` axis end to end over virtual CPU devices (a pod runs the same
+programs over real chips):
+
+1. **tp acceptance block**: the dp=2 x mdl=2 gather-mode captured step
+   is ONE program, bit-identical params AND optimizer state vs the
+   mdl=1 captured reference at the same dp, per-device parameter bytes
+   halved, the mdl all-gather priced on the wire and counted in
+   ``shard_collective_bytes_total{axis=mdl}``; composing ZeRO-3 takes
+   storage to ~1/(dp*mdl), still bit-exact.
+2. **pipeline stage-kill drill**: a membership world-stop posted
+   mid-run fences the NEXT 1F1B step before any stage program consumes
+   a donated buffer — the trainer stays whole and resumes bit-for-bit
+   once the flag clears (the PR 9 deadline + membership envelope on
+   the captured pipeline).
+3. **sharded-decode byte parity**: an mdl=2 DecodeRunner emits the
+   byte-identical greedy token stream vs the unsharded runner, with
+   head-sharded KV pages at 1/2 per-device residency and ZERO fresh
+   compiles after warm_up (``serve_decode_compile_total`` flat).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from _virtual_devices import force_virtual_cpu  # noqa: E402
+
+force_virtual_cpu(8)
+
+STEPS = 10
+BATCH, DIN, DOUT = 8, 12, 4
+
+
+def _mesh(dp, mdl=1):
+    import jax
+
+    from mxnet_tpu import shard
+
+    return shard.GlobalMesh(dp=dp, mdl=mdl,
+                            devices=jax.devices()[:dp * mdl])
+
+
+def build(zero, mesh, seed=7):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=DIN),
+            nn.Dense(DOUT, in_units=16))
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01},
+                            zero=zero, mesh=mesh)
+    prog = trainer.capture(net, gluon.loss.L2Loss())
+    return net, trainer, prog
+
+
+def batch(seed=0):
+    import numpy as np
+
+    from mxnet_tpu import nd
+
+    rs = np.random.RandomState(seed)
+    return (nd.array(rs.rand(BATCH, DIN).astype(np.float32)),
+            nd.array(rs.rand(BATCH, DOUT).astype(np.float32)))
+
+
+def assert_same(net_a, net_b, what):
+    import numpy as np
+
+    pa, pb = net_a.collect_params(), net_b.collect_params()
+    for k in pa:
+        if not np.array_equal(pa[k].data().asnumpy(),
+                              pb[k].data().asnumpy()):
+            raise SystemExit("FAIL[%s]: param %s differs" % (what, k))
+
+
+def stage1_tp_acceptance():
+    from mxnet_tpu import shard, telemetry
+
+    telemetry.enable()
+    x, y = batch()
+    net_r, tr_r, prog_r = build(0, _mesh(2))
+    for _ in range(STEPS):
+        prog_r(x, y)
+    rep_r = prog_r.report()
+    assert rep_r["paths"] == {"captured": STEPS, "stitched": 0}, rep_r
+
+    net_t, tr_t, prog_t = build(0, _mesh(2, mdl=2))
+    before = telemetry.value("step_capture_builds_total")
+    for _ in range(STEPS):
+        prog_t(x, y)
+    builds = telemetry.value("step_capture_builds_total") - before
+    if builds != 1:
+        raise SystemExit("FAIL[1]: %d captured builds for %d mdl=2 "
+                         "steps (want 1)" % (builds, STEPS))
+    rep_t = prog_t.report()
+    assert rep_t["paths"] == {"captured": STEPS, "stitched": 0}, rep_t
+    assert_same(net_r, net_t, "1:tp-parity")
+
+    def param_bytes(net):
+        return shard.device_bytes(
+            [p.data() for p in net.collect_params().values()])
+
+    pr, pt = param_bytes(net_r), param_bytes(net_t)
+    if pt > pr / 2 + 64:
+        raise SystemExit("FAIL[1]: mdl=2 params not ~1/2 resident: "
+                         "%d/%d B/device" % (pt, pr))
+    prog_row = rep_t["programs"][0]
+    if prog_row["tp_mode"] != "gather" or \
+            prog_row["wire"]["mdl_gather"] <= 0:
+        raise SystemExit("FAIL[1]: mdl gather not priced: %r"
+                         % (prog_row["wire"],))
+    if telemetry.value("shard_collective_bytes_total",
+                       {"axis": "mdl", "op": "all_gather"}) <= 0:
+        raise SystemExit("FAIL[1]: shard_collective_bytes_total"
+                         "{axis=mdl} not counted")
+
+    net_z, tr_z, prog_z = build(3, _mesh(2, mdl=2))
+    for _ in range(STEPS):
+        prog_z(x, y)
+    assert_same(net_r, net_z, "1:tp-zero3-parity")
+    pz = param_bytes(net_z)
+    if pz > pr / 4 + 64:
+        raise SystemExit("FAIL[1]: zero3 x mdl=2 params not ~1/4 "
+                         "resident: %d/%d B/device" % (pz, pr))
+    print("PASS stage 1: mdl=2 gather ONE program, %d-step bit parity, "
+          "params %d->%d B/device (x zero3 -> %d), mdl all-gather %d "
+          "wire B/step" % (STEPS, pr, pt, pz,
+                           prog_row["wire"]["mdl_gather"]))
+
+
+def stage2_pipeline_stage_kill():
+    import numpy as np
+
+    import mxnet_tpu as mx
+    import mxnet_tpu.dist as dist
+    from mxnet_tpu import parallel
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.gluon import nn
+
+    mesh = parallel.make_mesh({"pp": 2})
+    np.random.seed(5)
+    X = np.random.rand(8, 12).astype(np.float32)
+    Y = np.random.randint(0, 8, 8).astype(np.int32)
+
+    def _net(seed):
+        mx.random.seed(seed)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(8))
+        net.initialize()
+        return net
+
+    def _pipe(seed):
+        return parallel.PipelineTrainer(
+            _net(seed), loss="softmax_ce", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            mesh=mesh, num_microbatches=2, schedule="1f1b")
+
+    ref = _pipe(13)
+    for _ in range(4):
+        ref.step(X, Y)
+
+    pipe = _pipe(13)
+    for _ in range(2):
+        pipe.step(X, Y)
+
+    class _StopMembership:
+        def poll_stop(self):
+            return {"reason": "stage-kill", "rank": 1, "step": 2}
+
+    old = dist._MEMBERSHIP
+    dist._MEMBERSHIP = _StopMembership()
+    try:
+        try:
+            pipe.step(X, Y)
+        except MXNetError as exc:
+            if "membership stop" not in str(exc):
+                raise SystemExit("FAIL[2]: wrong fence error: %r"
+                                 % (exc,))
+        else:
+            raise SystemExit("FAIL[2]: stage kill did NOT fence the "
+                             "pipeline step")
+    finally:
+        dist._MEMBERSHIP = old
+    # the fence fired BEFORE any donation: state is whole, training
+    # resumes and lands exactly where the unfaulted run does
+    for _ in range(2):
+        pipe.step(X, Y)
+    pipe.sync_block()
+    ref.sync_block()
+    assert_same(ref._block, pipe._block, "2:post-fence-parity")
+    print("PASS stage 2: mid-run stage kill fenced the 1F1B step at "
+          "the envelope (no donated buffer consumed); resumed run is "
+          "bit-identical to the unfaulted pipeline")
+
+
+def stage3_sharded_decode_parity():
+    import mxnet_tpu as mx
+    from mxnet_tpu import serve, telemetry
+
+    def _decoder():
+        mx.random.seed(0)
+        blk = serve.TinyDecoder(vocab_size=32, num_layers=2,
+                                num_heads=2, head_dim=4)
+        blk.initialize()
+        return blk
+
+    def _config():
+        return serve.DecodeConfig(page_size=4, pool_pages=32,
+                                  max_live=2, max_new_tokens=6,
+                                  max_context=16, prefill_lengths=(8,),
+                                  batch_sizes=(1, 2))
+
+    def collect(runner, prompts):
+        sched = serve.DecodeScheduler(runner)
+        try:
+            futs = [sched.submit(p, max_new_tokens=6) for p in prompts]
+            return [f.result(timeout=120)["tokens"] for f in futs]
+        finally:
+            sched.stop()
+
+    prompts = [[1, 2, 3], [4, 5, 6, 7], [8, 9]]
+    ref_runner = serve.DecodeRunner(_decoder(), config=_config())
+    ref = collect(ref_runner, prompts)
+
+    gm = _mesh(1, mdl=2)
+    runner = serve.DecodeRunner(_decoder(), config=_config(), mesh=gm)
+    runner.warm_up()
+    before = telemetry.value("serve_decode_compile_total")
+    got = collect(runner, prompts)
+    delta = telemetry.value("serve_decode_compile_total") - before
+    if got != ref:
+        raise SystemExit("FAIL[3]: sharded token stream differs:\n"
+                         "  ref %r\n  got %r" % (ref, got))
+    if delta != 0:
+        raise SystemExit("FAIL[3]: %d fresh compiles after warm_up "
+                         "(want 0)" % delta)
+    total = runner.pool.k.nbytes + runner.pool.v.nbytes
+    dev = runner.pool.device_bytes()
+    if dev * 2 != total:
+        raise SystemExit("FAIL[3]: KV pages not 1/2 resident: "
+                         "%d of %d B" % (dev, total))
+    runner.pool.check()
+    print("PASS stage 3: mdl=2 decode byte-identical (%d tokens), 0 "
+          "compiles after warm_up, KV pages %d->%d B/device"
+          % (sum(len(t) for t in got), total, dev))
+
+
+def main():
+    stage1_tp_acceptance()
+    stage2_pipeline_stage_kill()
+    stage3_sharded_decode_parity()
+    print("shard smoke: all stages passed")
+
+
+if __name__ == "__main__":
+    main()
